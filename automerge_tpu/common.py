@@ -1,0 +1,43 @@
+"""Shared helpers (ref src/common.js, src/uuid.js)."""
+
+import uuid as _uuid
+
+
+def parse_op_id(op_id):
+    """Parse 'counter@actorId' into (counter, actor_id) (ref src/common.js:32-38)."""
+    counter, sep, actor_id = op_id.partition('@')
+    if not sep or not counter.isdigit():
+        raise ValueError(f'Not a valid opId: {op_id}')
+    return int(counter), actor_id
+
+
+def compare_op_ids(a, b):
+    """Lamport order on 'counter@actor' strings: by counter, then actorId."""
+    ac, aa = parse_op_id(a)
+    bc, ba = parse_op_id(b)
+    if ac != bc:
+        return -1 if ac < bc else 1
+    if aa != ba:
+        return -1 if aa < ba else 1
+    return 0
+
+
+def lamport_key(op_id):
+    """Sort key giving ascending Lamport order for 'counter@actor' opIds."""
+    counter, actor = parse_op_id(op_id)
+    return (counter, actor)
+
+
+_uuid_factory = None
+
+
+def set_uuid_factory(factory):
+    """Override uuid generation, e.g. for deterministic tests (ref src/uuid.js:13)."""
+    global _uuid_factory
+    _uuid_factory = factory
+
+
+def uuid():
+    if _uuid_factory is not None:
+        return _uuid_factory()
+    return _uuid.uuid4().hex

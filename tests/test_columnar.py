@@ -1,0 +1,163 @@
+"""Columnar change-format tests, ported from reference test/columnar_test.js,
+plus extra round-trip coverage."""
+
+import pytest
+
+from automerge_tpu.columnar import encode_change, decode_change
+
+
+class TestChangeEncoding:
+    def test_encode_text_edits_exact_bytes(self):
+        change1 = {'actor': 'aaaa', 'seq': 1, 'startOp': 1, 'time': 9, 'message': '',
+                   'deps': [], 'ops': [
+            {'action': 'makeText', 'obj': '_root', 'key': 'text', 'insert': False, 'pred': []},
+            {'action': 'set', 'obj': '1@aaaa', 'elemId': '_head', 'insert': True,
+             'value': 'h', 'pred': []},
+            {'action': 'del', 'obj': '1@aaaa', 'elemId': '2@aaaa', 'insert': False,
+             'pred': ['2@aaaa']},
+            {'action': 'set', 'obj': '1@aaaa', 'elemId': '_head', 'insert': True,
+             'value': 'H', 'pred': []},
+            {'action': 'set', 'obj': '1@aaaa', 'elemId': '4@aaaa', 'insert': True,
+             'value': 'i', 'pred': []},
+        ]}
+        expected = bytes([
+            0x85, 0x6f, 0x4a, 0x83,  # magic bytes
+            0xe2, 0xbd, 0xfb, 0xf5,  # checksum
+            1, 94, 0, 2, 0xaa, 0xaa,  # chunkType: change, length, deps, actor 'aaaa'
+            1, 1, 9, 0, 0,  # seq, startOp, time, message, actor list
+            12, 0x01, 4, 0x02, 4,  # column count, objActor, objCtr
+            0x11, 8, 0x13, 7, 0x15, 8,  # keyActor, keyCtr, keyStr
+            0x34, 4, 0x42, 6,  # insert, action
+            0x56, 6, 0x57, 3,  # valLen, valRaw
+            0x70, 6, 0x71, 2, 0x73, 2,  # predNum, predActor, predCtr
+            0, 1, 4, 0,  # objActor column: null, 0, 0, 0, 0
+            0, 1, 4, 1,  # objCtr column: null, 1, 1, 1, 1
+            0, 2, 0x7f, 0, 0, 1, 0x7f, 0,  # keyActor column: null, null, 0, null, 0
+            0, 1, 0x7c, 0, 2, 0x7e, 4,  # keyCtr column: null, 0, 2, 0, 4
+            0x7f, 4, 0x74, 0x65, 0x78, 0x74, 0, 4,  # keyStr column: 'text', null x4
+            1, 1, 1, 2,  # insert column: false, true, false, true, true
+            0x7d, 4, 1, 3, 2, 1,  # action column: makeText, set, del, set, set
+            0x7d, 0, 0x16, 0, 2, 0x16,  # valLen column
+            0x68, 0x48, 0x69,  # valRaw column: 'h', 'H', 'i'
+            2, 0, 0x7f, 1, 2, 0,  # predNum column: 0, 0, 1, 0, 0
+            0x7f, 0,  # predActor column: 0
+            0x7f, 2,  # predCtr column: 2
+        ])
+        assert encode_change(change1) == expected
+        decoded = decode_change(encode_change(change1))
+        expected_decoded = dict(change1, hash=decoded['hash'])
+        assert decoded == expected_decoded
+
+    def test_strict_pred_ordering(self):
+        change = bytes([
+            133, 111, 74, 131, 31, 229, 112, 44, 1, 105, 1, 58, 30, 190, 100, 253, 180,
+            180, 66, 49, 126, 81, 142, 10, 3, 35, 140, 189, 231, 34, 145, 57, 66, 23,
+            224, 149, 64, 97, 88, 140, 168, 194, 229, 4, 244, 209, 58, 138, 67, 140, 1,
+            152, 236, 250, 2, 0, 1, 4, 55, 234, 66, 242, 8, 21, 11, 52, 1, 66, 2, 86, 3,
+            87, 10, 112, 2, 113, 3, 115, 4, 127, 9, 99, 111, 109, 109, 111, 110, 86, 97,
+            114, 1, 127, 1, 127, 166, 1, 52, 48, 57, 49, 52, 57, 52, 53, 56, 50, 127, 2,
+            126, 0, 1, 126, 139, 1, 0,
+        ])
+        with pytest.raises(ValueError, match='operation IDs are not in ascending order'):
+            decode_change(change)
+
+    TRAILING_BYTES_CHANGE = bytes([
+        0x85, 0x6f, 0x4a, 0x83,  # magic bytes
+        0xb2, 0x98, 0x9e, 0xa9,  # checksum
+        1, 61, 0, 2, 0x12, 0x34,  # chunkType: change, length, deps, actor '1234'
+        1, 1, 252, 250, 220, 255, 5,  # seq, startOp, time
+        14, 73, 110, 105, 116, 105, 97, 108, 105, 122, 97, 116, 105, 111, 110,  # message
+        0, 6,  # actor list, column count
+        0x15, 3, 0x34, 1, 0x42, 2,  # keyStr, insert, action
+        0x56, 2, 0x57, 1, 0x70, 2,  # valLen, valRaw, predNum
+        0x7f, 1, 0x78,  # keyStr: 'x'
+        1,  # insert: false
+        0x7f, 1,  # action: set
+        0x7f, 19,  # valLen: 1 byte of type uint
+        1,  # valRaw: 1
+        0x7f, 0,  # predNum: 0
+        0, 1, 2, 3, 4, 5, 6, 7, 8, 9,  # 10 trailing bytes
+    ])
+
+    def test_trailing_bytes_decode_reencode(self):
+        assert encode_change(decode_change(self.TRAILING_BYTES_CHANGE)) == \
+            self.TRAILING_BYTES_CHANGE
+
+
+class TestRoundTrips:
+    def test_map_ops_round_trip(self):
+        change = {'actor': 'deadbeef', 'seq': 1, 'startOp': 1, 'time': 0,
+                  'message': 'hi', 'deps': [], 'ops': [
+            {'action': 'set', 'obj': '_root', 'key': 'a', 'insert': False,
+             'value': 'magpie', 'pred': []},
+            {'action': 'set', 'obj': '_root', 'key': 'b', 'insert': False,
+             'value': 42, 'datatype': 'int', 'pred': []},
+            {'action': 'set', 'obj': '_root', 'key': 'c', 'insert': False,
+             'value': 1.5, 'datatype': 'float64', 'pred': []},
+            {'action': 'set', 'obj': '_root', 'key': 'd', 'insert': False,
+             'value': True, 'pred': []},
+            {'action': 'set', 'obj': '_root', 'key': 'e', 'insert': False,
+             'value': None, 'pred': []},
+            {'action': 'set', 'obj': '_root', 'key': 'f', 'insert': False,
+             'value': 3, 'datatype': 'counter', 'pred': []},
+            {'action': 'set', 'obj': '_root', 'key': 'g', 'insert': False,
+             'value': 1609459200000, 'datatype': 'timestamp', 'pred': []},
+        ]}
+        decoded = decode_change(encode_change(change))
+        assert decoded['actor'] == 'deadbeef'
+        assert decoded['message'] == 'hi'
+        ops = decoded['ops']
+        assert ops[0]['value'] == 'magpie'
+        assert ops[1]['value'] == 42 and ops[1]['datatype'] == 'int'
+        assert ops[2]['value'] == 1.5 and ops[2]['datatype'] == 'float64'
+        assert ops[3]['value'] is True
+        assert ops[4]['value'] is None
+        assert ops[5]['value'] == 3 and ops[5]['datatype'] == 'counter'
+        assert ops[6]['value'] == 1609459200000 and ops[6]['datatype'] == 'timestamp'
+
+    def test_multi_actor_preds_round_trip(self):
+        change = {'actor': 'aaaa', 'seq': 2, 'startOp': 5, 'time': 123,
+                  'message': '', 'deps': [], 'ops': [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'insert': False,
+             'value': 1, 'datatype': 'int', 'pred': ['3@bbbb', '4@aaaa']},
+        ]}
+        decoded = decode_change(encode_change(change))
+        # preds are sorted into Lamport order on encode
+        assert decoded['ops'][0]['pred'] == ['3@bbbb', '4@aaaa']
+
+    def test_deps_round_trip(self):
+        h1 = 'aa' * 32
+        h2 = 'bb' * 32
+        change = {'actor': 'abcd', 'seq': 3, 'startOp': 10, 'time': 1, 'message': 'm',
+                  'deps': [h2, h1], 'ops': [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'insert': False,
+             'value': 1, 'datatype': 'uint', 'pred': []},
+        ]}
+        decoded = decode_change(encode_change(change))
+        assert decoded['deps'] == [h1, h2]  # sorted
+
+    def test_large_change_deflated(self):
+        ops = [{'action': 'set', 'obj': '_root', 'key': f'key-{i:04d}', 'insert': False,
+                'value': f'value-{i}', 'pred': []} for i in range(100)]
+        change = {'actor': 'cafe', 'seq': 1, 'startOp': 1, 'time': 0, 'message': '',
+                  'deps': [], 'ops': ops}
+        encoded = encode_change(change)
+        assert encoded[8] == 2  # CHUNK_TYPE_DEFLATE
+        decoded = decode_change(encoded)
+        assert len(decoded['ops']) == 100
+        assert decoded['ops'][99]['value'] == 'value-99'
+
+    def test_multi_insert_expansion(self):
+        change = {'actor': 'aaaa', 'seq': 1, 'startOp': 1, 'time': 0, 'message': '',
+                  'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'list', 'insert': False,
+             'pred': []},
+            {'action': 'set', 'obj': '1@aaaa', 'elemId': '_head', 'insert': True,
+             'values': [1, 2, 3], 'datatype': 'int', 'pred': []},
+        ]}
+        decoded = decode_change(encode_change(change))
+        assert len(decoded['ops']) == 4
+        assert [op.get('value') for op in decoded['ops'][1:]] == [1, 2, 3]
+        assert decoded['ops'][1]['elemId'] == '_head'
+        assert decoded['ops'][2]['elemId'] == '2@aaaa'
+        assert decoded['ops'][3]['elemId'] == '3@aaaa'

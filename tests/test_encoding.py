@@ -1,0 +1,846 @@
+"""Codec conformance tests, ported from reference test/encoding_test.js.
+
+Exact-byte assertions guarantee wire compatibility of the LEB128/RLE/
+delta/boolean column codecs.
+"""
+
+import pytest
+
+from automerge_tpu.encoding import (
+    Encoder, Decoder, RLEEncoder, RLEDecoder, DeltaEncoder, DeltaDecoder,
+    BooleanEncoder, BooleanDecoder,
+)
+
+MAX_SAFE = 2 ** 53 - 1
+MIN_SAFE = -(2 ** 53 - 1)
+
+
+def check_encoded(encoder, expected):
+    assert encoder.buffer == bytes(expected)
+
+
+def enc(method, value):
+    e = Encoder()
+    getattr(e, method)(value)
+    return e
+
+
+class TestLeb128_32bit:
+    CASES_UINT = [
+        (0, [0]), (1, [1]), (0x42, [0x42]), (0x7f, [0x7f]), (0x80, [0x80, 0x01]),
+        (0xff, [0xff, 0x01]), (0x1234, [0xb4, 0x24]), (0x3fff, [0xff, 0x7f]),
+        (0x4000, [0x80, 0x80, 0x01]), (0x5678, [0xf8, 0xac, 0x01]),
+        (0xfffff, [0xff, 0xff, 0x3f]), (0x1fffff, [0xff, 0xff, 0x7f]),
+        (0x200000, [0x80, 0x80, 0x80, 0x01]), (0xfffffff, [0xff, 0xff, 0xff, 0x7f]),
+        (0x10000000, [0x80, 0x80, 0x80, 0x80, 0x01]),
+        (0x7fffffff, [0xff, 0xff, 0xff, 0xff, 0x07]),
+        (0x87654321, [0xa1, 0x86, 0x95, 0xbb, 0x08]),
+        (0xffffffff, [0xff, 0xff, 0xff, 0xff, 0x0f]),
+    ]
+    CASES_INT = [
+        (0, [0]), (1, [1]), (-1, [0x7f]), (0x3f, [0x3f]), (0x40, [0xc0, 0x00]),
+        (-0x3f, [0x41]), (-0x40, [0x40]), (-0x41, [0xbf, 0x7f]),
+        (0x1fff, [0xff, 0x3f]), (0x2000, [0x80, 0xc0, 0x00]), (-0x2000, [0x80, 0x40]),
+        (-0x2001, [0xff, 0xbf, 0x7f]), (0xfffff, [0xff, 0xff, 0x3f]),
+        (0x100000, [0x80, 0x80, 0xc0, 0x00]), (-0x100000, [0x80, 0x80, 0x40]),
+        (-0x100001, [0xff, 0xff, 0xbf, 0x7f]), (0x7ffffff, [0xff, 0xff, 0xff, 0x3f]),
+        (0x8000000, [0x80, 0x80, 0x80, 0xc0, 0x00]), (-0x8000000, [0x80, 0x80, 0x80, 0x40]),
+        (-0x8000001, [0xff, 0xff, 0xff, 0xbf, 0x7f]),
+        (0x76543210, [0x90, 0xe4, 0xd0, 0xb2, 0x07]),
+        (-0x76543210, [0xf0, 0x9b, 0xaf, 0xcd, 0x78]),
+        (0x7fffffff, [0xff, 0xff, 0xff, 0xff, 0x07]),
+        (-0x80000000, [0x80, 0x80, 0x80, 0x80, 0x78]),
+    ]
+
+    def test_encode_unsigned(self):
+        for value, expected in self.CASES_UINT:
+            check_encoded(enc('append_uint32', value), expected)
+
+    def test_round_trip_unsigned(self):
+        for value, _ in self.CASES_UINT:
+            d = Decoder(enc('append_uint32', value).buffer)
+            assert d.read_uint32() == value
+            assert d.done
+
+    def test_encode_signed(self):
+        for value, expected in self.CASES_INT:
+            check_encoded(enc('append_int32', value), expected)
+
+    def test_round_trip_signed(self):
+        for value, _ in self.CASES_INT:
+            d = Decoder(enc('append_int32', value).buffer)
+            assert d.read_int32() == value
+            assert d.done
+
+    def test_encode_out_of_range(self):
+        for bad in (0x100000000, MAX_SAFE, -1, -0x80000000):
+            with pytest.raises(ValueError, match='out of range'):
+                Encoder().append_uint32(bad)
+        for bad in (0x80000000, MAX_SAFE, -0x80000001):
+            with pytest.raises(ValueError, match='out of range'):
+                Encoder().append_int32(bad)
+        for bad in (float('-inf'), float('nan'), 3.14159):
+            with pytest.raises(ValueError, match='not an integer'):
+                Encoder().append_uint32(bad)
+            with pytest.raises(ValueError, match='not an integer'):
+                Encoder().append_int32(bad)
+
+    def test_decode_out_of_range(self):
+        with pytest.raises(ValueError, match='out of range'):
+            Decoder(bytes([0x80, 0x80, 0x80, 0x80, 0x80, 0x00])).read_uint32()
+        with pytest.raises(ValueError, match='out of range'):
+            Decoder(bytes([0x80, 0x80, 0x80, 0x80, 0x80, 0x00])).read_int32()
+        with pytest.raises(ValueError, match='out of range'):
+            Decoder(bytes([0x80, 0x80, 0x80, 0x80, 0x10])).read_uint32()
+        with pytest.raises(ValueError, match='out of range'):
+            Decoder(bytes([0x80, 0x80, 0x80, 0x80, 0x08])).read_int32()
+        with pytest.raises(ValueError, match='out of range'):
+            Decoder(bytes([0xff, 0xff, 0xff, 0xff, 0x77])).read_int32()
+        with pytest.raises(ValueError, match='incomplete number'):
+            Decoder(bytes([0x80, 0x80])).read_uint32()
+        with pytest.raises(ValueError, match='incomplete number'):
+            Decoder(bytes([0x80, 0x80])).read_int32()
+
+
+class TestLeb128_53bit:
+    CASES_UINT = [
+        (0, [0]), (0x7f, [0x7f]), (0x80, [0x80, 0x01]), (0x3fff, [0xff, 0x7f]),
+        (0x4000, [0x80, 0x80, 0x01]), (0x1fffff, [0xff, 0xff, 0x7f]),
+        (0x200000, [0x80, 0x80, 0x80, 0x01]), (0xfffffff, [0xff, 0xff, 0xff, 0x7f]),
+        (0x10000000, [0x80, 0x80, 0x80, 0x80, 0x01]),
+        (0xffffffff, [0xff, 0xff, 0xff, 0xff, 0x0f]),
+        (0x100000000, [0x80, 0x80, 0x80, 0x80, 0x10]),
+        (0x7ffffffff, [0xff, 0xff, 0xff, 0xff, 0x7f]),
+        (0x800000000, [0x80, 0x80, 0x80, 0x80, 0x80, 0x01]),
+        (0x3ffffffffff, [0xff, 0xff, 0xff, 0xff, 0xff, 0x7f]),
+        (0x40000000000, [0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x01]),
+        (0x2000000000000, [0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x01]),
+        (0x123456789abcde, [0xde, 0xf9, 0xea, 0xc4, 0xe7, 0x8a, 0x8d, 0x09]),
+        (MAX_SAFE, [0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x0f]),
+    ]
+    CASES_INT = [
+        (0, [0]), (1, [1]), (-1, [0x7f]), (0x3f, [0x3f]), (-0x40, [0x40]),
+        (0x40, [0xc0, 0x00]), (-0x41, [0xbf, 0x7f]), (0x1fff, [0xff, 0x3f]),
+        (-0x2000, [0x80, 0x40]), (0x2000, [0x80, 0xc0, 0x00]),
+        (-0x2001, [0xff, 0xbf, 0x7f]), (0xfffff, [0xff, 0xff, 0x3f]),
+        (-0x100000, [0x80, 0x80, 0x40]), (0x100000, [0x80, 0x80, 0xc0, 0x00]),
+        (-0x100001, [0xff, 0xff, 0xbf, 0x7f]), (0x7ffffff, [0xff, 0xff, 0xff, 0x3f]),
+        (-0x8000000, [0x80, 0x80, 0x80, 0x40]), (0x8000000, [0x80, 0x80, 0x80, 0xc0, 0x00]),
+        (-0x8000001, [0xff, 0xff, 0xff, 0xbf, 0x7f]),
+        (0x7fffffff, [0xff, 0xff, 0xff, 0xff, 0x07]),
+        (0x80000000, [0x80, 0x80, 0x80, 0x80, 0x08]),
+        (-0x80000000, [0x80, 0x80, 0x80, 0x80, 0x78]),
+        (-0x80000001, [0xff, 0xff, 0xff, 0xff, 0x77]),
+        (0x3ffffffff, [0xff, 0xff, 0xff, 0xff, 0x3f]),
+        (-0x400000000, [0x80, 0x80, 0x80, 0x80, 0x40]),
+        (0x400000000, [0x80, 0x80, 0x80, 0x80, 0xc0, 0x00]),
+        (-0x400000001, [0xff, 0xff, 0xff, 0xff, 0xbf, 0x7f]),
+        (0x1ffffffffff, [0xff, 0xff, 0xff, 0xff, 0xff, 0x3f]),
+        (-0x20000000000, [0x80, 0x80, 0x80, 0x80, 0x80, 0x40]),
+        (0x20000000000, [0x80, 0x80, 0x80, 0x80, 0x80, 0xc0, 0x00]),
+        (-0x20000000001, [0xff, 0xff, 0xff, 0xff, 0xff, 0xbf, 0x7f]),
+        (0xffffffffffff, [0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x3f]),
+        (-0x1000000000000, [0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x40]),
+        (0x1000000000000, [0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0xc0, 0x00]),
+        (-0x1000000000001, [0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xbf, 0x7f]),
+        (0x123456789abcde, [0xde, 0xf9, 0xea, 0xc4, 0xe7, 0x8a, 0x8d, 0x09]),
+        (MAX_SAFE, [0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x0f]),
+        (MIN_SAFE, [0x81, 0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x70]),
+    ]
+
+    def test_encode_unsigned(self):
+        for value, expected in self.CASES_UINT:
+            check_encoded(enc('append_uint53', value), expected)
+
+    def test_round_trip_unsigned(self):
+        for value, _ in self.CASES_UINT:
+            d = Decoder(enc('append_uint53', value).buffer)
+            assert d.read_uint53() == value
+            assert d.done
+
+    def test_encode_signed(self):
+        for value, expected in self.CASES_INT:
+            check_encoded(enc('append_int53', value), expected)
+
+    def test_round_trip_signed(self):
+        extra = []
+        for mag in (0x123, 0x1234, 0x12345, 0x123456, 0x1234567, 0x12345678,
+                    0x123456789, 0x123456789a, 0x123456789ab, 0x123456789abc,
+                    0x123456789abcd, 0x123456789abcde):
+            extra.extend([(mag, None), (-mag, None)])
+        for value, _ in self.CASES_INT + extra:
+            d = Decoder(enc('append_int53', value).buffer)
+            assert d.read_int53() == value
+            assert d.done
+
+    def test_encode_out_of_range(self):
+        for bad in (MAX_SAFE + 1, -1, -0x80000000, MIN_SAFE):
+            with pytest.raises(ValueError, match='out of range'):
+                Encoder().append_uint53(bad)
+        for bad in (MAX_SAFE + 1, MIN_SAFE - 1):
+            with pytest.raises(ValueError, match='out of range'):
+                Encoder().append_int53(bad)
+        for bad in (float('-inf'), float('nan'), 3.14159):
+            with pytest.raises(ValueError, match='not an integer'):
+                Encoder().append_uint53(bad)
+            with pytest.raises(ValueError, match='not an integer'):
+                Encoder().append_int53(bad)
+
+    def test_decode_out_of_range(self):
+        with pytest.raises(ValueError, match='out of range'):
+            Decoder(bytes([0x80] * 7 + [0x10])).read_uint53()
+        with pytest.raises(ValueError, match='out of range'):
+            Decoder(bytes([0x80] * 7 + [0x10])).read_int53()
+        with pytest.raises(ValueError, match='out of range'):
+            Decoder(bytes([0x80] * 7 + [0x70])).read_int53()
+        with pytest.raises(ValueError, match='out of range'):
+            Decoder(bytes([0xff] * 7 + [0x6f])).read_int53()
+        with pytest.raises(ValueError, match='incomplete number'):
+            Decoder(bytes([0x80, 0x80])).read_uint53()
+        with pytest.raises(ValueError, match='incomplete number'):
+            Decoder(bytes([0x80, 0x80])).read_int53()
+
+
+class TestLeb128_64bit:
+    # (value, expected bytes); values written as (high32, low32) pairs in the
+    # reference are combined here since Python ints are arbitrary precision
+    CASES_UINT = [
+        (0, [0]), (0x7f, [0x7f]), (0x80, [0x80, 0x01]), (0x3fff, [0xff, 0x7f]),
+        (0xffffffff, [0xff, 0xff, 0xff, 0xff, 0x0f]),
+        (0x100000000, [0x80, 0x80, 0x80, 0x80, 0x10]),
+        (0x7ffffffff, [0xff, 0xff, 0xff, 0xff, 0x7f]),
+        (0x800000000, [0x80, 0x80, 0x80, 0x80, 0x80, 0x01]),
+        (0x3ffffffffff, [0xff, 0xff, 0xff, 0xff, 0xff, 0x7f]),
+        (0x40000000000, [0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x01]),
+        (0x1ffffffffffff, [0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x7f]),
+        (0x2000000000000, [0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x01]),
+        (0xffffffffffffff, [0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x7f]),
+        (0x100000000000000, [0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x01]),
+        (0xffffffffffffffff, [0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x01]),
+    ]
+    CASES_INT = [
+        (0, [0]), (1, [1]), (-1, [0x7f]), (0x3f, [0x3f]), (-0x40, [0x40]),
+        (0x40, [0xc0, 0x00]), (-0x41, [0xbf, 0x7f]),
+        (0x7fffffff, [0xff, 0xff, 0xff, 0xff, 0x07]),
+        (0x80000000, [0x80, 0x80, 0x80, 0x80, 0x08]),
+        (0xffffffff, [0xff, 0xff, 0xff, 0xff, 0x0f]),
+        (-0x80000000, [0x80, 0x80, 0x80, 0x80, 0x78]),
+        (-0x100000000 + 0x7fffffff, [0xff, 0xff, 0xff, 0xff, 0x77]),
+        (-0xffffffff, [0x81, 0x80, 0x80, 0x80, 0x70]),
+        (-0x100000000, [0x80, 0x80, 0x80, 0x80, 0x70]),
+        (-0x100000001, [0xff, 0xff, 0xff, 0xff, 0x6f]),
+        (0x3ffffffff, [0xff, 0xff, 0xff, 0xff, 0x3f]),
+        (-0x400000000, [0x80, 0x80, 0x80, 0x80, 0x40]),
+        (0x400000000, [0x80, 0x80, 0x80, 0x80, 0xc0, 0x00]),
+        (-0x400000001, [0xff, 0xff, 0xff, 0xff, 0xbf, 0x7f]),
+        (0x1ffffffffff, [0xff, 0xff, 0xff, 0xff, 0xff, 0x3f]),
+        (-0x20000000000, [0x80, 0x80, 0x80, 0x80, 0x80, 0x40]),
+        (0x20000000000, [0x80, 0x80, 0x80, 0x80, 0x80, 0xc0, 0x00]),
+        (-0x20000000001, [0xff, 0xff, 0xff, 0xff, 0xff, 0xbf, 0x7f]),
+        (0xffffffffffff, [0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x3f]),
+        (-0x1000000000000, [0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x40]),
+        (0x1000000000000, [0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0xc0, 0x00]),
+        (-0x1000000000001, [0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xbf, 0x7f]),
+        (0x7fffffffffffff, [0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x3f]),
+        (-0x80000000000000, [0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x40]),
+        (0x80000000000000, [0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0xc0, 0x00]),
+        (-0x80000000000001, [0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xbf, 0x7f]),
+        (0x3fffffffffffffff, [0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x3f]),
+        (-0x4000000000000000, [0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x40]),
+        (0x4000000000000000, [0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0xc0, 0x00]),
+        (-0x4000000000000001, [0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xbf, 0x7f]),
+        (0x7fffffffffffffff, [0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x00]),
+        (-0x8000000000000000, [0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x80, 0x7f]),
+    ]
+
+    def test_encode_unsigned(self):
+        for value, expected in self.CASES_UINT:
+            check_encoded(enc('append_uint64', value), expected)
+
+    def test_round_trip_unsigned(self):
+        for value, _ in self.CASES_UINT:
+            d = Decoder(enc('append_uint64', value).buffer)
+            assert d.read_uint64() == value
+            assert d.done
+
+    def test_encode_signed(self):
+        for value, expected in self.CASES_INT:
+            check_encoded(enc('append_int64', value), expected)
+
+    def test_round_trip_signed(self):
+        for value, _ in self.CASES_INT:
+            d = Decoder(enc('append_int64', value).buffer)
+            assert d.read_int64() == value
+            assert d.done
+
+    def test_encode_out_of_range(self):
+        for bad in (2 ** 64, -1):
+            with pytest.raises(ValueError, match='out of range'):
+                Encoder().append_uint64(bad)
+        for bad in (2 ** 63, -(2 ** 63) - 1):
+            with pytest.raises(ValueError, match='out of range'):
+                Encoder().append_int64(bad)
+
+    def test_decode_out_of_range(self):
+        with pytest.raises(ValueError, match='out of range'):
+            Decoder(bytes([0x80] * 10 + [0x00])).read_uint64()
+        with pytest.raises(ValueError, match='out of range'):
+            Decoder(bytes([0x80] * 10 + [0x00])).read_int64()
+        with pytest.raises(ValueError, match='out of range'):
+            Decoder(bytes([0xff] * 9 + [0x02])).read_uint64()
+        with pytest.raises(ValueError, match='out of range'):
+            Decoder(bytes([0xff] * 9 + [0x01])).read_int64()
+        with pytest.raises(ValueError, match='out of range'):
+            Decoder(bytes([0x80] * 9 + [0x7e])).read_int64()
+        with pytest.raises(ValueError, match='incomplete number'):
+            Decoder(bytes([0x80, 0x80])).read_uint64()
+        with pytest.raises(ValueError, match='incomplete number'):
+            Decoder(bytes([0x80, 0x80])).read_int64()
+
+
+class TestStringsAndHex:
+    def test_encode_strings(self):
+        check_encoded(Encoder().append_prefixed_string(''), [0])
+        check_encoded(Encoder().append_prefixed_string('a'), [1, 0x61])
+        check_encoded(Encoder().append_prefixed_string('Oh là là'),
+                      [10, 79, 104, 32, 108, 195, 160, 32, 108, 195, 160])
+        check_encoded(Encoder().append_prefixed_string('\U0001f604'),
+                      [4, 0xf0, 0x9f, 0x98, 0x84])
+
+    def test_round_trip_strings(self):
+        for s in ('', 'a', 'Oh là là', '\U0001f604'):
+            assert Decoder(Encoder().append_prefixed_string(s).buffer) \
+                .read_prefixed_string() == s
+
+    def test_multiple_strings(self):
+        e = Encoder()
+        for s in ('one', 'two', 'three'):
+            e.append_prefixed_string(s)
+        d = Decoder(e.buffer)
+        assert [d.read_prefixed_string() for _ in range(3)] == ['one', 'two', 'three']
+
+    def test_encode_hex(self):
+        check_encoded(Encoder().append_hex_string(''), [0])
+        check_encoded(Encoder().append_hex_string('00'), [1, 0])
+        check_encoded(Encoder().append_hex_string('0123'), [2, 1, 0x23])
+        check_encoded(Encoder().append_hex_string('fedcba9876543210'),
+                      [8, 0xfe, 0xdc, 0xba, 0x98, 0x76, 0x54, 0x32, 0x10])
+
+    def test_round_trip_hex(self):
+        for s in ('', '00', '0123', 'fedcba9876543210'):
+            assert Decoder(Encoder().append_hex_string(s).buffer).read_hex_string() == s
+
+    def test_malformed_hex(self):
+        with pytest.raises(TypeError, match='value is not a string'):
+            Encoder().append_hex_string(0x1234)
+        for bad in ('abcd-ef', '0', 'ABCD', 'zz'):
+            with pytest.raises(ValueError, match='value is not hexadecimal'):
+                Encoder().append_hex_string(bad)
+
+
+def encode_rle(type, values):
+    e = RLEEncoder(type)
+    for v in values:
+        e.append_value(v)
+    return e.buffer
+
+
+def decode_rle(type, buffer):
+    if isinstance(buffer, list):
+        buffer = bytes(buffer)
+    d = RLEDecoder(type, buffer)
+    values = []
+    while not d.done:
+        values.append(d.read_value())
+    return values
+
+
+class TestRLE:
+    def test_encode_without_nulls(self):
+        assert encode_rle('uint', []) == b''
+        assert encode_rle('uint', [1, 2, 3]) == bytes([0x7d, 1, 2, 3])
+        assert encode_rle('uint', [0, 1, 2, 2, 3]) == bytes([0x7e, 0, 1, 2, 2, 0x7f, 3])
+        assert encode_rle('uint', [1, 1, 1, 1, 1, 1]) == bytes([6, 1])
+        assert encode_rle('uint', [1, 1, 1, 4, 4, 4]) == bytes([3, 1, 3, 4])
+        assert encode_rle('uint', [0xff]) == bytes([0x7f, 0xff, 0x01])
+        assert encode_rle('int', [-0x40]) == bytes([0x7f, 0x40])
+
+    def test_encode_with_nulls(self):
+        assert encode_rle('uint', [None, 1]) == bytes([0, 1, 0x7f, 1])
+        assert encode_rle('uint', [1, None]) == bytes([0x7f, 1, 0, 1])
+        assert encode_rle('uint', [1, 1, 1, None]) == bytes([3, 1, 0, 1])
+        assert encode_rle('uint', [None, None, None, 3, 4, 5, None]) == \
+            bytes([0, 3, 0x7d, 3, 4, 5, 0, 1])
+        assert encode_rle('uint', [None, None, None, 9, 9, 9]) == bytes([0, 3, 3, 9])
+        assert encode_rle('uint', [1, 1, 1, 1, 1, None, None, None, 1]) == \
+            bytes([5, 1, 0, 3, 0x7f, 1])
+
+    def test_round_trip(self):
+        for seq in ([], [1, 2, 3], [0, 1, 2, 2, 3], [1, 1, 1, 1, 1, 1],
+                    [1, 1, 1, 4, 4, 4], [0xff], [None, 1], [1, None],
+                    [1, 1, 1, None], [None, None, None, 3, 4, 5, None],
+                    [None, None, None, 9, 9, 9], [1, 1, 1, 1, 1, None, None, None, 1]):
+            assert decode_rle('uint', encode_rle('uint', seq)) == seq
+        assert decode_rle('int', encode_rle('int', [-0x40])) == [-0x40]
+
+    def test_string_values(self):
+        assert encode_rle('utf8', ['a']) == bytes([0x7f, 1, 0x61])
+        assert encode_rle('utf8', ['a', 'b', 'c', 'd']) == \
+            bytes([0x7c, 1, 0x61, 1, 0x62, 1, 0x63, 1, 0x64])
+        assert encode_rle('utf8', ['a', 'a', 'a', 'a']) == bytes([4, 1, 0x61])
+        assert encode_rle('utf8', ['a', 'a', None, None, 'a', 'a']) == \
+            bytes([2, 1, 0x61, 0, 2, 2, 1, 0x61])
+        assert encode_rle('utf8', [None, None, None, None, 'abc']) == \
+            bytes([0, 4, 0x7f, 3, 0x61, 0x62, 0x63])
+        for seq in (['a'], ['a', 'b', 'c', 'd'], ['a', 'a', 'a', 'a'],
+                    ['a', 'a', None, None, 'a', 'a'], [None, None, None, None, 'abc']):
+            assert decode_rle('utf8', encode_rle('utf8', seq)) == seq
+
+    def test_repetition_counts(self):
+        cases = [
+            ([(3, 0)], []),
+            ([(3, 10)], [10, 3]),
+            ([(3, 10), (3, 10)], [20, 3]),
+            ([(3, 10), (4, 10)], [10, 3, 10, 4]),
+            ([(3, 10), (None, 10)], [10, 3, 0, 10]),
+            ([(1, 1), (1, 2)], [3, 1]),
+            ([(1, 1), (2, 3)], [0x7f, 1, 3, 2]),
+            ([(1, 1), (2, 1), (3, 3)], [0x7e, 1, 2, 3, 3]),
+            ([(None, 1), (3, 3)], [0, 1, 3, 3]),
+            ([(None, 1), (None, 3), (1, 1)], [0, 4, 0x7f, 1]),
+        ]
+        for appends, expected in cases:
+            e = RLEEncoder('uint')
+            for value, reps in appends:
+                e.append_value(value, reps)
+            check_encoded(e, expected)
+
+    def test_all_nulls_empty_buffer(self):
+        assert encode_rle('uint', []) == b''
+        assert encode_rle('uint', [None]) == b''
+        assert encode_rle('uint', [None] * 4) == b''
+
+    def test_canonical_form_enforced(self):
+        with pytest.raises(ValueError, match='Repetition count of 1 is not allowed'):
+            decode_rle('int', [1, 1])
+        with pytest.raises(ValueError, match='Successive repetitions with the same value'):
+            decode_rle('int', [2, 1, 2, 1])
+        with pytest.raises(ValueError, match='Successive null runs are not allowed'):
+            decode_rle('int', [0, 1, 0, 2])
+        with pytest.raises(ValueError, match='Zero-length null runs are not allowed'):
+            decode_rle('int', [0, 0])
+        with pytest.raises(ValueError, match='Successive literals are not allowed'):
+            decode_rle('int', [0x7f, 1, 0x7f, 2])
+        with pytest.raises(ValueError, match='Repetition of values is not allowed'):
+            decode_rle('int', [0x7d, 1, 2, 2])
+        with pytest.raises(ValueError, match='Repetition of values is not allowed'):
+            decode_rle('int', [2, 0, 0x7e, 0, 1])
+        with pytest.raises(ValueError, match='Successive repetitions with the same value'):
+            decode_rle('int', [0x7e, 1, 2, 2, 2])
+
+    def test_skip_strings(self):
+        example = [None, None, None, 'a', 'a', 'a', 'b', 'c', 'd', 'e']
+        encoded = encode_rle('utf8', example)
+        for skip in range(len(example)):
+            d = RLEDecoder('utf8', encoded)
+            d.skip_values(skip)
+            values = []
+            while not d.done:
+                values.append(d.read_value())
+            assert values == example[skip:], f'skipping {skip} values failed'
+
+    def test_skip_integers(self):
+        example = [None, None, None, 1, 1, 1, 2, 3, 4, 5]
+        encoded = encode_rle('uint', example)
+        for skip in range(len(example)):
+            d = RLEDecoder('uint', encoded)
+            d.skip_values(skip)
+            values = []
+            while not d.done:
+                values.append(d.read_value())
+            assert values == example[skip:], f'skipping {skip} values failed'
+
+
+def do_copy_rle(input1, input2, skip=None, count=None, **kw):
+    if isinstance(input1, list):
+        encoder1 = RLEEncoder('uint')
+        for v in input1:
+            encoder1.append_value(v)
+    else:
+        encoder1 = input1
+    encoder2 = RLEEncoder('uint')
+    for v in input2:
+        encoder2.append_value(v)
+    decoder2 = RLEDecoder('uint', encoder2.buffer)
+    if skip:
+        decoder2.skip_values(skip)
+    encoder1.copy_from(decoder2, count=count, **kw)
+    return encoder1
+
+
+class TestRLECopyFrom:
+    def test_copy_sequence(self):
+        cases = [
+            (([], [0, 1, 2]), [0x7d, 0, 1, 2]),
+            (([0, 1, 2], []), [0x7d, 0, 1, 2]),
+            (([0, 1, 2], [3, 4, 5, 6]), [0x79, 0, 1, 2, 3, 4, 5, 6]),
+            (([0, 1], [2, 3, 4, 4, 4]), [0x7c, 0, 1, 2, 3, 3, 4]),
+            (([0, 1, 2], [3, 4, 4, 4]), [0x7c, 0, 1, 2, 3, 3, 4]),
+            (([0, 1, 2], [3, 3, 3, 4, 4, 4]), [0x7d, 0, 1, 2, 3, 3, 3, 4]),
+            (([0, 1, 2], [None, None, 4, 4, 4]), [0x7d, 0, 1, 2, 0, 2, 3, 4]),
+            (([0, 1, 2], [3, 4, 4, None, None]), [0x7c, 0, 1, 2, 3, 2, 4, 0, 2]),
+            (([0, 1, 2], [3, 4, 4, 5, 6, 6]), [0x7c, 0, 1, 2, 3, 2, 4, 0x7f, 5, 2, 6]),
+            (([0, 1, 2], [2, 2, 3, 3, 4, 5, 6]), [0x7e, 0, 1, 3, 2, 2, 3, 0x7d, 4, 5, 6]),
+            (([0, 0, 0], [0, 0, 0]), [6, 0]),
+            (([0, 0, 0], [0, 1, 1]), [4, 0, 2, 1]),
+            (([0, 0, 0], [1, 2, 2]), [3, 0, 0x7f, 1, 2, 2]),
+            (([0, 0, 0], [1, 2, 3]), [3, 0, 0x7d, 1, 2, 3]),
+            (([0, 0, 0], [None, None, 2, 2]), [3, 0, 0, 2, 2, 2]),
+            (([0, 0, 0], [None, 0, 0, 0]), [3, 0, 0, 1, 3, 0]),
+            (([0, 0, None], [None, 0, 0]), [2, 0, 0, 2, 2, 0]),
+            (([0, 0, None], [0, 0, 0]), [2, 0, 0, 1, 3, 0]),
+            (([0, 0, None], [1, 2, 3]), [2, 0, 0, 1, 0x7d, 1, 2, 3]),
+        ]
+        for (in1, in2), expected in cases:
+            check_encoded(do_copy_rle(in1, in2), expected)
+
+    def test_copy_multiple(self):
+        check_encoded(do_copy_rle(do_copy_rle([0, 0, 1], [1, 2]), [2, 3]),
+                      [2, 0, 2, 1, 2, 2, 0x7f, 3])
+        check_encoded(do_copy_rle(do_copy_rle([0], [0, 0, 1, 1, 2]), [2, 3, 3, 4]),
+                      [3, 0, 2, 1, 2, 2, 2, 3, 0x7f, 4])
+        check_encoded(do_copy_rle(do_copy_rle([0, 1, 2], [3, 4]), [5, 6]),
+                      [0x79, 0, 1, 2, 3, 4, 5, 6])
+        check_encoded(do_copy_rle(do_copy_rle([0, 0, 0], [0, 0, 1, 1]), [1, 1]),
+                      [5, 0, 4, 1])
+        check_encoded(do_copy_rle(do_copy_rle([0, None], [None, 1, None]), [None, 2]),
+                      [0x7f, 0, 0, 2, 0x7f, 1, 0, 2, 0x7f, 2])
+
+    def test_copy_subsequence(self):
+        cases = [
+            (([0, 1, 2], [3, 4, 5, 6]), dict(skip=0, count=0), [0x7d, 0, 1, 2]),
+            (([0, 1, 2], [3, 4, 5, 6]), dict(skip=0, count=1), [0x7c, 0, 1, 2, 3]),
+            (([0, 1, 2], [3, 4, 5, 6]), dict(skip=0, count=2), [0x7b, 0, 1, 2, 3, 4]),
+            (([0, 1, 2], [3, 4, 5, 6]), dict(skip=0, count=4), [0x79, 0, 1, 2, 3, 4, 5, 6]),
+            (([0, 1, 2], [3, 4, 5, 6]), dict(skip=1, count=1), [0x7c, 0, 1, 2, 4]),
+            (([0, 1, 2], [3, 4, 5, 6]), dict(skip=1, count=2), [0x7b, 0, 1, 2, 4, 5]),
+            (([0, 1, 2], [3, 3, 3, 3]), dict(skip=0, count=2), [0x7d, 0, 1, 2, 2, 3]),
+            (([0, 0, 0], [0, 0, 0, 0]), dict(skip=0, count=2), [5, 0]),
+            (([0, 0], [0, 0, 1, 1, 1]), dict(skip=0, count=4), [4, 0, 2, 1]),
+            (([0, 0], [0, 0, 1, 1, 2, 2]), dict(skip=1, count=4), [3, 0, 2, 1, 0x7f, 2]),
+            (([0, 0], [1, 1, 2, 3, 4, 5]), dict(skip=0, count=3), [2, 0, 2, 1, 0x7f, 2]),
+            (([None], [None, 1, 1, None]), dict(skip=0, count=2), [0, 2, 0x7f, 1]),
+            (([None], [None, 1, 1, None]), dict(skip=1, count=3), [0, 1, 2, 1, 0, 1]),
+            (([], [None, None, None, 0, 0]), dict(skip=0, count=5), [0, 3, 2, 0]),
+        ]
+        for (in1, in2), opts, expected in cases:
+            check_encoded(do_copy_rle(in1, in2, **opts), expected)
+
+    def test_insertion_into_sequence(self):
+        d1 = RLEDecoder('uint', encode_rle('uint', [0, 1, 2, 3, 4, 5, 6]))
+        d2 = RLEDecoder('uint', encode_rle('uint', [3, 3, 3]))
+        e = RLEEncoder('uint')
+        e.copy_from(d1, count=4)
+        e.copy_from(d2)
+        e.copy_from(d1)
+        check_encoded(e, [0x7d, 0, 1, 2, 4, 3, 0x7d, 4, 5, 6])
+
+    def test_insertion_into_repetition_run(self):
+        d1 = RLEDecoder('uint', encode_rle('uint', [1, 2, 3, 3, 4]))
+        d2 = RLEDecoder('uint', encode_rle('uint', [5]))
+        e = RLEEncoder('uint')
+        e.copy_from(d1, count=3)
+        e.copy_from(d2)
+        e.copy_from(d1)
+        check_encoded(e, [0x7a, 1, 2, 3, 5, 3, 4])
+
+    def test_copy_starting_with_nulls(self):
+        d = RLEDecoder('uint', bytes([0, 2, 0x7f, 0]))  # null, null, 0
+        RLEEncoder('uint').copy_from(d, count=1)
+        assert d.read_value() is None
+        assert d.read_value() == 0
+        d.reset()
+        RLEEncoder('uint').copy_from(d, count=2)
+        assert d.read_value() == 0
+
+    def test_sum_of_copied_values(self):
+        e2 = RLEEncoder('uint')
+        for v in (1, 2, 3, 10, 10, 10):
+            e2.append_value(v)
+        assert RLEEncoder('uint').copy_from(
+            RLEDecoder('uint', e2.buffer), sum_values=True) == (6, 36)
+        assert RLEEncoder('uint').copy_from(
+            RLEDecoder('uint', e2.buffer), sum_values=True, sum_shift=2) == (6, 6)
+
+    def test_too_few_values(self):
+        for in1, in2, count in ([[0, 1, 2], [], 1], [[0, 1, 2], [3], 2],
+                                [[0, 1, 2], [3, 4, 5, 6], 5], [[0, 1, 2], [3, 3, 3], 4],
+                                [[0, 1, 2], [3, 3, 4, 4, 5, 5], 7]):
+            with pytest.raises(ValueError, match=f'cannot copy {count} values'):
+                do_copy_rle(in1, in2, count=count)
+        with pytest.raises(ValueError, match='incomplete literal'):
+            RLEEncoder('uint').copy_from(RLEDecoder('uint', bytes([0x7e, 1])))
+        with pytest.raises(ValueError, match='Repetition of values'):
+            RLEEncoder('uint').copy_from(RLEDecoder('uint', bytes([2, 1, 0x7f, 1])))
+
+    def test_decoder_type_check(self):
+        with pytest.raises(TypeError, match='incompatible type of decoder'):
+            RLEEncoder('uint').copy_from(Decoder(b''))
+        with pytest.raises(TypeError, match='incompatible type of decoder'):
+            RLEEncoder('uint').copy_from(RLEDecoder('int', b''))
+
+
+def encode_delta(values):
+    e = DeltaEncoder()
+    for v in values:
+        e.append_value(v)
+    return e.buffer
+
+
+def decode_delta(buffer):
+    d = DeltaDecoder(buffer)
+    values = []
+    while not d.done:
+        values.append(d.read_value())
+    return values
+
+
+def do_copy_delta(input1, input2, skip=None, count=None):
+    if isinstance(input1, list):
+        encoder1 = DeltaEncoder()
+        for v in input1:
+            encoder1.append_value(v)
+    else:
+        encoder1 = input1
+    encoder2 = DeltaEncoder()
+    for v in input2:
+        encoder2.append_value(v)
+    decoder2 = DeltaDecoder(encoder2.buffer)
+    if skip:
+        decoder2.skip_values(skip)
+    encoder1.copy_from(decoder2, count=count)
+    return encoder1
+
+
+class TestDelta:
+    def test_encode(self):
+        assert encode_delta([]) == b''
+        assert encode_delta([18, 2, 9, 15, 16, 19, 25]) == \
+            bytes([0x79, 18, 0x70, 7, 6, 1, 3, 6])
+        assert encode_delta([1, 2, 3, 4, 5, 6, 7, 8]) == bytes([8, 1])
+        assert encode_delta([10, 11, 12, 13, 14, 15]) == bytes([0x7f, 10, 5, 1])
+        assert encode_delta([10, 11, 12, 13, 0, 1, 2, 3]) == \
+            bytes([0x7f, 10, 3, 1, 0x7f, 0x73, 3, 1])
+        assert encode_delta([0, 1, 2, 3, None, None, None, 4, 5, 6]) == \
+            bytes([0x7f, 0, 3, 1, 0, 3, 3, 1])
+        assert encode_delta([-64, -60, -56, -52, -48, -44, -40, -36]) == \
+            bytes([0x7f, 0x40, 7, 4])
+
+    def test_round_trip(self):
+        for seq in ([], [18, 2, 9, 15, 16, 19, 25], [1, 2, 3, 4, 5, 6, 7, 8],
+                    [10, 11, 12, 13, 14, 15], [10, 11, 12, 13, 0, 1, 2, 3],
+                    [0, 1, 2, 3, None, None, None, 4, 5, 6],
+                    [-64, -60, -56, -52, -48, -44, -40, -36]):
+            assert decode_delta(encode_delta(seq)) == seq
+
+    def test_repetition_counts(self):
+        e = DeltaEncoder(); e.append_value(3, 0); check_encoded(e, [])
+        e = DeltaEncoder(); e.append_value(3, 10); check_encoded(e, [0x7f, 3, 9, 0])
+        e = DeltaEncoder(); e.append_value(1, 3); e.append_value(1, 3)
+        check_encoded(e, [0x7f, 1, 5, 0])
+
+    def test_skip(self):
+        example = [None, None, None, 10, 11, 12, 13, 14, 15, 16, 1, 2, 3,
+                   40, 11, 13, 21, 103]
+        encoded = encode_delta(example)
+        for skip in range(len(example)):
+            d = DeltaDecoder(encoded)
+            d.skip_values(skip)
+            values = []
+            while not d.done:
+                values.append(d.read_value())
+            assert values == example[skip:], f'skipping {skip} values failed'
+
+    def test_copy_sequence(self):
+        cases = [
+            (([], [0, 0, 0]), [3, 0]),
+            (([0, 0, 0], []), [3, 0]),
+            (([0, 0, 0], [0, 0, 0]), [6, 0]),
+            (([1, 2, 3], [4, 5, 6]), [6, 1]),
+            (([1, 2, 3], [4, 10, 20]), [4, 1, 0x7e, 6, 10]),
+            (([1, 2, 3], [1, 2, 3, 4]), [3, 1, 0x7f, 0x7e, 3, 1]),
+            (([0, 1, 3], [6, 10, 15]), [0x7a, 0, 1, 2, 3, 4, 5]),
+            (([0, 1, 3], [5, 9, 14]), [0x7e, 0, 1, 2, 2, 0x7e, 4, 5]),
+            (([1, 2, 4], [5, 6, 8, 9, 10, 12]),
+             [2, 1, 0x7f, 2, 2, 1, 0x7f, 2, 2, 1, 0x7f, 2]),
+            (([4, 4, 4], [4, 4, 4, 5, 6, 7]), [0x7f, 4, 5, 0, 3, 1]),
+            (([0, 1, 4], [9, 6, 2, 5, 3]), [0x78, 0, 1, 3, 5, 0x7d, 0x7c, 3, 0x7e]),
+            (([1, 2, 3], [None, 4, 5, 6]), [3, 1, 0, 1, 3, 1]),
+            (([1, 2, 3], [None, 6, 6, 6]), [3, 1, 0, 1, 0x7f, 3, 2, 0]),
+            (([1, 2, 3], [None, None, 4, 5, 7, 9]), [3, 1, 0, 2, 2, 1, 2, 2]),
+            (([1, 2, None], [3, 4, 5]), [2, 1, 0, 1, 3, 1]),
+            (([1, 2, None], [6, 6, 6]), [2, 1, 0, 1, 0x7f, 4, 2, 0]),
+            (([1, 2, None], [None, 3, 4]), [2, 1, 0, 2, 2, 1]),
+            (([1, 2, None], [None, 6, 6]), [2, 1, 0, 2, 0x7e, 4, 0]),
+        ]
+        for (in1, in2), expected in cases:
+            check_encoded(do_copy_delta(in1, in2), expected)
+
+    def test_copy_subsequence(self):
+        check_encoded(do_copy_delta([1, 2, 3], [4, 5, 6, 7], count=2), [5, 1])
+        check_encoded(do_copy_delta([1, 2, 3], [None, None, 4], count=1), [3, 1, 0, 1])
+        check_encoded(do_copy_delta([1, 2, 3], [None, None, 4], count=2), [3, 1, 0, 2])
+
+    def test_copy_non_ascending(self):
+        d = DeltaDecoder(bytes([2, 1, 0x7e, 2, 0x7f]))  # 1, 2, 4, 3
+        e = DeltaEncoder()
+        e.copy_from(d, count=4)
+        e.append_value(5)
+        check_encoded(e, [2, 1, 0x7d, 2, 0x7f, 2])  # 1, 2, 4, 3, 5
+
+    def test_pause_and_resume(self):
+        num_values = 13  # 1, 3, 4, 2, null, 3, 4, 5, null, null, 4, 2, -1
+        data = bytes([0x7c, 1, 2, 1, 0x7e, 0, 1, 3, 1, 0, 2, 0x7d, 0x7f, 0x7e, 0x7d])
+        d = DeltaDecoder(data)
+        for i in range(num_values + 1):
+            e = DeltaEncoder()
+            e.copy_from(d, count=i)
+            e.copy_from(d, count=num_values - i)
+            check_encoded(e, data)
+            d.reset()
+
+    def test_copy_then_append(self):
+        e1 = do_copy_delta([], [1, 2, 3])
+        e1.append_value(4)
+        check_encoded(e1, [4, 1])
+
+        e2 = do_copy_delta([5], [6, None, None, None, 7, 8])
+        e2.append_value(9)
+        check_encoded(e2, [0x7e, 5, 1, 0, 3, 3, 1])
+
+        e3 = do_copy_delta([1], [2])
+        e3.append_value(3)
+        check_encoded(e3, [3, 1])
+
+    def test_too_few_values(self):
+        with pytest.raises(ValueError, match='cannot copy 1 values'):
+            do_copy_delta([0, 1, 2], [], count=1)
+        with pytest.raises(ValueError, match='cannot copy 1 values'):
+            do_copy_delta([0, 1, 2], [None, 3], count=3)
+        with pytest.raises(ValueError, match='cannot copy 3 values'):
+            DeltaEncoder().copy_from(DeltaDecoder(bytes([0, 2])), count=3)
+
+    def test_argument_checks(self):
+        with pytest.raises(TypeError, match='incompatible type of decoder'):
+            DeltaEncoder().copy_from(Decoder(b''))
+        with pytest.raises(ValueError, match='unsupported options'):
+            DeltaEncoder().copy_from(DeltaDecoder(b''), sum_values=True)
+
+
+def encode_bools(values):
+    e = BooleanEncoder()
+    for v in values:
+        e.append_value(v)
+    return e.buffer
+
+
+def decode_bools(buffer):
+    if isinstance(buffer, list):
+        buffer = bytes(buffer)
+    d = BooleanDecoder(buffer)
+    values = []
+    while not d.done:
+        values.append(d.read_value())
+    return values
+
+
+def do_copy_bools(input1, input2, skip=None, count=None):
+    if isinstance(input1, list):
+        encoder1 = BooleanEncoder()
+        for v in input1:
+            encoder1.append_value(v)
+    else:
+        encoder1 = input1
+    encoder2 = BooleanEncoder()
+    for v in input2:
+        encoder2.append_value(v)
+    decoder2 = BooleanDecoder(encoder2.buffer)
+    if skip:
+        decoder2.skip_values(skip)
+    encoder1.copy_from(decoder2, count=count)
+    return encoder1
+
+
+class TestBoolean:
+    def test_encode(self):
+        assert encode_bools([]) == b''
+        assert encode_bools([False]) == bytes([1])
+        assert encode_bools([True]) == bytes([0, 1])
+        assert encode_bools([False, False, False, True, True]) == bytes([3, 2])
+        assert encode_bools([True, True, True, False, False]) == bytes([0, 3, 2])
+        assert encode_bools([True, False, True, False, True, True, False]) == \
+            bytes([0, 1, 1, 1, 1, 2, 1])
+
+    def test_round_trip(self):
+        for seq in ([], [False], [True], [False, False, False, True, True],
+                    [True, True, True, False, False],
+                    [True, False, True, False, True, True, False]):
+            assert decode_bools(encode_bools(seq)) == seq
+
+    def test_non_boolean_rejected(self):
+        for bad in (42, None, 'false'):
+            with pytest.raises(ValueError, match='Unsupported value'):
+                encode_bools([bad])
+
+    def test_repetition_counts(self):
+        e = BooleanEncoder(); e.append_value(False, 0); check_encoded(e, [])
+        e = BooleanEncoder(); e.append_value(False, 2); e.append_value(False, 2)
+        check_encoded(e, [4])
+        e = BooleanEncoder(); e.append_value(True, 2); e.append_value(False, 2)
+        check_encoded(e, [0, 2, 2])
+
+    def test_skip(self):
+        example = [False, False, False, True, True, True, False, True, False, True]
+        encoded = encode_bools(example)
+        for skip in range(len(example)):
+            d = BooleanDecoder(encoded)
+            d.skip_values(skip)
+            values = []
+            while not d.done:
+                values.append(d.read_value())
+            assert values == example[skip:], f'skipping {skip} values failed'
+
+    def test_canonical_form(self):
+        with pytest.raises(ValueError, match='Zero-length runs are not allowed'):
+            decode_bools([1, 0])
+        with pytest.raises(ValueError, match='Zero-length runs are not allowed'):
+            decode_bools([1, 1, 0])
+        d = BooleanDecoder(bytes([2, 0, 1]))
+        d.skip_values(1)
+        with pytest.raises(ValueError, match='Zero-length runs are not allowed'):
+            d.skip_values(2)
+
+    def test_copy_sequence(self):
+        check_encoded(do_copy_bools([False, False, True], []), [2, 1])
+        check_encoded(do_copy_bools([], [False, False, True, True]), [2, 2])
+        check_encoded(do_copy_bools([False, False], [False, False, True, True]), [4, 2])
+        check_encoded(do_copy_bools([True, True], [False, False, True, True]), [0, 2, 2, 2])
+        check_encoded(do_copy_bools([True, True], [True, True]), [0, 4])
+
+    def test_copy_subsequence(self):
+        check_encoded(do_copy_bools([False], [False, False, False, True], count=2), [3])
+        check_encoded(do_copy_bools([False], [True, True, True, True], count=3), [1, 3])
+        check_encoded(do_copy_bools([False], [False, True, True, True], skip=1), [1, 3])
+        check_encoded(do_copy_bools([False], [False, True, True, True], skip=2), [1, 2])
+
+    def test_too_few_values(self):
+        with pytest.raises(ValueError, match='cannot copy 1 values'):
+            do_copy_bools([False], [], count=1)
+        with pytest.raises(ValueError, match='cannot copy 3 values'):
+            do_copy_bools([False], [True, False], count=3)
+
+    def test_argument_checks(self):
+        with pytest.raises(TypeError, match='incompatible type of decoder'):
+            BooleanEncoder().copy_from(Decoder(b''))
+        with pytest.raises(ValueError, match='Zero-length runs'):
+            BooleanEncoder().copy_from(BooleanDecoder(bytes([2, 0])))
