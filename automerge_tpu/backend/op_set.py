@@ -1,0 +1,874 @@
+"""The CRDT document engine (OpSet): change application, patch generation,
+and document serialization.
+
+This is the host reference engine, semantically equivalent to the reference's
+BackendDoc (backend/new.js) but with a different in-memory design: instead of
+RLE-compressed op blocks merged by a streaming two-pointer scan
+(new.js:1052-1290), we keep a key-indexed op store — per-object dicts of
+per-key op lists for maps/tables, and an RGA-ordered element list for
+lists/texts. Observable behavior (patches, error conditions, binary document
+format) matches the reference:
+
+- conflict resolution: all ops for a key kept in ascending Lamport order;
+  visible ops are those with no successors (new.js:1204-1217)
+- RGA list insertion: scan forward from the reference element, skipping
+  elements with a greater insertion opId (new.js:145-163)
+- counters: inc ops are successors of the set op but accumulate
+  (new.js:937-965)
+- patch grammar and edit coalescing (new.js:747-1040)
+- causal gating with per-actor seq contiguity (new.js:1550-1597)
+
+The batched/TPU execution path lives in automerge_tpu.fleet; this engine is
+the correctness oracle and handles the irregular host-side work (hash graph,
+patch assembly, wire format).
+"""
+
+import copy
+
+from ..common import parse_op_id, lamport_key
+from ..columnar import (
+    OBJECT_TYPE, DOCUMENT_COLUMNS, VALUE_TYPE,
+    decode_change, decode_change_meta, decode_document, decode_document_header,
+    encode_change, encode_document_header, encode_ops, split_containers,
+    CHUNK_TYPE_DOCUMENT, CHUNK_TYPE_CHANGE, CHUNK_TYPE_DEFLATE,
+    materialize_columns, encoder_by_column_id,
+)
+from .. import encoding
+
+
+def _utf16_key(s):
+    """Sort key giving JS-compatible UTF-16 code-unit string ordering."""
+    return s.encode('utf-16-be', 'surrogatepass')
+
+
+def _js_typeof(value):
+    if isinstance(value, bool):
+        return 'boolean'
+    if isinstance(value, (int, float)):
+        return 'number'
+    if isinstance(value, str):
+        return 'string'
+    return 'object'
+
+
+def empty_object_patch(object_id, type):
+    if type in ('list', 'text'):
+        return {'objectId': object_id, 'type': type, 'edits': []}
+    return {'objectId': object_id, 'type': type, 'props': {}}
+
+
+def _op_id_delta(id1, id2, delta=1):
+    c1, a1 = parse_op_id(id1)
+    c2, a2 = parse_op_id(id2)
+    return a1 == a2 and c1 + delta == c2
+
+
+def append_edit(edits, next_edit):
+    """Append a list edit, coalescing runs (multi-insert, remove counts)
+    (ref new.js:747-782)."""
+    if not edits:
+        edits.append(next_edit)
+        return
+    last = edits[-1]
+    if last['action'] == 'insert' and next_edit['action'] == 'insert' and \
+            last['index'] == next_edit['index'] - 1 and \
+            last['value']['type'] == 'value' and next_edit['value']['type'] == 'value' and \
+            last['elemId'] == last['opId'] and next_edit['elemId'] == next_edit['opId'] and \
+            _op_id_delta(last['elemId'], next_edit['elemId'], 1) and \
+            last['value'].get('datatype') == next_edit['value'].get('datatype') and \
+            _js_typeof(last['value']['value']) == _js_typeof(next_edit['value']['value']):
+        last['action'] = 'multi-insert'
+        if next_edit['value'].get('datatype'):
+            last['datatype'] = next_edit['value']['datatype']
+        last['values'] = [last['value']['value'], next_edit['value']['value']]
+        del last['value']
+        del last['opId']
+    elif last['action'] == 'multi-insert' and next_edit['action'] == 'insert' and \
+            last['index'] + len(last['values']) == next_edit['index'] and \
+            next_edit['value']['type'] == 'value' and \
+            next_edit['elemId'] == next_edit['opId'] and \
+            _op_id_delta(last['elemId'], next_edit['elemId'], len(last['values'])) and \
+            last.get('datatype') == next_edit['value'].get('datatype') and \
+            _js_typeof(last['values'][0]) == _js_typeof(next_edit['value']['value']):
+        last['values'].append(next_edit['value']['value'])
+    elif last['action'] == 'remove' and next_edit['action'] == 'remove' and \
+            last['index'] == next_edit['index']:
+        last['count'] += next_edit['count']
+    else:
+        edits.append(next_edit)
+
+
+def append_update(edits, index, elem_id, op_id, value, first_update):
+    """Append an UpdateEdit; consecutive updates at the same index represent a
+    conflict (ref new.js:798-824)."""
+    insert = False
+    if first_update:
+        # Pop earlier edits for the same index so they aren't misread as
+        # part of this conflict set
+        while not insert and edits:
+            last = edits[-1]
+            if last['action'] in ('insert', 'update') and last['index'] == index:
+                edits.pop()
+                insert = last['action'] == 'insert'
+            elif last['action'] == 'multi-insert' and \
+                    last['index'] + len(last['values']) - 1 == index:
+                last['values'].pop()
+                insert = True
+            else:
+                break
+    if insert:
+        append_edit(edits, {'action': 'insert', 'index': index, 'elemId': elem_id,
+                            'opId': op_id, 'value': value})
+    else:
+        append_edit(edits, {'action': 'update', 'index': index, 'opId': op_id,
+                            'value': value})
+
+
+def convert_insert_to_update(edits, index, elem_id):
+    """Rewrite a trailing insert-plus-updates suffix at `index` into updates
+    (ref new.js:838-869)."""
+    updates = []
+    while edits:
+        last = edits[-1]
+        if last['action'] == 'insert':
+            if last['index'] != index:
+                raise ValueError('last edit has unexpected index')
+            updates.insert(0, edits.pop())
+            break
+        elif last['action'] == 'update':
+            if last['index'] != index:
+                raise ValueError('last edit has unexpected index')
+            updates.insert(0, edits.pop())
+        else:
+            raise ValueError('last edit has unexpected action')
+    first_update = True
+    for update in updates:
+        append_update(edits, index, elem_id, update['opId'], update['value'], first_update)
+        first_update = False
+
+
+def _value_patch(op):
+    value = {'type': 'value', 'value': op.get('value')}
+    if op.get('datatype') is not None:
+        value['datatype'] = op['datatype']
+    return value
+
+
+class Elem:
+    """One list/text element: the insertion op plus all ops targeting it,
+    in ascending Lamport order."""
+    __slots__ = ('elem_id', 'ops')
+
+    def __init__(self, elem_id, ops):
+        self.elem_id = elem_id
+        self.ops = ops
+
+    def visible(self):
+        return any(len(op['succ']) == 0 for op in self.ops)
+
+
+class ObjState:
+    """State of one object in the document tree."""
+    __slots__ = ('type', 'keys', 'elems', 'by_id')
+
+    def __init__(self, type):
+        self.type = type
+        if type in ('list', 'text'):
+            self.keys = None
+            self.elems = []
+            self.by_id = {}
+        else:
+            self.keys = {}
+            self.elems = None
+            self.by_id = None
+
+    @property
+    def is_seq(self):
+        return self.elems is not None
+
+    def visible_count_before(self, pos):
+        return sum(1 for e in self.elems[:pos] if e.visible())
+
+    def visible_index_of(self, elem_id):
+        """Number of visible elements strictly before the given element."""
+        count = 0
+        for e in self.elems:
+            if e.elem_id == elem_id:
+                return count
+            if e.visible():
+                count += 1
+        raise ValueError(f'Reference element not found: {elem_id}')
+
+
+ROOT_META = {'parentObj': None, 'parentKey': None, 'opId': '_root', 'type': 'map',
+             'children': {}}
+
+
+class OpSet:
+    """The document engine: equivalent of the reference's BackendDoc
+    (new.js:1694-2069)."""
+
+    def __init__(self, buffer=None):
+        self.max_op = 0
+        self.actor_ids = []
+        self.heads = []
+        self.clock = {}
+        self.queue = []
+        self.objects = {'_root': ObjState('map')}
+        self.object_meta = {'_root': copy.deepcopy(ROOT_META)}
+        self.changes = []           # binary changes, in application order
+        self.changes_meta = []      # per-change metadata for document encoding
+        self.change_index_by_hash = {}
+        self.dependencies_by_hash = {}
+        self.dependents_by_hash = {}
+        self.hashes_by_actor = {}
+        self.binary_doc = None
+        self.extra_bytes = None
+        if buffer is not None:
+            self._load(buffer)
+
+    def clone(self):
+        other = copy.deepcopy(self)
+        return other
+
+    # ------------------------------------------------------------------
+    # Change application
+    # ------------------------------------------------------------------
+
+    def apply_changes(self, change_buffers, is_local=False):
+        """Apply binary changes; returns a patch (ref new.js:1797-1879)."""
+        if isinstance(change_buffers, (bytes, bytearray)):
+            raise TypeError('applyChanges takes an array of byte buffers, '
+                            'not just a single buffer')
+        decoded = []
+        for buffer in change_buffers:
+            for chunk in split_containers(buffer):
+                if chunk[8] in (CHUNK_TYPE_CHANGE, CHUNK_TYPE_DEFLATE):
+                    change = decode_change(chunk)
+                    change['buffer'] = chunk
+                    decoded.append(change)
+                elif chunk[8] == CHUNK_TYPE_DOCUMENT:
+                    # decode_document already normalizes each change through an
+                    # encode/decode round-trip, so only the buffer is missing
+                    for change in decode_document(chunk):
+                        change['buffer'] = encode_change(change)
+                        decoded.append(change)
+
+        patches = {'_root': empty_object_patch('_root', 'map')}
+        object_ids = set()
+        queue = decoded + self.queue
+        all_applied = []
+
+        try:
+            while True:
+                applied, queue = self._causal_gate(queue)
+                for change in applied:
+                    self._apply_decoded_change(patches, change, object_ids)
+                all_applied.extend(applied)
+                if not applied or not queue:
+                    break
+        except Exception:
+            # Roll back to the pre-call state by replaying the (unmodified)
+            # change history; cheap because it only runs on the error path
+            self._restore_from_history()
+            raise
+
+        self._setup_patches(patches, object_ids)
+
+        for change in all_applied:
+            self.changes.append(change['buffer'])
+            self.hashes_by_actor.setdefault(change['actor'], []).append(change['hash'])
+            self.change_index_by_hash[change['hash']] = len(self.changes) - 1
+            self.dependencies_by_hash[change['hash']] = list(change['deps'])
+            self.dependents_by_hash.setdefault(change['hash'], [])
+            for dep in change['deps']:
+                self.dependents_by_hash.setdefault(dep, []).append(change['hash'])
+            self.changes_meta.append({
+                'actor': change['actor'], 'seq': change['seq'],
+                'maxOp': change['startOp'] + len(change['ops']) - 1,
+                'time': change.get('time', 0), 'message': change.get('message') or '',
+                'deps': list(change['deps']),
+                'extraBytes': change.get('extraBytes'),
+            })
+        self.queue = queue
+        self.binary_doc = None
+
+        patch = {'maxOp': self.max_op, 'clock': dict(self.clock), 'deps': list(self.heads),
+                 'pendingChanges': len(self.queue), 'diffs': patches['_root']}
+        if is_local and len(decoded) == 1:
+            patch['actor'] = decoded[0]['actor']
+            patch['seq'] = decoded[0]['seq']
+        return patch
+
+    def _restore_from_history(self):
+        fresh = OpSet()
+        if self.changes:
+            fresh.apply_changes(list(self.changes))
+        self.objects = fresh.objects
+        self.object_meta = fresh.object_meta
+        self.max_op = fresh.max_op
+        self.actor_ids = fresh.actor_ids
+        self.heads = fresh.heads
+        self.clock = fresh.clock
+
+    def _causal_gate(self, changes):
+        """Partition changes into causally-ready (applied to clock/heads) and
+        enqueued (ref new.js:1550-1586)."""
+        heads = set(self.heads)
+        change_hashes = set()
+        clock = dict(self.clock)
+        applied, enqueued = [], []
+        for change in changes:
+            if change['hash'] in self.change_index_by_hash or change['hash'] in change_hashes:
+                continue
+            expected_seq = clock.get(change['actor'], 0) + 1
+            ready = all(dep in self.change_index_by_hash or dep in change_hashes
+                        for dep in change['deps'])
+            if not ready:
+                enqueued.append(change)
+            elif change['seq'] < expected_seq:
+                raise ValueError(
+                    f"Reuse of sequence number {change['seq']} for actor {change['actor']}")
+            elif change['seq'] > expected_seq:
+                raise ValueError(
+                    f"Skipped sequence number {expected_seq} for actor {change['actor']}")
+            else:
+                clock[change['actor']] = change['seq']
+                change_hashes.add(change['hash'])
+                for dep in change['deps']:
+                    heads.discard(dep)
+                heads.add(change['hash'])
+                applied.append(change)
+        if applied:
+            self.heads = sorted(heads)
+            self.clock = clock
+        return applied, enqueued
+
+    def _apply_decoded_change(self, patches, change, object_ids):
+        if change['actor'] not in self.actor_ids:
+            self.actor_ids.append(change['actor'])
+        start_op = change['startOp']
+        for i, op in enumerate(change['ops']):
+            op_id = f"{start_op + i}@{change['actor']}"
+            if start_op + i > self.max_op:
+                self.max_op = start_op + i
+            self._apply_op(patches, op_id, op, object_ids)
+
+    def _apply_op(self, patches, op_id, op, object_ids):
+        object_id = op['obj']
+        obj = self.objects.get(object_id)
+        if obj is None:
+            raise ValueError(f'modification of unknown object {object_id}')
+        object_ids.add(object_id)
+
+        record = {
+            'id': op_id, 'action': op['action'], 'insert': bool(op.get('insert')),
+            'succ': [],
+        }
+        if 'value' in op:
+            record['value'] = op['value']
+        if op.get('datatype') is not None:
+            record['datatype'] = op['datatype']
+        if op.get('child') is not None:
+            record['child'] = op['child']
+        if obj.is_seq:
+            # Keep the original reference elemId (needed to serialize the
+            # document's keyActor/keyCtr columns); the element's own id is
+            # derived from the record id when insert is set
+            record['elemId'] = op.get('elemId')
+        else:
+            record['key'] = op.get('key')
+
+        # A make* op brings a new object into existence
+        if op['action'] in OBJECT_TYPE and op_id not in self.objects:
+            self.objects[op_id] = ObjState(OBJECT_TYPE[op['action']])
+
+        if op.get('insert'):
+            self._apply_insert(patches, object_id, obj, record, op)
+        else:
+            self._apply_update(patches, object_id, obj, record, op)
+
+    def _apply_insert(self, patches, object_id, obj, record, op):
+        """RGA list insertion (ref new.js seekWithinBlock:95-163)."""
+        if not obj.is_seq:
+            raise ValueError(f'insert into non-list object {object_id}')
+        if op.get('pred'):
+            pred = op['pred'][0]
+            raise ValueError(f'no matching operation for pred: {pred}')
+        op_id = record['id']
+        if op_id in obj.by_id:
+            raise ValueError(f'duplicate operation ID: {op_id}')
+        ref = op.get('elemId', '_head')
+        if ref == '_head':
+            pos = 0
+        else:
+            relem = obj.by_id.get(ref)
+            if relem is None:
+                raise ValueError(f'Reference element not found: {ref}')
+            pos = obj.elems.index(relem) + 1
+        # Skip concurrent insertions with greater opIds (descending-order rule)
+        my_key = lamport_key(op_id)
+        while pos < len(obj.elems) and lamport_key(obj.elems[pos].elem_id) > my_key:
+            pos += 1
+        list_index = obj.visible_count_before(pos)
+        elem = Elem(op_id, [record])
+        obj.elems.insert(pos, elem)
+        obj.by_id[op_id] = elem
+
+        prop_state = {}
+        self._update_patch_property(patches, object_id, record, prop_state,
+                                    list_index, None, self.object_meta)
+
+    def _apply_update(self, patches, object_id, obj, record, op):
+        """Apply a non-insert op: merge into the target key's op list, mark
+        succ on preds, and emit patch calls for every op of that key in
+        ascending Lamport order (equivalent to the doc-op consumption in
+        new.js mergeDocChangeOps:1067-1282)."""
+        op_id = record['id']
+        if obj.is_seq:
+            elem_id = op.get('elemId')
+            elem = obj.by_id.get(elem_id)
+            if elem is None:
+                raise ValueError(f'Reference element not found: {elem_id}')
+            rows = elem.ops
+        else:
+            key = op.get('key')
+            if key is None:
+                raise ValueError(f'Unexpected operation key: {op}')
+            rows = self.objects[object_id].keys.setdefault(key, [])
+
+        # Capture old succ counts (before this op's overwrites are recorded)
+        old_succ = {row['id']: len(row['succ']) for row in rows}
+
+        # Mark this op as successor of each of its preds
+        preds = list(op.get('pred', []))
+        pred_set = set(preds)
+        seen = set()
+        for row in rows:
+            if row['id'] == op_id:
+                raise ValueError(f'duplicate operation ID: {op_id}')
+            if row['id'] in pred_set:
+                row['succ'].append(op_id)
+                row['succ'].sort(key=lamport_key)
+                seen.add(row['id'])
+        for pred in preds:
+            if pred not in seen:
+                raise ValueError(f'no matching operation for pred: {pred}')
+
+        is_del = op['action'] == 'del'
+        # Insert the new op into the key's op list in ascending Lamport order
+        # (deletions exist only as succ entries, not as rows)
+        if not is_del:
+            insert_at = len(rows)
+            my_key = lamport_key(op_id)
+            for i, row in enumerate(rows):
+                if lamport_key(row['id']) > my_key:
+                    insert_at = i
+                    break
+            rows.insert(insert_at, record)
+
+        # Emit patch calls for all ops of this key in order
+        if obj.is_seq:
+            list_index = obj.visible_index_of(op.get('elemId'))
+        else:
+            list_index = 0
+        prop_state = {}
+        for row in rows:
+            if row is record:
+                self._update_patch_property(patches, object_id, row, prop_state,
+                                            list_index, None, self.object_meta)
+            else:
+                self._update_patch_property(patches, object_id, row, prop_state,
+                                            list_index, old_succ[row['id']],
+                                            self.object_meta)
+
+    # ------------------------------------------------------------------
+    # Patch generation
+    # ------------------------------------------------------------------
+
+    def _update_patch_property(self, patches, object_id, op, prop_state, list_index,
+                               old_succ_num, object_meta, whole_doc=False):
+        """Port of new.js updatePatchProperty (:884-1040): updates `patches`
+        to reflect op, carrying conflict/counter state in `prop_state`."""
+        action = op['action']
+        is_make = action in OBJECT_TYPE
+        type_ = OBJECT_TYPE.get(action)
+        op_id = op['id']
+        obj = self.objects[object_id]
+        is_seq = obj.is_seq
+        if is_seq:
+            key = op['id'] if op.get('insert') else op.get('elemId')
+        else:
+            key = op.get('key')
+
+        if is_make and op_id not in object_meta:
+            object_meta[op_id] = {'parentObj': object_id, 'parentKey': key,
+                                  'opId': op_id, 'type': type_, 'children': {}}
+            object_meta[object_id]['children'].setdefault(key, {})[op_id] = \
+                {'objectId': op_id, 'type': type_, 'props': {}}
+
+        first_op = key not in prop_state
+        state = prop_state.setdefault(
+            key, {'visibleOps': [], 'hasChild': False, 'counterStates': {}, 'action': None})
+
+        is_overwritten = old_succ_num is not None and len(op['succ']) > 0
+
+        if not is_overwritten:
+            state['visibleOps'].append(op)
+            state['hasChild'] = state['hasChild'] or is_make
+
+        prev_children = object_meta[object_id]['children'].get(key)
+        if state['hasChild'] or prev_children:
+            values = {}
+            for vis in state['visibleOps']:
+                if vis['action'] == 'set':
+                    values[vis['id']] = _value_patch(vis)
+                elif vis['action'] in OBJECT_TYPE:
+                    values[vis['id']] = {'objectId': vis['id'],
+                                         'type': OBJECT_TYPE[vis['action']], 'props': {}}
+            object_meta[object_id]['children'][key] = values
+
+        patch_key = patch_value = None
+
+        if is_overwritten and action == 'set' and op.get('datatype') == 'counter':
+            # Counter initialization: succs may be increments that accumulate
+            counter_state = {'opId': op_id, 'value': op.get('value'),
+                             'succs': set(op['succ'])}
+            for succ in op['succ']:
+                state['counterStates'][succ] = counter_state
+        elif action == 'inc':
+            counter_state = state['counterStates'].get(op_id)
+            if counter_state is None:
+                raise ValueError(f'increment operation {op_id} for unknown counter')
+            counter_state['value'] += op.get('value')
+            counter_state['succs'].discard(op_id)
+            if not counter_state['succs']:
+                patch_key = counter_state['opId']
+                patch_value = {'type': 'value', 'datatype': 'counter',
+                               'value': counter_state['value']}
+        elif not is_overwritten:
+            if action == 'set':
+                patch_key = op_id
+                patch_value = _value_patch(op)
+            elif is_make:
+                if op_id not in patches:
+                    patches[op_id] = empty_object_patch(op_id, type_)
+                patch_key = op_id
+                patch_value = patches[op_id]
+
+        if object_id not in patches:
+            patches[object_id] = empty_object_patch(object_id,
+                                                    object_meta[object_id]['type'])
+        patch = patches[object_id]
+
+        if is_seq:
+            elem_id = key
+            if old_succ_num == 0 and not whole_doc and state['action'] == 'insert':
+                # The list element already existed, so the insert becomes an update
+                state['action'] = 'update'
+                convert_insert_to_update(patch['edits'], list_index, elem_id)
+
+            if patch_value is not None:
+                if not state['action'] and (old_succ_num is None or whole_doc):
+                    state['action'] = 'insert'
+                    append_edit(patch['edits'], {'action': 'insert', 'index': list_index,
+                                                 'elemId': elem_id, 'opId': patch_key,
+                                                 'value': patch_value})
+                elif state['action'] == 'remove':
+                    last = patch['edits'][-1]
+                    if last['action'] != 'remove':
+                        raise ValueError('last edit has unexpected type')
+                    if last['count'] > 1:
+                        last['count'] -= 1
+                    else:
+                        patch['edits'].pop()
+                    state['action'] = 'update'
+                    append_update(patch['edits'], list_index, elem_id, patch_key,
+                                  patch_value, True)
+                else:
+                    append_update(patch['edits'], list_index, elem_id, patch_key,
+                                  patch_value, not state['action'])
+                    if not state['action']:
+                        state['action'] = 'update'
+            elif old_succ_num == 0 and not state['action']:
+                state['action'] = 'remove'
+                append_edit(patch['edits'], {'action': 'remove', 'index': list_index,
+                                             'count': 1})
+        elif patch_value is not None or not whole_doc:
+            if first_op or key not in patch['props']:
+                patch['props'][key] = {}
+            if patch_value is not None:
+                patch['props'][key][patch_key] = patch_value
+
+    def _setup_patches(self, patches, object_ids):
+        """Link child-object patches up the tree to the root (ref new.js:1461-1528)."""
+        for object_id in object_ids:
+            meta = self.object_meta[object_id]
+            child_meta = None
+            patch_exists = False
+            while True:
+                has_children = child_meta is not None and \
+                    bool(meta['children'].get(child_meta['parentKey']))
+                if object_id not in patches:
+                    patches[object_id] = empty_object_patch(object_id, meta['type'])
+
+                if child_meta and has_children:
+                    if meta['type'] in ('list', 'text'):
+                        for edit in patches[object_id]['edits']:
+                            if edit.get('opId') and \
+                                    edit['opId'] in meta['children'][child_meta['parentKey']]:
+                                patch_exists = True
+                        if not patch_exists:
+                            obj = self.objects[object_id]
+                            visible_count = obj.visible_index_of(child_meta['parentKey'])
+                            for op_id, value in \
+                                    meta['children'][child_meta['parentKey']].items():
+                                patch_value = value
+                                if value.get('objectId'):
+                                    if value['objectId'] not in patches:
+                                        patches[value['objectId']] = \
+                                            empty_object_patch(value['objectId'], value['type'])
+                                    patch_value = patches[value['objectId']]
+                                append_edit(patches[object_id]['edits'],
+                                            {'action': 'update', 'index': visible_count,
+                                             'opId': op_id, 'value': patch_value})
+                    else:
+                        values = patches[object_id]['props'].setdefault(
+                            child_meta['parentKey'], {})
+                        for op_id, value in \
+                                meta['children'][child_meta['parentKey']].items():
+                            if op_id in values:
+                                patch_exists = True
+                            elif value.get('objectId'):
+                                if value['objectId'] not in patches:
+                                    patches[value['objectId']] = \
+                                        empty_object_patch(value['objectId'], value['type'])
+                                values[op_id] = patches[value['objectId']]
+                            else:
+                                values[op_id] = value
+
+                if patch_exists or not meta['parentObj'] or \
+                        (child_meta and not has_children):
+                    break
+                child_meta = meta
+                object_id = meta['parentObj']
+                meta = self.object_meta[object_id]
+        return patches
+
+    # ------------------------------------------------------------------
+    # Whole-document patch (ref new.js documentPatch:1604-1635)
+    # ------------------------------------------------------------------
+
+    def get_patch(self):
+        object_meta = {'_root': copy.deepcopy(ROOT_META)}
+        patches = {'_root': empty_object_patch('_root', 'map')}
+        for object_id in self._document_object_order():
+            obj = self.objects[object_id]
+            prop_state = {}
+            if obj.is_seq:
+                list_index = 0
+                for elem in obj.elems:
+                    for row in elem.ops:
+                        self._update_patch_property(patches, object_id, row, prop_state,
+                                                    list_index, len(row['succ']),
+                                                    object_meta, whole_doc=True)
+                    if elem.visible():
+                        list_index += 1
+            else:
+                for key in sorted(obj.keys.keys(), key=_utf16_key):
+                    for row in obj.keys[key]:
+                        self._update_patch_property(patches, object_id, row, prop_state,
+                                                    0, len(row['succ']),
+                                                    object_meta, whole_doc=True)
+        return {'maxOp': self.max_op, 'clock': dict(self.clock),
+                'deps': list(self.heads), 'pendingChanges': len(self.queue),
+                'diffs': patches['_root']}
+
+    def _document_object_order(self):
+        """Objects in document order: root first, then ascending (counter, actor)."""
+        others = [oid for oid in self.objects if oid != '_root']
+        others.sort(key=lamport_key)
+        return ['_root'] + others
+
+    # ------------------------------------------------------------------
+    # Serialization
+    # ------------------------------------------------------------------
+
+    def _document_ops(self):
+        """All ops in document order, as dicts for columnar encoding."""
+        ops = []
+        for object_id in self._document_object_order():
+            obj = self.objects[object_id]
+            if obj.is_seq:
+                for elem in obj.elems:
+                    for row in elem.ops:
+                        op = {'obj': object_id, 'action': row['action'],
+                              'insert': row.get('insert', False),
+                              'id': row['id'], 'succ': list(row['succ']),
+                              'elemId': row['elemId']}
+                        if 'value' in row:
+                            op['value'] = row['value']
+                        if 'datatype' in row:
+                            op['datatype'] = row['datatype']
+                        if 'child' in row:
+                            op['child'] = row['child']
+                        ops.append(op)
+            else:
+                for key in sorted(obj.keys.keys(), key=_utf16_key):
+                    for row in obj.keys[key]:
+                        op = {'obj': object_id, 'action': row['action'],
+                              'key': key, 'insert': False,
+                              'id': row['id'], 'succ': list(row['succ'])}
+                        if 'value' in row:
+                            op['value'] = row['value']
+                        if 'datatype' in row:
+                            op['datatype'] = row['datatype']
+                        if 'child' in row:
+                            op['child'] = row['child']
+                        ops.append(op)
+        return ops
+
+    def save(self):
+        """Serialize to the document container format (ref new.js:2033-2055)."""
+        if self.binary_doc:
+            return self.binary_doc
+        doc_ops = self._document_ops()
+        # Re-encode ops with parsed ids against our actor table
+        from ..columnar import ParsedOpId
+        actor_index = {actor: i for i, actor in enumerate(self.actor_ids)}
+
+        def parse(op_id_str):
+            ctr, actor = parse_op_id(op_id_str)
+            return ParsedOpId(ctr, actor_index[actor], actor)
+
+        parsed_ops = []
+        for op in doc_ops:
+            parsed = dict(op)
+            parsed['id'] = parse(op['id'])
+            parsed['obj'] = op['obj'] if op['obj'] == '_root' else parse(op['obj'])
+            if parsed.get('elemId') not in (None, '_head'):
+                parsed['elemId'] = parse(parsed['elemId'])
+            parsed['succ'] = [parse(s) for s in op['succ']]
+            if parsed.get('child') is not None:
+                parsed['child'] = parse(parsed['child'])
+            parsed_ops.append(parsed)
+        ops_columns = encode_ops(parsed_ops, True)
+
+        changes_columns = self._encode_changes_columns()
+        self.binary_doc = encode_document_header({
+            'changesColumns': changes_columns,
+            'opsColumns': ops_columns,
+            'actorIds': self.actor_ids,
+            'heads': list(self.heads),
+            'headsIndexes': [self.change_index_by_hash[h] for h in sorted(self.heads)],
+            'extraBytes': self.extra_bytes,
+        })
+        return self.binary_doc
+
+    def _encode_changes_columns(self):
+        columns = {name: encoder_by_column_id(cid) for name, cid in DOCUMENT_COLUMNS
+                   if (cid & 7) != 7}
+        val_raw = encoding.Encoder()
+        actor_index = {actor: i for i, actor in enumerate(self.actor_ids)}
+        for meta in self.changes_meta:
+            columns['actor'].append_value(actor_index[meta['actor']])
+            columns['seq'].append_value(meta['seq'])
+            columns['maxOp'].append_value(meta['maxOp'])
+            columns['time'].append_value(meta['time'])
+            columns['message'].append_value(meta['message'])
+            deps = sorted(meta['deps'])
+            columns['depsNum'].append_value(len(deps))
+            for dep in deps:
+                columns['depsIndex'].append_value(self.change_index_by_hash[dep])
+            extra = meta.get('extraBytes')
+            if extra:
+                num = val_raw.append_raw_bytes(extra)
+                columns['extraLen'].append_value(num << 4 | VALUE_TYPE['BYTES'])
+            else:
+                columns['extraLen'].append_value(VALUE_TYPE['BYTES'])
+        out = []
+        for name, cid in DOCUMENT_COLUMNS:
+            if name == 'extraRaw':
+                out.append((cid, name, val_raw))
+            else:
+                out.append((cid, name, columns[name]))
+        return out
+
+    def _load(self, buffer):
+        """Initialize from a saved document (or concatenated chunks)."""
+        buffer = bytes(buffer)
+        chunks = split_containers(buffer)
+        changes = []
+        for chunk in chunks:
+            if chunk[8] == CHUNK_TYPE_DOCUMENT:
+                header = decode_document_header(chunk)
+                if header['extraBytes']:
+                    self.extra_bytes = header['extraBytes']
+                for change in decode_document(chunk):
+                    changes.append(encode_change(change))
+            else:
+                changes.append(chunk)
+        if changes:
+            self.apply_changes(changes)
+        if len(chunks) == 1 and chunks[0][8] == CHUNK_TYPE_DOCUMENT:
+            self.binary_doc = buffer
+
+    # ------------------------------------------------------------------
+    # History / hash graph queries (ref new.js:1921-2028)
+    # ------------------------------------------------------------------
+
+    def get_changes(self, have_deps):
+        if not have_deps:
+            return list(self.changes)
+        stack, seen, to_return = [], set(), []
+        for h in have_deps:
+            seen.add(h)
+            successors = self.dependents_by_hash.get(h)
+            if successors is None:
+                raise ValueError(f'hash not found: {h}')
+            stack.extend(successors)
+        while stack:
+            h = stack.pop()
+            seen.add(h)
+            to_return.append(h)
+            if not all(dep in seen for dep in self.dependencies_by_hash[h]):
+                break
+            stack.extend(self.dependents_by_hash[h])
+        if not stack and all(head in seen for head in self.heads):
+            return [self.changes[self.change_index_by_hash[h]] for h in to_return]
+
+        # Slow path: collect ancestors of have_deps, return everything else
+        stack, seen = list(have_deps), set()
+        while stack:
+            h = stack.pop()
+            if h not in seen:
+                deps = self.dependencies_by_hash.get(h)
+                if deps is None:
+                    raise ValueError(f'hash not found: {h}')
+                stack.extend(deps)
+                seen.add(h)
+        return [change for change in self.changes
+                if decode_change_meta(change, True)['hash'] not in seen]
+
+    def get_changes_added(self, other):
+        stack, seen, to_return = list(self.heads), set(), []
+        while stack:
+            h = stack.pop()
+            if h not in seen and h not in other.change_index_by_hash:
+                seen.add(h)
+                to_return.append(h)
+                stack.extend(self.dependencies_by_hash[h])
+        return [self.changes[self.change_index_by_hash[h]] for h in reversed(to_return)]
+
+    def get_change_by_hash(self, hash):
+        index = self.change_index_by_hash.get(hash)
+        return self.changes[index] if index is not None else None
+
+    def get_missing_deps(self, heads=()):
+        all_deps = set(heads)
+        in_queue = set()
+        for change in self.queue:
+            in_queue.add(change['hash'])
+            all_deps.update(change['deps'])
+        return sorted(h for h in all_deps
+                      if h not in self.change_index_by_hash and h not in in_queue)

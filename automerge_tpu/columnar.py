@@ -14,7 +14,7 @@ import hashlib
 import struct
 import zlib
 
-from .common import parse_op_id
+from .common import parse_op_id, lamport_key
 from .encoding import (
     Encoder, Decoder, RLEEncoder, RLEDecoder, DeltaEncoder, DeltaDecoder,
     BooleanEncoder, BooleanDecoder, hex_string_to_bytes, bytes_to_hex_string,
@@ -746,13 +746,6 @@ def decode_changes(binary_changes):
     return decoded
 
 
-def _sort_op_id_strings_key(op_id):
-    if op_id == '_root':
-        return (-1, '')
-    counter, actor = parse_op_id(op_id)
-    return (counter, actor)
-
-
 def group_change_ops(changes, ops):
     """Redistribute a document's consolidated ops back into the changes they
     came from, resynthesizing del ops from succ entries (ref columnar.js:876-943)."""
@@ -802,7 +795,7 @@ def group_change_ops(changes, ops):
         actor_changes[left]['ops'].append(op)
 
     for change in changes:
-        change['ops'].sort(key=lambda op: _sort_op_id_strings_key(op['id']))
+        change['ops'].sort(key=lambda op: lamport_key(op['id']))
         change['startOp'] = change['maxOp'] - len(change['ops']) + 1
         del change['maxOp']
         for i, op in enumerate(change['ops']):

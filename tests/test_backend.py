@@ -1,0 +1,876 @@
+"""Backend conformance tests, ported from reference test/backend_test.js.
+
+These pin the exact patch grammar and (via hard-coded SHA-256 change hashes
+from the reference test suite) cross-implementation wire compatibility.
+"""
+
+import pytest
+
+from automerge_tpu import backend as Backend
+from automerge_tpu.columnar import encode_change, decode_change
+
+ACTOR1 = '111111'
+ACTOR2 = '222222'
+ACTOR3 = '333333'
+
+
+def hash_of(change):
+    return decode_change(encode_change(change))['hash']
+
+
+def set_op(obj, key, value, pred=(), **kw):
+    op = {'action': 'set', 'obj': obj, 'key': key, 'value': value,
+          'pred': list(pred)}
+    op.update(kw)
+    return op
+
+
+class TestIncrementalDiffs:
+    def test_assign_to_map_key(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            set_op('_root', 'bird', 'magpie')]}
+        s0 = Backend.init()
+        s1, patch1 = Backend.apply_changes(s0, [encode_change(change1)])
+        assert patch1 == {
+            'clock': {actor: 1}, 'deps': [hash_of(change1)], 'maxOp': 1,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                'bird': {f'1@{actor}': {'type': 'value', 'value': 'magpie'}}}}}
+
+    def test_increment_map_key(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            set_op('_root', 'counter', 1, datatype='counter')]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 2, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'inc', 'obj': '_root', 'key': 'counter', 'value': 2,
+             'pred': [f'1@{actor}']}]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [encode_change(change1)])
+        s2, patch2 = Backend.apply_changes(s1, [encode_change(change2)])
+        assert patch2 == {
+            'clock': {actor: 2}, 'deps': [hash_of(change2)], 'maxOp': 2,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                'counter': {f'1@{actor}': {'type': 'value', 'value': 3,
+                                           'datatype': 'counter'}}}}}
+
+    def test_conflict_on_assignment(self):
+        change1 = {'actor': ACTOR1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+                   'ops': [set_op('_root', 'bird', 'magpie')]}
+        change2 = {'actor': ACTOR2, 'seq': 1, 'startOp': 2, 'time': 0,
+                   'deps': [hash_of(change1)],
+                   'ops': [set_op('_root', 'bird', 'blackbird')]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [encode_change(change1)])
+        s2, patch2 = Backend.apply_changes(s1, [encode_change(change2)])
+        assert patch2 == {
+            'clock': {ACTOR1: 1, ACTOR2: 1}, 'deps': [hash_of(change2)], 'maxOp': 2,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                'bird': {'1@111111': {'type': 'value', 'value': 'magpie'},
+                         '2@222222': {'type': 'value', 'value': 'blackbird'}}}}}
+
+    def test_delete_map_key(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+                   'ops': [set_op('_root', 'bird', 'magpie')]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 2, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'del', 'obj': '_root', 'key': 'bird', 'pred': [f'1@{actor}']}]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [encode_change(change1)])
+        s2, patch2 = Backend.apply_changes(s1, [encode_change(change2)])
+        assert patch2 == {
+            'clock': {actor: 2}, 'deps': [hash_of(change2)], 'maxOp': 2,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'bird': {}}}}
+
+    def test_create_nested_maps(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeMap', 'obj': '_root', 'key': 'birds', 'pred': []},
+            set_op(f'1@{actor}', 'wrens', 3)]}
+        s0 = Backend.init()
+        s1, patch1 = Backend.apply_changes(s0, [encode_change(change1)])
+        assert patch1 == {
+            'clock': {actor: 1}, 'deps': [hash_of(change1)], 'maxOp': 2,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'birds': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'map', 'props': {
+                    'wrens': {f'2@{actor}': {'type': 'value', 'value': 3,
+                                             'datatype': 'int'}}}}}}}}
+
+    def test_assign_in_nested_maps(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeMap', 'obj': '_root', 'key': 'birds', 'pred': []},
+            set_op(f'1@{actor}', 'wrens', 3)]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            set_op(f'1@{actor}', 'sparrows', 15)]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [encode_change(change1)])
+        s2, patch2 = Backend.apply_changes(s1, [encode_change(change2)])
+        assert patch2 == {
+            'clock': {actor: 2}, 'deps': [hash_of(change2)], 'maxOp': 3,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'birds': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'map', 'props': {
+                    'sparrows': {f'3@{actor}': {'type': 'value', 'value': 15,
+                                                'datatype': 'int'}}}}}}}}
+
+    def test_delete_nested_map(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeMap', 'obj': '_root', 'key': 'birds', 'pred': []},
+            set_op(f'1@{actor}', 'wrens', 3)]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'del', 'obj': '_root', 'key': 'birds', 'pred': [f'1@{actor}']}]}
+        s0 = Backend.init()
+        s1, patch1 = Backend.apply_changes(
+            s0, [encode_change(change1), encode_change(change2)])
+        assert patch1 == {
+            'clock': {actor: 2}, 'deps': [hash_of(change2)], 'maxOp': 3,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'birds': {}}}}
+
+    def test_conflicts_on_nested_maps(self):
+        a1, a2 = '012345', '89abcd'
+        change1 = {'actor': a1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeMap', 'obj': '_root', 'key': 'birds', 'pred': []},
+            set_op(f'1@{a1}', 'wrens', 3)]}
+        change2 = {'actor': a1, 'seq': 2, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'makeMap', 'obj': '_root', 'key': 'birds', 'pred': [f'1@{a1}']},
+            set_op(f'3@{a1}', 'hawks', 1)]}
+        change3 = {'actor': a2, 'seq': 1, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'makeMap', 'obj': '_root', 'key': 'birds', 'pred': [f'1@{a1}']},
+            set_op(f'3@{a2}', 'sparrows', 15)]}
+        s0 = Backend.init()
+        s1, patch1 = Backend.apply_changes(
+            s0, [encode_change(c) for c in (change1, change2, change3)])
+        assert patch1 == {
+            'clock': {a1: 2, a2: 1},
+            'deps': sorted([hash_of(change2), hash_of(change3)]),
+            'maxOp': 4, 'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'birds': {
+                f'3@{a1}': {'objectId': f'3@{a1}', 'type': 'map', 'props': {
+                    'hawks': {f'4@{a1}': {'type': 'value', 'value': 1,
+                                          'datatype': 'int'}}}},
+                f'3@{a2}': {'objectId': f'3@{a2}', 'type': 'map', 'props': {
+                    'sparrows': {f'4@{a2}': {'type': 'value', 'value': 15,
+                                             'datatype': 'int'}}}}}}}}
+
+    def test_updates_inside_conflicted_map_keys(self):
+        a1, a2 = '012345', '89abcd'
+        change1 = {'actor': a1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeMap', 'obj': '_root', 'key': 'birds', 'pred': []},
+            set_op(f'1@{a1}', 'hawks', 1)]}
+        change2 = {'actor': a2, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeMap', 'obj': '_root', 'key': 'birds', 'pred': []},
+            set_op(f'1@{a2}', 'sparrows', 15)]}
+        change3 = {'actor': a1, 'seq': 2, 'startOp': 3, 'time': 0,
+                   'deps': sorted([hash_of(change1), hash_of(change2)]), 'ops': [
+            set_op(f'1@{a2}', 'sparrows', 17, pred=[f'2@{a2}'])]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(
+            s0, [encode_change(change1), encode_change(change2)])
+        s2, patch2 = Backend.apply_changes(s1, [encode_change(change3)])
+        assert patch2 == {
+            'clock': {a1: 2, a2: 1}, 'deps': [hash_of(change3)], 'maxOp': 3,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'birds': {
+                f'1@{a1}': {'objectId': f'1@{a1}', 'type': 'map', 'props': {}},
+                f'1@{a2}': {'objectId': f'1@{a2}', 'type': 'map', 'props': {
+                    'sparrows': {f'3@{a1}': {'type': 'value', 'value': 17,
+                                             'datatype': 'int'}}}}}}}}
+
+    def test_updates_inside_deleted_maps(self):
+        a1, a2 = '012345', '89abcd'
+        change1 = {'actor': a1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeMap', 'obj': '_root', 'key': 'birds', 'pred': []},
+            set_op(f'1@{a1}', 'hawks', 1)]}
+        change2 = {'actor': a2, 'seq': 1, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'del', 'obj': '_root', 'key': 'birds', 'pred': [f'1@{a1}']}]}
+        change3 = {'actor': a1, 'seq': 2, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            set_op(f'1@{a1}', 'hawks', 2, pred=[f'2@{a1}'])]}
+        s0 = Backend.init()
+        s1, patch1 = Backend.apply_changes(
+            s0, [encode_change(change1), encode_change(change2)])
+        s2, patch2 = Backend.apply_changes(s1, [encode_change(change3)])
+        assert patch1 == {
+            'clock': {a1: 1, a2: 1}, 'deps': [hash_of(change2)], 'maxOp': 3,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'birds': {}}}}
+        assert patch2 == {
+            'clock': {a1: 2, a2: 1},
+            'deps': sorted([hash_of(change2), hash_of(change3)]), 'maxOp': 3,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {}}}
+
+    def test_create_lists(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'birds', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': '_head', 'insert': True,
+             'value': 'chaffinch', 'pred': []}]}
+        s0 = Backend.init()
+        s1, patch1 = Backend.apply_changes(s0, [encode_change(change1)])
+        assert patch1 == {
+            'clock': {actor: 1}, 'deps': [hash_of(change1)], 'maxOp': 2,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'birds': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'list', 'edits': [
+                    {'action': 'insert', 'index': 0, 'elemId': f'2@{actor}',
+                     'opId': f'2@{actor}',
+                     'value': {'type': 'value', 'value': 'chaffinch'}}]}}}}}
+
+    def test_apply_updates_inside_lists(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'birds', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': '_head', 'insert': True,
+             'value': 'chaffinch', 'pred': []}]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': f'2@{actor}',
+             'value': 'greenfinch', 'pred': [f'2@{actor}']}]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [encode_change(change1)])
+        s2, patch2 = Backend.apply_changes(s1, [encode_change(change2)])
+        assert patch2 == {
+            'clock': {actor: 2}, 'deps': [hash_of(change2)], 'maxOp': 3,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'birds': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'list', 'edits': [
+                    {'action': 'update', 'opId': f'3@{actor}', 'index': 0,
+                     'value': {'type': 'value', 'value': 'greenfinch'}}]}}}}}
+
+    def test_updates_to_objects_in_list_elements(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'todos', 'pred': []},
+            {'action': 'makeMap', 'obj': f'1@{actor}', 'elemId': '_head',
+             'insert': True, 'pred': []},
+            set_op(f'2@{actor}', 'title', 'buy milk'),
+            set_op(f'2@{actor}', 'done', False)]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 5, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'makeMap', 'obj': f'1@{actor}', 'elemId': '_head',
+             'insert': True, 'pred': []},
+            set_op(f'5@{actor}', 'title', 'water plants'),
+            set_op(f'5@{actor}', 'done', False),
+            set_op(f'2@{actor}', 'done', True, pred=[f'4@{actor}'])]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [encode_change(change1)])
+        s2, patch2 = Backend.apply_changes(s1, [encode_change(change2)])
+        assert patch2 == {
+            'clock': {actor: 2}, 'deps': [hash_of(change2)], 'maxOp': 8,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'todos': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'list', 'edits': [
+                    {'action': 'insert', 'index': 0, 'elemId': f'5@{actor}',
+                     'opId': f'5@{actor}', 'value': {
+                         'objectId': f'5@{actor}', 'type': 'map', 'props': {
+                             'title': {f'6@{actor}': {'type': 'value',
+                                                      'value': 'water plants'}},
+                             'done': {f'7@{actor}': {'type': 'value',
+                                                     'value': False}}}}},
+                    {'action': 'update', 'index': 1, 'opId': f'2@{actor}', 'value': {
+                        'objectId': f'2@{actor}', 'type': 'map', 'props': {
+                            'done': {f'8@{actor}': {'type': 'value',
+                                                    'value': True}}}}}]}}}}}
+
+    def test_updates_inside_conflicted_list_elements(self):
+        a1, a2 = '01234567', '89abcdef'
+        change1 = {'actor': a1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'todos', 'pred': []},
+            {'action': 'makeMap', 'obj': f'1@{a1}', 'elemId': '_head',
+             'insert': True, 'pred': []}]}
+        change2 = {'actor': a1, 'seq': 2, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'makeMap', 'obj': f'1@{a1}', 'elemId': f'2@{a1}',
+             'pred': [f'2@{a1}']},
+            set_op(f'3@{a1}', 'title', 'buy milk'),
+            set_op(f'3@{a1}', 'done', False)]}
+        change3 = {'actor': a2, 'seq': 1, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'makeMap', 'obj': f'1@{a1}', 'elemId': f'2@{a1}',
+             'pred': [f'2@{a1}']},
+            set_op(f'3@{a2}', 'title', 'water plants'),
+            set_op(f'3@{a2}', 'done', False)]}
+        change4 = {'actor': a1, 'seq': 3, 'startOp': 6, 'time': 0,
+                   'deps': sorted([hash_of(change2), hash_of(change3)]), 'ops': [
+            set_op(f'3@{a1}', 'done', True, pred=[f'5@{a1}'])]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(
+            s0, [encode_change(c) for c in (change1, change2, change3)])
+        s2, patch2 = Backend.apply_changes(s1, [encode_change(change4)])
+        assert patch2 == {
+            'clock': {a1: 3, a2: 1}, 'deps': [hash_of(change4)], 'maxOp': 6,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'todos': {
+                f'1@{a1}': {'objectId': f'1@{a1}', 'type': 'list', 'edits': [
+                    {'action': 'update', 'index': 0, 'opId': f'3@{a1}', 'value': {
+                        'objectId': f'3@{a1}', 'type': 'map', 'props': {
+                            'done': {f'6@{a1}': {'type': 'value', 'value': True}}}}},
+                    {'action': 'update', 'index': 0, 'opId': f'3@{a2}', 'value': {
+                        'objectId': f'3@{a2}', 'type': 'map', 'props': {}}}]}}}}}
+
+    def test_overwrite_list_elements(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'todos', 'pred': []},
+            {'action': 'makeMap', 'obj': f'1@{actor}', 'elemId': '_head',
+             'insert': True, 'pred': []},
+            set_op(f'2@{actor}', 'title', 'buy milk'),
+            set_op(f'2@{actor}', 'done', False)]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 5, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'makeMap', 'obj': f'1@{actor}', 'elemId': f'2@{actor}',
+             'insert': False, 'pred': [f'2@{actor}']},
+            set_op(f'5@{actor}', 'title', 'water plants'),
+            set_op(f'5@{actor}', 'done', False)]}
+        s0 = Backend.init()
+        s1, patch1 = Backend.apply_changes(
+            s0, [encode_change(change1), encode_change(change2)])
+        assert patch1 == {
+            'clock': {actor: 2}, 'deps': [hash_of(change2)], 'maxOp': 7,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'todos': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'list', 'edits': [
+                    {'action': 'insert', 'index': 0, 'elemId': f'2@{actor}',
+                     'opId': f'5@{actor}', 'value': {
+                         'objectId': f'5@{actor}', 'type': 'map', 'props': {
+                             'title': {f'6@{actor}': {'type': 'value',
+                                                      'value': 'water plants'}},
+                             'done': {f'7@{actor}': {'type': 'value',
+                                                     'value': False}}}}}]}}}}}
+
+    def test_delete_list_elements(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'birds', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': '_head', 'insert': True,
+             'value': 'chaffinch', 'pred': []}]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'del', 'obj': f'1@{actor}', 'elemId': f'2@{actor}',
+             'pred': [f'2@{actor}']}]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [encode_change(change1)])
+        s2, patch2 = Backend.apply_changes(s1, [encode_change(change2)])
+        assert patch2 == {
+            'clock': {actor: 2}, 'deps': [hash_of(change2)], 'maxOp': 3,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'birds': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'list', 'edits': [
+                    {'action': 'remove', 'index': 0, 'count': 1}]}}}}}
+
+    def test_insert_and_delete_same_change(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'birds', 'pred': []}]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 2, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': '_head', 'insert': True,
+             'value': 'chaffinch', 'pred': []},
+            {'action': 'del', 'obj': f'1@{actor}', 'elemId': f'2@{actor}',
+             'pred': [f'2@{actor}']}]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [encode_change(change1)])
+        s2, patch2 = Backend.apply_changes(s1, [encode_change(change2)])
+        assert patch2 == {
+            'clock': {actor: 2}, 'deps': [hash_of(change2)], 'maxOp': 3,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'birds': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'list', 'edits': [
+                    {'action': 'insert', 'index': 0, 'elemId': f'2@{actor}',
+                     'opId': f'2@{actor}',
+                     'value': {'type': 'value', 'value': 'chaffinch'}},
+                    {'action': 'remove', 'index': 0, 'count': 1}]}}}}}
+
+    def test_changes_within_conflicted_objects(self):
+        a1, a2 = '012345', '89abcd'
+        change1 = {'actor': a1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'conflict', 'pred': []}]}
+        change2 = {'actor': a2, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeMap', 'obj': '_root', 'key': 'conflict', 'pred': []}]}
+        change3 = {'actor': a2, 'seq': 2, 'startOp': 2, 'time': 0,
+                   'deps': [hash_of(change2)], 'ops': [
+            set_op(f'1@{a2}', 'sparrows', 12)]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [encode_change(change1)])
+        s2, _ = Backend.apply_changes(s1, [encode_change(change2)])
+        s3, patch3 = Backend.apply_changes(s2, [encode_change(change3)])
+        assert patch3 == {
+            'clock': {a1: 1, a2: 2}, 'maxOp': 2, 'pendingChanges': 0,
+            'deps': sorted([hash_of(change1), hash_of(change3)]),
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'conflict': {
+                f'1@{a1}': {'objectId': f'1@{a1}', 'type': 'list', 'edits': []},
+                f'1@{a2}': {'objectId': f'1@{a2}', 'type': 'map', 'props': {
+                    'sparrows': {f'2@{a2}': {'type': 'value', 'value': 12,
+                                             'datatype': 'int'}}}}}}}}
+
+    def test_timestamp_at_root(self):
+        actor = 'aaaa11'
+        now = 1609459200123
+        change = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            set_op('_root', 'now', now, datatype='timestamp')]}
+        s0 = Backend.init()
+        s1, patch = Backend.apply_changes(s0, [encode_change(change)])
+        assert patch == {
+            'clock': {actor: 1}, 'deps': [hash_of(change)], 'maxOp': 1,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                'now': {f'1@{actor}': {'type': 'value', 'value': now,
+                                       'datatype': 'timestamp'}}}}}
+
+    def test_updates_to_deleted_object(self):
+        a1, a2 = '012345', '89abcd'
+        change1 = {'actor': a1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeMap', 'obj': '_root', 'key': 'birds', 'pred': []},
+            set_op(f'1@{a1}', 'blackbirds', 2)]}
+        change2 = {'actor': a2, 'seq': 1, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'del', 'obj': '_root', 'key': 'birds', 'pred': [f'1@{a1}']}]}
+        change3 = {'actor': a1, 'seq': 2, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            set_op(f'1@{a1}', 'blackbirds', 2)]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [encode_change(change1)])
+        s2, _ = Backend.apply_changes(s1, [encode_change(change2)])
+        s3, patch3 = Backend.apply_changes(s2, [encode_change(change3)])
+        assert patch3 == {
+            'clock': {a1: 2, a2: 1}, 'maxOp': 3, 'pendingChanges': 0,
+            'deps': sorted([hash_of(change2), hash_of(change3)]),
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {}}}
+
+    def test_multi_insert_int(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'todos', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'insert': True, 'elemId': '_head',
+             'pred': [], 'datatype': 'int', 'values': [1, 2, 3, 4, 5]}]}
+        s0 = Backend.init()
+        s1, patch1 = Backend.apply_changes(s0, [encode_change(change1)])
+        assert patch1 == {
+            'clock': {actor: 1}, 'deps': [hash_of(change1)], 'maxOp': 6,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'todos': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'list', 'edits': [
+                    {'action': 'multi-insert', 'index': 0, 'elemId': f'2@{actor}',
+                     'datatype': 'int', 'values': [1, 2, 3, 4, 5]}]}}}}}
+
+    def test_multi_insert_bool(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'todos', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'insert': True, 'elemId': '_head',
+             'pred': [], 'values': [True, True, False, True, False]}]}
+        s0 = Backend.init()
+        s1, patch1 = Backend.apply_changes(s0, [encode_change(change1)])
+        assert patch1['diffs']['props']['todos'][f'1@{actor}']['edits'] == [
+            {'action': 'multi-insert', 'index': 0, 'elemId': f'2@{actor}',
+             'values': [True, True, False, True, False]}]
+
+    def test_multi_insert_null(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'todos', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'insert': True, 'elemId': '_head',
+             'pred': [], 'values': [None, None, None]}]}
+        s0 = Backend.init()
+        s1, patch1 = Backend.apply_changes(s0, [encode_change(change1)])
+        assert patch1['maxOp'] == 4
+        assert patch1['diffs']['props']['todos'][f'1@{actor}']['edits'] == [
+            {'action': 'multi-insert', 'index': 0, 'elemId': f'2@{actor}',
+             'values': [None, None, None]}]
+
+    def test_multi_delete(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'todos', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'insert': True, 'elemId': '_head',
+             'pred': [], 'datatype': 'int', 'values': [1, 2, 3, 4, 5]}]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 7, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'del', 'obj': f'1@{actor}', 'elemId': f'3@{actor}',
+             'multiOp': 3, 'pred': [f'3@{actor}']}]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [encode_change(change1)])
+        s2, patch2 = Backend.apply_changes(s1, [encode_change(change2)])
+        assert patch2['diffs']['props']['todos'][f'1@{actor}']['edits'] == [
+            {'action': 'remove', 'index': 1, 'count': 3}]
+
+
+class TestApplyLocalChange:
+    def test_apply_change_requests(self):
+        change1 = {'actor': ACTOR1, 'seq': 1, 'time': 0, 'startOp': 1, 'deps': [],
+                   'ops': [set_op('_root', 'bird', 'magpie')]}
+        s0 = Backend.init()
+        s1, patch1, _bin = Backend.apply_local_change(s0, change1)
+        changes01 = [decode_change(c) for c in Backend.get_all_changes(s1)]
+        assert patch1 == {
+            'actor': ACTOR1, 'seq': 1, 'clock': {ACTOR1: 1}, 'deps': [],
+            'maxOp': 1, 'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                'bird': {'1@111111': {'type': 'value', 'value': 'magpie'}}}}}
+        # exact hash from the reference implementation (backend_test.js:745)
+        assert changes01 == [{
+            'hash': '2c2845859ce4336936f56410f9161a09ba269f48aee5826782f1c389ec01d054',
+            'actor': ACTOR1, 'seq': 1, 'startOp': 1, 'time': 0, 'message': '',
+            'deps': [], 'ops': [
+                {'action': 'set', 'obj': '_root', 'key': 'bird', 'insert': False,
+                 'value': 'magpie', 'pred': []}]}]
+
+    def test_duplicate_requests_throw(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'time': 0, 'startOp': 1, 'deps': [],
+                   'ops': [set_op('_root', 'bird', 'magpie')]}
+        change2 = {'actor': actor, 'seq': 2, 'time': 0, 'startOp': 2, 'deps': [],
+                   'ops': [set_op('_root', 'bird', 'jay')]}
+        s0 = Backend.init()
+        s1, _, _ = Backend.apply_local_change(s0, change1)
+        s2, _, _ = Backend.apply_local_change(s1, change2)
+        with pytest.raises(ValueError, match='Change request has already been applied'):
+            Backend.apply_local_change(s2, dict(change1))
+        with pytest.raises(ValueError, match='Change request has already been applied'):
+            Backend.apply_local_change(s2, dict(change2))
+
+    def test_concurrent_frontend_backend_changes(self):
+        local1 = {'actor': ACTOR1, 'seq': 1, 'time': 0, 'startOp': 1, 'deps': [],
+                  'ops': [set_op('_root', 'bird', 'magpie')]}
+        local2 = {'actor': ACTOR1, 'seq': 2, 'time': 0, 'startOp': 2, 'deps': [],
+                  'ops': [set_op('_root', 'bird', 'jay', pred=['1@111111'])]}
+        remote1 = {'actor': ACTOR2, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+                   'ops': [set_op('_root', 'fish', 'goldfish')]}
+        s0 = Backend.init()
+        s1, _, _ = Backend.apply_local_change(s0, local1)
+        s2, _ = Backend.apply_changes(s1, [encode_change(remote1)])
+        s3, _, _ = Backend.apply_local_change(s2, local2)
+        changes = [decode_change(c) for c in Backend.get_all_changes(s3)]
+        assert changes[0]['hash'] == \
+            '2c2845859ce4336936f56410f9161a09ba269f48aee5826782f1c389ec01d054'
+        assert changes[1]['hash'] == \
+            'efc7e9b1b809364fb1b7029d2838dd3c7cf539eea595b22f9ae665505187f6c4'
+        assert changes[2]['hash'] == \
+            'e7ed7a790432aba39fe7ad75fa9e02a9fc8d8e9ee4ec8c81dcc93da15a561f8a'
+        assert changes[2]['deps'] == [changes[0]['hash']]
+
+    def test_insert_delete_same_local_change(self):
+        local1 = {'actor': ACTOR1, 'seq': 1, 'startOp': 1, 'deps': [], 'time': 0,
+                  'ops': [{'obj': '_root', 'action': 'makeList', 'key': 'birds',
+                           'pred': []}]}
+        local2 = {'actor': ACTOR1, 'seq': 2, 'startOp': 2, 'deps': [], 'time': 0,
+                  'ops': [
+            {'obj': '1@111111', 'action': 'set', 'elemId': '_head', 'insert': True,
+             'value': 'magpie', 'pred': []},
+            {'obj': '1@111111', 'action': 'del', 'elemId': '2@111111',
+             'pred': ['2@111111']}]}
+        s0 = Backend.init()
+        s1, _, _ = Backend.apply_local_change(s0, local1)
+        s2, patch2, _ = Backend.apply_local_change(s1, local2)
+        assert patch2 == {
+            'actor': ACTOR1, 'seq': 2, 'clock': {ACTOR1: 2}, 'deps': [],
+            'maxOp': 3, 'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'birds': {
+                '1@111111': {'objectId': '1@111111', 'type': 'list', 'edits': [
+                    {'action': 'insert', 'index': 0, 'elemId': '2@111111',
+                     'opId': '2@111111',
+                     'value': {'type': 'value', 'value': 'magpie'}},
+                    {'action': 'remove', 'index': 0, 'count': 1}]}}}}}
+        changes = [decode_change(c) for c in Backend.get_all_changes(s2)]
+        assert changes[1]['hash'] == \
+            'deef4c9b9ca378844144c4bbc5d82a52f30c95a8624f13f243fe8f1214e8e833'
+
+    def test_conflict_resolution(self):
+        change1 = {'actor': ACTOR1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+                   'ops': [set_op('_root', 'bird', 'magpie')]}
+        change2 = {'actor': ACTOR2, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+                   'ops': [set_op('_root', 'bird', 'blackbird')]}
+        change3 = {'actor': ACTOR3, 'seq': 1, 'startOp': 2, 'time': 0,
+                   'deps': sorted([hash_of(change1), hash_of(change2)]),
+                   'ops': [set_op('_root', 'bird', 'robin',
+                                  pred=['1@111111', '1@222222'])]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(
+            s0, [encode_change(change1), encode_change(change2)])
+        s2, patch2, _ = Backend.apply_local_change(s1, dict(change3))
+        assert patch2 == {
+            'clock': {ACTOR1: 1, ACTOR2: 1, ACTOR3: 1}, 'deps': [],
+            'actor': ACTOR3, 'seq': 1, 'maxOp': 2, 'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                'bird': {'2@333333': {'type': 'value', 'value': 'robin'}}}}}
+
+    def test_deflate_changes(self):
+        long_string = 'a' * 1024
+        change1 = {'actor': ACTOR1, 'seq': 1, 'time': 0, 'startOp': 1, 'deps': [],
+                   'ops': [set_op('_root', 'longString', long_string)]}
+        s1, _, _ = Backend.apply_local_change(Backend.init(), change1)
+        changes = Backend.get_all_changes(s1)
+        assert len(changes[0]) < 100
+        s2, patch2 = Backend.apply_changes(Backend.init(), changes)
+        assert patch2['diffs']['props']['longString'] == {
+            '1@111111': {'type': 'value', 'value': long_string}}
+
+
+class TestSaveLoad:
+    def test_reconstruct_conflict_resolving_changes(self):
+        a1, a2 = '8765', '1234'
+        change1 = {'actor': a1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+                   'ops': [set_op('_root', 'bird', 'magpie')]}
+        change2 = {'actor': a2, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+                   'ops': [set_op('_root', 'bird', 'blackbird')]}
+        change3 = {'actor': a1, 'seq': 2, 'startOp': 2, 'time': 0,
+                   'deps': sorted([hash_of(change1), hash_of(change2)]),
+                   'ops': [set_op('_root', 'bird', 'robin',
+                                  pred=[f'1@{a1}', f'1@{a2}'])]}
+        s1 = Backend.load_changes(
+            Backend.init(), [encode_change(c) for c in (change1, change2, change3)])
+        s2 = Backend.load(Backend.save(s1))
+        assert Backend.get_heads(s2) == [hash_of(change3)]
+
+    def test_deflate_columns(self):
+        long_string = 'a' * 1024
+        change1 = {'actor': ACTOR1, 'seq': 1, 'time': 0, 'startOp': 1, 'deps': [],
+                   'ops': [set_op('_root', 'longString', long_string)]}
+        doc = Backend.save(Backend.load_changes(Backend.init(), [encode_change(change1)]))
+        assert len(doc) < 200
+        patch = Backend.get_patch(Backend.load(doc))
+        assert patch == {
+            'clock': {ACTOR1: 1}, 'deps': [hash_of(change1)], 'maxOp': 1,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                'longString': {'1@111111': {'type': 'value', 'value': long_string}}}}}
+
+    def test_save_load_round_trip_lists(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'birds', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': '_head', 'insert': True,
+             'value': 'chaffinch', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': f'2@{actor}',
+             'insert': True, 'value': 'goldfinch', 'pred': []}]}
+        s1 = Backend.load_changes(Backend.init(), [encode_change(change1)])
+        s2 = Backend.load(Backend.save(s1))
+        assert Backend.get_patch(s2) == Backend.get_patch(
+            Backend.load_changes(Backend.init(), [encode_change(change1)]))
+
+
+class TestGetPatch:
+    def test_most_recent_value(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+                   'ops': [set_op('_root', 'bird', 'magpie')]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 2, 'time': 0,
+                   'deps': [hash_of(change1)],
+                   'ops': [set_op('_root', 'bird', 'blackbird', pred=[f'1@{actor}'])]}
+        s1 = Backend.load_changes(
+            Backend.init(), [encode_change(change1), encode_change(change2)])
+        assert Backend.get_patch(s1) == {
+            'clock': {actor: 2}, 'deps': [hash_of(change2)], 'maxOp': 2,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                'bird': {f'2@{actor}': {'type': 'value', 'value': 'blackbird'}}}}}
+
+    def test_conflicting_values(self):
+        change1 = {'actor': ACTOR1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+                   'ops': [set_op('_root', 'bird', 'magpie')]}
+        change2 = {'actor': ACTOR2, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+                   'ops': [set_op('_root', 'bird', 'blackbird')]}
+        s1 = Backend.load_changes(
+            Backend.init(), [encode_change(change1), encode_change(change2)])
+        assert Backend.get_patch(s1)['diffs']['props']['bird'] == {
+            '1@111111': {'type': 'value', 'value': 'magpie'},
+            '1@222222': {'type': 'value', 'value': 'blackbird'}}
+
+    def test_counter_increments(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+                   'ops': [set_op('_root', 'counter', 1, datatype='counter')]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 2, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'inc', 'obj': '_root', 'key': 'counter', 'value': 2,
+             'pred': [f'1@{actor}']}]}
+        s1 = Backend.load_changes(
+            Backend.init(), [encode_change(change1), encode_change(change2)])
+        assert Backend.get_patch(s1)['diffs']['props']['counter'] == {
+            f'1@{actor}': {'type': 'value', 'value': 3, 'datatype': 'counter'}}
+
+    def test_counter_deletion(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+                   'ops': [set_op('_root', 'counter', 1, datatype='counter')]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 2, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'inc', 'obj': '_root', 'key': 'counter', 'value': 2,
+             'pred': [f'1@{actor}']}]}
+        change3 = {'actor': actor, 'seq': 3, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change2)], 'ops': [
+            {'action': 'del', 'obj': '_root', 'key': 'counter',
+             'pred': [f'1@{actor}']}]}
+        s1 = Backend.load_changes(
+            Backend.init(),
+            [encode_change(c) for c in (change1, change2, change3)])
+        assert Backend.get_patch(s1)['diffs'] == \
+            {'objectId': '_root', 'type': 'map', 'props': {}}
+
+    def test_latest_list_state(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'birds', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': '_head', 'insert': True,
+             'value': 'chaffinch', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': f'2@{actor}',
+             'insert': True, 'value': 'goldfinch', 'pred': []}]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 4, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'del', 'obj': f'1@{actor}', 'elemId': f'2@{actor}',
+             'pred': [f'2@{actor}']},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': f'2@{actor}',
+             'insert': True, 'value': 'greenfinch', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': f'3@{actor}',
+             'value': 'goldfinches!!', 'pred': [f'3@{actor}']}]}
+        s1 = Backend.load_changes(
+            Backend.init(), [encode_change(change1), encode_change(change2)])
+        assert Backend.get_patch(s1)['diffs']['props']['birds'][f'1@{actor}'] == {
+            'objectId': f'1@{actor}', 'type': 'list', 'edits': [
+                {'action': 'insert', 'index': 0, 'elemId': f'5@{actor}',
+                 'opId': f'5@{actor}',
+                 'value': {'type': 'value', 'value': 'greenfinch'}},
+                {'action': 'insert', 'index': 1, 'elemId': f'3@{actor}',
+                 'opId': f'6@{actor}',
+                 'value': {'type': 'value', 'value': 'goldfinches!!'}}]}
+
+    def test_conflicts_on_list_elements(self):
+        a1, a2 = '01234567', '89abcdef'
+        change1 = {'actor': a1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'birds', 'pred': []},
+            {'action': 'set', 'obj': f'1@{a1}', 'elemId': '_head', 'insert': True,
+             'value': 'chaffinch', 'pred': []},
+            {'action': 'set', 'obj': f'1@{a1}', 'elemId': f'2@{a1}', 'insert': True,
+             'value': 'magpie', 'pred': []}]}
+        change2 = {'actor': a1, 'seq': 2, 'startOp': 4, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{a1}', 'elemId': f'2@{a1}',
+             'value': 'greenfinch', 'pred': [f'2@{a1}']}]}
+        change3 = {'actor': a2, 'seq': 1, 'startOp': 4, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{a1}', 'elemId': f'2@{a1}',
+             'value': 'goldfinch', 'pred': [f'2@{a1}']}]}
+        s1 = Backend.load_changes(
+            Backend.init(), [encode_change(c) for c in (change1, change2, change3)])
+        assert Backend.get_patch(s1)['diffs']['props']['birds'][f'1@{a1}'] == {
+            'objectId': f'1@{a1}', 'type': 'list', 'edits': [
+                {'action': 'insert', 'index': 0, 'elemId': f'2@{a1}',
+                 'opId': f'4@{a1}',
+                 'value': {'type': 'value', 'value': 'greenfinch'}},
+                {'action': 'update', 'index': 0, 'opId': f'4@{a2}',
+                 'value': {'type': 'value', 'value': 'goldfinch'}},
+                {'action': 'insert', 'index': 1, 'elemId': f'3@{a1}',
+                 'opId': f'3@{a1}',
+                 'value': {'type': 'value', 'value': 'magpie'}}]}
+
+    def test_condense_multiple_inserts(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'birds', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': '_head', 'insert': True,
+             'value': 'chaffinch', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': f'2@{actor}',
+             'insert': True, 'value': 'goldfinch', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': f'3@{actor}',
+             'insert': True, 'values': ['bullfinch', 'greenfinch'], 'pred': []}]}
+        s1 = Backend.load_changes(Backend.init(), [encode_change(change1)])
+        assert Backend.get_patch(s1)['diffs']['props']['birds'][f'1@{actor}']['edits'] == [
+            {'action': 'multi-insert', 'index': 0, 'elemId': f'2@{actor}',
+             'values': ['chaffinch', 'goldfinch', 'bullfinch', 'greenfinch']}]
+
+    def test_multi_insert_only_consecutive(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'birds', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': '_head', 'insert': True,
+             'value': 'chaffinch', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': f'2@{actor}',
+             'insert': True, 'value': 'goldfinch', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': '_head', 'insert': True,
+             'values': ['bullfinch', 'greenfinch'], 'pred': []}]}
+        s1 = Backend.load_changes(Backend.init(), [encode_change(change1)])
+        assert Backend.get_patch(s1)['diffs']['props']['birds'][f'1@{actor}']['edits'] == [
+            {'action': 'multi-insert', 'index': 0, 'elemId': f'4@{actor}',
+             'values': ['bullfinch', 'greenfinch']},
+            {'action': 'multi-insert', 'index': 2, 'elemId': f'2@{actor}',
+             'values': ['chaffinch', 'goldfinch']}]
+
+
+class TestCausalGating:
+    def test_pending_changes(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+                   'ops': [set_op('_root', 'bird', 'magpie')]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 2, 'time': 0,
+                   'deps': [hash_of(change1)],
+                   'ops': [set_op('_root', 'bird', 'jay', pred=[f'1@{actor}'])]}
+        s0 = Backend.init()
+        # Apply change2 before change1: it must be queued
+        s1, patch1 = Backend.apply_changes(s0, [encode_change(change2)])
+        assert patch1['pendingChanges'] == 1
+        assert patch1['diffs'] == {'objectId': '_root', 'type': 'map', 'props': {}}
+        s2, patch2 = Backend.apply_changes(s1, [encode_change(change1)])
+        assert patch2['pendingChanges'] == 0
+        assert patch2['clock'] == {actor: 2}
+        assert patch2['diffs']['props']['bird'] == {
+            f'2@{actor}': {'type': 'value', 'value': 'jay'}}
+        assert Backend.get_missing_deps(s2) == []
+
+    def test_missing_deps_reported(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+                   'ops': [set_op('_root', 'bird', 'magpie')]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 2, 'time': 0,
+                   'deps': [hash_of(change1)],
+                   'ops': [set_op('_root', 'bird', 'jay', pred=[f'1@{actor}'])]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [encode_change(change2)])
+        assert Backend.get_missing_deps(s1) == [hash_of(change1)]
+
+    def test_duplicate_changes_ignored(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+                   'ops': [set_op('_root', 'bird', 'magpie')]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [encode_change(change1)])
+        s2, patch2 = Backend.apply_changes(s1, [encode_change(change1)])
+        assert patch2['clock'] == {actor: 1}
+        assert len(Backend.get_all_changes(s2)) == 1
+
+    def test_seq_gap_throws(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+                   'ops': [set_op('_root', 'bird', 'magpie')]}
+        change3 = {'actor': actor, 'seq': 3, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)],
+                   'ops': [set_op('_root', 'bird', 'jay')]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [encode_change(change1)])
+        with pytest.raises(ValueError, match='Skipped sequence number'):
+            Backend.apply_changes(s1, [encode_change(change3)])
+
+
+class TestFrozenHandles:
+    def test_stale_handle_raises(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+                   'ops': [set_op('_root', 'bird', 'magpie')]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [encode_change(change1)])
+        with pytest.raises(ValueError, match='outdated Automerge document'):
+            Backend.apply_changes(s0, [encode_change(change1)])
