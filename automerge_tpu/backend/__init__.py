@@ -118,3 +118,12 @@ def get_change_by_hash(backend, hash):
 
 def get_missing_deps(backend, heads=()):
     return _backend_state(backend).get_missing_deps(heads)
+
+
+# Sync protocol re-exports (ref backend/index.js:5-7); imported last to avoid
+# a circular import, since sync.py uses the backend API above
+from .sync import (  # noqa: E402
+    generate_sync_message, receive_sync_message, encode_sync_message,
+    decode_sync_message, init_sync_state, encode_sync_state, decode_sync_state,
+    BloomFilter,
+)

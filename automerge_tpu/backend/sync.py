@@ -1,0 +1,342 @@
+"""Peer-to-peer data synchronisation protocol (ref backend/sync.js).
+
+Based on Kleppmann & Howard, "Byzantine Eventual Consistency and the
+Fundamental Limits of Peer-to-Peer Databases" (arXiv:2012.00472): each peer
+remembers the shared heads after the last successful sync, and reconciliation
+exchanges Bloom filters over the changes added since then. Wire format is
+byte-compatible with the reference (message type 0x42, peer state 0x43,
+explicit Bloom parameters).
+
+The batched fleet-scale Bloom build/probe lives in automerge_tpu.fleet.bloom;
+this module is the host-side protocol driver.
+"""
+
+from ..encoding import Encoder, Decoder, hex_string_to_bytes, bytes_to_hex_string
+from ..columnar import decode_change_meta
+from . import get_heads, get_missing_deps, get_change_by_hash, get_changes, \
+    apply_changes
+
+HASH_SIZE = 32
+MESSAGE_TYPE_SYNC = 0x42  # first byte of a sync message
+PEER_STATE_TYPE = 0x43    # first byte of an encoded peer state
+
+# ~1% false positive rate; the parameters are part of the wire format so they
+# can change without breaking protocol compatibility (ref sync.js:29-31)
+BITS_PER_ENTRY = 10
+NUM_PROBES = 7
+
+
+class BloomFilter:
+    """Bloom filter over SHA-256 change hashes, using triple hashing over the
+    first 12 hash bytes (Dillinger & Manolios; ref sync.js:38-125)."""
+
+    def __init__(self, arg):
+        if isinstance(arg, (list, tuple)):
+            self.num_entries = len(arg)
+            self.num_bits_per_entry = BITS_PER_ENTRY
+            self.num_probes = NUM_PROBES
+            self.bits = bytearray(
+                (self.num_entries * self.num_bits_per_entry + 7) // 8)
+            for hash in arg:
+                self.add_hash(hash)
+        elif isinstance(arg, (bytes, bytearray, memoryview)):
+            arg = bytes(arg)
+            if len(arg) == 0:
+                self.num_entries = 0
+                self.num_bits_per_entry = 0
+                self.num_probes = 0
+                self.bits = bytearray()
+            else:
+                decoder = Decoder(arg)
+                self.num_entries = decoder.read_uint32()
+                self.num_bits_per_entry = decoder.read_uint32()
+                self.num_probes = decoder.read_uint32()
+                self.bits = bytearray(decoder.read_raw_bytes(
+                    (self.num_entries * self.num_bits_per_entry + 7) // 8))
+        else:
+            raise TypeError('invalid argument')
+
+    @property
+    def bytes(self):
+        if self.num_entries == 0:
+            return b''
+        encoder = Encoder()
+        encoder.append_uint32(self.num_entries)
+        encoder.append_uint32(self.num_bits_per_entry)
+        encoder.append_uint32(self.num_probes)
+        encoder.append_raw_bytes(self.bits)
+        return encoder.buffer
+
+    def get_probes(self, hash):
+        hash_bytes = hex_string_to_bytes(hash)
+        modulo = 8 * len(self.bits)
+        if len(hash_bytes) != 32:
+            raise ValueError(f'Not a 256-bit hash: {hash}')
+        x = int.from_bytes(hash_bytes[0:4], 'little') % modulo
+        y = int.from_bytes(hash_bytes[4:8], 'little') % modulo
+        z = int.from_bytes(hash_bytes[8:12], 'little') % modulo
+        probes = [x]
+        for _ in range(1, self.num_probes):
+            x = (x + y) % modulo
+            y = (y + z) % modulo
+            probes.append(x)
+        return probes
+
+    def add_hash(self, hash):
+        for probe in self.get_probes(hash):
+            self.bits[probe >> 3] |= 1 << (probe & 7)
+
+    def contains_hash(self, hash):
+        if self.num_entries == 0:
+            return False
+        return all(self.bits[probe >> 3] & (1 << (probe & 7))
+                   for probe in self.get_probes(hash))
+
+
+def _encode_hashes(encoder, hashes):
+    if not isinstance(hashes, (list, tuple)):
+        raise TypeError('hashes must be an array')
+    encoder.append_uint32(len(hashes))
+    for i, hash in enumerate(hashes):
+        if i > 0 and hashes[i - 1] >= hash:
+            raise ValueError('hashes must be sorted')
+        data = hex_string_to_bytes(hash)
+        if len(data) != HASH_SIZE:
+            raise TypeError('heads hashes must be 256 bits')
+        encoder.append_raw_bytes(data)
+
+
+def _decode_hashes(decoder):
+    return [bytes_to_hex_string(decoder.read_raw_bytes(HASH_SIZE))
+            for _ in range(decoder.read_uint32())]
+
+
+def encode_sync_message(message):
+    """(ref sync.js:157-172)"""
+    encoder = Encoder()
+    encoder.append_byte(MESSAGE_TYPE_SYNC)
+    _encode_hashes(encoder, message['heads'])
+    _encode_hashes(encoder, message['need'])
+    encoder.append_uint32(len(message['have']))
+    for have in message['have']:
+        _encode_hashes(encoder, have['lastSync'])
+        encoder.append_prefixed_bytes(have['bloom'])
+    encoder.append_uint32(len(message['changes']))
+    for change in message['changes']:
+        encoder.append_prefixed_bytes(change)
+    return encoder.buffer
+
+
+def decode_sync_message(data):
+    """(ref sync.js:177-201)"""
+    decoder = Decoder(data)
+    message_type = decoder.read_byte()
+    if message_type != MESSAGE_TYPE_SYNC:
+        raise ValueError(f'Unexpected message type: {message_type}')
+    message = {'heads': _decode_hashes(decoder), 'need': _decode_hashes(decoder),
+               'have': [], 'changes': []}
+    for _ in range(decoder.read_uint32()):
+        last_sync = _decode_hashes(decoder)
+        bloom = decoder.read_prefixed_bytes()
+        message['have'].append({'lastSync': last_sync, 'bloom': bloom})
+    for _ in range(decoder.read_uint32()):
+        message['changes'].append(decoder.read_prefixed_bytes())
+    # Trailing bytes are ignored for forward compatibility
+    return message
+
+
+def encode_sync_state(sync_state):
+    """Only sharedHeads persists across restarts (ref sync.js:206-211)."""
+    encoder = Encoder()
+    encoder.append_byte(PEER_STATE_TYPE)
+    _encode_hashes(encoder, sync_state['sharedHeads'])
+    return encoder.buffer
+
+
+def decode_sync_state(data):
+    decoder = Decoder(data)
+    record_type = decoder.read_byte()
+    if record_type != PEER_STATE_TYPE:
+        raise ValueError(f'Unexpected record type: {record_type}')
+    state = init_sync_state()
+    state['sharedHeads'] = _decode_hashes(decoder)
+    return state
+
+
+def make_bloom_filter(backend, last_sync):
+    """Bloom filter over changes applied since `last_sync` (ref sync.js:234-238)."""
+    new_changes = get_changes(backend, last_sync)
+    hashes = [decode_change_meta(c, True)['hash'] for c in new_changes]
+    return {'lastSync': last_sync, 'bloom': BloomFilter(hashes).bytes}
+
+
+def get_changes_to_send(backend, have, need):
+    """Changes since lastSync whose hash misses every peer Bloom filter, plus
+    transitive dependents of Bloom-negative changes, plus explicitly needed
+    hashes (ref sync.js:246-306)."""
+    if not have:
+        return [c for c in (get_change_by_hash(backend, h) for h in need)
+                if c is not None]
+
+    last_sync_hashes = set()
+    bloom_filters = []
+    for h in have:
+        last_sync_hashes.update(h['lastSync'])
+        bloom_filters.append(BloomFilter(h['bloom']))
+
+    changes = [decode_change_meta(c, True)
+               for c in get_changes(backend, sorted(last_sync_hashes))]
+
+    change_hashes = set()
+    dependents = {}
+    hashes_to_send = set()
+    for change in changes:
+        change_hashes.add(change['hash'])
+        for dep in change['deps']:
+            dependents.setdefault(dep, []).append(change['hash'])
+        if all(not bloom.contains_hash(change['hash']) for bloom in bloom_filters):
+            hashes_to_send.add(change['hash'])
+
+    # Include any changes that depend on a Bloom-negative change
+    stack = list(hashes_to_send)
+    while stack:
+        hash = stack.pop()
+        for dep in dependents.get(hash, []):
+            if dep not in hashes_to_send:
+                hashes_to_send.add(dep)
+                stack.append(dep)
+
+    changes_to_send = []
+    for hash in need:
+        hashes_to_send.add(hash)
+        if hash not in change_hashes:
+            change = get_change_by_hash(backend, hash)
+            if change is not None:
+                changes_to_send.append(change)
+
+    for change in changes:
+        if change['hash'] in hashes_to_send:
+            changes_to_send.append(change['change'])
+    return changes_to_send
+
+
+def init_sync_state():
+    return {
+        'sharedHeads': [],
+        'lastSentHeads': [],
+        'theirHeads': None,
+        'theirNeed': None,
+        'theirHave': None,
+        'sentHashes': set(),
+    }
+
+
+def generate_sync_message(backend, sync_state):
+    """Generate the next message to a peer, or None when in sync
+    (ref sync.js:327-393)."""
+    if backend is None:
+        raise ValueError('generateSyncMessage called with no Automerge document')
+    if sync_state is None:
+        raise ValueError('generateSyncMessage requires a syncState, which can be '
+                         'created with initSyncState()')
+
+    shared_heads = sync_state['sharedHeads']
+    last_sent_heads = sync_state['lastSentHeads']
+    their_heads = sync_state['theirHeads']
+    their_need = sync_state['theirNeed']
+    their_have = sync_state['theirHave']
+    sent_hashes = sync_state['sentHashes']
+    our_heads = get_heads(backend)
+
+    our_need = get_missing_deps(backend, their_heads or [])
+
+    # Only attach a Bloom filter when we're not just chasing missing deps
+    # caused by false positives (rationale: sync.js:341-348)
+    our_have = []
+    if their_heads is None or all(h in their_heads for h in our_need):
+        our_have = [make_bloom_filter(backend, shared_heads)]
+
+    # Full-resync reset if the peer's lastSync contains hashes unknown to us
+    # (e.g. peer crashed without persisting; ref sync.js:352-362)
+    if their_have:
+        last_sync = their_have[0]['lastSync']
+        if not all(get_change_by_hash(backend, h) is not None for h in last_sync):
+            reset = {'heads': our_heads, 'need': [],
+                     'have': [{'lastSync': [], 'bloom': b''}], 'changes': []}
+            return [sync_state, encode_sync_message(reset)]
+
+    changes_to_send = get_changes_to_send(backend, their_have, their_need) \
+        if isinstance(their_have, list) and isinstance(their_need, list) else []
+
+    heads_unchanged = isinstance(last_sent_heads, list) and \
+        our_heads == last_sent_heads
+    heads_equal = isinstance(their_heads, list) and our_heads == their_heads
+    if heads_unchanged and heads_equal and not changes_to_send:
+        return [sync_state, None]
+
+    changes_to_send = [c for c in changes_to_send
+                       if decode_change_meta(c, True)['hash'] not in sent_hashes]
+
+    message = {'heads': our_heads, 'have': our_have, 'need': our_need,
+               'changes': changes_to_send}
+    if changes_to_send:
+        sent_hashes = set(sent_hashes)
+        for change in changes_to_send:
+            sent_hashes.add(decode_change_meta(change, True)['hash'])
+
+    new_state = dict(sync_state, lastSentHeads=our_heads, sentHashes=sent_hashes)
+    return [new_state, encode_sync_message(message)]
+
+
+def advance_heads(my_old_heads, my_new_heads, our_old_shared_heads):
+    """Shared-heads algebra after applying received changes (ref sync.js:408-413)."""
+    new_heads = [h for h in my_new_heads if h not in my_old_heads]
+    common_heads = [h for h in our_old_shared_heads if h in my_new_heads]
+    return sorted(set(new_heads + common_heads))
+
+
+def receive_sync_message(backend, old_sync_state, binary_message):
+    """Apply a received sync message; returns [backend, syncState, patch]
+    (ref sync.js:420-473)."""
+    if backend is None:
+        raise ValueError('generateSyncMessage called with no Automerge document')
+    if old_sync_state is None:
+        raise ValueError('generateSyncMessage requires a syncState, which can be '
+                         'created with initSyncState()')
+
+    shared_heads = old_sync_state['sharedHeads']
+    last_sent_heads = old_sync_state['lastSentHeads']
+    sent_hashes = old_sync_state['sentHashes']
+    patch = None
+    message = decode_sync_message(binary_message)
+    before_heads = get_heads(backend)
+
+    # Apply received changes; Bloom false positives may leave missing deps, in
+    # which case the backend queues them (repaired later via `need`)
+    if message['changes']:
+        backend, patch = apply_changes(backend, message['changes'])
+        shared_heads = advance_heads(before_heads, get_heads(backend), shared_heads)
+
+    if not message['changes'] and message['heads'] == before_heads:
+        last_sent_heads = message['heads']
+
+    known_heads = [h for h in message['heads']
+                   if get_change_by_hash(backend, h) is not None]
+    if len(known_heads) == len(message['heads']):
+        shared_heads = message['heads']
+        # Remote peer lost all its data: reset for a full resync
+        if len(message['heads']) == 0:
+            last_sent_heads = []
+            sent_hashes = set()
+    else:
+        shared_heads = sorted(set(known_heads) | set(shared_heads))
+
+    sync_state = {
+        'sharedHeads': shared_heads,
+        'lastSentHeads': last_sent_heads,
+        'theirHave': message['have'],
+        'theirHeads': message['heads'],
+        'theirNeed': message['need'],
+        'sentHashes': sent_hashes,
+    }
+    return [backend, sync_state, patch]
