@@ -163,10 +163,29 @@ def decode_sync_state(data):
     return state
 
 
+# The reference re-decodes and re-hashes every change for each of the
+# Bloom-filter build, the changes-to-send scan, and the sentHashes filter
+# (its own TODO at sync.js:378). Change buffers are immutable, so a bounded
+# memo of their metadata removes the O(rounds x changes) redundant SHA-256s.
+_META_CACHE_MAX = 1 << 16
+_meta_cache = {}
+
+
+def _cached_meta(change):
+    change = bytes(change)
+    meta = _meta_cache.get(change)
+    if meta is None:
+        meta = decode_change_meta(change, True)
+        if len(_meta_cache) >= _META_CACHE_MAX:
+            _meta_cache.clear()
+        _meta_cache[change] = meta
+    return meta
+
+
 def make_bloom_filter(backend, last_sync):
     """Bloom filter over changes applied since `last_sync` (ref sync.js:234-238)."""
     new_changes = get_changes(backend, last_sync)
-    hashes = [decode_change_meta(c, True)['hash'] for c in new_changes]
+    hashes = [_cached_meta(c)['hash'] for c in new_changes]
     return {'lastSync': last_sync, 'bloom': BloomFilter(hashes).bytes}
 
 
@@ -184,7 +203,7 @@ def get_changes_to_send(backend, have, need):
         last_sync_hashes.update(h['lastSync'])
         bloom_filters.append(BloomFilter(h['bloom']))
 
-    changes = [decode_change_meta(c, True)
+    changes = [_cached_meta(c)
                for c in get_changes(backend, sorted(last_sync_hashes))]
 
     change_hashes = set()
@@ -275,14 +294,14 @@ def generate_sync_message(backend, sync_state):
         return [sync_state, None]
 
     changes_to_send = [c for c in changes_to_send
-                       if decode_change_meta(c, True)['hash'] not in sent_hashes]
+                       if _cached_meta(c)['hash'] not in sent_hashes]
 
     message = {'heads': our_heads, 'have': our_have, 'need': our_need,
                'changes': changes_to_send}
     if changes_to_send:
         sent_hashes = set(sent_hashes)
         for change in changes_to_send:
-            sent_hashes.add(decode_change_meta(change, True)['hash'])
+            sent_hashes.add(_cached_meta(change)['hash'])
 
     new_state = dict(sync_state, lastSentHeads=our_heads, sentHashes=sent_hashes)
     return [new_state, encode_sync_message(message)]

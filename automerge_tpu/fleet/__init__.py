@@ -1,0 +1,24 @@
+"""The TPU-native fleet engine: batched CRDT computation over document fleets.
+
+This is the performance core of automerge_tpu (BASELINE.json north star): a
+fleet of thousands of documents lives as padded device tensors, the
+change-application loop (Lamport-clock LWW resolution, counter accumulation)
+runs as one XLA dispatch over the whole fleet, and sync Bloom-filter
+construction/probing is batched bit-tensor math. The host OpSet engine
+(automerge_tpu.backend.op_set) is the correctness oracle; kernels here are
+differentially tested against it.
+
+Scaling: fleet state shards across a `jax.sharding.Mesh` (data-parallel over
+the docs axis, optionally a second axis over the key grid), with XLA inserting
+the collectives — see automerge_tpu.fleet.sharding.
+"""
+
+from .tensor_doc import FleetState, OpBatch, TOMBSTONE, pack_op_id, unpack_op_id
+from .apply import apply_op_batch, fleet_merge
+from .bloom import build_bloom_filters, probe_bloom_filters, bloom_filter_bytes
+
+__all__ = [
+    'FleetState', 'OpBatch', 'TOMBSTONE', 'pack_op_id', 'unpack_op_id',
+    'apply_op_batch', 'fleet_merge',
+    'build_bloom_filters', 'probe_bloom_filters', 'bloom_filter_bytes',
+]

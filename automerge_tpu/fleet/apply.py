@@ -1,0 +1,64 @@
+"""Batched change application: the whole fleet's merge in one XLA dispatch.
+
+This is the tensorized equivalent of the reference's per-document op-merge
+loop (ref backend/new.js:1052-1290 mergeDocChangeOps + seekToOp): instead of
+a streaming two-pointer merge per document, all documents' ops land as padded
+[N, P] columns and per-key LWW resolution becomes a scatter-max of packed
+opIds over the [N, K] key grid. Counter accumulation is a scatter-add.
+
+Everything is static-shape, fusion-friendly gather/scatter on the VPU; no
+data-dependent Python control flow, so the whole step is one `jit` region
+that XLA pipelines across the fleet.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .tensor_doc import FleetState
+
+
+@jax.jit
+def apply_op_batch(state, ops):
+    """Apply one OpBatch to the fleet. Returns (new_state, stats).
+
+    `stats` is a per-fleet vector of ops applied (useful as a psum'd health
+    metric when the fleet is sharded across hosts).
+    """
+    n_docs, n_slots = state.winners.shape
+    doc_idx = jnp.arange(n_docs, dtype=jnp.int32)[:, None]
+    doc_idx = jnp.broadcast_to(doc_idx, ops.key_id.shape)
+
+    # Padded/invalid lanes scatter into the scratch column (n_slots - 1)
+    scratch = n_slots - 1
+    set_mask = ops.is_set & ops.valid
+    inc_mask = ops.is_inc & ops.valid
+    set_key = jnp.where(set_mask, ops.key_id, scratch)
+    inc_key = jnp.where(inc_mask, ops.key_id, scratch)
+
+    # LWW winner: scatter-max of packed opIds (unique per fleet, so ties are
+    # impossible; overwritten ops always lose to their successors)
+    winners = state.winners.at[doc_idx, set_key].max(
+        jnp.where(set_mask, ops.packed, 0))
+
+    # Find which op (if any) became the winner of its key, and scatter its
+    # value. Packed opIds are unique per fleet, so at most one op per
+    # (doc, key) matches; losing lanes write garbage into the scratch column.
+    won = set_mask & (ops.packed == winners[doc_idx, ops.key_id])
+    win_key = jnp.where(won, ops.key_id, scratch)
+    values = state.values.at[doc_idx, win_key].set(jnp.where(won, ops.value, 0))
+
+    # Counters accumulate (inc ops are successors that add, not overwrite)
+    counters = state.counters.at[doc_idx, inc_key].add(
+        jnp.where(inc_mask, ops.value, 0))
+
+    stats = jnp.sum(ops.valid, dtype=jnp.int32)
+    return FleetState(winners, values, counters), stats
+
+
+def fleet_merge(state, op_batches):
+    """Apply a sequence of OpBatches (e.g. one per change round)."""
+    total = 0
+    for ops in op_batches:
+        state, stats = apply_op_batch(state, ops)
+        total += int(stats)
+    return state, total

@@ -1,0 +1,103 @@
+"""Batched Bloom-filter construction and probing for fleet-scale sync.
+
+The sync protocol's per-peer Bloom filter (ref backend/sync.js:38-125:
+10 bits/entry, 7 probes, triple hashing over the first 12 bytes of each
+change hash) becomes bit-tensor math over the whole fleet: hashes arrive as
+[N, H, 3] uint32 words, probe indexes are computed with vectorized triple
+hashing, and filters live as an [N, B] bool tensor built with one scatter.
+Probing is a gather + reduce. Serialization (`bloom_filter_bytes`) is
+bit-exact with the reference's wire format.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..encoding import Encoder
+
+BITS_PER_ENTRY = 10
+NUM_PROBES = 7
+
+
+def hashes_to_words(hashes_hex):
+    """Convert a list of hash lists (hex strings) into an [N, H, 3] uint32
+    array of the first three little-endian words of each hash, padded with
+    an all-ones sentinel row mask. Returns (words, valid_mask)."""
+    n = len(hashes_hex)
+    h = max((len(row) for row in hashes_hex), default=0)
+    words = np.zeros((n, max(h, 1), 3), dtype=np.uint32)
+    valid = np.zeros((n, max(h, 1)), dtype=bool)
+    for i, row in enumerate(hashes_hex):
+        for j, hash in enumerate(row):
+            raw = bytes.fromhex(hash)[:12]
+            words[i, j] = np.frombuffer(raw, dtype='<u4')
+            valid[i, j] = True
+    return words, valid
+
+
+def _probe_indexes(words, num_bits):
+    """Triple hashing (Dillinger & Manolios): probe p = (x + p*y + C(p)*z)
+    mod m, computed iteratively as in the reference (ref sync.js:88-102)."""
+    modulo = jnp.asarray(num_bits, dtype=jnp.uint32)
+    x = words[..., 0] % modulo
+    y = words[..., 1] % modulo
+    z = words[..., 2] % modulo
+    probes = [x]
+    for _ in range(1, NUM_PROBES):
+        x = (x + y) % modulo
+        y = (y + z) % modulo
+        probes.append(x)
+    return jnp.stack(probes, axis=-1).astype(jnp.int32)  # [N, H, NUM_PROBES]
+
+
+def num_filter_bits(num_entries):
+    """Bit capacity of a filter with the reference's sizing rule."""
+    return 8 * ((num_entries * BITS_PER_ENTRY + 7) // 8)
+
+
+@jax.jit
+def _build(words, valid, bits_init):
+    n_docs, n_bits = bits_init.shape
+    probes = _probe_indexes(words, n_bits)  # [N, H, P]
+    doc_idx = jnp.broadcast_to(
+        jnp.arange(n_docs, dtype=jnp.int32)[:, None, None], probes.shape)
+    # Invalid hash lanes scatter out of range and are dropped
+    probes = jnp.where(valid[..., None], probes, n_bits)
+    return bits_init.at[doc_idx, probes].set(True, mode='drop')
+
+
+def build_bloom_filters(words, valid, num_entries):
+    """Build [N, B] bool filters for N peers, each over `num_entries` hashes
+    ([N, H] padded with `valid` mask). All peers share the same B (sized for
+    the max entry count) so the fleet batches into one tensor."""
+    n_docs = words.shape[0]
+    n_bits = max(num_filter_bits(num_entries), 8)
+    bits = jnp.zeros((n_docs, n_bits), dtype=bool)
+    return _build(jnp.asarray(words), jnp.asarray(valid), bits)
+
+
+@jax.jit
+def probe_bloom_filters(bits, words, valid):
+    """Probe [N, H] hashes against [N, B] filters; returns [N, H] bool
+    (True = possibly contained)."""
+    n_docs, n_bits = bits.shape
+    probes = _probe_indexes(jnp.asarray(words), n_bits)
+    doc_idx = jnp.broadcast_to(
+        jnp.arange(n_docs, dtype=jnp.int32)[:, None, None], probes.shape)
+    hit = bits[doc_idx, probes]  # [N, H, P]
+    return jnp.all(hit, axis=-1) & jnp.asarray(valid)
+
+
+def bloom_filter_bytes(bits_row, num_entries):
+    """Serialize one filter row ([B] bool) to the reference wire format
+    (ref sync.js:67-76): explicit parameters + little-bit-order packed bits."""
+    if num_entries == 0:
+        return b''
+    encoder = Encoder()
+    encoder.append_uint32(num_entries)
+    encoder.append_uint32(BITS_PER_ENTRY)
+    encoder.append_uint32(NUM_PROBES)
+    n_bytes = (num_entries * BITS_PER_ENTRY + 7) // 8
+    packed = np.packbits(np.asarray(bits_row), bitorder='little')[:n_bytes]
+    encoder.append_raw_bytes(packed.tobytes())
+    return encoder.buffer

@@ -1,0 +1,69 @@
+"""Fleet sharding across a device mesh.
+
+The parallelism story for a CRDT fleet (SURVEY.md §2.12): documents are
+independent, so the fleet batch axis shards data-parallel across chips; the
+per-document key grid can shard across a second mesh axis when the key
+universe is large. XLA inserts the collectives (scatter updates crossing the
+key axis become all-to-alls; fleet-wide stats are psums riding ICI).
+
+No NCCL/MPI translation — this is `jax.sharding.Mesh` + NamedSharding over
+the fleet pytree, the idiomatic JAX equivalent of the reference's
+transport-agnostic peer protocol scaled to a sharded fleet service.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .tensor_doc import FleetState
+from .apply import apply_op_batch
+
+
+def fleet_mesh(devices=None, keys_axis=1):
+    """Build a (docs, keys) mesh over the available devices."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if keys_axis > 1 and n % keys_axis == 0:
+        shape = (n // keys_axis, keys_axis)
+    else:
+        shape = (n, 1)
+    import numpy as np
+    return Mesh(np.array(devices).reshape(shape), ('docs', 'keys'))
+
+
+def fleet_sharding(mesh):
+    """NamedShardings for FleetState ([docs, keys] grid) and OpBatch
+    ([docs, ops] columns, replicated over the keys axis)."""
+    state_spec = NamedSharding(mesh, P('docs', 'keys'))
+    ops_spec = NamedSharding(mesh, P('docs', None))
+    return state_spec, ops_spec
+
+
+def shard_fleet(state, mesh):
+    state_spec, _ = fleet_sharding(mesh)
+    return FleetState(*(jax.device_put(x, state_spec)
+                        for x in (state.winners, state.values, state.counters)))
+
+
+def shard_ops(ops, mesh):
+    _, ops_spec = fleet_sharding(mesh)
+    import jax.tree_util as tree
+    return tree.tree_map(lambda x: jax.device_put(x, ops_spec),
+                         ops)
+
+
+def sharded_apply(mesh):
+    """A jitted fleet step with explicit output shardings: data-parallel over
+    docs, key grid sharded over the second mesh axis. The scatter by key_id
+    crossing key shards compiles to XLA collectives; the stats reduction is a
+    global psum over the mesh."""
+    state_spec, _ = fleet_sharding(mesh)
+
+    @jax.jit
+    def step(state, ops):
+        new_state, stats = apply_op_batch(state, ops)
+        new_state = FleetState(
+            *(jax.lax.with_sharding_constraint(x, state_spec)
+              for x in (new_state.winners, new_state.values, new_state.counters)))
+        return new_state, stats
+    return step

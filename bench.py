@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Fleet benchmark: batched change application over a document fleet.
+
+Measures the BASELINE.json primary metric — changes/sec on a 10k-document
+concurrent-merge batch (config 1 shape: 2-actor concurrent map key sets) —
+for the TPU fleet engine, against the host reference engine (the pure-Python
+OpSet backend) measured on the same workload shape.
+
+Note: the reference JS backend cannot run in this image (no Node.js), so the
+recorded baseline is our host reference engine; see BASELINE.md.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def build_workload(n_docs, n_keys, n_actors, rounds, ops_per_round, seed=0):
+    """Concurrent map-set workload as per-round op columns [N, P]."""
+    from automerge_tpu.fleet import OpBatch
+    from automerge_tpu.fleet.tensor_doc import ACTOR_BITS
+    rng = np.random.default_rng(seed)
+    batches = []
+    ctr = 1
+    for _ in range(rounds):
+        shape = (n_docs, ops_per_round)
+        key_id = rng.integers(0, n_keys, shape, dtype=np.int32)
+        actor = rng.integers(0, n_actors, shape, dtype=np.int32)
+        ctrs = ctr + np.broadcast_to(
+            np.arange(ops_per_round, dtype=np.int32), shape)
+        packed = (ctrs.astype(np.int32) << ACTOR_BITS) | actor
+        value = rng.integers(1, 1 << 20, shape, dtype=np.int32)
+        ones = np.ones(shape, dtype=bool)
+        batches.append(OpBatch(key_id, packed, value, ones,
+                               np.zeros(shape, dtype=bool), ones))
+        ctr += ops_per_round
+    return batches
+
+
+def bench_fleet(n_docs, n_keys, rounds, ops_per_round):
+    import jax
+    from automerge_tpu.fleet import FleetState, apply_op_batch
+
+    batches = build_workload(n_docs, n_keys, 2, rounds, ops_per_round)
+    state = FleetState.empty(n_docs, n_keys)
+    device_batches = [jax.device_put(b) for b in batches]
+    state = jax.tree_util.tree_map(jax.device_put, state)
+
+    # Warmup / compile
+    warm, _ = apply_op_batch(state, device_batches[0])
+    jax.block_until_ready(warm.winners)
+
+    start = time.perf_counter()
+    s = state
+    for b in device_batches:
+        s, stats = apply_op_batch(s, b)
+    jax.block_until_ready(s.winners)
+    elapsed = time.perf_counter() - start
+    total_ops = n_docs * ops_per_round * rounds
+    return total_ops / elapsed, elapsed
+
+
+def bench_host(n_docs, n_keys, rounds, ops_per_round, seed=0):
+    """Same workload shape through the host OpSet engine (single-op changes,
+    matching the backend_test.js concurrent-key-set shape)."""
+    from automerge_tpu import backend as Backend
+    from automerge_tpu.columnar import encode_change
+    rng = np.random.default_rng(seed)
+    actors = ['aa' * 4, 'bb' * 4]
+
+    # Pre-encode all changes (decode cost is part of applyChanges either way;
+    # encode cost is the remote peer's problem)
+    docs = []
+    for d in range(n_docs):
+        changes = []
+        seqs = {0: 0, 1: 0}
+        ctr = 1
+        for _ in range(rounds):
+            for i in range(ops_per_round):
+                a = int(rng.integers(0, 2))
+                seqs[a] += 1
+                changes.append(encode_change({
+                    'actor': actors[a], 'seq': seqs[a], 'startOp': ctr,
+                    'time': 0, 'message': '', 'deps': [],
+                    'ops': [{'action': 'set', 'obj': '_root',
+                             'key': f'k{int(rng.integers(0, n_keys))}',
+                             'value': int(rng.integers(1, 1 << 20)),
+                             'datatype': 'int', 'pred': []}],
+                }))
+                ctr += 1
+        docs.append(changes)
+
+    start = time.perf_counter()
+    for changes in docs:
+        backend = Backend.init()
+        state = backend['state']
+        # seq contiguity: interleave per actor in recorded order
+        state.apply_changes(changes)
+    elapsed = time.perf_counter() - start
+    total_ops = n_docs * rounds * ops_per_round
+    return total_ops / elapsed, elapsed
+
+
+def main():
+    n_docs = int(os.environ.get('BENCH_DOCS', 10000))
+    n_keys = int(os.environ.get('BENCH_KEYS', 1000))
+    rounds = int(os.environ.get('BENCH_ROUNDS', 10))
+    ops_per_round = int(os.environ.get('BENCH_OPS', 100))
+
+    fleet_rate, fleet_time = bench_fleet(n_docs, n_keys, rounds, ops_per_round)
+
+    # Host baseline on a smaller doc count (rate-based metric)
+    host_docs = int(os.environ.get('BENCH_HOST_DOCS', 20))
+    host_rate, host_time = bench_host(host_docs, n_keys, rounds,
+                                      min(ops_per_round, 20))
+
+    result = {
+        'metric': 'changes_per_sec_10k_doc_merge',
+        'value': round(fleet_rate),
+        'unit': 'changes/s',
+        'vs_baseline': round(fleet_rate / host_rate, 2),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == '__main__':
+    main()

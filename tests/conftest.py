@@ -8,9 +8,18 @@ benchmarks run on real TPU outside of pytest.
 import os
 import sys
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# Force CPU even when the environment points JAX at a TPU tunnel: unit tests
+# must run on the virtual 8-device mesh, not the single real chip. The site
+# hook imports jax at interpreter startup, so setting the env var is not
+# enough — update the already-imported config too.
+os.environ['JAX_PLATFORMS'] = 'cpu'
 flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (flags + ' --xla_force_host_platform_device_count=8').strip()
+try:
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+except ImportError:
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
