@@ -90,14 +90,25 @@ def probe_bloom_filters(bits, words, valid):
 
 def bloom_filter_bytes(bits_row, num_entries):
     """Serialize one filter row ([B] bool) to the reference wire format
-    (ref sync.js:67-76): explicit parameters + little-bit-order packed bits."""
+    (ref sync.js:67-76): explicit parameters + little-bit-order packed bits.
+
+    The row must have been built with a filter sized for exactly
+    `num_entries` (probe indexes are modulo the bit capacity, so truncating
+    a larger filter would corrupt it into false negatives). Batch peers of
+    differing entry counts into separate build_bloom_filters calls."""
     if num_entries == 0:
         return b''
+    bits_row = np.asarray(bits_row)
+    if bits_row.shape[-1] != num_filter_bits(num_entries):
+        raise ValueError(
+            f'filter row has {bits_row.shape[-1]} bits but num_entries='
+            f'{num_entries} requires {num_filter_bits(num_entries)}; '
+            f'serialize only rows built with matching sizing')
     encoder = Encoder()
     encoder.append_uint32(num_entries)
     encoder.append_uint32(BITS_PER_ENTRY)
     encoder.append_uint32(NUM_PROBES)
     n_bytes = (num_entries * BITS_PER_ENTRY + 7) // 8
-    packed = np.packbits(np.asarray(bits_row), bitorder='little')[:n_bytes]
+    packed = np.packbits(bits_row, bitorder='little')[:n_bytes]
     encoder.append_raw_bytes(packed.tobytes())
     return encoder.buffer

@@ -1,0 +1,259 @@
+"""Wire ingest: binary changes decoded straight into fleet op tensors.
+
+The pipeline stage between the network/disk and the device (the north star's
+"decode straight into padded device tensors"): change chunks are parsed with
+the native C++ codecs (automerge_tpu.native) — container split + checksum,
+DEFLATE, LEB128/RLE/delta column decode — and land as OpBatch columns with
+host-side dictionary encoding of keys and actors. String columns (keyStr)
+currently decode via the Python RLE codec; numeric columns are native.
+
+Supports the fleet-kernel op subset (root-map set/inc/del); anything else
+routes to the host OpSet engine.
+"""
+
+import numpy as np
+
+from .. import native
+from ..encoding import (
+    Decoder, RLEDecoder, DeltaDecoder, BooleanDecoder,
+)
+from ..columnar import (
+    decode_container_header, decode_column_info, decode_value, inflate_change,
+    COLUMN_TYPE, CHUNK_TYPE_CHANGE, CHUNK_TYPE_DEFLATE, ACTIONS,
+)
+from .tensor_doc import OpBatch, TOMBSTONE, pack_op_id
+
+_SET = ACTIONS.index('set')
+_INC = ACTIONS.index('inc')
+_DEL = ACTIONS.index('del')
+
+_COL_KEYSTR = 1 << 4 | COLUMN_TYPE['STRING_RLE']
+_COL_ACTION = 4 << 4 | COLUMN_TYPE['INT_RLE']
+_COL_VALLEN = 5 << 4 | COLUMN_TYPE['VALUE_LEN']
+_COL_VALRAW = 5 << 4 | COLUMN_TYPE['VALUE_RAW']
+_COL_OBJCTR = 0 << 4 | COLUMN_TYPE['INT_RLE']
+
+
+def _inflate_chunk(buffer):
+    if buffer[8] != CHUNK_TYPE_DEFLATE:
+        return buffer
+    return inflate_change(buffer)
+
+
+def _decode_numeric_column(ctype, buf):
+    """Decode a numeric column: native when available, Python codecs otherwise."""
+    if native.available():
+        if ctype == COLUMN_TYPE['INT_DELTA']:
+            return native.decode_delta_column(buf)
+        if ctype == COLUMN_TYPE['BOOLEAN']:
+            return native.decode_boolean_column(buf)
+        return native.decode_rle_column(buf, signed=False)
+    if ctype == COLUMN_TYPE['INT_DELTA']:
+        decoder = DeltaDecoder(buf)
+    elif ctype == COLUMN_TYPE['BOOLEAN']:
+        decoder = BooleanDecoder(buf)
+    else:
+        decoder = RLEDecoder('uint', buf)
+    values, valid = [], []
+    while not decoder.done:
+        v = decoder.read_value()
+        values.append(0 if v is None else int(v))
+        valid.append(v is not None)
+    return np.array(values, dtype=np.int64), np.array(valid, dtype=bool)
+
+
+def decode_change_ops_columns(buffer):
+    """Parse one binary change into (header_meta, numeric column arrays).
+
+    Returns (actor, start_op, columns) where columns maps columnId to
+    (values int64[], valid bool[]) for numeric columns and to a Python list
+    for the keyStr column."""
+    buffer = _inflate_chunk(bytes(buffer))
+    header = decode_container_header(Decoder(buffer), False)
+    chunk = Decoder(header['chunkData'])
+    # change header (ref columnar.js:635-652)
+    num_deps = chunk.read_uint53()
+    chunk.skip(32 * num_deps)
+    actor = chunk.read_hex_string()
+    chunk.read_uint53()  # seq
+    start_op = chunk.read_uint53()
+    chunk.read_int53()   # time
+    chunk.read_prefixed_string()  # message
+    for _ in range(chunk.read_uint53()):
+        chunk.read_hex_string()
+    infos = decode_column_info(chunk)
+    columns = {}
+    for info in infos:
+        buf = chunk.read_raw_bytes(info['bufferLen'])
+        cid = info['columnId']
+        ctype = cid & 7
+        if cid == _COL_VALRAW:
+            columns[cid] = buf
+        elif cid == _COL_KEYSTR:
+            decoder = RLEDecoder('utf8', buf)
+            values = []
+            while not decoder.done:
+                values.append(decoder.read_value())
+            columns[cid] = values
+        elif ctype in (COLUMN_TYPE['INT_DELTA'], COLUMN_TYPE['BOOLEAN'],
+                       COLUMN_TYPE['INT_RLE'], COLUMN_TYPE['ACTOR_ID'],
+                       COLUMN_TYPE['VALUE_LEN'], COLUMN_TYPE['GROUP_CARD']):
+            columns[cid] = _decode_numeric_column(ctype, buf)
+        else:
+            columns[cid] = buf
+    return actor, start_op, columns
+
+
+class KeyInterner:
+    """Host-side dictionary encoding of map keys for the fleet key grid."""
+
+    def __init__(self):
+        self.index = {}
+        self.keys = []
+
+    def intern(self, key):
+        idx = self.index.get(key)
+        if idx is None:
+            idx = len(self.keys)
+            self.index[key] = idx
+            self.keys.append(key)
+        return idx
+
+    def __len__(self):
+        return len(self.keys)
+
+
+def changes_to_op_batch_native(per_doc_changes, key_interner, actor_interner):
+    """Fast path: the whole parse + dictionary-encode runs in C++
+    (native.ingest_changes), and the flat op rows scatter into OpBatch
+    tensors with vectorized numpy. Returns None if any change falls outside
+    the fleet subset (caller falls back to the host engine)."""
+    buffers, doc_ids = [], []
+    for d, changes in enumerate(per_doc_changes):
+        for change in changes:
+            buffers.append(change)
+            doc_ids.append(d)
+    if not buffers:
+        return OpBatch(*(np.zeros((len(per_doc_changes), 1), dtype=dt)
+                         for dt in (np.int32, np.int32, np.int32, bool, bool,
+                                    bool)))
+    out = native.ingest_changes(buffers, doc_ids)
+    if out is None:
+        return None
+    rows, keys, actors = out
+    # Merge the C++ interning into the fleet-level interners
+    key_map = np.array([key_interner.intern(k) for k in keys], dtype=np.int32)
+    actor_map = np.array([actor_interner.intern(a) for a in actors],
+                         dtype=np.int32)
+    n_docs = len(per_doc_changes)
+    doc = rows['doc']
+    key = key_map[rows['key']] if len(keys) else rows['key']
+    ctr = rows['packed'] >> 8
+    actor = actor_map[rows['packed'] & 0xff] if len(actors) else 0
+    packed = (ctr << 8) | actor
+    # Lay out rows into [N, P] with per-doc positions
+    order = np.argsort(doc, kind='stable')
+    doc_sorted = doc[order]
+    pos = np.arange(len(doc_sorted)) - \
+        np.searchsorted(doc_sorted, doc_sorted, side='left')
+    counts = np.bincount(doc, minlength=n_docs)
+    max_ops = max(int(counts.max()) if counts.size else 0, 1)
+    shape = (n_docs, max_ops)
+    key_id = np.zeros(shape, dtype=np.int32)
+    packed_arr = np.zeros(shape, dtype=np.int32)
+    value = np.zeros(shape, dtype=np.int32)
+    is_set = np.zeros(shape, dtype=bool)
+    is_inc = np.zeros(shape, dtype=bool)
+    valid = np.zeros(shape, dtype=bool)
+    key_id[doc_sorted, pos] = key[order]
+    packed_arr[doc_sorted, pos] = packed[order]
+    value[doc_sorted, pos] = rows['value'][order]
+    flags = rows['flags'][order]
+    is_set[doc_sorted, pos] = flags == 1
+    is_inc[doc_sorted, pos] = flags == 2
+    valid[doc_sorted, pos] = True
+    return OpBatch(key_id, packed_arr, value, is_set, is_inc, valid)
+
+
+def changes_to_op_batch(per_doc_changes, key_interner, actor_interner,
+                        value_table=None):
+    """Convert per-document lists of binary changes into one OpBatch.
+
+    Tries the native C++ batched parser first; falls back to the per-change
+    Python decode. Only root-map set/inc/del ops are supported (the fleet
+    kernel's op subset); raises ValueError otherwise. Values are interned
+    into `value_table` (a list) and referenced by index; int values are
+    stored inline when they fit."""
+    if value_table is None and native.available():
+        batch = changes_to_op_batch_native(per_doc_changes, key_interner,
+                                           actor_interner)
+        if batch is not None:
+            return batch
+    n_docs = len(per_doc_changes)
+    rows = []  # (doc, key_id, packed, value, is_set, is_inc)
+    for d, changes in enumerate(per_doc_changes):
+        for change in changes:
+            actor, start_op, columns = decode_change_ops_columns(change)
+            actor_num = actor_interner.intern(actor)
+            actions, actions_ok = columns.get(_COL_ACTION, (np.zeros(0), None))
+            key_strs = columns.get(_COL_KEYSTR, [])
+            obj_ctr = columns.get(_COL_OBJCTR)
+            val_len, _vl_ok = columns.get(_COL_VALLEN, (None, None))
+            val_raw = columns.get(_COL_VALRAW, b'')
+            raw_pos = 0
+            for i, action in enumerate(np.asarray(actions)):
+                if obj_ctr is not None and i < len(obj_ctr[1]) and obj_ctr[1][i]:
+                    raise ValueError('fleet ingest supports root-map ops only')
+                key = key_strs[i] if i < len(key_strs) else None
+                if key is None:
+                    raise ValueError('fleet ingest supports map (string-key) ops only')
+                tag = int(val_len[i]) if val_len is not None and i < len(val_len) \
+                    else 0
+                size = tag >> 4
+                raw = val_raw[raw_pos:raw_pos + size]
+                raw_pos += size
+                if action == _SET or action == _INC:
+                    decoded = decode_value(tag, raw)
+                    value = decoded['value']
+                elif action == _DEL:
+                    value = None
+                else:
+                    raise ValueError(f'unsupported action {action} for fleet ingest')
+                if action == _DEL:
+                    val_idx = TOMBSTONE
+                elif isinstance(value, int) and not isinstance(value, bool) and \
+                        0 <= value < (1 << 31) and value_table is None:
+                    val_idx = value
+                elif value_table is not None:
+                    val_idx = len(value_table)
+                    value_table.append(value)
+                else:
+                    raise ValueError('non-int value requires a value_table')
+                rows.append((d, key_interner.intern(key),
+                             pack_op_id(start_op + i, actor_num), val_idx,
+                             action != _INC, action == _INC))
+    doc_counts = np.bincount([r[0] for r in rows], minlength=n_docs) \
+        if rows else np.zeros(n_docs, dtype=np.int64)
+    max_ops = int(doc_counts.max()) if rows else 0
+    per_doc_counts = np.zeros(n_docs, dtype=np.int64)
+    shape = (n_docs, max(max_ops, 1))
+    key_id = np.zeros(shape, dtype=np.int32)
+    packed = np.zeros(shape, dtype=np.int32)
+    value = np.zeros(shape, dtype=np.int32)
+    is_set = np.zeros(shape, dtype=bool)
+    is_inc = np.zeros(shape, dtype=bool)
+    valid = np.zeros(shape, dtype=bool)
+    for (d, k, p, v, s, inc) in rows:
+        j = per_doc_counts[d]
+        per_doc_counts[d] += 1
+        key_id[d, j] = k
+        packed[d, j] = p
+        value[d, j] = v
+        is_set[d, j] = s
+        is_inc[d, j] = inc
+        valid[d, j] = True
+    return OpBatch(key_id, packed, value, is_set, is_inc, valid)
+
+
+class ActorInterner(KeyInterner):
+    pass

@@ -1,0 +1,272 @@
+"""ctypes bindings for the native codec kernels (codec.cpp).
+
+Compiled on demand with g++ (cached next to the source); all entry points
+have pure-Python fallbacks so the library works without a toolchain, but the
+native path is the production one (SURVEY.md section 2.9 native accounting):
+SHA-256 (single + batched across documents), raw DEFLATE, and the
+LEB128/RLE/delta/boolean column decoders emitting int64 arrays + null masks.
+"""
+
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'codec.cpp')
+_LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         f'_codec_{sys.implementation.cache_tag}.so')
+
+_lib = None
+_load_error = None
+
+
+def _build():
+    cmd = ['g++', '-O3', '-shared', '-fPIC', '-std=c++17', _SRC, '-lz',
+           '-o', _LIB_PATH]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def _load():
+    global _lib, _load_error
+    if _lib is not None or _load_error is not None:
+        return _lib
+    try:
+        if not os.path.exists(_LIB_PATH) or \
+                os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC):
+            _build()
+        lib = ctypes.CDLL(_LIB_PATH)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.am_sha256.argtypes = [u8p, ctypes.c_uint64, u8p]
+        lib.am_sha256_batch.argtypes = [u8p, u64p, u64p, ctypes.c_uint64, u8p]
+        lib.am_deflate_raw.argtypes = [u8p, ctypes.c_uint64, u8p, ctypes.c_uint64]
+        lib.am_deflate_raw.restype = ctypes.c_int64
+        lib.am_inflate_raw.argtypes = [u8p, ctypes.c_uint64, u8p, ctypes.c_uint64]
+        lib.am_inflate_raw.restype = ctypes.c_int64
+        lib.am_decode_rle.argtypes = [u8p, ctypes.c_uint64, ctypes.c_int,
+                                      i64p, u8p, ctypes.c_int64]
+        lib.am_decode_rle.restype = ctypes.c_int64
+        lib.am_decode_delta.argtypes = [u8p, ctypes.c_uint64, i64p, u8p,
+                                        ctypes.c_int64]
+        lib.am_decode_delta.restype = ctypes.c_int64
+        lib.am_decode_boolean.argtypes = [u8p, ctypes.c_uint64, i64p, u8p,
+                                          ctypes.c_int64]
+        lib.am_decode_boolean.restype = ctypes.c_int64
+        lib.am_count_rle.argtypes = [u8p, ctypes.c_uint64, ctypes.c_int]
+        lib.am_count_rle.restype = ctypes.c_int64
+        _lib = lib
+    except Exception as exc:  # toolchain missing or compile failure
+        _load_error = exc
+        _lib = None
+    return _lib
+
+
+def available():
+    return _load() is not None
+
+
+def _u8(buf):
+    arr = np.frombuffer(bytes(buf), dtype=np.uint8)
+    if arr.size == 0:
+        arr = np.zeros(1, dtype=np.uint8)
+    return arr, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def sha256(data):
+    """SHA-256 digest (native; falls back to hashlib)."""
+    lib = _load()
+    if lib is None:
+        import hashlib
+        return hashlib.sha256(bytes(data)).digest()
+    arr, ptr = _u8(data)
+    out = np.zeros(32, dtype=np.uint8)
+    lib.am_sha256(ptr, len(bytes(data)),
+                  out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out.tobytes()
+
+
+def sha256_batch(buffers):
+    """Hash many buffers (e.g. one change per document across a fleet)."""
+    lib = _load()
+    if lib is None:
+        import hashlib
+        return [hashlib.sha256(bytes(b)).digest() for b in buffers]
+    blob = b''.join(bytes(b) for b in buffers)
+    offsets = np.zeros(len(buffers), dtype=np.uint64)
+    lens = np.array([len(b) for b in buffers], dtype=np.uint64)
+    np.cumsum(lens[:-1], out=offsets[1:]) if len(buffers) > 1 else None
+    arr, ptr = _u8(blob)
+    out = np.zeros(32 * len(buffers), dtype=np.uint8)
+    lib.am_sha256_batch(
+        ptr, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), len(buffers),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    raw = out.tobytes()
+    return [raw[32 * i:32 * i + 32] for i in range(len(buffers))]
+
+
+def deflate_raw(data):
+    lib = _load()
+    if lib is None:
+        import zlib
+        c = zlib.compressobj(6, zlib.DEFLATED, -15)
+        return c.compress(bytes(data)) + c.flush()
+    data = bytes(data)
+    cap = len(data) + (len(data) >> 3) + 64
+    out = np.zeros(cap, dtype=np.uint8)
+    arr, ptr = _u8(data)
+    size = lib.am_deflate_raw(ptr, len(data),
+                              out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                              cap)
+    if size < 0:
+        raise ValueError('deflate failed')
+    return out[:size].tobytes()
+
+
+def inflate_raw(data, max_size=1 << 28):
+    lib = _load()
+    if lib is None:
+        import zlib
+        return zlib.decompress(bytes(data), -15)
+    data = bytes(data)
+    cap = min(max(len(data) * 8, 1 << 16), max_size)
+    arr, ptr = _u8(data)
+    while True:
+        out = np.zeros(cap, dtype=np.uint8)
+        size = lib.am_inflate_raw(
+            ptr, len(data), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            cap)
+        if size >= 0:
+            return out[:size].tobytes()
+        if cap >= max_size:
+            raise ValueError('inflate failed')
+        cap = min(cap * 4, max_size)
+
+
+def _decode_column(fn_name, buf, signed=False):
+    lib = _load()
+    if lib is None:
+        return None  # caller falls back to the Python codecs
+    data = bytes(buf)
+    arr, ptr = _u8(data)
+    if fn_name == 'rle':
+        count = lib.am_count_rle(ptr, len(data), int(signed))
+    elif fn_name == 'delta':
+        count = lib.am_count_rle(ptr, len(data), 1)
+    else:
+        count = len(data) * 8  # upper bound for boolean runs is large; count below
+    if fn_name == 'boolean':
+        # booleans: decode with a growing buffer
+        cap = max(64, len(data) * 8)
+        while True:
+            out = np.zeros(cap, dtype=np.int64)
+            mask = np.zeros(cap, dtype=np.uint8)
+            n = lib.am_decode_boolean(
+                ptr, len(data), out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap)
+            if n >= 0:
+                return out[:n], mask[:n].astype(bool)
+            cap *= 4
+            if cap > 1 << 30:
+                raise ValueError('malformed boolean column')
+    if count < 0:
+        raise ValueError('malformed column')
+    out = np.zeros(max(count, 1), dtype=np.int64)
+    mask = np.zeros(max(count, 1), dtype=np.uint8)
+    fn = lib.am_decode_rle if fn_name == 'rle' else lib.am_decode_delta
+    args = [ptr, len(data)]
+    if fn_name == 'rle':
+        args.append(int(signed))
+    args += [out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+             mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+             max(count, 1)]
+    n = fn(*args)
+    if n < 0:
+        raise ValueError('malformed column')
+    return out[:n], mask[:n].astype(bool)
+
+
+def decode_rle_column(buf, signed=False):
+    """Decode an entire RLE column to (values int64[], valid bool[])."""
+    return _decode_column('rle', buf, signed)
+
+
+def decode_delta_column(buf):
+    """Decode a delta column to absolute values (values int64[], valid bool[])."""
+    return _decode_column('delta', buf)
+
+
+def decode_boolean_column(buf):
+    return _decode_column('boolean', buf)
+
+
+def ingest_changes(buffers, doc_ids):
+    """Batched native change ingest: parse N binary changes into flat op-row
+    arrays (doc, key_id, packed_opid, value, flags) with C++-side dictionary
+    encoding of keys and actors.
+
+    Returns (rows dict, key_strings list, actor_hex list), or None if any
+    change falls outside the fleet-kernel subset (caller falls back to the
+    general host engine)."""
+    lib = _load()
+    if lib is None:
+        return None
+    blob = b''.join(bytes(b) for b in buffers)
+    lens = np.array([len(b) for b in buffers], dtype=np.uint64)
+    offsets = np.zeros(len(buffers), dtype=np.uint64)
+    if len(buffers) > 1:
+        np.cumsum(lens[:-1], out=offsets[1:])
+    docs = np.asarray(doc_ids, dtype=np.int32)
+    arr, ptr = _u8(blob)
+    i64 = ctypes.c_int64
+    lib.am_ingest_changes.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_uint64]
+    lib.am_ingest_changes.restype = i64
+    n_rows = lib.am_ingest_changes(
+        ptr, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        docs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(buffers))
+    if n_rows < 0:
+        return None
+    n = max(int(n_rows), 1)
+    doc = np.zeros(n, dtype=np.int32)
+    key = np.zeros(n, dtype=np.int32)
+    packed = np.zeros(n, dtype=np.int32)
+    val = np.zeros(n, dtype=np.int32)
+    flags = np.zeros(n, dtype=np.uint8)
+    key_blob = np.zeros(max(len(blob) * 2, 1 << 16), dtype=np.uint8)
+    actor_blob = np.zeros(1 << 20, dtype=np.uint8)
+    n_keys = i64(0)
+    n_actors = i64(0)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.am_ingest_fetch.argtypes = [i32p, i32p, i32p, i32p, u8p, u8p,
+                                    ctypes.c_uint64, ctypes.POINTER(i64),
+                                    u8p, ctypes.c_uint64, ctypes.POINTER(i64)]
+    lib.am_ingest_fetch.restype = i64
+    ret = lib.am_ingest_fetch(
+        doc.ctypes.data_as(i32p), key.ctypes.data_as(i32p),
+        packed.ctypes.data_as(i32p), val.ctypes.data_as(i32p),
+        flags.ctypes.data_as(u8p), key_blob.ctypes.data_as(u8p),
+        key_blob.size, ctypes.byref(n_keys),
+        actor_blob.ctypes.data_as(u8p), actor_blob.size,
+        ctypes.byref(n_actors))
+    if ret < 0:
+        raise ValueError('ingest fetch failed')
+
+    def read_blob(blob_arr, count):
+        from ..encoding import Decoder
+        decoder = Decoder(blob_arr.tobytes())
+        return [decoder.read_prefixed_string() for _ in range(count)]
+
+    keys = read_blob(key_blob, int(n_keys.value))
+    actors = read_blob(actor_blob, int(n_actors.value))
+    rows = {'doc': doc[:int(n_rows)], 'key': key[:int(n_rows)],
+            'packed': packed[:int(n_rows)], 'value': val[:int(n_rows)],
+            'flags': flags[:int(n_rows)]}
+    return rows, keys, actors

@@ -1,0 +1,669 @@
+// Native codec kernels for automerge_tpu.
+//
+// The components the JS reference delegates to npm packages (SHA-256 via
+// fast-sha256, DEFLATE via pako) plus its hand-rolled LEB128/RLE/delta/
+// boolean column codecs (ref backend/encoding.js) are implemented here as
+// first-class C++ host kernels (SURVEY.md section 2.9). Column decoders emit
+// int64 value arrays + validity masks directly, so binary changes decode
+// straight into the padded tensors the fleet engine consumes.
+//
+// Exposed as a plain C ABI consumed from Python via ctypes.
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <zlib.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// SHA-256 (FIPS 180-4), compact single-shot implementation
+// ---------------------------------------------------------------------------
+
+static const uint32_t K256[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+static inline uint32_t rotr(uint32_t x, int n) {
+  return (x >> n) | (x << (32 - n));
+}
+
+static void sha256_block(uint32_t state[8], const uint8_t *p) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; i++) {
+    w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+           (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+  }
+  for (int i = 16; i < 64; i++) {
+    uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+  uint32_t e = state[4], f = state[5], g = state[6], h = state[7];
+  for (int i = 0; i < 64; i++) {
+    uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = h + S1 + ch + K256[i] + w[i];
+    uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+    uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = S0 + maj;
+    h = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  state[0] += a; state[1] += b; state[2] += c; state[3] += d;
+  state[4] += e; state[5] += f; state[6] += g; state[7] += h;
+}
+
+// out must have room for 32 bytes
+void am_sha256(const uint8_t *data, uint64_t len, uint8_t *out) {
+  uint32_t st[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+  uint64_t full = len / 64;
+  for (uint64_t i = 0; i < full; i++) sha256_block(st, data + 64 * i);
+  uint8_t tail[128];
+  uint64_t rem = len - 64 * full;
+  memcpy(tail, data + 64 * full, rem);
+  tail[rem] = 0x80;
+  uint64_t tail_len = (rem + 9 <= 64) ? 64 : 128;
+  memset(tail + rem + 1, 0, tail_len - rem - 9);
+  uint64_t bits = len * 8;
+  for (int i = 0; i < 8; i++)
+    tail[tail_len - 1 - i] = uint8_t(bits >> (8 * i));
+  sha256_block(st, tail);
+  if (tail_len == 128) sha256_block(st, tail + 64);
+  for (int i = 0; i < 8; i++) {
+    out[4 * i] = uint8_t(st[i] >> 24);
+    out[4 * i + 1] = uint8_t(st[i] >> 16);
+    out[4 * i + 2] = uint8_t(st[i] >> 8);
+    out[4 * i + 3] = uint8_t(st[i]);
+  }
+}
+
+// Batched hashing: n buffers, each lens[i] bytes at data + offsets[i];
+// out receives n * 32 bytes. The per-doc hash chains of a fleet are
+// independent, so this parallelizes across documents (SURVEY.md section 7
+// hard part 5: batch across docs, not within a doc).
+void am_sha256_batch(const uint8_t *data, const uint64_t *offsets,
+                     const uint64_t *lens, uint64_t n, uint8_t *out) {
+  for (uint64_t i = 0; i < n; i++) {
+    am_sha256(data + offsets[i], lens[i], out + 32 * i);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Raw DEFLATE via zlib (the reference uses pako: columnar.js:1)
+// ---------------------------------------------------------------------------
+
+// Returns compressed size, or -1 on error. out_cap must be generous.
+int64_t am_deflate_raw(const uint8_t *data, uint64_t len, uint8_t *out,
+                       uint64_t out_cap) {
+  z_stream zs;
+  memset(&zs, 0, sizeof(zs));
+  if (deflateInit2(&zs, 6, Z_DEFLATED, -15, 8, Z_DEFAULT_STRATEGY) != Z_OK)
+    return -1;
+  zs.next_in = const_cast<uint8_t *>(data);
+  zs.avail_in = uInt(len);
+  zs.next_out = out;
+  zs.avail_out = uInt(out_cap);
+  int ret = deflate(&zs, Z_FINISH);
+  deflateEnd(&zs);
+  if (ret != Z_STREAM_END) return -1;
+  return int64_t(out_cap - zs.avail_out);
+}
+
+int64_t am_inflate_raw(const uint8_t *data, uint64_t len, uint8_t *out,
+                       uint64_t out_cap) {
+  z_stream zs;
+  memset(&zs, 0, sizeof(zs));
+  if (inflateInit2(&zs, -15) != Z_OK) return -1;
+  zs.next_in = const_cast<uint8_t *>(data);
+  zs.avail_in = uInt(len);
+  zs.next_out = out;
+  zs.avail_out = uInt(out_cap);
+  int ret = inflate(&zs, Z_FINISH);
+  inflateEnd(&zs);
+  if (ret != Z_STREAM_END) return -1;
+  return int64_t(out_cap - zs.avail_out);
+}
+
+// ---------------------------------------------------------------------------
+// LEB128 (ref encoding.js:97-230)
+// ---------------------------------------------------------------------------
+
+// Reads one unsigned LEB128; advances *pos; returns value or sets *err.
+static inline uint64_t read_uleb(const uint8_t *buf, uint64_t len,
+                                 uint64_t *pos, int *err) {
+  uint64_t result = 0;
+  int shift = 0;
+  while (*pos < len) {
+    uint8_t byte = buf[(*pos)++];
+    if (shift >= 64) { *err = 1; return 0; }
+    result |= uint64_t(byte & 0x7f) << shift;
+    shift += 7;
+    if ((byte & 0x80) == 0) return result;
+  }
+  *err = 1;
+  return 0;
+}
+
+static inline int64_t read_sleb(const uint8_t *buf, uint64_t len,
+                                uint64_t *pos, int *err) {
+  int64_t result = 0;
+  int shift = 0;
+  while (*pos < len) {
+    uint8_t byte = buf[(*pos)++];
+    if (shift >= 64) { *err = 1; return 0; }
+    result |= int64_t(byte & 0x7f) << shift;
+    shift += 7;
+    if ((byte & 0x80) == 0) {
+      if ((byte & 0x40) && shift < 64) result |= -(int64_t(1) << shift);
+      return result;
+    }
+  }
+  *err = 1;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Column decoders (ref encoding.js RLEDecoder/DeltaDecoder/BooleanDecoder)
+//
+// Each decodes an entire column buffer into out[0..cap) int64 values with a
+// validity mask (0 = null), returning the number of values decoded or -1 on
+// malformed input / overflow. This is the "decode straight into padded
+// arrays" path: the output arrays are reused as device-transfer staging.
+// ---------------------------------------------------------------------------
+
+int64_t am_decode_rle(const uint8_t *buf, uint64_t len, int is_signed,
+                      int64_t *out, uint8_t *mask, int64_t cap) {
+  uint64_t pos = 0;
+  int64_t n = 0;
+  int err = 0;
+  int64_t last_value = 0;
+  int have_last = 0, last_was_literal = 0, last_was_nulls = 0;
+  while (pos < len) {
+    int64_t count = read_sleb(buf, len, &pos, &err);
+    if (err) return -1;
+    if (count > 1) {
+      int64_t value = is_signed ? read_sleb(buf, len, &pos, &err)
+                                : int64_t(read_uleb(buf, len, &pos, &err));
+      if (err) return -1;
+      if (have_last && !last_was_nulls && last_value == value) return -1;
+      if (n + count > cap) return -1;
+      for (int64_t i = 0; i < count; i++) { out[n] = value; mask[n] = 1; n++; }
+      last_value = value; have_last = 1; last_was_literal = 0; last_was_nulls = 0;
+    } else if (count == 1) {
+      return -1;  // repetition count of 1 is not allowed
+    } else if (count < 0) {
+      if (last_was_literal) return -1;  // successive literals not allowed
+      int64_t m = -count;
+      if (n + m > cap) return -1;
+      for (int64_t i = 0; i < m; i++) {
+        int64_t value = is_signed ? read_sleb(buf, len, &pos, &err)
+                                  : int64_t(read_uleb(buf, len, &pos, &err));
+        if (err) return -1;
+        if (have_last && !last_was_nulls && value == last_value) return -1;
+        out[n] = value; mask[n] = 1; n++;
+        last_value = value; have_last = 1;
+      }
+      last_was_literal = 1; last_was_nulls = 0;
+    } else {  // count == 0: null run
+      if (last_was_nulls) return -1;
+      uint64_t m = read_uleb(buf, len, &pos, &err);
+      if (err || m == 0) return -1;
+      if (n + int64_t(m) > cap) return -1;
+      for (uint64_t i = 0; i < m; i++) { out[n] = 0; mask[n] = 0; n++; }
+      last_was_nulls = 1; last_was_literal = 0;
+    }
+  }
+  return n;
+}
+
+int64_t am_decode_delta(const uint8_t *buf, uint64_t len, int64_t *out,
+                        uint8_t *mask, int64_t cap) {
+  // Delta = RLE('int') of successive differences; accumulate absolutes
+  int64_t n = am_decode_rle(buf, len, 1, out, mask, cap);
+  if (n < 0) return -1;
+  int64_t absolute = 0;
+  for (int64_t i = 0; i < n; i++) {
+    if (mask[i]) {
+      absolute += out[i];
+      out[i] = absolute;
+    }
+  }
+  return n;
+}
+
+int64_t am_decode_boolean(const uint8_t *buf, uint64_t len, int64_t *out,
+                          uint8_t *mask, int64_t cap) {
+  uint64_t pos = 0;
+  int64_t n = 0;
+  int err = 0;
+  int value = 0, first = 1;
+  while (pos < len) {
+    uint64_t count = read_uleb(buf, len, &pos, &err);
+    if (err) return -1;
+    if (count == 0 && !first) return -1;  // zero-length runs not allowed
+    if (n + int64_t(count) > cap) return -1;
+    for (uint64_t i = 0; i < count; i++) { out[n] = value; mask[n] = 1; n++; }
+    value = !value;
+    first = 0;
+  }
+  return n;
+}
+
+// Counts values in an RLE/delta column without materializing them.
+int64_t am_count_rle(const uint8_t *buf, uint64_t len, int is_signed) {
+  uint64_t pos = 0;
+  int64_t n = 0;
+  int err = 0;
+  while (pos < len) {
+    int64_t count = read_sleb(buf, len, &pos, &err);
+    if (err) return -1;
+    if (count > 1) {
+      if (is_signed) read_sleb(buf, len, &pos, &err);
+      else read_uleb(buf, len, &pos, &err);
+      if (err) return -1;
+      n += count;
+    } else if (count == 1) {
+      return -1;
+    } else if (count < 0) {
+      for (int64_t i = 0; i < -count; i++) {
+        if (is_signed) read_sleb(buf, len, &pos, &err);
+        else read_uleb(buf, len, &pos, &err);
+        if (err) return -1;
+      }
+      n += -count;
+    } else {
+      uint64_t m = read_uleb(buf, len, &pos, &err);
+      if (err) return -1;
+      n += int64_t(m);
+    }
+  }
+  return n;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Batched change ingest: parse whole binary changes into fleet op rows.
+//
+// One call parses N change chunks (possibly DEFLATE-compressed), decodes
+// their header + columns, dictionary-encodes map keys and actor ids, and
+// emits flat op-row arrays ready to scatter into OpBatch tensors. This is
+// the host runtime leg of the wire->device pipeline; doing it in C++ removes
+// the per-change Python orchestration cost.
+//
+// Supports the fleet-kernel subset: root-map set/inc/del ops with integer
+// values (LEB128 uint/int/counter/timestamp). Returns -1 if any change needs
+// the general host engine.
+// ---------------------------------------------------------------------------
+
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Cursor {
+  const uint8_t *buf;
+  uint64_t len;
+  uint64_t pos = 0;
+  bool fail = false;
+
+  uint64_t uleb() {
+    int err = 0;
+    uint64_t v = read_uleb(buf, len, &pos, &err);
+    if (err) fail = true;
+    return v;
+  }
+  int64_t sleb() {
+    int err = 0;
+    int64_t v = read_sleb(buf, len, &pos, &err);
+    if (err) fail = true;
+    return v;
+  }
+  void skip(uint64_t n) {
+    if (pos + n > len) { fail = true; return; }
+    pos += n;
+  }
+  const uint8_t *bytes(uint64_t n) {
+    if (pos + n > len) { fail = true; return nullptr; }
+    const uint8_t *p = buf + pos;
+    pos += n;
+    return p;
+  }
+};
+
+struct Interner {
+  std::unordered_map<std::string, int32_t> index;
+  std::vector<std::string> items;
+
+  int32_t intern(const std::string &s) {
+    auto it = index.find(s);
+    if (it != index.end()) return it->second;
+    int32_t id = int32_t(items.size());
+    index.emplace(s, id);
+    items.push_back(s);
+    return id;
+  }
+};
+
+struct IngestCtx {
+  Interner keys, actors;
+  std::vector<int32_t> out_doc, out_key, out_packed, out_val;
+  std::vector<uint8_t> out_flags;  // 1 = set/del, 2 = inc
+  std::string error;
+};
+
+constexpr int kColObjActor = 0x01, kColObjCtr = 0x02;
+constexpr int kColKeyActor = 0x11, kColKeyCtr = 0x13, kColKeyStr = 0x15;
+constexpr int kColInsert = 0x34, kColAction = 0x42;
+constexpr int kColValLen = 0x56, kColValRaw = 0x57;
+constexpr int kActionSet = 1, kActionDel = 3, kActionInc = 5;
+constexpr int kActorBits = 8;
+
+// Decode an RLE utf8 column into interned key ids (-1 = null)
+bool decode_keystr(const uint8_t *buf, uint64_t len, Interner &keys,
+                   std::vector<int32_t> &out) {
+  Cursor c{buf, len};
+  while (c.pos < c.len && !c.fail) {
+    int64_t count = c.sleb();
+    if (c.fail) return false;
+    if (count > 1) {
+      uint64_t slen = c.uleb();
+      const uint8_t *p = c.bytes(slen);
+      if (c.fail) return false;
+      int32_t id = keys.intern(std::string((const char *)p, slen));
+      for (int64_t i = 0; i < count; i++) out.push_back(id);
+    } else if (count == 1) {
+      return false;
+    } else if (count < 0) {
+      for (int64_t i = 0; i < -count; i++) {
+        uint64_t slen = c.uleb();
+        const uint8_t *p = c.bytes(slen);
+        if (c.fail) return false;
+        out.push_back(keys.intern(std::string((const char *)p, slen)));
+      }
+    } else {
+      uint64_t nulls = c.uleb();
+      if (c.fail) return false;
+      for (uint64_t i = 0; i < nulls; i++) out.push_back(-1);
+    }
+  }
+  return !c.fail;
+}
+
+bool decode_i64_col(const uint8_t *buf, uint64_t len, bool is_signed,
+                    bool is_delta, std::vector<int64_t> &vals,
+                    std::vector<uint8_t> &mask) {
+  int64_t count = am_count_rle(buf, len, is_signed || is_delta);
+  if (count < 0) return false;
+  vals.resize(size_t(count));
+  mask.resize(size_t(count));
+  if (count == 0) return true;
+  int64_t n = is_delta
+      ? am_decode_delta(buf, len, vals.data(), mask.data(), count)
+      : am_decode_rle(buf, len, is_signed ? 1 : 0, vals.data(), mask.data(),
+                      count);
+  return n == count;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Implemented without the goto mess: parse body given the chunk *contents*
+// (after the 8-byte magic+checksum, 1-byte type, LEB length header).
+static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
+                              uint64_t body_len, int32_t doc) {
+  Cursor c{body, body_len};
+  uint64_t num_deps = c.uleb();
+  c.skip(32 * num_deps);
+  // actor hex string (length-prefixed bytes)
+  uint64_t actor_len = c.uleb();
+  const uint8_t *actor_bytes = c.bytes(actor_len);
+  if (c.fail) return false;
+  static const char *hex = "0123456789abcdef";
+  std::string actor_hex;
+  actor_hex.reserve(actor_len * 2);
+  for (uint64_t i = 0; i < actor_len; i++) {
+    actor_hex.push_back(hex[actor_bytes[i] >> 4]);
+    actor_hex.push_back(hex[actor_bytes[i] & 15]);
+  }
+  int32_t actor_id = ctx.actors.intern(actor_hex);
+  if (actor_id >= (1 << kActorBits)) return false;
+  c.uleb();                       // seq
+  uint64_t start_op = c.uleb();   // startOp
+  c.sleb();                       // time
+  uint64_t msg_len = c.uleb();    // message
+  c.skip(msg_len);
+  uint64_t num_other_actors = c.uleb();
+  for (uint64_t i = 0; i < num_other_actors; i++) {
+    uint64_t alen = c.uleb();
+    c.skip(alen);
+  }
+  if (c.fail) return false;
+
+  uint64_t num_cols = c.uleb();
+  std::vector<std::pair<uint32_t, std::pair<const uint8_t *, uint64_t>>> cols;
+  std::vector<uint64_t> col_lens;
+  std::vector<uint32_t> col_ids;
+  for (uint64_t i = 0; i < num_cols; i++) {
+    uint32_t cid = uint32_t(c.uleb());
+    uint64_t blen = c.uleb();
+    col_ids.push_back(cid);
+    col_lens.push_back(blen);
+  }
+  if (c.fail) return false;
+  std::vector<const uint8_t *> col_bufs;
+  for (uint64_t i = 0; i < num_cols; i++) {
+    col_bufs.push_back(c.bytes(col_lens[i]));
+  }
+  if (c.fail) return false;
+
+  std::vector<int32_t> key_ids;
+  std::vector<int64_t> actions, val_lens, obj_ctr;
+  std::vector<uint8_t> actions_ok, val_lens_ok, obj_ctr_ok, insert_vals,
+      insert_ok;
+  std::vector<int64_t> insert_i64;
+  const uint8_t *val_raw = nullptr;
+  uint64_t val_raw_len = 0;
+
+  for (uint64_t i = 0; i < num_cols; i++) {
+    uint32_t cid = col_ids[i];
+    const uint8_t *b = col_bufs[i];
+    uint64_t blen = col_lens[i];
+    if (cid == kColKeyStr) {
+      if (!decode_keystr(b, blen, ctx.keys, key_ids)) return false;
+    } else if (cid == kColAction) {
+      if (!decode_i64_col(b, blen, false, false, actions, actions_ok))
+        return false;
+    } else if (cid == kColValLen) {
+      if (!decode_i64_col(b, blen, false, false, val_lens, val_lens_ok))
+        return false;
+    } else if (cid == kColValRaw) {
+      val_raw = b;
+      val_raw_len = blen;
+    } else if (cid == kColObjCtr) {
+      if (!decode_i64_col(b, blen, false, false, obj_ctr, obj_ctr_ok))
+        return false;
+    } else if (cid == kColInsert) {
+      if (!decode_i64_col(b, blen, false, false, insert_i64, insert_ok)) {
+        // boolean column needs the boolean decoder
+        insert_i64.clear();
+        insert_ok.clear();
+      }
+      // decode as boolean
+      {
+        int64_t cap = 16;
+        std::vector<int64_t> v;
+        std::vector<uint8_t> m;
+        int64_t n = -1;
+        while (n < 0 && cap < (int64_t(1) << 30)) {
+          v.resize(size_t(cap));
+          m.resize(size_t(cap));
+          n = am_decode_boolean(b, blen, v.data(), m.data(), cap);
+          if (n < 0) cap *= 4;
+        }
+        if (n < 0) return false;
+        insert_i64.assign(v.begin(), v.begin() + n);
+      }
+    }
+    // other columns (keyActor/keyCtr, pred group, chld) are irrelevant for
+    // root-map set/inc/del ingest; their presence with non-null content for
+    // list ops is caught via key_ids null check below
+  }
+
+  uint64_t n_ops = actions.size();
+  uint64_t raw_pos = 0;
+  for (uint64_t i = 0; i < n_ops; i++) {
+    int64_t action = actions[i];
+    // root-map only: objCtr must be null
+    if (i < obj_ctr.size() && obj_ctr_ok.size() > i && obj_ctr_ok[i])
+      return false;
+    if (i < insert_i64.size() && insert_i64[i]) return false;  // no inserts
+    int32_t key = (i < key_ids.size()) ? key_ids[i] : -1;
+    if (key < 0) return false;  // list element op
+    int64_t tag = (i < val_lens.size() && val_lens_ok[i]) ? val_lens[i] : 0;
+    uint64_t vsize = uint64_t(tag) >> 4;
+    int vtype = int(tag & 0x0f);
+    if (raw_pos + vsize > val_raw_len) return false;
+    const uint8_t *vbytes = val_raw ? val_raw + raw_pos : nullptr;
+    raw_pos += vsize;
+
+    int64_t value = 0;
+    if (action == kActionSet || action == kActionInc) {
+      uint64_t p = 0;
+      int err = 0;
+      if (vtype == 3) {  // LEB128 uint
+        value = int64_t(read_uleb(vbytes, vsize, &p, &err));
+      } else if (vtype == 4 || vtype == 8 || vtype == 9) {  // int/counter/ts
+        value = read_sleb(vbytes, vsize, &p, &err);
+      } else {
+        return false;  // non-integer value: general engine path
+      }
+      if (err) return false;
+      if (value < 0 || value >= (int64_t(1) << 31)) return false;
+    } else if (action != kActionDel) {
+      return false;  // make*/link need the general engine
+    }
+
+    int64_t ctr = int64_t(start_op + i);
+    if (ctr >= (int64_t(1) << (31 - kActorBits))) return false;
+    ctx.out_doc.push_back(doc);
+    ctx.out_key.push_back(key);
+    ctx.out_packed.push_back(int32_t((ctr << kActorBits) | actor_id));
+    // A winning delete must be distinguishable from set-to-zero: deletions
+    // carry the TOMBSTONE value (-1), matching tensor_doc.TOMBSTONE
+    ctx.out_val.push_back(action == kActionDel ? -1 : int32_t(value));
+    ctx.out_flags.push_back(action == kActionInc ? 2 : 1);
+  }
+  return true;
+}
+
+// One-shot batched ingest. Returns number of op rows, or -1 on any change
+// that needs the general host engine. Outputs are retrieved with
+// am_ingest_fetch (two-phase because row count is not known in advance).
+static IngestCtx *g_ingest = nullptr;
+
+int64_t am_ingest_changes(const uint8_t *blob, const uint64_t *offsets,
+                          const uint64_t *lens, const int32_t *doc_ids,
+                          uint64_t n_changes) {
+  delete g_ingest;
+  g_ingest = new IngestCtx();
+  for (uint64_t i = 0; i < n_changes; i++) {
+    const uint8_t *chunk = blob + offsets[i];
+    uint64_t chunk_len = lens[i];
+    if (chunk_len < 12) { delete g_ingest; g_ingest = nullptr; return -1; }
+    const uint8_t *body;
+    uint64_t body_len;
+    std::vector<uint8_t> inflated;
+    Cursor hc{chunk, chunk_len};
+    hc.skip(8);  // magic + checksum
+    uint8_t chunk_type = *hc.bytes(1);
+    uint64_t blen = hc.uleb();
+    const uint8_t *bptr = hc.bytes(blen);
+    if (hc.fail) { delete g_ingest; g_ingest = nullptr; return -1; }
+    if (chunk_type == 2) {  // deflated change
+      size_t cap = blen * 16 + 1024;
+      int64_t n = -1;
+      while (n < 0 && cap < (size_t(1) << 28)) {
+        inflated.resize(cap);
+        n = am_inflate_raw(bptr, blen, inflated.data(), cap);
+        if (n < 0) cap *= 4;
+      }
+      if (n < 0) { delete g_ingest; g_ingest = nullptr; return -1; }
+      body = inflated.data();
+      body_len = uint64_t(n);
+    } else if (chunk_type == 1) {
+      body = bptr;
+      body_len = blen;
+    } else {
+      delete g_ingest; g_ingest = nullptr; return -1;
+    }
+    if (!parse_change_body(*g_ingest, body, body_len, doc_ids[i])) {
+      delete g_ingest;
+      g_ingest = nullptr;
+      return -1;
+    }
+  }
+  return int64_t(g_ingest->out_doc.size());
+}
+
+// Copy results out after am_ingest_changes. key_blob receives the interned
+// keys as length-prefixed (uleb) strings; returns bytes written or -1 if cap
+// too small.
+int64_t am_ingest_fetch(int32_t *doc, int32_t *key, int32_t *packed,
+                        int32_t *val, uint8_t *flags, uint8_t *key_blob,
+                        uint64_t key_blob_cap, int64_t *n_keys,
+                        uint8_t *actor_blob, uint64_t actor_blob_cap,
+                        int64_t *n_actors) {
+  if (!g_ingest) return -1;
+  IngestCtx &ctx = *g_ingest;
+  size_t n = ctx.out_doc.size();
+  memcpy(doc, ctx.out_doc.data(), n * 4);
+  memcpy(key, ctx.out_key.data(), n * 4);
+  memcpy(packed, ctx.out_packed.data(), n * 4);
+  memcpy(val, ctx.out_val.data(), n * 4);
+  memcpy(flags, ctx.out_flags.data(), n);
+
+  auto write_blob = [](const std::vector<std::string> &items, uint8_t *out,
+                       uint64_t cap) -> int64_t {
+    uint64_t pos = 0;
+    for (const auto &s : items) {
+      uint64_t len = s.size();
+      // uleb encode length
+      uint64_t v = len;
+      do {
+        if (pos >= cap) return -1;
+        uint8_t byte = v & 0x7f;
+        v >>= 7;
+        out[pos++] = byte | (v ? 0x80 : 0);
+      } while (v);
+      if (pos + len > cap) return -1;
+      memcpy(out + pos, s.data(), len);
+      pos += len;
+    }
+    return int64_t(pos);
+  };
+  int64_t kb = write_blob(ctx.keys.items, key_blob, key_blob_cap);
+  int64_t ab = write_blob(ctx.actors.items, actor_blob, actor_blob_cap);
+  if (kb < 0 || ab < 0) return -1;
+  *n_keys = int64_t(ctx.keys.items.size());
+  *n_actors = int64_t(ctx.actors.items.size());
+  delete g_ingest;
+  g_ingest = nullptr;
+  return kb;
+}
+
+}  // extern "C"

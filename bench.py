@@ -108,6 +108,46 @@ def bench_host(n_docs, n_keys, rounds, ops_per_round, seed=0):
     return total_ops / elapsed, elapsed
 
 
+def bench_pipeline(n_docs, n_keys, changes_per_doc, seed=0):
+    """Full wire-to-device pipeline: binary changes -> native C++ column
+    decode -> dictionary encoding -> device merge."""
+    import jax
+    from automerge_tpu.columnar import encode_change
+    from automerge_tpu.fleet import FleetState, apply_op_batch
+    from automerge_tpu.fleet.ingest import (
+        changes_to_op_batch, KeyInterner, ActorInterner)
+    rng = np.random.default_rng(seed)
+    actors = ['aa' * 4, 'bb' * 4]
+    per_doc = []
+    for d in range(n_docs):
+        changes = []
+        seqs = [0, 0]
+        for c in range(changes_per_doc):
+            a = int(rng.integers(0, 2))
+            seqs[a] += 1
+            changes.append(encode_change({
+                'actor': actors[a], 'seq': seqs[a], 'startOp': c + 1,
+                'time': 0, 'message': '', 'deps': [],
+                'ops': [{'action': 'set', 'obj': '_root',
+                         'key': f'k{int(rng.integers(0, n_keys))}',
+                         'value': int(rng.integers(1, 1 << 20)),
+                         'datatype': 'int', 'pred': []}]}))
+        per_doc.append(changes)
+
+    def run():
+        ki, ai = KeyInterner(), ActorInterner()
+        batch = changes_to_op_batch(per_doc, ki, ai)
+        state = FleetState.empty(n_docs, max(len(ki), 1))
+        state, _ = apply_op_batch(state, batch)
+        jax.block_until_ready(state.winners)
+
+    run()  # warmup: jit compile for these shapes
+    start = time.perf_counter()
+    run()
+    elapsed = time.perf_counter() - start
+    return (n_docs * changes_per_doc) / elapsed, elapsed
+
+
 def main():
     n_docs = int(os.environ.get('BENCH_DOCS', 10000))
     n_keys = int(os.environ.get('BENCH_KEYS', 1000))
@@ -120,6 +160,13 @@ def main():
     host_docs = int(os.environ.get('BENCH_HOST_DOCS', 20))
     host_rate, host_time = bench_host(host_docs, n_keys, rounds,
                                       min(ops_per_round, 20))
+
+    # Full-pipeline (wire decode included) on a medium fleet, for the record
+    pipe_rate, _ = bench_pipeline(int(os.environ.get('BENCH_PIPE_DOCS', 500)),
+                                  n_keys, 20)
+    print(f'# pipeline (wire->device incl. native decode): '
+          f'{pipe_rate:.0f} changes/s', file=sys.stderr)
+    print(f'# host reference engine: {host_rate:.0f} changes/s', file=sys.stderr)
 
     result = {
         'metric': 'changes_per_sec_10k_doc_merge',

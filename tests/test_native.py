@@ -1,0 +1,162 @@
+"""Native C++ codec tests: differential vs the Python codecs, hashlib, and
+zlib, plus the end-to-end ingest pipeline (binary change -> native column
+decode -> fleet tensors) against the host engine."""
+
+import hashlib
+import os
+import random
+import zlib
+
+import numpy as np
+import pytest
+
+from automerge_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason='native toolchain unavailable')
+
+
+class TestSha256:
+    def test_matches_hashlib(self):
+        for n in (0, 1, 55, 56, 63, 64, 65, 127, 128, 1000, 100000):
+            data = os.urandom(n)
+            assert native.sha256(data) == hashlib.sha256(data).digest()
+
+    def test_batched(self):
+        bufs = [os.urandom(i * 7 + 1) for i in range(50)]
+        assert native.sha256_batch(bufs) == \
+            [hashlib.sha256(b).digest() for b in bufs]
+
+
+class TestDeflate:
+    def test_round_trip_and_zlib_interop(self):
+        data = os.urandom(5000) + b'a' * 5000
+        compressed = native.deflate_raw(data)
+        assert zlib.decompress(compressed, -15) == data
+        co = zlib.compressobj(6, zlib.DEFLATED, -15)
+        zc = co.compress(data) + co.flush()
+        assert native.inflate_raw(zc) == data
+        assert native.inflate_raw(compressed) == data
+
+    def test_inflate_garbage_rejected(self):
+        with pytest.raises(ValueError):
+            native.inflate_raw(b'\xff\xff\xff\xff', max_size=1 << 16)
+
+
+class TestColumnDecoders:
+    def test_rle_int_differential(self):
+        from automerge_tpu.encoding import RLEEncoder
+        rng = random.Random(7)
+        seq = []
+        for _ in range(500):
+            r = rng.random()
+            if r < 0.2:
+                seq.append(None)
+            elif r < 0.7:
+                seq.append(rng.randrange(-50, 50))
+            else:
+                seq.append(seq[-1] if seq and seq[-1] is not None else 3)
+        enc = RLEEncoder('int')
+        for v in seq:
+            enc.append_value(v)
+        vals, valid = native.decode_rle_column(enc.buffer, signed=True)
+        assert [(int(v), bool(m)) for v, m in zip(vals, valid)] == \
+            [(v if v is not None else 0, v is not None) for v in seq]
+
+    def test_rle_uint_differential(self):
+        from automerge_tpu.encoding import RLEEncoder
+        rng = random.Random(9)
+        seq = [None if rng.random() < 0.15 else rng.randrange(0, 2 ** 40)
+               for _ in range(300)]
+        enc = RLEEncoder('uint')
+        for v in seq:
+            enc.append_value(v)
+        vals, valid = native.decode_rle_column(enc.buffer, signed=False)
+        assert [(int(v) if m else None) for v, m in zip(vals, valid)] == seq
+
+    def test_delta_differential(self):
+        from automerge_tpu.encoding import DeltaEncoder
+        rng = random.Random(11)
+        seq = [None if rng.random() < 0.1 else rng.randrange(0, 10 ** 6)
+               for _ in range(400)]
+        enc = DeltaEncoder()
+        for v in seq:
+            enc.append_value(v)
+        vals, valid = native.decode_delta_column(enc.buffer)
+        assert [(int(v) if m else None) for v, m in zip(vals, valid)] == seq
+
+    def test_boolean_differential(self):
+        from automerge_tpu.encoding import BooleanEncoder
+        rng = random.Random(13)
+        seq = [rng.random() < 0.5 for _ in range(300)]
+        enc = BooleanEncoder()
+        for v in seq:
+            enc.append_value(v)
+        vals, valid = native.decode_boolean_column(enc.buffer)
+        assert [bool(v) for v in vals] == seq
+        assert valid.all()
+
+    def test_malformed_rejected(self):
+        for bad in ([1, 1], [2, 1, 2, 1], [0, 0], [0x7f]):
+            with pytest.raises(ValueError):
+                native.decode_rle_column(bytes(bad), signed=True)
+
+
+class TestIngestPipeline:
+    def test_ingest_matches_host_engine(self):
+        import automerge_tpu.backend as Backend
+        from automerge_tpu.columnar import encode_change
+        from automerge_tpu.common import lamport_key
+        from automerge_tpu.fleet import FleetState, apply_op_batch
+        from automerge_tpu.fleet.ingest import (
+            changes_to_op_batch, KeyInterner, ActorInterner)
+
+        rng = random.Random(123)
+        actors = ['aa' * 4, 'bb' * 4, 'cc' * 4]
+        n_docs, n_keys = 6, 8
+        per_doc = []
+        host_backends = []
+        for d in range(n_docs):
+            changes = []
+            seqs = {a: 0 for a in actors}
+            ctr = 1
+            for _ in range(12):
+                a = rng.choice(actors)
+                seqs[a] += 1
+                n_ops = rng.randrange(1, 4)
+                ops = [{'action': 'set', 'obj': '_root',
+                        'key': f'k{rng.randrange(n_keys)}',
+                        'value': rng.randrange(1, 10 ** 6), 'datatype': 'int',
+                        'pred': []} for _ in range(n_ops)]
+                changes.append(encode_change(
+                    {'actor': a, 'seq': seqs[a], 'startOp': ctr, 'time': 0,
+                     'message': '', 'deps': [], 'ops': ops}))
+                ctr += n_ops
+            per_doc.append(changes)
+            backend = Backend.init()
+            backend['state'].apply_changes(list(changes))
+            host_backends.append(backend)
+
+        key_interner, actor_interner = KeyInterner(), ActorInterner()
+        batch = changes_to_op_batch(per_doc, key_interner, actor_interner)
+        state = FleetState.empty(n_docs, max(len(key_interner), 1))
+        state, stats = apply_op_batch(state, batch)
+        values = np.asarray(state.values)
+
+        for d in range(n_docs):
+            props = Backend.get_patch(host_backends[d])['diffs']['props']
+            for key, conflict in props.items():
+                winner = max(conflict.keys(), key=lamport_key)
+                assert values[d, key_interner.index[key]] == \
+                    conflict[winner]['value']
+
+    def test_ingest_rejects_non_map_ops(self):
+        from automerge_tpu.columnar import encode_change
+        from automerge_tpu.fleet.ingest import (
+            changes_to_op_batch, KeyInterner, ActorInterner)
+        change = encode_change({
+            'actor': 'aaaa', 'seq': 1, 'startOp': 1, 'time': 0, 'message': '',
+            'deps': [], 'ops': [
+                {'action': 'makeList', 'obj': '_root', 'key': 'l', 'pred': []}]})
+        with pytest.raises(ValueError):
+            changes_to_op_batch([[change]], KeyInterner(), ActorInterner())
