@@ -156,48 +156,155 @@ def _value_patch(op):
 
 class Elem:
     """One list/text element: the insertion op plus all ops targeting it,
-    in ascending Lamport order."""
-    __slots__ = ('elem_id', 'ops')
+    in ascending Lamport order. Visibility (any op with no successors) is
+    cached and refreshed by the mutation paths."""
+    __slots__ = ('elem_id', 'ops', 'vis')
 
     def __init__(self, elem_id, ops):
         self.elem_id = elem_id
         self.ops = ops
+        self.vis = any(len(op['succ']) == 0 for op in ops)
 
     def visible(self):
-        return any(len(op['succ']) == 0 for op in self.ops)
+        return self.vis
+
+    def recompute_visibility(self):
+        self.vis = any(len(op['succ']) == 0 for op in self.ops)
+        return self.vis
+
+
+# Sequence objects store elements in blocks with cached visible counts so
+# that position lookups are O(blocks + block_size) instead of O(elements) —
+# the same trick as the reference's op blocks (ref new.js MAX_BLOCK_SIZE=600,
+# blocks carry numVisible metadata for list index computation)
+_BLOCK_SIZE = 256
+
+
+class _Block:
+    __slots__ = ('elems', 'visible')
+
+    def __init__(self, elems=None, visible=0):
+        self.elems = elems if elems is not None else []
+        self.visible = visible
 
 
 class ObjState:
     """State of one object in the document tree."""
-    __slots__ = ('type', 'keys', 'elems', 'by_id')
+    __slots__ = ('type', 'keys', 'blocks', 'elem_block')
 
     def __init__(self, type):
         self.type = type
         if type in ('list', 'text'):
             self.keys = None
-            self.elems = []
-            self.by_id = {}
+            self.blocks = [_Block()]
+            self.elem_block = {}
         else:
             self.keys = {}
-            self.elems = None
-            self.by_id = None
+            self.blocks = None
+            self.elem_block = None
 
     @property
     def is_seq(self):
-        return self.elems is not None
+        return self.blocks is not None
 
-    def visible_count_before(self, pos):
-        return sum(1 for e in self.elems[:pos] if e.visible())
+    # -- sequence operations ------------------------------------------------
+
+    def iter_elems(self):
+        for block in self.blocks:
+            yield from block.elems
+
+    def find(self, elem_id):
+        block = self.elem_block.get(elem_id)
+        if block is None:
+            return None
+        for elem in block.elems:
+            if elem.elem_id == elem_id:
+                return elem
+        return None
 
     def visible_index_of(self, elem_id):
         """Number of visible elements strictly before the given element."""
+        target_block = self.elem_block.get(elem_id)
+        if target_block is None:
+            raise ValueError(f'Reference element not found: {elem_id}')
         count = 0
-        for e in self.elems:
-            if e.elem_id == elem_id:
-                return count
-            if e.visible():
-                count += 1
+        for block in self.blocks:
+            if block is target_block:
+                for elem in block.elems:
+                    if elem.elem_id == elem_id:
+                        return count
+                    if elem.visible():
+                        count += 1
+                break
+            count += block.visible
         raise ValueError(f'Reference element not found: {elem_id}')
+
+    def insert_rga(self, ref_elem_id, elem, my_key):
+        """Insert `elem` after `ref_elem_id` ('_head' for the front), skipping
+        concurrent insertions with greater packed opIds (the RGA rule, ref
+        new.js:145-163). Returns the visible index of the insertion point."""
+        if ref_elem_id == '_head':
+            bi, pos, count = 0, 0, 0
+        else:
+            block = self.elem_block.get(ref_elem_id)
+            if block is None:
+                raise ValueError(f'Reference element not found: {ref_elem_id}')
+            bi = self.blocks.index(block)
+            count = sum(b.visible for b in self.blocks[:bi])
+            pos = None
+            for i, e in enumerate(block.elems):
+                if e.elem_id == ref_elem_id:
+                    pos = i + 1
+                    if e.visible():
+                        count += 1
+                    break
+                if e.visible():
+                    count += 1
+            if pos is None:
+                raise ValueError(f'Reference element not found: {ref_elem_id}')
+        # Skip concurrent siblings with greater insertion opIds
+        while True:
+            block = self.blocks[bi]
+            while pos < len(block.elems):
+                nxt = block.elems[pos]
+                if lamport_key(nxt.elem_id) > my_key:
+                    if nxt.visible():
+                        count += 1
+                    pos += 1
+                else:
+                    break
+            else:
+                if bi + 1 < len(self.blocks):
+                    bi += 1
+                    pos = 0
+                    continue
+            break
+        block = self.blocks[bi]
+        block.elems.insert(pos, elem)
+        self.elem_block[elem.elem_id] = block
+        if elem.visible():
+            block.visible += 1
+        if len(block.elems) > _BLOCK_SIZE:
+            self._split_block(bi)
+        return count
+
+    def _split_block(self, bi):
+        block = self.blocks[bi]
+        half = len(block.elems) // 2
+        right = _Block(block.elems[half:])
+        block.elems = block.elems[:half]
+        right.visible = sum(1 for e in right.elems if e.visible())
+        block.visible -= right.visible
+        self.blocks.insert(bi + 1, right)
+        for elem in right.elems:
+            self.elem_block[elem.elem_id] = right
+
+    def refresh_visibility(self, elem, was_visible):
+        """Adjust the cached visible count after elem's ops changed."""
+        now = elem.recompute_visibility()
+        if now != was_visible:
+            block = self.elem_block[elem.elem_id]
+            block.visible += 1 if now else -1
 
 
 ROOT_META = {'parentObj': None, 'parentKey': None, 'opId': '_root', 'type': 'map',
@@ -396,24 +503,11 @@ class OpSet:
             pred = op['pred'][0]
             raise ValueError(f'no matching operation for pred: {pred}')
         op_id = record['id']
-        if op_id in obj.by_id:
+        if op_id in obj.elem_block:
             raise ValueError(f'duplicate operation ID: {op_id}')
         ref = op.get('elemId', '_head')
-        if ref == '_head':
-            pos = 0
-        else:
-            relem = obj.by_id.get(ref)
-            if relem is None:
-                raise ValueError(f'Reference element not found: {ref}')
-            pos = obj.elems.index(relem) + 1
-        # Skip concurrent insertions with greater opIds (descending-order rule)
-        my_key = lamport_key(op_id)
-        while pos < len(obj.elems) and lamport_key(obj.elems[pos].elem_id) > my_key:
-            pos += 1
-        list_index = obj.visible_count_before(pos)
         elem = Elem(op_id, [record])
-        obj.elems.insert(pos, elem)
-        obj.by_id[op_id] = elem
+        list_index = obj.insert_rga(ref, elem, lamport_key(op_id))
 
         prop_state = {}
         self._update_patch_property(patches, object_id, record, prop_state,
@@ -425,9 +519,10 @@ class OpSet:
         ascending Lamport order (equivalent to the doc-op consumption in
         new.js mergeDocChangeOps:1067-1282)."""
         op_id = record['id']
+        elem = None
         if obj.is_seq:
             elem_id = op.get('elemId')
-            elem = obj.by_id.get(elem_id)
+            elem = obj.find(elem_id)
             if elem is None:
                 raise ValueError(f'Reference element not found: {elem_id}')
             rows = elem.ops
@@ -439,6 +534,7 @@ class OpSet:
 
         # Capture old succ counts (before this op's overwrites are recorded)
         old_succ = {row['id']: len(row['succ']) for row in rows}
+        was_visible = elem.visible() if elem is not None else None
 
         # Mark this op as successor of each of its preds
         preds = list(op.get('pred', []))
@@ -466,6 +562,10 @@ class OpSet:
                     insert_at = i
                     break
             rows.insert(insert_at, record)
+
+        # Keep the block's cached visible count in sync with the mutation
+        if elem is not None:
+            obj.refresh_visibility(elem, was_visible)
 
         # Emit patch calls for all ops of this key in order
         if obj.is_seq:
@@ -667,7 +767,7 @@ class OpSet:
             prop_state = {}
             if obj.is_seq:
                 list_index = 0
-                for elem in obj.elems:
+                for elem in obj.iter_elems():
                     for row in elem.ops:
                         self._update_patch_property(patches, object_id, row, prop_state,
                                                     list_index, len(row['succ']),
@@ -700,7 +800,7 @@ class OpSet:
         for object_id in self._document_object_order():
             obj = self.objects[object_id]
             if obj.is_seq:
-                for elem in obj.elems:
+                for elem in obj.iter_elems():
                     for row in elem.ops:
                         op = {'obj': object_id, 'action': row['action'],
                               'insert': row.get('insert', False),

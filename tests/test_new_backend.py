@@ -1,0 +1,322 @@
+"""Deep CRDT semantics tests, ported from reference test/new_backend_test.js:
+RGA concurrent insertions (same position and head), counters in lists,
+conflict shapes, plus permutation-convergence fuzzing in the spirit of
+test/fuzz_test.js (the backend itself under op-permutations, with the host
+engine as its own oracle via order-independence)."""
+
+import itertools
+import random
+
+import pytest
+
+from automerge_tpu.backend.op_set import OpSet
+from automerge_tpu.columnar import encode_change, decode_change
+
+A1, A2 = '01234567', '89abcdef'
+
+
+def hash_of(change):
+    return decode_change(encode_change(change))['hash']
+
+
+class TestConcurrentInsertions:
+    def changes(self):
+        change1 = {'actor': A1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeText', 'obj': '_root', 'key': 'text', 'insert': False,
+             'pred': []},
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': '_head', 'insert': True,
+             'value': 'a', 'pred': []}]}
+        change2 = {'actor': A1, 'seq': 2, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': f'2@{A1}', 'insert': True,
+             'value': 'c', 'pred': []}]}
+        change3 = {'actor': A2, 'seq': 1, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': f'2@{A1}', 'insert': True,
+             'value': 'b', 'pred': []}]}
+        return change1, change2, change3
+
+    def test_same_position_order1(self):
+        """(ref new_backend_test.js:725-780)"""
+        change1, change2, change3 = self.changes()
+        backend = OpSet()
+        patch1 = backend.apply_changes([encode_change(change1)])
+        assert patch1['diffs']['props']['text'][f'1@{A1}']['edits'] == [
+            {'action': 'insert', 'index': 0, 'elemId': f'2@{A1}',
+             'opId': f'2@{A1}', 'value': {'type': 'value', 'value': 'a'}}]
+        patch2 = backend.apply_changes([encode_change(change2)])
+        assert patch2['diffs']['props']['text'][f'1@{A1}']['edits'] == [
+            {'action': 'insert', 'index': 1, 'elemId': f'3@{A1}',
+             'opId': f'3@{A1}', 'value': {'type': 'value', 'value': 'c'}}]
+        patch3 = backend.apply_changes([encode_change(change3)])
+        # actor2's insert (lower actorId) goes after actor1's concurrent one
+        assert patch3['diffs']['props']['text'][f'1@{A1}']['edits'] == [
+            {'action': 'insert', 'index': 1, 'elemId': f'3@{A2}',
+             'opId': f'3@{A2}', 'value': {'type': 'value', 'value': 'b'}}]
+        assert patch3['deps'] == sorted([hash_of(change2), hash_of(change3)])
+
+    def test_same_position_order2(self):
+        change1, change2, change3 = self.changes()
+        backend = OpSet()
+        backend.apply_changes([encode_change(change1)])
+        patch3 = backend.apply_changes([encode_change(change3)])
+        assert patch3['diffs']['props']['text'][f'1@{A1}']['edits'] == [
+            {'action': 'insert', 'index': 1, 'elemId': f'3@{A2}',
+             'opId': f'3@{A2}', 'value': {'type': 'value', 'value': 'b'}}]
+        patch2 = backend.apply_changes([encode_change(change2)])
+        assert patch2['diffs']['props']['text'][f'1@{A1}']['edits'] == [
+            {'action': 'insert', 'index': 2, 'elemId': f'3@{A1}',
+             'opId': f'3@{A1}', 'value': {'type': 'value', 'value': 'c'}}]
+
+    def test_both_orders_converge(self):
+        change1, change2, change3 = self.changes()
+        b1, b2 = OpSet(), OpSet()
+        for c in (change1, change2, change3):
+            b1.apply_changes([encode_change(c)])
+        for c in (change1, change3, change2):
+            b2.apply_changes([encode_change(c)])
+        assert b1.get_patch()['diffs'] == b2.get_patch()['diffs']
+        # Document order: a, b, c
+        edits = b1.get_patch()['diffs']['props']['text'][f'1@{A1}']['edits']
+        assert edits == [{'action': 'multi-insert', 'index': 0,
+                          'elemId': f'2@{A1}', 'values': ['a', 'b', 'c']}] or \
+            [e['value']['value'] for e in edits] == ['a', 'b', 'c']
+
+    def test_head_insertions(self):
+        """(ref new_backend_test.js:814-880)"""
+        change1 = {'actor': A1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeText', 'obj': '_root', 'key': 'text', 'insert': False,
+             'pred': []},
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': '_head', 'insert': True,
+             'value': 'd', 'pred': []}]}
+        change2 = {'actor': A1, 'seq': 2, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': '_head', 'insert': True,
+             'value': 'c', 'pred': []}]}
+        change3 = {'actor': A2, 'seq': 1, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': '_head', 'insert': True,
+             'value': 'a', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': f'3@{A2}', 'insert': True,
+             'value': 'b', 'pred': []}]}
+
+        backend1 = OpSet()
+        backend1.apply_changes([encode_change(change1)])
+        patch2 = backend1.apply_changes([encode_change(change2)])
+        assert patch2['diffs']['props']['text'][f'1@{A1}']['edits'] == [
+            {'action': 'insert', 'index': 0, 'elemId': f'3@{A1}',
+             'opId': f'3@{A1}', 'value': {'type': 'value', 'value': 'c'}}]
+        patch3 = backend1.apply_changes([encode_change(change3)])
+        assert patch3['diffs']['props']['text'][f'1@{A1}']['edits'] == [
+            {'action': 'multi-insert', 'index': 0, 'elemId': f'3@{A2}',
+             'values': ['a', 'b']}]
+
+        backend2 = OpSet()
+        backend2.apply_changes([encode_change(change1)])
+        patch3b = backend2.apply_changes([encode_change(change3)])
+        assert patch3b['diffs']['props']['text'][f'1@{A1}']['edits'] == [
+            {'action': 'multi-insert', 'index': 0, 'elemId': f'3@{A2}',
+             'values': ['a', 'b']}]
+        patch2b = backend2.apply_changes([encode_change(change2)])
+        assert patch2b['diffs']['props']['text'][f'1@{A1}']['edits'] == [
+            {'action': 'insert', 'index': 2, 'elemId': f'3@{A1}',
+             'opId': f'3@{A1}', 'value': {'type': 'value', 'value': 'c'}}]
+
+        # Final order on both: a b c d
+        for backend in (backend1, backend2):
+            edits = backend.get_patch()['diffs']['props']['text'][f'1@{A1}']['edits']
+            flat = []
+            for e in edits:
+                if e['action'] == 'multi-insert':
+                    flat.extend(e['values'])
+                else:
+                    flat.append(e['value']['value'])
+            assert flat == ['a', 'b', 'c', 'd']
+
+
+class TestCountersInLists:
+    def test_counter_in_list_element(self):
+        """(ref new_backend_test.js:1196+)"""
+        change1 = {'actor': A1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'counts', 'insert': False,
+             'pred': []},
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': '_head', 'insert': True,
+             'value': 1, 'datatype': 'counter', 'pred': []}]}
+        change2 = {'actor': A1, 'seq': 2, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'inc', 'obj': f'1@{A1}', 'elemId': f'2@{A1}',
+             'value': 2, 'pred': [f'2@{A1}']}]}
+        backend = OpSet()
+        backend.apply_changes([encode_change(change1)])
+        patch2 = backend.apply_changes([encode_change(change2)])
+        assert patch2['diffs']['props']['counts'][f'1@{A1}']['edits'] == [
+            {'action': 'update', 'index': 0, 'opId': f'2@{A1}',
+             'value': {'type': 'value', 'datatype': 'counter', 'value': 3}}]
+        # whole-doc patch shows the accumulated value too
+        edits = backend.get_patch()['diffs']['props']['counts'][f'1@{A1}']['edits']
+        assert edits == [
+            {'action': 'insert', 'index': 0, 'elemId': f'2@{A1}',
+             'opId': f'2@{A1}',
+             'value': {'type': 'value', 'datatype': 'counter', 'value': 3}}]
+
+    def test_concurrent_increments_in_list(self):
+        change1 = {'actor': A1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'counts', 'insert': False,
+             'pred': []},
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': '_head', 'insert': True,
+             'value': 10, 'datatype': 'counter', 'pred': []}]}
+        change2 = {'actor': A1, 'seq': 2, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'inc', 'obj': f'1@{A1}', 'elemId': f'2@{A1}', 'value': 2,
+             'pred': [f'2@{A1}']}]}
+        change3 = {'actor': A2, 'seq': 1, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'inc', 'obj': f'1@{A1}', 'elemId': f'2@{A1}', 'value': 5,
+             'pred': [f'2@{A1}']}]}
+        for order in ((change2, change3), (change3, change2)):
+            backend = OpSet()
+            backend.apply_changes([encode_change(change1)])
+            for c in order:
+                backend.apply_changes([encode_change(c)])
+            edits = backend.get_patch()['diffs']['props']['counts'][f'1@{A1}']['edits']
+            assert edits[0]['value'] == \
+                {'type': 'value', 'datatype': 'counter', 'value': 17}
+
+
+class TestPermutationConvergence:
+    """Fuzz in the spirit of test/fuzz_test.js: causally-concurrent changes
+    applied in every permutation must converge to the same document."""
+
+    def _random_concurrent_changes(self, rng, n_actors=3):
+        actors = [f'{i + 1:02d}' * 4 for i in range(n_actors)]
+        base = {'actor': actors[0], 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+                'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'list', 'insert': False,
+             'pred': []},
+            {'action': 'set', 'obj': f'1@{actors[0]}', 'elemId': '_head',
+             'insert': True, 'value': 'x', 'pred': []},
+            {'action': 'set', 'obj': '_root', 'key': 'shared', 'value': 0,
+             'datatype': 'int', 'pred': []}]}
+        base_hash = hash_of(base)
+        concurrent = []
+        for i, actor in enumerate(actors):
+            ops = []
+            start_op = 4
+            ctr = start_op
+            choice = rng.randrange(4)
+            if choice == 0:
+                ops.append({'action': 'set', 'obj': '_root', 'key': 'shared',
+                            'value': i + 10, 'datatype': 'int',
+                            'pred': [f'3@{actors[0]}']})
+                ctr += 1
+            elif choice == 1:
+                ops.append({'action': 'set', 'obj': f'1@{actors[0]}',
+                            'elemId': '_head', 'insert': True,
+                            'value': f'i{i}', 'pred': []})
+                ctr += 1
+            elif choice == 2:
+                ops.append({'action': 'set', 'obj': f'1@{actors[0]}',
+                            'elemId': f'2@{actors[0]}', 'insert': True,
+                            'value': f't{i}', 'pred': []})
+                ctr += 1
+            else:
+                ops.append({'action': 'set', 'obj': f'1@{actors[0]}',
+                            'elemId': f'2@{actors[0]}',
+                            'value': f'u{i}', 'pred': [f'2@{actors[0]}']})
+                ctr += 1
+            ops.append({'action': 'set', 'obj': '_root', 'key': f'k{i}',
+                        'value': i, 'datatype': 'int', 'pred': []})
+            seq = 2 if actor == actors[0] else 1
+            concurrent.append({'actor': actor, 'seq': seq, 'startOp': start_op,
+                               'time': 0, 'deps': [base_hash], 'ops': ops})
+        return base, concurrent
+
+    def test_all_permutations_converge(self):
+        rng = random.Random(2024)
+        for trial in range(6):
+            base, concurrent = self._random_concurrent_changes(rng)
+            encoded = [encode_change(c) for c in concurrent]
+            reference = None
+            for perm in itertools.permutations(range(len(encoded))):
+                backend = OpSet()
+                backend.apply_changes([encode_change(base)])
+                for i in perm:
+                    backend.apply_changes([encoded[i]])
+                diffs = backend.get_patch()['diffs']
+                if reference is None:
+                    reference = diffs
+                else:
+                    assert diffs == reference, f'trial {trial} perm {perm} diverged'
+
+    def test_batch_vs_incremental_application(self):
+        rng = random.Random(7)
+        base, concurrent = self._random_concurrent_changes(rng)
+        encoded = [encode_change(c) for c in concurrent]
+        b1 = OpSet()
+        b1.apply_changes([encode_change(base)] + encoded)
+        b2 = OpSet()
+        b2.apply_changes([encode_change(base)])
+        for e in encoded:
+            b2.apply_changes([e])
+        assert b1.get_patch()['diffs'] == b2.get_patch()['diffs']
+
+    def test_save_load_convergence(self):
+        rng = random.Random(99)
+        base, concurrent = self._random_concurrent_changes(rng)
+        backend = OpSet()
+        backend.apply_changes(
+            [encode_change(base)] + [encode_change(c) for c in concurrent])
+        loaded = OpSet(backend.save())
+        assert loaded.get_patch()['diffs'] == backend.get_patch()['diffs']
+        assert loaded.heads == backend.heads
+        assert loaded.clock == backend.clock
+
+
+class TestLongTextStress:
+    """Long-text workload (ref new_backend_test.js:2063-2193 scale)."""
+
+    def test_sequential_insertions(self):
+        backend = OpSet()
+        n = 600
+        change1 = {'actor': A1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+                   'ops': [{'action': 'makeText', 'obj': '_root', 'key': 'text',
+                            'insert': False, 'pred': []}]}
+        backend.apply_changes([encode_change(change1)])
+        prev_hash = hash_of(change1)
+        elem = '_head'
+        for i in range(n):
+            change = {'actor': A1, 'seq': i + 2, 'startOp': i + 2, 'time': 0,
+                      'deps': [prev_hash], 'ops': [
+                {'action': 'set', 'obj': f'1@{A1}', 'elemId': elem,
+                 'insert': True, 'value': chr(97 + i % 26), 'pred': []}]}
+            backend.apply_changes([encode_change(change)])
+            prev_hash = hash_of(change)
+            elem = f'{i + 2}@{A1}'
+        edits = backend.get_patch()['diffs']['props']['text'][f'1@{A1}']['edits']
+        assert edits[0]['action'] == 'multi-insert'
+        assert len(edits[0]['values']) == n
+        text = ''.join(edits[0]['values'])
+        assert text == ''.join(chr(97 + i % 26) for i in range(n))
+        # save/load round trip at this size
+        loaded = OpSet(backend.save())
+        assert loaded.get_patch()['diffs'] == backend.get_patch()['diffs']
+
+    def test_interleaved_insert_delete(self):
+        import automerge_tpu as A
+        doc = A.from_({'text': A.Text()}, 'aa' * 4)
+        rng = random.Random(4)
+        expected = []
+        for i in range(120):
+            if expected and rng.random() < 0.3:
+                pos = rng.randrange(len(expected))
+                doc = A.change(doc, lambda d, pos=pos: d['text'].delete_at(pos))
+                expected.pop(pos)
+            else:
+                pos = rng.randrange(len(expected) + 1)
+                ch = chr(97 + i % 26)
+                doc = A.change(doc, lambda d, pos=pos, ch=ch:
+                               d['text'].insert_at(pos, ch))
+                expected.insert(pos, ch)
+            assert str(doc['text']) == ''.join(expected)
+        doc2 = A.load(A.save(doc))
+        assert str(doc2['text']) == ''.join(expected)
