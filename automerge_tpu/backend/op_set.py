@@ -214,19 +214,15 @@ class ObjState:
             yield from block.elems
 
     def find(self, elem_id):
-        block = self.elem_block.get(elem_id)
-        if block is None:
-            return None
-        for elem in block.elems:
-            if elem.elem_id == elem_id:
-                return elem
-        return None
+        entry = self.elem_block.get(elem_id)
+        return entry[1] if entry is not None else None
 
     def visible_index_of(self, elem_id):
         """Number of visible elements strictly before the given element."""
-        target_block = self.elem_block.get(elem_id)
-        if target_block is None:
+        entry = self.elem_block.get(elem_id)
+        if entry is None:
             raise ValueError(f'Reference element not found: {elem_id}')
+        target_block = entry[0]
         count = 0
         for block in self.blocks:
             if block is target_block:
@@ -246,9 +242,10 @@ class ObjState:
         if ref_elem_id == '_head':
             bi, pos, count = 0, 0, 0
         else:
-            block = self.elem_block.get(ref_elem_id)
-            if block is None:
+            entry = self.elem_block.get(ref_elem_id)
+            if entry is None:
                 raise ValueError(f'Reference element not found: {ref_elem_id}')
+            block = entry[0]
             bi = self.blocks.index(block)
             count = sum(b.visible for b in self.blocks[:bi])
             pos = None
@@ -281,7 +278,7 @@ class ObjState:
             break
         block = self.blocks[bi]
         block.elems.insert(pos, elem)
-        self.elem_block[elem.elem_id] = block
+        self.elem_block[elem.elem_id] = (block, elem)
         if elem.visible():
             block.visible += 1
         if len(block.elems) > _BLOCK_SIZE:
@@ -297,13 +294,13 @@ class ObjState:
         block.visible -= right.visible
         self.blocks.insert(bi + 1, right)
         for elem in right.elems:
-            self.elem_block[elem.elem_id] = right
+            self.elem_block[elem.elem_id] = (right, elem)
 
     def refresh_visibility(self, elem, was_visible):
         """Adjust the cached visible count after elem's ops changed."""
         now = elem.recompute_visibility()
         if now != was_visible:
-            block = self.elem_block[elem.elem_id]
+            block = self.elem_block[elem.elem_id][0]
             block.visible += 1 if now else -1
 
 

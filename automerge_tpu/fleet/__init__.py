@@ -16,9 +16,13 @@ the collectives — see automerge_tpu.fleet.sharding.
 from .tensor_doc import FleetState, OpBatch, TOMBSTONE, pack_op_id, unpack_op_id
 from .apply import apply_op_batch, fleet_merge
 from .bloom import build_bloom_filters, probe_bloom_filters, bloom_filter_bytes
+from .sequence import (SeqState, SeqOpBatch, SeqEncoder, apply_seq_batch,
+                       linearize, materialize, visible_text)
 
 __all__ = [
     'FleetState', 'OpBatch', 'TOMBSTONE', 'pack_op_id', 'unpack_op_id',
     'apply_op_batch', 'fleet_merge',
     'build_bloom_filters', 'probe_bloom_filters', 'bloom_filter_bytes',
+    'SeqState', 'SeqOpBatch', 'SeqEncoder', 'apply_seq_batch',
+    'linearize', 'materialize', 'visible_text',
 ]

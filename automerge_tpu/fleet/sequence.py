@@ -1,0 +1,334 @@
+"""Batched RGA sequence engine: list/text CRDTs as device tensors.
+
+This is the tensorized equivalent of the reference's list-insertion path
+(ref backend/new.js:50-192 seekWithinBlock, :145-163 concurrent-insert skip;
+host mirror: automerge_tpu/backend/op_set.py ObjState.insert_rga): a fleet of
+N sequence documents (one Text or list object each) lives as padded [N, S]
+slot tensors plus a linked-list `nxt` pointer array encoding RGA order. Slots
+are allocated in op-arrival order and never move; an insert splices pointers,
+so per-op work is O(S) vector compares (the referent lookup) + an O(skip)
+pointer walk, with NO data movement of the sequence itself — the analogue of
+the reference editing a block in place instead of reshuffling the array.
+
+Application is a `vmap` over docs of a `lax.scan` over each doc's op stream:
+ops within one doc apply in causal order (as the reference's per-change op
+loop does), while the fleet axis is embarrassingly parallel — the SURVEY §7
+"vmap'd masked scan" formulation. Extraction back to sequence order
+(`linearize`) is pointer-doubling list ranking: O(log S) rounds of gathers,
+fully parallel, replacing the reference's visibleCount block walk
+(new.js:225-240).
+
+Packed opIds: (counter << ACTOR_BITS) | actorNum, as in tensor_doc. For the
+integer comparisons here to agree with the host engine's Lamport order
+(counter, actorId-hex-string) — used both for the RGA concurrent-insert skip
+and per-element LWW — actor numbers MUST be assigned in ascending
+lexicographic order of the actor hex ids (the reference's columnar format
+sorts its actor table the same way, ref backend/columnar.js:133-170).
+
+Semantics note: per-element overwrite resolution here is greatest-opId LWW,
+which matches the host engine for causally-ordered edits; concurrent
+set-vs-delete multi-value conflict shapes route through the host OpSet engine
+(same caveat as the map engine, see tensor_doc.py).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .tensor_doc import ACTOR_BITS, pack_op_id
+
+# Op kinds in a SeqOpBatch
+PAD, INSERT, SET, DEL = 0, 1, 2, 3
+
+HEAD_REF = 0  # `ref == 0` means insert at the head ('_head' in the reference)
+
+INT32_MAX = np.int32(2**31 - 1)
+
+
+def _node_indexes(capacity):
+    """Node-id layout for the pointer array.
+
+    0..S-1   real slots
+    S        slot-scratch (masked writes of per-slot arrays land here)
+    S+1      HEAD sentinel (its nxt is the first element)
+    S+2      END sentinel / pointer-scratch (masked pointer writes land here;
+             its outgoing pointer is never followed)
+    """
+    return capacity, capacity + 1, capacity + 2
+
+
+class SeqState:
+    """Pytree of per-doc sequence tensors, [N, S+1] slot arrays + [N, S+3]
+    pointers + [N] allocation cursors."""
+
+    def __init__(self, elem_id, nxt, winner, vis, val, n):
+        self.elem_id = elem_id  # packed elemId per slot (0 = unallocated)
+        self.nxt = nxt          # linked-list next pointers over node ids
+        self.winner = winner    # packed opId of the LWW winner op per element
+        self.vis = vis          # element visible (winner is not a delete)
+        self.val = val          # winner's value (char code / value-table idx)
+        self.n = n              # slots allocated per doc
+
+    @property
+    def capacity(self):
+        return self.elem_id.shape[1] - 1
+
+    @classmethod
+    def empty(cls, n_docs, capacity, xp=np):
+        scratch, head, end = _node_indexes(capacity)
+        slots = (n_docs, capacity + 1)
+        nxt = xp.full((n_docs, capacity + 3), end, dtype=np.int32)
+        return cls(
+            xp.zeros(slots, dtype=np.int32),
+            nxt,
+            xp.zeros(slots, dtype=np.int32),
+            xp.zeros(slots, dtype=bool),
+            xp.zeros(slots, dtype=np.int32),
+            xp.zeros((n_docs,), dtype=np.int32))
+
+    def tree_flatten(self):
+        return ((self.elem_id, self.nxt, self.winner, self.vis, self.val,
+                 self.n), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+class SeqOpBatch:
+    """One batch of sequence ops, parallel columns [N, P].
+
+    - kind   int32: PAD / INSERT / SET / DEL
+    - ref    int32: INSERT → packed elemId to insert after (0 = head);
+                    SET/DEL → packed elemId of the target element
+    - packed int32: the op's own packed opId (INSERT: the new elemId)
+    - value  int32: INSERT/SET payload
+    """
+
+    def __init__(self, kind, ref, packed, value):
+        self.kind = kind
+        self.ref = ref
+        self.packed = packed
+        self.value = value
+
+    def tree_flatten(self):
+        return ((self.kind, self.ref, self.packed, self.value), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def _register_pytrees():
+    from jax import tree_util
+    for klass in (SeqState, SeqOpBatch):
+        try:
+            tree_util.register_pytree_node(
+                klass, lambda obj: obj.tree_flatten(), klass.tree_unflatten)
+        except ValueError:
+            pass
+
+
+_register_pytrees()
+
+
+def _apply_one_doc(carry, op, capacity):
+    """One op against one doc. carry = (elem_id, nxt, winner, vis, val, n)."""
+    elem_id, nxt, winner, vis, val, n = carry
+    kind, ref, packed, value = op
+    scratch, head, end = _node_indexes(capacity)
+
+    is_ins = kind == INSERT
+    is_upd = (kind == SET) | (kind == DEL)
+
+    # Referent / target slot: packed elemIds are unique and non-zero, so an
+    # equality one-hot over the slot axis finds it (elem_id[scratch] stays 0).
+    # A miss (op referencing an elemId not in the doc, e.g. one dropped by a
+    # capacity overflow) must not resolve to an arbitrary slot.
+    hits = elem_id == ref
+    found = jnp.any(hits)
+    match = jnp.argmax(hits).astype(jnp.int32)
+
+    # ---- INSERT: RGA splice -------------------------------------------
+    # Start after the referent (HEAD sentinel for ref==0), then skip any
+    # following elements whose insertion opId is greater than ours — the
+    # concurrent-insert rule (ref new.js:145-163; op_set.insert_rga).
+    r0 = jnp.where(ref == HEAD_REF, jnp.int32(head), match)
+    # Non-insert ops must not walk: an impossible comparison key stalls the
+    # loop immediately.
+    my_key = jnp.where(is_ins, packed, INT32_MAX)
+
+    def skip_cond(state):
+        r, j = state
+        return (j < capacity) & (elem_id[jnp.minimum(j, capacity)] > my_key)
+
+    def skip_body(state):
+        r, j = state
+        return j, nxt[j]
+
+    r, j = lax.while_loop(skip_cond, skip_body, (r0, nxt[r0]))
+
+    # Inserts past capacity or after an unknown referent are dropped
+    # (reported via the per-op applied flag) rather than silently corrupting
+    # state: slot-scratch and the sentinels must never be written by a live
+    # insert, and a missed referent lookup must not splice after slot 0.
+    can_ins = is_ins & (n < capacity) & ((ref == HEAD_REF) | found)
+    slot = jnp.minimum(n, capacity - 1)  # allocation cursor, clamped
+    ins_slot = jnp.where(can_ins, slot, jnp.int32(scratch))
+    ins_ptr_from = jnp.where(can_ins, r, jnp.int32(end))
+    ins_ptr_new = jnp.where(can_ins, slot, jnp.int32(end))
+
+    nxt = nxt.at[ins_ptr_new].set(jnp.where(can_ins, j, nxt[ins_ptr_new]))
+    nxt = nxt.at[ins_ptr_from].set(jnp.where(can_ins, slot, nxt[ins_ptr_from]))
+    # All four masked writes preserve the scratch slot's contents so that
+    # elem_id[scratch] stays 0 — the invariant the one-hot referent match
+    # depends on.
+    elem_id = elem_id.at[ins_slot].set(jnp.where(can_ins, packed,
+                                                 elem_id[ins_slot]))
+    winner = winner.at[ins_slot].set(jnp.where(can_ins, packed,
+                                               winner[ins_slot]))
+    vis = vis.at[ins_slot].set(jnp.where(can_ins, True, vis[ins_slot]))
+    val = val.at[ins_slot].set(jnp.where(can_ins, value, val[ins_slot]))
+    n = n + can_ins.astype(jnp.int32)
+
+    # ---- SET / DEL: per-element LWW ------------------------------------
+    lww = is_upd & found & (packed > winner[match])
+    upd_slot = jnp.where(lww, match, jnp.int32(scratch))
+    winner = winner.at[upd_slot].set(jnp.where(lww, packed, winner[upd_slot]))
+    vis = vis.at[upd_slot].set(jnp.where(lww, kind == SET, vis[upd_slot]))
+    val = val.at[upd_slot].set(jnp.where(lww & (kind == SET), value,
+                                         val[upd_slot]))
+
+    # Dropped ops (over-capacity or unknown-referent inserts, SET/DELs on
+    # unknown targets) report as not-applied so callers can detect loss from
+    # the stats instead of getting silent truncation.
+    applied = jnp.where(is_ins, can_ins, (kind > PAD) & found)
+    return (elem_id, nxt, winner, vis, val, n), applied
+
+
+def _apply_seq_batch_impl(state, ops):
+    capacity = state.elem_id.shape[1] - 1
+
+    def per_doc(elem_id, nxt, winner, vis, val, n, kind, ref, packed, value):
+        carry = (elem_id, nxt, winner, vis, val, n)
+        xs = (kind, ref, packed, value)
+        carry, applied = lax.scan(
+            lambda c, x: _apply_one_doc(c, x, capacity), carry, xs)
+        return carry, jnp.sum(applied, dtype=jnp.int32)
+
+    (elem_id, nxt, winner, vis, val, n), applied = jax.vmap(per_doc)(
+        state.elem_id, state.nxt, state.winner, state.vis, state.val, state.n,
+        ops.kind, ops.ref, ops.packed, ops.value)
+    return SeqState(elem_id, nxt, winner, vis, val, n), jnp.sum(applied)
+
+
+apply_seq_batch = jax.jit(_apply_seq_batch_impl)
+
+
+def _linearize_impl(state):
+    """List-rank every slot: returns (pos [N, S+1], length [N]).
+
+    pos[d, i] = 0-based sequence index of slot i in doc d (allocated slots
+    only; unallocated/scratch values are garbage — mask with slot < n).
+    Pointer doubling: dist[i] = hops from node i to END, accumulated over
+    ceil(log2(nodes)) rounds of jumps. Then pos = dist[HEAD] - dist - 1.
+    """
+    capacity = state.elem_id.shape[1] - 1
+    scratch, head, end = _node_indexes(capacity)
+    nodes = capacity + 3
+
+    def per_doc(nxt):
+        dist = jnp.ones((nodes,), dtype=jnp.int32).at[end].set(0)
+        ptr = nxt.at[end].set(end)
+
+        def round_(i, s):
+            dist, ptr = s
+            return dist + dist[ptr], ptr[ptr]
+
+        steps = int(np.ceil(np.log2(nodes)))
+        dist, ptr = lax.fori_loop(0, steps, round_, (dist, ptr))
+        pos = dist[head] - dist - 1
+        return pos[:capacity + 1]
+
+    pos = jax.vmap(per_doc)(state.nxt)
+    return pos, state.n
+
+
+linearize = jax.jit(_linearize_impl)
+
+
+def _materialize_impl(state):
+    """Return (vals [N, S], vis [N, S], length [N]) in sequence order.
+
+    vals/vis are scattered into order positions; entries at index >= length
+    are zeros. Visible-only extraction (for text strings / patch indexes) is
+    a host-side compress over the vis mask.
+    """
+    capacity = state.elem_id.shape[1] - 1
+    pos, n = _linearize_impl(state)
+
+    def per_doc(pos, vis, val, n):
+        slot_ids = jnp.arange(capacity + 1, dtype=jnp.int32)
+        alloc = slot_ids < n
+        tgt = jnp.where(alloc, jnp.clip(pos, 0, capacity), capacity)
+        out_val = jnp.zeros((capacity + 1,), val.dtype).at[tgt].set(
+            jnp.where(alloc, val, 0))
+        out_vis = jnp.zeros((capacity + 1,), jnp.bool_).at[tgt].set(
+            jnp.where(alloc, vis, False))
+        return out_val[:capacity], out_vis[:capacity]
+
+    vals, vis = jax.vmap(per_doc)(pos, state.vis, state.val, state.n)
+    return vals, vis, state.n
+
+
+materialize = jax.jit(_materialize_impl)
+
+
+def visible_text(state):
+    """Host helper: decode each doc's visible values as a Python string
+    (values interpreted as Unicode code points)."""
+    vals, vis, n = jax.device_get(materialize(state))
+    out = []
+    for d in range(vals.shape[0]):
+        row_vis = vis[d]
+        out.append(''.join(chr(int(c)) for c in vals[d][row_vis]))
+    return out
+
+
+class SeqEncoder:
+    """Host-side helper turning 'ctr@actor' string ops into SeqOpBatch
+    columns for one fleet. Actor numbers are assigned by ascending hex order
+    over a fixed, pre-registered actor set (required for packed-opId
+    comparisons to match host Lamport order)."""
+
+    def __init__(self, actors):
+        self.actor_num = {a: i for i, a in enumerate(sorted(actors))}
+
+    def pack(self, op_id):
+        if op_id in ('_head', None):
+            return HEAD_REF
+        ctr_s, _, actor = op_id.partition('@')
+        return pack_op_id(int(ctr_s), self.actor_num[actor])
+
+    def batch(self, per_doc_ops, pad_to=None):
+        """per_doc_ops: list (per doc) of op dicts
+        {kind: 'insert'|'set'|'del', ref/target: opId str, id: opId str,
+         value: int}. Returns a SeqOpBatch of numpy columns [N, P]."""
+        n_docs = len(per_doc_ops)
+        width = max((len(ops) for ops in per_doc_ops), default=0)
+        if pad_to is not None:
+            width = max(width, pad_to)
+        kind = np.zeros((n_docs, width), dtype=np.int32)
+        ref = np.zeros((n_docs, width), dtype=np.int32)
+        packed = np.zeros((n_docs, width), dtype=np.int32)
+        value = np.zeros((n_docs, width), dtype=np.int32)
+        kinds = {'insert': INSERT, 'set': SET, 'del': DEL}
+        for d, ops in enumerate(per_doc_ops):
+            for i, op in enumerate(ops):
+                kind[d, i] = kinds[op['kind']]
+                ref[d, i] = self.pack(op.get('ref') or op.get('target'))
+                packed[d, i] = self.pack(op['id'])
+                value[d, i] = op.get('value', 0)
+        return SeqOpBatch(kind, ref, packed, value)

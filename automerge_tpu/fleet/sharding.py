@@ -52,6 +52,47 @@ def shard_ops(ops, mesh):
                          ops)
 
 
+def seq_sharding(mesh):
+    """NamedShardings for SeqState / SeqOpBatch: data-parallel over the docs
+    axis only — the per-doc slot axis stays local (the RGA pointer walk is a
+    per-document scan; sharding it would put pointer chasing on ICI)."""
+    row = NamedSharding(mesh, P('docs', None))
+    vec = NamedSharding(mesh, P('docs'))
+    return row, vec
+
+
+def shard_seq(state, mesh):
+    from .sequence import SeqState
+    row, vec = seq_sharding(mesh)
+    return SeqState(
+        jax.device_put(state.elem_id, row), jax.device_put(state.nxt, row),
+        jax.device_put(state.winner, row), jax.device_put(state.vis, row),
+        jax.device_put(state.val, row), jax.device_put(state.n, vec))
+
+
+def shard_seq_ops(ops, mesh):
+    row, _ = seq_sharding(mesh)
+    import jax.tree_util as tree
+    return tree.tree_map(lambda x: jax.device_put(x, row), ops)
+
+
+def sharded_seq_apply(mesh):
+    """Jitted sequence-fleet step, data-parallel over docs."""
+    from .sequence import SeqState, _apply_seq_batch_impl
+    row, vec = seq_sharding(mesh)
+
+    @jax.jit
+    def step(state, ops):
+        new_state, stats = _apply_seq_batch_impl(state, ops)
+        new_state = SeqState(
+            *(jax.lax.with_sharding_constraint(x, row)
+              for x in (new_state.elem_id, new_state.nxt, new_state.winner,
+                        new_state.vis, new_state.val)),
+            jax.lax.with_sharding_constraint(new_state.n, vec))
+        return new_state, stats
+    return step
+
+
 def sharded_apply(mesh):
     """A jitted fleet step with explicit output shardings: data-parallel over
     docs, key grid sharded over the second mesh axis. The scatter by key_id

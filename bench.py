@@ -148,6 +148,50 @@ def bench_pipeline(n_docs, n_keys, changes_per_doc, seed=0):
     return (n_docs * changes_per_doc) / elapsed, elapsed
 
 
+def bench_text(n_docs, trace_len, n_actors=3, seed=0):
+    """Config 2 (BASELINE.md): batched text editing traces through the device
+    sequence engine — n_docs docs, each applying a trace_len-op multi-actor
+    insert/delete trace, as vmap'd RGA scans in one dispatch per batch."""
+    import jax
+    from automerge_tpu.fleet.sequence import (
+        DEL, INSERT, SeqOpBatch, SeqState, apply_seq_batch)
+    from automerge_tpu.fleet.tensor_doc import ACTOR_BITS
+    rng = np.random.default_rng(seed)
+
+    # Randomized trace as packed columns [N, P]: ~80% inserts (after a random
+    # earlier insert; head for the first), ~20% deletes of a random earlier
+    # insert. The insert/delete column pattern is shared across docs so every
+    # ref targets a real elemId; referents and actors vary per doc.
+    is_del = rng.random(trace_len) < 0.2
+    is_del[0] = False
+    kind = np.where(is_del, DEL, INSERT).astype(np.int32)
+    kind = np.broadcast_to(kind, (n_docs, trace_len)).copy()
+    value = rng.integers(97, 123, (n_docs, trace_len), dtype=np.int32)
+    actor = rng.integers(0, n_actors, (n_docs, trace_len), dtype=np.int32)
+    ctr = 2 + np.arange(trace_len, dtype=np.int32)
+    packed = ((ctr[None, :] << ACTOR_BITS) | actor).astype(np.int32)
+    ref = np.zeros((n_docs, trace_len), dtype=np.int32)
+    insert_cols = np.flatnonzero(~is_del)
+    rows = np.arange(n_docs)
+    for i in range(1, trace_len):
+        prior = insert_cols[insert_cols < i]
+        choice = prior[rng.integers(0, len(prior), n_docs)]
+        ref[:, i] = packed[rows, choice]
+    batch = SeqOpBatch(kind, ref, packed, value)
+
+    state = SeqState.empty(n_docs, trace_len + 1)
+    batch = jax.device_put(batch)
+    state = jax.tree_util.tree_map(jax.device_put, state)
+    warm, _ = apply_seq_batch(state, batch)
+    jax.block_until_ready(warm.nxt)
+
+    start = time.perf_counter()
+    out, _ = apply_seq_batch(state, batch)
+    jax.block_until_ready(out.nxt)
+    elapsed = time.perf_counter() - start
+    return (n_docs * trace_len) / elapsed, elapsed
+
+
 def main():
     n_docs = int(os.environ.get('BENCH_DOCS', 10000))
     n_keys = int(os.environ.get('BENCH_KEYS', 1000))
@@ -164,8 +208,13 @@ def main():
     # Full-pipeline (wire decode included) on a medium fleet, for the record
     pipe_rate, _ = bench_pipeline(int(os.environ.get('BENCH_PIPE_DOCS', 500)),
                                   n_keys, 20)
+    # Config 2: batched text-trace editing through the device sequence engine
+    text_rate, _ = bench_text(int(os.environ.get('BENCH_TEXT_DOCS', 2000)),
+                              int(os.environ.get('BENCH_TEXT_LEN', 512)))
     print(f'# pipeline (wire->device incl. native decode): '
           f'{pipe_rate:.0f} changes/s', file=sys.stderr)
+    print(f'# sequence engine (text traces): {text_rate:.0f} ops/s',
+          file=sys.stderr)
     print(f'# host reference engine: {host_rate:.0f} changes/s', file=sys.stderr)
 
     result = {

@@ -1,0 +1,259 @@
+"""Device sequence-engine tests: RGA ordering unit cases mirroring reference
+test/new_backend_test.js:725-880 (same-position and head concurrent inserts),
+plus differential fuzzing against the full host engine (public API with
+multi-actor Text editing and merge) — the wasm.js-style cross-implementation
+harness, with the host OpSet as the oracle."""
+
+import random
+
+import numpy as np
+import pytest
+
+import automerge_tpu as A
+from automerge_tpu.columnar import decode_change
+from automerge_tpu.fleet.sequence import (
+    DEL, INSERT, PAD, SET, SeqEncoder, SeqOpBatch, SeqState,
+    apply_seq_batch, linearize, materialize, visible_text)
+
+A1, A2, A3 = '01234567', '89abcdef', 'fedcba98'
+
+
+def run_ops(per_doc_ops, actors, capacity=64):
+    enc = SeqEncoder(actors)
+    batch = enc.batch(per_doc_ops)
+    state = SeqState.empty(len(per_doc_ops), capacity)
+    state, applied = apply_seq_batch(state, batch)
+    return state
+
+
+def ins(ref, op_id, ch):
+    return {'kind': 'insert', 'ref': ref, 'id': op_id, 'value': ord(ch)}
+
+
+class TestRGAOrdering:
+    def test_typewriter(self):
+        ops = [ins('_head', f'2@{A1}', 'h'), ins(f'2@{A1}', f'3@{A1}', 'i')]
+        state = run_ops([ops], [A1])
+        assert visible_text(state) == ['hi']
+
+    def test_same_position_concurrent(self):
+        """Concurrent inserts after the same elem order descending by opId
+        (ref new.js:145-163): 'a' then concurrent 'c'(3@A1) and 'b'(3@A2)
+        after it; A2 > A1 so document order is a, b, c... wait — descending
+        means greater opId first: 3@A2 ('b') > 3@A1 ('c')?  No: the host
+        engine (op_set.insert_rga) skips elems with *greater* ids, so the
+        final order sorts concurrent siblings descending; 3@{A2} has greater
+        actor so 'b' lands before 'c'?  The reference test asserts a,b,c with
+        b=3@A2 inserted at index 1 after c=3@A1 was placed — i.e. 3@A2 wins
+        the earlier position.  Assert equality with the host engine instead
+        of hand-deriving."""
+        ops = [ins('_head', f'2@{A1}', 'a'),
+               ins(f'2@{A1}', f'3@{A1}', 'c'),
+               ins(f'2@{A1}', f'3@{A2}', 'b')]
+        state = run_ops([ops], [A1, A2])
+        # Host oracle on identical ops
+        assert visible_text(state) == [host_text(ops, [A1, A2])]
+
+    def test_head_concurrent(self):
+        ops = [ins('_head', f'2@{A1}', 'd'),
+               ins('_head', f'3@{A1}', 'c'),
+               ins('_head', f'3@{A2}', 'a'),
+               ins(f'3@{A2}', f'4@{A2}', 'b')]
+        state = run_ops([ops], [A1, A2])
+        assert visible_text(state) == [host_text(ops, [A1, A2])]
+
+    def test_delete(self):
+        ops = [ins('_head', f'2@{A1}', 'h'),
+               ins(f'2@{A1}', f'3@{A1}', 'x'),
+               ins(f'3@{A1}', f'4@{A1}', 'i'),
+               {'kind': 'del', 'target': f'3@{A1}', 'id': f'5@{A1}'}]
+        state = run_ops([ops], [A1])
+        assert visible_text(state) == ['hi']
+
+    def test_set_updates_value(self):
+        ops = [ins('_head', f'2@{A1}', 'a'),
+               ins(f'2@{A1}', f'3@{A1}', 'b'),
+               {'kind': 'set', 'target': f'3@{A1}', 'id': f'4@{A1}',
+                'value': ord('B')}]
+        state = run_ops([ops], [A1])
+        assert visible_text(state) == ['aB']
+
+    def test_insert_after_deleted_elem(self):
+        ops = [ins('_head', f'2@{A1}', 'a'),
+               {'kind': 'del', 'target': f'2@{A1}', 'id': f'3@{A1}'},
+               ins(f'2@{A1}', f'4@{A1}', 'b')]
+        state = run_ops([ops], [A1])
+        assert visible_text(state) == ['b']
+
+    def test_multiple_docs_independent(self):
+        doc0 = [ins('_head', f'2@{A1}', 'x')]
+        doc1 = [ins('_head', f'2@{A1}', 'a'), ins(f'2@{A1}', f'3@{A1}', 'b'),
+                ins(f'3@{A1}', f'4@{A1}', 'c')]
+        doc2 = []
+        state = run_ops([doc0, doc1, doc2], [A1])
+        assert visible_text(state) == ['x', 'abc', '']
+
+    def test_incremental_batches(self):
+        """State carries correctly across separate apply_seq_batch calls."""
+        enc = SeqEncoder([A1, A2])
+        state = SeqState.empty(1, 64)
+        b1 = enc.batch([[ins('_head', f'2@{A1}', 'a'),
+                         ins(f'2@{A1}', f'3@{A1}', 'c')]])
+        state, _ = apply_seq_batch(state, b1)
+        b2 = enc.batch([[ins(f'2@{A1}', f'3@{A2}', 'b')]])
+        state, _ = apply_seq_batch(state, b2)
+        ops = [ins('_head', f'2@{A1}', 'a'), ins(f'2@{A1}', f'3@{A1}', 'c'),
+               ins(f'2@{A1}', f'3@{A2}', 'b')]
+        assert visible_text(state) == [host_text(ops, [A1, A2])]
+
+    def test_capacity_overflow_drops_and_reports(self):
+        """Inserts past capacity are dropped (not silently corrupting), and
+        the applied-count stat exposes the overflow."""
+        ops = [ins('_head' if i == 0 else f'{i + 1}@{A1}', f'{i + 2}@{A1}',
+                   chr(ord('a') + i)) for i in range(6)]
+        enc = SeqEncoder([A1])
+        state = SeqState.empty(1, 4)
+        state, applied = apply_seq_batch(state, enc.batch([ops]))
+        assert int(applied) == 4  # two inserts dropped
+        assert visible_text(state) == ['abcd']
+
+    def test_unknown_target_is_dropped(self):
+        """Ops referencing an elemId absent from the doc (e.g. one dropped by
+        overflow) are dropped and reported, not resolved to slot 0."""
+        ops = [ins('_head', f'2@{A1}', 'a'),
+               {'kind': 'del', 'target': f'99@{A1}', 'id': f'3@{A1}'},
+               ins(f'98@{A1}', f'4@{A1}', 'z')]
+        enc = SeqEncoder([A1])
+        state = SeqState.empty(1, 8)
+        state, applied = apply_seq_batch(state, enc.batch([ops]))
+        assert int(applied) == 1
+        assert visible_text(state) == ['a']
+
+    def test_linearize_positions(self):
+        ops = [ins('_head', f'2@{A1}', 'a'), ins(f'2@{A1}', f'3@{A1}', 'b')]
+        state = run_ops([ops], [A1])
+        pos, n = linearize(state)
+        pos, n = np.asarray(pos), np.asarray(n)
+        assert n[0] == 2
+        assert pos[0, 0] == 0 and pos[0, 1] == 1
+
+
+def host_text(seq_ops, actors, key='text'):
+    """Oracle: run the same elemId-level ops through the host OpSet engine,
+    one single-op change per op (deps = current heads, so any stream order
+    that respects per-elem causality is a valid causal order)."""
+    from automerge_tpu.backend.op_set import OpSet
+    from automerge_tpu.columnar import encode_change
+    backend = OpSet()
+    make = {'actor': actors[0], 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+            'ops': [{'action': 'makeText', 'obj': '_root', 'key': key,
+                     'insert': False, 'pred': []}]}
+    obj = f'1@{actors[0]}'
+    backend.apply_changes([encode_change(make)])
+    seqs = {a: (2 if a == actors[0] else 1) for a in actors}
+    for op in seq_ops:
+        ctr_s, _, actor = op['id'].partition('@')
+        if op['kind'] == 'insert':
+            o = {'action': 'set', 'obj': obj, 'elemId': op['ref'],
+                 'insert': True, 'value': chr(op['value']), 'pred': []}
+        elif op['kind'] == 'set':
+            o = {'action': 'set', 'obj': obj, 'elemId': op['target'],
+                 'insert': False, 'value': chr(op['value']),
+                 'pred': [op['target']]}
+        else:
+            o = {'action': 'del', 'obj': obj, 'elemId': op['target'],
+                 'insert': False, 'pred': [op['target']]}
+        change = {'actor': actor, 'seq': seqs[actor], 'startOp': int(ctr_s),
+                  'time': 0, 'deps': list(backend.heads), 'ops': [o]}
+        seqs[actor] += 1
+        backend.apply_changes([encode_change(change)])
+    return patch_text(backend.get_patch(), key)
+
+
+def patch_text(patch, key='text'):
+    """Fold a whole-document patch's text edits into a string."""
+    props = patch['diffs'].get('props', {})
+    if key not in props or not props[key]:
+        return ''
+    obj_patch = next(iter(props[key].values()))
+    chars = []
+    for edit in obj_patch.get('edits', []):
+        if edit['action'] == 'insert':
+            chars.insert(edit['index'], edit['value']['value'])
+        elif edit['action'] == 'multi-insert':
+            for i, v in enumerate(edit['values']):
+                chars.insert(edit['index'] + i, v)
+        elif edit['action'] == 'update':
+            chars[edit['index']] = edit['value']['value']
+        elif edit['action'] == 'remove':
+            del chars[edit['index']:edit['index'] + edit['count']]
+    return ''.join(str(c) for c in chars)
+
+
+class TestDifferentialFuzz:
+    """Multi-actor Text editing through the public API as oracle; the same
+    ops (recovered from the merged doc's change log) through the device
+    sequence engine (wasm.js-pattern differential harness)."""
+
+    def _device_ops_from_doc(self, doc):
+        """Decode the merged doc's changes back to elemId-level seq ops."""
+        changes = A.get_all_changes(doc)
+        text_obj = None
+        seq_ops = []
+        actors = set()
+        for buf in changes:
+            change = decode_change(buf)
+            actors.add(change['actor'])
+            for idx, op in enumerate(change['ops']):
+                if op['action'] == 'makeText' and op.get('obj') == '_root':
+                    # the single text object in these fuzz docs
+                    text_obj = f"{change['startOp'] + idx}@{change['actor']}"
+                    continue
+                if text_obj is None or op.get('obj') != text_obj:
+                    continue
+                op_id = f"{change['startOp'] + idx}@{change['actor']}"
+                if op['action'] == 'set' and op.get('insert'):
+                    seq_ops.append({'kind': 'insert', 'ref': op['elemId'],
+                                    'id': op_id, 'value': ord(op['value'])})
+                elif op['action'] == 'set':
+                    seq_ops.append({'kind': 'set', 'target': op['elemId'],
+                                    'id': op_id, 'value': ord(op['value'])})
+                elif op['action'] == 'del':
+                    seq_ops.append({'kind': 'del', 'target': op['elemId'],
+                                    'id': op_id})
+        return seq_ops, actors
+
+    @pytest.mark.parametrize('seed', [0, 1, 2])
+    def test_random_trace_matches_public_api(self, seed):
+        rng = random.Random(seed)
+        actors = [A1, A2, A3]
+        base = A.from_({'text': A.Text()}, actors[0])
+        docs = [base] + [A.merge(A.init(a), base) for a in actors[1:]]
+        alphabet = 'abcdefghijklmnopqrstuvwxyz'
+
+        for round_ in range(6):
+            for i in range(len(docs)):
+                for _ in range(rng.randrange(0, 4)):
+                    def edit(d, rng=rng):
+                        t = d['text']
+                        if len(t) and rng.random() < 0.3:
+                            t.delete_at(rng.randrange(len(t)))
+                        else:
+                            t.insert_at(rng.randrange(len(t) + 1),
+                                        rng.choice(alphabet))
+                    docs[i] = A.change(docs[i], edit)
+            # random pairwise merge
+            i, j = rng.sample(range(len(docs)), 2)
+            docs[i] = A.merge(docs[i], docs[j])
+
+        final = docs[0]
+        for d in docs[1:]:
+            final = A.merge(final, d)
+        expected = str(final['text'])
+
+        seq_ops, seen_actors = self._device_ops_from_doc(final)
+        enc = SeqEncoder(seen_actors)
+        batch = enc.batch([seq_ops])
+        state = SeqState.empty(1, max(64, len(seq_ops) + 1))
+        state, _ = apply_seq_batch(state, batch)
+        assert visible_text(state) == [expected]
