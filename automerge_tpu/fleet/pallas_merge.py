@@ -1,0 +1,131 @@
+"""Pallas TPU kernel: fused fleet LWW merge.
+
+The jnp path (fleet/apply.py) lowers to three scatters + one gather over the
+HBM-resident [docs, keys] grids (scatter-max winners, scatter values,
+scatter-add counters). This kernel replaces scatter with the TPU-native
+formulation: tile the key grid into VMEM blocks and turn each op into a
+dense one-hot contribution over its key tile — max-reduced for LWW winners,
+sum-reduced for counter accumulation — so the whole merge is one pass of
+VPU-friendly compares/selects with NO gather/scatter at all, and winners,
+values, and counters update in a single fused kernel (one HBM read + write
+per state tile instead of three scatter round-trips).
+
+Semantics are identical to fleet.apply.apply_op_batch (differentially tested
+in tests/test_pallas.py): this is the merge loop of ref backend/new.js
+:1052-1290 (mergeDocChangeOps) vectorized over a doc fleet, per SURVEY §7
+stage 3.
+
+Grid: (doc_tiles, key_tiles). Ops columns [DN, P] ride along the doc axis;
+state tiles [DN, DK] are updated in place via input_output_aliases. Padded /
+invalid op lanes are masked out by `valid` — no scratch column needed (the
+dense formulation has no out-of-range scatter lanes to redirect).
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .tensor_doc import FleetState
+
+DOC_TILE = 32
+KEY_TILE = 128
+
+
+def _merge_kernel(key_ref, packed_ref, value_ref, is_set_ref, is_inc_ref,
+                  valid_ref, winners_in, values_in, counters_in,
+                  winners_out, values_out, counters_out):
+    j = pl.program_id(1)
+    k_base = j * KEY_TILE
+    dn, p = key_ref.shape
+
+    # Dense one-hot over the key tile, [DN, P, DK]: Mosaic cannot lower
+    # per-op dynamic lane slices, so the op axis is materialized and reduced
+    # instead — pure elementwise + reductions, no gather/scatter.
+    k_ids = jax.lax.broadcasted_iota(jnp.int32, (dn, p, KEY_TILE), 2) + k_base
+    in_tile = key_ref[:][:, :, None] == k_ids
+    # Masks arrive as int32 (Mosaic only supports minor-dim insertion for
+    # 32-bit types, so 8-bit bools can't be broadcast to the 3D shape)
+    valid3 = valid_ref[:][:, :, None] != 0
+    set3 = in_tile & (is_set_ref[:][:, :, None] != 0) & valid3
+    packed3 = packed_ref[:][:, :, None]
+    value3 = value_ref[:][:, :, None]
+
+    winners = jnp.maximum(
+        winners_in[:], jnp.max(jnp.where(set3, packed3, 0), axis=1))
+
+    inc3 = in_tile & (is_inc_ref[:][:, :, None] != 0) & valid3
+    counters = counters_in[:] + jnp.sum(jnp.where(inc3, value3, 0), axis=1)
+
+    # The op whose packed opId equals the final winner (unique per
+    # (doc, key) — packed ids are fleet-unique) contributes its value.
+    won = set3 & (packed3 == winners[:, None, :])
+    values = jnp.where(jnp.any(won, axis=1),
+                       jnp.sum(jnp.where(won, value3, 0), axis=1),
+                       values_in[:])
+
+    winners_out[:] = winners
+    values_out[:] = values
+    counters_out[:] = counters
+
+
+def _pad_to(x, axis, multiple):
+    size = x.shape[axis]
+    rem = (-size) % multiple
+    if rem == 0:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, rem)
+    return jnp.pad(x, pad)
+
+
+@functools.partial(jax.jit, static_argnames=('interpret',))
+def pallas_apply_op_batch(state, ops, interpret=False):
+    """Drop-in fused-kernel equivalent of fleet.apply.apply_op_batch."""
+    n_docs, n_slots = state.winners.shape
+
+    def prep_state(x):
+        return _pad_to(_pad_to(x, 0, DOC_TILE), 1, KEY_TILE)
+
+    def prep_ops(x, dtype=None):
+        x = _pad_to(jnp.asarray(x), 0, DOC_TILE)
+        return x if dtype is None else x.astype(dtype)
+
+    winners = prep_state(state.winners)
+    values = prep_state(state.values)
+    counters = prep_state(state.counters)
+    nd, nk = winners.shape
+    p = ops.key_id.shape[1]
+
+    key_id = prep_ops(ops.key_id)
+    packed = prep_ops(ops.packed)
+    value = prep_ops(ops.value)
+    is_set = prep_ops(ops.is_set, jnp.int32)
+    is_inc = prep_ops(ops.is_inc, jnp.int32)
+    # Padded doc rows carry valid=0, masking them out entirely
+    valid = prep_ops(ops.valid, jnp.int32)
+
+    grid = (nd // DOC_TILE, nk // KEY_TILE)
+    ops_spec = pl.BlockSpec((DOC_TILE, p), lambda i, j: (i, 0))
+    state_spec = pl.BlockSpec((DOC_TILE, KEY_TILE), lambda i, j: (i, j))
+
+    out_w, out_v, out_c = pl.pallas_call(
+        _merge_kernel,
+        grid=grid,
+        in_specs=[ops_spec] * 6 + [state_spec] * 3,
+        out_specs=[state_spec] * 3,
+        out_shape=[jax.ShapeDtypeStruct((nd, nk), jnp.int32)] * 3,
+        input_output_aliases={6: 0, 7: 1, 8: 2},
+        interpret=interpret,
+    )(key_id, packed, value, is_set, is_inc, valid,
+      winners, values, counters)
+
+    new_state = FleetState(out_w[:n_docs, :n_slots],
+                           out_v[:n_docs, :n_slots],
+                           out_c[:n_docs, :n_slots])
+    stats = jnp.sum(ops.valid, dtype=jnp.int32)
+    return new_state, stats

@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .tensor_doc import ACTOR_BITS, pack_op_id
+from .tensor_doc import ACTOR_BITS, pack_op_id, register_pytrees
 
 # Op kinds in a SeqOpBatch
 PAD, INSERT, SET, DEL = 0, 1, 2, 3
@@ -121,17 +121,7 @@ class SeqOpBatch:
         return cls(*children)
 
 
-def _register_pytrees():
-    from jax import tree_util
-    for klass in (SeqState, SeqOpBatch):
-        try:
-            tree_util.register_pytree_node(
-                klass, lambda obj: obj.tree_flatten(), klass.tree_unflatten)
-        except ValueError:
-            pass
-
-
-_register_pytrees()
+register_pytrees(SeqState, SeqOpBatch)
 
 
 def _apply_one_doc(carry, op, capacity):
@@ -194,7 +184,9 @@ def _apply_one_doc(carry, op, capacity):
     n = n + can_ins.astype(jnp.int32)
 
     # ---- SET / DEL: per-element LWW ------------------------------------
-    lww = is_upd & found & (packed > winner[match])
+    # ref == HEAD_REF (0) marks a malformed update (no target): it would
+    # "match" every unallocated slot's zero elem_id, so reject it explicitly.
+    lww = is_upd & found & (ref != HEAD_REF) & (packed > winner[match])
     upd_slot = jnp.where(lww, match, jnp.int32(scratch))
     winner = winner.at[upd_slot].set(jnp.where(lww, packed, winner[upd_slot]))
     vis = vis.at[upd_slot].set(jnp.where(lww, kind == SET, vis[upd_slot]))
@@ -204,7 +196,8 @@ def _apply_one_doc(carry, op, capacity):
     # Dropped ops (over-capacity or unknown-referent inserts, SET/DELs on
     # unknown targets) report as not-applied so callers can detect loss from
     # the stats instead of getting silent truncation.
-    applied = jnp.where(is_ins, can_ins, (kind > PAD) & found)
+    applied = jnp.where(is_ins, can_ins,
+                        (kind > PAD) & found & (ref != HEAD_REF))
     return (elem_id, nxt, winner, vis, val, n), applied
 
 

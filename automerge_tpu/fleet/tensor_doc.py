@@ -95,19 +95,21 @@ class OpBatch:
         return cls(*children)
 
 
-def _register_pytrees():
+def register_pytrees(*classes):
+    """Register container classes (with tree_flatten/tree_unflatten) as JAX
+    pytree nodes; idempotent."""
     try:
         from jax import tree_util
-        for klass in (FleetState, OpBatch):
-            try:
-                tree_util.register_pytree_node(
-                    klass,
-                    lambda obj: obj.tree_flatten(),
-                    klass.tree_unflatten)
-            except ValueError:
-                pass  # already registered
     except ImportError:
-        pass
+        return
+    for klass in classes:
+        try:
+            tree_util.register_pytree_node(
+                klass,
+                lambda obj: obj.tree_flatten(),
+                klass.tree_unflatten)
+        except ValueError:
+            pass  # already registered
 
 
-_register_pytrees()
+register_pytrees(FleetState, OpBatch)

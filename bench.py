@@ -47,6 +47,9 @@ def build_workload(n_docs, n_keys, n_actors, rounds, ops_per_round, seed=0):
 def bench_fleet(n_docs, n_keys, rounds, ops_per_round):
     import jax
     from automerge_tpu.fleet import FleetState, apply_op_batch
+    if os.environ.get('BENCH_PALLAS'):
+        from automerge_tpu.fleet.pallas_merge import pallas_apply_op_batch
+        apply_op_batch = pallas_apply_op_batch
 
     batches = build_workload(n_docs, n_keys, 2, rounds, ops_per_round)
     state = FleetState.empty(n_docs, n_keys)

@@ -37,16 +37,9 @@ class TestRGAOrdering:
         assert visible_text(state) == ['hi']
 
     def test_same_position_concurrent(self):
-        """Concurrent inserts after the same elem order descending by opId
-        (ref new.js:145-163): 'a' then concurrent 'c'(3@A1) and 'b'(3@A2)
-        after it; A2 > A1 so document order is a, b, c... wait — descending
-        means greater opId first: 3@A2 ('b') > 3@A1 ('c')?  No: the host
-        engine (op_set.insert_rga) skips elems with *greater* ids, so the
-        final order sorts concurrent siblings descending; 3@{A2} has greater
-        actor so 'b' lands before 'c'?  The reference test asserts a,b,c with
-        b=3@A2 inserted at index 1 after c=3@A1 was placed — i.e. 3@A2 wins
-        the earlier position.  Assert equality with the host engine instead
-        of hand-deriving."""
+        """Concurrent siblings at the same insertion point order descending
+        by opId (ref new.js:145-163); asserted against the host oracle
+        rather than hand-derived."""
         ops = [ins('_head', f'2@{A1}', 'a'),
                ins(f'2@{A1}', f'3@{A1}', 'c'),
                ins(f'2@{A1}', f'3@{A2}', 'b')]
