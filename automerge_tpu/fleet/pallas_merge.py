@@ -15,10 +15,15 @@ in tests/test_pallas.py): this is the merge loop of ref backend/new.js
 :1052-1290 (mergeDocChangeOps) vectorized over a doc fleet, per SURVEY §7
 stage 3.
 
-Grid: (doc_tiles, key_tiles). Ops columns [DN, P] ride along the doc axis;
-state tiles [DN, DK] are updated in place via input_output_aliases. Padded /
-invalid op lanes are masked out by `valid` — no scratch column needed (the
-dense formulation has no out-of-range scatter lanes to redirect).
+Grid: (doc_tiles, key_tiles, op_chunks). The op axis is tiled as the
+innermost (sequential) grid dimension so VMEM stays bounded at
+[DOC_TILE, OP_CHUNK, KEY_TILE] temporaries no matter how many ops per doc a
+batch carries; the state tile [DOC_TILE, KEY_TILE] persists in VMEM across
+op chunks (TPU revisiting semantics) and accumulates. Winner values carry as
+(winner, value) pairs combined by take-if-greater, which is associative
+across chunks and idempotent under duplicate op delivery (redundant sync
+re-sends select the same value instead of summing it twice). Padded /
+invalid op lanes are masked out by `valid`.
 """
 
 import functools
@@ -34,18 +39,31 @@ from .tensor_doc import FleetState
 
 DOC_TILE = 32
 KEY_TILE = 128
+OP_CHUNK = 128
+
+_INT32_MIN = np.iinfo(np.int32).min
 
 
 def _merge_kernel(key_ref, packed_ref, value_ref, is_set_ref, is_inc_ref,
                   valid_ref, winners_in, values_in, counters_in,
                   winners_out, values_out, counters_out):
     j = pl.program_id(1)
+    c = pl.program_id(2)
     k_base = j * KEY_TILE
-    dn, p = key_ref.shape
+    dn, p = key_ref.shape  # p == OP_CHUNK
 
-    # Dense one-hot over the key tile, [DN, P, DK]: Mosaic cannot lower
-    # per-op dynamic lane slices, so the op axis is materialized and reduced
-    # instead — pure elementwise + reductions, no gather/scatter.
+    # First op chunk for this state tile: seed the accumulators from the
+    # input state (out blocks persist in VMEM across the sequential op-chunk
+    # grid axis, so later chunks read back their own partial results)
+    @pl.when(c == 0)
+    def _seed():
+        winners_out[:] = winners_in[:]
+        values_out[:] = values_in[:]
+        counters_out[:] = counters_in[:]
+
+    # Dense one-hot over the key tile, [DN, OP_CHUNK, KEY_TILE]: Mosaic
+    # cannot lower per-op dynamic lane slices, so the op axis is materialized
+    # and reduced instead — pure elementwise + reductions, no gather/scatter.
     k_ids = jax.lax.broadcasted_iota(jnp.int32, (dn, p, KEY_TILE), 2) + k_base
     in_tile = key_ref[:][:, :, None] == k_ids
     # Masks arrive as int32 (Mosaic only supports minor-dim insertion for
@@ -55,22 +73,22 @@ def _merge_kernel(key_ref, packed_ref, value_ref, is_set_ref, is_inc_ref,
     packed3 = packed_ref[:][:, :, None]
     value3 = value_ref[:][:, :, None]
 
-    winners = jnp.maximum(
-        winners_in[:], jnp.max(jnp.where(set3, packed3, 0), axis=1))
+    # Chunk-local LWW winner per key, and the value of the lane that won it.
+    # Packed opIds of real set ops are > 0, so 0 means "no set in this chunk";
+    # duplicate packed ids (redundant delivery) carry equal values, which the
+    # max-reduction selects once instead of summing.
+    chunk_w = jnp.max(jnp.where(set3, packed3, 0), axis=1)
+    won = set3 & (packed3 == chunk_w[:, None, :])
+    chunk_v = jnp.max(jnp.where(won, value3, _INT32_MIN), axis=1)
+
+    winners = winners_out[:]
+    take = chunk_w > winners
+    winners_out[:] = jnp.maximum(winners, chunk_w)
+    values_out[:] = jnp.where(take, chunk_v, values_out[:])
 
     inc3 = in_tile & (is_inc_ref[:][:, :, None] != 0) & valid3
-    counters = counters_in[:] + jnp.sum(jnp.where(inc3, value3, 0), axis=1)
-
-    # The op whose packed opId equals the final winner (unique per
-    # (doc, key) — packed ids are fleet-unique) contributes its value.
-    won = set3 & (packed3 == winners[:, None, :])
-    values = jnp.where(jnp.any(won, axis=1),
-                       jnp.sum(jnp.where(won, value3, 0), axis=1),
-                       values_in[:])
-
-    winners_out[:] = winners
-    values_out[:] = values
-    counters_out[:] = counters
+    counters_out[:] = counters_out[:] + \
+        jnp.sum(jnp.where(inc3, value3, 0), axis=1)
 
 
 def _pad_to(x, axis, multiple):
@@ -92,26 +110,26 @@ def pallas_apply_op_batch(state, ops, interpret=False):
         return _pad_to(_pad_to(x, 0, DOC_TILE), 1, KEY_TILE)
 
     def prep_ops(x, dtype=None):
-        x = _pad_to(jnp.asarray(x), 0, DOC_TILE)
+        x = _pad_to(_pad_to(jnp.asarray(x), 0, DOC_TILE), 1, OP_CHUNK)
         return x if dtype is None else x.astype(dtype)
 
     winners = prep_state(state.winners)
     values = prep_state(state.values)
     counters = prep_state(state.counters)
     nd, nk = winners.shape
-    p = ops.key_id.shape[1]
 
     key_id = prep_ops(ops.key_id)
     packed = prep_ops(ops.packed)
     value = prep_ops(ops.value)
     is_set = prep_ops(ops.is_set, jnp.int32)
     is_inc = prep_ops(ops.is_inc, jnp.int32)
-    # Padded doc rows carry valid=0, masking them out entirely
+    # Padded doc rows / op lanes carry valid=0, masking them out entirely
     valid = prep_ops(ops.valid, jnp.int32)
+    p = key_id.shape[1]
 
-    grid = (nd // DOC_TILE, nk // KEY_TILE)
-    ops_spec = pl.BlockSpec((DOC_TILE, p), lambda i, j: (i, 0))
-    state_spec = pl.BlockSpec((DOC_TILE, KEY_TILE), lambda i, j: (i, j))
+    grid = (nd // DOC_TILE, nk // KEY_TILE, p // OP_CHUNK)
+    ops_spec = pl.BlockSpec((DOC_TILE, OP_CHUNK), lambda i, j, c: (i, c))
+    state_spec = pl.BlockSpec((DOC_TILE, KEY_TILE), lambda i, j, c: (i, j))
 
     out_w, out_v, out_c = pl.pallas_call(
         _merge_kernel,

@@ -131,7 +131,12 @@ class Context:
         return subpatch
 
     def get_object(self, object_id):
-        object = self.updated.get(object_id) or self.cache.get(object_id)
+        # Explicit None checks: an empty MapView/ListView/Table is falsy in
+        # Python (unlike any JS object), so `updated.get(id) or cache.get(id)`
+        # would wrongly fall through to the stale cache
+        object = self.updated.get(object_id)
+        if object is None:
+            object = self.cache.get(object_id)
         if object is None:
             raise ValueError(f'Target object does not exist: {object_id}')
         return object

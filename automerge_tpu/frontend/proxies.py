@@ -144,6 +144,14 @@ class ListProxy(MutableSequence):
     def extend(self, values):
         self._context.splice(self._path, len(self._target()), 0, list(values))
 
+    def fill(self, value, start=0, end=None):
+        """Set a range of elements to `value` (ref proxies.js listMethods
+        fill())."""
+        length = len(self._target())
+        for i in range(*slice(start, end).indices(length)):
+            self._context.set_list_index(self._path, i, value)
+        return self
+
     def pop(self, index=-1):
         if index < 0:
             index += len(self._target())

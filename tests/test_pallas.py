@@ -36,6 +36,8 @@ def assert_states_match(a, b, n_keys):
     (8, 17, 12),      # everything unaligned -> exercises padding
     (128, 127, 32),   # exact doc tile
     (200, 300, 16),   # multiple key tiles
+    (16, 40, 200),    # multi-chunk op axis (> OP_CHUNK=128): chunk carry
+    (8, 130, 300),    # multi-chunk AND multiple key tiles
 ])
 def test_matches_jnp_path(n_docs, n_keys, p):
     rng = np.random.default_rng(n_docs + n_keys)
@@ -44,6 +46,28 @@ def test_matches_jnp_path(n_docs, n_keys, p):
     want, want_stats = apply_op_batch(state, ops)
     got, got_stats = pallas_apply_op_batch(state, ops, interpret=True)
     assert int(got_stats) == int(want_stats)
+    assert_states_match(got, want, n_keys)
+
+
+def test_duplicate_delivery_is_idempotent():
+    """Redundant re-delivery of the same op (same packed opId, same value —
+    the sync path can re-send) must select the winner value once, not sum it;
+    both engines must agree. Spread across op chunks to exercise the
+    cross-chunk take-if-greater carry."""
+    rng = np.random.default_rng(42)
+    n_docs, n_keys, p = 12, 23, 160   # p > OP_CHUNK: dups straddle chunks
+    ops = random_batch(rng, n_docs, n_keys, p)
+    cols = np.stack([ops.key_id, ops.packed, ops.value,
+                     ops.is_set.astype(np.int32), ops.is_inc.astype(np.int32),
+                     ops.valid.astype(np.int32)])
+    src = rng.integers(0, p // 2, 30)
+    dst = p - 1 - rng.permutation(30)   # mirror lanes into the other chunk
+    cols[:, :, dst] = cols[:, :, src]
+    dup = OpBatch(cols[0], cols[1], cols[2], cols[3] != 0, cols[4] != 0,
+                  cols[5] != 0)
+    state = FleetState.empty(n_docs, n_keys)
+    want, _ = apply_op_batch(state, dup)
+    got, _ = pallas_apply_op_batch(state, dup, interpret=True)
     assert_states_match(got, want, n_keys)
 
 
