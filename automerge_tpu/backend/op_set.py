@@ -364,8 +364,9 @@ class OpSet:
         all_applied = []
 
         try:
+            applied_hashes = set()
             while True:
-                applied, queue = self._causal_gate(queue)
+                applied, queue = self._causal_gate(queue, applied_hashes)
                 for change in applied:
                     self._apply_decoded_change(patches, change, object_ids)
                 all_applied.extend(applied)
@@ -415,11 +416,13 @@ class OpSet:
         self.heads = fresh.heads
         self.clock = fresh.clock
 
-    def _causal_gate(self, changes):
+    def _causal_gate(self, changes, applied_hashes=None):
         """Partition changes into causally-ready (applied to clock/heads) and
-        enqueued (ref new.js:1550-1586)."""
+        enqueued (ref new.js:1550-1586). `applied_hashes` carries the hashes
+        applied by earlier passes of the same apply_changes call (they are not
+        yet in change_index_by_hash, but satisfy deps and must be deduped)."""
         heads = set(self.heads)
-        change_hashes = set()
+        change_hashes = applied_hashes if applied_hashes is not None else set()
         clock = dict(self.clock)
         applied, enqueued = [], []
         for change in changes:

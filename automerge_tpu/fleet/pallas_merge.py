@@ -21,9 +21,11 @@ innermost (sequential) grid dimension so VMEM stays bounded at
 batch carries; the state tile [DOC_TILE, KEY_TILE] persists in VMEM across
 op chunks (TPU revisiting semantics) and accumulates. Winner values carry as
 (winner, value) pairs combined by take-if-greater, which is associative
-across chunks and idempotent under duplicate op delivery (redundant sync
-re-sends select the same value instead of summing it twice). Padded /
-invalid op lanes are masked out by `valid`.
+across chunks and — for LWW set ops — idempotent under duplicate delivery
+within a batch (redundant re-sends select the same value instead of summing
+it twice; counter-increment lanes accumulate per delivery in both this and
+the jnp path, so increment dedup is the sync layer's job). Padded / invalid
+op lanes are masked out by `valid`.
 """
 
 import functools

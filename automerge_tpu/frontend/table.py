@@ -90,8 +90,10 @@ class Table:
         self.op_ids[id] = op_id
 
     def remove(self, id):
-        del self.entries[id]
-        del self.op_ids[id]
+        # Tolerate missing ids like the JS `delete` operator does: a patch may
+        # remove a row that was created and deleted within the same change
+        self.entries.pop(id, None)
+        self.op_ids.pop(id, None)
 
     def get_writeable(self, context, path):
         if not self._object_id:

@@ -1,0 +1,137 @@
+"""Micromerge: a miniature straight-line CRDT used as the executable
+specification for fuzz testing (ported semantics of the reference's oracle in
+test/fuzz_test.js:12-137). Supports maps, lists, and primitives with
+last-writer-wins conflict resolution; deps are vector clocks {actor: seq};
+no buffering of causally-premature changes (they are a caller error here)."""
+
+
+def _op_id_lt(id1, id2):
+    """True iff id1 < id2 in Lamport order (counter, then actorId)."""
+    c1, a1 = id1.split('@', 1)
+    c2, a2 = id2.split('@', 1)
+    return (int(c1), a1) < (int(c2), a2)
+
+
+class Micromerge:
+    def __init__(self):
+        self.by_actor = {}           # actorId -> list of changes
+        self.by_obj_id = {'_root': {}}
+        self.metadata = {'_root': {}}
+
+    @property
+    def root(self):
+        return self.by_obj_id['_root']
+
+    def apply_change(self, change):
+        last_seq = len(self.by_actor.get(change['actor'], []))
+        if change['seq'] != last_seq + 1:
+            raise ValueError(
+                f"Expected sequence number {last_seq + 1}, got {change['seq']}")
+        for actor, dep in (change.get('deps') or {}).items():
+            if len(self.by_actor.get(actor, [])) < dep:
+                raise ValueError(f'Missing dependency: change {dep} by {actor}')
+        self.by_actor.setdefault(change['actor'], []).append(change)
+        for index, op in enumerate(change['ops']):
+            op = dict(op, opId=f"{change['startOp'] + index}@{change['actor']}")
+            self.apply_op(op)
+
+    def apply_op(self, op):
+        if op['obj'] not in self.metadata:
+            raise ValueError(f"Object does not exist: {op['obj']}")
+        if op['action'] == 'makeMap':
+            self.by_obj_id[op['opId']] = {}
+            self.metadata[op['opId']] = {}
+        elif op['action'] == 'makeList':
+            self.by_obj_id[op['opId']] = []
+            self.metadata[op['opId']] = []
+        elif op['action'] not in ('set', 'del'):
+            raise ValueError(f"Unsupported operation type: {op['action']}")
+
+        meta = self.metadata[op['obj']]
+        if isinstance(meta, list):
+            if op.get('insert'):
+                self._apply_list_insert(op)
+            else:
+                self._apply_list_update(op)
+        elif meta.get(op['key']) is None or \
+                _op_id_lt(meta[op['key']], op['opId']):
+            meta[op['key']] = op['opId']
+            if op['action'] == 'del':
+                self.by_obj_id[op['obj']].pop(op['key'], None)
+            elif op['action'].startswith('make'):
+                self.by_obj_id[op['obj']][op['key']] = self.by_obj_id[op['opId']]
+            else:
+                self.by_obj_id[op['obj']][op['key']] = op['value']
+
+    def _apply_list_insert(self, op):
+        meta = self.metadata[op['obj']]
+        value = self.by_obj_id[op['opId']] \
+            if op['action'].startswith('make') else op['value']
+        if op['key'] == '_head':
+            index, visible = -1, 0
+        else:
+            index, visible = self._find_list_element(op['obj'], op['key'])
+        if index >= 0 and not meta[index]['deleted']:
+            visible += 1
+        index += 1
+        # RGA: skip over concurrent insertions with higher opIds
+        while index < len(meta) and _op_id_lt(op['opId'], meta[index]['elemId']):
+            if not meta[index]['deleted']:
+                visible += 1
+            index += 1
+        meta.insert(index, {'elemId': op['opId'], 'valueId': op['opId'],
+                            'deleted': False})
+        self.by_obj_id[op['obj']].insert(visible, value)
+
+    def _apply_list_update(self, op):
+        index, visible = self._find_list_element(op['obj'], op['key'])
+        meta = self.metadata[op['obj']][index]
+        if op['action'] == 'del':
+            if not meta['deleted']:
+                del self.by_obj_id[op['obj']][visible]
+            meta['deleted'] = True
+        elif _op_id_lt(meta['valueId'], op['opId']):
+            if not meta['deleted']:
+                self.by_obj_id[op['obj']][visible] = \
+                    self.by_obj_id[op['opId']] \
+                    if op['action'].startswith('make') else op['value']
+            meta['valueId'] = op['opId']
+
+    def _find_list_element(self, object_id, elem_id):
+        index, visible = 0, 0
+        meta = self.metadata[object_id]
+        while index < len(meta) and meta[index]['elemId'] != elem_id:
+            if not meta[index]['deleted']:
+                visible += 1
+            index += 1
+        if index == len(meta):
+            raise ValueError(f'List element not found: {elem_id}')
+        return index, visible
+
+
+def expand_ops(change):
+    """Expand a frontend change request's compressed ops (multi-insert
+    `values` arrays, `multiOp` deletes) into individual Micromerge ops, and
+    normalize elemId -> key (ref backend/columnar.js:446-475)."""
+    ops = []
+    op_num = change['startOp']
+    for op in change['ops']:
+        key = op.get('elemId', op.get('key'))
+        if op['action'] == 'set' and 'values' in op:
+            for i, value in enumerate(op['values']):
+                ops.append({'action': 'set', 'obj': op['obj'],
+                            'key': key if i == 0 else f"{op_num - 1}@{change['actor']}",
+                            'insert': True, 'value': value})
+                op_num += 1
+        elif op['action'] == 'del' and op.get('multiOp'):
+            ctr, actor = key.split('@', 1)
+            for i in range(op['multiOp']):
+                ops.append({'action': 'del', 'obj': op['obj'],
+                            'key': f'{int(ctr) + i}@{actor}', 'insert': False})
+                op_num += 1
+        else:
+            ops.append({'action': op['action'], 'obj': op['obj'], 'key': key,
+                        'insert': bool(op.get('insert')),
+                        'value': op.get('value')})
+            op_num += 1
+    return dict(change, ops=ops)

@@ -10,6 +10,7 @@ import automerge_tpu as am
 from automerge_tpu import frontend as Frontend
 from automerge_tpu.frontend.context import Context
 from automerge_tpu.frontend.apply_patch import interpret_patch
+from automerge_tpu.frontend.proxies import root_object_proxy
 from automerge_tpu.frontend import Text, Table, Counter
 
 ACTOR = 'aabbcc'
@@ -35,10 +36,7 @@ def make_doc(setup=None):
         doc = am.change(doc, setup)
     spy = PatchSpy()
     context = Context(doc, ACTOR, apply_patch=spy)
-    from automerge_tpu.frontend.proxies import instantiate_proxy
-    context.instantiate_object = \
-        lambda path, object_id, read_only=None: \
-        instantiate_proxy(context, path, object_id, read_only)
+    root_object_proxy(context)   # wires context.instantiate_object
     return doc, context, spy
 
 
@@ -263,7 +261,6 @@ class TestListManipulation:
 
 class TestTableManipulation:
     def test_add_table_row(self):
-        am.Frontend  # noqa: B018 - keep import referenced
         doc = am.init(ACTOR)
         doc = am.change(doc, lambda d: d.update({'books': Table()}))
         spy = PatchSpy()

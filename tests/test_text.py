@@ -1,0 +1,241 @@
+"""Text CRDT conformance tests (ported semantics of reference
+test/text_test.js: editing, control characters, spans, elemIds)."""
+
+import json
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import frontend as Frontend
+from automerge_tpu.frontend import Text
+
+
+def fresh_pair():
+    s1 = am.change(am.init(), lambda d: d.update({'text': Text()}))
+    s2 = am.load(am.save(s1))
+    return s1, s2
+
+
+class TestTextEditing:
+    def test_insertion(self):
+        s1, _ = fresh_pair()
+        s1 = am.change(s1, lambda d: d['text'].insert_at(0, 'a'))
+        actor = am.get_actor_id(s1)
+        assert len(s1['text']) == 1
+        assert s1['text'].get(0) == 'a'
+        assert str(s1['text']) == 'a'
+        assert s1['text'].get_elem_id(0) == f'2@{actor}'
+
+    def test_deletion(self):
+        s1, _ = fresh_pair()
+        s1 = am.change(s1, lambda d: d['text'].insert_at(0, 'a', 'b', 'c'))
+        s1 = am.change(s1, lambda d: d['text'].delete_at(1, 1))
+        assert len(s1['text']) == 2
+        assert s1['text'].get(0) == 'a'
+        assert s1['text'].get(1) == 'c'
+        assert str(s1['text']) == 'ac'
+
+    def test_implicit_and_explicit_deletion(self):
+        s1, _ = fresh_pair()
+        s1 = am.change(s1, lambda d: d['text'].insert_at(0, 'a', 'b', 'c'))
+        s1 = am.change(s1, lambda d: d['text'].delete_at(1))
+        s1 = am.change(s1, lambda d: d['text'].delete_at(1, 0))
+        assert len(s1['text']) == 2
+        assert str(s1['text']) == 'ac'
+
+    def test_concurrent_insertion(self):
+        s1, s2 = fresh_pair()
+        s1 = am.change(s1, lambda d: d['text'].insert_at(0, 'a', 'b', 'c'))
+        s2 = am.change(s2, lambda d: d['text'].insert_at(0, 'x', 'y', 'z'))
+        s1 = am.merge(s1, s2)
+        assert len(s1['text']) == 6
+        assert str(s1['text']) in ('abcxyz', 'xyzabc')
+
+    def test_text_and_other_ops_in_same_change(self):
+        s1, _ = fresh_pair()
+
+        def edit(d):
+            d['foo'] = 'bar'
+            d['text'].insert_at(0, 'a')
+        s1 = am.change(s1, edit)
+        assert s1['foo'] == 'bar'
+        assert str(s1['text']) == 'a'
+
+    def test_json_serializes_as_string(self):
+        s1, _ = fresh_pair()
+        s1 = am.change(s1, lambda d: d['text'].insert_at(0, 'a', '"', 'b'))
+        assert json.dumps(s1.to_py()) == '{"text": "a\\"b"}'
+
+    def test_modification_before_assignment(self):
+        def edit(d):
+            text = Text()
+            text.insert_at(0, 'a', 'b', 'c', 'd')
+            text.delete_at(2)
+            d['text'] = text
+        s1 = am.change(am.init(), edit)
+        assert str(s1['text']) == 'abd'
+
+    def test_modification_after_assignment(self):
+        def edit(d):
+            d['text'] = Text()
+            d['text'].insert_at(0, 'a', 'b', 'c', 'd')
+            d['text'].delete_at(2)
+        s1 = am.change(am.init(), edit)
+        assert str(s1['text']) == 'abd'
+
+    def test_no_modification_outside_change_callback(self):
+        s1, _ = fresh_pair()
+        with pytest.raises(TypeError, match='outside of a change block'):
+            s1['text'].insert_at(0, 'x')
+        with pytest.raises(TypeError, match='outside of a change block'):
+            s1['text'].delete_at(0)
+
+
+class TestInitialValue:
+    def test_string_initial_value(self):
+        s1 = am.change(am.init(), lambda d: d.update({'text': Text('init')}))
+        assert len(s1['text']) == 4
+        assert s1['text'].get(0) == 'i'
+        assert str(s1['text']) == 'init'
+
+    def test_array_initial_value(self):
+        s1 = am.change(am.init(),
+                       lambda d: d.update({'text': Text(['i', 'n', 'i', 't'])}))
+        assert str(s1['text']) == 'init'
+
+    def test_text_in_from(self):
+        s1 = am.from_({'text': Text('init')})
+        assert str(s1['text']) == 'init'
+
+    def test_initial_value_encodes_as_change(self):
+        s1 = am.change(am.init(), lambda d: d.update({'text': Text('init')}))
+        changes = am.get_all_changes(s1)
+        s2, _patch = am.apply_changes(am.init(), changes)
+        assert str(s2['text']) == 'init'
+
+    def test_immediate_access_in_callback(self):
+        def edit(d):
+            d['text'] = Text('init')
+            assert len(d['text']) == 4
+            assert str(d['text']) == 'init'
+        am.change(am.init(), edit)
+
+    def test_pre_assignment_modification(self):
+        def edit(d):
+            text = Text('init')
+            text.delete_at(3)
+            text.insert_at(0, 'I', 'n', 'i', 't', 'i', 'a', 'l', ' ')
+            text.delete_at(8, 3)
+            d['text'] = text
+        s1 = am.change(am.init(), edit)
+        assert str(s1['text']) == 'Initial '
+        s2 = am.load(am.save(s1))
+        assert str(s2['text']) == 'Initial '
+
+    def test_post_assignment_modification(self):
+        def edit(d):
+            d['text'] = Text('init')
+            d['text'].delete_at(0)
+            d['text'].insert_at(0, 'I')
+        s1 = am.change(am.init(), edit)
+        assert str(s1['text']) == 'Init'
+        s2 = am.load(am.save(s1))
+        assert str(s2['text']) == 'Init'
+
+
+class TestControlCharacters:
+    def make(self):
+        def edit(d):
+            d['text'] = Text()
+            d['text'].insert_at(0, 'a')
+            d['text'].insert_at(1, {'attribute': 'bold'})
+        return am.change(am.init(), edit)
+
+    def test_fetch_non_textual(self):
+        s1 = self.make()
+        actor = am.get_actor_id(s1)
+        assert s1['text'].get(1) == {'attribute': 'bold'}
+        assert s1['text'].get_elem_id(1) == f'3@{actor}'
+
+    def test_control_chars_in_length(self):
+        s1 = self.make()
+        assert len(s1['text']) == 2
+        assert s1['text'].get(0) == 'a'
+
+    def test_excluded_from_str(self):
+        s1 = self.make()
+        assert str(s1['text']) == 'a'
+
+    def test_control_char_update(self):
+        s1 = self.make()
+        s2 = am.change(s1, lambda d: d['text'][1].update({'attribute': 'italic'}))
+        s3 = am.load(am.save(s2))
+        assert s1['text'].get(1)['attribute'] == 'bold'
+        assert s2['text'].get(1)['attribute'] == 'italic'
+        assert s3['text'].get(1)['attribute'] == 'italic'
+
+
+class TestSpans:
+    def test_simple_string_single_span(self):
+        s1 = am.change(am.init(),
+                       lambda d: d.update({'text': Text('hello world')}))
+        assert s1['text'].to_spans() == ['hello world']
+
+    def test_empty_string_empty_spans(self):
+        s1 = am.change(am.init(), lambda d: d.update({'text': Text()}))
+        assert s1['text'].to_spans() == []
+
+    def test_split_at_control_character(self):
+        def edit(d):
+            d['text'] = Text('hello world')
+            d['text'].insert_at(5, {'attributes': {'bold': True}})
+        s1 = am.change(am.init(), edit)
+        assert s1['text'].to_spans() == \
+            ['hello', {'attributes': {'bold': True}}, ' world']
+
+    def test_consecutive_control_characters(self):
+        def edit(d):
+            d['text'] = Text('hello world')
+            d['text'].insert_at(5, {'attributes': {'bold': True}})
+            d['text'].insert_at(6, {'attributes': {'italic': True}})
+        s1 = am.change(am.init(), edit)
+        assert s1['text'].to_spans() == \
+            ['hello', {'attributes': {'bold': True}},
+             {'attributes': {'italic': True}}, ' world']
+
+    def test_control_char_at_text_start(self):
+        def edit(d):
+            d['text'] = Text('hello')
+            d['text'].insert_at(0, {'attributes': {'bold': True}})
+        s1 = am.change(am.init(), edit)
+        assert s1['text'].to_spans() == [{'attributes': {'bold': True}}, 'hello']
+
+
+class TestLongEditTrace:
+    def test_editing_trace_convergence(self):
+        """Simulated multi-actor editing trace with interleaved inserts and
+        deletes converges across merge (ref test/text_test.js editing-trace
+        style, scaled down)."""
+        import random
+        rnd = random.Random(42)
+        s1 = am.change(am.init('aa01'), lambda d: d.update({'text': Text('seed')}))
+        s2 = am.load(am.save(s1), 'bb02')
+
+        def mutate(s, rnd):
+            def edit(d):
+                t = d['text']
+                for _ in range(5):
+                    if len(t) > 2 and rnd.random() < 0.4:
+                        t.delete_at(rnd.randrange(len(t)))
+                    else:
+                        t.insert_at(rnd.randrange(len(t) + 1),
+                                    rnd.choice('abcdefgh'))
+            return am.change(s, edit)
+
+        for _ in range(6):
+            s1 = mutate(s1, rnd)
+            s2 = mutate(s2, rnd)
+        m1 = am.merge(s1, s2)
+        m2 = am.merge(s2, m1)
+        assert str(m1['text']) == str(m2['text'])
+        assert len(m1['text']) > 0
