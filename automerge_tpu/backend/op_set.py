@@ -34,6 +34,7 @@ from ..columnar import (
     materialize_columns, encoder_by_column_id,
 )
 from .. import encoding
+from .hash_graph import HashGraph, decode_change_buffers
 
 
 def _utf16_key(s):
@@ -308,24 +309,14 @@ ROOT_META = {'parentObj': None, 'parentKey': None, 'opId': '_root', 'type': 'map
              'children': {}}
 
 
-class OpSet:
+class OpSet(HashGraph):
     """The document engine: equivalent of the reference's BackendDoc
-    (new.js:1694-2069)."""
+    (new.js:1694-2069). Causal-gate/hash-graph state lives in HashGraph."""
 
     def __init__(self, buffer=None):
-        self.max_op = 0
-        self.actor_ids = []
-        self.heads = []
-        self.clock = {}
-        self.queue = []
+        super().__init__()
         self.objects = {'_root': ObjState('map')}
         self.object_meta = {'_root': copy.deepcopy(ROOT_META)}
-        self.changes = []           # binary changes, in application order
-        self.changes_meta = []      # per-change metadata for document encoding
-        self.change_index_by_hash = {}
-        self.dependencies_by_hash = {}
-        self.dependents_by_hash = {}
-        self.hashes_by_actor = {}
         self.binary_doc = None
         self.extra_bytes = None
         if buffer is not None:
@@ -341,37 +332,15 @@ class OpSet:
 
     def apply_changes(self, change_buffers, is_local=False):
         """Apply binary changes; returns a patch (ref new.js:1797-1879)."""
-        if isinstance(change_buffers, (bytes, bytearray)):
-            raise TypeError('applyChanges takes an array of byte buffers, '
-                            'not just a single buffer')
-        decoded = []
-        for buffer in change_buffers:
-            for chunk in split_containers(buffer):
-                if chunk[8] in (CHUNK_TYPE_CHANGE, CHUNK_TYPE_DEFLATE):
-                    change = decode_change(chunk)
-                    change['buffer'] = chunk
-                    decoded.append(change)
-                elif chunk[8] == CHUNK_TYPE_DOCUMENT:
-                    # decode_document already normalizes each change through an
-                    # encode/decode round-trip, so only the buffer is missing
-                    for change in decode_document(chunk):
-                        change['buffer'] = encode_change(change)
-                        decoded.append(change)
-
+        decoded = decode_change_buffers(change_buffers)
         patches = {'_root': empty_object_patch('_root', 'map')}
         object_ids = set()
-        queue = decoded + self.queue
-        all_applied = []
 
         try:
-            applied_hashes = set()
-            while True:
-                applied, queue = self._causal_gate(queue, applied_hashes)
-                for change in applied:
-                    self._apply_decoded_change(patches, change, object_ids)
-                all_applied.extend(applied)
-                if not applied or not queue:
-                    break
+            all_applied, queue = self._drain_queue(
+                decoded,
+                lambda change: self._apply_decoded_change(patches, change,
+                                                          object_ids))
         except Exception:
             # Roll back to the pre-call state by replaying the (unmodified)
             # change history; cheap because it only runs on the error path
@@ -381,20 +350,7 @@ class OpSet:
         self._setup_patches(patches, object_ids)
 
         for change in all_applied:
-            self.changes.append(change['buffer'])
-            self.hashes_by_actor.setdefault(change['actor'], []).append(change['hash'])
-            self.change_index_by_hash[change['hash']] = len(self.changes) - 1
-            self.dependencies_by_hash[change['hash']] = list(change['deps'])
-            self.dependents_by_hash.setdefault(change['hash'], [])
-            for dep in change['deps']:
-                self.dependents_by_hash.setdefault(dep, []).append(change['hash'])
-            self.changes_meta.append({
-                'actor': change['actor'], 'seq': change['seq'],
-                'maxOp': change['startOp'] + len(change['ops']) - 1,
-                'time': change.get('time', 0), 'message': change.get('message') or '',
-                'deps': list(change['deps']),
-                'extraBytes': change.get('extraBytes'),
-            })
+            self._record_applied(change)
         self.queue = queue
         self.binary_doc = None
 
@@ -415,41 +371,6 @@ class OpSet:
         self.actor_ids = fresh.actor_ids
         self.heads = fresh.heads
         self.clock = fresh.clock
-
-    def _causal_gate(self, changes, applied_hashes=None):
-        """Partition changes into causally-ready (applied to clock/heads) and
-        enqueued (ref new.js:1550-1586). `applied_hashes` carries the hashes
-        applied by earlier passes of the same apply_changes call (they are not
-        yet in change_index_by_hash, but satisfy deps and must be deduped)."""
-        heads = set(self.heads)
-        change_hashes = applied_hashes if applied_hashes is not None else set()
-        clock = dict(self.clock)
-        applied, enqueued = [], []
-        for change in changes:
-            if change['hash'] in self.change_index_by_hash or change['hash'] in change_hashes:
-                continue
-            expected_seq = clock.get(change['actor'], 0) + 1
-            ready = all(dep in self.change_index_by_hash or dep in change_hashes
-                        for dep in change['deps'])
-            if not ready:
-                enqueued.append(change)
-            elif change['seq'] < expected_seq:
-                raise ValueError(
-                    f"Reuse of sequence number {change['seq']} for actor {change['actor']}")
-            elif change['seq'] > expected_seq:
-                raise ValueError(
-                    f"Skipped sequence number {expected_seq} for actor {change['actor']}")
-            else:
-                clock[change['actor']] = change['seq']
-                change_hashes.add(change['hash'])
-                for dep in change['deps']:
-                    heads.discard(dep)
-                heads.add(change['hash'])
-                applied.append(change)
-        if applied:
-            self.heads = sorted(heads)
-            self.clock = clock
-        return applied, enqueued
 
     def _apply_decoded_change(self, patches, change, object_ids):
         if change['actor'] not in self.actor_ids:
@@ -912,63 +833,3 @@ class OpSet:
             self.apply_changes(changes)
         if len(chunks) == 1 and chunks[0][8] == CHUNK_TYPE_DOCUMENT:
             self.binary_doc = buffer
-
-    # ------------------------------------------------------------------
-    # History / hash graph queries (ref new.js:1921-2028)
-    # ------------------------------------------------------------------
-
-    def get_changes(self, have_deps):
-        if not have_deps:
-            return list(self.changes)
-        stack, seen, to_return = [], set(), []
-        for h in have_deps:
-            seen.add(h)
-            successors = self.dependents_by_hash.get(h)
-            if successors is None:
-                raise ValueError(f'hash not found: {h}')
-            stack.extend(successors)
-        while stack:
-            h = stack.pop()
-            seen.add(h)
-            to_return.append(h)
-            if not all(dep in seen for dep in self.dependencies_by_hash[h]):
-                break
-            stack.extend(self.dependents_by_hash[h])
-        if not stack and all(head in seen for head in self.heads):
-            return [self.changes[self.change_index_by_hash[h]] for h in to_return]
-
-        # Slow path: collect ancestors of have_deps, return everything else
-        stack, seen = list(have_deps), set()
-        while stack:
-            h = stack.pop()
-            if h not in seen:
-                deps = self.dependencies_by_hash.get(h)
-                if deps is None:
-                    raise ValueError(f'hash not found: {h}')
-                stack.extend(deps)
-                seen.add(h)
-        return [change for change in self.changes
-                if decode_change_meta(change, True)['hash'] not in seen]
-
-    def get_changes_added(self, other):
-        stack, seen, to_return = list(self.heads), set(), []
-        while stack:
-            h = stack.pop()
-            if h not in seen and h not in other.change_index_by_hash:
-                seen.add(h)
-                to_return.append(h)
-                stack.extend(self.dependencies_by_hash[h])
-        return [self.changes[self.change_index_by_hash[h]] for h in reversed(to_return)]
-
-    def get_change_by_hash(self, hash):
-        index = self.change_index_by_hash.get(hash)
-        return self.changes[index] if index is not None else None
-
-    def get_missing_deps(self, heads=()):
-        all_deps = set(heads)
-        in_queue = set()
-        for change in self.queue:
-            in_queue.add(change['hash'])
-            all_deps.update(change['deps'])
-        return sorted(h for h in all_deps
-                      if h not in self.change_index_by_hash and h not in in_queue)

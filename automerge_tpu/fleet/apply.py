@@ -47,8 +47,17 @@ def apply_op_batch(state, ops):
     win_key = jnp.where(won, ops.key_id, scratch)
     values = state.values.at[doc_idx, win_key].set(jnp.where(won, ops.value, 0))
 
-    # Counters accumulate (inc ops are successors that add, not overwrite)
-    counters = state.counters.at[doc_idx, inc_key].add(
+    # Counters accumulate (inc ops are successors that add, not overwrite,
+    # ref new.js:937-965) — but a key whose winner changed this batch starts
+    # from a fresh base: the old accumulator belonged to the overwritten op
+    # (a redundant re-delivery of the standing winner leaves it intact).
+    # Known corner: ops don't carry pred info on device, so an inc targeting
+    # the *old* counter that lands in the same batch as the overwriting set
+    # is credited to the new winner; the host mirror (fleet.backend) remains
+    # exact there, and per-op pred ingest is the planned fix.
+    keep = winners == state.winners
+    counters = jnp.where(keep, state.counters, 0)
+    counters = counters.at[doc_idx, inc_key].add(
         jnp.where(inc_mask, ops.value, 0))
 
     stats = jnp.sum(ops.valid, dtype=jnp.int32)

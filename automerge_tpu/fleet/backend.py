@@ -1,0 +1,638 @@
+"""The device-routed backend: Automerge's Backend contract over the TPU fleet.
+
+This is the `setDefaultBackend` drop-in (ref src/automerge.js:147-149,
+test/wasm.js:24-25): documents created through this module keep their bulk
+CRDT state — per-key LWW winners, values, counter accumulators — in the
+shared device fleet (automerge_tpu.fleet.tensor_doc.FleetState), where change
+application is a batched scatter-max/scatter-add dispatch over every document
+at once. The host keeps only what is inherently host work:
+
+- the hash graph + causal gate (HashGraph — same machinery as the host OpSet,
+  ref new.js:1550-1597),
+- a per-document *mirror* of visible ops per key, from which exact reference
+  patches (conflict sets, counter accumulation, ref new.js:884-1040) are
+  produced without touching the device,
+- wire encode/decode.
+
+Documents whose changes leave the flat root-map subset (nested objects,
+lists, text, tables) transparently *promote*: their change log replays into
+the host OpSet engine and every later call delegates to it, so the full
+reference semantics are always available — the fleet path is an accelerator,
+never a semantic fork.
+
+Scale notes: one fleet packs up to 256 actors (tensor_doc.ACTOR_BITS); actor
+numbers are kept in actor-hex sort order so the device's packed-opId
+scatter-max resolves Lamport ties identically to the reference's
+lamportCompare (frontend/apply_patch.js:33-42) — when a new actor lands
+between existing ones, the fleet renumbers by remapping the low bits of the
+winners tensor in one dispatch.
+"""
+
+import copy
+
+import numpy as np
+
+from ..backend.hash_graph import HashGraph, decode_change_buffers
+from ..backend.op_set import OpSet
+from ..columnar import decode_change
+from .tensor_doc import FleetState, MAX_ACTORS, TOMBSTONE
+from .ingest import KeyInterner, changes_to_op_batch
+
+_FLAT_ACTIONS = ('set', 'del', 'inc')
+
+
+class _Unsupported(Exception):
+    """An op outside the flat root-map subset: promote to the host engine."""
+
+
+class _SortedActorTable:
+    """Actor interning that keeps numbers equal to the actor-hex sort rank,
+    so packed opIds order exactly like the reference's Lamport comparison.
+    Inserting an actor that sorts before existing ones renumbers; the caller
+    applies the returned permutation to any device state."""
+
+    def __init__(self):
+        self.actors = []          # sorted actor hex strings
+        self.index = {}           # actor -> current number
+
+    def __len__(self):
+        return len(self.actors)
+
+    def intern(self, actor):
+        num = self.index.get(actor)
+        if num is None:
+            raise KeyError(f'actor {actor} not pre-registered with the fleet')
+        return num
+
+    def insert_many(self, new_actors):
+        """Insert actors; returns an old->new permutation array if existing
+        numbers changed, else None."""
+        fresh = sorted(set(a for a in new_actors if a not in self.index))
+        if not fresh:
+            return None
+        if len(self.actors) + len(fresh) > MAX_ACTORS:
+            raise ValueError(
+                f'fleet actor table overflow (> {MAX_ACTORS} actors); '
+                f'use separate fleets or the host backend')
+        old_order = list(self.actors)
+        self.actors = sorted(self.actors + fresh)
+        self.index = {a: i for i, a in enumerate(self.actors)}
+        if not old_order:
+            return None
+        perm = np.array([self.index[a] for a in old_order], dtype=np.int32)
+        if np.array_equal(perm, np.arange(len(old_order), dtype=np.int32)):
+            return None
+        return perm
+
+
+def _pow2(n):
+    cap = 1
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+class DocFleet:
+    """The shared device state for a fleet of flat documents.
+
+    Capacity (doc slots, key-grid width) grows in powers of two so XLA
+    recompiles O(log n) times as the fleet grows. Change buffers enqueue per
+    slot and land on the device in one batched ingest + one merge dispatch
+    per flush (lazy: reads flush first)."""
+
+    def __init__(self, doc_capacity=64, key_capacity=64):
+        self.keys = KeyInterner()
+        self.actors = _SortedActorTable()
+        self.value_table = []     # non-inline values, referenced as -(i + 2)
+        self.state = None         # FleetState, allocated on first flush
+        self.doc_cap = doc_capacity
+        self.key_cap = key_capacity
+        self.n_slots = 0
+        self.free_slots = []
+        self.pending = []         # (slot, [change buffers])
+        self.pending_actors = set()
+        self.dispatches = 0       # number of device merge dispatches issued
+
+    # -- slot management ------------------------------------------------
+
+    def alloc_slot(self):
+        if self.free_slots:
+            return self.free_slots.pop()
+        slot = self.n_slots
+        self.n_slots += 1
+        return slot
+
+    def free_slot(self, slot):
+        self.pending = [(s, b) for (s, b) in self.pending if s != slot]
+        self._zero_row(slot)
+        self.free_slots.append(slot)
+
+    def clone_slot(self, src):
+        self.flush()
+        dst = self.alloc_slot()
+        if self.state is not None and src < self.state.winners.shape[0]:
+            self._ensure_capacity(n_docs=dst + 1, n_keys=len(self.keys))
+            st = self.state
+            self.state = FleetState(
+                st.winners.at[dst].set(st.winners[src]),
+                st.values.at[dst].set(st.values[src]),
+                st.counters.at[dst].set(st.counters[src]))
+        return dst
+
+    def _zero_row(self, slot):
+        if self.state is None or slot >= self.state.winners.shape[0]:
+            return
+        st = self.state
+        self.state = FleetState(st.winners.at[slot].set(0),
+                                st.values.at[slot].set(0),
+                                st.counters.at[slot].set(0))
+
+    # -- ingest ---------------------------------------------------------
+
+    def enqueue(self, slot, buffers, actors):
+        if buffers:
+            self.pending.append((slot, list(buffers)))
+            self.pending_actors.update(actors)
+
+    def _ensure_capacity(self, n_docs, n_keys):
+        need_docs = _pow2(max(n_docs, self.doc_cap))
+        need_keys = _pow2(max(n_keys + 1, self.key_cap))
+        if self.state is None:
+            self.doc_cap, self.key_cap = need_docs, need_keys
+            self.state = FleetState.empty(need_docs, need_keys)
+            return
+        old_n, old_k = self.state.winners.shape
+        if need_docs <= old_n and need_keys + 1 <= old_k:
+            return
+        import jax.numpy as jnp
+        n, k = max(need_docs, old_n), max(need_keys + 1, old_k)
+        # The old scratch column (index old_k - 1) holds garbage from padded
+        # scatter lanes; it must not become a real key slot when widening
+        grown = []
+        for arr in (self.state.winners, self.state.values, self.state.counters):
+            out = jnp.zeros((n, k), dtype=arr.dtype)
+            out = out.at[:old_n, :old_k - 1].set(arr[:, :old_k - 1])
+            grown.append(out)
+        self.doc_cap, self.key_cap = n, k - 1
+        self.state = FleetState(*grown)
+
+    def _remap_actors(self, perm):
+        """Renumber the actor bits of every packed opId on the device."""
+        if self.state is None:
+            return
+        import jax.numpy as jnp
+        mask = MAX_ACTORS - 1
+        perm_full = np.arange(MAX_ACTORS, dtype=np.int32)
+        perm_full[:len(perm)] = perm
+        w = self.state.winners
+        remapped = (w & ~mask) | jnp.asarray(perm_full)[w & mask]
+        self.state = FleetState(jnp.where(w != 0, remapped, 0),
+                                self.state.values, self.state.counters)
+
+    def flush(self):
+        """Land all pending change buffers on the device: one batched ingest
+        and one merge dispatch for the whole fleet."""
+        if not self.pending:
+            return
+        from .apply import apply_op_batch
+        perm = self.actors.insert_many(self.pending_actors)
+        if perm is not None:
+            self._remap_actors(perm)
+        n_docs = self.n_slots
+        per_doc = [[] for _ in range(n_docs)]
+        for slot, buffers in self.pending:
+            per_doc[slot].extend(buffers)
+        self.pending = []
+        self.pending_actors = set()
+        batch = changes_to_op_batch(per_doc, self.keys, self.actors,
+                                    value_table=self.value_table)
+        self._ensure_capacity(n_docs=n_docs, n_keys=len(self.keys))
+        if batch.key_id.shape[0] < self.state.winners.shape[0]:
+            pad = self.state.winners.shape[0] - batch.key_id.shape[0]
+            batch = type(batch)(*(np.pad(col, ((0, pad), (0, 0)))
+                                  for col in batch.tree_flatten()[0]))
+        self.state, _stats = apply_op_batch(self.state, batch)
+        self.dispatches += 1
+
+    # -- reads ----------------------------------------------------------
+
+    def materialize_all(self):
+        """Whole-fleet state readback in one device->host transfer:
+        slot -> {key: value} with LWW winners, tombstones dropped, and
+        counter accumulators added to their base value."""
+        self.flush()
+        if self.state is None:
+            return [{} for _ in range(self.n_slots)]
+        winners = np.asarray(self.state.winners)
+        values = np.asarray(self.state.values)
+        counters = np.asarray(self.state.counters)
+        out = []
+        free = set(self.free_slots)
+        for slot in range(self.n_slots):
+            doc = {}
+            if slot not in free:
+                live = np.flatnonzero(winners[slot, :len(self.keys)])
+                for k in live:
+                    v = int(values[slot, k])
+                    if v == TOMBSTONE:
+                        continue
+                    value = self.value_table[-v - 2] if v <= -2 else v
+                    c = int(counters[slot, k])
+                    if c and isinstance(value, int):
+                        value += c
+                    doc[self.keys.keys[k]] = value
+            out.append(doc)
+        return out
+
+    def materialize(self, slot):
+        return self.materialize_all()[slot]
+
+
+class _FlatEngine(HashGraph):
+    """Host-side mirror + patch generator for one flat fleet document.
+
+    Tracks, per root-map key, the visible op set (the reference's multi-value
+    register: ops with no successors, new.js:1204-1217) as {opId: leaf} plus
+    the set of row opIds for pred validation. The heavy merge state lives on
+    the device; this mirror exists to produce exact patches and errors."""
+
+    def __init__(self, fleet, slot):
+        super().__init__()
+        self.fleet = fleet
+        self.slot = slot
+        self.visible = {}         # key -> {opId: {'type','value'[,'datatype']}}
+        self.all_ops = {}         # key -> set of row opIds (set + inc ops)
+        self.binary_doc = None
+        self._op_set_cache = None
+
+    # -- change application --------------------------------------------
+
+    def apply_changes(self, change_buffers, is_local=False):
+        decoded = decode_change_buffers(change_buffers)
+
+        # Pre-scan for the flat subset before mutating anything, so promotion
+        # to the host engine happens from an untouched state
+        for change in decoded:
+            for op in change['ops']:
+                self._check_flat(op)
+
+        props = {}
+        backup = (dict(self.clock), list(self.heads), list(self.queue))
+        try:
+            all_applied, queue = self._drain_queue(
+                decoded,
+                lambda change: self._apply_decoded_change(props, change))
+        except Exception:
+            self._rollback(backup)
+            raise
+
+        for change in all_applied:
+            self._record_applied(change)
+        self.queue = queue
+        self.binary_doc = None
+        self._op_set_cache = None
+        self.fleet.enqueue(self.slot, [c['buffer'] for c in all_applied],
+                           [c['actor'] for c in all_applied])
+
+        patch = {'maxOp': self.max_op, 'clock': dict(self.clock),
+                 'deps': list(self.heads), 'pendingChanges': len(self.queue),
+                 'diffs': {'objectId': '_root', 'type': 'map', 'props': props}}
+        if is_local and len(decoded) == 1:
+            patch['actor'] = decoded[0]['actor']
+            patch['seq'] = decoded[0]['seq']
+        return patch
+
+    def _check_flat(self, op):
+        if op['obj'] != '_root' or op.get('insert') or \
+                op['action'] not in _FLAT_ACTIONS or op.get('key') is None:
+            raise _Unsupported()
+        if op['action'] == 'inc':
+            # The device value column carries inc deltas inline as int32
+            delta = op.get('value', 0)
+            if not isinstance(delta, int) or isinstance(delta, bool) or \
+                    not -(1 << 31) < delta < (1 << 31):
+                raise _Unsupported()
+
+    def _rollback(self, backup):
+        """Restore the mirror by replaying the committed log host-side (the
+        device never saw the failed call; enqueue happens only on success)."""
+        self.clock, self.heads, self.queue = backup
+        fresh = _FlatEngine(self.fleet, self.slot)
+        for buffer in self.changes:
+            change = decode_change(bytes(buffer))
+            acc = {}
+            fresh._apply_decoded_change(acc, change)
+        self.visible = fresh.visible
+        self.all_ops = fresh.all_ops
+        self.max_op = fresh.max_op
+        self.actor_ids = fresh.actor_ids
+
+    def _apply_decoded_change(self, props, change):
+        if change['actor'] not in self.actor_ids:
+            self.actor_ids.append(change['actor'])
+        start_op = change['startOp']
+        for i, op in enumerate(change['ops']):
+            op_id = f"{start_op + i}@{change['actor']}"
+            if start_op + i > self.max_op:
+                self.max_op = start_op + i
+            self._apply_op(props, op_id, op)
+
+    def _apply_op(self, props, op_id, op):
+        key = op['key']
+        action = op['action']
+        rows = self.all_ops.setdefault(key, set())
+        vis = self.visible.setdefault(key, {})
+        if op_id in rows:
+            raise ValueError(f'duplicate operation ID: {op_id}')
+        preds = list(op.get('pred', []))
+        for p in preds:
+            if p not in rows:
+                raise ValueError(f'no matching operation for pred: {p}')
+
+        if action == 'inc':
+            # The target counter must still be visible (the reference's
+            # counter state machine raises otherwise, new.js:941-946)
+            target = None
+            for p in preds:
+                leaf = vis.get(p)
+                if leaf is not None and leaf.get('datatype') == 'counter':
+                    target = leaf
+                    break
+            if target is None:
+                raise ValueError(
+                    f'increment operation {op_id} for unknown counter')
+            target['value'] += op.get('value', 0)
+            rows.add(op_id)
+        else:
+            for p in preds:
+                vis.pop(p, None)
+            if action == 'set':
+                leaf = {'type': 'value', 'value': op.get('value')}
+                if op.get('datatype') is not None:
+                    leaf['datatype'] = op['datatype']
+                vis[op_id] = leaf
+                rows.add(op_id)
+            # 'del' ops are not rows: they exist only as successor marks
+            # (ref new.js:1204-1217), so they can never be pred targets
+
+        props[key] = {i: copy.copy(leaf) for i, leaf in vis.items()}
+
+    # -- reads ----------------------------------------------------------
+
+    def get_patch(self):
+        props = {}
+        for key, vis in self.visible.items():
+            if vis:
+                props[key] = {i: copy.copy(leaf) for i, leaf in vis.items()}
+        return {'maxOp': self.max_op, 'clock': dict(self.clock),
+                'deps': list(self.heads), 'pendingChanges': len(self.queue),
+                'diffs': {'objectId': '_root', 'type': 'map', 'props': props}}
+
+    def materialize(self):
+        """Exact {key: value} view from the host mirror (LWW winner per key,
+        ascending-Lamport max, matching frontend/apply_patch.js:33-42)."""
+        from ..common import lamport_key
+        doc = {}
+        for key, vis in self.visible.items():
+            if vis:
+                winner = max(vis.keys(), key=lamport_key)
+                doc[key] = vis[winner]['value']
+        return doc
+
+    def _materialized_op_set(self):
+        if self._op_set_cache is None:
+            ops = OpSet()
+            if self.changes:
+                ops.apply_changes([bytes(b) for b in self.changes])
+            self._op_set_cache = ops
+        return self._op_set_cache
+
+    def save(self):
+        """Document container serialization, via a host replay (deferred like
+        the reference's deferred hash graph, new.js:1887-1912)."""
+        if self.binary_doc is None:
+            self.binary_doc = self._materialized_op_set().save()
+        return self.binary_doc
+
+    def clone_engine(self):
+        other = _FlatEngine(self.fleet, self.fleet.clone_slot(self.slot))
+        for field in ('max_op', 'actor_ids', 'heads', 'clock', 'queue',
+                      'changes', 'changes_meta', 'change_index_by_hash',
+                      'dependencies_by_hash', 'dependents_by_hash',
+                      'hashes_by_actor', 'visible', 'all_ops'):
+            setattr(other, field, copy.deepcopy(getattr(self, field)))
+        return other
+
+
+class FleetDoc:
+    """A Backend-contract document handle routed through the device fleet.
+
+    Wraps either a _FlatEngine (fleet mode) or, after promotion, a host
+    OpSet. All HashGraph state is exposed as properties so handles stay
+    valid across promotion, and so host-backed and fleet-backed documents
+    interoperate (merge, sync) freely."""
+
+    def __init__(self, fleet, impl=None):
+        self.fleet = fleet
+        self._impl = impl if impl is not None else \
+            _FlatEngine(fleet, fleet.alloc_slot())
+
+    # HashGraph state passthrough (valid across promotion)
+    heads = property(lambda self: self._impl.heads)
+    clock = property(lambda self: self._impl.clock)
+    queue = property(lambda self: self._impl.queue)
+    changes = property(lambda self: self._impl.changes)
+    changes_meta = property(lambda self: self._impl.changes_meta)
+    change_index_by_hash = property(lambda self: self._impl.change_index_by_hash)
+    dependencies_by_hash = property(lambda self: self._impl.dependencies_by_hash)
+    dependents_by_hash = property(lambda self: self._impl.dependents_by_hash)
+    hashes_by_actor = property(lambda self: self._impl.hashes_by_actor)
+    max_op = property(lambda self: self._impl.max_op)
+    actor_ids = property(lambda self: self._impl.actor_ids)
+
+    @property
+    def is_fleet(self):
+        return isinstance(self._impl, _FlatEngine)
+
+    def promote(self):
+        """Replay this document into the host OpSet engine and delegate all
+        further calls to it (the escape hatch for non-flat documents)."""
+        if not self.is_fleet:
+            return self._impl
+        impl = self._impl
+        ops = OpSet()
+        if impl.changes:
+            ops.apply_changes([bytes(b) for b in impl.changes])
+        for change in impl.queue:
+            ops.apply_changes([change['buffer']])
+        self.fleet.free_slot(impl.slot)
+        self._impl = ops
+        return ops
+
+    def apply_changes(self, change_buffers, is_local=False):
+        if self.is_fleet:
+            try:
+                return self._impl.apply_changes(change_buffers, is_local)
+            except _Unsupported:
+                self.promote()
+        return self._impl.apply_changes(change_buffers, is_local)
+
+    def get_patch(self):
+        return self._impl.get_patch()
+
+    def get_changes(self, have_deps):
+        return self._impl.get_changes(have_deps)
+
+    def get_changes_added(self, other):
+        return self._impl.get_changes_added(other)
+
+    def get_change_by_hash(self, hash):
+        return self._impl.get_change_by_hash(hash)
+
+    def get_missing_deps(self, heads=()):
+        return self._impl.get_missing_deps(heads)
+
+    def save(self):
+        return self._impl.save()
+
+    def clone(self):
+        if self.is_fleet:
+            return FleetDoc(self.fleet, self._impl.clone_engine())
+        return FleetDoc(self.fleet, self._impl.clone())
+
+    def free(self):
+        if self.is_fleet:
+            self.fleet.free_slot(self._impl.slot)
+        self._impl = None
+
+    def materialize(self):
+        """Exact current {key: value} state (host mirror when in fleet mode,
+        whole-doc patch walk after promotion)."""
+        if self.is_fleet:
+            return self._impl.materialize()
+        patch = self._impl.get_patch()
+        from ..common import lamport_key
+        doc = {}
+        for key, candidates in patch['diffs'].get('props', {}).items():
+            if candidates:
+                winner = max(candidates.keys(), key=lamport_key)
+                leaf = candidates[winner]
+                doc[key] = leaf.get('value', leaf)
+        return doc
+
+
+# ----------------------------------------------------------------------
+# Backend-contract module surface (ref backend/index.js:1-8): identical to
+# automerge_tpu.backend but init/load build fleet-routed documents. Pass this
+# module (or a FleetBackend instance) to automerge_tpu.set_default_backend.
+# ----------------------------------------------------------------------
+
+_default_fleet = DocFleet()
+
+
+def default_fleet():
+    return _default_fleet
+
+
+from ..backend import (  # noqa: E402
+    _backend_state, apply_changes, apply_local_change, save,
+    load_changes, get_patch, get_heads, get_all_changes, get_changes,
+    get_changes_added, get_change_by_hash, get_missing_deps,
+    generate_sync_message, receive_sync_message, encode_sync_message,
+    decode_sync_message, init_sync_state, encode_sync_state,
+    decode_sync_state, BloomFilter,
+)
+
+
+def init(fleet=None):
+    return {'state': FleetDoc(fleet or _default_fleet), 'heads': []}
+
+
+def load(data, fleet=None):
+    handle = init(fleet)
+    state = handle['state']
+    state.apply_changes([data])
+    return {'state': state, 'heads': state.heads}
+
+
+def clone(backend):
+    return {'state': _backend_state(backend).clone(),
+            'heads': backend['heads']}
+
+
+def free(backend):
+    backend['state'].free()
+    backend['state'] = None
+    backend['frozen'] = True
+
+
+class FleetBackend:
+    """Object-style backend (equivalent to this module) bound to its own
+    DocFleet — for isolating fleets or injecting a custom-capacity one."""
+
+    def __init__(self, fleet=None):
+        self.fleet = fleet or DocFleet()
+
+    def init(self):
+        return init(self.fleet)
+
+    def load(self, data):
+        return load(data, self.fleet)
+
+    def __getattr__(self, name):
+        import sys
+        return getattr(sys.modules[__name__], name)
+
+
+# ----------------------------------------------------------------------
+# Fleet-level batched API: the TPU-idiomatic entry point
+# ----------------------------------------------------------------------
+
+def init_docs(n, fleet=None):
+    """Create n fleet documents sharing one device fleet."""
+    return [init(fleet) for _ in range(n)]
+
+
+def apply_changes_docs(handles, per_doc_changes):
+    """Apply per-document change lists across the fleet: per-doc causal
+    gating and patch mirrors on host, then ONE batched ingest + merge
+    dispatch for every document's ops. Returns (new_handles, patches)."""
+    out_handles, patches = [], []
+    for handle, changes in zip(handles, per_doc_changes):
+        if changes:
+            new_handle, patch = apply_changes(handle, changes)
+        else:
+            new_handle, patch = handle, None
+        out_handles.append(new_handle)
+        patches.append(patch)
+    fleet = None
+    for handle in out_handles:
+        state = handle['state']
+        if isinstance(state, FleetDoc) and state.is_fleet:
+            fleet = state.fleet
+            break
+    if fleet is not None:
+        fleet.flush()
+    return out_handles, patches
+
+
+def materialize_docs(handles):
+    """Bulk {key: value} readback for many documents; fleet-resident docs
+    come from one device transfer, promoted docs from their host engine."""
+    by_fleet = {}
+    for handle in handles:
+        state = handle['state']
+        if isinstance(state, FleetDoc) and state.is_fleet:
+            fleet = state.fleet
+            if id(fleet) not in by_fleet:
+                by_fleet[id(fleet)] = fleet.materialize_all()
+    out = []
+    for handle in handles:
+        state = handle['state']
+        if isinstance(state, FleetDoc) and state.is_fleet:
+            out.append(by_fleet[id(state.fleet)][state._impl.slot])
+        elif isinstance(state, FleetDoc):
+            out.append(state.materialize())
+        else:
+            raise TypeError('materialize_docs needs fleet backend handles')
+    return out

@@ -181,10 +181,11 @@ def changes_to_op_batch(per_doc_changes, key_interner, actor_interner,
 
     Tries the native C++ batched parser first; falls back to the per-change
     Python decode. Only root-map set/inc/del ops are supported (the fleet
-    kernel's op subset); raises ValueError otherwise. Values are interned
-    into `value_table` (a list) and referenced by index; int values are
-    stored inline when they fit."""
-    if value_table is None and native.available():
+    kernel's op subset); raises ValueError otherwise. Ints in [0, 2^31) are
+    stored inline in the value column; any other value is appended to
+    `value_table` (when given) and referenced as -(index + 2) — distinct
+    from TOMBSTONE (-1) and from inline ints."""
+    if native.available():
         batch = changes_to_op_batch_native(per_doc_changes, key_interner,
                                            actor_interner)
         if batch is not None:
@@ -221,11 +222,20 @@ def changes_to_op_batch(per_doc_changes, key_interner, actor_interner,
                     raise ValueError(f'unsupported action {action} for fleet ingest')
                 if action == _DEL:
                     val_idx = TOMBSTONE
+                elif action == _INC:
+                    # The device scatter-add consumes the value column of inc
+                    # ops as a raw delta (never a table index), so any int32
+                    # delta — negative included — must be stored inline
+                    if not isinstance(value, int) or isinstance(value, bool) \
+                            or not -(1 << 31) < value < (1 << 31):
+                        raise ValueError('inc delta must be an int32 '
+                                         'for fleet ingest')
+                    val_idx = value
                 elif isinstance(value, int) and not isinstance(value, bool) and \
-                        0 <= value < (1 << 31) and value_table is None:
+                        0 <= value < (1 << 31):
                     val_idx = value
                 elif value_table is not None:
-                    val_idx = len(value_table)
+                    val_idx = -(len(value_table) + 2)
                     value_table.append(value)
                 else:
                     raise ValueError('non-int value requires a value_table')

@@ -48,7 +48,8 @@ _INT32_MIN = np.iinfo(np.int32).min
 
 def _merge_kernel(key_ref, packed_ref, value_ref, is_set_ref, is_inc_ref,
                   valid_ref, winners_in, values_in, counters_in,
-                  winners_out, values_out, counters_out):
+                  winners_out, values_out, counters_out,
+                  orig_w_ref, base_c_ref):
     j = pl.program_id(1)
     c = pl.program_id(2)
     k_base = j * KEY_TILE
@@ -56,12 +57,18 @@ def _merge_kernel(key_ref, packed_ref, value_ref, is_set_ref, is_inc_ref,
 
     # First op chunk for this state tile: seed the accumulators from the
     # input state (out blocks persist in VMEM across the sequential op-chunk
-    # grid axis, so later chunks read back their own partial results)
+    # grid axis, so later chunks read back their own partial results). The
+    # pre-batch winners and counter bases stash in scratch; counters_out
+    # accumulates only this batch's increments until the final chunk decides,
+    # per key, whether the old base survives (winner unchanged) or resets
+    # (a strictly newer set op won — matching fleet.apply.apply_op_batch).
     @pl.when(c == 0)
     def _seed():
         winners_out[:] = winners_in[:]
         values_out[:] = values_in[:]
-        counters_out[:] = counters_in[:]
+        orig_w_ref[:] = winners_in[:]
+        base_c_ref[:] = counters_in[:]
+        counters_out[:] = jnp.zeros_like(counters_in)
 
     # Dense one-hot over the key tile, [DN, OP_CHUNK, KEY_TILE]: Mosaic
     # cannot lower per-op dynamic lane slices, so the op axis is materialized
@@ -91,6 +98,14 @@ def _merge_kernel(key_ref, packed_ref, value_ref, is_set_ref, is_inc_ref,
     inc3 = in_tile & (is_inc_ref[:][:, :, None] != 0) & valid3
     counters_out[:] = counters_out[:] + \
         jnp.sum(jnp.where(inc3, value3, 0), axis=1)
+
+    # Final chunk: fold the pre-batch counter base back in wherever the
+    # winner is unchanged (a re-delivered standing winner keeps its base)
+    @pl.when(c == pl.num_programs(2) - 1)
+    def _finalize():
+        keep = winners_out[:] == orig_w_ref[:]
+        counters_out[:] = counters_out[:] + \
+            jnp.where(keep, base_c_ref[:], 0)
 
 
 def _pad_to(x, axis, multiple):
@@ -140,6 +155,7 @@ def pallas_apply_op_batch(state, ops, interpret=False):
         out_specs=[state_spec] * 3,
         out_shape=[jax.ShapeDtypeStruct((nd, nk), jnp.int32)] * 3,
         input_output_aliases={6: 0, 7: 1, 8: 2},
+        scratch_shapes=[pltpu.VMEM((DOC_TILE, KEY_TILE), jnp.int32)] * 2,
         interpret=interpret,
     )(key_id, packed, value, is_set, is_inc, valid,
       winners, values, counters)

@@ -1,0 +1,457 @@
+"""The device-routed fleet backend (automerge_tpu.fleet.backend): drop-in
+Backend-contract conformance, differential equivalence against the host
+backend, promotion/fallback, device materialization, and sync interop.
+
+Modeled on the reference's alternative-backend harness (test/wasm.js:27-36):
+the same change streams go through the host backend and the fleet backend,
+asserting identical patches, state, and serialization."""
+
+import copy
+
+import numpy as np
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import backend as host_backend
+from automerge_tpu.columnar import encode_change
+from automerge_tpu.fleet import backend as fleet_backend
+from automerge_tpu.fleet.backend import DocFleet, FleetBackend, FleetDoc
+
+ACTORS = ['aa' * 16, 'bb' * 16, 'cc' * 16, '11' * 16]
+
+
+def change_buf(actor, seq, start_op, ops, deps=(), time=0, message=''):
+    return encode_change({
+        'actor': actor, 'seq': seq, 'startOp': start_op, 'time': time,
+        'message': message, 'deps': sorted(deps), 'ops': ops,
+    })
+
+
+def fresh_pair():
+    """A host backend handle and a fleet backend handle on a private fleet."""
+    fb = FleetBackend(DocFleet(doc_capacity=4, key_capacity=4))
+    return host_backend.init(), fb.init(), fb
+
+
+def apply_both(hb, gb, changes):
+    hb2, hp = host_backend.apply_changes(hb, changes)
+    gb2, gp = fleet_backend.apply_changes(gb, changes)
+    assert hp == gp
+    return hb2, gb2
+
+
+class TestDifferential:
+    def test_simple_sets_and_patches(self):
+        hb, gb, _ = fresh_pair()
+        c1 = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'bird', 'value': 'magpie',
+             'pred': []},
+            {'action': 'set', 'obj': '_root', 'key': 'n', 'value': 7,
+             'datatype': 'int', 'pred': []},
+        ])
+        hb, gb = apply_both(hb, gb, [c1])
+        c2 = change_buf(ACTORS[0], 2, 3, [
+            {'action': 'set', 'obj': '_root', 'key': 'bird', 'value': 'wren',
+             'pred': [f'1@{ACTORS[0]}']},
+        ], deps=host_backend.get_heads(hb))
+        hb, gb = apply_both(hb, gb, [c2])
+        assert host_backend.get_patch(hb) == fleet_backend.get_patch(gb)
+        assert gb['state'].materialize() == {'bird': 'wren', 'n': 7}
+
+    def test_concurrent_conflict_sets(self):
+        hb, gb, _ = fresh_pair()
+        c1 = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        c2 = change_buf(ACTORS[1], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'value': 2,
+             'datatype': 'int', 'pred': []}])
+        hb, gb = apply_both(hb, gb, [c1, c2])
+        hp = host_backend.get_patch(hb)
+        assert set(hp['diffs']['props']['x'].keys()) == \
+            {f'1@{ACTORS[0]}', f'1@{ACTORS[1]}'}
+        assert hp == fleet_backend.get_patch(gb)
+        # Lamport winner: equal counters, higher actor id wins
+        assert gb['state'].materialize() == {'x': 2}
+
+    def test_counter_accumulation(self):
+        hb, gb, _ = fresh_pair()
+        c1 = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'c', 'value': 10,
+             'datatype': 'counter', 'pred': []}])
+        hb, gb = apply_both(hb, gb, [c1])
+        c2 = change_buf(ACTORS[0], 2, 2, [
+            {'action': 'inc', 'obj': '_root', 'key': 'c', 'value': 4,
+             'pred': [f'1@{ACTORS[0]}']}],
+            deps=host_backend.get_heads(hb))
+        c3 = change_buf(ACTORS[1], 1, 2, [
+            {'action': 'inc', 'obj': '_root', 'key': 'c', 'value': -2,
+             'pred': [f'1@{ACTORS[0]}']}])
+        hb, gb = apply_both(hb, gb, [c2, c3])
+        hp = host_backend.get_patch(hb)
+        assert hp['diffs']['props']['c'][f'1@{ACTORS[0]}'] == \
+            {'type': 'value', 'value': 12, 'datatype': 'counter'}
+        assert hp == fleet_backend.get_patch(gb)
+        assert gb['state'].materialize() == {'c': 12}
+
+    def test_delete_and_empty_props(self):
+        hb, gb, _ = fresh_pair()
+        c1 = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        hb, gb = apply_both(hb, gb, [c1])
+        c2 = change_buf(ACTORS[0], 2, 2, [
+            {'action': 'del', 'obj': '_root', 'key': 'k',
+             'pred': [f'1@{ACTORS[0]}']}], deps=host_backend.get_heads(hb))
+        hb2, hp = host_backend.apply_changes(hb, [c2])
+        gb2, gp = fleet_backend.apply_changes(gb, [c2])
+        assert hp == gp
+        assert hp['diffs']['props']['k'] == {}
+        assert host_backend.get_patch(hb2) == fleet_backend.get_patch(gb2)
+        assert gb2['state'].materialize() == {}
+
+    def test_save_load_round_trip(self):
+        hb, gb, fb = fresh_pair()
+        c1 = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'a', 'value': 'x',
+             'pred': []}])
+        c2 = change_buf(ACTORS[1], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'b', 'value': True,
+             'pred': []}])
+        hb, gb = apply_both(hb, gb, [c1, c2])
+        assert bytes(host_backend.save(hb)) == bytes(fleet_backend.save(gb))
+        # Load the saved doc back through the fleet backend
+        gb2 = fb.load(host_backend.save(hb))
+        assert fleet_backend.get_patch(gb2) == host_backend.get_patch(hb)
+        assert gb2['state'].is_fleet
+
+    def test_queueing_missing_deps(self):
+        hb, gb, _ = fresh_pair()
+        c1 = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        h1 = am.decode_change(c1)['hash']
+        c2 = change_buf(ACTORS[0], 2, 2, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 2,
+             'datatype': 'int', 'pred': [f'1@{ACTORS[0]}']}], deps=[h1])
+        hb, gb = apply_both(hb, gb, [c2])   # queued: dep missing
+        assert fleet_backend.get_missing_deps(gb) == [h1]
+        hb, gb = apply_both(hb, gb, [c1])   # both drain
+        assert host_backend.get_patch(hb) == fleet_backend.get_patch(gb)
+        assert gb['state'].materialize() == {'k': 2}
+
+    def test_error_parity_and_rollback(self):
+        for bad_ops, msg in [
+            ([{'action': 'set', 'obj': '_root', 'key': 'k', 'value': 9,
+               'datatype': 'int', 'pred': [f'9@{ACTORS[1]}']}],
+             'no matching operation for pred'),
+            ([{'action': 'inc', 'obj': '_root', 'key': 'z', 'value': 1,
+               'pred': []}], 'unknown counter'),
+        ]:
+            hb, gb, _ = fresh_pair()
+            setup = change_buf(ACTORS[0], 1, 1, [
+                {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 1,
+                 'datatype': 'int', 'pred': []}])
+            hb, gb = apply_both(hb, gb, [setup])
+            bad = change_buf(ACTORS[0], 2, 2, bad_ops,
+                             deps=host_backend.get_heads(hb))
+            with pytest.raises(ValueError, match=msg):
+                host_backend.apply_changes(hb, [bad])
+            hb2, gb2, _ = fresh_pair()
+            hb2, gb2 = apply_both(hb2, gb2, [setup])
+            with pytest.raises(ValueError, match=msg):
+                fleet_backend.apply_changes(gb2, [bad])
+            # Fleet state must be unchanged after the failed call
+            assert fleet_backend.get_patch(gb2) == host_backend.get_patch(hb2)
+            assert gb2['state'].materialize() == {'k': 1}
+
+    def test_seq_gate_errors(self):
+        _, gb, _ = fresh_pair()
+        c = change_buf(ACTORS[0], 3, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        with pytest.raises(ValueError, match='Skipped sequence number'):
+            fleet_backend.apply_changes(gb, [c])
+
+    def test_randomized_differential(self):
+        rng = np.random.default_rng(7)
+        for trial in range(6):
+            hb, gb, fb = fresh_pair()
+            seqs = {a: 0 for a in ACTORS[:3]}
+            ctrs = {a: 0 for a in ACTORS[:3]}
+            visible = {}    # key -> set of opIds (tracked for pred choice)
+            values = ['x', -5, 3.25, None, True, 1 << 40, 'yy']
+            for step in range(30):
+                actor = ACTORS[int(rng.integers(0, 3))]
+                key = f'k{int(rng.integers(0, 5))}'
+                seqs[actor] += 1
+                ctr = max(ctrs.values()) + 1
+                kind = rng.random()
+                vis = sorted(visible.get(key, set()))
+                if kind < 0.55 or not vis:
+                    value = values[int(rng.integers(0, len(values)))] \
+                        if rng.random() < 0.5 else int(rng.integers(0, 100))
+                    pred = vis if rng.random() < 0.7 else []
+                    op = {'action': 'set', 'obj': '_root', 'key': key,
+                          'value': value, 'pred': pred}
+                    if isinstance(value, int) and not isinstance(value, bool):
+                        op['datatype'] = 'int'
+                    visible.setdefault(key, set()).difference_update(pred)
+                    visible[key].add(f'{ctr}@{actor}')
+                elif kind < 0.8:
+                    pred = vis
+                    op = {'action': 'del', 'obj': '_root', 'key': key,
+                          'pred': pred}
+                    visible[key].difference_update(pred)
+                else:
+                    value = int(rng.integers(0, 50))
+                    pred = vis
+                    op = {'action': 'set', 'obj': '_root', 'key': key,
+                          'value': value, 'datatype': 'counter', 'pred': pred}
+                    visible[key].difference_update(pred)
+                    visible[key].add(f'{ctr}@{actor}')
+                deps = host_backend.get_heads(hb) if rng.random() < 0.8 else []
+                buf = change_buf(actor, seqs[actor], ctr, [op], deps=deps)
+                ctrs[actor] = ctr
+                hb, gb = apply_both(hb, gb, [buf])
+            assert host_backend.get_patch(hb) == fleet_backend.get_patch(gb)
+            assert bytes(host_backend.save(hb)) == bytes(fleet_backend.save(gb))
+
+
+class TestDeviceMaterialization:
+    def test_device_matches_mirror(self):
+        fb = FleetBackend(DocFleet(doc_capacity=4, key_capacity=4))
+        rng = np.random.default_rng(3)
+        handles = fleet_backend.init_docs(6, fb.fleet)
+        seqs = [0] * 6
+        per_doc = [[] for _ in range(6)]
+        for d in range(6):
+            ctr = 0
+            vis = {}
+            actor = ACTORS[d % 2]
+            for _ in range(12):
+                key = f'k{int(rng.integers(0, 6))}'
+                ctr += 1
+                if rng.random() < 0.3 and vis.get(key):
+                    op = {'action': 'del', 'obj': '_root', 'key': key,
+                          'pred': sorted(vis[key])}
+                    vis[key] = set()
+                else:
+                    op = {'action': 'set', 'obj': '_root', 'key': key,
+                          'value': int(rng.integers(0, 1000)),
+                          'datatype': 'int', 'pred': sorted(vis.get(key, set()))}
+                    vis[key] = {f'{ctr}@{actor}'}
+                seqs[d] += 1
+                deps = host_backend.get_heads(handles[d]) if seqs[d] > 1 else []
+                per_doc[d].append(change_buf(actor, seqs[d], ctr,
+                                             [op], deps=deps))
+            handles[d], _ = fleet_backend.apply_changes(handles[d], per_doc[d])
+        mirror = [h['state'].materialize() for h in handles]
+        device = fleet_backend.materialize_docs(handles)
+        assert device == mirror
+
+    def test_negative_inc_delta_device_parity(self):
+        """Negative inc deltas must land inline in the value column, not as
+        value-table references (regression: device counters were corrupted
+        by the table index)."""
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=2))
+        gb = fb.init()
+        c1 = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'c', 'value': 10,
+             'datatype': 'counter', 'pred': []}])
+        gb, _ = fleet_backend.apply_changes(gb, [c1])
+        c2 = change_buf(ACTORS[0], 2, 2, [
+            {'action': 'inc', 'obj': '_root', 'key': 'c', 'value': -5,
+             'pred': [f'1@{ACTORS[0]}']}], deps=fleet_backend.get_heads(gb))
+        gb, _ = fleet_backend.apply_changes(gb, [c2])
+        assert gb['state'].materialize() == {'c': 5}
+        assert fleet_backend.materialize_docs([gb]) == [{'c': 5}]
+
+    def test_counter_overwrite_resets_device_accumulator(self):
+        """A causally-later plain set over a counter must not inherit the
+        counter's accumulated increments on the device read path
+        (regression: the counters column was never reset)."""
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=2))
+        gb = fb.init()
+        c1 = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'c', 'value': 10,
+             'datatype': 'counter', 'pred': []}])
+        gb, _ = fleet_backend.apply_changes(gb, [c1])
+        c2 = change_buf(ACTORS[0], 2, 2, [
+            {'action': 'inc', 'obj': '_root', 'key': 'c', 'value': 3,
+             'pred': [f'1@{ACTORS[0]}']}], deps=fleet_backend.get_heads(gb))
+        gb, _ = fleet_backend.apply_changes(gb, [c2])
+        # Flush so the overwrite arrives in a separate device batch
+        assert fleet_backend.materialize_docs([gb]) == [{'c': 13}]
+        c3 = change_buf(ACTORS[0], 3, 3, [
+            {'action': 'set', 'obj': '_root', 'key': 'c', 'value': 100,
+             'datatype': 'int', 'pred': [f'1@{ACTORS[0]}']}],
+            deps=fleet_backend.get_heads(gb))
+        gb, _ = fleet_backend.apply_changes(gb, [c3])
+        assert gb['state'].materialize() == {'c': 100}
+        assert fleet_backend.materialize_docs([gb]) == [{'c': 100}]
+
+    def test_actor_renumbering_tie_break(self):
+        """Equal op counters, actors arriving in non-sorted order: the device
+        scatter-max must still pick the reference's Lamport winner."""
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=2))
+        gb = fb.init()
+        # 'bb…' arrives first (gets number 0), then 'aa…' must renumber
+        c1 = change_buf(ACTORS[1], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        gb, _ = fleet_backend.apply_changes(gb, [c1])
+        fb.fleet.flush()
+        c2 = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'value': 2,
+             'datatype': 'int', 'pred': []}])
+        gb, _ = fleet_backend.apply_changes(gb, [c2])
+        assert fleet_backend.materialize_docs([gb]) == [{'x': 1}]
+        assert gb['state'].materialize() == {'x': 1}
+
+    def test_batched_apply_one_dispatch(self):
+        fb = FleetBackend(DocFleet(doc_capacity=8, key_capacity=8))
+        handles = fleet_backend.init_docs(5, fb.fleet)
+        per_doc = []
+        for d in range(5):
+            per_doc.append([change_buf(ACTORS[0], 1, 1, [
+                {'action': 'set', 'obj': '_root', 'key': f'k{d}', 'value': d,
+                 'datatype': 'int', 'pred': []}])])
+        before = fb.fleet.dispatches
+        handles, patches = fleet_backend.apply_changes_docs(handles, per_doc)
+        assert fb.fleet.dispatches == before + 1
+        assert all(p is not None for p in patches)
+        docs = fleet_backend.materialize_docs(handles)
+        assert docs == [{f'k{d}': d} for d in range(5)]
+
+    def test_key_grid_growth(self):
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=2))
+        gb = fb.init()
+        for i in range(20):
+            c = change_buf(ACTORS[0], i + 1, i + 1, [
+                {'action': 'set', 'obj': '_root', 'key': f'key{i}', 'value': i,
+                 'datatype': 'int', 'pred': []}],
+                deps=fleet_backend.get_heads(gb))
+            gb, _ = fleet_backend.apply_changes(gb, [c])
+            fb.fleet.flush()
+        expected = {f'key{i}': i for i in range(20)}
+        assert fleet_backend.materialize_docs([gb]) == [expected]
+
+    def test_clone_and_free(self):
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=2))
+        gb = fb.init()
+        c = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'a', 'value': 5,
+             'datatype': 'int', 'pred': []}])
+        gb, _ = fleet_backend.apply_changes(gb, [c])
+        gb2 = fleet_backend.clone(gb)
+        c2 = change_buf(ACTORS[1], 1, 2, [
+            {'action': 'set', 'obj': '_root', 'key': 'b', 'value': 6,
+             'datatype': 'int', 'pred': []}],
+            deps=fleet_backend.get_heads(gb2))
+        gb2, _ = fleet_backend.apply_changes(gb2, [c2])
+        assert fleet_backend.materialize_docs([gb2]) == [{'a': 5, 'b': 6}]
+        assert gb['state'].materialize() == {'a': 5}
+        fleet_backend.free(gb2)
+        assert gb2['state'] is None
+
+
+class TestPromotion:
+    def test_nested_object_promotes(self):
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=2))
+        hb = host_backend.init()
+        gb = fb.init()
+        flat = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'a', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        hb, gb = apply_both(hb, gb, [flat])
+        assert gb['state'].is_fleet
+        nested = change_buf(ACTORS[0], 2, 2, [
+            {'action': 'makeMap', 'obj': '_root', 'key': 'm', 'pred': []},
+            {'action': 'set', 'obj': f'2@{ACTORS[0]}', 'key': 'x', 'value': 9,
+             'datatype': 'int', 'pred': []}],
+            deps=host_backend.get_heads(hb))
+        hb, gb = apply_both(hb, gb, [nested])
+        assert not gb['state'].is_fleet
+        assert host_backend.get_patch(hb) == fleet_backend.get_patch(gb)
+        # Flat ops still work after promotion
+        more = change_buf(ACTORS[0], 3, 4, [
+            {'action': 'set', 'obj': '_root', 'key': 'a', 'value': 2,
+             'datatype': 'int', 'pred': [f'1@{ACTORS[0]}']}],
+            deps=host_backend.get_heads(hb))
+        hb, gb = apply_both(hb, gb, [more])
+        assert bytes(host_backend.save(hb)) == bytes(fleet_backend.save(gb))
+
+    def test_promotion_preserves_queue(self):
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=2))
+        gb = fb.init()
+        c1 = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        h1 = am.decode_change(c1)['hash']
+        c2 = change_buf(ACTORS[0], 2, 2, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 2,
+             'datatype': 'int', 'pred': [f'1@{ACTORS[0]}']}], deps=[h1])
+        gb, patch = fleet_backend.apply_changes(gb, [c2])
+        assert patch['pendingChanges'] == 1
+        nested = change_buf(ACTORS[1], 1, 1, [
+            {'action': 'makeList', 'obj': '_root', 'key': 'l', 'pred': []}])
+        gb, _ = fleet_backend.apply_changes(gb, [nested])
+        assert not gb['state'].is_fleet
+        gb, patch = fleet_backend.apply_changes(gb, [c1])
+        assert patch['pendingChanges'] == 0
+        props = fleet_backend.get_patch(gb)['diffs']['props']
+        assert props['k'] == {f'2@{ACTORS[0]}':
+                              {'type': 'value', 'value': 2, 'datatype': 'int'}}
+
+
+class TestSyncInterop:
+    def test_fleet_host_sync_convergence(self):
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=2))
+        gb = fb.init()
+        hb = host_backend.init()
+        c1 = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'fleet', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        gb, _ = fleet_backend.apply_changes(gb, [c1])
+        c2 = change_buf(ACTORS[1], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'host', 'value': 2,
+             'datatype': 'int', 'pred': []}])
+        hb, _ = host_backend.apply_changes(hb, [c2])
+
+        s1, s2 = fleet_backend.init_sync_state(), host_backend.init_sync_state()
+        for _ in range(10):
+            s1, msg = fleet_backend.generate_sync_message(gb, s1)
+            if msg is not None:
+                hb, s2, _ = host_backend.receive_sync_message(hb, s2, msg)
+            s2, reply = host_backend.generate_sync_message(hb, s2)
+            if reply is not None:
+                gb, s1, _ = fleet_backend.receive_sync_message(gb, s1, reply)
+            if msg is None and reply is None:
+                break
+        assert fleet_backend.get_heads(gb) == host_backend.get_heads(hb)
+        assert fleet_backend.get_patch(gb) == host_backend.get_patch(hb)
+        assert gb['state'].materialize() == {'fleet': 1, 'host': 2}
+
+
+class TestDropIn:
+    def test_set_default_backend_public_api(self):
+        fb = FleetBackend(DocFleet(doc_capacity=4, key_capacity=4))
+        am.set_default_backend(fb)
+        try:
+            d1 = am.init(ACTORS[0])
+            d1 = am.change(d1, lambda doc: doc.update({'title': 'fleet'}))
+            d2 = am.init(ACTORS[1])
+            d2 = am.merge(d2, d1)
+            d2 = am.change(d2, lambda doc: doc.update({'count': 3}))
+            d1 = am.merge(d1, d2)
+            assert d1['title'] == 'fleet'
+            assert d1['count'] == 3
+            data = am.save(d1)
+            d3 = am.load(data)
+            assert am.equals(d3, d1)
+            # Nested objects trigger transparent promotion
+            d1 = am.change(d1, lambda doc: doc.update({'nested': {'x': 1}}))
+            assert d1['nested']['x'] == 1
+        finally:
+            am.set_default_backend(host_backend)

@@ -106,3 +106,33 @@ def test_counter_accumulation_and_overwrite():
     state, _ = pallas_apply_op_batch(state, ops2, interpret=True)
     assert np.asarray(state.values)[0, 0] == 42
     assert np.asarray(state.winners)[0, 0] == 9 << ACTOR_BITS
+    # The overwritten counter's accumulator resets with its op
+    assert np.asarray(state.counters)[0, 0] == 0
+
+
+def test_counter_reset_parity_with_jnp():
+    """Winner-change counter reset must match between both kernels,
+    including the keep-base case (re-delivered standing winner)."""
+    n_docs, n_keys = 4, 8
+    base = FleetState.empty(n_docs, n_keys)
+    mk = lambda key, packed, value, is_set: OpBatch(
+        np.full((n_docs, 1), key, np.int32),
+        np.full((n_docs, 1), packed, np.int32),
+        np.full((n_docs, 1), value, np.int32),
+        np.full((n_docs, 1), is_set, bool),
+        np.full((n_docs, 1), not is_set, bool),
+        np.ones((n_docs, 1), bool))
+    rounds = [
+        mk(0, 1 << ACTOR_BITS, 10, True),    # counter base
+        mk(0, 2 << ACTOR_BITS, -4, False),   # negative inc
+        mk(0, 1 << ACTOR_BITS, 10, True),    # duplicate delivery: keep base
+        mk(0, 9 << ACTOR_BITS, 100, True),   # overwrite: reset
+        mk(0, 11 << ACTOR_BITS, 2, False),   # inc on the new winner
+    ]
+    a = b = base
+    for ops in rounds:
+        a, _ = apply_op_batch(a, ops)
+        b, _ = pallas_apply_op_batch(b, ops, interpret=True)
+        assert_states_match(b, a, n_keys)
+    assert np.asarray(a.counters)[0, 0] == 2
+    assert np.asarray(a.values)[0, 0] == 100
