@@ -264,6 +264,35 @@ class _FlatEngine(HashGraph):
         self.all_ops = {}         # key -> set of row opIds (set + inc ops)
         self.binary_doc = None
         self._op_set_cache = None
+        # True after a turbo (metadata-only) apply: the hash graph and device
+        # state are current but visible/all_ops are not; reads rebuild lazily
+        self.stale = False
+
+    def _replay_mirror(self):
+        """Rebuild visible/all_ops (and actor/max-op bookkeeping) by
+        replaying the committed log host-side."""
+        fresh = _FlatEngine(self.fleet, self.slot)
+        for buffer in self.changes:
+            change = decode_change(bytes(buffer))
+            fresh._apply_decoded_change({}, change)
+        self.visible = fresh.visible
+        self.all_ops = fresh.all_ops
+        self.max_op = fresh.max_op
+        self.actor_ids = fresh.actor_ids
+
+    def _ensure_mirror(self):
+        """Rebuild the visible-op mirror after turbo applies (deferred
+        exactly like the reference's deferred hash graph, new.js:1887-1912).
+        Raises if the committed log contains a change turbo could not
+        validate (dangling pred) — see apply_changes_docs' trust note."""
+        if not self.stale:
+            return
+        self._replay_mirror()
+        # Turbo queue entries carry only metadata; re-decode so the exact
+        # drain path can apply their ops when deps arrive
+        self.queue = [dict(decode_change(bytes(c['buffer'])), buffer=c['buffer'])
+                      for c in self.queue]
+        self.stale = False
 
     # -- change application --------------------------------------------
 
@@ -275,6 +304,7 @@ class _FlatEngine(HashGraph):
         for change in decoded:
             for op in change['ops']:
                 self._check_flat(op)
+        self._ensure_mirror()
 
         props = {}
         backup = (dict(self.clock), list(self.heads), list(self.queue))
@@ -317,15 +347,7 @@ class _FlatEngine(HashGraph):
         """Restore the mirror by replaying the committed log host-side (the
         device never saw the failed call; enqueue happens only on success)."""
         self.clock, self.heads, self.queue = backup
-        fresh = _FlatEngine(self.fleet, self.slot)
-        for buffer in self.changes:
-            change = decode_change(bytes(buffer))
-            acc = {}
-            fresh._apply_decoded_change(acc, change)
-        self.visible = fresh.visible
-        self.all_ops = fresh.all_ops
-        self.max_op = fresh.max_op
-        self.actor_ids = fresh.actor_ids
+        self._replay_mirror()
 
     def _apply_decoded_change(self, props, change):
         if change['actor'] not in self.actor_ids:
@@ -380,6 +402,7 @@ class _FlatEngine(HashGraph):
     # -- reads ----------------------------------------------------------
 
     def get_patch(self):
+        self._ensure_mirror()
         props = {}
         for key, vis in self.visible.items():
             if vis:
@@ -391,6 +414,7 @@ class _FlatEngine(HashGraph):
     def materialize(self):
         """Exact {key: value} view from the host mirror (LWW winner per key,
         ascending-Lamport max, matching frontend/apply_patch.js:33-42)."""
+        self._ensure_mirror()
         from ..common import lamport_key
         doc = {}
         for key, vis in self.visible.items():
@@ -415,6 +439,7 @@ class _FlatEngine(HashGraph):
         return self.binary_doc
 
     def clone_engine(self):
+        self._ensure_mirror()
         other = _FlatEngine(self.fleet, self.fleet.clone_slot(self.slot))
         for field in ('max_op', 'actor_ids', 'heads', 'clock', 'queue',
                       'changes', 'changes_meta', 'change_index_by_hash',
@@ -593,10 +618,32 @@ def init_docs(n, fleet=None):
     return [init(fleet) for _ in range(n)]
 
 
-def apply_changes_docs(handles, per_doc_changes):
-    """Apply per-document change lists across the fleet: per-doc causal
-    gating and patch mirrors on host, then ONE batched ingest + merge
-    dispatch for every document's ops. Returns (new_handles, patches)."""
+def apply_changes_docs(handles, per_doc_changes, mirror=True):
+    """Apply per-document change lists across the fleet. Returns
+    (new_handles, patches).
+
+    mirror=True (exact): per-doc causal gating and patch mirrors on host,
+    then ONE batched ingest + merge dispatch for every document's ops.
+
+    mirror=False (turbo): only change *headers* are decoded on host (hash,
+    deps, actor/seq — the causal gate and hash graph stay exact); the op
+    columns go straight from the wire through the native C++ parser into the
+    device merge, never materializing per-op Python objects. Patches come
+    back as None and per-key mirrors are marked stale — reads rebuild them
+    lazily. Sync protocol functions need only the hash graph, so they work
+    on turbo documents without any rebuild.
+
+    Trust note: turbo validates the causal gate (seq contiguity, deps),
+    chunk checksums/hashes, and intra-batch duplicate opIds, but NOT per-op
+    pred well-formedness (that requires decoding op objects — the cost turbo
+    exists to skip). A change with a dangling pred is rejected up front by
+    mirror=True but only at the next mirror rebuild under turbo. Use
+    mirror=True for untrusted peers; per-op pred columns in the native
+    parser are the planned lift."""
+    if not mirror:
+        turbo = _apply_changes_turbo(handles, per_doc_changes)
+        if turbo is not None:
+            return turbo
     out_handles, patches = [], []
     for handle, changes in zip(handles, per_doc_changes):
         if changes:
@@ -614,6 +661,179 @@ def apply_changes_docs(handles, per_doc_changes):
     if fleet is not None:
         fleet.flush()
     return out_handles, patches
+
+
+def _apply_changes_turbo(handles, per_doc_changes):
+    """Header-decode + native-ingest batched apply. Returns None when the
+    workload can't take the turbo path (no native codec, non-fleet docs,
+    multi-chunk buffers, or ops outside the flat subset), in which case the
+    caller falls back to the exact path."""
+    from .. import native
+    from ..columnar import decode_change_meta
+    from .apply import apply_op_batch
+    from .tensor_doc import OpBatch, MAX_ACTORS as _MA
+
+    if not native.available() or not handles:
+        return None
+    engines = []
+    for handle in handles:
+        state = handle.get('state')
+        if handle.get('frozen') or not isinstance(state, FleetDoc) or \
+                not state.is_fleet:
+            return None
+        if state._impl.queue:
+            # Draining held-back changes needs their op rows; the exact path
+            # re-ingests them on flush, so route this call there
+            return None
+        engines.append(state._impl)
+    fleet = engines[0].fleet
+    if any(e.fleet is not fleet for e in engines):
+        return None
+
+    flat_buffers, change_doc = [], []
+    for d, changes in enumerate(per_doc_changes):
+        for buf in changes:
+            buf = bytes(buf)
+            if len(buf) < 12 or buf[8] not in (1, 2):
+                return None     # document chunks etc: exact path
+            flat_buffers.append(buf)
+            change_doc.append(d)
+    if not flat_buffers:
+        return handles, [None] * len(handles)
+
+    out = native.ingest_changes(flat_buffers,
+                                list(range(len(flat_buffers))))
+    if out is None:
+        return None             # ops outside the flat subset
+    rows, nat_keys, nat_actors = out
+    ops_per_change = np.bincount(rows['doc'], minlength=len(flat_buffers))
+
+    # Header decode (hash + deps + actor/seq) and per-doc causal gating
+    metas = [decode_change_meta(buf, True) for buf in flat_buffers]
+    per_doc_metas = [[] for _ in range(len(handles))]
+    for i, meta in enumerate(metas):
+        n_ops = int(ops_per_change[i])
+        per_doc_metas[change_doc[i]].append({
+            'actor': meta['actor'], 'seq': meta['seq'],
+            'startOp': meta['startOp'], 'time': meta.get('time', 0),
+            'message': meta.get('message') or '',
+            'deps': list(meta['deps']),
+            'extraBytes': meta.get('extraBytes'),
+            'hash': meta['hash'], 'buffer': flat_buffers[i],
+            'ops': range(n_ops), '_change_index': i,
+        })
+
+    # Phase 1 — fallible: causal-gate every doc, committing nothing durable.
+    # _drain_queue mutates clock/heads, so every engine carries a backup and
+    # any failure restores ALL of them: the whole turbo call is atomic
+    # (the exact path gets per-doc atomicity from fleet.pending instead).
+    ready = np.zeros(len(flat_buffers), dtype=bool)
+    applied_actors = set()
+    staged = []                  # (engine, applied, queue)
+    backups = []                 # (engine, clock, heads, queue)
+
+    def restore_all():
+        for engine, clock, heads, queue in backups:
+            engine.clock, engine.heads, engine.queue = clock, heads, queue
+
+    for d, engine in enumerate(engines):
+        if not per_doc_metas[d]:
+            continue
+        backups.append((engine, dict(engine.clock), list(engine.heads),
+                        list(engine.queue)))
+        try:
+            applied, queue = engine._drain_queue(per_doc_metas[d],
+                                                 lambda change: None)
+        except Exception:
+            restore_all()
+            raise
+        staged.append((engine, applied, queue))
+        for change in applied:
+            applied_actors.add(change['actor'])
+            ready[change['_change_index']] = True
+
+    keep = ready[rows['doc']]
+    # Partial validation from the native rows: duplicate opIds *within* the
+    # applied batch are detectable per doc without decoding op objects.
+    # (Pred well-formedness and duplicates against history are NOT checkable
+    # here — see the trust note in apply_changes_docs.)
+    kept_change = rows['doc'][keep]      # native 'doc' is the change index
+    kept_packed_nat = rows['packed'][keep]
+    if len(kept_packed_nat):
+        kept_doc = np.array(change_doc, dtype=np.int64)[kept_change]
+        pairs = kept_doc * (1 << 32) + kept_packed_nat
+        if len(np.unique(pairs)) != len(pairs):
+            restore_all()
+            raise ValueError('duplicate operation ID in turbo batch')
+
+    # Phase 2 — infallible: record the hash graph, queues, staleness
+    for engine, applied, queue in staged:
+        for change in applied:
+            engine._record_applied(change)
+            engine.max_op = max(engine.max_op,
+                                change['startOp'] + len(change['ops']) - 1)
+            engine.stale = True
+            engine.binary_doc = None
+            engine._op_set_cache = None
+        engine.queue = queue
+        if queue:
+            # Queue entries from this pass carry only headers; flag the
+            # mirror so the exact path re-decodes them before draining
+            engine.stale = True
+
+    out_handles = []
+    for handle in handles:
+        handle['frozen'] = True
+        out_handles.append({'state': handle['state'],
+                            'heads': handle['state'].heads})
+    result = out_handles, [None] * len(handles)
+    if not keep.any():
+        return result            # everything queued: no device work
+
+    # Device batch: remap the native parser's key/actor numbering into the
+    # fleet tables (interning only keys that actually land on the device)
+    perm = fleet.actors.insert_many(applied_actors)
+    if perm is not None:
+        fleet._remap_actors(perm)
+    key_map = np.zeros(max(len(nat_keys), 1), dtype=np.int32)
+    for k in np.unique(rows['key'][keep]):
+        key_map[k] = fleet.keys.intern(nat_keys[k])
+    actor_map = np.array([fleet.actors.index.get(a, 0) for a in nat_actors],
+                         dtype=np.int32) if nat_actors else np.zeros(1, np.int32)
+    doc_arr = np.array(change_doc, dtype=np.int32)[kept_change]
+    slots = np.array([e.slot for e in engines], dtype=np.int32)[doc_arr]
+    key = key_map[rows['key'][keep]]
+    ctr = kept_packed_nat >> 8
+    actor = actor_map[kept_packed_nat & (_MA - 1)]
+    packed = (ctr << 8) | actor
+
+    n_slots = fleet.n_slots
+    counts = np.bincount(slots, minlength=n_slots)
+    max_ops = max(int(counts.max()) if counts.size else 0, 1)
+    order = np.argsort(slots, kind='stable')
+    slot_sorted = slots[order]
+    pos = np.arange(len(slot_sorted)) - \
+        np.searchsorted(slot_sorted, slot_sorted, side='left')
+    shape = (n_slots, max_ops)
+    cols = {name: np.zeros(shape, dtype=np.int32)
+            for name in ('key_id', 'packed', 'value')}
+    flags = np.zeros(shape, dtype=np.int8)
+    cols['key_id'][slot_sorted, pos] = key[order]
+    cols['packed'][slot_sorted, pos] = packed[order]
+    cols['value'][slot_sorted, pos] = rows['value'][keep][order]
+    flags[slot_sorted, pos] = rows['flags'][keep][order]
+    batch = OpBatch(cols['key_id'], cols['packed'], cols['value'],
+                    flags == 1, flags == 2, flags != 0)
+
+    fleet._ensure_capacity(n_docs=n_slots, n_keys=len(fleet.keys))
+    n_cap = fleet.state.winners.shape[0]
+    if batch.key_id.shape[0] < n_cap:
+        pad = n_cap - batch.key_id.shape[0]
+        batch = OpBatch(*(np.pad(col, ((0, pad), (0, 0)))
+                          for col in batch.tree_flatten()[0]))
+    fleet.state, _stats = apply_op_batch(fleet.state, batch)
+    fleet.dispatches += 1
+    return result
 
 
 def materialize_docs(handles):

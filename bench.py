@@ -151,6 +151,84 @@ def bench_pipeline(n_docs, n_keys, changes_per_doc, seed=0):
     return (n_docs * changes_per_doc) / elapsed, elapsed
 
 
+def bench_backend_pipeline(n_docs, n_keys, changes_per_doc, seed=0):
+    """Wire-to-device through the Backend seam (fleet.backend turbo path):
+    header decode + SHA-256 hash graph + causal gate on host, native C++
+    column parse, one device merge dispatch. This is the full
+    setDefaultBackend-pluggable pipeline, unlike bench_pipeline which skips
+    the causal/hash-graph bookkeeping."""
+    from automerge_tpu.columnar import encode_change, decode_change_meta
+    from automerge_tpu.fleet.backend import (
+        DocFleet, init_docs, apply_changes_docs, materialize_docs)
+    rng = np.random.default_rng(seed)
+    actors = ['aa' * 16, 'bb' * 16]
+    per_doc = []
+    for d in range(n_docs):
+        changes, heads = [], []
+        seqs = [0, 0]
+        for c in range(changes_per_doc):
+            a = c % 2
+            seqs[a] += 1
+            buf = encode_change({
+                'actor': actors[a], 'seq': seqs[a], 'startOp': c + 1,
+                'time': 0, 'message': '', 'deps': heads,
+                'ops': [{'action': 'set', 'obj': '_root',
+                         'key': f'k{int(rng.integers(0, n_keys))}',
+                         'value': int(rng.integers(1, 1 << 20)),
+                         'datatype': 'int', 'pred': []}]})
+            heads = [decode_change_meta(buf, True)['hash']]
+            changes.append(buf)
+        per_doc.append(changes)
+
+    def run():
+        fleet = DocFleet(doc_capacity=n_docs, key_capacity=n_keys + 1)
+        handles = init_docs(n_docs, fleet)
+        handles, _ = apply_changes_docs(handles, per_doc, mirror=False)
+        import jax
+        jax.block_until_ready(fleet.state.winners)
+        return handles
+
+    run()  # warmup compile
+    start = time.perf_counter()
+    run()
+    elapsed = time.perf_counter() - start
+    return (n_docs * changes_per_doc) / elapsed, elapsed
+
+
+def bench_sync_bloom(n_docs, hashes_per_doc, seed=0):
+    """Config 4 (BASELINE.md): sync Bloom-filter throughput. Device path:
+    per-peer filters for the whole fleet built in one scatter dispatch and
+    probed in one gather dispatch ([docs, bits] bit tensors); host baseline:
+    the per-peer BloomFilter loop the reference runs per sync message
+    (ref sync.js:38-125). Returns (device_hashes_per_sec, host_hashes_per_sec)."""
+    import hashlib
+    import jax
+    from automerge_tpu.backend.sync import BloomFilter
+    from automerge_tpu.fleet.bloom import (
+        build_bloom_filters, probe_bloom_filters, hashes_to_words)
+    hashes = [[hashlib.sha256(f'{d}:{i}:{seed}'.encode()).hexdigest()
+               for i in range(hashes_per_doc)] for d in range(n_docs)]
+    words, valid = hashes_to_words(hashes)
+    words = jax.device_put(words)
+    valid = jax.device_put(valid)
+    bits = build_bloom_filters(words, valid, hashes_per_doc)  # warmup build
+    probe_bloom_filters(bits, words, valid).block_until_ready()
+    start = time.perf_counter()
+    bits = build_bloom_filters(words, valid, hashes_per_doc)
+    hit = probe_bloom_filters(bits, words, valid)
+    jax.block_until_ready(hit)
+    device_rate = (2 * n_docs * hashes_per_doc) / (time.perf_counter() - start)
+
+    host_docs = max(n_docs // 100, 1)
+    start = time.perf_counter()
+    for d in range(host_docs):
+        f = BloomFilter(hashes[d])
+        for h in hashes[d]:
+            assert f.contains_hash(h)
+    host_rate = (2 * host_docs * hashes_per_doc) / (time.perf_counter() - start)
+    return device_rate, host_rate
+
+
 def bench_text(n_docs, trace_len, n_actors=3, seed=0):
     """Config 2 (BASELINE.md): batched text editing traces through the device
     sequence engine — n_docs docs, each applying a trace_len-op multi-actor
@@ -211,13 +289,24 @@ def main():
     # Full-pipeline (wire decode included) on a medium fleet, for the record
     pipe_rate, _ = bench_pipeline(int(os.environ.get('BENCH_PIPE_DOCS', 500)),
                                   n_keys, 20)
+    # Same, through the Backend seam (causal gate + hash graph included)
+    seam_rate, _ = bench_backend_pipeline(
+        int(os.environ.get('BENCH_SEAM_DOCS', 500)), n_keys, 20)
     # Config 2: batched text-trace editing through the device sequence engine
     text_rate, _ = bench_text(int(os.environ.get('BENCH_TEXT_DOCS', 2000)),
                               int(os.environ.get('BENCH_TEXT_LEN', 512)))
+    # Config 4: sync Bloom filters, device fleet vs per-peer host loop
+    bloom_dev, bloom_host = bench_sync_bloom(
+        int(os.environ.get('BENCH_BLOOM_DOCS', 10000)),
+        int(os.environ.get('BENCH_BLOOM_HASHES', 32)))
     print(f'# pipeline (wire->device incl. native decode): '
           f'{pipe_rate:.0f} changes/s', file=sys.stderr)
+    print(f'# backend-seam pipeline (turbo, incl. hash graph): '
+          f'{seam_rate:.0f} changes/s', file=sys.stderr)
     print(f'# sequence engine (text traces): {text_rate:.0f} ops/s',
           file=sys.stderr)
+    print(f'# sync bloom build+probe: device {bloom_dev:.0f} hashes/s, '
+          f'host {bloom_host:.0f} hashes/s', file=sys.stderr)
     print(f'# host reference engine: {host_rate:.0f} changes/s', file=sys.stderr)
 
     result = {

@@ -356,6 +356,161 @@ class TestDeviceMaterialization:
         assert gb2['state'] is None
 
 
+class TestTurboPath:
+    def _workload(self, n_docs, n_changes, rng):
+        per_doc = []
+        for d in range(n_docs):
+            changes, heads = [], []
+            for c in range(n_changes):
+                buf = change_buf(ACTORS[d % 3], c + 1, c + 1, [
+                    {'action': 'set', 'obj': '_root',
+                     'key': f'k{int(rng.integers(0, 4))}',
+                     'value': int(rng.integers(0, 500)),
+                     'datatype': 'int', 'pred': []}], deps=heads)
+                heads = [am.decode_change(buf)['hash']]
+                changes.append(buf)
+            per_doc.append(changes)
+        return per_doc
+
+    def test_turbo_matches_exact(self):
+        rng = np.random.default_rng(11)
+        per_doc = self._workload(5, 8, rng)
+        fb1 = FleetBackend(DocFleet(doc_capacity=8, key_capacity=8))
+        fb2 = FleetBackend(DocFleet(doc_capacity=8, key_capacity=8))
+        exact = fleet_backend.init_docs(5, fb1.fleet)
+        turbo = fleet_backend.init_docs(5, fb2.fleet)
+        exact, ep = fleet_backend.apply_changes_docs(exact, per_doc)
+        turbo, tp = fleet_backend.apply_changes_docs(turbo, per_doc,
+                                                     mirror=False)
+        assert all(p is None for p in tp)
+        assert fleet_backend.materialize_docs(exact) == \
+            fleet_backend.materialize_docs(turbo)
+        # Mirrors rebuild lazily and agree with the exact path
+        for e, t in zip(exact, turbo):
+            assert t['state']._impl.stale
+            assert fleet_backend.get_patch(t) == fleet_backend.get_patch(e)
+            assert not t['state']._impl.stale
+            assert fleet_backend.get_heads(t) == fleet_backend.get_heads(e)
+            assert bytes(fleet_backend.save(t)) == bytes(fleet_backend.save(e))
+
+    def test_turbo_then_exact_interleave(self):
+        fb = FleetBackend(DocFleet(doc_capacity=4, key_capacity=4))
+        handles = fleet_backend.init_docs(2, fb.fleet)
+        c1 = [[change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'a', 'value': d + 1,
+             'datatype': 'int', 'pred': []}])] for d in range(2)]
+        handles, _ = fleet_backend.apply_changes_docs(handles, c1,
+                                                      mirror=False)
+        # Exact call on a stale doc rebuilds the mirror and keeps going
+        h0 = handles[0]
+        c2 = change_buf(ACTORS[0], 2, 2, [
+            {'action': 'set', 'obj': '_root', 'key': 'b', 'value': 9,
+             'datatype': 'int', 'pred': []}],
+            deps=fleet_backend.get_heads(h0))
+        h0, patch = fleet_backend.apply_changes(h0, [c2])
+        assert patch['diffs']['props']['b'] == \
+            {f'2@{ACTORS[0]}': {'type': 'value', 'value': 9,
+                                'datatype': 'int'}}
+        assert h0['state'].materialize() == {'a': 1, 'b': 9}
+        assert fleet_backend.materialize_docs([h0, handles[1]]) == \
+            [{'a': 1, 'b': 9}, {'a': 2}]
+
+    def test_turbo_queues_missing_deps(self):
+        fb = FleetBackend(DocFleet(doc_capacity=4, key_capacity=4))
+        handles = fleet_backend.init_docs(1, fb.fleet)
+        c1 = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        h1 = am.decode_change(c1)['hash']
+        c2 = change_buf(ACTORS[0], 2, 2, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 2,
+             'datatype': 'int', 'pred': [f'1@{ACTORS[0]}']}], deps=[h1])
+        handles, _ = fleet_backend.apply_changes_docs(handles, [[c2]],
+                                                      mirror=False)
+        assert fleet_backend.get_missing_deps(handles[0]) == [h1]
+        # Dep arrives; queued change drains through the exact path
+        handles, _ = fleet_backend.apply_changes_docs(handles, [[c1]],
+                                                      mirror=False)
+        assert handles[0]['state'].materialize() == {'k': 2}
+        assert fleet_backend.materialize_docs(handles) == [{'k': 2}]
+
+    def test_turbo_atomic_across_docs(self):
+        """A gate error on one doc must roll back every doc in the turbo
+        call (regression: earlier docs kept hash-graph entries whose ops
+        never reached the device)."""
+        fb = FleetBackend(DocFleet(doc_capacity=4, key_capacity=4))
+        handles = fleet_backend.init_docs(2, fb.fleet)
+        good = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'a', 'value': 7,
+             'datatype': 'int', 'pred': []}])
+        bad = change_buf(ACTORS[1], 3, 1, [     # seq skip
+            {'action': 'set', 'obj': '_root', 'key': 'b', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        with pytest.raises(ValueError, match='Skipped sequence number'):
+            fleet_backend.apply_changes_docs(handles, [[good], [bad]],
+                                             mirror=False)
+        assert fleet_backend.get_heads(handles[0]) == []
+        assert handles[0]['state'].materialize() == {}
+        assert fleet_backend.materialize_docs(handles) == [{}, {}]
+
+    def test_turbo_queue_only_no_dispatch_no_interning(self):
+        """A turbo call where everything queues must not issue a device
+        dispatch nor intern the queued changes' keys (regression)."""
+        fb = FleetBackend(DocFleet(doc_capacity=4, key_capacity=4))
+        handles = fleet_backend.init_docs(1, fb.fleet)
+        dangling = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'ghostkey', 'value': 1,
+             'datatype': 'int', 'pred': []}], deps=['ab' * 32])
+        before = fb.fleet.dispatches
+        handles, _ = fleet_backend.apply_changes_docs(handles, [[dangling]],
+                                                      mirror=False)
+        assert fb.fleet.dispatches == before
+        assert len(fb.fleet.keys) == 0
+        assert fleet_backend.get_missing_deps(handles[0]) == ['ab' * 32]
+
+    def test_turbo_duplicate_op_id_rejected(self):
+        fb = FleetBackend(DocFleet(doc_capacity=4, key_capacity=4))
+        handles = fleet_backend.init_docs(1, fb.fleet)
+        c1 = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'a', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        # Same opId (1@actor) from a different change in the same batch
+        c2 = change_buf(ACTORS[0], 2, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'b', 'value': 2,
+             'datatype': 'int', 'pred': []}],
+            deps=[am.decode_change(c1)['hash']])
+        with pytest.raises(ValueError, match='duplicate operation ID'):
+            fleet_backend.apply_changes_docs(handles, [[c1, c2]],
+                                             mirror=False)
+        assert fleet_backend.get_heads(handles[0]) == []
+
+    def test_turbo_sync_without_rebuild(self):
+        """Sync needs only the hash graph: a turbo doc syncs to a host doc
+        without its mirror ever being rebuilt."""
+        fb = FleetBackend(DocFleet(doc_capacity=4, key_capacity=4))
+        handles = fleet_backend.init_docs(1, fb.fleet)
+        c1 = [[change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 5,
+             'datatype': 'int', 'pred': []}])]]
+        handles, _ = fleet_backend.apply_changes_docs(handles, c1,
+                                                      mirror=False)
+        gb, hb = handles[0], host_backend.init()
+        s1, s2 = fleet_backend.init_sync_state(), host_backend.init_sync_state()
+        for _ in range(8):
+            s1, m = fleet_backend.generate_sync_message(gb, s1)
+            if m is not None:
+                hb, s2, _ = host_backend.receive_sync_message(hb, s2, m)
+            s2, r = host_backend.generate_sync_message(hb, s2)
+            if r is not None:
+                gb, s1, _ = fleet_backend.receive_sync_message(gb, s1, r)
+            if m is None and r is None:
+                break
+        assert host_backend.get_heads(hb) == fleet_backend.get_heads(gb)
+        assert host_backend.get_patch(hb)['diffs']['props']['k'] == \
+            {f'1@{ACTORS[0]}': {'type': 'value', 'value': 5,
+                                'datatype': 'int'}}
+
+
 class TestPromotion:
     def test_nested_object_promotes(self):
         fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=2))
