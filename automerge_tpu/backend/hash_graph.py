@@ -55,12 +55,51 @@ class HashGraph:
         self.dependencies_by_hash = {}
         self.dependents_by_hash = {}
         self.hashes_by_actor = {}
+        # Deferred-index log (the reference's deferred hash graph,
+        # new.js:1709-1749): bulk appends record only (index, hash, deps,
+        # actor, meta) tuples here; the query dicts above materialize lazily
+        self._deferred = []
+
+    def _defer_record(self, change):
+        """Record an applied change without building the graph indexes;
+        self.changes must already hold its buffer at the captured index."""
+        self._deferred.append((len(self.changes) - 1, change['hash'],
+                               list(change['deps']), change['actor'], {
+            'actor': change['actor'], 'seq': change['seq'],
+            'maxOp': change['startOp'] + len(change['ops']) - 1,
+            'time': change.get('time', 0),
+            'message': change.get('message') or '',
+            'deps': list(change['deps']),
+            'extraBytes': change.get('extraBytes'),
+        }))
+
+    def _ensure_graph(self):
+        """Materialize the query dicts from the deferred log. Entries are
+        either eager 5-tuples (index, hash, deps, actor, meta) or lazy
+        3-tuples (index, batch, i) resolved via batch.resolve(i)."""
+        if not self._deferred:
+            return
+        for entry in self._deferred:
+            if len(entry) == 3:
+                index, batch, i = entry
+                hash, deps, actor, meta = batch.resolve(i)
+            else:
+                index, hash, deps, actor, meta = entry
+            self.hashes_by_actor.setdefault(actor, []).append(hash)
+            self.change_index_by_hash[hash] = index
+            self.dependencies_by_hash[hash] = deps
+            self.dependents_by_hash.setdefault(hash, [])
+            for dep in deps:
+                self.dependents_by_hash.setdefault(dep, []).append(hash)
+            self.changes_meta.append(meta)
+        self._deferred = []
 
     def _causal_gate(self, changes, applied_hashes=None):
         """Partition changes into causally-ready (applied to clock/heads) and
         enqueued (ref new.js:1550-1586). `applied_hashes` carries the hashes
         applied by earlier passes of the same apply_changes call (they are not
         yet in change_index_by_hash, but satisfy deps and must be deduped)."""
+        self._ensure_graph()
         heads = set(self.heads)
         change_hashes = applied_hashes if applied_hashes is not None else set()
         clock = dict(self.clock)
@@ -131,6 +170,7 @@ class HashGraph:
     # ------------------------------------------------------------------
 
     def get_changes(self, have_deps):
+        self._ensure_graph()
         if not have_deps:
             return list(self.changes)
         stack, seen, to_return = [], set(), []
@@ -164,6 +204,9 @@ class HashGraph:
                 if decode_change_meta(change, True)['hash'] not in seen]
 
     def get_changes_added(self, other):
+        self._ensure_graph()
+        if isinstance(other, HashGraph):
+            other._ensure_graph()
         stack, seen, to_return = list(self.heads), set(), []
         while stack:
             h = stack.pop()
@@ -174,10 +217,12 @@ class HashGraph:
         return [self.changes[self.change_index_by_hash[h]] for h in reversed(to_return)]
 
     def get_change_by_hash(self, hash):
+        self._ensure_graph()
         index = self.change_index_by_hash.get(hash)
         return self.changes[index] if index is not None else None
 
     def get_missing_deps(self, heads=()):
+        self._ensure_graph()
         all_deps = set(heads)
         in_queue = set()
         for change in self.queue:

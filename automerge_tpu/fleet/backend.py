@@ -440,6 +440,7 @@ class _FlatEngine(HashGraph):
 
     def clone_engine(self):
         self._ensure_mirror()
+        self._ensure_graph()
         other = _FlatEngine(self.fleet, self.fleet.clone_slot(self.slot))
         for field in ('max_op', 'actor_ids', 'heads', 'clock', 'queue',
                       'changes', 'changes_meta', 'change_index_by_hash',
@@ -467,13 +468,22 @@ class FleetDoc:
     clock = property(lambda self: self._impl.clock)
     queue = property(lambda self: self._impl.queue)
     changes = property(lambda self: self._impl.changes)
-    changes_meta = property(lambda self: self._impl.changes_meta)
-    change_index_by_hash = property(lambda self: self._impl.change_index_by_hash)
-    dependencies_by_hash = property(lambda self: self._impl.dependencies_by_hash)
-    dependents_by_hash = property(lambda self: self._impl.dependents_by_hash)
-    hashes_by_actor = property(lambda self: self._impl.hashes_by_actor)
     max_op = property(lambda self: self._impl.max_op)
     actor_ids = property(lambda self: self._impl.actor_ids)
+
+    def _graph_dict(name):
+        # The index dicts materialize lazily after turbo applies
+        def get(self):
+            self._impl._ensure_graph()
+            return getattr(self._impl, name)
+        return property(get)
+
+    changes_meta = _graph_dict('changes_meta')
+    change_index_by_hash = _graph_dict('change_index_by_hash')
+    dependencies_by_hash = _graph_dict('dependencies_by_hash')
+    dependents_by_hash = _graph_dict('dependents_by_hash')
+    hashes_by_actor = _graph_dict('hashes_by_actor')
+    del _graph_dict
 
     @property
     def is_fleet(self):
@@ -663,13 +673,68 @@ def apply_changes_docs(handles, per_doc_changes, mirror=True):
     return out_handles, patches
 
 
+class _TurboMetaBatch:
+    """Raw per-change metadata from the native parser, with lazy hex/dict
+    materialization: the fast path touches only numpy arrays; full dicts are
+    built per change only for general-path gating and deferred hash-graph
+    resolution."""
+
+    __slots__ = ('m', 'actors', 'buffers')
+
+    def __init__(self, m, actors, buffers):
+        self.m = m
+        self.actors = actors
+        self.buffers = buffers
+
+    def hash_hex(self, i):
+        return self.m['hash32'][i].tobytes().hex()
+
+    def deps_hex(self, i):
+        off = self.m['deps_off']
+        blob = self.m['deps_blob']
+        return [blob[32 * j:32 * (j + 1)].hex()
+                for j in range(off[i], off[i + 1])]
+
+    def message(self, i):
+        off = self.m['msg_off']
+        return self.m['msg_blob'][off[i]:off[i + 1]].decode('utf8')
+
+    def meta(self, i):
+        """Full change-header dict (general gating path)."""
+        m = self.m
+        return {
+            'actor': self.actors[int(m['actor'][i])], 'seq': int(m['seq'][i]),
+            'startOp': int(m['startOp'][i]), 'time': int(m['time'][i]),
+            'message': self.message(i), 'deps': self.deps_hex(i),
+            'extraBytes': None, 'hash': self.hash_hex(i),
+            'buffer': self.buffers[i], 'ops': range(int(m['nops'][i])),
+            '_change_index': i,
+        }
+
+    def resolve(self, i):
+        """(hash, deps, actor, changes_meta entry) for HashGraph._ensure_graph."""
+        m = self.m
+        meta = {
+            'actor': self.actors[int(m['actor'][i])], 'seq': int(m['seq'][i]),
+            'maxOp': int(m['startOp'][i] + m['nops'][i] - 1),
+            'time': int(m['time'][i]), 'message': self.message(i),
+            'deps': self.deps_hex(i), 'extraBytes': None,
+        }
+        return self.hash_hex(i), meta['deps'], meta['actor'], meta
+
+
 def _apply_changes_turbo(handles, per_doc_changes):
     """Header-decode + native-ingest batched apply. Returns None when the
     workload can't take the turbo path (no native codec, non-fleet docs,
     multi-chunk buffers, or ops outside the flat subset), in which case the
-    caller falls back to the exact path."""
+    caller falls back to the exact path.
+
+    Control flow: one native parse for every change; chain validation
+    (deps == current head, contiguous seqs) vectorized over the whole batch;
+    docs that fit the linear-chain shape commit through the deferred hash
+    graph with no per-change dict work, the rest go through the general
+    causal gate. The call is atomic: any gate error rolls back every doc."""
     from .. import native
-    from ..columnar import decode_change_meta
     from .apply import apply_op_batch
     from .tensor_doc import OpBatch, MAX_ACTORS as _MA
 
@@ -691,45 +756,84 @@ def _apply_changes_turbo(handles, per_doc_changes):
         return None
 
     flat_buffers, change_doc = [], []
+    per_doc_idx = [[] for _ in range(len(handles))]
     for d, changes in enumerate(per_doc_changes):
         for buf in changes:
             buf = bytes(buf)
             if len(buf) < 12 or buf[8] not in (1, 2):
                 return None     # document chunks etc: exact path
+            per_doc_idx[d].append(len(flat_buffers))
             flat_buffers.append(buf)
             change_doc.append(d)
-    if not flat_buffers:
+    n_changes = len(flat_buffers)
+    if not n_changes:
         return handles, [None] * len(handles)
 
-    out = native.ingest_changes(flat_buffers,
-                                list(range(len(flat_buffers))))
+    out = native.ingest_changes(flat_buffers, list(range(n_changes)),
+                                with_meta=True)
     if out is None:
-        return None             # ops outside the flat subset
-    rows, nat_keys, nat_actors = out
-    ops_per_change = np.bincount(rows['doc'], minlength=len(flat_buffers))
+        return None     # ops outside the flat subset, or corrupt chunk
+    rows, nat_keys, nat_actors, nmeta = out
+    batch_meta = _TurboMetaBatch(nmeta, nat_actors, flat_buffers)
 
-    # Header decode (hash + deps + actor/seq) and per-doc causal gating
-    metas = [decode_change_meta(buf, True) for buf in flat_buffers]
-    per_doc_metas = [[] for _ in range(len(handles))]
-    for i, meta in enumerate(metas):
-        n_ops = int(ops_per_change[i])
-        per_doc_metas[change_doc[i]].append({
-            'actor': meta['actor'], 'seq': meta['seq'],
-            'startOp': meta['startOp'], 'time': meta.get('time', 0),
-            'message': meta.get('message') or '',
-            'deps': list(meta['deps']),
-            'extraBytes': meta.get('extraBytes'),
-            'hash': meta['hash'], 'buffer': flat_buffers[i],
-            'ops': range(n_ops), '_change_index': i,
-        })
+    # ---- Vectorized linear-chain validation over the whole batch ----
+    # A doc takes the fast path iff every change deps on exactly the
+    # previous change (or the doc's current head for the first) and seqs
+    # are contiguous per actor. Everything else gets the general gate.
+    doc_of = np.array(change_doc, dtype=np.int64)
+    actor_id = nmeta['actor'].astype(np.int64)
+    seqs = nmeta['seq']
+    deps_off = nmeta['deps_off']
+    deps_count = np.diff(deps_off)
+    hash32 = nmeta['hash32']
+    deps_view = np.frombuffer(nmeta['deps_blob'], dtype=np.uint8)
+    deps_view = deps_view.reshape(-1, 32) if deps_view.size else \
+        np.zeros((0, 32), dtype=np.uint8)
 
-    # Phase 1 — fallible: causal-gate every doc, committing nothing durable.
-    # _drain_queue mutates clock/heads, so every engine carries a backup and
-    # any failure restores ALL of them: the whole turbo call is atomic
-    # (the exact path gets per-doc atomicity from fleet.pending instead).
-    ready = np.zeros(len(flat_buffers), dtype=bool)
-    applied_actors = set()
-    staged = []                  # (engine, applied, queue)
+    ok = np.ones(n_changes, dtype=bool)
+    prev_same = np.zeros(n_changes, dtype=bool)
+    prev_same[1:] = doc_of[1:] == doc_of[:-1]
+    dep0 = np.zeros((n_changes, 32), dtype=np.uint8)
+    has_dep = deps_count >= 1
+    dep0[has_dep] = deps_view[deps_off[:-1][has_dep]]
+    link = np.zeros(n_changes, dtype=bool)
+    if n_changes > 1:
+        link[1:] = (dep0[1:] == hash32[:-1]).all(axis=1)
+    ok &= ~prev_same | ((deps_count == 1) & link)
+
+    # Contiguous seqs per (doc, actor): rank within the group + clock base
+    key = doc_of * _MA + actor_id
+    order = np.argsort(key, kind='stable')
+    key_sorted = key[order]
+    rank = np.arange(n_changes) - \
+        np.searchsorted(key_sorted, key_sorted, side='left')
+    base_sorted = np.empty(n_changes, dtype=np.int64)
+    group_starts = np.flatnonzero(np.r_[True, key_sorted[1:] != key_sorted[:-1]])
+    for gi, start in enumerate(group_starts):
+        stop = group_starts[gi + 1] if gi + 1 < len(group_starts) else n_changes
+        k = int(key_sorted[start])
+        actor_hex = nat_actors[k % _MA]
+        base_sorted[start:stop] = engines[k // _MA].clock.get(actor_hex, 0)
+    ok_seq = np.empty(n_changes, dtype=bool)
+    ok_seq[order] = seqs[order] == base_sorted + rank + 1
+    ok &= ok_seq
+
+    # First change of each doc must dep on the doc's current heads
+    for i in np.flatnonzero(~prev_same):
+        heads = engines[int(doc_of[i])].heads
+        if int(deps_count[i]) != len(heads) or \
+                (len(heads) and batch_meta.deps_hex(i) != heads):
+            ok[i] = False
+
+    fast_mask = np.ones(len(engines), dtype=bool)
+    fast_mask[doc_of[~ok]] = False
+
+    # Phase 1 — fallible: general causal gate for docs off the chain shape.
+    # _drain_queue mutates clock/heads, so engines carry backups and any
+    # failure restores all of them: the whole turbo call is atomic (the
+    # exact path gets per-doc atomicity from fleet.pending instead).
+    ready = np.zeros(n_changes, dtype=bool)
+    staged = []                  # general-path: (engine, applied, queue)
     backups = []                 # (engine, clock, heads, queue)
 
     def restore_all():
@@ -737,19 +841,22 @@ def _apply_changes_turbo(handles, per_doc_changes):
             engine.clock, engine.heads, engine.queue = clock, heads, queue
 
     for d, engine in enumerate(engines):
-        if not per_doc_metas[d]:
+        if not per_doc_idx[d]:
+            continue
+        if fast_mask[d]:
+            ready[per_doc_idx[d]] = True
             continue
         backups.append((engine, dict(engine.clock), list(engine.heads),
                         list(engine.queue)))
         try:
-            applied, queue = engine._drain_queue(per_doc_metas[d],
-                                                 lambda change: None)
+            applied, queue = engine._drain_queue(
+                [batch_meta.meta(i) for i in per_doc_idx[d]],
+                lambda change: None)
         except Exception:
             restore_all()
             raise
         staged.append((engine, applied, queue))
         for change in applied:
-            applied_actors.add(change['actor'])
             ready[change['_change_index']] = True
 
     keep = ready[rows['doc']]
@@ -766,10 +873,28 @@ def _apply_changes_turbo(handles, per_doc_changes):
             restore_all()
             raise ValueError('duplicate operation ID in turbo batch')
 
-    # Phase 2 — infallible: record the hash graph, queues, staleness
+    # Phase 2 — infallible: record logs, queues, staleness
+    start_op = nmeta['startOp']
+    nops = nmeta['nops']
+    for d in np.flatnonzero(fast_mask):
+        idxs = per_doc_idx[d]
+        if not idxs:
+            continue
+        engine = engines[d]
+        for i in idxs:
+            engine.changes.append(flat_buffers[i])
+            engine._deferred.append((len(engine.changes) - 1, batch_meta, i))
+            engine.clock[nat_actors[int(actor_id[i])]] = int(seqs[i])
+        engine.heads = [batch_meta.hash_hex(idxs[-1])]
+        engine.max_op = max(engine.max_op,
+                            int((start_op[idxs] + nops[idxs]).max()) - 1)
+        engine.stale = True
+        engine.binary_doc = None
+        engine._op_set_cache = None
     for engine, applied, queue in staged:
         for change in applied:
-            engine._record_applied(change)
+            engine.changes.append(change['buffer'])
+            engine._defer_record(change)
             engine.max_op = max(engine.max_op,
                                 change['startOp'] + len(change['ops']) - 1)
             engine.stale = True
@@ -792,7 +917,9 @@ def _apply_changes_turbo(handles, per_doc_changes):
 
     # Device batch: remap the native parser's key/actor numbering into the
     # fleet tables (interning only keys that actually land on the device)
-    perm = fleet.actors.insert_many(applied_actors)
+    applied_actor_ids = np.unique(actor_id[ready])
+    perm = fleet.actors.insert_many([nat_actors[int(a)]
+                                     for a in applied_actor_ids])
     if perm is not None:
         fleet._remap_actors(perm)
     key_map = np.zeros(max(len(nat_keys), 1), dtype=np.int32)

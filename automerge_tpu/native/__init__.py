@@ -203,14 +203,17 @@ def decode_boolean_column(buf):
     return _decode_column('boolean', buf)
 
 
-def ingest_changes(buffers, doc_ids):
+def ingest_changes(buffers, doc_ids, with_meta=False):
     """Batched native change ingest: parse N binary changes into flat op-row
     arrays (doc, key_id, packed_opid, value, flags) with C++-side dictionary
     encoding of keys and actors.
 
     Returns (rows dict, key_strings list, actor_hex list), or None if any
     change falls outside the fleet-kernel subset (caller falls back to the
-    general host engine)."""
+    general host engine). With with_meta=True, a fourth element carries
+    per-change header metadata (the whole hash-graph feed: SHA-256 hash with
+    checksum verification, deps, actor/seq/startOp/time/message, op counts)
+    so no Python-side header decode is needed."""
     lib = _load()
     if lib is None:
         return None
@@ -225,14 +228,20 @@ def ingest_changes(buffers, doc_ids):
     lib.am_ingest_changes.argtypes = [
         ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint64),
         ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int32),
-        ctypes.c_uint64]
+        ctypes.c_uint64, ctypes.c_int]
     lib.am_ingest_changes.restype = i64
     n_rows = lib.am_ingest_changes(
         ptr, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
         lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
-        docs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(buffers))
+        docs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(buffers),
+        1 if with_meta else 0)
     if n_rows < 0:
         return None
+    metas = None
+    if with_meta:
+        metas = _fetch_ingest_meta(lib, len(buffers), len(blob))
+        if metas is None:
+            return None
     n = max(int(n_rows), 1)
     doc = np.zeros(n, dtype=np.int32)
     key = np.zeros(n, dtype=np.int32)
@@ -269,4 +278,50 @@ def ingest_changes(buffers, doc_ids):
     rows = {'doc': doc[:int(n_rows)], 'key': key[:int(n_rows)],
             'packed': packed[:int(n_rows)], 'value': val[:int(n_rows)],
             'flags': flags[:int(n_rows)]}
+    if with_meta:
+        return rows, keys, actors, metas
     return rows, keys, actors
+
+
+def _fetch_ingest_meta(lib, n_changes, blob_len):
+    """Copy out the per-change metadata captured by am_ingest_changes.
+    Must run before am_ingest_fetch (which frees the native context)."""
+    i64 = ctypes.c_int64
+    i64p = ctypes.POINTER(i64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    n = max(n_changes, 1)
+    actor = np.zeros(n, dtype=np.int32)
+    seq = np.zeros(n, dtype=np.int64)
+    start_op = np.zeros(n, dtype=np.int64)
+    time = np.zeros(n, dtype=np.int64)
+    nops = np.zeros(n, dtype=np.int64)
+    hash32 = np.zeros(32 * n, dtype=np.uint8)
+    deps_off = np.zeros(n + 1, dtype=np.int64)
+    deps_blob = np.zeros(max(blob_len, 64), dtype=np.uint8)
+    msg_off = np.zeros(n + 1, dtype=np.int64)
+    msg_blob = np.zeros(max(blob_len, 64), dtype=np.uint8)
+    lib.am_ingest_meta_fetch.argtypes = [
+        i32p, i64p, i64p, i64p, i64p, u8p, i64p, u8p, ctypes.c_uint64,
+        i64p, u8p, ctypes.c_uint64]
+    lib.am_ingest_meta_fetch.restype = i64
+    got = lib.am_ingest_meta_fetch(
+        actor.ctypes.data_as(i32p), seq.ctypes.data_as(i64p),
+        start_op.ctypes.data_as(i64p), time.ctypes.data_as(i64p),
+        nops.ctypes.data_as(i64p), hash32.ctypes.data_as(u8p),
+        deps_off.ctypes.data_as(i64p), deps_blob.ctypes.data_as(u8p),
+        deps_blob.size, msg_off.ctypes.data_as(i64p),
+        msg_blob.ctypes.data_as(u8p), msg_blob.size)
+    if got != n_changes:
+        return None
+    # Raw arrays/blobs only — hex strings and per-change dicts are built
+    # lazily by the caller (most changes never need them on the fast path)
+    return {
+        'actor': actor[:n_changes], 'seq': seq[:n_changes],
+        'startOp': start_op[:n_changes], 'time': time[:n_changes],
+        'nops': nops[:n_changes], 'hash32': hash32.reshape(n, 32)[:n_changes],
+        'deps_off': deps_off[:n_changes + 1],
+        'deps_blob': deps_blob.tobytes()[:32 * int(deps_off[n_changes])],
+        'msg_off': msg_off[:n_changes + 1],
+        'msg_blob': msg_blob.tobytes()[:int(msg_off[n_changes])],
+    }

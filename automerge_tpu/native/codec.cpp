@@ -363,7 +363,35 @@ struct IngestCtx {
   std::vector<int32_t> out_doc, out_key, out_packed, out_val;
   std::vector<uint8_t> out_flags;  // 1 = set/del, 2 = inc
   std::string error;
+  // Per-change metadata (filled only when am_ingest_changes gets
+  // with_meta=1): header fields + full SHA-256 chunk hash, so the causal
+  // gate / hash graph never needs a Python-side header decode.
+  std::vector<int32_t> m_actor;
+  std::vector<int64_t> m_seq, m_start_op, m_time, m_nops;
+  std::vector<uint8_t> m_hash;      // 32 bytes per change
+  std::vector<int64_t> m_deps_off;  // per change, index into m_deps/32
+  std::vector<uint8_t> m_deps;      // 32 bytes per dep, concatenated
+  std::vector<int64_t> m_msg_off;   // per change, byte offset into m_msg
+  std::vector<uint8_t> m_msg;       // UTF-8 message bytes, concatenated
 };
+
+// SHA-256 of a change chunk as the reference hashes it (columnar.js:688-708):
+// over [chunk type 1][uleb body length][uncompressed body].
+static void change_chunk_hash(const uint8_t *body, uint64_t body_len,
+                              uint8_t out[32]) {
+  std::vector<uint8_t> buf;
+  buf.reserve(body_len + 10);
+  buf.push_back(1);
+  uint64_t v = body_len;
+  do {
+    uint8_t b = v & 0x7f;
+    v >>= 7;
+    if (v) b |= 0x80;
+    buf.push_back(b);
+  } while (v);
+  buf.insert(buf.end(), body, body + body_len);
+  am_sha256(buf.data(), buf.size(), out);
+}
 
 constexpr int kColObjActor = 0x01, kColObjCtr = 0x02;
 constexpr int kColKeyActor = 0x11, kColKeyCtr = 0x13, kColKeyStr = 0x15;
@@ -425,10 +453,25 @@ extern "C" {
 // Implemented without the goto mess: parse body given the chunk *contents*
 // (after the 8-byte magic+checksum, 1-byte type, LEB length header).
 static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
-                              uint64_t body_len, int32_t doc) {
+                              uint64_t body_len, int32_t doc,
+                              int with_meta, const uint8_t *checksum) {
+  size_t rows_before = ctx.out_doc.size();
+  if (with_meta) {
+    uint8_t digest[32];
+    change_chunk_hash(body, body_len, digest);
+    if (memcmp(digest, checksum, 4) != 0) return false;  // corrupt chunk
+    ctx.m_hash.insert(ctx.m_hash.end(), digest, digest + 32);
+  }
   Cursor c{body, body_len};
   uint64_t num_deps = c.uleb();
-  c.skip(32 * num_deps);
+  if (with_meta) {
+    ctx.m_deps_off.push_back(int64_t(ctx.m_deps.size() / 32));
+    const uint8_t *deps = c.bytes(32 * num_deps);
+    if (c.fail) return false;
+    ctx.m_deps.insert(ctx.m_deps.end(), deps, deps + 32 * num_deps);
+  } else {
+    c.skip(32 * num_deps);
+  }
   // actor hex string (length-prefixed bytes)
   uint64_t actor_len = c.uleb();
   const uint8_t *actor_bytes = c.bytes(actor_len);
@@ -442,11 +485,22 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
   }
   int32_t actor_id = ctx.actors.intern(actor_hex);
   if (actor_id >= (1 << kActorBits)) return false;
-  c.uleb();                       // seq
+  uint64_t seq = c.uleb();
   uint64_t start_op = c.uleb();   // startOp
-  c.sleb();                       // time
+  int64_t time = c.sleb();
   uint64_t msg_len = c.uleb();    // message
-  c.skip(msg_len);
+  if (with_meta) {
+    ctx.m_actor.push_back(actor_id);
+    ctx.m_seq.push_back(int64_t(seq));
+    ctx.m_start_op.push_back(int64_t(start_op));
+    ctx.m_time.push_back(time);
+    ctx.m_msg_off.push_back(int64_t(ctx.m_msg.size()));
+    const uint8_t *msg = c.bytes(msg_len);
+    if (c.fail) return false;
+    ctx.m_msg.insert(ctx.m_msg.end(), msg, msg + msg_len);
+  } else {
+    c.skip(msg_len);
+  }
   uint64_t num_other_actors = c.uleb();
   for (uint64_t i = 0; i < num_other_actors; i++) {
     uint64_t alen = c.uleb();
@@ -553,7 +607,14 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
         return false;  // non-integer value: general engine path
       }
       if (err) return false;
-      if (value < 0 || value >= (int64_t(1) << 31)) return false;
+      // inc deltas are raw int32 addends (negatives allowed); set values
+      // must be non-negative inline ints (others use the host value table)
+      if (action == kActionInc) {
+        if (value <= -(int64_t(1) << 31) || value >= (int64_t(1) << 31))
+          return false;
+      } else if (value < 0 || value >= (int64_t(1) << 31)) {
+        return false;
+      }
     } else if (action != kActionDel) {
       return false;  // make*/link need the general engine
     }
@@ -568,6 +629,7 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
     ctx.out_val.push_back(action == kActionDel ? -1 : int32_t(value));
     ctx.out_flags.push_back(action == kActionInc ? 2 : 1);
   }
+  if (with_meta) ctx.m_nops.push_back(int64_t(ctx.out_doc.size() - rows_before));
   return true;
 }
 
@@ -578,7 +640,7 @@ static IngestCtx *g_ingest = nullptr;
 
 int64_t am_ingest_changes(const uint8_t *blob, const uint64_t *offsets,
                           const uint64_t *lens, const int32_t *doc_ids,
-                          uint64_t n_changes) {
+                          uint64_t n_changes, int with_meta) {
   delete g_ingest;
   g_ingest = new IngestCtx();
   for (uint64_t i = 0; i < n_changes; i++) {
@@ -611,7 +673,8 @@ int64_t am_ingest_changes(const uint8_t *blob, const uint64_t *offsets,
     } else {
       delete g_ingest; g_ingest = nullptr; return -1;
     }
-    if (!parse_change_body(*g_ingest, body, body_len, doc_ids[i])) {
+    if (!parse_change_body(*g_ingest, body, body_len, doc_ids[i],
+                           with_meta, chunk + 4)) {
       delete g_ingest;
       g_ingest = nullptr;
       return -1;
@@ -664,6 +727,38 @@ int64_t am_ingest_fetch(int32_t *doc, int32_t *key, int32_t *packed,
   delete g_ingest;
   g_ingest = nullptr;
   return kb;
+}
+
+// Copy per-change metadata captured by am_ingest_changes(with_meta=1).
+// Must be called BEFORE am_ingest_fetch (which frees the context).
+// deps_off/msg_off receive n_changes+1 entries (prefix offsets); deps_blob
+// holds 32 bytes per dep. Returns the number of changes, or -1 when the
+// context is missing, metadata was not requested, or a blob doesn't fit.
+int64_t am_ingest_meta_fetch(int32_t *actor, int64_t *seq, int64_t *start_op,
+                             int64_t *time, int64_t *nops, uint8_t *hash32,
+                             int64_t *deps_off, uint8_t *deps_blob,
+                             uint64_t deps_cap, int64_t *msg_off,
+                             uint8_t *msg_blob, uint64_t msg_cap) {
+  if (!g_ingest) return -1;
+  IngestCtx &ctx = *g_ingest;
+  size_t n = ctx.m_seq.size();
+  if (ctx.m_actor.size() != n || ctx.m_nops.size() != n ||
+      ctx.m_hash.size() != 32 * n)
+    return -1;
+  if (ctx.m_deps.size() > deps_cap || ctx.m_msg.size() > msg_cap) return -1;
+  memcpy(actor, ctx.m_actor.data(), n * 4);
+  memcpy(seq, ctx.m_seq.data(), n * 8);
+  memcpy(start_op, ctx.m_start_op.data(), n * 8);
+  memcpy(time, ctx.m_time.data(), n * 8);
+  memcpy(nops, ctx.m_nops.data(), n * 8);
+  memcpy(hash32, ctx.m_hash.data(), 32 * n);
+  memcpy(deps_off, ctx.m_deps_off.data(), n * 8);
+  deps_off[n] = int64_t(ctx.m_deps.size() / 32);
+  memcpy(deps_blob, ctx.m_deps.data(), ctx.m_deps.size());
+  memcpy(msg_off, ctx.m_msg_off.data(), n * 8);
+  msg_off[n] = int64_t(ctx.m_msg.size());
+  memcpy(msg_blob, ctx.m_msg.data(), ctx.m_msg.size());
+  return int64_t(n);
 }
 
 }  // extern "C"
