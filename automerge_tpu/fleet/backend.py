@@ -33,6 +33,7 @@ import copy
 import numpy as np
 
 from ..backend.hash_graph import HashGraph, decode_change_buffers
+from ..observability import Metrics
 from ..backend.op_set import OpSet
 from ..columnar import decode_change
 from .tensor_doc import FleetState, MAX_ACTORS, TOMBSTONE
@@ -111,7 +112,11 @@ class DocFleet:
         self.free_slots = []
         self.pending = []         # (slot, [change buffers])
         self.pending_actors = set()
-        self.dispatches = 0       # number of device merge dispatches issued
+        self.metrics = Metrics()  # per-dispatch counters (observability.py)
+
+    @property
+    def dispatches(self):
+        return self.metrics.dispatches
 
     # -- slot management ------------------------------------------------
 
@@ -165,6 +170,7 @@ class DocFleet:
         if need_docs <= old_n and need_keys + 1 <= old_k:
             return
         import jax.numpy as jnp
+        self.metrics.grows += 1
         n, k = max(need_docs, old_n), max(need_keys + 1, old_k)
         # The old scratch column (index old_k - 1) holds garbage from padded
         # scatter lanes; it must not become a real key slot when widening
@@ -184,6 +190,7 @@ class DocFleet:
         mask = MAX_ACTORS - 1
         perm_full = np.arange(MAX_ACTORS, dtype=np.int32)
         perm_full[:len(perm)] = perm
+        self.metrics.remaps += 1
         w = self.state.winners
         remapped = (w & ~mask) | jnp.asarray(perm_full)[w & mask]
         self.state = FleetState(jnp.where(w != 0, remapped, 0),
@@ -202,6 +209,8 @@ class DocFleet:
         per_doc = [[] for _ in range(n_docs)]
         for slot, buffers in self.pending:
             per_doc[slot].extend(buffers)
+            self.metrics.changes_ingested += len(buffers)
+            self.metrics.bytes_ingested += sum(len(b) for b in buffers)
         self.pending = []
         self.pending_actors = set()
         batch = changes_to_op_batch(per_doc, self.keys, self.actors,
@@ -212,7 +221,8 @@ class DocFleet:
             batch = type(batch)(*(np.pad(col, ((0, pad), (0, 0)))
                                   for col in batch.tree_flatten()[0]))
         self.state, _stats = apply_op_batch(self.state, batch)
-        self.dispatches += 1
+        self.metrics.dispatches += 1
+        self.metrics.device_ops += int(batch.valid.sum())
 
     # -- reads ----------------------------------------------------------
 
@@ -287,6 +297,7 @@ class _FlatEngine(HashGraph):
         validate (dangling pred) — see apply_changes_docs' trust note."""
         if not self.stale:
             return
+        self.fleet.metrics.mirror_rebuilds += 1
         self._replay_mirror()
         # Turbo queue entries carry only metadata; re-decode so the exact
         # drain path can apply their ops when deps arrive
@@ -296,7 +307,13 @@ class _FlatEngine(HashGraph):
 
     # -- change application --------------------------------------------
 
+    def _ensure_graph(self):
+        if self._deferred:
+            self.fleet.metrics.graph_builds += 1
+        super()._ensure_graph()
+
     def apply_changes(self, change_buffers, is_local=False):
+        self.fleet.metrics.exact_calls += 1
         decoded = decode_change_buffers(change_buffers)
 
         # Pre-scan for the flat subset before mutating anything, so promotion
@@ -495,6 +512,7 @@ class FleetDoc:
         if not self.is_fleet:
             return self._impl
         impl = self._impl
+        impl.fleet.metrics.promotions += 1
         ops = OpSet()
         if impl.changes:
             ops.apply_changes([bytes(b) for b in impl.changes])
@@ -654,6 +672,11 @@ def apply_changes_docs(handles, per_doc_changes, mirror=True):
         turbo = _apply_changes_turbo(handles, per_doc_changes)
         if turbo is not None:
             return turbo
+        for handle in handles:
+            state = handle.get('state')
+            if isinstance(state, FleetDoc) and state.is_fleet:
+                state.fleet.metrics.fallbacks += 1
+                break
     out_handles, patches = [], []
     for handle, changes in zip(handles, per_doc_changes):
         if changes:
@@ -774,6 +797,9 @@ def _apply_changes_turbo(handles, per_doc_changes):
     if out is None:
         return None     # ops outside the flat subset, or corrupt chunk
     rows, nat_keys, nat_actors, nmeta = out
+    fleet.metrics.turbo_calls += 1
+    fleet.metrics.changes_ingested += n_changes
+    fleet.metrics.bytes_ingested += sum(len(b) for b in flat_buffers)
     batch_meta = _TurboMetaBatch(nmeta, nat_actors, flat_buffers)
 
     # ---- Vectorized linear-chain validation over the whole batch ----
@@ -959,7 +985,8 @@ def _apply_changes_turbo(handles, per_doc_changes):
         batch = OpBatch(*(np.pad(col, ((0, pad), (0, 0)))
                           for col in batch.tree_flatten()[0]))
     fleet.state, _stats = apply_op_batch(fleet.state, batch)
-    fleet.dispatches += 1
+    fleet.metrics.dispatches += 1
+    fleet.metrics.device_ops += int(len(kept_packed_nat))
     return result
 
 

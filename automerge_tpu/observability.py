@@ -1,0 +1,83 @@
+"""Metrics and profiling for the fleet engine.
+
+The reference has no tracing/profiling/metrics at all (SURVEY.md §5 — its
+only observability is patchCallback/Observable/getHistory, which this
+framework also provides). A batched device engine needs more: you cannot see
+an XLA dispatch from a patchCallback. This module provides the two tools the
+build plan names: per-dispatch op counters and JAX profiler traces.
+
+- `Metrics`: cheap monotonic counters every DocFleet maintains
+  (`fleet.metrics`): device dispatches, ops applied on device, changes
+  ingested, bytes ingested, host fallbacks, actor renumber remaps, capacity
+  growths. `snapshot()` returns a plain dict; `delta(prev)` diffs two
+  snapshots — subtract around a workload to get per-phase counts.
+- `trace(path)`: context manager around `jax.profiler.trace` — writes a
+  TensorBoard-loadable XLA trace of everything dispatched inside the block.
+- `timed(metrics, key)`: context manager accumulating wall-clock seconds
+  into a counter, for host-side phases (decode, gate, patch build).
+"""
+
+import contextlib
+import time
+
+
+class Metrics:
+    """Monotonic counters; plain attributes so incrementing is one add."""
+
+    _FIELDS = (
+        'dispatches',            # device merge dispatches issued
+        'device_ops',            # op rows applied on device (incl. padding)
+        'changes_ingested',      # binary changes accepted by apply paths
+        'bytes_ingested',        # wire bytes parsed
+        'turbo_calls',           # batched turbo applies
+        'exact_calls',           # mirror-exact applies
+        'fallbacks',             # turbo calls routed to the exact path
+        'promotions',            # documents promoted to the host engine
+        'remaps',                # actor renumber dispatches
+        'grows',                 # capacity regrowths (doc/key axes)
+        'mirror_rebuilds',       # lazy mirror replays after turbo
+        'graph_builds',          # deferred hash-graph materializations
+    )
+
+    def __init__(self):
+        for name in self._FIELDS:
+            setattr(self, name, 0)
+        self.seconds = {}        # phase name -> accumulated wall seconds
+
+    def snapshot(self):
+        out = {name: getattr(self, name) for name in self._FIELDS}
+        out['seconds'] = dict(self.seconds)
+        return out
+
+    def delta(self, prev):
+        """Counters accumulated since `prev` (an earlier snapshot())."""
+        now = self.snapshot()
+        out = {k: now[k] - prev.get(k, 0) for k in self._FIELDS}
+        out['seconds'] = {k: v - prev.get('seconds', {}).get(k, 0.0)
+                          for k, v in now['seconds'].items()}
+        return out
+
+    def __repr__(self):
+        parts = [f'{k}={getattr(self, k)}' for k in self._FIELDS
+                 if getattr(self, k)]
+        return f'Metrics({", ".join(parts)})'
+
+
+@contextlib.contextmanager
+def timed(metrics, key):
+    """Accumulate the block's wall-clock seconds into metrics.seconds[key]."""
+    start = time.perf_counter()
+    try:
+        yield
+    finally:
+        metrics.seconds[key] = metrics.seconds.get(key, 0.0) + \
+            (time.perf_counter() - start)
+
+
+@contextlib.contextmanager
+def trace(log_dir):
+    """JAX profiler trace of every dispatch inside the block; view the
+    written trace with TensorBoard's profile plugin or Perfetto."""
+    import jax
+    with jax.profiler.trace(str(log_dir)):
+        yield

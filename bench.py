@@ -229,6 +229,53 @@ def bench_sync_bloom(n_docs, hashes_per_doc, seed=0):
     return device_rate, host_rate
 
 
+def bench_zipf(n_docs, zipf_a=1.5, max_per_doc=256, round_width=32, seed=0):
+    """Config 5 (BASELINE.md stretch): large fleet with Zipf-skewed per-doc
+    change rates, mixed set/inc/del ops. Skew is the scatter design's worst
+    case: padded [N, P] rounds are sized by the hottest doc, so effective
+    throughput = real ops/s (padding excluded) is reported alongside the
+    occupancy (real ops / padded lanes)."""
+    import jax
+    from automerge_tpu.fleet import FleetState, OpBatch, TOMBSTONE, apply_op_batch
+    from automerge_tpu.fleet.tensor_doc import ACTOR_BITS
+    rng = np.random.default_rng(seed)
+    n_keys = 64
+    counts = np.minimum(rng.zipf(zipf_a, n_docs), max_per_doc)
+    total_ops = int(counts.sum())
+    rounds = int(np.ceil(counts.max() / round_width))
+    batches = []
+    ctr = 1
+    for r in range(rounds):
+        todo = np.clip(counts - r * round_width, 0, round_width)
+        shape = (n_docs, round_width)
+        lane = np.arange(round_width)[None, :]
+        valid = lane < todo[:, None]
+        key_id = rng.integers(0, n_keys, shape, dtype=np.int32)
+        actor = rng.integers(0, 4, shape, dtype=np.int32)
+        packed = ((ctr + lane).astype(np.int32) << ACTOR_BITS) | actor
+        kind = rng.random(shape)
+        value = rng.integers(1, 1 << 20, shape, dtype=np.int32)
+        value = np.where(kind < 0.1, TOMBSTONE, value)          # 10% deletes
+        is_inc = (kind >= 0.8) & valid                          # 20% incs
+        is_set = (kind < 0.8) & valid
+        batches.append(OpBatch(key_id, packed, value.astype(np.int32),
+                               is_set, is_inc, valid))
+        ctr += round_width
+    state = FleetState.empty(n_docs, n_keys)
+    device_batches = [jax.device_put(b) for b in batches]
+    state = jax.tree_util.tree_map(jax.device_put, state)
+    warm, _ = apply_op_batch(state, device_batches[0])
+    jax.block_until_ready(warm.winners)
+    start = time.perf_counter()
+    s = state
+    for b in device_batches:
+        s, _ = apply_op_batch(s, b)
+    jax.block_until_ready(s.winners)
+    elapsed = time.perf_counter() - start
+    occupancy = total_ops / (n_docs * round_width * rounds)
+    return total_ops / elapsed, occupancy
+
+
 def bench_text(n_docs, trace_len, n_actors=3, seed=0):
     """Config 2 (BASELINE.md): batched text editing traces through the device
     sequence engine — n_docs docs, each applying a trace_len-op multi-actor
@@ -299,6 +346,9 @@ def main():
     bloom_dev, bloom_host = bench_sync_bloom(
         int(os.environ.get('BENCH_BLOOM_DOCS', 10000)),
         int(os.environ.get('BENCH_BLOOM_HASHES', 32)))
+    # Config 5 (stretch): Zipf-skewed change rates over a large fleet
+    zipf_rate, zipf_occ = bench_zipf(
+        int(os.environ.get('BENCH_ZIPF_DOCS', 100000)))
     print(f'# pipeline (wire->device incl. native decode): '
           f'{pipe_rate:.0f} changes/s', file=sys.stderr)
     print(f'# backend-seam pipeline (turbo, incl. hash graph): '
@@ -307,6 +357,8 @@ def main():
           file=sys.stderr)
     print(f'# sync bloom build+probe: device {bloom_dev:.0f} hashes/s, '
           f'host {bloom_host:.0f} hashes/s', file=sys.stderr)
+    print(f'# zipf 100k-doc fleet: {zipf_rate:.0f} effective ops/s '
+          f'(occupancy {zipf_occ:.2f})', file=sys.stderr)
     print(f'# host reference engine: {host_rate:.0f} changes/s', file=sys.stderr)
 
     result = {

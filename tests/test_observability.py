@@ -1,0 +1,55 @@
+"""Metrics counters and profiler trace helper (automerge_tpu.observability)."""
+
+import numpy as np
+
+from automerge_tpu.fleet import backend as fleet_backend
+from automerge_tpu.fleet.backend import DocFleet, FleetBackend
+from automerge_tpu.observability import Metrics, timed
+from tests.test_fleet_backend import change_buf, ACTORS
+
+
+def test_metrics_counters_track_turbo_and_exact():
+    fb = FleetBackend(DocFleet(doc_capacity=4, key_capacity=4))
+    m = fb.fleet.metrics
+    base = m.snapshot()
+    handles = fleet_backend.init_docs(2, fb.fleet)
+    per_doc = [[change_buf(ACTORS[0], 1, 1, [
+        {'action': 'set', 'obj': '_root', 'key': 'a', 'value': d,
+         'datatype': 'int', 'pred': []}])] for d in range(2)]
+    handles, _ = fleet_backend.apply_changes_docs(handles, per_doc,
+                                                  mirror=False)
+    d = m.delta(base)
+    assert d['turbo_calls'] == 1
+    assert d['dispatches'] == 1
+    assert d['changes_ingested'] == 2
+    assert d['device_ops'] == 2
+    assert d['bytes_ingested'] > 0
+
+    # Lazy rebuilds are counted
+    handles[0]['state'].materialize()
+    fleet_backend.get_missing_deps(handles[0])
+    d = m.delta(base)
+    assert d['mirror_rebuilds'] == 1
+    assert d['graph_builds'] >= 1
+
+    # Exact path and promotion
+    c = change_buf(ACTORS[0], 2, 2, [
+        {'action': 'makeMap', 'obj': '_root', 'key': 'm', 'pred': []}],
+        deps=fleet_backend.get_heads(handles[0]))
+    h0, _ = fleet_backend.apply_changes(handles[0], [c])
+    d = m.delta(base)
+    assert d['exact_calls'] >= 1
+    assert d['promotions'] == 1
+
+
+def test_metrics_repr_and_timed():
+    m = Metrics()
+    m.dispatches += 3
+    with timed(m, 'decode'):
+        pass
+    assert 'dispatches=3' in repr(m)
+    assert m.seconds['decode'] >= 0
+    snap = m.snapshot()
+    assert snap['dispatches'] == 3
+    d = m.delta(snap)
+    assert d['dispatches'] == 0
