@@ -798,8 +798,6 @@ def _apply_changes_turbo(handles, per_doc_changes):
         return None     # ops outside the flat subset, or corrupt chunk
     rows, nat_keys, nat_actors, nmeta = out
     fleet.metrics.turbo_calls += 1
-    fleet.metrics.changes_ingested += n_changes
-    fleet.metrics.bytes_ingested += sum(len(b) for b in flat_buffers)
     batch_meta = _TurboMetaBatch(nmeta, nat_actors, flat_buffers)
 
     # ---- Vectorized linear-chain validation over the whole batch ----
@@ -898,6 +896,12 @@ def _apply_changes_turbo(handles, per_doc_changes):
         if len(np.unique(pairs)) != len(pairs):
             restore_all()
             raise ValueError('duplicate operation ID in turbo batch')
+
+    # Count only causally-applied changes: queued ones are re-counted when
+    # the exact path drains and flushes them later
+    fleet.metrics.changes_ingested += int(ready.sum())
+    fleet.metrics.bytes_ingested += sum(len(flat_buffers[i])
+                                        for i in np.flatnonzero(ready))
 
     # Phase 2 — infallible: record logs, queues, staleness
     start_op = nmeta['startOp']

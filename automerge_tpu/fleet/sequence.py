@@ -47,21 +47,22 @@ HEAD_REF = 0  # `ref == 0` means insert at the head ('_head' in the reference)
 INT32_MAX = np.int32(2**31 - 1)
 
 
-def _node_indexes(capacity):
-    """Node-id layout for the pointer array.
-
-    0..S-1   real slots
-    S        slot-scratch (masked writes of per-slot arrays land here)
-    S+1      HEAD sentinel (its nxt is the first element)
-    S+2      END sentinel / pointer-scratch (masked pointer writes land here;
-             its outgoing pointer is never followed)
-    """
-    return capacity, capacity + 1, capacity + 2
+# Node-id layout, front-anchored so every per-node array shares one shape
+# [N, capacity + 3] and capacity can grow (or pad for sharding) by appending
+# at the tail without moving the sentinels:
+#
+#   0        HEAD sentinel (its nxt is the first element)
+#   1        END sentinel / pointer-scratch (masked pointer writes land here;
+#            its outgoing pointer is never followed)
+#   2        slot-scratch (masked writes of per-slot arrays land here)
+#   3..S+2   real slots, allocated in op-arrival order
+HEAD, END, SCRATCH, SLOT0 = 0, 1, 2, 3
 
 
 class SeqState:
-    """Pytree of per-doc sequence tensors, [N, S+1] slot arrays + [N, S+3]
-    pointers + [N] allocation cursors."""
+    """Pytree of per-doc sequence tensors: five [N, S+3] per-node arrays
+    (shared node-id indexing, sentinels at the front) + [N] allocation
+    cursors."""
 
     def __init__(self, elem_id, nxt, winner, vis, val, n):
         self.elem_id = elem_id  # packed elemId per slot (0 = unallocated)
@@ -73,19 +74,18 @@ class SeqState:
 
     @property
     def capacity(self):
-        return self.elem_id.shape[1] - 1
+        return self.elem_id.shape[1] - 3
 
     @classmethod
     def empty(cls, n_docs, capacity, xp=np):
-        scratch, head, end = _node_indexes(capacity)
-        slots = (n_docs, capacity + 1)
-        nxt = xp.full((n_docs, capacity + 3), end, dtype=np.int32)
+        nodes = (n_docs, capacity + 3)
+        nxt = xp.full(nodes, END, dtype=np.int32)
         return cls(
-            xp.zeros(slots, dtype=np.int32),
+            xp.zeros(nodes, dtype=np.int32),
             nxt,
-            xp.zeros(slots, dtype=np.int32),
-            xp.zeros(slots, dtype=bool),
-            xp.zeros(slots, dtype=np.int32),
+            xp.zeros(nodes, dtype=np.int32),
+            xp.zeros(nodes, dtype=bool),
+            xp.zeros(nodes, dtype=np.int32),
             xp.zeros((n_docs,), dtype=np.int32))
 
     def tree_flatten(self):
@@ -128,15 +128,15 @@ def _apply_one_doc(carry, op, capacity):
     """One op against one doc. carry = (elem_id, nxt, winner, vis, val, n)."""
     elem_id, nxt, winner, vis, val, n = carry
     kind, ref, packed, value = op
-    scratch, head, end = _node_indexes(capacity)
 
     is_ins = kind == INSERT
     is_upd = (kind == SET) | (kind == DEL)
 
-    # Referent / target slot: packed elemIds are unique and non-zero, so an
-    # equality one-hot over the slot axis finds it (elem_id[scratch] stays 0).
-    # A miss (op referencing an elemId not in the doc, e.g. one dropped by a
-    # capacity overflow) must not resolve to an arbitrary slot.
+    # Referent / target node: packed elemIds are unique and non-zero, so an
+    # equality one-hot over the node axis finds it (sentinel and scratch
+    # entries keep elem_id 0). A miss (op referencing an elemId not in the
+    # doc, e.g. one dropped by a capacity overflow) must not resolve to an
+    # arbitrary slot.
     hits = elem_id == ref
     found = jnp.any(hits)
     match = jnp.argmax(hits).astype(jnp.int32)
@@ -145,14 +145,16 @@ def _apply_one_doc(carry, op, capacity):
     # Start after the referent (HEAD sentinel for ref==0), then skip any
     # following elements whose insertion opId is greater than ours — the
     # concurrent-insert rule (ref new.js:145-163; op_set.insert_rga).
-    r0 = jnp.where(ref == HEAD_REF, jnp.int32(head), match)
+    r0 = jnp.where(ref == HEAD_REF, jnp.int32(HEAD), match)
     # Non-insert ops must not walk: an impossible comparison key stalls the
     # loop immediately.
     my_key = jnp.where(is_ins, packed, INT32_MAX)
 
     def skip_cond(state):
         r, j = state
-        return (j < capacity) & (elem_id[jnp.minimum(j, capacity)] > my_key)
+        # Sentinels/scratch hold elem_id 0, which can never exceed a real
+        # packed opId, so the walk stops at END (or list end) by itself.
+        return elem_id[j] > my_key
 
     def skip_body(state):
         r, j = state
@@ -162,18 +164,18 @@ def _apply_one_doc(carry, op, capacity):
 
     # Inserts past capacity or after an unknown referent are dropped
     # (reported via the per-op applied flag) rather than silently corrupting
-    # state: slot-scratch and the sentinels must never be written by a live
-    # insert, and a missed referent lookup must not splice after slot 0.
+    # state: scratch and the sentinels must never be written by a live
+    # insert, and a missed referent lookup must not splice after node 0.
     can_ins = is_ins & (n < capacity) & ((ref == HEAD_REF) | found)
-    slot = jnp.minimum(n, capacity - 1)  # allocation cursor, clamped
-    ins_slot = jnp.where(can_ins, slot, jnp.int32(scratch))
-    ins_ptr_from = jnp.where(can_ins, r, jnp.int32(end))
-    ins_ptr_new = jnp.where(can_ins, slot, jnp.int32(end))
+    slot = SLOT0 + jnp.minimum(n, capacity - 1)  # allocation cursor, clamped
+    ins_slot = jnp.where(can_ins, slot, jnp.int32(SCRATCH))
+    ins_ptr_from = jnp.where(can_ins, r, jnp.int32(END))
+    ins_ptr_new = jnp.where(can_ins, slot, jnp.int32(END))
 
     nxt = nxt.at[ins_ptr_new].set(jnp.where(can_ins, j, nxt[ins_ptr_new]))
     nxt = nxt.at[ins_ptr_from].set(jnp.where(can_ins, slot, nxt[ins_ptr_from]))
-    # All four masked writes preserve the scratch slot's contents so that
-    # elem_id[scratch] stays 0 — the invariant the one-hot referent match
+    # All four masked writes preserve the scratch node's contents so that
+    # elem_id[SCRATCH] stays 0 — the invariant the one-hot referent match
     # depends on.
     elem_id = elem_id.at[ins_slot].set(jnp.where(can_ins, packed,
                                                  elem_id[ins_slot]))
@@ -187,7 +189,7 @@ def _apply_one_doc(carry, op, capacity):
     # ref == HEAD_REF (0) marks a malformed update (no target): it would
     # "match" every unallocated slot's zero elem_id, so reject it explicitly.
     lww = is_upd & found & (ref != HEAD_REF) & (packed > winner[match])
-    upd_slot = jnp.where(lww, match, jnp.int32(scratch))
+    upd_slot = jnp.where(lww, match, jnp.int32(SCRATCH))
     winner = winner.at[upd_slot].set(jnp.where(lww, packed, winner[upd_slot]))
     vis = vis.at[upd_slot].set(jnp.where(lww, kind == SET, vis[upd_slot]))
     val = val.at[upd_slot].set(jnp.where(lww & (kind == SET), value,
@@ -202,7 +204,7 @@ def _apply_one_doc(carry, op, capacity):
 
 
 def _apply_seq_batch_impl(state, ops):
-    capacity = state.elem_id.shape[1] - 1
+    capacity = state.elem_id.shape[1] - 3
 
     def per_doc(elem_id, nxt, winner, vis, val, n, kind, ref, packed, value):
         carry = (elem_id, nxt, winner, vis, val, n)
@@ -221,20 +223,21 @@ apply_seq_batch = jax.jit(_apply_seq_batch_impl)
 
 
 def _linearize_impl(state):
-    """List-rank every slot: returns (pos [N, S+1], length [N]).
+    """List-rank every node: returns (pos [N, S+3], length [N]).
 
-    pos[d, i] = 0-based sequence index of slot i in doc d (allocated slots
-    only; unallocated/scratch values are garbage — mask with slot < n).
-    Pointer doubling: dist[i] = hops from node i to END, accumulated over
-    ceil(log2(nodes)) rounds of jumps. Then pos = dist[HEAD] - dist - 1.
+    pos is node-indexed (sentinels at 0..2, real slots from SLOT0=3, in
+    op-arrival order): pos[d, SLOT0 + k] is the 0-based sequence index of
+    doc d's k-th allocated slot; sentinel and unallocated entries are
+    garbage — mask with SLOT0 <= node < SLOT0 + n.
+    Pointer doubling (Wyllie's list ranking): dist[i] = hops from node i to
+    END, accumulated over ceil(log2(nodes)) rounds of jumps. Then
+    pos = dist[HEAD] - dist - 1.
     """
-    capacity = state.elem_id.shape[1] - 1
-    scratch, head, end = _node_indexes(capacity)
-    nodes = capacity + 3
+    nodes = state.nxt.shape[1]
 
     def per_doc(nxt):
-        dist = jnp.ones((nodes,), dtype=jnp.int32).at[end].set(0)
-        ptr = nxt.at[end].set(end)
+        dist = jnp.ones((nodes,), dtype=jnp.int32).at[END].set(0)
+        ptr = nxt.at[END].set(END)
 
         def round_(i, s):
             dist, ptr = s
@@ -242,8 +245,7 @@ def _linearize_impl(state):
 
         steps = int(np.ceil(np.log2(nodes)))
         dist, ptr = lax.fori_loop(0, steps, round_, (dist, ptr))
-        pos = dist[head] - dist - 1
-        return pos[:capacity + 1]
+        return dist[HEAD] - dist - 1
 
     pos = jax.vmap(per_doc)(state.nxt)
     return pos, state.n
@@ -259,12 +261,14 @@ def _materialize_impl(state):
     are zeros. Visible-only extraction (for text strings / patch indexes) is
     a host-side compress over the vis mask.
     """
-    capacity = state.elem_id.shape[1] - 1
+    capacity = state.elem_id.shape[1] - 3
     pos, n = _linearize_impl(state)
 
     def per_doc(pos, vis, val, n):
-        slot_ids = jnp.arange(capacity + 1, dtype=jnp.int32)
-        alloc = slot_ids < n
+        node_ids = jnp.arange(capacity + 3, dtype=jnp.int32)
+        alloc = (node_ids >= SLOT0) & (node_ids < SLOT0 + n)
+        # Scatter into sequence order; masked lanes land on a trailing
+        # scratch column that the [:capacity] slice drops
         tgt = jnp.where(alloc, jnp.clip(pos, 0, capacity), capacity)
         out_val = jnp.zeros((capacity + 1,), val.dtype).at[tgt].set(
             jnp.where(alloc, val, 0))

@@ -93,6 +93,84 @@ def sharded_seq_apply(mesh):
     return step
 
 
+def long_seq_sharding(mesh):
+    """NamedShardings for the LONG-document regime: a handful of very long
+    sequences whose slot axis shards across every device of the mesh (the
+    CRDT analogue of sequence/context parallelism, SURVEY.md §2.12/§5 — the
+    document is too long for one chip's memory/bandwidth, so its element
+    slots, pointers, and values stripe over the whole mesh)."""
+    every_axis = mesh.axis_names
+    slots = NamedSharding(mesh, P(None, every_axis))
+    vec = NamedSharding(mesh, P())
+    return slots, vec
+
+
+def shard_long_seq(state, mesh):
+    """Shard a long-document SeqState's node axis across the whole mesh,
+    tail-padding to a device-count multiple first (safe because sentinels
+    are front-anchored and padded tail slots read as unallocated)."""
+    from .sequence import END, SeqState
+    import numpy as np
+    slots, vec = long_seq_sharding(mesh)
+    n_dev = int(np.prod(mesh.devices.shape))
+    size = state.elem_id.shape[1]
+    pad = (-size) % n_dev
+
+    def padded(x, fill):
+        if pad == 0:
+            return x
+        out = jnp.full((x.shape[0], size + pad), fill, dtype=x.dtype)
+        return out.at[:, :size].set(x)
+
+    return SeqState(
+        jax.device_put(padded(state.elem_id, 0), slots),
+        jax.device_put(padded(state.nxt, END), slots),
+        jax.device_put(padded(state.winner, 0), slots),
+        jax.device_put(padded(state.vis, False), slots),
+        jax.device_put(padded(state.val, 0), slots),
+        jax.device_put(state.n, vec))
+
+
+def sharded_long_seq_apply(mesh):
+    """Jitted op application for slot-sharded long documents. Per-op work is
+    a one-hot referent lookup over the sharded slot axis (an all-reduce per
+    op) plus the RGA pointer walk's scalar gathers; causality keeps the op
+    stream itself sequential — the win is that the document's state never
+    has to fit one chip."""
+    from .sequence import SeqState, _apply_seq_batch_impl
+    slots, vec = long_seq_sharding(mesh)
+
+    @jax.jit
+    def step(state, ops):
+        new_state, stats = _apply_seq_batch_impl(state, ops)
+        new_state = SeqState(
+            *(jax.lax.with_sharding_constraint(x, slots)
+              for x in (new_state.elem_id, new_state.nxt, new_state.winner,
+                        new_state.vis, new_state.val)),
+            jax.lax.with_sharding_constraint(new_state.n, vec))
+        return new_state, stats
+    return step
+
+
+def sharded_long_seq_materialize(mesh):
+    """Jitted sequence-order extraction for slot-sharded long documents.
+
+    This is the bandwidth-heavy read path and the part that genuinely
+    parallelizes: pointer-doubling list ranking (Wyllie's algorithm) runs
+    ceil(log2 S) rounds of gathers over the sharded pointer array, with XLA
+    inserting the cross-shard collectives — the segmented-scan trick the
+    survey names as the long-context equivalent (SURVEY.md §5)."""
+    from .sequence import _materialize_impl
+    slots, _vec = long_seq_sharding(mesh)
+
+    @jax.jit
+    def run(state):
+        vals, vis, n = _materialize_impl(state)
+        return (jax.lax.with_sharding_constraint(vals, slots),
+                jax.lax.with_sharding_constraint(vis, slots), n)
+    return run
+
+
 def sharded_apply(mesh):
     """A jitted fleet step with explicit output shardings: data-parallel over
     docs, key grid sharded over the second mesh axis. The scatter by key_id

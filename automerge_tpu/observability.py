@@ -26,7 +26,7 @@ class Metrics:
 
     _FIELDS = (
         'dispatches',            # device merge dispatches issued
-        'device_ops',            # op rows applied on device (incl. padding)
+        'device_ops',            # real op rows applied on device (padding excluded)
         'changes_ingested',      # binary changes accepted by apply paths
         'bytes_ingested',        # wire bytes parsed
         'turbo_calls',           # batched turbo applies
