@@ -320,3 +320,87 @@ class TestLongTextStress:
             assert str(doc['text']) == ''.join(expected)
         doc2 = A.load(A.save(doc))
         assert str(doc2['text']) == ''.join(expected)
+
+
+class TestLongTextSaveLoad:
+    """Long text editing with persistence (extends the stress suite above,
+    ref new_backend_test.js:2063-2193): 1200 inserts + 300 deletes crossing
+    several sequence-block splits (_BLOCK_SIZE=256), then save/load
+    round-trip and full-log convergence on a second doc."""
+
+    def test_long_text_insert_delete_saveload(self):
+        rng = random.Random(42)
+        doc = OpSet()
+        text_id = f'1@{A1}'
+        changes = [encode_change({
+            'actor': A1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+            'ops': [{'action': 'makeText', 'obj': '_root', 'key': 'text',
+                     'insert': False, 'pred': []}]})]
+        doc.apply_changes([changes[0]])
+
+        # 1200 single-char inserts: 70% append, 30% at a random position
+        elem_ids = []      # visible order
+        expected = []
+        ctr = 2
+        seq = 2
+        for i in range(1200):
+            ch = chr(97 + rng.randrange(26))
+            if elem_ids and rng.random() < 0.3:
+                pos = rng.randrange(len(elem_ids))
+                ref = elem_ids[pos - 1] if pos else '_head'
+            else:
+                pos = len(elem_ids)
+                ref = elem_ids[-1] if elem_ids else '_head'
+            buf = encode_change({
+                'actor': A1, 'seq': seq, 'startOp': ctr, 'time': 0,
+                'deps': doc.heads,
+                'ops': [{'action': 'set', 'obj': text_id, 'elemId': ref,
+                         'insert': True, 'value': ch, 'pred': []}]})
+            doc.apply_changes([buf])
+            changes.append(buf)
+            elem_ids.insert(pos, f'{ctr}@{A1}')
+            expected.insert(pos, ch)
+            ctr += 1
+            seq += 1
+
+        # 300 deletes at random positions
+        for i in range(300):
+            pos = rng.randrange(len(elem_ids))
+            target = elem_ids.pop(pos)
+            expected.pop(pos)
+            buf = encode_change({
+                'actor': A1, 'seq': seq, 'startOp': ctr, 'time': 0,
+                'deps': doc.heads,
+                'ops': [{'action': 'del', 'obj': text_id, 'elemId': target,
+                         'insert': False, 'pred': [target]}]})
+            doc.apply_changes([buf])
+            changes.append(buf)
+            ctr += 1
+            seq += 1
+
+        def text_of(op_set):
+            patch = op_set.get_patch()
+            text_diff = patch['diffs']['props']['text'][text_id]
+            out = []
+            for edit in text_diff['edits']:
+                if edit['action'] == 'insert':
+                    out.insert(edit['index'], edit['value']['value'])
+                elif edit['action'] == 'multi-insert':
+                    for k, v in enumerate(edit['values']):
+                        out.insert(edit['index'] + k, v)
+            return ''.join(out)
+
+        assert text_of(doc) == ''.join(expected)
+        assert len(expected) == 900
+
+        # Save/load round trip preserves content and heads
+        saved = doc.save()
+        loaded = OpSet(saved)
+        assert loaded.heads == doc.heads
+        assert text_of(loaded) == ''.join(expected)
+
+        # A second doc receiving the full change log in one call converges
+        other = OpSet()
+        other.apply_changes(list(changes))
+        assert other.heads == doc.heads
+        assert bytes(other.save()) == bytes(doc.save())
