@@ -214,3 +214,46 @@ def test_fuzz_delivery_order_independence(seed):
         fresh, _ = am.apply_changes(am.init('dd04'), order)
         results.append(to_plain(fresh))
     assert all(r == results[0] for r in results)
+
+
+@pytest.mark.parametrize('seed', [11, 12, 13])
+def test_fuzz_fleet_backend_matches_host(seed):
+    """The wasm.js differential pattern under fuzz: the same random 3-actor
+    history drives the host backend and the device-routed fleet backend
+    (installed via set_default_backend); every replica's converged state and
+    serialized document must be identical across backends."""
+    from automerge_tpu import backend as host_backend
+    from automerge_tpu.fleet.backend import DocFleet, FleetBackend
+
+    def run(seed):
+        rnd = random.Random(seed)
+        actors = ['aa01', 'bb02', 'cc03']
+        docs = {a: am.init(a) for a in actors}
+        for round_ in range(12):
+            actor = rnd.choice(actors)
+            new_doc, req = Frontend.change(
+                docs[actor], random_mutation(rnd, docs[actor], deletes=False))
+            if req is not None:
+                docs[actor] = new_doc
+            if rnd.random() < 0.6:
+                src, dst = rnd.sample(actors, 2)
+                if docs[src]._state['clock'] != docs[dst]._state['clock']:
+                    changes = am.get_all_changes(docs[src])
+                    docs[dst], _ = am.apply_changes(docs[dst], changes)
+        all_changes = []
+        for a in actors:
+            all_changes.extend(am.get_all_changes(docs[a]))
+        out = {}
+        for a in actors:
+            merged, _ = am.apply_changes(docs[a], all_changes)
+            out[a] = (to_plain(merged), bytes(am.save(merged)))
+        return out
+
+    host_out = run(seed)
+    am.set_default_backend(FleetBackend(DocFleet(doc_capacity=4,
+                                                 key_capacity=4)))
+    try:
+        fleet_out = run(seed)
+    finally:
+        am.set_default_backend(host_backend)
+    assert host_out == fleet_out

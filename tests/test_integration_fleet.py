@@ -1,0 +1,29 @@
+"""The full public-API integration suite re-run with the device-routed fleet
+backend installed as the default backend (the test/wasm.js pattern: the same
+test corpus must pass against a replacement backend, ref test/wasm.js:27-36).
+
+Every class from tests/test_integration.py is re-collected here under an
+autouse fixture that swaps in a fresh FleetBackend per test; flat documents
+exercise the device path, nested/list/text documents exercise transparent
+promotion, and teardown restores the host backend."""
+
+import pytest
+
+import automerge_tpu as A
+from automerge_tpu import backend as host_backend
+from automerge_tpu.fleet.backend import DocFleet, FleetBackend
+
+from tests.test_integration import (  # noqa: F401
+    TestInitAndChange, TestLists, TestConcurrentUse, TestCounters,
+    TestSaveLoad, TestHistory, TestChangesAPI, TestText, TestTable,
+)
+
+
+@pytest.fixture(autouse=True)
+def fleet_default_backend():
+    A.set_default_backend(FleetBackend(DocFleet(doc_capacity=4,
+                                                key_capacity=4)))
+    try:
+        yield
+    finally:
+        A.set_default_backend(host_backend)
