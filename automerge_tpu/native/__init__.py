@@ -238,9 +238,13 @@ def ingest_changes(buffers, doc_ids, with_meta=False):
     if n_rows < 0:
         return None
     metas = None
+    preds = None
     if with_meta:
         metas = _fetch_ingest_meta(lib, len(buffers), len(blob))
         if metas is None:
+            return None
+        preds = _fetch_ingest_preds(lib, int(n_rows))
+        if preds is None:
             return None
     n = max(int(n_rows), 1)
     doc = np.zeros(n, dtype=np.int32)
@@ -279,8 +283,32 @@ def ingest_changes(buffers, doc_ids, with_meta=False):
             'packed': packed[:int(n_rows)], 'value': val[:int(n_rows)],
             'flags': flags[:int(n_rows)]}
     if with_meta:
+        rows['pred_off'], rows['pred'] = preds
         return rows, keys, actors, metas
     return rows, keys, actors
+
+
+def _fetch_ingest_preds(lib, n_rows):
+    """Copy out per-op pred lists (packed opIds with native actor numbers).
+    Must run before am_ingest_fetch."""
+    i64 = ctypes.c_int64
+    i64p = ctypes.POINTER(i64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.am_ingest_pred_count.argtypes = []
+    lib.am_ingest_pred_count.restype = i64
+    n_preds = int(lib.am_ingest_pred_count())
+    if n_preds < 0:
+        return None
+    pred_off = np.zeros(max(n_rows, 1) + 1, dtype=np.int64)
+    pred_blob = np.zeros(max(n_preds, 1), dtype=np.int32)
+    lib.am_ingest_pred_fetch.argtypes = [i64p, i32p, ctypes.c_uint64]
+    lib.am_ingest_pred_fetch.restype = i64
+    got = lib.am_ingest_pred_fetch(
+        pred_off.ctypes.data_as(i64p), pred_blob.ctypes.data_as(i32p),
+        pred_blob.size)
+    if got < 0:
+        return None
+    return pred_off[:n_rows + 1], pred_blob[:int(got)]
 
 
 def _fetch_ingest_meta(lib, n_changes, blob_len):

@@ -373,6 +373,11 @@ struct IngestCtx {
   std::vector<uint8_t> m_deps;      // 32 bytes per dep, concatenated
   std::vector<int64_t> m_msg_off;   // per change, byte offset into m_msg
   std::vector<uint8_t> m_msg;       // UTF-8 message bytes, concatenated
+  // Per-op pred lists (with_meta only): out_pred_off[i] indexes the first
+  // pred of op row i in out_pred; packed as (ctr << kActorBits) | actor
+  // with GLOBAL actor numbers (the per-change actor table is interned)
+  std::vector<int64_t> out_pred_off;
+  std::vector<int32_t> out_pred;
 };
 
 // SHA-256 of a change chunk as the reference hashes it (columnar.js:688-708):
@@ -397,6 +402,7 @@ constexpr int kColObjActor = 0x01, kColObjCtr = 0x02;
 constexpr int kColKeyActor = 0x11, kColKeyCtr = 0x13, kColKeyStr = 0x15;
 constexpr int kColInsert = 0x34, kColAction = 0x42;
 constexpr int kColValLen = 0x56, kColValRaw = 0x57;
+constexpr int kColPredNum = 0x70, kColPredActor = 0x71, kColPredCtr = 0x73;
 constexpr int kActionSet = 1, kActionDel = 3, kActionInc = 5;
 constexpr int kActorBits = 8;
 
@@ -501,10 +507,24 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
   } else {
     c.skip(msg_len);
   }
+  std::vector<int32_t> actor_table;
+  actor_table.push_back(actor_id);
   uint64_t num_other_actors = c.uleb();
   for (uint64_t i = 0; i < num_other_actors; i++) {
     uint64_t alen = c.uleb();
-    c.skip(alen);
+    const uint8_t *abytes = c.bytes(alen);
+    if (c.fail) return false;
+    if (with_meta) {
+      std::string other_hex;
+      other_hex.reserve(alen * 2);
+      for (uint64_t j = 0; j < alen; j++) {
+        other_hex.push_back(hex[abytes[j] >> 4]);
+        other_hex.push_back(hex[abytes[j] & 15]);
+      }
+      int32_t oid = ctx.actors.intern(other_hex);
+      if (oid >= (1 << kActorBits)) return false;
+      actor_table.push_back(oid);
+    }
   }
   if (c.fail) return false;
 
@@ -530,6 +550,8 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
   std::vector<uint8_t> actions_ok, val_lens_ok, obj_ctr_ok, insert_vals,
       insert_ok;
   std::vector<int64_t> insert_i64;
+  std::vector<int64_t> pred_num, pred_actor, pred_ctr;
+  std::vector<uint8_t> pred_num_ok, pred_actor_ok, pred_ctr_ok;
   const uint8_t *val_raw = nullptr;
   uint64_t val_raw_len = 0;
 
@@ -550,6 +572,15 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
       val_raw_len = blen;
     } else if (cid == kColObjCtr) {
       if (!decode_i64_col(b, blen, false, false, obj_ctr, obj_ctr_ok))
+        return false;
+    } else if (with_meta && cid == kColPredNum) {
+      if (!decode_i64_col(b, blen, false, false, pred_num, pred_num_ok))
+        return false;
+    } else if (with_meta && cid == kColPredActor) {
+      if (!decode_i64_col(b, blen, false, false, pred_actor, pred_actor_ok))
+        return false;
+    } else if (with_meta && cid == kColPredCtr) {
+      if (!decode_i64_col(b, blen, true, true, pred_ctr, pred_ctr_ok))
         return false;
     } else if (cid == kColInsert) {
       if (!decode_i64_col(b, blen, false, false, insert_i64, insert_ok)) {
@@ -580,8 +611,30 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
 
   uint64_t n_ops = actions.size();
   uint64_t raw_pos = 0;
+  uint64_t pred_pos = 0;
   for (uint64_t i = 0; i < n_ops; i++) {
     int64_t action = actions[i];
+    if (with_meta) {
+      ctx.out_pred_off.push_back(int64_t(ctx.out_pred.size()));
+      uint64_t np = 0;
+      if (i < pred_num.size()) {
+        if (!pred_num_ok[i]) return false;  // null group cardinality
+        np = uint64_t(pred_num[i]);
+      }
+      for (uint64_t d = 0; d < np; d++, pred_pos++) {
+        if (pred_pos >= pred_actor.size() || pred_pos >= pred_ctr.size())
+          return false;
+        if (!pred_actor_ok[pred_pos] || !pred_ctr_ok[pred_pos])
+          return false;  // null entries inside a pred group are malformed
+        uint64_t ta = uint64_t(pred_actor[pred_pos]);
+        if (ta >= actor_table.size()) return false;
+        int64_t pctr = pred_ctr[pred_pos];
+        if (pctr <= 0 || pctr >= (int64_t(1) << (31 - kActorBits)))
+          return false;
+        ctx.out_pred.push_back(
+            int32_t((pctr << kActorBits) | actor_table[ta]));
+      }
+    }
     // root-map only: objCtr must be null
     if (i < obj_ctr.size() && obj_ctr_ok.size() > i && obj_ctr_ok[i])
       return false;
@@ -759,6 +812,29 @@ int64_t am_ingest_meta_fetch(int32_t *actor, int64_t *seq, int64_t *start_op,
   msg_off[n] = int64_t(ctx.m_msg.size());
   memcpy(msg_blob, ctx.m_msg.data(), ctx.m_msg.size());
   return int64_t(n);
+}
+
+// Number of pred entries captured by the last am_ingest_changes call
+// (with_meta=1), so the caller can size the fetch buffer exactly.
+int64_t am_ingest_pred_count() {
+  if (!g_ingest) return -1;
+  return int64_t(g_ingest->out_pred.size());
+}
+
+// Copy per-op pred lists captured by am_ingest_changes(with_meta=1).
+// pred_off receives n_rows+1 prefix offsets. Must be called BEFORE
+// am_ingest_fetch (which frees the context). Returns total preds or -1.
+int64_t am_ingest_pred_fetch(int64_t *pred_off, int32_t *pred_blob,
+                             uint64_t pred_cap) {
+  if (!g_ingest) return -1;
+  IngestCtx &ctx = *g_ingest;
+  size_t n = ctx.out_pred_off.size();
+  if (n != ctx.out_doc.size()) return -1;
+  if (ctx.out_pred.size() > pred_cap) return -1;
+  memcpy(pred_off, ctx.out_pred_off.data(), n * 8);
+  pred_off[n] = int64_t(ctx.out_pred.size());
+  memcpy(pred_blob, ctx.out_pred.data(), ctx.out_pred.size() * 4);
+  return int64_t(ctx.out_pred.size());
 }
 
 }  // extern "C"

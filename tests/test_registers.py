@@ -1,0 +1,265 @@
+"""The exact multi-value register engine (fleet/registers.py) against the
+host OpSet oracle: conflict sets, set-vs-delete resurrection, per-op counter
+accumulation, self-conflict flagging — the corners the LWW scatter engine
+documents away must be exact here."""
+
+import numpy as np
+import pytest
+
+from automerge_tpu.backend.op_set import OpSet
+from automerge_tpu.columnar import encode_change, decode_change
+from automerge_tpu.common import lamport_key, parse_op_id
+from automerge_tpu.fleet.registers import (
+    DEL, INC, PAD, SET, RegisterOpBatch, RegisterState,
+    apply_register_batch, materialize_registers)
+
+ACTORS = sorted(['aa' * 16, 'bb' * 16, 'cc' * 16])
+ANUM = {a: i for i, a in enumerate(ACTORS)}
+KEYS = ['k0', 'k1', 'k2', 'k3']
+KNUM = {k: i for i, k in enumerate(KEYS)}
+
+
+def pack(op_id):
+    ctr, actor = parse_op_id(op_id)
+    return (ctr << 8) | ANUM[actor]
+
+
+def batch_of(op_lists, n_docs=1, d_preds=2):
+    """op_lists: per-doc list of (kind, key, op_id, value, preds)."""
+    width = max((len(o) for o in op_lists), default=1)
+    kind = np.zeros((n_docs, width), dtype=np.int32)
+    key_id = np.zeros((n_docs, width), dtype=np.int32)
+    packed = np.zeros((n_docs, width), dtype=np.int32)
+    value = np.zeros((n_docs, width), dtype=np.int32)
+    preds = np.zeros((n_docs, width, d_preds), dtype=np.int32)
+    overflow = np.zeros((n_docs, width), dtype=bool)
+    for d, ops in enumerate(op_lists):
+        for i, (k, key, op_id, val, pred) in enumerate(ops):
+            kind[d, i] = k
+            key_id[d, i] = KNUM[key]
+            packed[d, i] = pack(op_id)
+            value[d, i] = val
+            if len(pred) > d_preds:
+                overflow[d, i] = True
+            for j, p in enumerate(pred[:d_preds]):
+                preds[d, i, j] = pack(p)
+    return RegisterOpBatch(kind, key_id, packed, value, preds, overflow)
+
+
+def run_device(ops, n_actor_slots=4):
+    state = RegisterState.empty(1, len(KEYS), n_actor_slots)
+    state, _ = apply_register_batch(state, batch_of([ops]))
+    return state
+
+
+def host_oracle(changes):
+    """Apply hand-built changes to the host engine; return
+    {key: (winner_value, {opId: value})} from the whole-doc patch."""
+    doc = OpSet()
+    doc.apply_changes([encode_change(c) for c in changes])
+    props = doc.get_patch()['diffs']['props']
+    out = {}
+    for key, candidates in props.items():
+        if not candidates:
+            continue
+        winner = max(candidates.keys(), key=lamport_key)
+        conflicts = {pack(op_id): leaf['value']
+                     for op_id, leaf in candidates.items()} \
+            if len(candidates) > 1 else {}
+        out[key] = (candidates[winner]['value'], conflicts)
+    return out
+
+
+def device_view(state):
+    docs = materialize_registers(state, KEYS)
+    assert not bool(np.asarray(state.inexact)[0])
+    return docs[0]
+
+
+class TestExactCorners:
+    def test_concurrent_conflict_set(self):
+        a, b = ACTORS[0], ACTORS[1]
+        ops = [(SET, 'k0', f'1@{a}', 10, []),
+               (SET, 'k0', f'1@{b}', 20, [])]
+        state = run_device(ops)
+        assert device_view(state) == {'k0': (20, {pack(f'1@{a}'): 10,
+                                                  pack(f'1@{b}'): 20})}
+        changes = [
+            {'actor': a, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+             'ops': [{'action': 'set', 'obj': '_root', 'key': 'k0',
+                      'value': 10, 'datatype': 'int', 'pred': []}]},
+            {'actor': b, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+             'ops': [{'action': 'set', 'obj': '_root', 'key': 'k0',
+                      'value': 20, 'datatype': 'int', 'pred': []}]},
+        ]
+        assert host_oracle(changes) == device_view(state)
+
+    def test_set_vs_delete_resurrection(self):
+        """A delete kills only its preds: a concurrent set survives even
+        when the delete's opId is Lamport-greater (the LWW engine's
+        documented divergence; exact here)."""
+        a, b, c = ACTORS
+        ops = [(SET, 'k1', f'1@{a}', 5, []),
+               (SET, 'k1', f'2@{b}', 7, [f'1@{a}']),     # concurrent branch 1
+               (DEL, 'k1', f'9@{c}', 0, [f'1@{a}'])]     # concurrent branch 2
+        state = run_device(ops)
+        # 9@cc > 2@bb, yet bb's set survives because the del pred'd only 1@aa
+        assert device_view(state) == {'k1': (7, {})}
+
+        h1 = {'actor': a, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+              'ops': [{'action': 'set', 'obj': '_root', 'key': 'k1',
+                       'value': 5, 'datatype': 'int', 'pred': []}]}
+        dep = decode_change(encode_change(h1))['hash']
+        changes = [h1,
+                   {'actor': b, 'seq': 1, 'startOp': 2, 'time': 0,
+                    'deps': [dep],
+                    'ops': [{'action': 'set', 'obj': '_root', 'key': 'k1',
+                             'value': 7, 'datatype': 'int',
+                             'pred': [f'1@{a}']}]},
+                   {'actor': c, 'seq': 1, 'startOp': 9, 'time': 0,
+                    'deps': [dep],
+                    'ops': [{'action': 'del', 'obj': '_root', 'key': 'k1',
+                             'pred': [f'1@{a}']}]}]
+        assert host_oracle(changes) == device_view(state)
+
+    def test_counter_accumulates_into_its_op(self):
+        a, b = ACTORS[0], ACTORS[1]
+        ops = [(SET, 'k2', f'1@{a}', 10, []),
+               (INC, 'k2', f'2@{a}', 4, [f'1@{a}']),
+               (INC, 'k2', f'2@{b}', -2, [f'1@{a}'])]
+        state = run_device(ops)
+        assert device_view(state) == {'k2': (12, {})}
+
+    def test_counter_overwrite_drops_accumulator(self):
+        a = ACTORS[0]
+        ops = [(SET, 'k2', f'1@{a}', 10, []),
+               (INC, 'k2', f'2@{a}', 3, [f'1@{a}']),
+               (SET, 'k2', f'3@{a}', 100, [f'1@{a}'])]
+        state = run_device(ops)
+        assert device_view(state) == {'k2': (100, {})}
+
+    def test_delete_then_nothing_visible(self):
+        a = ACTORS[0]
+        ops = [(SET, 'k3', f'1@{a}', 1, []),
+               (DEL, 'k3', f'2@{a}', 0, [f'1@{a}'])]
+        state = run_device(ops)
+        assert device_view(state) == {}
+
+    def test_same_batch_kill_ordering(self):
+        """An op and its killer in one batch: the scan applies them in
+        order, so the kill lands (the unordered scatter engine can't)."""
+        a, b = ACTORS[0], ACTORS[1]
+        ops = [(SET, 'k0', f'5@{b}', 1, []),
+               (SET, 'k0', f'6@{a}', 2, [f'5@{b}'])]   # smaller actor, kills
+        state = run_device(ops)
+        assert device_view(state) == {'k0': (2, {})}
+
+
+class TestInexactFlags:
+    def test_self_conflict_flags_doc(self):
+        a = ACTORS[0]
+        ops = [(SET, 'k0', f'1@{a}', 1, []),
+               (SET, 'k0', f'2@{a}', 2, [])]   # own overwrite without pred
+        state = run_device(ops)
+        assert bool(np.asarray(state.inexact)[0])
+
+    def test_bad_inc_flags_doc(self):
+        a = ACTORS[0]
+        ops = [(INC, 'k0', f'1@{a}', 1, [f'9@{a}'])]
+        state = run_device(ops)
+        assert bool(np.asarray(state.inexact)[0])
+
+    def test_pred_overflow_flags_doc(self):
+        a = ACTORS[0]
+        ops = [(SET, 'k0', f'1@{a}', 1, []),
+               (SET, 'k0', f'9@{a}', 2,
+                [f'1@{a}', f'3@{a}', f'4@{a}'])]   # > d_preds=2
+        state = run_device(ops)
+        assert bool(np.asarray(state.inexact)[0])
+
+
+class TestRandomizedDifferential:
+    @pytest.mark.parametrize('seed', [0, 1, 2])
+    def test_random_histories_match_host(self, seed):
+        """Random causally-valid op streams (sets/dels/incs with correct
+        preds) through both engines; visible winners and conflict sets must
+        match the host patch exactly."""
+        rng = np.random.default_rng(seed)
+        visible = {k: set() for k in KEYS}    # key -> visible opIds
+        counters = {}                          # opId -> is counter
+        ops, changes = [], []
+        ctr = {a: 0 for a in ACTORS}
+        seqs = {a: 0 for a in ACTORS}
+        deps = []
+        for step in range(40):
+            actor = ACTORS[int(rng.integers(0, 3))]
+            key = KEYS[int(rng.integers(0, len(KEYS)))]
+            ctr[actor] = max(ctr.values()) + 1
+            seqs[actor] += 1
+            op_id = f'{ctr[actor]}@{actor}'
+            vis = sorted(visible[key], key=lamport_key)
+            roll = rng.random()
+            counter_targets = [v for v in vis if counters.get(v)]
+            if roll < 0.2 and counter_targets:
+                target = counter_targets[int(rng.integers(0, len(counter_targets)))]
+                delta = int(rng.integers(-5, 10))
+                ops.append((INC, key, op_id, delta, [target]))
+                op = {'action': 'inc', 'obj': '_root', 'key': key,
+                      'value': delta, 'pred': [target]}
+            elif roll < 0.4 and vis:
+                pred = vis if rng.random() < 0.7 else vis[:1]
+                ops.append((DEL, key, op_id, 0, pred))
+                op = {'action': 'del', 'obj': '_root', 'key': key,
+                      'pred': pred}
+                visible[key] -= set(pred)
+            else:
+                is_counter = rng.random() < 0.3
+                val = int(rng.integers(0, 100))
+                pred = vis  # always supersede what we see (frontend shape)
+                ops.append((SET, key, op_id, val, pred))
+                op = {'action': 'set', 'obj': '_root', 'key': key,
+                      'value': val, 'pred': pred,
+                      'datatype': 'counter' if is_counter else 'int'}
+                visible[key] -= set(pred)
+                visible[key].add(op_id)
+                counters[op_id] = is_counter
+            change = {'actor': actor, 'seq': seqs[actor],
+                      'startOp': ctr[actor], 'time': 0, 'deps': deps,
+                      'ops': [op]}
+            deps = [decode_change(encode_change(change))['hash']]
+            changes.append(change)
+
+        state = run_device(ops, n_actor_slots=4)
+        assert host_oracle(changes) == device_view(state)
+
+
+class TestSlotWidthFlags:
+    def test_actor_beyond_slot_width_flags(self):
+        a, c = ACTORS[0], ACTORS[2]
+        state = RegisterState.empty(1, len(KEYS), 2)   # slots for 2 actors
+        state, _ = apply_register_batch(state, batch_of([[
+            (SET, 'k0', f'1@{c}', 1, [])]]))           # actor num 2 >= 2
+        assert bool(np.asarray(state.inexact)[0])
+
+    def test_pred_actor_beyond_slot_width_flags(self):
+        a, c = ACTORS[0], ACTORS[2]
+        state = RegisterState.empty(1, len(KEYS), 2)
+        state, _ = apply_register_batch(state, batch_of([[
+            (SET, 'k0', f'1@{a}', 1, []),
+            (DEL, 'k0', f'2@{a}', 0, [f'1@{c}'])]]))
+        assert bool(np.asarray(state.inexact)[0])
+
+    def test_null_valued_set_keeps_conflicts(self):
+        """A winner decoding to None must not drop the key or its conflict
+        set (regression)."""
+        a, b = ACTORS[0], ACTORS[1]
+        table = [None]
+        state = RegisterState.empty(1, len(KEYS), 4)
+        batch = batch_of([[
+            (SET, 'k0', f'1@{a}', 5, []),
+            (SET, 'k0', f'1@{b}', -2, [])]])   # -2 = table ref 0 -> None
+        state, _ = apply_register_batch(state, batch)
+        docs = materialize_registers(state, KEYS, value_table=table)
+        winner, conflicts = docs[0]['k0']
+        assert winner is None
+        assert conflicts == {pack(f'1@{a}'): 5, pack(f'1@{b}'): None}
