@@ -214,6 +214,52 @@ def visible_registers(state):
     return visible, winner_slot, winner_packed
 
 
+def rows_to_register_batch(doc_ids, flags, key_ids, packed, values,
+                           pred_off, pred, n_docs, d_preds=4):
+    """Lay flat native-ingest op rows (application order, doc-contiguous)
+    into a RegisterOpBatch [n_docs, P]. Inputs are the arrays the native
+    parser emits with with_meta=True — flags (1 = set/del, 2 = inc; dels
+    carry value -1), pred_off/pred per-row pred lists — already remapped to
+    fleet key/actor numbering by the caller. Stable layout preserves each
+    document's op order (the scan applies columns in order)."""
+    doc_ids = np.asarray(doc_ids, dtype=np.int64)
+    n_rows = len(doc_ids)
+    counts = np.bincount(doc_ids, minlength=n_docs) if n_rows else \
+        np.zeros(n_docs, dtype=np.int64)
+    width = max(int(counts.max()) if n_rows else 0, 1)
+    order = np.argsort(doc_ids, kind='stable')
+    doc_sorted = doc_ids[order]
+    pos = np.arange(n_rows) - np.searchsorted(doc_sorted, doc_sorted,
+                                              side='left')
+    kind = np.zeros((n_docs, width), dtype=np.int32)
+    key_col = np.zeros((n_docs, width), dtype=np.int32)
+    packed_col = np.zeros((n_docs, width), dtype=np.int32)
+    value_col = np.zeros((n_docs, width), dtype=np.int32)
+    preds_col = np.zeros((n_docs, width, d_preds), dtype=np.int32)
+    overflow = np.zeros((n_docs, width), dtype=bool)
+
+    flags = np.asarray(flags)
+    values = np.asarray(values)
+    kinds_flat = np.where(flags == 2, INC,
+                          np.where(values == -1, DEL, SET)).astype(np.int32)
+    kind[doc_sorted, pos] = kinds_flat[order]
+    key_col[doc_sorted, pos] = np.asarray(key_ids)[order]
+    packed_col[doc_sorted, pos] = np.asarray(packed)[order]
+    value_col[doc_sorted, pos] = np.where(values == -1, 0, values)[order]
+
+    pred_off = np.asarray(pred_off)
+    pred = np.asarray(pred)
+    pred_counts = np.diff(pred_off)
+    overflow[doc_sorted, pos] = (pred_counts > d_preds)[order]
+    for d in range(d_preds):
+        has = pred_counts > d
+        lane = np.zeros(n_rows, dtype=np.int32)
+        lane[has] = pred[pred_off[:-1][has] + d]
+        preds_col[doc_sorted, pos, d] = lane[order]
+    return RegisterOpBatch(kind, key_col, packed_col, value_col, preds_col,
+                           overflow)
+
+
 def materialize_registers(state, keys, value_table=None):
     """Host-side read: per doc {key: (winner_value, conflict_dict)} where
     conflict_dict maps packed opId -> value for every visible op (empty for

@@ -276,6 +276,32 @@ def bench_zipf(n_docs, zipf_a=1.5, max_per_doc=256, round_width=32, seed=0):
     return total_ops / elapsed, occupancy
 
 
+def bench_registers(n_docs, n_keys=64, n_actor_slots=4, p=128, seed=0):
+    """Exact multi-value register engine: ordered scan over the op axis,
+    [n_docs]-wide steps (conflict sets / resurrection / counter semantics
+    exact on device, unlike the scatter-max LWW engine)."""
+    import jax
+    from automerge_tpu.fleet.registers import (
+        RegisterOpBatch, RegisterState, apply_register_batch)
+    rng = np.random.default_rng(seed)
+    kind = rng.integers(1, 4, (n_docs, p), dtype=np.int32)
+    key = rng.integers(0, n_keys, (n_docs, p), dtype=np.int32)
+    actor = rng.integers(0, n_actor_slots - 1, (n_docs, p), dtype=np.int32)
+    packed = ((1 + np.arange(p, dtype=np.int32))[None, :] << 8) | actor
+    value = rng.integers(0, 1000, (n_docs, p), dtype=np.int32)
+    preds = np.zeros((n_docs, p, 2), dtype=np.int32)
+    preds[:, 1:, 0] = packed[:, :-1]     # chain preds (kill previous)
+    overflow = np.zeros((n_docs, p), dtype=bool)
+    batch = RegisterOpBatch(kind, key, packed, value, preds, overflow)
+    state = RegisterState.empty(n_docs, n_keys, n_actor_slots)
+    state, _ = apply_register_batch(state, batch)
+    jax.block_until_ready(state.reg)
+    start = time.perf_counter()
+    state, stats = apply_register_batch(state, batch)
+    jax.block_until_ready(state.reg)
+    return (n_docs * p) / (time.perf_counter() - start)
+
+
 def bench_text(n_docs, trace_len, n_actors=3, seed=0):
     """Config 2 (BASELINE.md): batched text editing traces through the device
     sequence engine — n_docs docs, each applying a trace_len-op multi-actor
@@ -349,6 +375,8 @@ def main():
     # Config 5 (stretch): Zipf-skewed change rates over a large fleet
     zipf_rate, zipf_occ = bench_zipf(
         int(os.environ.get('BENCH_ZIPF_DOCS', 100000)))
+    # Exact multi-value register engine (ordered scan formulation)
+    reg_rate = bench_registers(int(os.environ.get('BENCH_REG_DOCS', 4000)))
     print(f'# pipeline (wire->device incl. native decode): '
           f'{pipe_rate:.0f} changes/s', file=sys.stderr)
     print(f'# backend-seam pipeline (turbo, incl. hash graph): '
@@ -359,6 +387,7 @@ def main():
           f'host {bloom_host:.0f} hashes/s', file=sys.stderr)
     print(f'# zipf 100k-doc fleet: {zipf_rate:.0f} effective ops/s '
           f'(occupancy {zipf_occ:.2f})', file=sys.stderr)
+    print(f'# exact register engine: {reg_rate:.0f} ops/s', file=sys.stderr)
     print(f'# host reference engine: {host_rate:.0f} changes/s', file=sys.stderr)
 
     result = {

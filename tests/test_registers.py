@@ -263,3 +263,73 @@ class TestSlotWidthFlags:
         winner, conflicts = docs[0]['k0']
         assert winner is None
         assert conflicts == {pack(f'1@{a}'): 5, pack(f'1@{b}'): None}
+
+
+class TestWireToRegisters:
+    """Full wire path: binary changes -> native C++ parse (with preds) ->
+    RegisterOpBatch -> exact device state, against the host oracle."""
+
+    @pytest.mark.parametrize('seed', [5, 6])
+    def test_native_ingest_to_registers(self, seed):
+        from automerge_tpu import native
+        from automerge_tpu.fleet.registers import rows_to_register_batch
+        if not native.available():
+            pytest.skip('native codec unavailable')
+        rng = np.random.default_rng(seed)
+        visible = {k: set() for k in KEYS}
+        counters = {}
+        changes, deps = [], []
+        ctr = {a: 0 for a in ACTORS}
+        seqs = {a: 0 for a in ACTORS}
+        for step in range(30):
+            actor = ACTORS[int(rng.integers(0, 3))]
+            key = KEYS[int(rng.integers(0, len(KEYS)))]
+            ctr[actor] = max(ctr.values()) + 1
+            seqs[actor] += 1
+            op_id = f'{ctr[actor]}@{actor}'
+            vis = sorted(visible[key], key=lamport_key)
+            roll = rng.random()
+            ctr_targets = [v for v in vis if counters.get(v)]
+            if roll < 0.2 and ctr_targets:
+                op = {'action': 'inc', 'obj': '_root', 'key': key,
+                      'value': int(rng.integers(-5, 10)),
+                      'pred': ctr_targets[:1]}
+            elif roll < 0.35 and vis:
+                op = {'action': 'del', 'obj': '_root', 'key': key,
+                      'pred': vis}
+                visible[key] -= set(vis)
+            else:
+                is_counter = rng.random() < 0.3
+                op = {'action': 'set', 'obj': '_root', 'key': key,
+                      'value': int(rng.integers(0, 100)), 'pred': vis,
+                      'datatype': 'counter' if is_counter else 'int'}
+                visible[key] -= set(vis)
+                visible[key].add(op_id)
+                counters[op_id] = is_counter
+            change = {'actor': actor, 'seq': seqs[actor],
+                      'startOp': ctr[actor], 'time': 0, 'deps': deps,
+                      'ops': [op]}
+            deps = [decode_change(encode_change(change))['hash']]
+            changes.append(change)
+
+        buffers = [encode_change(c) for c in changes]
+        out = native.ingest_changes(buffers, list(range(len(buffers))),
+                                    with_meta=True)
+        assert out is not None
+        rows, nat_keys, nat_actors, meta = out
+        # Remap native key/actor numbering to the test's sorted tables
+        key_remap = np.array([KNUM[k] for k in nat_keys], dtype=np.int32)
+        actor_remap = np.array([ANUM[a] for a in nat_actors], dtype=np.int32)
+        key_ids = key_remap[rows['key']]
+        def remap(p):
+            return np.where(p != 0,
+                            (p >> 8 << 8) | actor_remap[p & 0xff], 0)
+        packed = remap(rows['packed'])
+        preds = remap(rows['pred'])
+        doc_ids = np.zeros(len(key_ids), dtype=np.int64)   # all one doc
+        batch = rows_to_register_batch(doc_ids, rows['flags'], key_ids,
+                                       packed, rows['value'], rows['pred_off'],
+                                       preds, n_docs=1)
+        state = RegisterState.empty(1, len(KEYS), 4)
+        state, _ = apply_register_batch(state, batch)
+        assert host_oracle(changes) == device_view(state)
