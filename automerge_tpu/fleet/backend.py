@@ -101,11 +101,20 @@ class DocFleet:
     slot and land on the device in one batched ingest + one merge dispatch
     per flush (lazy: reads flush first)."""
 
-    def __init__(self, doc_capacity=64, key_capacity=64):
+    def __init__(self, doc_capacity=64, key_capacity=64,
+                 exact_device=False, actor_slot_capacity=8, d_preds=4):
         self.keys = KeyInterner()
         self.actors = _SortedActorTable()
         self.value_table = []     # non-inline values, referenced as -(i + 2)
         self.state = None         # FleetState, allocated on first flush
+        # exact_device=True stores the device state in the multi-value
+        # register engine (fleet/registers.py) instead of the LWW
+        # scatter-max grid: conflict sets, set-vs-delete resurrection, and
+        # counter semantics become exact on device, at ordered-scan cost
+        self.exact_device = exact_device
+        self.reg_state = None     # RegisterState, allocated on first flush
+        self.actor_slot_cap = actor_slot_capacity
+        self.d_preds = d_preds
         self.doc_cap = doc_capacity
         self.key_cap = key_capacity
         self.n_slots = 0
@@ -142,15 +151,32 @@ class DocFleet:
                 st.winners.at[dst].set(st.winners[src]),
                 st.values.at[dst].set(st.values[src]),
                 st.counters.at[dst].set(st.counters[src]))
+        if self.reg_state is not None and src < self.reg_state.reg.shape[0]:
+            from .registers import RegisterState
+            self._ensure_reg_capacity(n_docs=dst + 1, n_keys=len(self.keys))
+            rs = self.reg_state
+            self.reg_state = RegisterState(
+                rs.reg.at[dst].set(rs.reg[src]),
+                rs.killed.at[dst].set(rs.killed[src]),
+                rs.value.at[dst].set(rs.value[src]),
+                rs.counter.at[dst].set(rs.counter[src]),
+                rs.inexact.at[dst].set(rs.inexact[src]))
         return dst
 
     def _zero_row(self, slot):
-        if self.state is None or slot >= self.state.winners.shape[0]:
-            return
-        st = self.state
-        self.state = FleetState(st.winners.at[slot].set(0),
-                                st.values.at[slot].set(0),
-                                st.counters.at[slot].set(0))
+        if self.state is not None and slot < self.state.winners.shape[0]:
+            st = self.state
+            self.state = FleetState(st.winners.at[slot].set(0),
+                                    st.values.at[slot].set(0),
+                                    st.counters.at[slot].set(0))
+        if self.reg_state is not None and \
+                slot < self.reg_state.reg.shape[0]:
+            from .registers import RegisterState
+            rs = self.reg_state
+            self.reg_state = RegisterState(
+                rs.reg.at[slot].set(0), rs.killed.at[slot].set(False),
+                rs.value.at[slot].set(0), rs.counter.at[slot].set(0),
+                rs.inexact.at[slot].set(False))
 
     # -- ingest ---------------------------------------------------------
 
@@ -196,6 +222,80 @@ class DocFleet:
         self.state = FleetState(jnp.where(w != 0, remapped, 0),
                                 self.state.values, self.state.counters)
 
+    def _ensure_reg_capacity(self, n_docs, n_keys):
+        from .registers import RegisterState
+        import jax.numpy as jnp
+        need_docs = _pow2(max(n_docs, self.doc_cap))
+        need_keys = _pow2(max(n_keys + 1, self.key_cap))
+        need_slots = _pow2(max(len(self.actors), self.actor_slot_cap))
+        if self.reg_state is None:
+            self.doc_cap, self.key_cap = need_docs, need_keys
+            self.actor_slot_cap = need_slots
+            self.reg_state = RegisterState.empty(need_docs, need_keys - 1,
+                                                 need_slots)
+            return
+        old_n, old_k, old_a = self.reg_state.reg.shape
+        if need_docs <= old_n and need_keys <= old_k and \
+                need_slots <= old_a:
+            return
+        self.metrics.grows += 1
+        n = max(need_docs, old_n)
+        k = max(need_keys, old_k)
+        a = max(need_slots, old_a)
+        grown = []
+        for arr in (self.reg_state.reg, self.reg_state.killed,
+                    self.reg_state.value, self.reg_state.counter):
+            out = jnp.zeros((n, k, a), dtype=arr.dtype)
+            # old scratch column (old_k - 1) holds garbage: drop it
+            out = out.at[:old_n, :old_k - 1, :old_a].set(arr[:, :old_k - 1])
+            grown.append(out)
+        inexact = jnp.zeros((n,), dtype=bool)
+        inexact = inexact.at[:old_n].set(self.reg_state.inexact)
+        self.doc_cap, self.key_cap = n, k - 1
+        self.actor_slot_cap = a
+        self.reg_state = RegisterState(*grown, inexact)
+
+    def _remap_reg_actors(self, perm):
+        """Renumber actor bits AND permute the actor-slot axis of the
+        register state after a sorted-order actor insertion."""
+        if self.reg_state is None:
+            return
+        import jax.numpy as jnp
+        from .registers import RegisterState
+        # Grow the slot axis FIRST: the freshly inserted actors may push an
+        # existing actor's new slot index past the current width, and the
+        # permutation below would silently drop its registers
+        self._ensure_reg_capacity(n_docs=self.n_slots, n_keys=len(self.keys))
+        self.metrics.remaps += 1
+        rs = self.reg_state
+        n, k, a = rs.reg.shape
+        # Old slot feeding each new slot: every pre-existing actor appears
+        # in perm; slots not fed by any old actor (newly inserted actors,
+        # plus the unused tail) start zeroed.
+        old_of_new = np.zeros(a, dtype=np.int32)
+        fresh = np.ones(a, dtype=bool)
+        for old_i, new_i in enumerate(np.asarray(perm)):
+            if new_i < a:
+                old_of_new[new_i] = old_i
+                fresh[new_i] = False
+        gather = jnp.asarray(old_of_new)
+        zero_new = jnp.asarray(fresh)
+        mask = MAX_ACTORS - 1
+        perm_full = np.arange(MAX_ACTORS, dtype=np.int32)
+        perm_full[:len(perm)] = perm
+        bits = jnp.asarray(perm_full)
+
+        def move(arr, fill):
+            out = arr[:, :, gather]
+            return jnp.where(zero_new[None, None, :],
+                             jnp.full_like(out, fill), out)
+
+        reg = move(rs.reg, 0)
+        reg = jnp.where(reg != 0, (reg & ~mask) | bits[reg & mask], 0)
+        self.reg_state = RegisterState(
+            reg, move(rs.killed, False), move(rs.value, 0),
+            move(rs.counter, 0), rs.inexact)
+
     def flush(self):
         """Land all pending change buffers on the device: one batched ingest
         and one merge dispatch for the whole fleet."""
@@ -204,7 +304,10 @@ class DocFleet:
         from .apply import apply_op_batch
         perm = self.actors.insert_many(self.pending_actors)
         if perm is not None:
-            self._remap_actors(perm)
+            if self.exact_device:
+                self._remap_reg_actors(perm)
+            else:
+                self._remap_actors(perm)
         n_docs = self.n_slots
         per_doc = [[] for _ in range(n_docs)]
         for slot, buffers in self.pending:
@@ -213,6 +316,9 @@ class DocFleet:
             self.metrics.bytes_ingested += sum(len(b) for b in buffers)
         self.pending = []
         self.pending_actors = set()
+        if self.exact_device:
+            self._flush_exact(per_doc, n_docs)
+            return
         batch = changes_to_op_batch(per_doc, self.keys, self.actors,
                                     value_table=self.value_table)
         self._ensure_capacity(n_docs=n_docs, n_keys=len(self.keys))
@@ -224,13 +330,43 @@ class DocFleet:
         self.metrics.dispatches += 1
         self.metrics.device_ops += int(batch.valid.sum())
 
+    def _flush_exact(self, per_doc, n_docs):
+        """Exact-device flush: flat rows (with preds) into the multi-value
+        register engine, one ordered-scan dispatch."""
+        from .ingest import changes_to_op_rows
+        from .registers import apply_register_batch, rows_to_register_batch
+        rows = changes_to_op_rows(per_doc, self.keys, self.actors,
+                                  value_table=self.value_table)
+        self._ensure_reg_capacity(n_docs=n_docs, n_keys=len(self.keys))
+        n_cap = self.reg_state.reg.shape[0]
+        batch = rows_to_register_batch(
+            rows['doc'], rows['flags'], rows['key'], rows['packed'],
+            rows['value'], rows['pred_off'], rows['pred'],
+            n_docs=n_cap, d_preds=self.d_preds)
+        self.reg_state, _stats = apply_register_batch(self.reg_state, batch)
+        self.metrics.dispatches += 1
+        self.metrics.device_ops += len(rows['doc'])
+
+    def inexact_slots(self):
+        """Slots whose histories fell outside the register engine's exact
+        shape (self-conflicts, pred overflow, …) — reads for these route to
+        the host mirror."""
+        self.flush()
+        if self.reg_state is None:
+            return set()
+        return set(np.flatnonzero(np.asarray(self.reg_state.inexact)))
+
     # -- reads ----------------------------------------------------------
 
     def materialize_all(self):
         """Whole-fleet state readback in one device->host transfer:
         slot -> {key: value} with LWW winners, tombstones dropped, and
-        counter accumulators added to their base value."""
+        counter accumulators added to their base value. In exact-device
+        mode the read comes from the multi-value registers instead (winner
+        per key from the visible set, per-op counter folds)."""
         self.flush()
+        if self.exact_device:
+            return self._materialize_registers()
         if self.state is None:
             return [{} for _ in range(self.n_slots)]
         winners = np.asarray(self.state.winners)
@@ -256,6 +392,36 @@ class DocFleet:
 
     def materialize(self, slot):
         return self.materialize_all()[slot]
+
+    def _materialize_registers(self):
+        from .registers import materialize_registers
+        if self.reg_state is None:
+            return [{} for _ in range(self.n_slots)]
+        docs = materialize_registers(self.reg_state, self.keys.keys,
+                                     value_table=self.value_table)
+        free = set(self.free_slots)
+        out = []
+        for slot in range(self.n_slots):
+            if slot in free or slot >= len(docs):
+                out.append({})
+            else:
+                out.append({k: v for k, (v, _conflicts) in docs[slot].items()
+                            if v is not None})
+        return out
+
+    def conflicts_all(self):
+        """Exact-device only: slot -> {key: {packed opId: value}} for every
+        key with a multi-value conflict (>1 visible op)."""
+        self.flush()
+        from .registers import materialize_registers
+        if not self.exact_device:
+            raise ValueError('conflicts_all requires exact_device=True')
+        if self.reg_state is None:
+            return [{} for _ in range(self.n_slots)]
+        docs = materialize_registers(self.reg_state, self.keys.keys,
+                                     value_table=self.value_table)
+        return [{k: conflicts for k, (_v, conflicts) in doc.items()
+                 if conflicts} for doc in docs[:self.n_slots]]
 
 
 class _FlatEngine(HashGraph):
@@ -945,13 +1111,21 @@ def _apply_changes_turbo(handles, per_doc_changes):
     if not keep.any():
         return result            # everything queued: no device work
 
+    # Land any lazily-enqueued earlier changes first: the register engine
+    # is order-sensitive (pred kills), and even the LWW grid's counter
+    # reset bases on the pre-batch winner
+    fleet.flush()
+
     # Device batch: remap the native parser's key/actor numbering into the
     # fleet tables (interning only keys that actually land on the device)
     applied_actor_ids = np.unique(actor_id[ready])
     perm = fleet.actors.insert_many([nat_actors[int(a)]
                                      for a in applied_actor_ids])
     if perm is not None:
-        fleet._remap_actors(perm)
+        if fleet.exact_device:
+            fleet._remap_reg_actors(perm)
+        else:
+            fleet._remap_actors(perm)
     key_map = np.zeros(max(len(nat_keys), 1), dtype=np.int32)
     for k in np.unique(rows['key'][keep]):
         key_map[k] = fleet.keys.intern(nat_keys[k])
@@ -963,6 +1137,31 @@ def _apply_changes_turbo(handles, per_doc_changes):
     ctr = kept_packed_nat >> 8
     actor = actor_map[kept_packed_nat & (_MA - 1)]
     packed = (ctr << 8) | actor
+
+    if fleet.exact_device:
+        from .registers import apply_register_batch, rows_to_register_batch
+        # Slice the kept rows' pred segments and remap their actor bits
+        pred_counts = np.diff(rows['pred_off'])
+        entry_keep = np.repeat(keep, pred_counts)
+        preds_kept = rows['pred'][entry_keep]
+        preds_kept = np.where(
+            preds_kept != 0,
+            (preds_kept >> 8 << 8) | actor_map[preds_kept & (_MA - 1)],
+            0).astype(np.int32)
+        off_kept = np.zeros(int(keep.sum()) + 1, dtype=np.int64)
+        np.cumsum(pred_counts[keep], out=off_kept[1:])
+        fleet._ensure_reg_capacity(n_docs=fleet.n_slots,
+                                   n_keys=len(fleet.keys))
+        n_cap = fleet.reg_state.reg.shape[0]
+        reg_batch = rows_to_register_batch(
+            slots.astype(np.int64), rows['flags'][keep], key, packed,
+            rows['value'][keep], off_kept, preds_kept,
+            n_docs=n_cap, d_preds=fleet.d_preds)
+        fleet.reg_state, _stats = apply_register_batch(fleet.reg_state,
+                                                       reg_batch)
+        fleet.metrics.dispatches += 1
+        fleet.metrics.device_ops += int(len(kept_packed_nat))
+        return result
 
     n_slots = fleet.n_slots
     counts = np.bincount(slots, minlength=n_slots)
@@ -1004,11 +1203,21 @@ def materialize_docs(handles):
             fleet = state.fleet
             if id(fleet) not in by_fleet:
                 by_fleet[id(fleet)] = fleet.materialize_all()
+    inexact_by_fleet = {}
     out = []
     for handle in handles:
         state = handle['state']
         if isinstance(state, FleetDoc) and state.is_fleet:
-            out.append(by_fleet[id(state.fleet)][state._impl.slot])
+            fleet = state.fleet
+            if fleet.exact_device:
+                if id(fleet) not in inexact_by_fleet:
+                    inexact_by_fleet[id(fleet)] = fleet.inexact_slots()
+                if state._impl.slot in inexact_by_fleet[id(fleet)]:
+                    # History fell outside the register engine's exact
+                    # shape: the host mirror is authoritative
+                    out.append(state.materialize())
+                    continue
+            out.append(by_fleet[id(fleet)][state._impl.slot])
         elif isinstance(state, FleetDoc):
             out.append(state.materialize())
         else:

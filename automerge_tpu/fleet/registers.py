@@ -245,7 +245,10 @@ def rows_to_register_batch(doc_ids, flags, key_ids, packed, values,
     kind[doc_sorted, pos] = kinds_flat[order]
     key_col[doc_sorted, pos] = np.asarray(key_ids)[order]
     packed_col[doc_sorted, pos] = np.asarray(packed)[order]
-    value_col[doc_sorted, pos] = np.where(values == -1, 0, values)[order]
+    # -1 is the DEL sentinel only for set/del rows; an inc delta of -1 is a
+    # legitimate negative increment and must pass through untouched
+    value_col[doc_sorted, pos] = np.where(
+        (values == -1) & (flags != 2), 0, values)[order]
 
     pred_off = np.asarray(pred_off)
     pred = np.asarray(pred)

@@ -610,3 +610,186 @@ class TestDropIn:
             assert d1['nested']['x'] == 1
         finally:
             am.set_default_backend(host_backend)
+
+
+class TestExactDeviceMode:
+    """DocFleet(exact_device=True): the multi-value register engine as the
+    fleet's device state — resurrection/conflict/counter corners exact on
+    the device read path, not just the host mirror."""
+
+    def _fb(self):
+        return FleetBackend(DocFleet(doc_capacity=4, key_capacity=4,
+                                     exact_device=True))
+
+    def test_resurrection_exact_on_device(self):
+        fb = self._fb()
+        gb = fb.init()
+        c1 = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 5,
+             'datatype': 'int', 'pred': []}])
+        gb, _ = fleet_backend.apply_changes(gb, [c1])
+        # Concurrent: bb overwrites (2@bb), cc deletes with greater opId
+        c2 = change_buf(ACTORS[1], 1, 2, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 7,
+             'datatype': 'int', 'pred': [f'1@{ACTORS[0]}']}],
+            deps=fleet_backend.get_heads(gb))
+        c3 = change_buf(ACTORS[2], 1, 9, [
+            {'action': 'del', 'obj': '_root', 'key': 'k',
+             'pred': [f'1@{ACTORS[0]}']}],
+            deps=[am.decode_change(c1)['hash']])
+        gb, _ = fleet_backend.apply_changes(gb, [c2, c3])
+        # Device read path must keep bb's set alive (the LWW grid would
+        # have shown the key deleted: 9@cc > 2@bb)
+        assert fleet_backend.materialize_docs([gb]) == [{'k': 7}]
+        assert gb['state'].materialize() == {'k': 7}
+
+    def test_conflicts_on_device(self):
+        fb = self._fb()
+        gb = fb.init()
+        c1 = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        c2 = change_buf(ACTORS[1], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'value': 2,
+             'datatype': 'int', 'pred': []}])
+        gb, _ = fleet_backend.apply_changes(gb, [c1, c2])
+        conflicts = fb.fleet.conflicts_all()[gb['state']._impl.slot]
+        assert set(conflicts) == {'x'}
+        assert sorted(conflicts['x'].values()) == [1, 2]
+        assert fleet_backend.materialize_docs([gb]) == [{'x': 2}]
+
+    def test_counter_exact_on_device(self):
+        fb = self._fb()
+        gb = fb.init()
+        cs = []
+        heads = []
+        specs = [
+            {'action': 'set', 'obj': '_root', 'key': 'c', 'value': 10,
+             'datatype': 'counter', 'pred': []},
+            {'action': 'inc', 'obj': '_root', 'key': 'c', 'value': 3,
+             'pred': [f'1@{ACTORS[0]}']},
+            {'action': 'set', 'obj': '_root', 'key': 'c', 'value': 100,
+             'datatype': 'int', 'pred': [f'1@{ACTORS[0]}']},
+        ]
+        for i, op in enumerate(specs):
+            buf = change_buf(ACTORS[0], i + 1, i + 1, [op], deps=heads)
+            heads = [am.decode_change(buf)['hash']]
+            cs.append(buf)
+        gb, _ = fleet_backend.apply_changes(gb, cs[:2])
+        assert fleet_backend.materialize_docs([gb]) == [{'c': 13}]
+        gb, _ = fleet_backend.apply_changes(gb, [cs[2]])
+        assert fleet_backend.materialize_docs([gb]) == [{'c': 100}]
+
+    def test_turbo_exact_device_string_values(self):
+        """Turbo on int workloads, Python-ingest flush on string values —
+        both land in the same register state."""
+        fb = self._fb()
+        handles = fleet_backend.init_docs(2, fb.fleet)
+        ints = [[change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'n', 'value': d + 1,
+             'datatype': 'int', 'pred': []}])] for d in range(2)]
+        handles, patches = fleet_backend.apply_changes_docs(handles, ints,
+                                                           mirror=False)
+        assert all(p is None for p in patches)
+        strs = [[change_buf(ACTORS[1], 1, 5, [
+            {'action': 'set', 'obj': '_root', 'key': 's', 'value': f'doc{d}',
+             'pred': []}])] for d in range(2)]
+        handles, _ = fleet_backend.apply_changes_docs(handles, strs)
+        assert fleet_backend.materialize_docs(handles) == \
+            [{'n': 1, 's': 'doc0'}, {'n': 2, 's': 'doc1'}]
+
+    def test_actor_renumber_in_register_mode(self):
+        fb = self._fb()
+        gb = fb.init()
+        c1 = change_buf(ACTORS[1], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        gb, _ = fleet_backend.apply_changes(gb, [c1])
+        fb.fleet.flush()
+        c2 = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'value': 2,
+             'datatype': 'int', 'pred': []}])
+        gb, _ = fleet_backend.apply_changes(gb, [c2])
+        assert fleet_backend.materialize_docs([gb]) == [{'x': 1}]
+
+    def test_randomized_exact_device_differential(self):
+        rng = np.random.default_rng(23)
+        fb = self._fb()
+        hb = host_backend.init()
+        gb = fb.init()
+        vis = {}
+        heads = []
+        seqs = {a: 0 for a in ACTORS[:2]}
+        ctr = 0
+        for step in range(25):
+            actor = ACTORS[int(rng.integers(0, 2))]
+            key = f'k{int(rng.integers(0, 4))}'
+            ctr += 1
+            seqs[actor] += 1
+            cur = sorted(vis.get(key, set()))
+            if rng.random() < 0.25 and cur:
+                op = {'action': 'del', 'obj': '_root', 'key': key,
+                      'pred': cur}
+                vis[key] = set()
+            else:
+                op = {'action': 'set', 'obj': '_root', 'key': key,
+                      'value': int(rng.integers(0, 100)), 'datatype': 'int',
+                      'pred': cur}
+                vis[key] = {f'{ctr}@{actor}'}
+            buf = change_buf(actor, seqs[actor], ctr, [op], deps=heads)
+            heads = [am.decode_change(buf)['hash']]
+            hb, hp = host_backend.apply_changes(hb, [buf])
+            gb, gp = fleet_backend.apply_changes(gb, [buf])
+            assert hp == gp
+        assert fleet_backend.materialize_docs([gb]) == \
+            [gb['state'].materialize()]
+        assert host_backend.get_patch(hb) == fleet_backend.get_patch(gb)
+
+    def test_negative_one_inc_delta(self):
+        """inc by -1 must not be mistaken for the DEL value sentinel
+        (regression)."""
+        fb = self._fb()
+        gb = fb.init()
+        c1 = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'c', 'value': 10,
+             'datatype': 'counter', 'pred': []}])
+        gb, _ = fleet_backend.apply_changes(gb, [c1])
+        c2 = change_buf(ACTORS[0], 2, 2, [
+            {'action': 'inc', 'obj': '_root', 'key': 'c', 'value': -1,
+             'pred': [f'1@{ACTORS[0]}']}], deps=fleet_backend.get_heads(gb))
+        gb, _ = fleet_backend.apply_changes(gb, [c2])
+        assert fleet_backend.materialize_docs([gb]) == [{'c': 9}]
+
+    def test_renumber_beyond_slot_capacity_grows_first(self):
+        """Inserting an actor that pushes an existing actor's slot past the
+        current width must grow the axis, not drop registers (regression)."""
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=2,
+                                   exact_device=True, actor_slot_capacity=1))
+        gb = fb.init()
+        c1 = change_buf(ACTORS[2], 1, 1, [        # 'cc…' gets slot 0
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'value': 9,
+             'datatype': 'int', 'pred': []}])
+        gb, _ = fleet_backend.apply_changes(gb, [c1])
+        fb.fleet.flush()
+        c2 = change_buf(ACTORS[0], 1, 1, [        # 'aa…' sorts first
+            {'action': 'set', 'obj': '_root', 'key': 'y', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        gb, _ = fleet_backend.apply_changes(gb, [c2])
+        assert fleet_backend.materialize_docs([gb]) == [{'x': 9, 'y': 1}]
+
+    def test_turbo_after_lazy_exact_preserves_order(self):
+        """A turbo call must land lazily-pending earlier changes first: a
+        delete arriving via turbo after a pending set must win (regression:
+        the flush ran after the register dispatch, resurrecting the key)."""
+        fb = self._fb()
+        gb = fb.init()
+        c1 = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        gb, _ = fleet_backend.apply_changes(gb, [c1])   # pending, no flush
+        c2 = change_buf(ACTORS[0], 2, 2, [
+            {'action': 'del', 'obj': '_root', 'key': 'k',
+             'pred': [f'1@{ACTORS[0]}']}], deps=fleet_backend.get_heads(gb))
+        handles, _ = fleet_backend.apply_changes_docs([gb], [[c2]],
+                                                      mirror=False)
+        assert fleet_backend.materialize_docs(handles) == [{}]

@@ -19,10 +19,11 @@ from tests.test_integration import (  # noqa: F401
 )
 
 
-@pytest.fixture(autouse=True)
-def fleet_default_backend():
-    A.set_default_backend(FleetBackend(DocFleet(doc_capacity=4,
-                                                key_capacity=4)))
+@pytest.fixture(autouse=True, params=['lww', 'exact'])
+def fleet_default_backend(request):
+    A.set_default_backend(FleetBackend(DocFleet(
+        doc_capacity=4, key_capacity=4,
+        exact_device=request.param == 'exact')))
     try:
         yield
     finally:
