@@ -1,0 +1,124 @@
+"""Cross-shard change exchange: sync-protocol payload routing on ICI.
+
+The reference's sync protocol is transport-agnostic byte messages
+(backend/sync.js; SURVEY.md §2.11) — the application moves them. When the
+document fleet itself is sharded across devices/hosts, peer reconciliation
+between shards becomes a bulk payload movement problem, and the idiomatic
+TPU transport is an XLA collective riding ICI rather than a host-side mesh
+of sockets: every shard contributes, for every other shard, the concatenated
+change buffers (or sync messages) destined there, and one `all_to_all`
+delivers every shard its inbox in a single collective (SURVEY.md §5
+"per-peer change exchange becomes an all-to-all of change buffers").
+
+Payloads are ragged bytes; they ride as a padded uint8 tensor
+[n_shards_out, max_len] per shard with a length vector. The collective
+moves bytes only — hashing/causal gating stays host-side per shard, exactly
+like the reference's split between transport and protocol.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def pack_outboxes(per_dest_payloads, max_len=None):
+    """per_dest_payloads: list over destination shards of bytes objects
+    (b'' for none). Returns (data uint8 [n_dest, max_len], lens int32)."""
+    n = len(per_dest_payloads)
+    max_len = max_len if max_len is not None else \
+        max((len(p) for p in per_dest_payloads), default=0)
+    max_len = max(max_len, 1)
+    data = np.zeros((n, max_len), dtype=np.uint8)
+    lens = np.zeros((n,), dtype=np.int32)
+    for d, payload in enumerate(per_dest_payloads):
+        buf = np.frombuffer(bytes(payload), dtype=np.uint8)
+        data[d, :len(buf)] = buf
+        lens[d] = len(buf)
+    return data, lens
+
+
+def unpack_inbox(data, lens):
+    """Inverse of pack_outboxes after the exchange: list over source shards
+    of bytes."""
+    data = np.asarray(data)
+    lens = np.asarray(lens)
+    return [data[s, :int(lens[s])].tobytes() for s in range(data.shape[0])]
+
+
+def exchange_changes(mesh, axis, all_outboxes, all_lens):
+    """One collective round of shard-to-shard payload delivery.
+
+    all_outboxes: [n_shards, n_shards, L] uint8, where row i column j holds
+    shard i's payload for shard j (host-assembled, then sharded over the
+    first axis so each device owns its outbox row). Returns
+    (inboxes [n_shards, n_shards, L], in_lens) where row j column i is the
+    payload shard j received from shard i — one all_to_all on ICI plus the
+    matching length exchange."""
+    try:
+        from jax import shard_map
+    except ImportError:           # older jax
+        from jax.experimental.shard_map import shard_map
+
+    n = mesh.shape[axis]
+    spec_data = P(axis, None, None)
+    spec_lens = P(axis, None)
+
+    @jax.jit
+    def run(data, lens):
+        def body(data, lens):
+            # shard view: [1, n, L]; exchange rows over the peer axis so
+            # each shard ends with [from_peer, L] — one tiled all_to_all
+            out = jax.lax.all_to_all(data[0], axis, split_axis=0,
+                                     concat_axis=0, tiled=True)
+            out_lens = jax.lax.all_to_all(lens[0], axis, split_axis=0,
+                                          concat_axis=0, tiled=True)
+            return out[None], out_lens[None]
+
+        return shard_map(body, mesh=mesh,
+                         in_specs=(spec_data, spec_lens),
+                         out_specs=(spec_data, spec_lens))(data, lens)
+
+    data = jax.device_put(jnp.asarray(all_outboxes),
+                          NamedSharding(mesh, spec_data))
+    lens = jax.device_put(jnp.asarray(all_lens),
+                          NamedSharding(mesh, spec_lens))
+    return run(data, lens)
+
+
+def sync_round_sharded(mesh, axis, backends, sync_states, generate, receive):
+    """Drive one full sync round between every ordered pair of shards, with
+    message transport on the device mesh: each shard generates its per-peer
+    sync messages host-side (`generate(src, dst) -> bytes | None`), the
+    payload matrix rides ONE all_to_all, and `receive(dst, src, payload)`
+    applies what arrived. Returns the number of non-empty payloads moved."""
+    n = mesh.shape[axis]
+    rows, row_lens = [], []
+    for src in range(n):
+        payloads = []
+        for dst in range(n):
+            msg = generate(src, dst) if dst != src else None
+            payloads.append(msg or b'')
+        data, lens = pack_outboxes(payloads)
+        rows.append(data)
+        row_lens.append(lens)
+    width = max(r.shape[1] for r in rows)
+    outboxes = np.zeros((n, n, width), dtype=np.uint8)
+    lens = np.zeros((n, n), dtype=np.int32)
+    for src in range(n):
+        outboxes[src, :, :rows[src].shape[1]] = rows[src]
+        lens[src] = row_lens[src]
+
+    inboxes, in_lens = exchange_changes(mesh, axis, outboxes, lens)
+    inboxes = np.asarray(jax.device_get(inboxes))
+    in_lens = np.asarray(jax.device_get(in_lens))
+
+    moved = 0
+    for dst in range(n):
+        for src in range(n):
+            length = int(in_lens[dst, src])
+            if length:
+                receive(dst, src, inboxes[dst, src, :length].tobytes())
+                moved += 1
+    return moved
