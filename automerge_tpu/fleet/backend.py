@@ -405,8 +405,10 @@ class DocFleet:
             if slot in free or slot >= len(docs):
                 out.append({})
             else:
-                out.append({k: v for k, (v, _conflicts) in docs[slot].items()
-                            if v is not None})
+                # Keys legitimately set to null keep their None value (the
+                # LWW grid and host mirror both report them; only absent /
+                # fully-deleted keys are omitted)
+                out.append({k: v for k, (v, _conflicts) in docs[slot].items()})
         return out
 
     def conflicts_all(self):
@@ -862,6 +864,25 @@ def apply_changes_docs(handles, per_doc_changes, mirror=True):
     return out_handles, patches
 
 
+def _single_chunk(buf):
+    """True iff the buffer holds exactly ONE chunk: magic+checksum (8 bytes),
+    type byte, LEB128 body length, body — spanning the whole buffer. Buffers
+    holding concatenated chunks (valid input — split_containers handles them
+    on the exact path) must not take turbo, whose native parser reads only
+    the first chunk and would silently drop the rest."""
+    n, shift, i = 0, 0, 9
+    while True:
+        if i >= len(buf) or shift > 56:
+            return False
+        b = buf[i]
+        n |= (b & 0x7f) << shift
+        i += 1
+        if not (b & 0x80):
+            break
+        shift += 7
+    return i + n == len(buf)
+
+
 class _TurboMetaBatch:
     """Raw per-change metadata from the native parser, with lazy hex/dict
     materialization: the fast path touches only numpy arrays; full dicts are
@@ -949,8 +970,9 @@ def _apply_changes_turbo(handles, per_doc_changes):
     for d, changes in enumerate(per_doc_changes):
         for buf in changes:
             buf = bytes(buf)
-            if len(buf) < 12 or buf[8] not in (1, 2):
-                return None     # document chunks etc: exact path
+            if len(buf) < 12 or buf[8] not in (1, 2) or \
+                    not _single_chunk(buf):
+                return None     # document/multi-chunk buffers: exact path
             per_doc_idx[d].append(len(flat_buffers))
             flat_buffers.append(buf)
             change_doc.append(d)
@@ -1129,7 +1151,11 @@ def _apply_changes_turbo(handles, per_doc_changes):
     key_map = np.zeros(max(len(nat_keys), 1), dtype=np.int32)
     for k in np.unique(rows['key'][keep]):
         key_map[k] = fleet.keys.intern(nat_keys[k])
-    actor_map = np.array([fleet.actors.index.get(a, 0) for a in nat_actors],
+    # -1 marks actors the fleet has never registered: ops' own actors are
+    # always registered (applied_actor_ids above), so -1 can only surface
+    # through pred columns, where it flags the doc inexact instead of
+    # silently renumbering to actor 0
+    actor_map = np.array([fleet.actors.index.get(a, -1) for a in nat_actors],
                          dtype=np.int32) if nat_actors else np.zeros(1, np.int32)
     doc_arr = np.array(change_doc, dtype=np.int32)[kept_change]
     slots = np.array([e.slot for e in engines], dtype=np.int32)[doc_arr]
@@ -1144,19 +1170,30 @@ def _apply_changes_turbo(handles, per_doc_changes):
         pred_counts = np.diff(rows['pred_off'])
         entry_keep = np.repeat(keep, pred_counts)
         preds_kept = rows['pred'][entry_keep]
+        pred_actor = actor_map[preds_kept & (_MA - 1)]
+        bad_pred = (preds_kept != 0) & (pred_actor < 0)
         preds_kept = np.where(
             preds_kept != 0,
-            (preds_kept >> 8 << 8) | actor_map[preds_kept & (_MA - 1)],
+            (preds_kept >> 8 << 8) | pred_actor,
             0).astype(np.int32)
+        preds_kept[bad_pred] = 0    # unknown-actor preds never reach device
         off_kept = np.zeros(int(keep.sum()) + 1, dtype=np.int64)
         np.cumsum(pred_counts[keep], out=off_kept[1:])
+        # Rows whose preds named an unregistered actor go inexact (host
+        # replay re-validates them) rather than killing actor 0's slot
+        bad_rows = np.zeros(int(keep.sum()), dtype=bool)
+        if bad_pred.any():
+            row_of_entry = np.repeat(np.arange(int(keep.sum())),
+                                     pred_counts[keep])
+            bad_rows[row_of_entry[bad_pred]] = True
         fleet._ensure_reg_capacity(n_docs=fleet.n_slots,
                                    n_keys=len(fleet.keys))
         n_cap = fleet.reg_state.reg.shape[0]
         reg_batch = rows_to_register_batch(
             slots.astype(np.int64), rows['flags'][keep], key, packed,
             rows['value'][keep], off_kept, preds_kept,
-            n_docs=n_cap, d_preds=fleet.d_preds)
+            n_docs=n_cap, d_preds=fleet.d_preds,
+            force_overflow=bad_rows)
         fleet.reg_state, _stats = apply_register_batch(fleet.reg_state,
                                                        reg_batch)
         fleet.metrics.dispatches += 1

@@ -215,7 +215,8 @@ def visible_registers(state):
 
 
 def rows_to_register_batch(doc_ids, flags, key_ids, packed, values,
-                           pred_off, pred, n_docs, d_preds=4):
+                           pred_off, pred, n_docs, d_preds=4,
+                           force_overflow=None):
     """Lay flat native-ingest op rows (application order, doc-contiguous)
     into a RegisterOpBatch [n_docs, P]. Inputs are the arrays the native
     parser emits with with_meta=True — flags (1 = set/del, 2 = inc; dels
@@ -253,7 +254,12 @@ def rows_to_register_batch(doc_ids, flags, key_ids, packed, values,
     pred_off = np.asarray(pred_off)
     pred = np.asarray(pred)
     pred_counts = np.diff(pred_off)
-    overflow[doc_sorted, pos] = (pred_counts > d_preds)[order]
+    oflow_flat = pred_counts > d_preds
+    if force_overflow is not None:
+        # Caller-detected per-row badness (e.g. a pred naming an actor the
+        # fleet has never seen): route the doc to host replay via inexact
+        oflow_flat = oflow_flat | np.asarray(force_overflow, dtype=bool)
+    overflow[doc_sorted, pos] = oflow_flat[order]
     for d in range(d_preds):
         has = pred_counts > d
         lane = np.zeros(n_rows, dtype=np.int32)

@@ -151,16 +151,20 @@ def _apply_one_doc(carry, op, capacity):
     my_key = jnp.where(is_ins, packed, INT32_MAX)
 
     def skip_cond(state):
-        r, j = state
+        r, j, h = state
         # Sentinels/scratch hold elem_id 0, which can never exceed a real
-        # packed opId, so the walk stops at END (or list end) by itself.
-        return elem_id[j] > my_key
+        # packed opId, so the walk stops at END (or list end) by itself; the
+        # hop counter is a termination backstop so a corrupted/cyclic nxt
+        # chain cannot hang the device kernel (a well-formed list has at
+        # most capacity+3 nodes).
+        return (elem_id[j] > my_key) & (h < capacity + 3)
 
     def skip_body(state):
-        r, j = state
-        return j, nxt[j]
+        r, j, h = state
+        return j, nxt[j], h + 1
 
-    r, j = lax.while_loop(skip_cond, skip_body, (r0, nxt[r0]))
+    r, j, _ = lax.while_loop(skip_cond, skip_body,
+                             (r0, nxt[r0], jnp.int32(0)))
 
     # Inserts past capacity or after an unknown referent are dropped
     # (reported via the per-op applied flag) rather than silently corrupting

@@ -793,3 +793,98 @@ class TestExactDeviceMode:
         handles, _ = fleet_backend.apply_changes_docs([gb], [[c2]],
                                                       mirror=False)
         assert fleet_backend.materialize_docs(handles) == [{}]
+
+
+class TestAdviceRegressions:
+    """Round-1 advisor findings (ADVICE.md): turbo multi-chunk buffers,
+    unknown pred actors, null-value register materialization."""
+
+    def test_turbo_multichunk_buffer_not_dropped(self):
+        """A buffer holding two concatenated change chunks must apply BOTH
+        chunks (turbo's native parser reads one chunk per buffer, so such
+        buffers must fall back to the exact path)."""
+        from automerge_tpu.columnar import decode_change
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=4))
+        gb = fb.init()
+        c1 = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        h1 = decode_change(c1)['hash']
+        c2 = change_buf(ACTORS[0], 2, 2, [
+            {'action': 'set', 'obj': '_root', 'key': 'y', 'value': 2,
+             'datatype': 'int', 'pred': []}], deps=[h1])
+        h2 = decode_change(c2)['hash']
+        handles, _ = fleet_backend.apply_changes_docs(
+            [gb], [[bytes(c1) + bytes(c2)]], mirror=False)
+        assert fleet_backend.materialize_docs(handles) == [{'x': 1, 'y': 2}]
+        assert handles[0]['heads'] == [h2]
+        # save() must agree with heads/clock (the old bug diverged them)
+        reloaded = fb.load(fleet_backend.save(handles[0]))
+        assert fleet_backend.get_heads(reloaded) == [h2]
+
+    def test_turbo_unknown_pred_actor_flags_inexact(self):
+        """A pred naming an actor the fleet never registered must flag the
+        doc inexact, not renumber to actor 0 and kill its register."""
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=4,
+                                   exact_device=True))
+        gb = fb.init()
+        c1 = change_buf(ACTORS[0], 1, 1, [          # 'aa…' -> actor 0
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'value': 7,
+             'datatype': 'int', 'pred': []}])
+        handles, _ = fleet_backend.apply_changes_docs([gb], [[c1]],
+                                                      mirror=False)
+        # actor 'cc…' never authored a change with this fleet; '1@cc…'
+        # dangles. Exact path rejects it; turbo defers validation.
+        c2 = change_buf(ACTORS[1], 1, 1, [
+            {'action': 'del', 'obj': '_root', 'key': 'x',
+             'pred': [f'1@{ACTORS[2]}']}],
+            deps=handles[0]['heads'])
+        handles, _ = fleet_backend.apply_changes_docs(handles, [[c2]],
+                                                      mirror=False)
+        fleet = fb.fleet
+        fleet.flush()
+        slot = handles[0]['state']._impl.slot
+        assert slot in fleet.inexact_slots()
+        # actor 0's register for key 'x' must NOT have been killed
+        kx = fleet.keys.index['x']
+        a0 = fleet.actors.index[ACTORS[0]]
+        assert not bool(np.asarray(fleet.reg_state.killed)[slot, kx, a0])
+
+    def test_null_value_survives_register_materialize(self):
+        """A key legitimately set to null must appear (as None) in
+        exact-device bulk materialization, matching the host mirror."""
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=4,
+                                   exact_device=True))
+        gb = fb.init()
+        c1 = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': None,
+             'pred': []},
+            {'action': 'set', 'obj': '_root', 'key': 'm', 'value': 3,
+             'datatype': 'int', 'pred': []}])
+        gb, _ = fleet_backend.apply_changes(gb, [c1])
+        assert fleet_backend.materialize_docs([gb]) == [{'k': None, 'm': 3}]
+        # and it matches the host mirror's view
+        assert gb['state'].materialize() == {'k': None, 'm': 3}
+
+
+class TestSequenceTermination:
+    def test_cyclic_nxt_chain_terminates(self):
+        """A corrupted cyclic nxt chain whose nodes all compare greater than
+        the inserted key must terminate via the hop-counter backstop instead
+        of hanging the device kernel."""
+        from automerge_tpu.fleet import sequence as seq
+        state = seq.SeqState.empty(1, 4)
+        # Two real slots pointing at each other, both with huge elem_ids
+        state.nxt[0, seq.HEAD] = seq.SLOT0
+        state.nxt[0, seq.SLOT0] = seq.SLOT0 + 1
+        state.nxt[0, seq.SLOT0 + 1] = seq.SLOT0       # cycle
+        state.elem_id[0, seq.SLOT0] = 2**30
+        state.elem_id[0, seq.SLOT0 + 1] = 2**30 + 1
+        state.n[0] = 2
+        batch = seq.SeqOpBatch(
+            np.array([[seq.INSERT]], dtype=np.int32),
+            np.array([[seq.HEAD_REF]], dtype=np.int32),
+            np.array([[1 << 8]], dtype=np.int32),   # packed opId 1@actor0
+            np.array([[65]], dtype=np.int32))
+        out, _ = seq.apply_seq_batch(state, batch)   # must not hang
+        assert out.n.shape == (1,)
