@@ -32,18 +32,77 @@ import copy
 
 import numpy as np
 
+from .. import native
 from ..backend.hash_graph import HashGraph, decode_change_buffers
 from ..observability import Metrics
 from ..backend.op_set import OpSet
-from ..columnar import decode_change
+from ..columnar import decode_change, OBJECT_TYPE
 from .tensor_doc import FleetState, MAX_ACTORS, TOMBSTONE
-from .ingest import KeyInterner, changes_to_op_batch
+from .ingest import KeyInterner
 
 _FLAT_ACTIONS = ('set', 'del', 'inc')
+_SEQ_MAKE = ('makeText', 'makeList')
 
 
 class _Unsupported(Exception):
-    """An op outside the flat root-map subset: promote to the host engine."""
+    """An op outside the fleet-resident subset: promote to the host engine."""
+
+
+class _SeqLink:
+    """Value-table entry marking a root-map key whose value is a sequence
+    object (Text/list) living in the fleet's SeqState rows. Bulk reads
+    resolve it to the rendered sequence; the host mirror remains the exact
+    source for patches."""
+
+    __slots__ = ('object_id',)
+
+    def __init__(self, object_id):
+        self.object_id = object_id
+
+    def __repr__(self):
+        return f'_SeqLink({self.object_id})'
+
+    def __eq__(self, other):
+        return isinstance(other, _SeqLink) and \
+            other.object_id == self.object_id
+
+    def __hash__(self):
+        return hash(('_SeqLink', self.object_id))
+
+
+def _leaf_value(leaf):
+    """Render a whole-doc patch leaf to a plain Python value: value leaves
+    unwrap; list/text object patches replay their edits (whole-doc patches
+    contain only insert/multi-insert/update/remove shapes); map patches
+    resolve per-key Lamport winners."""
+    if not isinstance(leaf, dict):
+        return leaf
+    if leaf.get('type') == 'value':
+        return leaf.get('value')
+    if 'objectId' not in leaf:
+        return leaf
+    if leaf.get('type') in ('list', 'text'):
+        out = []
+        for edit in leaf.get('edits', []):
+            action = edit['action']
+            if action == 'insert':
+                out.insert(edit['index'], _leaf_value(edit['value']))
+            elif action == 'multi-insert':
+                out[edit['index']:edit['index']] = list(edit['values'])
+            elif action == 'update':
+                out[edit['index']] = _leaf_value(edit['value'])
+            elif action == 'remove':
+                del out[edit['index']:edit['index'] + edit.get('count', 1)]
+        if leaf['type'] == 'text':
+            return ''.join(str(v) for v in out)
+        return out
+    from ..common import lamport_key
+    doc = {}
+    for key, candidates in leaf.get('props', {}).items():
+        if candidates:
+            winner = max(candidates.keys(), key=lamport_key)
+            doc[key] = _leaf_value(candidates[winner])
+    return doc
 
 
 class _SortedActorTable:
@@ -122,6 +181,14 @@ class DocFleet:
         self.pending = []         # (slot, [change buffers])
         self.pending_actors = set()
         self.metrics = Metrics()  # per-dispatch counters (observability.py)
+        # Sequence-object fleet: one SeqState row per (doc slot, objectId).
+        # Text/list CRDT state lives here as RGA linked-list tensors
+        # (fleet/sequence.py), applied in the same flush as the map grid.
+        self.seq_state = None     # SeqState, allocated on first seq flush
+        self.seq_rows = []        # row -> {'slot','object_id','type'} | None
+        self.seq_free = []
+        self.slot_seq = {}        # slot -> {objectId: row}
+        self.seq_elem_cap = 64    # initial element capacity (grows pow2)
 
     @property
     def dispatches(self):
@@ -139,11 +206,32 @@ class DocFleet:
     def free_slot(self, slot):
         self.pending = [(s, b) for (s, b) in self.pending if s != slot]
         self._zero_row(slot)
+        rows = self.slot_seq.pop(slot, {})
+        if rows:
+            self._zero_seq_rows(list(rows.values()))
+            for row in rows.values():
+                self.seq_rows[row] = None
+                self.seq_free.append(row)
         self.free_slots.append(slot)
 
     def clone_slot(self, src):
         self.flush()
         dst = self.alloc_slot()
+        src_rows, dst_rows = [], []
+        for oid, row in list(self.slot_seq.get(src, {}).items()):
+            info = self.seq_rows[row]
+            src_rows.append(row)
+            dst_rows.append(self._alloc_seq_row(dst, oid, info['type']))
+        if src_rows and self.seq_state is not None:
+            from .sequence import grow_seq_state, SeqState
+            self.seq_state = grow_seq_state(
+                self.seq_state, _pow2(max(dst_rows) + 1),
+                self.seq_state.capacity)
+            st = self.seq_state
+            s = np.array(src_rows, dtype=np.int32)
+            t = np.array(dst_rows, dtype=np.int32)
+            self.seq_state = SeqState(
+                *(arr.at[t].set(arr[s]) for arr in st.tree_flatten()[0]))
         if self.state is not None and src < self.state.winners.shape[0]:
             self._ensure_capacity(n_docs=dst + 1, n_keys=len(self.keys))
             st = self.state
@@ -177,6 +265,210 @@ class DocFleet:
                 rs.reg.at[slot].set(0), rs.killed.at[slot].set(False),
                 rs.value.at[slot].set(0), rs.counter.at[slot].set(0),
                 rs.inexact.at[slot].set(False))
+
+    # -- sequence rows ---------------------------------------------------
+
+    def _alloc_seq_row(self, slot, object_id, type_):
+        info = {'slot': slot, 'object_id': object_id, 'type': type_}
+        if self.seq_free:
+            row = self.seq_free.pop()
+            self.seq_rows[row] = info
+        else:
+            row = len(self.seq_rows)
+            self.seq_rows.append(info)
+        self.slot_seq.setdefault(slot, {})[object_id] = row
+        return row
+
+    def _zero_seq_rows(self, rows):
+        from .sequence import SeqState, END
+        st = self.seq_state
+        if st is None:
+            return
+        rows = [r for r in rows if r < st.elem_id.shape[0]]
+        if not rows:
+            return
+        import jax.numpy as jnp
+        idx = np.array(rows, dtype=np.int32)
+        st = SeqState(*(jnp.asarray(x) for x in st.tree_flatten()[0]))
+        self.seq_state = SeqState(
+            st.elem_id.at[idx].set(0),
+            st.nxt.at[idx].set(END),
+            st.winner.at[idx].set(0),
+            st.vis.at[idx].set(False),
+            st.val.at[idx].set(0),
+            st.n.at[idx].set(0),
+            st.inexact.at[idx].set(False))
+
+    def _remap_seq_actors(self, perm):
+        """Renumber the actor bits of packed elemIds/winners in every
+        sequence row after a sorted-order actor insertion."""
+        if self.seq_state is None:
+            return
+        import jax.numpy as jnp
+        from .sequence import SeqState
+        mask = MAX_ACTORS - 1
+        perm_full = np.arange(MAX_ACTORS, dtype=np.int32)
+        perm_full[:len(perm)] = perm
+        bits = jnp.asarray(perm_full)
+        st = self.seq_state
+        self.metrics.remaps += 1
+
+        def remap(arr):
+            arr = jnp.asarray(arr)
+            return jnp.where(arr != 0, (arr & ~mask) | bits[arr & mask], 0)
+
+        self.seq_state = SeqState(
+            remap(st.elem_id), jnp.asarray(st.nxt), remap(st.winner),
+            jnp.asarray(st.vis), jnp.asarray(st.val), jnp.asarray(st.n),
+            jnp.asarray(st.inexact))
+
+    def _intern_value(self, value):
+        """Inline int32 in [0, 2^31) or a value-table ref -(i + 2)."""
+        if isinstance(value, int) and not isinstance(value, bool) and \
+                0 <= value < (1 << 31):
+            return value
+        return self._intern_value_boxed(value)
+
+    def _intern_seq_value(self, type_, op):
+        """Sequence-element payload: text rows store single-char codepoints
+        inline (table refs are negative, so the two never collide); list
+        rows store plain non-negative int32s inline; everything else goes
+        through the value table."""
+        value = op.get('value')
+        datatype = op.get('datatype')
+        if type_ == 'text':
+            if datatype is None and isinstance(value, str) and \
+                    len(value) == 1:
+                return ord(value)
+            return self._intern_value_boxed(value)
+        if isinstance(value, int) and not isinstance(value, bool) and \
+                0 <= value < (1 << 31) and datatype != 'counter':
+            return value
+        return self._intern_value_boxed(value)
+
+    def _intern_value_boxed(self, value):
+        idx = len(self.value_table)
+        self.value_table.append(value)
+        return -(idx + 2)
+
+    def _pack_seq_op(self, row, info, op, packed):
+        """One decoded sequence op -> (row, kind, ref, packed, value, pred,
+        flag) with packed opIds in fleet actor numbering."""
+        from .sequence import INSERT, SET, DEL, PAD
+        from .tensor_doc import pack_op_id
+        from ..common import parse_op_id
+
+        def pack_ref(eid):
+            if eid in (None, '_head'):
+                return 0
+            ctr, actor = parse_op_id(eid)
+            return pack_op_id(ctr, self.actors.intern(actor))
+
+        action = op['action']
+        flag = False
+        pred = 0
+        for p in op.get('pred', []):
+            pred = max(pred, pack_ref(p))
+        if action == 'inc':
+            # Counters inside sequences are host-mirror-only: mark the row
+            # inexact so reads route to the mirror (ref new.js:937-965)
+            kind, value = PAD, 0
+            flag = True
+        elif action == 'del':
+            kind, value = DEL, 0
+        else:
+            kind = INSERT if op.get('insert') else SET
+            value = self._intern_seq_value(info['type'], op)
+            if op.get('datatype') == 'counter':
+                flag = True
+        return (row, kind, pack_ref(op.get('elemId')), packed, value, pred,
+                flag)
+
+    def _dispatch_seq(self, seq_ops):
+        """Grow the SeqState to cover every allocated row and batch-apply
+        all pending sequence ops in one dispatch."""
+        import jax.numpy as jnp
+        from .sequence import (
+            SeqState, SeqOpBatch, grow_seq_state, apply_seq_batch, INSERT)
+        n_rows = len(self.seq_rows)
+        if n_rows == 0:
+            return
+        if self.seq_state is None:
+            self.seq_state = SeqState.empty(_pow2(n_rows),
+                                            self.seq_elem_cap, xp=jnp)
+        if not seq_ops:
+            if n_rows > self.seq_state.elem_id.shape[0]:
+                self.seq_state = grow_seq_state(self.seq_state,
+                                                _pow2(n_rows),
+                                                self.seq_state.capacity)
+            return
+        ins = np.zeros(n_rows, dtype=np.int64)
+        counts = np.zeros(n_rows, dtype=np.int64)
+        for (row, kind, _r, _p, _v, _pr, _f) in seq_ops:
+            counts[row] += 1
+            if kind == INSERT:
+                ins[row] += 1
+        cur_n = np.zeros(n_rows, dtype=np.int64)
+        have = np.asarray(self.seq_state.n)
+        cur_n[:min(n_rows, len(have))] = have[:n_rows]
+        need_cap = int((cur_n + ins).max())
+        self.seq_state = grow_seq_state(
+            self.seq_state, _pow2(n_rows),
+            _pow2(max(need_cap, self.seq_elem_cap)))
+        r_cap = self.seq_state.elem_id.shape[0]
+        width = max(int(counts.max()), 1)
+        cols = {name: np.zeros((r_cap, width), dtype=np.int32)
+                for name in ('kind', 'ref', 'packed', 'value', 'pred')}
+        flag = np.zeros((r_cap, width), dtype=bool)
+        pos = np.zeros(n_rows, dtype=np.int64)
+        for (row, kind, ref, packed, value, pred, f) in seq_ops:
+            j = pos[row]
+            pos[row] += 1
+            cols['kind'][row, j] = kind
+            cols['ref'][row, j] = ref
+            cols['packed'][row, j] = packed
+            cols['value'][row, j] = value
+            cols['pred'][row, j] = pred
+            flag[row, j] = f
+        batch = SeqOpBatch(cols['kind'], cols['ref'], cols['packed'],
+                           cols['value'], cols['pred'], flag)
+        self.seq_state, _stats = apply_seq_batch(self.seq_state, batch)
+        self.metrics.dispatches += 1
+        self.metrics.device_ops += len(seq_ops)
+
+    def render_seq_all(self):
+        """One-transfer render of every live sequence row: {row: str/list},
+        with None for rows whose device state is inexact (host mirror must
+        serve those reads)."""
+        import jax
+        from .sequence import materialize as seq_materialize
+        out = {}
+        if self.seq_state is None:
+            for row, info in enumerate(self.seq_rows):
+                if info is not None:
+                    out[row] = '' if info['type'] == 'text' else []
+            return out
+        vals, vis, _n = (np.asarray(x) for x in
+                         jax.device_get(seq_materialize(self.seq_state)))
+        inexact = np.asarray(self.seq_state.inexact)
+        for row, info in enumerate(self.seq_rows):
+            if info is None:
+                continue
+            if row >= vals.shape[0]:
+                out[row] = '' if info['type'] == 'text' else []
+                continue
+            if inexact[row]:
+                out[row] = None
+                continue
+            items = [int(v) for v in vals[row][vis[row]]]
+            if info['type'] == 'text':
+                out[row] = ''.join(
+                    chr(v) if v >= 0 else str(self.value_table[-v - 2])
+                    for v in items)
+            else:
+                out[row] = [v if v >= 0 else self.value_table[-v - 2]
+                            for v in items]
+        return out
 
     # -- ingest ---------------------------------------------------------
 
@@ -308,6 +600,7 @@ class DocFleet:
                 self._remap_reg_actors(perm)
             else:
                 self._remap_actors(perm)
+            self._remap_seq_actors(perm)
         n_docs = self.n_slots
         per_doc = [[] for _ in range(n_docs)]
         for slot, buffers in self.pending:
@@ -319,8 +612,17 @@ class DocFleet:
         if self.exact_device:
             self._flush_exact(per_doc, n_docs)
             return
-        batch = changes_to_op_batch(per_doc, self.keys, self.actors,
-                                    value_table=self.value_table)
+        batch = None
+        if native.available():
+            from .ingest import changes_to_op_batch_native
+            batch = changes_to_op_batch_native(per_doc, self.keys,
+                                               self.actors)
+        if batch is None:
+            # Sequence ops, non-inline values, or no native codec: Python
+            # decode once, routing flat rows to the grid and sequence ops
+            # to the SeqState fleet
+            self._flush_mixed(per_doc, n_docs)
+            return
         self._ensure_capacity(n_docs=n_docs, n_keys=len(self.keys))
         if batch.key_id.shape[0] < self.state.winners.shape[0]:
             pad = self.state.winners.shape[0] - batch.key_id.shape[0]
@@ -332,11 +634,16 @@ class DocFleet:
 
     def _flush_exact(self, per_doc, n_docs):
         """Exact-device flush: flat rows (with preds) into the multi-value
-        register engine, one ordered-scan dispatch."""
+        register engine, one ordered-scan dispatch. Batches containing
+        sequence ops route through the mixed Python parse."""
         from .ingest import changes_to_op_rows
         from .registers import apply_register_batch, rows_to_register_batch
-        rows = changes_to_op_rows(per_doc, self.keys, self.actors,
-                                  value_table=self.value_table)
+        try:
+            rows = changes_to_op_rows(per_doc, self.keys, self.actors,
+                                      value_table=self.value_table)
+        except ValueError:
+            self._flush_exact_mixed(per_doc, n_docs)
+            return
         self._ensure_reg_capacity(n_docs=n_docs, n_keys=len(self.keys))
         n_cap = self.reg_state.reg.shape[0]
         batch = rows_to_register_batch(
@@ -346,6 +653,134 @@ class DocFleet:
         self.reg_state, _stats = apply_register_batch(self.reg_state, batch)
         self.metrics.dispatches += 1
         self.metrics.device_ops += len(rows['doc'])
+
+    def _flush_mixed(self, per_doc, n_docs):
+        """Python-decode flush splitting flat root-map rows (LWW grid) from
+        sequence-object ops (SeqState fleet). per_doc is indexed by slot."""
+        from .apply import apply_op_batch
+        from .tensor_doc import OpBatch, pack_op_id
+        from .ingest import changes_to_decoded_ops
+        from ..common import parse_op_id
+
+        rows = []       # (slot, key_id, packed, value, is_set, is_inc)
+        seq_ops = []
+        for d, op_id, op in changes_to_decoded_ops(per_doc):
+            ctr, actor = parse_op_id(op_id)
+            packed = pack_op_id(ctr, self.actors.intern(actor))
+            obj = op['obj']
+            action = op['action']
+            if obj != '_root':
+                row = self.slot_seq[d][obj]
+                seq_ops.append(self._pack_seq_op(row, self.seq_rows[row],
+                                                 op, packed))
+                continue
+            key_id = self.keys.intern(op['key'])
+            if action in _SEQ_MAKE:
+                self._alloc_seq_row(
+                    d, op_id, 'text' if action == 'makeText' else 'list')
+                rows.append((d, key_id, packed,
+                             self._intern_value_boxed(_SeqLink(op_id)),
+                             True, False))
+            elif action == 'del':
+                rows.append((d, key_id, packed, TOMBSTONE, True, False))
+            elif action == 'inc':
+                rows.append((d, key_id, packed, op.get('value', 0),
+                             False, True))
+            else:
+                rows.append((d, key_id, packed,
+                             self._intern_value(op.get('value')),
+                             True, False))
+        if rows:
+            counts = np.zeros(n_docs, dtype=np.int64)
+            for r in rows:
+                counts[r[0]] += 1
+            width = max(int(counts.max()), 1)
+            self._ensure_capacity(n_docs=n_docs, n_keys=len(self.keys))
+            n_cap = self.state.winners.shape[0]
+            shape = (n_cap, width)
+            cols = {name: np.zeros(shape, dtype=np.int32)
+                    for name in ('key_id', 'packed', 'value')}
+            is_set = np.zeros(shape, dtype=bool)
+            is_inc = np.zeros(shape, dtype=bool)
+            valid = np.zeros(shape, dtype=bool)
+            pos = np.zeros(n_docs, dtype=np.int64)
+            for (d, k, p, v, s, inc) in rows:
+                j = pos[d]
+                pos[d] += 1
+                cols['key_id'][d, j] = k
+                cols['packed'][d, j] = p
+                cols['value'][d, j] = v
+                is_set[d, j] = s
+                is_inc[d, j] = inc
+                valid[d, j] = True
+            batch = OpBatch(cols['key_id'], cols['packed'], cols['value'],
+                            is_set, is_inc, valid)
+            self.state, _stats = apply_op_batch(self.state, batch)
+            self.metrics.dispatches += 1
+            self.metrics.device_ops += len(rows)
+        self._dispatch_seq(seq_ops)
+
+    def _flush_exact_mixed(self, per_doc, n_docs):
+        """Mixed-content flush for exact-device mode: flat rows (with pred
+        lists) into the register engine, sequence ops into the SeqState
+        fleet."""
+        from .registers import apply_register_batch, rows_to_register_batch
+        from .tensor_doc import pack_op_id
+        from .ingest import changes_to_decoded_ops
+        from ..common import parse_op_id
+
+        def pack(opid):
+            ctr, actor = parse_op_id(opid)
+            return pack_op_id(ctr, self.actors.intern(actor))
+
+        out_doc, out_key, out_packed, out_val, out_flags = [], [], [], [], []
+        pred_off, preds = [0], []
+        seq_ops = []
+        for d, op_id, op in changes_to_decoded_ops(per_doc):
+            obj = op['obj']
+            action = op['action']
+            packed = pack(op_id)
+            if obj != '_root':
+                row = self.slot_seq[d][obj]
+                seq_ops.append(self._pack_seq_op(row, self.seq_rows[row],
+                                                 op, packed))
+                continue
+            if action in _SEQ_MAKE:
+                self._alloc_seq_row(
+                    d, op_id, 'text' if action == 'makeText' else 'list')
+                val_idx, flags = \
+                    self._intern_value_boxed(_SeqLink(op_id)), 1
+            elif action == 'del':
+                val_idx, flags = TOMBSTONE, 1
+            elif action == 'inc':
+                val_idx, flags = op.get('value', 0), 2
+            else:
+                val_idx, flags = self._intern_value(op.get('value')), 1
+            out_doc.append(d)
+            out_key.append(self.keys.intern(op['key']))
+            out_packed.append(packed)
+            out_val.append(val_idx)
+            out_flags.append(flags)
+            for p in op.get('pred', []):
+                preds.append(pack(p))
+            pred_off.append(len(preds))
+        if out_doc:
+            self._ensure_reg_capacity(n_docs=n_docs, n_keys=len(self.keys))
+            n_cap = self.reg_state.reg.shape[0]
+            batch = rows_to_register_batch(
+                np.array(out_doc, dtype=np.int64),
+                np.array(out_flags, dtype=np.uint8),
+                np.array(out_key, dtype=np.int32),
+                np.array(out_packed, dtype=np.int32),
+                np.array(out_val, dtype=np.int32),
+                np.array(pred_off, dtype=np.int64),
+                np.array(preds, dtype=np.int32),
+                n_docs=n_cap, d_preds=self.d_preds)
+            self.reg_state, _stats = apply_register_batch(self.reg_state,
+                                                          batch)
+            self.metrics.dispatches += 1
+            self.metrics.device_ops += len(out_doc)
+        self._dispatch_seq(seq_ops)
 
     def inexact_slots(self):
         """Slots whose histories fell outside the register engine's exact
@@ -374,6 +809,7 @@ class DocFleet:
         counters = np.asarray(self.state.counters)
         out = []
         free = set(self.free_slots)
+        rendered = None
         for slot in range(self.n_slots):
             doc = {}
             if slot not in free:
@@ -383,12 +819,27 @@ class DocFleet:
                     if v == TOMBSTONE:
                         continue
                     value = self.value_table[-v - 2] if v <= -2 else v
-                    c = int(counters[slot, k])
-                    if c and isinstance(value, int):
-                        value += c
+                    if isinstance(value, _SeqLink):
+                        if rendered is None:
+                            rendered = self.render_seq_all()
+                        value = self._resolve_link(slot, value, rendered)
+                    else:
+                        c = int(counters[slot, k])
+                        if c and isinstance(value, int) and \
+                                not isinstance(value, bool):
+                            value += c
                     doc[self.keys.keys[k]] = value
             out.append(doc)
         return out
+
+    def _resolve_link(self, slot, link, rendered):
+        """Device render for a sequence link; returns the link itself when
+        the row is device-inexact (callers fall back to the host mirror)."""
+        row = self.slot_seq.get(slot, {}).get(link.object_id)
+        if row is None:
+            return link
+        r = rendered.get(row)
+        return link if r is None else r
 
     def materialize(self, slot):
         return self.materialize_all()[slot]
@@ -401,6 +852,7 @@ class DocFleet:
                                      value_table=self.value_table)
         free = set(self.free_slots)
         out = []
+        rendered = None
         for slot in range(self.n_slots):
             if slot in free or slot >= len(docs):
                 out.append({})
@@ -408,7 +860,14 @@ class DocFleet:
                 # Keys legitimately set to null keep their None value (the
                 # LWW grid and host mirror both report them; only absent /
                 # fully-deleted keys are omitted)
-                out.append({k: v for k, (v, _conflicts) in docs[slot].items()})
+                doc = {}
+                for k, (v, _conflicts) in docs[slot].items():
+                    if isinstance(v, _SeqLink):
+                        if rendered is None:
+                            rendered = self.render_seq_all()
+                        v = self._resolve_link(slot, v, rendered)
+                    doc[k] = v
+                out.append(doc)
         return out
 
     def conflicts_all(self):
@@ -427,49 +886,56 @@ class DocFleet:
 
 
 class _FlatEngine(HashGraph):
-    """Host-side mirror + patch generator for one flat fleet document.
+    """Host-side mirror + patch generator for one fleet document.
 
-    Tracks, per root-map key, the visible op set (the reference's multi-value
-    register: ops with no successors, new.js:1204-1217) as {opId: leaf} plus
-    the set of row opIds for pred validation. The heavy merge state lives on
-    the device; this mirror exists to produce exact patches and errors."""
+    The mirror is a real OpSet (the host conformance engine, op_set.py) with
+    the causal gate bypassed — this engine's own HashGraph does the gating,
+    and ready changes stream into the mirror's op store. Patches, conflict
+    sets, counter accumulation, and error conditions are therefore identical
+    to the host backend *by construction*: it is the same code. The heavy
+    merge state lives on the device; the mirror exists for exact patches,
+    reads, and serialization — and after turbo (metadata-only) applies it is
+    dropped and rebuilt lazily, like the reference's deferred hash graph
+    (new.js:1887-1912)."""
 
     def __init__(self, fleet, slot):
         super().__init__()
         self.fleet = fleet
         self.slot = slot
-        self.visible = {}         # key -> {opId: {'type','value'[,'datatype']}}
-        self.all_ops = {}         # key -> set of row opIds (set + inc ops)
+        self.mirror = OpSet()
         self.binary_doc = None
-        self._op_set_cache = None
-        # True after a turbo (metadata-only) apply: the hash graph and device
-        # state are current but visible/all_ops are not; reads rebuild lazily
+        self.seq_objects = {}     # objectId -> 'text' | 'list'
+        # True after a turbo apply (or failed exact apply): the hash graph
+        # and device state are current but the mirror is not; reads rebuild
         self.stale = False
 
-    def _replay_mirror(self):
-        """Rebuild visible/all_ops (and actor/max-op bookkeeping) by
-        replaying the committed log host-side."""
-        fresh = _FlatEngine(self.fleet, self.slot)
+    def _rebuild_mirror(self):
+        """Replay the committed log into a fresh OpSet, bypassing the causal
+        gate (the log is already in applied order, so no per-change SHA-256
+        or dep checks are needed)."""
+        mirror = OpSet()
         for buffer in self.changes:
             change = decode_change(bytes(buffer))
-            fresh._apply_decoded_change({}, change)
-        self.visible = fresh.visible
-        self.all_ops = fresh.all_ops
-        self.max_op = fresh.max_op
-        self.actor_ids = fresh.actor_ids
+            mirror._apply_decoded_change(
+                {'_root': {'objectId': '_root', 'type': 'map', 'props': {}}},
+                change, set())
+        self.mirror = mirror
 
     def _ensure_mirror(self):
-        """Rebuild the visible-op mirror after turbo applies (deferred
-        exactly like the reference's deferred hash graph, new.js:1887-1912).
-        Raises if the committed log contains a change turbo could not
-        validate (dangling pred) — see apply_changes_docs' trust note."""
+        """Rebuild the mirror after turbo applies. Raises if the committed
+        log contains a change turbo could not validate (dangling pred) — see
+        apply_changes_docs' trust note."""
         if not self.stale:
             return
         self.fleet.metrics.mirror_rebuilds += 1
-        self._replay_mirror()
+        self._rebuild_mirror()
+        self.seq_objects = {oid: obj.type
+                            for oid, obj in self.mirror.objects.items()
+                            if oid != '_root' and obj.is_seq}
         # Turbo queue entries carry only metadata; re-decode so the exact
         # drain path can apply their ops when deps arrive
         self.queue = [dict(decode_change(bytes(c['buffer'])), buffer=c['buffer'])
+                      if not isinstance(c.get('ops'), list) else c
                       for c in self.queue]
         self.stale = False
 
@@ -484,143 +950,126 @@ class _FlatEngine(HashGraph):
         self.fleet.metrics.exact_calls += 1
         decoded = decode_change_buffers(change_buffers)
 
-        # Pre-scan for the flat subset before mutating anything, so promotion
-        # to the host engine happens from an untouched state
+        # Pre-scan for the supported subset before mutating anything, so
+        # promotion to the host engine happens from an untouched state.
+        # `made` tracks sequence objects created earlier in the same batch
+        # so their element ops pass the scan.
+        made = set(self.seq_objects)
         for change in decoded:
-            for op in change['ops']:
-                self._check_flat(op)
+            start, actor = change['startOp'], change['actor']
+            for i, op in enumerate(change['ops']):
+                self._check_supported(op, made)
+                if op['obj'] == '_root' and op['action'] in _SEQ_MAKE:
+                    made.add(f'{start + i}@{actor}')
         self._ensure_mirror()
 
-        props = {}
+        from ..backend.op_set import empty_object_patch
+        patches = {'_root': empty_object_patch('_root', 'map')}
+        object_ids = set()
         backup = (dict(self.clock), list(self.heads), list(self.queue))
         try:
             all_applied, queue = self._drain_queue(
                 decoded,
-                lambda change: self._apply_decoded_change(props, change))
+                lambda change: self.mirror._apply_decoded_change(
+                    patches, change, object_ids))
         except Exception:
             self._rollback(backup)
             raise
+        self.mirror._setup_patches(patches, object_ids)
 
         for change in all_applied:
             self._record_applied(change)
+            for i, op in enumerate(change['ops']):
+                if op['obj'] == '_root' and op['action'] in _SEQ_MAKE:
+                    self.seq_objects[f"{change['startOp'] + i}"
+                                     f"@{change['actor']}"] = \
+                        OBJECT_TYPE[op['action']]
         self.queue = queue
+        self.max_op = max(self.max_op, self.mirror.max_op)
         self.binary_doc = None
-        self._op_set_cache = None
         self.fleet.enqueue(self.slot, [c['buffer'] for c in all_applied],
                            [c['actor'] for c in all_applied])
 
         patch = {'maxOp': self.max_op, 'clock': dict(self.clock),
                  'deps': list(self.heads), 'pendingChanges': len(self.queue),
-                 'diffs': {'objectId': '_root', 'type': 'map', 'props': props}}
+                 'diffs': patches['_root']}
         if is_local and len(decoded) == 1:
             patch['actor'] = decoded[0]['actor']
             patch['seq'] = decoded[0]['seq']
         return patch
 
-    def _check_flat(self, op):
-        if op['obj'] != '_root' or op.get('insert') or \
-                op['action'] not in _FLAT_ACTIONS or op.get('key') is None:
-            raise _Unsupported()
-        if op['action'] == 'inc':
-            # The device value column carries inc deltas inline as int32
-            delta = op.get('value', 0)
-            if not isinstance(delta, int) or isinstance(delta, bool) or \
-                    not -(1 << 31) < delta < (1 << 31):
+    def _check_supported(self, op, made):
+        """Fleet-resident subset: flat root-map set/del/inc, makeText/
+        makeList at root keys, and element ops on those sequence objects.
+        Anything else (nested maps/tables, objects inside sequences, link
+        ops) promotes to the host engine."""
+        action = op['action']
+        if op['obj'] == '_root':
+            if op.get('insert') or op.get('key') is None:
                 raise _Unsupported()
+            if action in _SEQ_MAKE:
+                return
+            if action not in _FLAT_ACTIONS:
+                raise _Unsupported()
+            if action == 'inc':
+                # The device value column carries inc deltas inline as int32
+                delta = op.get('value', 0)
+                if not isinstance(delta, int) or isinstance(delta, bool) or \
+                        not -(1 << 31) < delta < (1 << 31):
+                    raise _Unsupported()
+            return
+        if op['obj'] not in made:
+            raise _Unsupported()
+        # No nested objects inside sequences on the fleet path
+        if action not in ('set', 'del', 'inc') or op.get('key') is not None:
+            raise _Unsupported()
 
     def _rollback(self, backup):
-        """Restore the mirror by replaying the committed log host-side (the
-        device never saw the failed call; enqueue happens only on success)."""
+        """Restore gate state; the partially-mutated mirror rebuilds lazily
+        from the (unmodified) committed log. The device never saw the failed
+        call; enqueue happens only on success."""
         self.clock, self.heads, self.queue = backup
-        self._replay_mirror()
-
-    def _apply_decoded_change(self, props, change):
-        if change['actor'] not in self.actor_ids:
-            self.actor_ids.append(change['actor'])
-        start_op = change['startOp']
-        for i, op in enumerate(change['ops']):
-            op_id = f"{start_op + i}@{change['actor']}"
-            if start_op + i > self.max_op:
-                self.max_op = start_op + i
-            self._apply_op(props, op_id, op)
-
-    def _apply_op(self, props, op_id, op):
-        key = op['key']
-        action = op['action']
-        rows = self.all_ops.setdefault(key, set())
-        vis = self.visible.setdefault(key, {})
-        if op_id in rows:
-            raise ValueError(f'duplicate operation ID: {op_id}')
-        preds = list(op.get('pred', []))
-        for p in preds:
-            if p not in rows:
-                raise ValueError(f'no matching operation for pred: {p}')
-
-        if action == 'inc':
-            # The target counter must still be visible (the reference's
-            # counter state machine raises otherwise, new.js:941-946)
-            target = None
-            for p in preds:
-                leaf = vis.get(p)
-                if leaf is not None and leaf.get('datatype') == 'counter':
-                    target = leaf
-                    break
-            if target is None:
-                raise ValueError(
-                    f'increment operation {op_id} for unknown counter')
-            target['value'] += op.get('value', 0)
-            rows.add(op_id)
-        else:
-            for p in preds:
-                vis.pop(p, None)
-            if action == 'set':
-                leaf = {'type': 'value', 'value': op.get('value')}
-                if op.get('datatype') is not None:
-                    leaf['datatype'] = op['datatype']
-                vis[op_id] = leaf
-                rows.add(op_id)
-            # 'del' ops are not rows: they exist only as successor marks
-            # (ref new.js:1204-1217), so they can never be pred targets
-
-        props[key] = {i: copy.copy(leaf) for i, leaf in vis.items()}
+        self.stale = True
 
     # -- reads ----------------------------------------------------------
 
     def get_patch(self):
         self._ensure_mirror()
-        props = {}
-        for key, vis in self.visible.items():
-            if vis:
-                props[key] = {i: copy.copy(leaf) for i, leaf in vis.items()}
-        return {'maxOp': self.max_op, 'clock': dict(self.clock),
-                'deps': list(self.heads), 'pendingChanges': len(self.queue),
-                'diffs': {'objectId': '_root', 'type': 'map', 'props': props}}
+        patch = self.mirror.get_patch()
+        patch['maxOp'] = max(self.max_op, self.mirror.max_op)
+        patch['clock'] = dict(self.clock)
+        patch['deps'] = list(self.heads)
+        patch['pendingChanges'] = len(self.queue)
+        return patch
 
     def materialize(self):
-        """Exact {key: value} view from the host mirror (LWW winner per key,
-        ascending-Lamport max, matching frontend/apply_patch.js:33-42)."""
+        """Exact current {key: value} view from the host mirror (LWW winner
+        per key, ascending-Lamport max, frontend/apply_patch.js:33-42);
+        sequence-object values render to str (text) / list."""
         self._ensure_mirror()
         from ..common import lamport_key
         doc = {}
-        for key, vis in self.visible.items():
-            if vis:
-                winner = max(vis.keys(), key=lamport_key)
-                doc[key] = vis[winner]['value']
+        for key, candidates in self.get_patch()['diffs'].get('props',
+                                                             {}).items():
+            if candidates:
+                winner = max(candidates.keys(), key=lamport_key)
+                doc[key] = _leaf_value(candidates[winner])
         return doc
 
-    def _materialized_op_set(self):
-        if self._op_set_cache is None:
-            ops = OpSet()
-            if self.changes:
-                ops.apply_changes([bytes(b) for b in self.changes])
-            self._op_set_cache = ops
-        return self._op_set_cache
-
     def save(self):
-        """Document container serialization, via a host replay (deferred like
-        the reference's deferred hash graph, new.js:1887-1912)."""
+        """Document container serialization from the mirror's op store plus
+        this engine's hash-graph metadata."""
         if self.binary_doc is None:
-            self.binary_doc = self._materialized_op_set().save()
+            self._ensure_mirror()
+            self._ensure_graph()
+            m = self.mirror
+            m.changes = self.changes
+            m.changes_meta = self.changes_meta
+            m.change_index_by_hash = self.change_index_by_hash
+            m.heads = list(self.heads)
+            m.clock = dict(self.clock)
+            m.binary_doc = None
+            self.binary_doc = m.save()
         return self.binary_doc
 
     def clone_engine(self):
@@ -630,7 +1079,7 @@ class _FlatEngine(HashGraph):
         for field in ('max_op', 'actor_ids', 'heads', 'clock', 'queue',
                       'changes', 'changes_meta', 'change_index_by_hash',
                       'dependencies_by_hash', 'dependents_by_hash',
-                      'hashes_by_actor', 'visible', 'all_ops'):
+                      'hashes_by_actor', 'mirror', 'seq_objects'):
             setattr(other, field, copy.deepcopy(getattr(self, field)))
         return other
 
@@ -728,18 +1177,12 @@ class FleetDoc:
 
     def materialize(self):
         """Exact current {key: value} state (host mirror when in fleet mode,
-        whole-doc patch walk after promotion)."""
+        whole-doc patch walk after promotion); nested objects render to
+        plain Python values (str for text, list, dict for maps)."""
         if self.is_fleet:
             return self._impl.materialize()
         patch = self._impl.get_patch()
-        from ..common import lamport_key
-        doc = {}
-        for key, candidates in patch['diffs'].get('props', {}).items():
-            if candidates:
-                winner = max(candidates.keys(), key=lamport_key)
-                leaf = candidates[winner]
-                doc[key] = leaf.get('value', leaf)
-        return doc
+        return _leaf_value(patch['diffs'])
 
 
 # ----------------------------------------------------------------------
@@ -1108,7 +1551,6 @@ def _apply_changes_turbo(handles, per_doc_changes):
                             int((start_op[idxs] + nops[idxs]).max()) - 1)
         engine.stale = True
         engine.binary_doc = None
-        engine._op_set_cache = None
     for engine, applied, queue in staged:
         for change in applied:
             engine.changes.append(change['buffer'])
@@ -1117,7 +1559,6 @@ def _apply_changes_turbo(handles, per_doc_changes):
                                 change['startOp'] + len(change['ops']) - 1)
             engine.stale = True
             engine.binary_doc = None
-            engine._op_set_cache = None
         engine.queue = queue
         if queue:
             # Queue entries from this pass carry only headers; flag the
@@ -1148,6 +1589,7 @@ def _apply_changes_turbo(handles, per_doc_changes):
             fleet._remap_reg_actors(perm)
         else:
             fleet._remap_actors(perm)
+        fleet._remap_seq_actors(perm)
     key_map = np.zeros(max(len(nat_keys), 1), dtype=np.int32)
     for k in np.unique(rows['key'][keep]):
         key_map[k] = fleet.keys.intern(nat_keys[k])
@@ -1254,7 +1696,13 @@ def materialize_docs(handles):
                     # shape: the host mirror is authoritative
                     out.append(state.materialize())
                     continue
-            out.append(by_fleet[id(fleet)][state._impl.slot])
+            raw = by_fleet[id(fleet)][state._impl.slot]
+            if any(isinstance(v, _SeqLink) for v in raw.values()):
+                # A sequence row is device-inexact (concurrent overwrite,
+                # counter in list): the host mirror serves the whole doc
+                out.append(state.materialize())
+            else:
+                out.append(raw)
         elif isinstance(state, FleetDoc):
             out.append(state.materialize())
         else:

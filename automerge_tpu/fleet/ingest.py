@@ -269,6 +269,24 @@ class ActorInterner(KeyInterner):
     pass
 
 
+def changes_to_decoded_ops(per_doc_changes):
+    """Python-decode per-document change buffers into flat (doc, op_id, op)
+    rows in application order — the mixed-content path used when a batch
+    contains sequence-object ops (makeText/makeList/inserts), which the
+    native flat-only parser rejects. Multi-inserts and multiOp deletes
+    arrive pre-expanded by decode_change (ref columnar.js:446-475)."""
+    from ..columnar import decode_change
+    out = []
+    for d, changes in enumerate(per_doc_changes):
+        for buf in changes:
+            change = decode_change(bytes(buf))
+            start = change['startOp']
+            actor = change['actor']
+            for i, op in enumerate(change['ops']):
+                out.append((d, f'{start + i}@{actor}', op))
+    return out
+
+
 def changes_to_op_rows(per_doc_changes, key_interner, actor_interner,
                        value_table=None):
     """Flat op rows with per-op pred lists, for the exact register engine

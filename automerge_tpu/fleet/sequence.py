@@ -62,15 +62,21 @@ HEAD, END, SCRATCH, SLOT0 = 0, 1, 2, 3
 class SeqState:
     """Pytree of per-doc sequence tensors: five [N, S+3] per-node arrays
     (shared node-id indexing, sentinels at the front) + [N] allocation
-    cursors."""
+    cursors + [N] inexact flags (device state diverged from reference
+    semantics — concurrent set-vs-delete, counters, unknown referents — so
+    reads must come from the host mirror, cf. registers.RegisterState)."""
 
-    def __init__(self, elem_id, nxt, winner, vis, val, n):
+    def __init__(self, elem_id, nxt, winner, vis, val, n, inexact=None):
         self.elem_id = elem_id  # packed elemId per slot (0 = unallocated)
         self.nxt = nxt          # linked-list next pointers over node ids
         self.winner = winner    # packed opId of the LWW winner op per element
         self.vis = vis          # element visible (winner is not a delete)
         self.val = val          # winner's value (char code / value-table idx)
         self.n = n              # slots allocated per doc
+        if inexact is None:
+            # .shape is static even on tracers, so this default is jit-safe
+            inexact = np.zeros((n.shape[0],), dtype=bool)
+        self.inexact = inexact  # row needs the host mirror for reads
 
     @property
     def capacity(self):
@@ -86,15 +92,45 @@ class SeqState:
             xp.zeros(nodes, dtype=np.int32),
             xp.zeros(nodes, dtype=bool),
             xp.zeros(nodes, dtype=np.int32),
-            xp.zeros((n_docs,), dtype=np.int32))
+            xp.zeros((n_docs,), dtype=np.int32),
+            xp.zeros((n_docs,), dtype=bool))
 
     def tree_flatten(self):
         return ((self.elem_id, self.nxt, self.winner, self.vis, self.val,
-                 self.n), None)
+                 self.n, self.inexact), None)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         return cls(*children)
+
+
+def grow_seq_state(state, n_rows, capacity):
+    """Host-side resize to at least (n_rows rows, capacity slots): new rows
+    and tail slots are zeroed/END-filled; existing node ids never move (the
+    sentinels are front-anchored precisely so capacity can grow by appending
+    at the tail). Returns `state` unchanged if already big enough."""
+    old_r, old_nodes = state.elem_id.shape
+    old_cap = old_nodes - 3
+    if n_rows <= old_r and capacity <= old_cap:
+        return state
+    r, cap = max(n_rows, old_r), max(capacity, old_cap)
+
+    def pad(arr, fill, dtype):
+        out = jnp.full((r, cap + 3), fill, dtype=dtype)
+        return out.at[:old_r, :old_nodes].set(arr)
+
+    def pad_vec(arr, dtype):
+        out = jnp.zeros((r,), dtype=dtype)
+        return out.at[:old_r].set(arr)
+
+    return SeqState(
+        pad(state.elem_id, 0, jnp.int32),
+        pad(state.nxt, END, jnp.int32),
+        pad(state.winner, 0, jnp.int32),
+        pad(state.vis, False, bool),
+        pad(state.val, 0, jnp.int32),
+        pad_vec(state.n, jnp.int32),
+        pad_vec(state.inexact, bool))
 
 
 class SeqOpBatch:
@@ -105,16 +141,27 @@ class SeqOpBatch:
                     SET/DEL → packed elemId of the target element
     - packed int32: the op's own packed opId (INSERT: the new elemId)
     - value  int32: INSERT/SET payload
+    - pred   int32: SET/DEL → greatest packed pred opId (0 = none). The
+      device compares it against the element's current winner: a mismatch
+      means the op was concurrent with another overwrite — the one shape
+      where LWW diverges from the reference's multi-value/resurrection
+      semantics — and flags the row inexact.
+    - flag   bool: host-detected inexactness for this row (counter ops in
+      sequences, pred overflow): applied unconditionally.
     """
 
-    def __init__(self, kind, ref, packed, value):
+    def __init__(self, kind, ref, packed, value, pred=None, flag=None):
         self.kind = kind
         self.ref = ref
         self.packed = packed
         self.value = value
+        self.pred = np.zeros_like(np.asarray(kind)) if pred is None else pred
+        self.flag = np.zeros(np.asarray(kind).shape, dtype=bool) \
+            if flag is None else flag
 
     def tree_flatten(self):
-        return ((self.kind, self.ref, self.packed, self.value), None)
+        return ((self.kind, self.ref, self.packed, self.value, self.pred,
+                 self.flag), None)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -125,9 +172,10 @@ register_pytrees(SeqState, SeqOpBatch)
 
 
 def _apply_one_doc(carry, op, capacity):
-    """One op against one doc. carry = (elem_id, nxt, winner, vis, val, n)."""
-    elem_id, nxt, winner, vis, val, n = carry
-    kind, ref, packed, value = op
+    """One op against one doc.
+    carry = (elem_id, nxt, winner, vis, val, n, inexact)."""
+    elem_id, nxt, winner, vis, val, n, inexact = carry
+    kind, ref, packed, value, pred, flag = op
 
     is_ins = kind == INSERT
     is_upd = (kind == SET) | (kind == DEL)
@@ -192,6 +240,11 @@ def _apply_one_doc(carry, op, capacity):
     # ---- SET / DEL: per-element LWW ------------------------------------
     # ref == HEAD_REF (0) marks a malformed update (no target): it would
     # "match" every unallocated slot's zero elem_id, so reject it explicitly.
+    # The concurrency check must read the PRE-update winner: an op whose
+    # pred is not the op it actually supersedes was concurrent with another
+    # overwrite — the shape where LWW diverges from the reference's
+    # multi-value / set-vs-delete-resurrection semantics (new.js:1204-1217).
+    concurrent = is_upd & found & (ref != HEAD_REF) & (pred != winner[match])
     lww = is_upd & found & (ref != HEAD_REF) & (packed > winner[match])
     upd_slot = jnp.where(lww, match, jnp.int32(SCRATCH))
     winner = winner.at[upd_slot].set(jnp.where(lww, packed, winner[upd_slot]))
@@ -204,23 +257,28 @@ def _apply_one_doc(carry, op, capacity):
     # the stats instead of getting silent truncation.
     applied = jnp.where(is_ins, can_ins,
                         (kind > PAD) & found & (ref != HEAD_REF))
-    return (elem_id, nxt, winner, vis, val, n), applied
+    # Inexactness: host-flagged ops (counters, pred overflow), any dropped
+    # live op, and concurrent overwrites (computed above, pre-update)
+    inexact = inexact | flag | concurrent | ((kind > PAD) & ~applied)
+    return (elem_id, nxt, winner, vis, val, n, inexact), applied
 
 
 def _apply_seq_batch_impl(state, ops):
     capacity = state.elem_id.shape[1] - 3
 
-    def per_doc(elem_id, nxt, winner, vis, val, n, kind, ref, packed, value):
-        carry = (elem_id, nxt, winner, vis, val, n)
-        xs = (kind, ref, packed, value)
+    def per_doc(elem_id, nxt, winner, vis, val, n, inexact,
+                kind, ref, packed, value, pred, flag):
+        carry = (elem_id, nxt, winner, vis, val, n, inexact)
+        xs = (kind, ref, packed, value, pred, flag)
         carry, applied = lax.scan(
             lambda c, x: _apply_one_doc(c, x, capacity), carry, xs)
         return carry, jnp.sum(applied, dtype=jnp.int32)
 
-    (elem_id, nxt, winner, vis, val, n), applied = jax.vmap(per_doc)(
+    carry, applied = jax.vmap(per_doc)(
         state.elem_id, state.nxt, state.winner, state.vis, state.val, state.n,
-        ops.kind, ops.ref, ops.packed, ops.value)
-    return SeqState(elem_id, nxt, winner, vis, val, n), jnp.sum(applied)
+        state.inexact, ops.kind, ops.ref, ops.packed, ops.value, ops.pred,
+        ops.flag)
+    return SeqState(*carry), jnp.sum(applied)
 
 
 apply_seq_batch = jax.jit(_apply_seq_batch_impl)
@@ -325,6 +383,8 @@ class SeqEncoder:
         ref = np.zeros((n_docs, width), dtype=np.int32)
         packed = np.zeros((n_docs, width), dtype=np.int32)
         value = np.zeros((n_docs, width), dtype=np.int32)
+        pred = np.zeros((n_docs, width), dtype=np.int32)
+        flag = np.zeros((n_docs, width), dtype=bool)
         kinds = {'insert': INSERT, 'set': SET, 'del': DEL}
         for d, ops in enumerate(per_doc_ops):
             for i, op in enumerate(ops):
@@ -332,4 +392,8 @@ class SeqEncoder:
                 ref[d, i] = self.pack(op.get('ref') or op.get('target'))
                 packed[d, i] = self.pack(op['id'])
                 value[d, i] = op.get('value', 0)
-        return SeqOpBatch(kind, ref, packed, value)
+                preds = op.get('pred') or []
+                if preds:
+                    pred[d, i] = max(self.pack(p) for p in preds)
+                flag[d, i] = bool(op.get('flag'))
+        return SeqOpBatch(kind, ref, packed, value, pred, flag)

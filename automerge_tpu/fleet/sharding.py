@@ -88,7 +88,8 @@ def sharded_seq_apply(mesh):
             *(jax.lax.with_sharding_constraint(x, row)
               for x in (new_state.elem_id, new_state.nxt, new_state.winner,
                         new_state.vis, new_state.val)),
-            jax.lax.with_sharding_constraint(new_state.n, vec))
+            jax.lax.with_sharding_constraint(new_state.n, vec),
+            jax.lax.with_sharding_constraint(new_state.inexact, vec))
         return new_state, stats
     return step
 
@@ -128,7 +129,8 @@ def shard_long_seq(state, mesh):
         jax.device_put(padded(state.winner, 0), slots),
         jax.device_put(padded(state.vis, False), slots),
         jax.device_put(padded(state.val, 0), slots),
-        jax.device_put(state.n, vec))
+        jax.device_put(state.n, vec),
+        jax.device_put(state.inexact, vec))
 
 
 def sharded_long_seq_apply(mesh):
@@ -147,7 +149,8 @@ def sharded_long_seq_apply(mesh):
             *(jax.lax.with_sharding_constraint(x, slots)
               for x in (new_state.elem_id, new_state.nxt, new_state.winner,
                         new_state.vis, new_state.val)),
-            jax.lax.with_sharding_constraint(new_state.n, vec))
+            jax.lax.with_sharding_constraint(new_state.n, vec),
+            jax.lax.with_sharding_constraint(new_state.inexact, vec))
         return new_state, stats
     return step
 

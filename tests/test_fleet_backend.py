@@ -550,7 +550,7 @@ class TestPromotion:
         gb, patch = fleet_backend.apply_changes(gb, [c2])
         assert patch['pendingChanges'] == 1
         nested = change_buf(ACTORS[1], 1, 1, [
-            {'action': 'makeList', 'obj': '_root', 'key': 'l', 'pred': []}])
+            {'action': 'makeMap', 'obj': '_root', 'key': 'm', 'pred': []}])
         gb, _ = fleet_backend.apply_changes(gb, [nested])
         assert not gb['state'].is_fleet
         gb, patch = fleet_backend.apply_changes(gb, [c1])
@@ -888,3 +888,228 @@ class TestSequenceTermination:
             np.array([[65]], dtype=np.int32))
         out, _ = seq.apply_seq_batch(state, batch)   # must not hang
         assert out.n.shape == (1,)
+
+
+class TestSequenceSeam:
+    """Text/list documents through the Backend seam: fleet-resident device
+    state (SeqState rows), zero promotions for plain sequence docs, host
+    mirror fallback only for shapes outside device LWW semantics.
+    Ref: backend/new.js:50-192 (the reference's list-insertion hot path)."""
+
+    def _fb(self):
+        return FleetBackend(DocFleet(doc_capacity=4, key_capacity=8))
+
+    def test_text_doc_stays_fleet_resident(self):
+        fb = self._fb()
+        hb, gb = host_backend.init(), fb.init()
+        A = ACTORS[0]
+        c1 = change_buf(A, 1, 1, [
+            {'action': 'makeText', 'obj': '_root', 'key': 't', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': '_head',
+             'insert': True, 'value': 'h', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': f'2@{A}',
+             'insert': True, 'value': 'i', 'pred': []}])
+        hb, gb = apply_both(hb, gb, [c1])
+        c2 = change_buf(A, 2, 4, [
+            {'action': 'del', 'obj': f'1@{A}', 'elemId': f'2@{A}',
+             'pred': [f'2@{A}']}], deps=host_backend.get_heads(hb))
+        hb, gb = apply_both(hb, gb, [c2])
+        assert gb['state'].is_fleet
+        assert fb.fleet.metrics.promotions == 0
+        assert fleet_backend.materialize_docs([gb]) == [{'t': 'i'}]
+        # device row stayed exact: the render above came from the device
+        fb.fleet.flush()
+        assert not bool(np.asarray(fb.fleet.seq_state.inexact)[0])
+        # patches match host throughout (apply_both asserted) and so does
+        # the serialized document
+        assert bytes(fleet_backend.save(gb)) == bytes(host_backend.save(hb))
+
+    def test_list_values_device_render(self):
+        fb = self._fb()
+        gb = fb.init()
+        A = ACTORS[0]
+        c1 = change_buf(A, 1, 1, [
+            {'action': 'makeList', 'obj': '_root', 'key': 'l', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': '_head',
+             'insert': True, 'value': 7, 'datatype': 'int', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': f'2@{A}',
+             'insert': True, 'value': 'str', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': f'3@{A}',
+             'insert': True, 'value': -5, 'datatype': 'int', 'pred': []}])
+        gb, _ = fleet_backend.apply_changes(gb, [c1])
+        assert fleet_backend.materialize_docs([gb]) == [{'l': [7, 'str', -5]}]
+        fb.fleet.flush()
+        assert not bool(np.asarray(fb.fleet.seq_state.inexact)[0])
+
+    def test_rga_concurrent_insert_order_matches_host(self):
+        """Two actors inserting at the same position: device RGA order must
+        equal the host engine's (ref new.js:145-163)."""
+        from automerge_tpu.columnar import decode_change
+        fb = self._fb()
+        hb, gb = host_backend.init(), fb.init()
+        A, B = ACTORS[0], ACTORS[1]
+        c1 = change_buf(A, 1, 1, [
+            {'action': 'makeText', 'obj': '_root', 'key': 't', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': '_head',
+             'insert': True, 'value': 'm', 'pred': []}])
+        h1 = decode_change(c1)['hash']
+        hb, gb = apply_both(hb, gb, [c1])
+        c2 = change_buf(A, 2, 3, [
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': '_head',
+             'insert': True, 'value': 'a', 'pred': []}], deps=[h1])
+        c3 = change_buf(B, 1, 3, [
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': '_head',
+             'insert': True, 'value': 'b', 'pred': []}], deps=[h1])
+        hb, gb = apply_both(hb, gb, [c2, c3])
+        expect = host_backend.get_patch(hb)
+        got = fleet_backend.get_patch(gb)
+        assert expect == got
+        # device render agrees with the host's element order
+        mat = fleet_backend.materialize_docs([gb])[0]['t']
+        fb.fleet.flush()
+        assert not bool(np.asarray(fb.fleet.seq_state.inexact)[0])
+        assert mat == 'bam'   # higher actor's concurrent insert first
+
+    def test_concurrent_set_vs_del_falls_back_to_mirror(self):
+        """Delete concurrent with a set: the reference keeps the element
+        visible (the del only kills its preds); device LWW would hide it, so
+        the row flags inexact and reads come from the host mirror."""
+        from automerge_tpu.columnar import decode_change
+        fb = self._fb()
+        gb = fb.init()
+        A, B = ACTORS[0], ACTORS[1]
+        c1 = change_buf(A, 1, 1, [
+            {'action': 'makeList', 'obj': '_root', 'key': 'l', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': '_head',
+             'insert': True, 'value': 1, 'datatype': 'int', 'pred': []}])
+        h1 = decode_change(c1)['hash']
+        gb, _ = fleet_backend.apply_changes(gb, [c1])
+        c2 = change_buf(A, 2, 3, [
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': f'2@{A}',
+             'value': 9, 'datatype': 'int', 'pred': [f'2@{A}']}], deps=[h1])
+        c3 = change_buf(B, 1, 3, [
+            {'action': 'del', 'obj': f'1@{A}', 'elemId': f'2@{A}',
+             'pred': [f'2@{A}']}], deps=[h1])
+        gb, _ = fleet_backend.apply_changes(gb, [c2, c3])
+        # reference semantics: the concurrent set survives the delete
+        assert fleet_backend.materialize_docs([gb]) == [{'l': [9]}]
+        fb.fleet.flush()
+        assert bool(np.asarray(fb.fleet.seq_state.inexact)[0])
+
+    def test_counter_in_list_falls_back(self):
+        fb = self._fb()
+        gb = fb.init()
+        A = ACTORS[0]
+        c1 = change_buf(A, 1, 1, [
+            {'action': 'makeList', 'obj': '_root', 'key': 'l', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': '_head',
+             'insert': True, 'value': 10, 'datatype': 'counter', 'pred': []}])
+        gb, _ = fleet_backend.apply_changes(gb, [c1])
+        c2 = change_buf(A, 2, 3, [
+            {'action': 'inc', 'obj': f'1@{A}', 'elemId': f'2@{A}',
+             'value': 5, 'pred': [f'2@{A}']}],
+            deps=fleet_backend.get_heads(gb))
+        gb, _ = fleet_backend.apply_changes(gb, [c2])
+        assert fleet_backend.materialize_docs([gb]) == [{'l': [15]}]
+        fb.fleet.flush()
+        assert bool(np.asarray(fb.fleet.seq_state.inexact)[0])
+
+    def test_clone_and_free_with_seq_rows(self):
+        fb = self._fb()
+        gb = fb.init()
+        A = ACTORS[0]
+        c1 = change_buf(A, 1, 1, [
+            {'action': 'makeText', 'obj': '_root', 'key': 't', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': '_head',
+             'insert': True, 'value': 'x', 'pred': []}])
+        gb, _ = fleet_backend.apply_changes(gb, [c1])
+        clone = fleet_backend.clone(gb)
+        # divergent edits after cloning must not interfere
+        c2 = change_buf(A, 2, 3, [
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': f'2@{A}',
+             'insert': True, 'value': 'y', 'pred': []}],
+            deps=fleet_backend.get_heads(gb))
+        gb, _ = fleet_backend.apply_changes(gb, [c2])
+        assert fleet_backend.materialize_docs([gb, clone]) == \
+            [{'t': 'xy'}, {'t': 'x'}]
+        fleet_backend.free(clone)
+        assert fleet_backend.materialize_docs([gb]) == [{'t': 'xy'}]
+
+    def test_actor_renumber_remaps_seq_rows(self):
+        """A later actor that sorts before existing ones renumbers packed
+        elemIds in device rows; RGA order must stay correct."""
+        from automerge_tpu.columnar import decode_change
+        fb = self._fb()
+        gb = fb.init()
+        A, early = ACTORS[2], ACTORS[3]     # 'cc…' then '11…' (sorts first)
+        c1 = change_buf(A, 1, 1, [
+            {'action': 'makeText', 'obj': '_root', 'key': 't', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': '_head',
+             'insert': True, 'value': 'a', 'pred': []}])
+        h1 = decode_change(c1)['hash']
+        gb, _ = fleet_backend.apply_changes(gb, [c1])
+        fb.fleet.flush()                     # device rows exist pre-renumber
+        c2 = change_buf(early, 1, 3, [
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': f'2@{A}',
+             'insert': True, 'value': 'b', 'pred': []}], deps=[h1])
+        gb, _ = fleet_backend.apply_changes(gb, [c2])
+        assert fleet_backend.materialize_docs([gb]) == [{'t': 'ab'}]
+        fb.fleet.flush()
+        assert not bool(np.asarray(fb.fleet.seq_state.inexact)[0])
+
+    def test_public_api_text_promotionless(self):
+        import automerge_tpu as am
+        from automerge_tpu import Text
+        import automerge_tpu.frontend as fe
+        fb = self._fb()
+        old = am.Backend()
+        am.set_default_backend(fb)
+        try:
+            d = am.init(ACTORS[0])
+            d = am.change(d, lambda doc: doc.__setitem__('t', Text('hello')))
+            d = am.change(d, lambda doc: doc['t'].insert_at(5, '!', '?'))
+            d = am.change(d, lambda doc: doc['t'].delete_at(0, 2))
+            assert str(d['t']) == 'llo!?'
+            handle = fe.get_backend_state(d)
+            assert handle['state'].is_fleet
+            assert fb.fleet.metrics.promotions == 0
+            assert fleet_backend.materialize_docs([handle]) == \
+                [{'t': 'llo!?'}]
+            loaded = am.load(am.save(d))
+            assert str(loaded['t']) == 'llo!?'
+        finally:
+            am.set_default_backend(old)
+
+    def test_turbo_renumber_remaps_seq_rows(self):
+        """Turbo applies that insert an early-sorting actor must remap the
+        actor bits of live SeqState rows, exactly as flush() does
+        (regression: the turbo site skipped _remap_seq_actors, leaving
+        stale packed elemIds in every device text row)."""
+        from automerge_tpu.columnar import decode_change
+        fb = self._fb()
+        g1, g2 = fb.init(), fb.init()
+        A, early = ACTORS[2], ACTORS[3]     # 'cc…' index 0, then '11…'
+        c1 = change_buf(A, 1, 1, [
+            {'action': 'makeText', 'obj': '_root', 'key': 't', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': '_head',
+             'insert': True, 'value': 'a', 'pred': []}])
+        h1 = decode_change(c1)['hash']
+        g1, _ = fleet_backend.apply_changes(g1, [c1])
+        fb.fleet.flush()                     # text row live on device
+        # flat turbo batch on another doc by an actor sorting before 'cc…'
+        flat = change_buf(early, 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        handles, _ = fleet_backend.apply_changes_docs([g2], [[flat]],
+                                                      mirror=False)
+        g2 = handles[0]
+        # the text row's packed elemIds must reflect the new numbering:
+        # further edits (packed with new actor numbers) must still hit
+        c2 = change_buf(A, 2, 3, [
+            {'action': 'del', 'obj': f'1@{A}', 'elemId': f'2@{A}',
+             'pred': [f'2@{A}']}], deps=[h1])
+        g1, _ = fleet_backend.apply_changes(g1, [c2])
+        assert fleet_backend.materialize_docs([g1, g2]) == \
+            [{'t': ''}, {'k': 1}]
+        fb.fleet.flush()
+        assert not bool(np.asarray(fb.fleet.seq_state.inexact)[0])
