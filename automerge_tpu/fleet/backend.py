@@ -396,18 +396,16 @@ class DocFleet:
         if self.seq_state is None:
             self.seq_state = SeqState.empty(_pow2(n_rows),
                                             self.seq_elem_cap, xp=jnp)
-        if not seq_ops:
+        if len(seq_ops) == 0:
             if n_rows > self.seq_state.elem_id.shape[0]:
                 self.seq_state = grow_seq_state(self.seq_state,
                                                 _pow2(n_rows),
                                                 self.seq_state.capacity)
             return
-        ins = np.zeros(n_rows, dtype=np.int64)
-        counts = np.zeros(n_rows, dtype=np.int64)
-        for (row, kind, _r, _p, _v, _pr, _f) in seq_ops:
-            counts[row] += 1
-            if kind == INSERT:
-                ins[row] += 1
+        arr = np.asarray(seq_ops, dtype=np.int64)   # [M, 7] op tuples
+        row_a = arr[:, 0]
+        counts = np.bincount(row_a, minlength=n_rows)
+        ins = np.bincount(row_a[arr[:, 1] == INSERT], minlength=n_rows)
         cur_n = np.zeros(n_rows, dtype=np.int64)
         have = np.asarray(self.seq_state.n)
         cur_n[:min(n_rows, len(have))] = have[:n_rows]
@@ -417,19 +415,17 @@ class DocFleet:
             _pow2(max(need_cap, self.seq_elem_cap)))
         r_cap = self.seq_state.elem_id.shape[0]
         width = max(int(counts.max()), 1)
+        order = np.argsort(row_a, kind='stable')
+        row_sorted = row_a[order]
+        pos = np.arange(len(row_sorted)) - \
+            np.searchsorted(row_sorted, row_sorted, side='left')
         cols = {name: np.zeros((r_cap, width), dtype=np.int32)
                 for name in ('kind', 'ref', 'packed', 'value', 'pred')}
         flag = np.zeros((r_cap, width), dtype=bool)
-        pos = np.zeros(n_rows, dtype=np.int64)
-        for (row, kind, ref, packed, value, pred, f) in seq_ops:
-            j = pos[row]
-            pos[row] += 1
-            cols['kind'][row, j] = kind
-            cols['ref'][row, j] = ref
-            cols['packed'][row, j] = packed
-            cols['value'][row, j] = value
-            cols['pred'][row, j] = pred
-            flag[row, j] = f
+        for j, name in enumerate(('kind', 'ref', 'packed', 'value')):
+            cols[name][row_sorted, pos] = arr[order, j + 1]
+        cols['pred'][row_sorted, pos] = arr[order, 5]
+        flag[row_sorted, pos] = arr[order, 6] != 0
         batch = SeqOpBatch(cols['kind'], cols['ref'], cols['packed'],
                            cols['value'], cols['pred'], flag)
         self.seq_state, _stats = apply_seq_batch(self.seq_state, batch)
@@ -1424,11 +1420,10 @@ def _apply_changes_turbo(handles, per_doc_changes):
         return handles, [None] * len(handles)
 
     out = native.ingest_changes(flat_buffers, list(range(n_changes)),
-                                with_meta=True)
+                                with_meta=True, with_seq=True)
     if out is None:
-        return None     # ops outside the flat subset, or corrupt chunk
+        return None     # ops outside the fleet subset, or corrupt chunk
     rows, nat_keys, nat_actors, nmeta = out
-    fleet.metrics.turbo_calls += 1
     batch_meta = _TurboMetaBatch(nmeta, nat_actors, flat_buffers)
 
     # ---- Vectorized linear-chain validation over the whole batch ----
@@ -1482,6 +1477,33 @@ def _apply_changes_turbo(handles, per_doc_changes):
 
     fast_mask = np.ones(len(engines), dtype=bool)
     fast_mask[doc_of[~ok]] = False
+
+    flags_all = rows['flags']
+    seq_sel = (flags_all >= 3) & (flags_all <= 6)
+    make_sel = flags_all >= 7
+    if seq_sel.any() or make_sel.any():
+        # RGA application is order-sensitive: if any doc needs the general
+        # causal gate (whose applied order can differ from buffer order),
+        # route the whole call to the exact path
+        if (~fast_mask[doc_of]).any():
+            return None
+        # Every sequence op's object must resolve to a registered object or
+        # a make earlier in this batch; dangling objects get exact-path
+        # error handling
+        made = [set() for _ in engines]
+        for ri in np.flatnonzero(make_sel):
+            d = change_doc[int(rows['doc'][ri])]
+            p = int(rows['packed'][ri])
+            made[d].add(f'{p >> 8}@{nat_actors[p & (_MA - 1)]}')
+        for d, obj_nat in {(change_doc[int(rows['doc'][ri])],
+                            int(rows['obj'][ri]))
+                           for ri in np.flatnonzero(seq_sel)}:
+            oid = f'{obj_nat >> 8}@{nat_actors[obj_nat & (_MA - 1)]}'
+            if oid not in made[d] and \
+                    oid not in engines[d].seq_objects:
+                return None
+    # From here on the batch is committed to turbo (counted as such)
+    fleet.metrics.turbo_calls += 1
 
     # Phase 1 — fallible: general causal gate for docs off the chain shape.
     # _drain_queue mutates clock/heads, so engines carry backups and any
@@ -1590,85 +1612,167 @@ def _apply_changes_turbo(handles, per_doc_changes):
         else:
             fleet._remap_actors(perm)
         fleet._remap_seq_actors(perm)
-    key_map = np.zeros(max(len(nat_keys), 1), dtype=np.int32)
-    for k in np.unique(rows['key'][keep]):
-        key_map[k] = fleet.keys.intern(nat_keys[k])
     # -1 marks actors the fleet has never registered: ops' own actors are
     # always registered (applied_actor_ids above), so -1 can only surface
-    # through pred columns, where it flags the doc inexact instead of
-    # silently renumbering to actor 0
+    # through pred/ref columns, where it flags the doc/row inexact instead
+    # of silently renumbering to actor 0
     actor_map = np.array([fleet.actors.index.get(a, -1) for a in nat_actors],
                          dtype=np.int32) if nat_actors else np.zeros(1, np.int32)
-    doc_arr = np.array(change_doc, dtype=np.int32)[kept_change]
-    slots = np.array([e.slot for e in engines], dtype=np.int32)[doc_arr]
-    key = key_map[rows['key'][keep]]
-    ctr = kept_packed_nat >> 8
-    actor = actor_map[kept_packed_nat & (_MA - 1)]
+    slot_of_doc = np.array([e.slot for e in engines], dtype=np.int64)
+
+    keep_root = keep & ~seq_sel
+    keep_seq = keep & seq_sel
+
+    # Make ops: register the object with its engine, allocate its device
+    # row, and substitute the grid value with a _SeqLink table ref
+    kept_vals_all = rows['value'].astype(np.int32, copy=True)
+    kept_flags_all = rows['flags'].copy()
+    for ri in np.flatnonzero(make_sel & keep):
+        p = int(rows['packed'][ri])
+        oid = f'{p >> 8}@{nat_actors[p & (_MA - 1)]}'
+        d = change_doc[int(rows['doc'][ri])]
+        typ = 'text' if rows['flags'][ri] == 7 else 'list'
+        engines[d].seq_objects[oid] = typ
+        slot = engines[d].slot
+        if oid not in fleet.slot_seq.get(slot, {}):
+            fleet._alloc_seq_row(slot, oid, typ)
+        kept_vals_all[ri] = fleet._intern_value_boxed(_SeqLink(oid))
+        kept_flags_all[ri] = 1
+
+    def dispatch_seq_rows():
+        """Kept sequence rows -> one SeqState dispatch (fleet numbering)."""
+        if not keep_seq.any():
+            return
+        from .sequence import INSERT, SET, DEL, PAD
+        sflags = rows['flags'][keep_seq]
+        svtype = rows['vtype'][keep_seq]
+        svalue = rows['value'][keep_seq].astype(np.int64)
+        sdoc = np.array(change_doc, dtype=np.int64)[rows['doc'][keep_seq]]
+        sobj = rows['obj'][keep_seq].astype(np.int64)
+
+        def remap_ids(p):
+            # Unknown-actor refs/preds map to -1: never matches an element,
+            # so the op drops and the row flags inexact (mirror serves it)
+            a = actor_map[p & (_MA - 1)].astype(np.int64)
+            return np.where(p != 0,
+                            np.where(a >= 0, (p >> 8 << 8) | a, -1),
+                            0).astype(np.int64)
+
+        spacked = remap_ids(rows['packed'][keep_seq].astype(np.int64))
+        sref = remap_ids(rows['ref'][keep_seq].astype(np.int64))
+        pred_counts = np.diff(rows['pred_off'])
+        entry_keep = np.repeat(keep_seq, pred_counts)
+        spred_flat = remap_ids(rows['pred'][entry_keep].astype(np.int64))
+        n_seq = int(keep_seq.sum())
+        pred_max = np.zeros(n_seq, dtype=np.int64)
+        if len(spred_flat):
+            seg = np.repeat(np.arange(n_seq), pred_counts[keep_seq])
+            np.maximum.at(pred_max, seg, spred_flat)
+        # resolve device rows per unique (doc, objectId)
+        pair = np.stack([sdoc, sobj], axis=1)
+        uniq, inv = np.unique(pair, axis=0, return_inverse=True)
+        urow = np.empty(len(uniq), dtype=np.int64)
+        for i, (d, obj_nat) in enumerate(uniq):
+            oid = f'{int(obj_nat) >> 8}' \
+                  f'@{nat_actors[int(obj_nat) & (_MA - 1)]}'
+            urow[i] = fleet.slot_seq[int(slot_of_doc[int(d)])][oid]
+        srow = urow[inv]
+        kind_lut = np.zeros(9, dtype=np.int64)
+        kind_lut[3], kind_lut[4] = INSERT, SET
+        kind_lut[5], kind_lut[6] = DEL, PAD
+        skind = kind_lut[sflags]
+        is_text = np.array([info is not None and info['type'] == 'text'
+                            for info in fleet.seq_rows], dtype=bool)
+        txt = is_text[srow]
+        # host-side inexact flags: counter ops (flags 6 / vtype 8), and
+        # payload types the device value column can't carry for this row
+        # type (non-char in text, char in list)
+        val_op = (sflags == 3) | (sflags == 4)
+        hflag = (sflags == 6) | (svtype == 8) | \
+            (val_op & (txt != (svtype == 6)))
+        fleet._dispatch_seq(np.stack(
+            [srow, skind, sref, spacked, svalue, pred_max,
+             hflag.astype(np.int64)], axis=1))
+
+    n_kept_root = int(keep_root.sum())
+    doc_arr = np.array(change_doc, dtype=np.int32)[rows['doc'][keep_root]]
+    slots = slot_of_doc.astype(np.int32)[doc_arr]
+    kept_packed_root = rows['packed'][keep_root]
+    key_map = np.zeros(max(len(nat_keys), 1), dtype=np.int32)
+    for k in np.unique(rows['key'][keep_root]) if n_kept_root else []:
+        key_map[k] = fleet.keys.intern(nat_keys[k])
+    key = key_map[rows['key'][keep_root]]
+    ctr = kept_packed_root >> 8
+    actor = actor_map[kept_packed_root & (_MA - 1)]
     packed = (ctr << 8) | actor
 
     if fleet.exact_device:
         from .registers import apply_register_batch, rows_to_register_batch
-        # Slice the kept rows' pred segments and remap their actor bits
-        pred_counts = np.diff(rows['pred_off'])
-        entry_keep = np.repeat(keep, pred_counts)
-        preds_kept = rows['pred'][entry_keep]
-        pred_actor = actor_map[preds_kept & (_MA - 1)]
-        bad_pred = (preds_kept != 0) & (pred_actor < 0)
-        preds_kept = np.where(
-            preds_kept != 0,
-            (preds_kept >> 8 << 8) | pred_actor,
-            0).astype(np.int32)
-        preds_kept[bad_pred] = 0    # unknown-actor preds never reach device
-        off_kept = np.zeros(int(keep.sum()) + 1, dtype=np.int64)
-        np.cumsum(pred_counts[keep], out=off_kept[1:])
-        # Rows whose preds named an unregistered actor go inexact (host
-        # replay re-validates them) rather than killing actor 0's slot
-        bad_rows = np.zeros(int(keep.sum()), dtype=bool)
-        if bad_pred.any():
-            row_of_entry = np.repeat(np.arange(int(keep.sum())),
-                                     pred_counts[keep])
-            bad_rows[row_of_entry[bad_pred]] = True
-        fleet._ensure_reg_capacity(n_docs=fleet.n_slots,
-                                   n_keys=len(fleet.keys))
-        n_cap = fleet.reg_state.reg.shape[0]
-        reg_batch = rows_to_register_batch(
-            slots.astype(np.int64), rows['flags'][keep], key, packed,
-            rows['value'][keep], off_kept, preds_kept,
-            n_docs=n_cap, d_preds=fleet.d_preds,
-            force_overflow=bad_rows)
-        fleet.reg_state, _stats = apply_register_batch(fleet.reg_state,
-                                                       reg_batch)
-        fleet.metrics.dispatches += 1
-        fleet.metrics.device_ops += int(len(kept_packed_nat))
+        if n_kept_root:
+            # Slice the kept rows' pred segments and remap their actor bits
+            pred_counts = np.diff(rows['pred_off'])
+            entry_keep = np.repeat(keep_root, pred_counts)
+            preds_kept = rows['pred'][entry_keep]
+            pred_actor = actor_map[preds_kept & (_MA - 1)]
+            bad_pred = (preds_kept != 0) & (pred_actor < 0)
+            preds_kept = np.where(
+                preds_kept != 0,
+                (preds_kept >> 8 << 8) | pred_actor,
+                0).astype(np.int32)
+            preds_kept[bad_pred] = 0   # unknown-actor preds never reach device
+            off_kept = np.zeros(n_kept_root + 1, dtype=np.int64)
+            np.cumsum(pred_counts[keep_root], out=off_kept[1:])
+            # Rows whose preds named an unregistered actor go inexact (host
+            # replay re-validates them) rather than killing actor 0's slot
+            bad_rows = np.zeros(n_kept_root, dtype=bool)
+            if bad_pred.any():
+                row_of_entry = np.repeat(np.arange(n_kept_root),
+                                         pred_counts[keep_root])
+                bad_rows[row_of_entry[bad_pred]] = True
+            fleet._ensure_reg_capacity(n_docs=fleet.n_slots,
+                                       n_keys=len(fleet.keys))
+            n_cap = fleet.reg_state.reg.shape[0]
+            reg_batch = rows_to_register_batch(
+                slots.astype(np.int64), kept_flags_all[keep_root], key,
+                packed, kept_vals_all[keep_root], off_kept, preds_kept,
+                n_docs=n_cap, d_preds=fleet.d_preds,
+                force_overflow=bad_rows)
+            fleet.reg_state, _stats = apply_register_batch(fleet.reg_state,
+                                                           reg_batch)
+            fleet.metrics.dispatches += 1
+        dispatch_seq_rows()
+        fleet.metrics.device_ops += int(keep.sum())
         return result
 
-    n_slots = fleet.n_slots
-    counts = np.bincount(slots, minlength=n_slots)
-    max_ops = max(int(counts.max()) if counts.size else 0, 1)
-    order = np.argsort(slots, kind='stable')
-    slot_sorted = slots[order]
-    pos = np.arange(len(slot_sorted)) - \
-        np.searchsorted(slot_sorted, slot_sorted, side='left')
-    shape = (n_slots, max_ops)
-    cols = {name: np.zeros(shape, dtype=np.int32)
-            for name in ('key_id', 'packed', 'value')}
-    flags = np.zeros(shape, dtype=np.int8)
-    cols['key_id'][slot_sorted, pos] = key[order]
-    cols['packed'][slot_sorted, pos] = packed[order]
-    cols['value'][slot_sorted, pos] = rows['value'][keep][order]
-    flags[slot_sorted, pos] = rows['flags'][keep][order]
-    batch = OpBatch(cols['key_id'], cols['packed'], cols['value'],
-                    flags == 1, flags == 2, flags != 0)
+    if n_kept_root:
+        n_slots = fleet.n_slots
+        counts = np.bincount(slots, minlength=n_slots)
+        max_ops = max(int(counts.max()) if counts.size else 0, 1)
+        order = np.argsort(slots, kind='stable')
+        slot_sorted = slots[order]
+        pos = np.arange(len(slot_sorted)) - \
+            np.searchsorted(slot_sorted, slot_sorted, side='left')
+        shape = (n_slots, max_ops)
+        cols = {name: np.zeros(shape, dtype=np.int32)
+                for name in ('key_id', 'packed', 'value')}
+        flags = np.zeros(shape, dtype=np.int8)
+        cols['key_id'][slot_sorted, pos] = key[order]
+        cols['packed'][slot_sorted, pos] = packed[order]
+        cols['value'][slot_sorted, pos] = kept_vals_all[keep_root][order]
+        flags[slot_sorted, pos] = kept_flags_all[keep_root][order]
+        batch = OpBatch(cols['key_id'], cols['packed'], cols['value'],
+                        flags == 1, flags == 2, flags != 0)
 
-    fleet._ensure_capacity(n_docs=n_slots, n_keys=len(fleet.keys))
-    n_cap = fleet.state.winners.shape[0]
-    if batch.key_id.shape[0] < n_cap:
-        pad = n_cap - batch.key_id.shape[0]
-        batch = OpBatch(*(np.pad(col, ((0, pad), (0, 0)))
-                          for col in batch.tree_flatten()[0]))
-    fleet.state, _stats = apply_op_batch(fleet.state, batch)
-    fleet.metrics.dispatches += 1
-    fleet.metrics.device_ops += int(len(kept_packed_nat))
+        fleet._ensure_capacity(n_docs=n_slots, n_keys=len(fleet.keys))
+        n_cap = fleet.state.winners.shape[0]
+        if batch.key_id.shape[0] < n_cap:
+            pad = n_cap - batch.key_id.shape[0]
+            batch = OpBatch(*(np.pad(col, ((0, pad), (0, 0)))
+                              for col in batch.tree_flatten()[0]))
+        fleet.state, _stats = apply_op_batch(fleet.state, batch)
+        fleet.metrics.dispatches += 1
+    dispatch_seq_rows()
+    fleet.metrics.device_ops += int(keep.sum())
     return result
 
 

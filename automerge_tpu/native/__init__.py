@@ -203,7 +203,7 @@ def decode_boolean_column(buf):
     return _decode_column('boolean', buf)
 
 
-def ingest_changes(buffers, doc_ids, with_meta=False):
+def ingest_changes(buffers, doc_ids, with_meta=False, with_seq=False):
     """Batched native change ingest: parse N binary changes into flat op-row
     arrays (doc, key_id, packed_opid, value, flags) with C++-side dictionary
     encoding of keys and actors.
@@ -213,7 +213,12 @@ def ingest_changes(buffers, doc_ids, with_meta=False):
     general host engine). With with_meta=True, a fourth element carries
     per-change header metadata (the whole hash-graph feed: SHA-256 hash with
     checksum verification, deps, actor/seq/startOp/time/message, op counts)
-    so no Python-side header decode is needed."""
+    so no Python-side header decode is needed. With with_seq=True, the
+    parser also accepts sequence ops (makeText/makeList at root keys,
+    insert/set/del/inc on sequence objects) and the rows dict gains
+    obj/ref/vtype columns (packed objectId, packed referent elemId, wire
+    value-type tag); flags extend to 3=seq insert, 4=seq set, 5=seq del,
+    6=seq inc, 7=makeText, 8=makeList."""
     lib = _load()
     if lib is None:
         return None
@@ -228,17 +233,18 @@ def ingest_changes(buffers, doc_ids, with_meta=False):
     lib.am_ingest_changes.argtypes = [
         ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint64),
         ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int32),
-        ctypes.c_uint64, ctypes.c_int]
+        ctypes.c_uint64, ctypes.c_int, ctypes.c_int]
     lib.am_ingest_changes.restype = i64
     n_rows = lib.am_ingest_changes(
         ptr, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
         lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
         docs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(buffers),
-        1 if with_meta else 0)
+        1 if with_meta else 0, 1 if with_seq else 0)
     if n_rows < 0:
         return None
     metas = None
     preds = None
+    seq_cols = None
     if with_meta:
         metas = _fetch_ingest_meta(lib, len(buffers), len(blob))
         if metas is None:
@@ -246,6 +252,21 @@ def ingest_changes(buffers, doc_ids, with_meta=False):
         preds = _fetch_ingest_preds(lib, int(n_rows))
         if preds is None:
             return None
+    if with_seq:
+        i32p_ = ctypes.POINTER(ctypes.c_int32)
+        u8p_ = ctypes.POINTER(ctypes.c_uint8)
+        obj = np.zeros(max(int(n_rows), 1), dtype=np.int32)
+        ref = np.zeros(max(int(n_rows), 1), dtype=np.int32)
+        vtype = np.zeros(max(int(n_rows), 1), dtype=np.uint8)
+        lib.am_ingest_seq_fetch.argtypes = [i32p_, i32p_, u8p_]
+        lib.am_ingest_seq_fetch.restype = i64
+        got = lib.am_ingest_seq_fetch(
+            obj.ctypes.data_as(i32p_), ref.ctypes.data_as(i32p_),
+            vtype.ctypes.data_as(u8p_))
+        if got < 0:
+            return None
+        seq_cols = (obj[:int(n_rows)], ref[:int(n_rows)],
+                    vtype[:int(n_rows)])
     n = max(int(n_rows), 1)
     doc = np.zeros(n, dtype=np.int32)
     key = np.zeros(n, dtype=np.int32)
@@ -282,6 +303,8 @@ def ingest_changes(buffers, doc_ids, with_meta=False):
     rows = {'doc': doc[:int(n_rows)], 'key': key[:int(n_rows)],
             'packed': packed[:int(n_rows)], 'value': val[:int(n_rows)],
             'flags': flags[:int(n_rows)]}
+    if seq_cols is not None:
+        rows['obj'], rows['ref'], rows['vtype'] = seq_cols
     if with_meta:
         rows['pred_off'], rows['pred'] = preds
         return rows, keys, actors, metas

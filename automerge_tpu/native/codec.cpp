@@ -378,6 +378,10 @@ struct IngestCtx {
   // with GLOBAL actor numbers (the per-change actor table is interned)
   std::vector<int64_t> out_pred_off;
   std::vector<int32_t> out_pred;
+  // Sequence-op columns (with_seq only): packed objectId (0 = root map),
+  // packed referent elemId (0 = head/none), wire value-type tag low nibble
+  std::vector<int32_t> out_obj, out_ref;
+  std::vector<uint8_t> out_vtype;
 };
 
 // SHA-256 of a change chunk as the reference hashes it (columnar.js:688-708):
@@ -404,7 +408,36 @@ constexpr int kColInsert = 0x34, kColAction = 0x42;
 constexpr int kColValLen = 0x56, kColValRaw = 0x57;
 constexpr int kColPredNum = 0x70, kColPredActor = 0x71, kColPredCtr = 0x73;
 constexpr int kActionSet = 1, kActionDel = 3, kActionInc = 5;
+constexpr int kActionMakeList = 2, kActionMakeText = 4;
 constexpr int kActorBits = 8;
+
+// Decode a UTF-8 buffer holding EXACTLY one code point; returns it or -1.
+// Text-element payloads are single characters in the hot editing path —
+// multi-char / non-string values fall back to the host value table.
+static int64_t utf8_single_cp(const uint8_t *p, uint64_t n) {
+  if (n == 0 || p == nullptr) return -1;
+  uint32_t cp;
+  uint64_t need;
+  uint8_t b = p[0];
+  if (b < 0x80) { cp = b; need = 1; }
+  else if ((b >> 5) == 6) { cp = b & 0x1f; need = 2; }
+  else if ((b >> 4) == 14) { cp = b & 0x0f; need = 3; }
+  else if ((b >> 3) == 30) { cp = b & 0x07; need = 4; }
+  else return -1;
+  if (n != need) return -1;
+  for (uint64_t i = 1; i < need; i++) {
+    if ((p[i] >> 6) != 2) return -1;
+    cp = (cp << 6) | (p[i] & 0x3f);
+  }
+  // Match Python's strict UTF-8 decode (encoding.py read_prefixed_string):
+  // reject overlong encodings, surrogates, and out-of-range code points —
+  // otherwise turbo would commit values whose later chr()/encode crashes.
+  static const uint32_t min_cp[5] = {0, 0, 0x80, 0x800, 0x10000};
+  if (cp < min_cp[need]) return -1;              // overlong
+  if (cp >= 0xd800 && cp <= 0xdfff) return -1;   // surrogate
+  if (cp > 0x10ffff) return -1;
+  return int64_t(cp);
+}
 
 // Decode an RLE utf8 column into interned key ids (-1 = null)
 bool decode_keystr(const uint8_t *buf, uint64_t len, Interner &keys,
@@ -460,7 +493,8 @@ extern "C" {
 // (after the 8-byte magic+checksum, 1-byte type, LEB length header).
 static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
                               uint64_t body_len, int32_t doc,
-                              int with_meta, const uint8_t *checksum) {
+                              int with_meta, int with_seq,
+                              const uint8_t *checksum) {
   size_t rows_before = ctx.out_doc.size();
   if (with_meta) {
     uint8_t digest[32];
@@ -552,6 +586,8 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
   std::vector<int64_t> insert_i64;
   std::vector<int64_t> pred_num, pred_actor, pred_ctr;
   std::vector<uint8_t> pred_num_ok, pred_actor_ok, pred_ctr_ok;
+  std::vector<int64_t> obj_actor, key_actor, key_ctr;
+  std::vector<uint8_t> obj_actor_ok, key_actor_ok, key_ctr_ok;
   const uint8_t *val_raw = nullptr;
   uint64_t val_raw_len = 0;
 
@@ -572,6 +608,15 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
       val_raw_len = blen;
     } else if (cid == kColObjCtr) {
       if (!decode_i64_col(b, blen, false, false, obj_ctr, obj_ctr_ok))
+        return false;
+    } else if (with_seq && cid == kColObjActor) {
+      if (!decode_i64_col(b, blen, false, false, obj_actor, obj_actor_ok))
+        return false;
+    } else if (with_seq && cid == kColKeyActor) {
+      if (!decode_i64_col(b, blen, false, false, key_actor, key_actor_ok))
+        return false;
+    } else if (with_seq && cid == kColKeyCtr) {
+      if (!decode_i64_col(b, blen, true, true, key_ctr, key_ctr_ok))
         return false;
     } else if (with_meta && cid == kColPredNum) {
       if (!decode_i64_col(b, blen, false, false, pred_num, pred_num_ok))
@@ -635,18 +680,109 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
             int32_t((pctr << kActorBits) | actor_table[ta]));
       }
     }
-    // root-map only: objCtr must be null
-    if (i < obj_ctr.size() && obj_ctr_ok.size() > i && obj_ctr_ok[i])
-      return false;
-    if (i < insert_i64.size() && insert_i64[i]) return false;  // no inserts
+    bool is_root = !(i < obj_ctr.size() && obj_ctr_ok.size() > i &&
+                     obj_ctr_ok[i]);
+    bool insert = (i < insert_i64.size()) && insert_i64[i];
     int32_t key = (i < key_ids.size()) ? key_ids[i] : -1;
-    if (key < 0) return false;  // list element op
     int64_t tag = (i < val_lens.size() && val_lens_ok[i]) ? val_lens[i] : 0;
     uint64_t vsize = uint64_t(tag) >> 4;
     int vtype = int(tag & 0x0f);
     if (raw_pos + vsize > val_raw_len) return false;
     const uint8_t *vbytes = val_raw ? val_raw + raw_pos : nullptr;
     raw_pos += vsize;
+    int64_t ctr = int64_t(start_op + i);
+    if (ctr >= (int64_t(1) << (31 - kActorBits))) return false;
+    int32_t self_packed = int32_t((ctr << kActorBits) | actor_id);
+
+    if (!is_root && with_seq) {
+      // ---- sequence element op (flags 3-6) ----
+      if (key >= 0) return false;     // keyed op on an object: table/map
+      if (action != kActionSet && action != kActionDel &&
+          action != kActionInc)
+        return false;                 // nested make / link: host engine
+      if (i >= obj_actor.size() || !obj_actor_ok[i]) return false;
+      uint64_t ta = uint64_t(obj_actor[i]);
+      if (ta >= actor_table.size()) return false;
+      int64_t objc = (i < obj_ctr.size()) ? obj_ctr[i] : 0;
+      if (objc <= 0 || objc >= (int64_t(1) << (31 - kActorBits)))
+        return false;
+      int32_t obj = int32_t((objc << kActorBits) | actor_table[ta]);
+      // referent elemId: keyCtr 0 = '_head' (insert only); else packed
+      if (i >= key_ctr.size() || !key_ctr_ok[i]) return false;
+      int64_t kc = key_ctr[i];
+      if (kc < 0 || kc >= (int64_t(1) << (31 - kActorBits))) return false;
+      int32_t ref = 0;
+      if (kc == 0) {
+        if (!insert) return false;    // update needs a real target
+      } else {
+        if (i >= key_actor.size() || !key_actor_ok[i]) return false;
+        uint64_t ka = uint64_t(key_actor[i]);
+        if (ka >= actor_table.size()) return false;
+        ref = int32_t((kc << kActorBits) | actor_table[ka]);
+      }
+      int64_t value = 0;
+      uint8_t flags;
+      if (action == kActionDel) {
+        if (insert || vsize != 0) return false;
+        flags = 5;
+      } else if (action == kActionInc) {
+        if (insert) return false;
+        uint64_t p = 0;
+        int err = 0;
+        if (vtype == 3) value = int64_t(read_uleb(vbytes, vsize, &p, &err));
+        else if (vtype == 4 || vtype == 8 || vtype == 9)
+          value = read_sleb(vbytes, vsize, &p, &err);
+        else return false;
+        if (err || value <= -(int64_t(1) << 31) ||
+            value >= (int64_t(1) << 31))
+          return false;
+        flags = 6;
+      } else {
+        uint64_t p = 0;
+        int err = 0;
+        if (vtype == 3) {
+          value = int64_t(read_uleb(vbytes, vsize, &p, &err));
+        } else if (vtype == 4 || vtype == 8 || vtype == 9) {
+          value = read_sleb(vbytes, vsize, &p, &err);
+        } else if (vtype == 6) {      // UTF-8: single code point inline
+          value = utf8_single_cp(vbytes, vsize);
+          if (value < 0) return false;
+        } else {
+          return false;               // null/bool/float/bytes: host table
+        }
+        if (err) return false;
+        if (vtype != 6 && (value < 0 || value >= (int64_t(1) << 31)))
+          return false;
+        flags = insert ? 3 : 4;
+      }
+      ctx.out_doc.push_back(doc);
+      ctx.out_key.push_back(-1);
+      ctx.out_packed.push_back(self_packed);
+      ctx.out_val.push_back(int32_t(value));
+      ctx.out_flags.push_back(flags);
+      ctx.out_obj.push_back(obj);
+      ctx.out_ref.push_back(ref);
+      ctx.out_vtype.push_back(uint8_t(vtype));
+      continue;
+    }
+
+    // ---- root-map op ----
+    if (!is_root) return false;       // seq op without with_seq
+    if (insert) return false;
+    if (key < 0) return false;
+    if (with_seq &&
+        (action == kActionMakeText || action == kActionMakeList)) {
+      if (vsize != 0) return false;
+      ctx.out_doc.push_back(doc);
+      ctx.out_key.push_back(key);
+      ctx.out_packed.push_back(self_packed);
+      ctx.out_val.push_back(0);
+      ctx.out_flags.push_back(action == kActionMakeText ? 7 : 8);
+      ctx.out_obj.push_back(0);
+      ctx.out_ref.push_back(0);
+      ctx.out_vtype.push_back(0);
+      continue;
+    }
 
     int64_t value = 0;
     if (action == kActionSet || action == kActionInc) {
@@ -672,15 +808,18 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
       return false;  // make*/link need the general engine
     }
 
-    int64_t ctr = int64_t(start_op + i);
-    if (ctr >= (int64_t(1) << (31 - kActorBits))) return false;
     ctx.out_doc.push_back(doc);
     ctx.out_key.push_back(key);
-    ctx.out_packed.push_back(int32_t((ctr << kActorBits) | actor_id));
+    ctx.out_packed.push_back(self_packed);
     // A winning delete must be distinguishable from set-to-zero: deletions
     // carry the TOMBSTONE value (-1), matching tensor_doc.TOMBSTONE
     ctx.out_val.push_back(action == kActionDel ? -1 : int32_t(value));
     ctx.out_flags.push_back(action == kActionInc ? 2 : 1);
+    if (with_seq) {
+      ctx.out_obj.push_back(0);
+      ctx.out_ref.push_back(0);
+      ctx.out_vtype.push_back(uint8_t(vtype));
+    }
   }
   if (with_meta) ctx.m_nops.push_back(int64_t(ctx.out_doc.size() - rows_before));
   return true;
@@ -693,7 +832,7 @@ static IngestCtx *g_ingest = nullptr;
 
 int64_t am_ingest_changes(const uint8_t *blob, const uint64_t *offsets,
                           const uint64_t *lens, const int32_t *doc_ids,
-                          uint64_t n_changes, int with_meta) {
+                          uint64_t n_changes, int with_meta, int with_seq) {
   delete g_ingest;
   g_ingest = new IngestCtx();
   for (uint64_t i = 0; i < n_changes; i++) {
@@ -727,7 +866,7 @@ int64_t am_ingest_changes(const uint8_t *blob, const uint64_t *offsets,
       delete g_ingest; g_ingest = nullptr; return -1;
     }
     if (!parse_change_body(*g_ingest, body, body_len, doc_ids[i],
-                           with_meta, chunk + 4)) {
+                           with_meta, with_seq, chunk + 4)) {
       delete g_ingest;
       g_ingest = nullptr;
       return -1;
@@ -811,6 +950,23 @@ int64_t am_ingest_meta_fetch(int32_t *actor, int64_t *seq, int64_t *start_op,
   memcpy(msg_off, ctx.m_msg_off.data(), n * 8);
   msg_off[n] = int64_t(ctx.m_msg.size());
   memcpy(msg_blob, ctx.m_msg.data(), ctx.m_msg.size());
+  return int64_t(n);
+}
+
+// Copy sequence-op columns captured by am_ingest_changes(with_seq=1).
+// Must be called BEFORE am_ingest_fetch (which frees the context).
+// Returns row count, or -1 when the context is missing / seq columns were
+// not requested (arrays empty while rows exist).
+int64_t am_ingest_seq_fetch(int32_t *obj, int32_t *ref, uint8_t *vtype) {
+  if (!g_ingest) return -1;
+  IngestCtx &ctx = *g_ingest;
+  size_t n = ctx.out_obj.size();
+  if (n != ctx.out_doc.size() || ctx.out_ref.size() != n ||
+      ctx.out_vtype.size() != n)
+    return -1;
+  memcpy(obj, ctx.out_obj.data(), n * 4);
+  memcpy(ref, ctx.out_ref.data(), n * 4);
+  memcpy(vtype, ctx.out_vtype.data(), n);
   return int64_t(n);
 }
 

@@ -1113,3 +1113,88 @@ class TestSequenceSeam:
             [{'t': ''}, {'k': 1}]
         fb.fleet.flush()
         assert not bool(np.asarray(fb.fleet.seq_state.inexact)[0])
+
+
+class TestTurboSequence:
+    """mirror=False applies with sequence ops: op columns go wire -> native
+    C++ parse -> SeqState dispatch with no per-op Python objects and no
+    mirror work; reads come straight from the device."""
+
+    def _fb(self):
+        return FleetBackend(DocFleet(doc_capacity=4, key_capacity=8))
+
+    def _text_changes(self):
+        from automerge_tpu.columnar import decode_change
+        A = ACTORS[0]
+        c1 = change_buf(A, 1, 1, [
+            {'action': 'makeText', 'obj': '_root', 'key': 't', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': '_head',
+             'insert': True, 'value': 'a', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': f'2@{A}',
+             'insert': True, 'value': 'b', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': f'3@{A}',
+             'insert': True, 'value': 'c', 'pred': []}])
+        h1 = decode_change(c1)['hash']
+        c2 = change_buf(A, 2, 5, [
+            {'action': 'del', 'obj': f'1@{A}', 'elemId': f'3@{A}',
+             'pred': [f'3@{A}']}], deps=[h1])
+        return c1, c2
+
+    def test_turbo_text_no_mirror_no_fallback(self):
+        fb = self._fb()
+        g = fb.init()
+        c1, c2 = self._text_changes()
+        handles, _ = fleet_backend.apply_changes_docs([g], [[c1, c2]],
+                                                      mirror=False)
+        assert fb.fleet.metrics.fallbacks == 0
+        assert fb.fleet.metrics.turbo_calls == 1
+        assert fleet_backend.materialize_docs(handles) == [{'t': 'ac'}]
+        # the device served the read: no lazy mirror rebuild happened
+        assert fb.fleet.metrics.mirror_rebuilds == 0
+        assert not bool(np.asarray(fb.fleet.seq_state.inexact)[0])
+
+    def test_turbo_text_differential_vs_exact(self):
+        """Turbo and exact paths produce identical patches and bytes."""
+        fb, fb2 = self._fb(), self._fb()
+        g, g2 = fb.init(), fb2.init()
+        c1, c2 = self._text_changes()
+        A = ACTORS[0]
+        handles, _ = fleet_backend.apply_changes_docs([g], [[c1, c2]],
+                                                      mirror=False)
+        c3 = change_buf(A, 3, 6, [
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': f'4@{A}',
+             'insert': True, 'values': ['€', 'x'], 'pred': []}],
+            deps=fleet_backend.get_heads(handles[0]))
+        handles, _ = fleet_backend.apply_changes_docs(handles, [[c3]],
+                                                      mirror=False)
+        assert fb.fleet.metrics.fallbacks == 0
+        for c in (c1, c2, c3):
+            g2, _ = fleet_backend.apply_changes(g2, [c])
+        assert fleet_backend.materialize_docs(handles) == [{'t': 'ac€x'}]
+        assert fleet_backend.get_patch(handles[0]) == \
+            fleet_backend.get_patch(g2)
+        assert bytes(fleet_backend.save(handles[0])) == \
+            bytes(fleet_backend.save(g2))
+
+    def test_turbo_seq_register_mode(self):
+        """Turbo sequence dispatch under exact_device (register) mode."""
+        fb = FleetBackend(DocFleet(doc_capacity=4, key_capacity=8,
+                                   exact_device=True))
+        g = fb.init()
+        c1, c2 = self._text_changes()
+        handles, _ = fleet_backend.apply_changes_docs([g], [[c1, c2]],
+                                                      mirror=False)
+        assert fb.fleet.metrics.fallbacks == 0
+        assert fleet_backend.materialize_docs(handles) == [{'t': 'ac'}]
+
+    def test_turbo_unknown_seq_object_falls_back(self):
+        """Ops on an object the fleet has never seen route to the exact
+        path (which raises the reference's error)."""
+        A = ACTORS[0]
+        fb = self._fb()
+        g = fb.init()
+        bogus = change_buf(A, 1, 1, [
+            {'action': 'set', 'obj': f'9@{A}', 'elemId': '_head',
+             'insert': True, 'value': 'x', 'pred': []}])
+        with pytest.raises(ValueError, match='unknown object'):
+            fleet_backend.apply_changes_docs([g], [[bogus]], mirror=False)
