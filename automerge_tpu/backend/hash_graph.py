@@ -79,12 +79,8 @@ class HashGraph:
         3-tuples (index, batch, i) resolved via batch.resolve(i)."""
         if not self._deferred:
             return
-        for entry in self._deferred:
-            if len(entry) == 3:
-                index, batch, i = entry
-                hash, deps, actor, meta = batch.resolve(i)
-            else:
-                index, hash, deps, actor, meta = entry
+
+        def record(index, hash, deps, actor, meta):
             self.hashes_by_actor.setdefault(actor, []).append(hash)
             self.change_index_by_hash[hash] = index
             self.dependencies_by_hash[hash] = deps
@@ -92,6 +88,19 @@ class HashGraph:
             for dep in deps:
                 self.dependents_by_hash.setdefault(dep, []).append(hash)
             self.changes_meta.append(meta)
+
+        for entry in self._deferred:
+            if len(entry) == 3:
+                index, batch, i = entry
+                if isinstance(i, (list, tuple)):
+                    # One record covering a run of log entries [index, ...)
+                    for off, j in enumerate(i):
+                        record(index + off, *batch.resolve(int(j)))
+                    continue
+                record(index, *batch.resolve(i))
+            else:
+                index, hash, deps, actor, meta = entry
+                record(index, hash, deps, actor, meta)
         self._deferred = []
 
     def _causal_gate(self, changes, applied_hashes=None):

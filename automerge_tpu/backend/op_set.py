@@ -305,8 +305,10 @@ class ObjState:
             block.visible += 1 if now else -1
 
 
-ROOT_META = {'parentObj': None, 'parentKey': None, 'opId': '_root', 'type': 'map',
-             'children': {}}
+def root_meta():
+    """Fresh root objectMeta entry (ref new.js:1694-1768)."""
+    return {'parentObj': None, 'parentKey': None, 'opId': '_root',
+            'type': 'map', 'children': {}}
 
 
 class OpSet(HashGraph):
@@ -316,7 +318,7 @@ class OpSet(HashGraph):
     def __init__(self, buffer=None):
         super().__init__()
         self.objects = {'_root': ObjState('map')}
-        self.object_meta = {'_root': copy.deepcopy(ROOT_META)}
+        self.object_meta = {'_root': root_meta()}
         self.binary_doc = None
         self.extra_bytes = None
         if buffer is not None:
@@ -681,7 +683,7 @@ class OpSet(HashGraph):
     # ------------------------------------------------------------------
 
     def get_patch(self):
-        object_meta = {'_root': copy.deepcopy(ROOT_META)}
+        object_meta = {'_root': root_meta()}
         patches = {'_root': empty_object_patch('_root', 'map')}
         for object_id in self._document_object_order():
             obj = self.objects[object_id]

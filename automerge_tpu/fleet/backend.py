@@ -477,8 +477,11 @@ class DocFleet:
         need_docs = _pow2(max(n_docs, self.doc_cap))
         need_keys = _pow2(max(n_keys + 1, self.key_cap))
         if self.state is None:
+            import jax.numpy as jnp
             self.doc_cap, self.key_cap = need_docs, need_keys
-            self.state = FleetState.empty(need_docs, need_keys)
+            # Allocate on device: host-side zeros would ship the whole grid
+            # over the transfer link for no reason
+            self.state = FleetState.empty(need_docs, need_keys, xp=jnp)
             return
         old_n, old_k = self.state.winners.shape
         if need_docs <= old_n and need_keys + 1 <= old_k:
@@ -520,7 +523,7 @@ class DocFleet:
             self.doc_cap, self.key_cap = need_docs, need_keys
             self.actor_slot_cap = need_slots
             self.reg_state = RegisterState.empty(need_docs, need_keys - 1,
-                                                 need_slots)
+                                                 need_slots, xp=jnp)
             return
         old_n, old_k, old_a = self.reg_state.reg.shape
         if need_docs <= old_n and need_keys <= old_k and \
@@ -898,7 +901,7 @@ class _FlatEngine(HashGraph):
         super().__init__()
         self.fleet = fleet
         self.slot = slot
-        self.mirror = OpSet()
+        self.mirror = None        # OpSet, built lazily on first exact use
         self.binary_doc = None
         self.seq_objects = {}     # objectId -> 'text' | 'list'
         # True after a turbo apply (or failed exact apply): the hash graph
@@ -921,7 +924,10 @@ class _FlatEngine(HashGraph):
         """Rebuild the mirror after turbo applies. Raises if the committed
         log contains a change turbo could not validate (dangling pred) — see
         apply_changes_docs' trust note."""
-        if not self.stale:
+        if self.mirror is None and not self.stale and not self.changes:
+            self.mirror = OpSet()
+            return
+        if not self.stale and self.mirror is not None:
             return
         self.fleet.metrics.mirror_rebuilds += 1
         self._rebuild_mirror()
@@ -1303,25 +1309,6 @@ def apply_changes_docs(handles, per_doc_changes, mirror=True):
     return out_handles, patches
 
 
-def _single_chunk(buf):
-    """True iff the buffer holds exactly ONE chunk: magic+checksum (8 bytes),
-    type byte, LEB128 body length, body — spanning the whole buffer. Buffers
-    holding concatenated chunks (valid input — split_containers handles them
-    on the exact path) must not take turbo, whose native parser reads only
-    the first chunk and would silently drop the rest."""
-    n, shift, i = 0, 0, 9
-    while True:
-        if i >= len(buf) or shift > 56:
-            return False
-        b = buf[i]
-        n |= (b & 0x7f) << shift
-        i += 1
-        if not (b & 0x80):
-            break
-        shift += 7
-    return i + n == len(buf)
-
-
 class _TurboMetaBatch:
     """Raw per-change metadata from the native parser, with lazy hex/dict
     materialization: the fast path touches only numpy arrays; full dicts are
@@ -1405,16 +1392,12 @@ def _apply_changes_turbo(handles, per_doc_changes):
         return None
 
     flat_buffers, change_doc = [], []
-    per_doc_idx = [[] for _ in range(len(handles))]
+    per_doc_idx = [None] * len(handles)
     for d, changes in enumerate(per_doc_changes):
-        for buf in changes:
-            buf = bytes(buf)
-            if len(buf) < 12 or buf[8] not in (1, 2) or \
-                    not _single_chunk(buf):
-                return None     # document/multi-chunk buffers: exact path
-            per_doc_idx[d].append(len(flat_buffers))
-            flat_buffers.append(buf)
-            change_doc.append(d)
+        k = len(flat_buffers)
+        flat_buffers += [bytes(b) for b in changes]
+        per_doc_idx[d] = list(range(k, len(flat_buffers)))
+        change_doc += [d] * (len(flat_buffers) - k)
     n_changes = len(flat_buffers)
     if not n_changes:
         return handles, [None] * len(handles)
@@ -1559,18 +1542,24 @@ def _apply_changes_turbo(handles, per_doc_changes):
     # Phase 2 — infallible: record logs, queues, staleness
     start_op = nmeta['startOp']
     nops = nmeta['nops']
+    last_op = start_op + nops - 1
     for d in np.flatnonzero(fast_mask):
         idxs = per_doc_idx[d]
         if not idxs:
             continue
         engine = engines[d]
+        base = len(engine.changes)
+        engine.changes.extend(flat_buffers[i] for i in idxs)
+        # One deferred-graph record for the whole run (resolved lazily per
+        # change only if a graph query ever needs it)
+        engine._deferred.append((base, batch_meta, idxs))
+        clk = {}
         for i in idxs:
-            engine.changes.append(flat_buffers[i])
-            engine._deferred.append((len(engine.changes) - 1, batch_meta, i))
-            engine.clock[nat_actors[int(actor_id[i])]] = int(seqs[i])
+            clk[int(actor_id[i])] = int(seqs[i])
+        for a, s in clk.items():
+            engine.clock[nat_actors[a]] = s
         engine.heads = [batch_meta.hash_hex(idxs[-1])]
-        engine.max_op = max(engine.max_op,
-                            int((start_op[idxs] + nops[idxs]).max()) - 1)
+        engine.max_op = max(engine.max_op, int(last_op[idxs].max()))
         engine.stale = True
         engine.binary_doc = None
     for engine, applied, queue in staged:

@@ -222,10 +222,12 @@ def ingest_changes(buffers, doc_ids, with_meta=False, with_seq=False):
     lib = _load()
     if lib is None:
         return None
-    blob = b''.join(bytes(b) for b in buffers)
-    lens = np.array([len(b) for b in buffers], dtype=np.uint64)
-    offsets = np.zeros(len(buffers), dtype=np.uint64)
-    if len(buffers) > 1:
+    bufs = [bytes(b) for b in buffers]
+    blob = b''.join(bufs)
+    lens = np.fromiter((len(b) for b in bufs), dtype=np.uint64,
+                       count=len(bufs))
+    offsets = np.zeros(len(bufs), dtype=np.uint64)
+    if len(bufs) > 1:
         np.cumsum(lens[:-1], out=offsets[1:])
     docs = np.asarray(doc_ids, dtype=np.int32)
     arr, ptr = _u8(blob)
@@ -273,8 +275,8 @@ def ingest_changes(buffers, doc_ids, with_meta=False, with_seq=False):
     packed = np.zeros(n, dtype=np.int32)
     val = np.zeros(n, dtype=np.int32)
     flags = np.zeros(n, dtype=np.uint8)
-    key_blob = np.zeros(max(len(blob) * 2, 1 << 16), dtype=np.uint8)
-    actor_blob = np.zeros(1 << 20, dtype=np.uint8)
+    key_blob = np.empty(max(len(blob) * 2, 1 << 16), dtype=np.uint8)
+    actor_blob = np.empty(1 << 20, dtype=np.uint8)
     n_keys = i64(0)
     n_actors = i64(0)
     i32p = ctypes.POINTER(ctypes.c_int32)

@@ -865,6 +865,10 @@ int64_t am_ingest_changes(const uint8_t *blob, const uint64_t *offsets,
     } else {
       delete g_ingest; g_ingest = nullptr; return -1;
     }
+    // The chunk header + declared body must span the whole buffer: buffers
+    // holding concatenated chunks (split_containers territory) take the
+    // exact path, where every chunk is applied
+    if (hc.pos != chunk_len) { delete g_ingest; g_ingest = nullptr; return -1; }
     if (!parse_change_body(*g_ingest, body, body_len, doc_ids[i],
                            with_meta, with_seq, chunk + 4)) {
       delete g_ingest;

@@ -1,13 +1,19 @@
 #!/usr/bin/env python
 """Fleet benchmark: batched change application over a document fleet.
 
-Measures the BASELINE.json primary metric — changes/sec on a 10k-document
-concurrent-merge batch (config 1 shape: 2-actor concurrent map key sets) —
-for the TPU fleet engine, against the host reference engine (the pure-Python
-OpSet backend) measured on the same workload shape.
+The HEADLINE metric is the end-to-end Backend-seam rate: binary changes ->
+header decode + SHA-256 hash graph + causal gate (host) -> native C++ column
+parse -> one device merge dispatch, via fleet.backend.apply_changes_docs
+(mirror=False). That is the full setDefaultBackend-pluggable pipeline a user
+of the reference would hit — nothing skipped. Kernel-only numbers (device
+merge on pre-built batches) are reported separately and labeled as such.
+
+All key rates are medians over BENCH_REPS (default 5) timed runs after a
+compile warmup.
 
 Note: the reference JS backend cannot run in this image (no Node.js), so the
-recorded baseline is our host reference engine; see BASELINE.md.
+recorded baseline is our host reference engine (CPython OpSet); V8 would be
+several times faster, so treat vs_baseline as vs-CPython. See BASELINE.md.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -20,6 +26,18 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
+
+REPS = int(os.environ.get('BENCH_REPS', 5))
+
+
+def median_rate(run, total, reps=None):
+    """Median ops-per-second over `reps` timed runs of run()."""
+    rates = []
+    for _ in range(reps or REPS):
+        start = time.perf_counter()
+        run()
+        rates.append(total / (time.perf_counter() - start))
+    return float(np.median(rates))
 
 
 def build_workload(n_docs, n_keys, n_actors, rounds, ops_per_round, seed=0):
@@ -60,14 +78,14 @@ def bench_fleet(n_docs, n_keys, rounds, ops_per_round):
     warm, _ = apply_op_batch(state, device_batches[0])
     jax.block_until_ready(warm.winners)
 
-    start = time.perf_counter()
-    s = state
-    for b in device_batches:
-        s, stats = apply_op_batch(s, b)
-    jax.block_until_ready(s.winners)
-    elapsed = time.perf_counter() - start
+    def run():
+        s = state
+        for b in device_batches:
+            s, _stats = apply_op_batch(s, b)
+        jax.block_until_ready(s.winners)
+
     total_ops = n_docs * ops_per_round * rounds
-    return total_ops / elapsed, elapsed
+    return median_rate(run, total_ops), None
 
 
 def bench_host(n_docs, n_keys, rounds, ops_per_round, seed=0):
@@ -100,15 +118,15 @@ def bench_host(n_docs, n_keys, rounds, ops_per_round, seed=0):
                 ctr += 1
         docs.append(changes)
 
-    start = time.perf_counter()
-    for changes in docs:
-        backend = Backend.init()
-        state = backend['state']
-        # seq contiguity: interleave per actor in recorded order
-        state.apply_changes(changes)
-    elapsed = time.perf_counter() - start
+    def run():
+        for changes in docs:
+            backend = Backend.init()
+            state = backend['state']
+            # seq contiguity: interleave per actor in recorded order
+            state.apply_changes(changes)
+
     total_ops = n_docs * rounds * ops_per_round
-    return total_ops / elapsed, elapsed
+    return median_rate(run, total_ops, reps=3), None
 
 
 def bench_pipeline(n_docs, n_keys, changes_per_doc, seed=0):
@@ -145,10 +163,7 @@ def bench_pipeline(n_docs, n_keys, changes_per_doc, seed=0):
         jax.block_until_ready(state.winners)
 
     run()  # warmup: jit compile for these shapes
-    start = time.perf_counter()
-    run()
-    elapsed = time.perf_counter() - start
-    return (n_docs * changes_per_doc) / elapsed, elapsed
+    return median_rate(run, n_docs * changes_per_doc), None
 
 
 def bench_backend_pipeline(n_docs, n_keys, changes_per_doc, seed=0):
@@ -189,10 +204,7 @@ def bench_backend_pipeline(n_docs, n_keys, changes_per_doc, seed=0):
         return handles
 
     run()  # warmup compile
-    start = time.perf_counter()
-    run()
-    elapsed = time.perf_counter() - start
-    return (n_docs * changes_per_doc) / elapsed, elapsed
+    return median_rate(run, n_docs * changes_per_doc), None
 
 
 def bench_sync_bloom(n_docs, hashes_per_doc, seed=0):
@@ -303,9 +315,10 @@ def bench_registers(n_docs, n_keys=64, n_actor_slots=4, p=128, seed=0):
 
 
 def bench_text(n_docs, trace_len, n_actors=3, seed=0):
-    """Config 2 (BASELINE.md): batched text editing traces through the device
-    sequence engine — n_docs docs, each applying a trace_len-op multi-actor
-    insert/delete trace, as vmap'd RGA scans in one dispatch per batch."""
+    """KERNEL-ONLY config 2 shape: batched text editing traces through the
+    raw device sequence engine on pre-built packed columns (no wire decode,
+    no hash graph) — the device ceiling, not an end-to-end number; see
+    bench_backend_text for the honest seam rate."""
     import jax
     from automerge_tpu.fleet.sequence import (
         DEL, INSERT, SeqOpBatch, SeqState, apply_seq_batch)
@@ -339,11 +352,70 @@ def bench_text(n_docs, trace_len, n_actors=3, seed=0):
     warm, _ = apply_seq_batch(state, batch)
     jax.block_until_ready(warm.nxt)
 
-    start = time.perf_counter()
-    out, _ = apply_seq_batch(state, batch)
-    jax.block_until_ready(out.nxt)
-    elapsed = time.perf_counter() - start
-    return (n_docs * trace_len) / elapsed, elapsed
+    def run():
+        out, _ = apply_seq_batch(state, batch)
+        jax.block_until_ready(out.nxt)
+
+    return median_rate(run, n_docs * trace_len), None
+
+
+def bench_backend_text(n_docs, trace_len, ops_per_change=32, seed=0):
+    """End-to-end text editing through the Backend seam: binary change
+    chains (makeText + insert/delete runs) -> turbo wire->device into the
+    SeqState fleet. Returns median text ops/s across the fleet."""
+    from automerge_tpu.columnar import encode_change, decode_change_meta
+    from automerge_tpu.fleet.backend import (
+        DocFleet, init_docs, apply_changes_docs)
+    rng = np.random.default_rng(seed)
+    A = 'aa' * 16
+    # One trace shared by every doc: makeText, then chained changes of
+    # insert/delete ops (deletes target a random still-visible element)
+    ops, elems, alive = [], [], []
+    ops.append({'action': 'makeText', 'obj': '_root', 'key': 't',
+                'pred': []})
+    obj = f'1@{A}'
+    op_num = 2
+    prev = '_head'
+    while len(ops) < trace_len + 1:
+        if alive and rng.random() < 0.2:
+            i = int(rng.integers(0, len(alive)))
+            victim = alive.pop(i)
+            ops.append({'action': 'del', 'obj': obj, 'elemId': victim,
+                        'pred': [victim]})
+        else:
+            ref = prev if not alive or rng.random() < 0.5 else \
+                alive[int(rng.integers(0, len(alive)))]
+            me = f'{op_num}@{A}'
+            ops.append({'action': 'set', 'obj': obj, 'elemId': ref,
+                        'insert': True,
+                        'value': chr(97 + int(rng.integers(0, 26))),
+                        'pred': []})
+            alive.append(me)
+            prev = me
+        op_num += 1
+    changes, heads = [], []
+    seq = 0
+    for start in range(0, len(ops), ops_per_change):
+        chunk = ops[start:start + ops_per_change]
+        seq += 1
+        buf = encode_change({'actor': A, 'seq': seq, 'startOp': start + 1,
+                             'time': 0, 'message': '', 'deps': heads,
+                             'ops': chunk})
+        heads = [decode_change_meta(buf, True)['hash']]
+        changes.append(buf)
+    per_doc = [list(changes) for _ in range(n_docs)]
+    n_ops = len(ops) * n_docs
+
+    def run():
+        import jax
+        fleet = DocFleet(doc_capacity=n_docs, key_capacity=4)
+        handles = init_docs(n_docs, fleet)
+        handles, _ = apply_changes_docs(handles, per_doc, mirror=False)
+        assert fleet.metrics.fallbacks == 0
+        jax.block_until_ready(fleet.seq_state.nxt)
+
+    run()  # warmup compile
+    return median_rate(run, n_ops), None
 
 
 def main():
@@ -352,20 +424,26 @@ def main():
     rounds = int(os.environ.get('BENCH_ROUNDS', 10))
     ops_per_round = int(os.environ.get('BENCH_OPS', 100))
 
-    fleet_rate, fleet_time = bench_fleet(n_docs, n_keys, rounds, ops_per_round)
+    # HEADLINE: end-to-end Backend seam (wire -> hash graph + causal gate ->
+    # native parse -> device merge), median over reps
+    seam_rate, _ = bench_backend_pipeline(
+        int(os.environ.get('BENCH_SEAM_DOCS', 2000)), n_keys, 20)
 
-    # Host baseline on a smaller doc count (rate-based metric)
+    # Host reference engine on the same workload shape (rate-based)
     host_docs = int(os.environ.get('BENCH_HOST_DOCS', 20))
-    host_rate, host_time = bench_host(host_docs, n_keys, rounds,
-                                      min(ops_per_round, 20))
+    host_rate, _ = bench_host(host_docs, n_keys, rounds,
+                              min(ops_per_round, 20))
 
-    # Full-pipeline (wire decode included) on a medium fleet, for the record
+    # End-to-end text editing through the seam (config 2, honest number)
+    seam_text_rate, _ = bench_backend_text(
+        int(os.environ.get('BENCH_SEAM_TEXT_DOCS', 200)),
+        int(os.environ.get('BENCH_SEAM_TEXT_LEN', 512)))
+
+    # KERNEL-ONLY numbers (device ceilings on pre-built batches — NOT
+    # end-to-end; decode/hashing excluded):
+    fleet_rate, _ = bench_fleet(n_docs, n_keys, rounds, ops_per_round)
     pipe_rate, _ = bench_pipeline(int(os.environ.get('BENCH_PIPE_DOCS', 500)),
                                   n_keys, 20)
-    # Same, through the Backend seam (causal gate + hash graph included)
-    seam_rate, _ = bench_backend_pipeline(
-        int(os.environ.get('BENCH_SEAM_DOCS', 500)), n_keys, 20)
-    # Config 2: batched text-trace editing through the device sequence engine
     text_rate, _ = bench_text(int(os.environ.get('BENCH_TEXT_DOCS', 2000)),
                               int(os.environ.get('BENCH_TEXT_LEN', 512)))
     # Config 4: sync Bloom filters, device fleet vs per-peer host loop
@@ -377,24 +455,30 @@ def main():
         int(os.environ.get('BENCH_ZIPF_DOCS', 100000)))
     # Exact multi-value register engine (ordered scan formulation)
     reg_rate = bench_registers(int(os.environ.get('BENCH_REG_DOCS', 4000)))
-    print(f'# pipeline (wire->device incl. native decode): '
+
+    print(f'# HEADLINE backend-seam end-to-end (turbo, incl. hash graph): '
+          f'{seam_rate:.0f} changes/s (median of {REPS})', file=sys.stderr)
+    print(f'# backend-seam text editing end-to-end: '
+          f'{seam_text_rate:.0f} ops/s (median of {REPS})', file=sys.stderr)
+    print(f'# host reference engine (CPython, full pipeline): '
+          f'{host_rate:.0f} changes/s', file=sys.stderr)
+    print(f'# kernel-only device merge (pre-built batches): '
+          f'{fleet_rate:.0f} ops/s', file=sys.stderr)
+    print(f'# kernel-only pipeline (native decode, no hash graph): '
           f'{pipe_rate:.0f} changes/s', file=sys.stderr)
-    print(f'# backend-seam pipeline (turbo, incl. hash graph): '
-          f'{seam_rate:.0f} changes/s', file=sys.stderr)
-    print(f'# sequence engine (text traces): {text_rate:.0f} ops/s',
-          file=sys.stderr)
+    print(f'# kernel-only sequence engine (packed text traces): '
+          f'{text_rate:.0f} ops/s', file=sys.stderr)
     print(f'# sync bloom build+probe: device {bloom_dev:.0f} hashes/s, '
           f'host {bloom_host:.0f} hashes/s', file=sys.stderr)
     print(f'# zipf 100k-doc fleet: {zipf_rate:.0f} effective ops/s '
           f'(occupancy {zipf_occ:.2f})', file=sys.stderr)
     print(f'# exact register engine: {reg_rate:.0f} ops/s', file=sys.stderr)
-    print(f'# host reference engine: {host_rate:.0f} changes/s', file=sys.stderr)
 
     result = {
-        'metric': 'changes_per_sec_10k_doc_merge',
-        'value': round(fleet_rate),
+        'metric': 'changes_per_sec_backend_seam_e2e',
+        'value': round(seam_rate),
         'unit': 'changes/s',
-        'vs_baseline': round(fleet_rate / host_rate, 2),
+        'vs_baseline': round(seam_rate / host_rate, 2),
     }
     print(json.dumps(result))
 

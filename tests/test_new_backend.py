@@ -404,3 +404,489 @@ class TestLongTextSaveLoad:
         other.apply_changes(list(changes))
         assert other.heads == doc.heads
         assert bytes(other.save()) == bytes(doc.save())
+
+
+def full_patch(clock, deps, max_op, diffs, pending=0):
+    return {'maxOp': max_op, 'clock': clock, 'deps': sorted(deps),
+            'pendingChanges': pending, 'diffs': diffs}
+
+
+class TestConflictShapes:
+    """The conflict-shape matrix (ref new_backend_test.js:1282-1857):
+    conflicts inside list elements, conflicts created by one change,
+    conflicts on multi-inserted elements, insert->update conversion,
+    conflict growth, delete+overwrite interleavings, and conflicted nested
+    objects. Patch assertions are exact (block-internal column checks are
+    representation-specific to the reference and are covered by our own
+    save/load byte tests instead)."""
+
+    def test_conflicts_inside_list_elements(self):
+        """(ref new_backend_test.js:1282)"""
+        c1 = {'actor': A1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'list',
+             'insert': False, 'pred': []},
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': '_head',
+             'insert': True, 'datatype': 'uint', 'value': 1, 'pred': []}]}
+        c2 = {'actor': A1, 'seq': 2, 'startOp': 3, 'time': 0,
+              'deps': [hash_of(c1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': f'2@{A1}',
+             'insert': False, 'datatype': 'uint', 'value': 2,
+             'pred': [f'2@{A1}']}]}
+        c3 = {'actor': A2, 'seq': 1, 'startOp': 3, 'time': 0,
+              'deps': [hash_of(c1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': f'2@{A1}',
+             'insert': False, 'datatype': 'uint', 'value': 3,
+             'pred': [f'2@{A1}']}]}
+        b1, b2 = OpSet(), OpSet()
+        assert b1.apply_changes([encode_change(c1)]) == full_patch(
+            {A1: 1}, [hash_of(c1)], 2,
+            {'objectId': '_root', 'type': 'map', 'props': {'list': {f'1@{A1}': {
+                'objectId': f'1@{A1}', 'type': 'list', 'edits': [
+                    {'action': 'insert', 'index': 0, 'elemId': f'2@{A1}',
+                     'opId': f'2@{A1}',
+                     'value': {'type': 'value', 'value': 1,
+                               'datatype': 'uint'}}]}}}})
+        assert b1.apply_changes([encode_change(c2)]) == full_patch(
+            {A1: 2}, [hash_of(c2)], 3,
+            {'objectId': '_root', 'type': 'map', 'props': {'list': {f'1@{A1}': {
+                'objectId': f'1@{A1}', 'type': 'list', 'edits': [
+                    {'action': 'update', 'index': 0, 'opId': f'3@{A1}',
+                     'value': {'type': 'value', 'value': 2,
+                               'datatype': 'uint'}}]}}}})
+        assert b1.apply_changes([encode_change(c3)]) == full_patch(
+            {A1: 2, A2: 1}, [hash_of(c2), hash_of(c3)], 3,
+            {'objectId': '_root', 'type': 'map', 'props': {'list': {f'1@{A1}': {
+                'objectId': f'1@{A1}', 'type': 'list', 'edits': [
+                    {'action': 'update', 'index': 0, 'opId': f'3@{A1}',
+                     'value': {'type': 'value', 'value': 2,
+                               'datatype': 'uint'}},
+                    {'action': 'update', 'index': 0, 'opId': f'3@{A2}',
+                     'value': {'type': 'value', 'value': 3,
+                               'datatype': 'uint'}}]}}}})
+        # opposite arrival order converges to the same conflict set
+        b2.apply_changes([encode_change(c1)])
+        assert b2.apply_changes([encode_change(c3)])['diffs']['props'][
+            'list'][f'1@{A1}']['edits'] == [
+            {'action': 'update', 'index': 0, 'opId': f'3@{A2}',
+             'value': {'type': 'value', 'value': 3, 'datatype': 'uint'}}]
+        assert b2.apply_changes([encode_change(c2)])['diffs']['props'][
+            'list'][f'1@{A1}']['edits'] == [
+            {'action': 'update', 'index': 0, 'opId': f'3@{A1}',
+             'value': {'type': 'value', 'value': 2, 'datatype': 'uint'}},
+            {'action': 'update', 'index': 0, 'opId': f'3@{A2}',
+             'value': {'type': 'value', 'value': 3, 'datatype': 'uint'}}]
+        assert b1.save() == b2.save()
+
+    def test_conflicts_introduced_by_single_change(self):
+        """(ref new_backend_test.js:1371)"""
+        A = 'f0e1d2c3'
+        c1 = {'actor': A, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeText', 'obj': '_root', 'key': 'text',
+             'insert': False, 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': '_head',
+             'insert': True, 'value': 'a', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': f'2@{A}',
+             'insert': True, 'value': 'b', 'pred': []}]}
+        c2 = {'actor': A, 'seq': 2, 'startOp': 4, 'time': 0,
+              'deps': [hash_of(c1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': f'2@{A}',
+             'insert': False, 'value': 'x', 'pred': [f'2@{A}']},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': f'2@{A}',
+             'insert': False, 'value': 'y', 'pred': [f'2@{A}']}]}
+        backend = OpSet()
+        assert backend.apply_changes([encode_change(c1)])['diffs']['props'][
+            'text'][f'1@{A}']['edits'] == [
+            {'action': 'multi-insert', 'index': 0, 'elemId': f'2@{A}',
+             'values': ['a', 'b']}]
+        assert backend.apply_changes([encode_change(c2)])['diffs']['props'][
+            'text'][f'1@{A}']['edits'] == [
+            {'action': 'update', 'index': 0, 'opId': f'4@{A}',
+             'value': {'type': 'value', 'value': 'x'}},
+            {'action': 'update', 'index': 0, 'opId': f'5@{A}',
+             'value': {'type': 'value', 'value': 'y'}}]
+
+    def test_conflict_on_multi_inserted_element(self):
+        """(ref new_backend_test.js:1437)"""
+        A = 'f0e1d2c3'
+        c1 = {'actor': A, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeText', 'obj': '_root', 'key': 'text',
+             'insert': False, 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': '_head',
+             'insert': True, 'value': 'a', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': f'2@{A}',
+             'insert': True, 'value': 'b', 'pred': []}]}
+        c2 = {'actor': A, 'seq': 2, 'startOp': 4, 'time': 0,
+              'deps': [hash_of(c1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': f'3@{A}',
+             'insert': False, 'value': 'x', 'pred': [f'3@{A}']},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': f'3@{A}',
+             'insert': False, 'value': 'y', 'pred': [f'3@{A}']}]}
+        backend = OpSet()
+        patch = backend.apply_changes([encode_change(c1), encode_change(c2)])
+        assert patch['diffs']['props']['text'][f'1@{A}']['edits'] == [
+            {'action': 'multi-insert', 'index': 0, 'elemId': f'2@{A}',
+             'values': ['a']},
+            {'action': 'insert', 'index': 1, 'elemId': f'3@{A}',
+             'opId': f'4@{A}', 'value': {'type': 'value', 'value': 'x'}},
+            {'action': 'update', 'index': 1, 'opId': f'5@{A}',
+             'value': {'type': 'value', 'value': 'y'}}]
+
+    def test_convert_inserts_to_updates(self):
+        """(ref new_backend_test.js:1482)"""
+        c1 = {'actor': A1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeText', 'obj': '_root', 'key': 'text',
+             'insert': False, 'pred': []},
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': '_head',
+             'insert': True, 'value': 'c', 'pred': []}]}
+        c2 = {'actor': A1, 'seq': 2, 'startOp': 3, 'time': 0,
+              'deps': [hash_of(c1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': '_head',
+             'insert': True, 'value': 'a', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': f'3@{A1}',
+             'insert': True, 'value': 'b', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': f'2@{A1}',
+             'insert': False, 'value': 'C', 'pred': [f'2@{A1}']}]}
+        c3 = {'actor': A2, 'seq': 1, 'startOp': 3, 'time': 0,
+              'deps': [hash_of(c1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': f'2@{A1}',
+             'insert': False, 'value': 'x', 'pred': [f'2@{A1}']},
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': f'2@{A1}',
+             'insert': False, 'value': 'y', 'pred': [f'2@{A1}']}]}
+        backend = OpSet()
+        patch = backend.apply_changes([encode_change(c1), encode_change(c2)])
+        assert patch['diffs']['props']['text'][f'1@{A1}']['edits'] == [
+            {'action': 'insert', 'index': 0, 'elemId': f'2@{A1}',
+             'opId': f'2@{A1}', 'value': {'type': 'value', 'value': 'c'}},
+            {'action': 'multi-insert', 'index': 0, 'elemId': f'3@{A1}',
+             'values': ['a', 'b']},
+            {'action': 'update', 'index': 2, 'opId': f'5@{A1}',
+             'value': {'type': 'value', 'value': 'C'}}]
+        patch = backend.apply_changes([encode_change(c3)])
+        assert patch['diffs']['props']['text'][f'1@{A1}']['edits'] == [
+            {'action': 'update', 'index': 2, 'opId': f'3@{A2}',
+             'value': {'type': 'value', 'value': 'x'}},
+            {'action': 'update', 'index': 2, 'opId': f'4@{A2}',
+             'value': {'type': 'value', 'value': 'y'}},
+            {'action': 'update', 'index': 2, 'opId': f'5@{A1}',
+             'value': {'type': 'value', 'value': 'C'}}]
+
+    def test_further_conflict_added_to_existing(self):
+        """(ref new_backend_test.js:1547)"""
+        c1 = {'actor': A1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeText', 'obj': '_root', 'key': 'text',
+             'insert': False, 'pred': []},
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': '_head',
+             'insert': True, 'value': 'a', 'pred': []}]}
+        c2 = {'actor': A1, 'seq': 2, 'startOp': 3, 'time': 0,
+              'deps': [hash_of(c1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': f'2@{A1}',
+             'insert': False, 'value': 'b', 'pred': [f'2@{A1}']},
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': f'2@{A1}',
+             'insert': False, 'value': 'c', 'pred': [f'2@{A1}']}]}
+        c3 = {'actor': A2, 'seq': 1, 'startOp': 3, 'time': 0,
+              'deps': [hash_of(c1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': f'2@{A1}',
+             'insert': False, 'value': 'x', 'pred': [f'2@{A1}']}]}
+        backend = OpSet()
+        patch = backend.apply_changes(
+            [encode_change(c) for c in (c1, c2, c3)])
+        assert patch == full_patch(
+            {A1: 2, A2: 1}, [hash_of(c2), hash_of(c3)], 4,
+            {'objectId': '_root', 'type': 'map', 'props': {'text': {f'1@{A1}': {
+                'objectId': f'1@{A1}', 'type': 'text', 'edits': [
+                    {'action': 'insert', 'index': 0, 'elemId': f'2@{A1}',
+                     'opId': f'3@{A1}',
+                     'value': {'type': 'value', 'value': 'b'}},
+                    {'action': 'update', 'index': 0, 'opId': f'3@{A2}',
+                     'value': {'type': 'value', 'value': 'x'}},
+                    {'action': 'update', 'index': 0, 'opId': f'4@{A1}',
+                     'value': {'type': 'value', 'value': 'c'}}]}}}})
+
+    def test_element_delete_and_overwrite_same_change(self):
+        """(ref new_backend_test.js:1611)"""
+        A = 'f0e1d2c3'
+        c1 = {'actor': A, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeText', 'obj': '_root', 'key': 'text',
+             'insert': False, 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': '_head',
+             'insert': True, 'value': 'a', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': f'2@{A}',
+             'insert': True, 'value': 'b', 'pred': []}]}
+        c2 = {'actor': A, 'seq': 2, 'startOp': 4, 'time': 0,
+              'deps': [hash_of(c1)], 'ops': [
+            {'action': 'del', 'obj': f'1@{A}', 'elemId': f'2@{A}',
+             'insert': False, 'pred': [f'2@{A}']},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': f'3@{A}',
+             'insert': False, 'value': 'x', 'pred': [f'3@{A}']}]}
+        backend = OpSet()
+        patch = backend.apply_changes([encode_change(c1), encode_change(c2)])
+        assert patch['diffs']['props']['text'][f'1@{A}']['edits'] == [
+            {'action': 'multi-insert', 'index': 0, 'elemId': f'2@{A}',
+             'values': ['a', 'b']},
+            {'action': 'remove', 'index': 0, 'count': 1},
+            {'action': 'update', 'index': 0, 'opId': f'5@{A}',
+             'value': {'type': 'value', 'value': 'x'}}]
+
+    def test_concurrent_delete_and_assign_list_element(self):
+        """(ref new_backend_test.js:1660): the concurrent set survives the
+        delete (resurrection)."""
+        c1 = {'actor': A1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'list',
+             'insert': False, 'pred': []},
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': '_head',
+             'insert': True, 'datatype': 'uint', 'value': 1, 'pred': []}]}
+        c2 = {'actor': A1, 'seq': 2, 'startOp': 3, 'time': 0,
+              'deps': [hash_of(c1)], 'ops': [
+            {'action': 'del', 'obj': f'1@{A1}', 'elemId': f'2@{A1}',
+             'insert': False, 'pred': [f'2@{A1}']}]}
+        c3 = {'actor': A2, 'seq': 1, 'startOp': 3, 'time': 0,
+              'deps': [hash_of(c1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{A1}', 'elemId': f'2@{A1}',
+             'insert': False, 'datatype': 'uint', 'value': 2,
+             'pred': [f'2@{A1}']}]}
+        b1, b2 = OpSet(), OpSet()
+        patch = b1.apply_changes([encode_change(c1), encode_change(c2)])
+        assert patch['diffs']['props']['list'][f'1@{A1}']['edits'] == [
+            {'action': 'insert', 'index': 0, 'elemId': f'2@{A1}',
+             'opId': f'2@{A1}',
+             'value': {'type': 'value', 'value': 1, 'datatype': 'uint'}},
+            {'action': 'remove', 'index': 0, 'count': 1}]
+        patch = b1.apply_changes([encode_change(c3)])
+        assert patch['diffs']['props']['list'][f'1@{A1}']['edits'] == [
+            {'action': 'insert', 'index': 0, 'elemId': f'2@{A1}',
+             'opId': f'3@{A2}',
+             'value': {'type': 'value', 'value': 2, 'datatype': 'uint'}}]
+        # opposite order: assignment first, then the delete arrives
+        b2.apply_changes([encode_change(c1), encode_change(c3)])
+        patch = b2.apply_changes([encode_change(c2)])
+        assert patch['diffs']['props']['list'][f'1@{A1}']['edits'] == [
+            {'action': 'insert', 'index': 0, 'elemId': f'2@{A1}',
+             'opId': f'3@{A2}',
+             'value': {'type': 'value', 'value': 2, 'datatype': 'uint'}}]
+        assert b1.save() == b2.save()
+
+    def test_updates_inside_conflicted_properties(self):
+        """(ref new_backend_test.js:1736)"""
+        c1 = {'actor': A1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeMap', 'obj': '_root', 'key': 'map', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A1}', 'key': 'x',
+             'datatype': 'uint', 'value': 1, 'pred': []}]}
+        c2 = {'actor': A2, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeMap', 'obj': '_root', 'key': 'map', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A2}', 'key': 'y',
+             'datatype': 'uint', 'value': 2, 'pred': []}]}
+        c3 = {'actor': A1, 'seq': 2, 'startOp': 3, 'time': 0,
+              'deps': [hash_of(c1), hash_of(c2)], 'ops': [
+            {'action': 'set', 'obj': f'1@{A1}', 'key': 'x',
+             'datatype': 'uint', 'value': 3, 'pred': [f'2@{A1}']}]}
+        backend = OpSet()
+        assert backend.apply_changes([encode_change(c1)]) == full_patch(
+            {A1: 1}, [hash_of(c1)], 2,
+            {'objectId': '_root', 'type': 'map', 'props': {'map': {
+                f'1@{A1}': {'objectId': f'1@{A1}', 'type': 'map',
+                            'props': {'x': {f'2@{A1}': {
+                                'type': 'value', 'value': 1,
+                                'datatype': 'uint'}}}}}}})
+        assert backend.apply_changes([encode_change(c2)]) == full_patch(
+            {A1: 1, A2: 1}, [hash_of(c1), hash_of(c2)], 2,
+            {'objectId': '_root', 'type': 'map', 'props': {'map': {
+                f'1@{A1}': {'objectId': f'1@{A1}', 'type': 'map',
+                            'props': {}},
+                f'1@{A2}': {'objectId': f'1@{A2}', 'type': 'map',
+                            'props': {'y': {f'2@{A2}': {
+                                'type': 'value', 'value': 2,
+                                'datatype': 'uint'}}}}}}})
+        assert backend.apply_changes([encode_change(c3)]) == full_patch(
+            {A1: 2, A2: 1}, [hash_of(c3)], 3,
+            {'objectId': '_root', 'type': 'map', 'props': {'map': {
+                f'1@{A1}': {'objectId': f'1@{A1}', 'type': 'map',
+                            'props': {'x': {f'3@{A1}': {
+                                'type': 'value', 'value': 3,
+                                'datatype': 'uint'}}}},
+                f'1@{A2}': {'objectId': f'1@{A2}', 'type': 'map',
+                            'props': {}}}}})
+
+    def test_conflict_of_nested_object_and_value(self):
+        """(ref new_backend_test.js:1798)"""
+        c1 = {'actor': A1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeMap', 'obj': '_root', 'key': 'x', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A1}', 'key': 'y',
+             'datatype': 'uint', 'value': 2, 'pred': []}]}
+        c2 = {'actor': A2, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'set', 'obj': '_root', 'key': 'x',
+             'datatype': 'uint', 'value': 1, 'pred': []}]}
+        c3 = {'actor': A1, 'seq': 2, 'startOp': 3, 'time': 0,
+              'deps': [hash_of(c1), hash_of(c2)], 'ops': [
+            {'action': 'set', 'obj': f'1@{A1}', 'key': 'y',
+             'datatype': 'uint', 'value': 3, 'pred': [f'2@{A1}']}]}
+        backend = OpSet()
+        backend.apply_changes([encode_change(c1)])
+        assert backend.apply_changes([encode_change(c2)]) == full_patch(
+            {A1: 1, A2: 1}, [hash_of(c1), hash_of(c2)], 2,
+            {'objectId': '_root', 'type': 'map', 'props': {'x': {
+                f'1@{A1}': {'objectId': f'1@{A1}', 'type': 'map',
+                            'props': {}},
+                f'1@{A2}': {'type': 'value', 'value': 1,
+                            'datatype': 'uint'}}}})
+        assert backend.apply_changes([encode_change(c3)]) == full_patch(
+            {A1: 2, A2: 1}, [hash_of(c3)], 3,
+            {'objectId': '_root', 'type': 'map', 'props': {'x': {
+                f'1@{A1}': {'objectId': f'1@{A1}', 'type': 'map',
+                            'props': {'y': {f'3@{A1}': {
+                                'type': 'value', 'value': 3,
+                                'datatype': 'uint'}}}},
+                f'1@{A2}': {'type': 'value', 'value': 1,
+                            'datatype': 'uint'}}}})
+
+
+class TestUnknownColumns:
+    def test_unknown_columns_actions_datatypes(self):
+        """Forward compatibility: a change holding unknown columns, an
+        unknown action (17), and an unknown value datatype (14) must apply
+        and round-trip (ref new_backend_test.js:1857)."""
+        change = bytes([
+            0x85, 0x6f, 0x4a, 0x83,            # magic bytes
+            0xad, 0xfb, 0x1a, 0x69,            # checksum
+            1, 51, 0, 2, 0x12, 0x34,           # change chunk, len, deps, actor
+            1, 1, 0, 0,                        # seq, startOp, time, message
+            0, 9,                              # other actors, column count
+            0x15, 3, 0x34, 1, 0x42, 2,         # keyStr, insert, action
+            0x56, 2, 0x57, 4, 0x70, 2,         # valLen, valRaw, predNum
+            0xf0, 1, 2, 0xf1, 1, 2, 0xf3, 1, 2,  # unknown column group
+            0x7f, 1, 0x78,                     # keyStr: 'x'
+            1,                                 # insert: false
+            0x7f, 17,                          # unknown action 17
+            0x7f, 0x4e,                        # valLen: 4 bytes of type 14
+            1, 2, 3, 4,                        # valRaw
+            0x7f, 0,                           # predNum: 0
+            0x7f, 2,                           # unknown group cardinality
+            2, 0,                              # unknown actor column
+            2, 1])                             # unknown delta column
+        backend = OpSet()
+        patch = backend.apply_changes([change])
+        assert patch == full_patch(
+            {'1234': 1}, [decode_change(change)['hash']], 1,
+            {'objectId': '_root', 'type': 'map', 'props': {'x': {}}})
+        # the unknown columns survive a save/load round trip
+        reloaded = OpSet(backend.save())
+        assert reloaded.get_patch()['clock'] == {'1234': 1}
+
+
+class TestLongSequences:
+    """Long-insertion behavior (ref new_backend_test.js:1907-2193). The
+    reference asserts its MAX_BLOCK_SIZE=600 block split internals; our
+    engine blocks at op_set._BLOCK_SIZE=256 — these tests assert the
+    observable behavior (patches, indexes) across our block boundaries."""
+
+    def _long_insert_change(self, actor, n):
+        ops = [{'action': 'makeText', 'obj': '_root', 'key': 'text',
+                'insert': False, 'pred': []},
+               {'action': 'set', 'obj': f'1@{actor}', 'elemId': '_head',
+                'insert': True, 'value': 'a', 'pred': []}]
+        for i in range(2, n + 1):
+            ops.append({'action': 'set', 'obj': f'1@{actor}',
+                        'elemId': f'{i}@{actor}', 'insert': True,
+                        'value': 'a', 'pred': []})
+        return {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0,
+                'deps': [], 'ops': ops}
+
+    def test_long_insertion_splits_blocks(self):
+        from automerge_tpu.backend.op_set import _BLOCK_SIZE
+        A = 'f0e1d2c3'
+        n = _BLOCK_SIZE + 64
+        backend = OpSet()
+        patch = backend.apply_changes(
+            [encode_change(self._long_insert_change(A, n))])
+        edits = patch['diffs']['props']['text'][f'1@{A}']['edits']
+        assert len(edits) == 1
+        assert edits[0]['action'] == 'multi-insert'
+        assert len(edits[0]['values']) == n
+        assert len(backend.objects[f'1@{A}'].blocks) >= 2
+
+    def test_short_insertions_split_blocks(self):
+        from automerge_tpu.backend.op_set import _BLOCK_SIZE
+        A = 'f0e1d2c3'
+        backend = OpSet()
+        c1 = {'actor': A, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeText', 'obj': '_root', 'key': 'text',
+             'insert': False, 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': '_head',
+             'insert': True, 'value': 'a', 'pred': []}]}
+        backend.apply_changes([encode_change(c1)])
+        n = _BLOCK_SIZE + 8
+        for i in range(2, n + 1):
+            c = {'actor': A, 'seq': i, 'startOp': i + 1, 'time': 0,
+                 'deps': list(backend.heads), 'ops': [
+                {'action': 'set', 'obj': f'1@{A}', 'elemId': f'{i}@{A}',
+                 'insert': True, 'value': 'a', 'pred': []}]}
+            patch = backend.apply_changes([encode_change(c)])
+            assert patch['diffs']['props']['text'][f'1@{A}']['edits'] == [
+                {'action': 'insert', 'index': i - 1,
+                 'elemId': f'{i + 1}@{A}', 'opId': f'{i + 1}@{A}',
+                 'value': {'type': 'value', 'value': 'a'}}]
+        assert len(backend.objects[f'1@{A}'].blocks) >= 2
+
+    def test_delete_many_consecutive_characters(self):
+        from automerge_tpu.backend.op_set import _BLOCK_SIZE
+        A = 'f0e1d2c3'
+        n = _BLOCK_SIZE + 32
+        backend = OpSet()
+        backend.apply_changes(
+            [encode_change(self._long_insert_change(A, n))])
+        ops = [{'action': 'del', 'obj': f'1@{A}', 'elemId': f'{i}@{A}',
+                'insert': False, 'pred': [f'{i}@{A}']}
+               for i in range(2, n + 2)]
+        c2 = {'actor': A, 'seq': 2, 'startOp': n + 3, 'time': 0,
+              'deps': [], 'ops': ops}
+        patch = backend.apply_changes([encode_change(c2)])
+        assert patch['diffs']['props']['text'][f'1@{A}']['edits'] == [
+            {'action': 'remove', 'index': 0, 'count': n}]
+
+    def test_update_object_after_long_text(self):
+        """An object sorted after a long text object stays addressable
+        (ref new_backend_test.js:2063)."""
+        from automerge_tpu.backend.op_set import _BLOCK_SIZE
+        A = 'f0e1d2c3'
+        n = _BLOCK_SIZE + 16
+        ops = [{'action': 'makeText', 'obj': '_root', 'key': 'text1',
+                'insert': False, 'pred': []},
+               {'action': 'makeText', 'obj': '_root', 'key': 'text2',
+                'insert': False, 'pred': []},
+               {'action': 'set', 'obj': f'2@{A}', 'elemId': '_head',
+                'insert': True, 'value': 'x', 'pred': []},
+               {'action': 'set', 'obj': f'1@{A}', 'elemId': '_head',
+                'insert': True, 'value': 'a', 'pred': []}]
+        for i in range(4, n + 1):
+            ops.append({'action': 'set', 'obj': f'1@{A}',
+                        'elemId': f'{i}@{A}', 'insert': True, 'value': 'a',
+                        'pred': []})
+        c1 = {'actor': A, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [],
+              'ops': ops}
+        c2 = {'actor': A, 'seq': 2, 'startOp': n + 3, 'time': 0, 'deps': [],
+              'ops': [{'action': 'set', 'obj': f'2@{A}',
+                       'elemId': f'3@{A}', 'insert': True, 'value': 'x',
+                       'pred': []}]}
+        backend = OpSet()
+        backend.apply_changes([encode_change(c1)])
+        patch = backend.apply_changes([encode_change(c2)])
+        assert patch['diffs']['props'] == {'text2': {f'2@{A}': {
+            'objectId': f'2@{A}', 'type': 'text', 'edits': [{
+                'action': 'insert', 'index': 1,
+                'opId': f'{n + 3}@{A}', 'elemId': f'{n + 3}@{A}',
+                'value': {'type': 'value', 'value': 'x'}}]}}}
+
+    def test_root_ops_with_long_text_in_same_change(self):
+        """Root-map ops mixed into a long text change apply correctly
+        (ref new_backend_test.js:2090)."""
+        from automerge_tpu.backend.op_set import _BLOCK_SIZE
+        A = 'f0e1d2c3'
+        n = _BLOCK_SIZE + 16
+        change = self._long_insert_change(A, n)
+        change['ops'].append({'action': 'set', 'obj': '_root', 'key': 'z',
+                              'insert': False, 'value': 'zzz', 'pred': []})
+        backend = OpSet()
+        patch = backend.apply_changes([encode_change(change)])
+        assert patch['diffs']['props']['z'] == {
+            f'{n + 2}@{A}': {'type': 'value', 'value': 'zzz'}}
+        reloaded = OpSet(backend.save())
+        assert reloaded.save() == backend.save()
