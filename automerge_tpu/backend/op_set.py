@@ -401,6 +401,8 @@ class OpSet(HashGraph):
             record['datatype'] = op['datatype']
         if op.get('child') is not None:
             record['child'] = op['child']
+        if op.get('unknownCols'):
+            record['unknownCols'] = op['unknownCols']
         if obj.is_seq:
             # Keep the original reference elemId (needed to serialize the
             # document's keyActor/keyCtr columns); the element's own id is
@@ -735,6 +737,8 @@ class OpSet(HashGraph):
                             op['datatype'] = row['datatype']
                         if 'child' in row:
                             op['child'] = row['child']
+                        if 'unknownCols' in row:
+                            op['unknownCols'] = row['unknownCols']
                         ops.append(op)
             else:
                 for key in sorted(obj.keys.keys(), key=_utf16_key):
@@ -748,17 +752,65 @@ class OpSet(HashGraph):
                             op['datatype'] = row['datatype']
                         if 'child' in row:
                             op['child'] = row['child']
+                        if 'unknownCols' in row:
+                            op['unknownCols'] = row['unknownCols']
                         ops.append(op)
         return ops
 
+    def _canonical_change_order(self):
+        """Deterministic topological order over the applied changes, so that
+        converged replicas serialize byte-identical documents regardless of
+        the order changes arrived. The reference serializes in application
+        order and leaves canonicalization as a TODO (new.js:2048); we order by
+        a Kahn traversal with ties broken on change hash, adding implicit
+        per-actor seq edges so actors' changes stay seq-ascending (required by
+        the document decoder, columnar.js:876-905). Returns (order,
+        hash_by_index) where `order` lists original change indexes."""
+        import heapq
+        self._ensure_graph()
+        n = len(self.changes_meta)
+        hash_by_index = [None] * n
+        for h, i in self.change_index_by_hash.items():
+            hash_by_index[i] = h
+        children = [[] for _ in range(n)]
+        indegree = [0] * n
+        for i, meta in enumerate(self.changes_meta):
+            for dep in meta['deps']:
+                children[self.change_index_by_hash[dep]].append(i)
+                indegree[i] += 1
+        by_actor = {}
+        for i, meta in enumerate(self.changes_meta):
+            by_actor.setdefault(meta['actor'], []).append(i)
+        for idxs in by_actor.values():
+            idxs.sort(key=lambda i: self.changes_meta[i]['seq'])
+            for a, b in zip(idxs, idxs[1:]):
+                children[a].append(b)
+                indegree[b] += 1
+        heap = [(hash_by_index[i], i) for i in range(n) if indegree[i] == 0]
+        heapq.heapify(heap)
+        order = []
+        while heap:
+            _, i = heapq.heappop(heap)
+            order.append(i)
+            for child in children[i]:
+                indegree[child] -= 1
+                if indegree[child] == 0:
+                    heapq.heappush(heap, (hash_by_index[child], child))
+        return order, hash_by_index
+
     def save(self):
-        """Serialize to the document container format (ref new.js:2033-2055)."""
+        """Serialize to the document container format (ref new.js:2033-2055).
+        Unlike the reference, the encoding is canonical: changes are sorted
+        into a deterministic topological order and the actor table is sorted,
+        so converged replicas produce identical bytes."""
         if self.binary_doc:
             return self.binary_doc
         doc_ops = self._document_ops()
-        # Re-encode ops with parsed ids against our actor table
+        order, hash_by_index = self._canonical_change_order()
+        canonical_index = {hash_by_index[old]: pos for pos, old in enumerate(order)}
+        doc_actor_ids = sorted(self.actor_ids)
+        actor_index = {actor: i for i, actor in enumerate(doc_actor_ids)}
         from ..columnar import ParsedOpId
-        actor_index = {actor: i for i, actor in enumerate(self.actor_ids)}
 
         def parse(op_id_str):
             ctr, actor = parse_op_id(op_id_str)
@@ -775,25 +827,26 @@ class OpSet(HashGraph):
             if parsed.get('child') is not None:
                 parsed['child'] = parse(parsed['child'])
             parsed_ops.append(parsed)
-        ops_columns = encode_ops(parsed_ops, True)
+        ops_columns = encode_ops(parsed_ops, True, actor_index)
 
-        changes_columns = self._encode_changes_columns()
+        changes_columns = self._encode_changes_columns(order, actor_index,
+                                                       canonical_index)
         self.binary_doc = encode_document_header({
             'changesColumns': changes_columns,
             'opsColumns': ops_columns,
-            'actorIds': self.actor_ids,
+            'actorIds': doc_actor_ids,
             'heads': list(self.heads),
-            'headsIndexes': [self.change_index_by_hash[h] for h in sorted(self.heads)],
+            'headsIndexes': [canonical_index[h] for h in sorted(self.heads)],
             'extraBytes': self.extra_bytes,
         })
         return self.binary_doc
 
-    def _encode_changes_columns(self):
+    def _encode_changes_columns(self, order, actor_index, canonical_index):
         columns = {name: encoder_by_column_id(cid) for name, cid in DOCUMENT_COLUMNS
                    if (cid & 7) != 7}
         val_raw = encoding.Encoder()
-        actor_index = {actor: i for i, actor in enumerate(self.actor_ids)}
-        for meta in self.changes_meta:
+        for i in order:
+            meta = self.changes_meta[i]
             columns['actor'].append_value(actor_index[meta['actor']])
             columns['seq'].append_value(meta['seq'])
             columns['maxOp'].append_value(meta['maxOp'])
@@ -802,7 +855,7 @@ class OpSet(HashGraph):
             deps = sorted(meta['deps'])
             columns['depsNum'].append_value(len(deps))
             for dep in deps:
-                columns['depsIndex'].append_value(self.change_index_by_hash[dep])
+                columns['depsIndex'].append_value(canonical_index[dep])
             extra = meta.get('extraBytes')
             if extra:
                 num = val_raw.append_raw_bytes(extra)

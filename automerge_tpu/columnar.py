@@ -166,6 +166,16 @@ def expand_multi_ops(ops, start_op, actor):
     return expanded
 
 
+def _collect_unknown_actors(cid, value, actors):
+    """Actor-id strings inside unknown columns must be in the actor table."""
+    if cid % 8 == COLUMN_TYPE['ACTOR_ID'] and isinstance(value, str):
+        actors.add(value)
+    elif isinstance(value, list):
+        for item in value:
+            for inner_cid, inner_value in item.items():
+                _collect_unknown_actors(inner_cid, inner_value, actors)
+
+
 def parse_all_op_ids(changes, single):
     """Replace string opIds in `changes` with ParsedOpId objects and return
     (parsed_changes, actor_ids) (ref columnar.js:133-170)."""
@@ -184,6 +194,8 @@ def parse_all_op_ids(changes, single):
                 actors.add(parse_op_id(op['child'])[1])
             for pred in op.get('pred', []):
                 actors.add(parse_op_id(pred)[1])
+            for cid, value in op.get('unknownCols', {}).items():
+                _collect_unknown_actors(cid, value, actors)
         new_changes.append(change)
 
     actor_ids = sorted(actors)
@@ -251,7 +263,8 @@ def encode_value_to_columns(op, val_len, val_raw):
     """Encode op's value into the valLen/valRaw column pair (ref columnar.js:259-292)."""
     value = op.get('value')
     datatype = op.get('datatype')
-    if op['action'] not in ('set', 'inc') or value is None:
+    action = op['action']
+    if (action not in ('set', 'inc') and not isinstance(action, int)) or value is None:
         val_len.append_value(VALUE_TYPE['NULL'])
     elif value is False:
         val_len.append_value(VALUE_TYPE['FALSE'])
@@ -321,9 +334,79 @@ def decode_value(size_tag, data):
     return {'value': bytes(data), 'datatype': tag}
 
 
-def encode_ops(ops, for_document):
+def _unknown_column_plan(ops):
+    """Collect unknown column ids across ops: returns (groups, standalone)
+    where `groups` maps a GROUP_CARD column id to the set of inner column ids
+    observed in its items."""
+    groups = {}
+    standalone = set()
+    for op in ops:
+        for cid, value in op.get('unknownCols', {}).items():
+            if cid % 8 == COLUMN_TYPE['GROUP_CARD']:
+                inner = groups.setdefault(cid, set())
+                if isinstance(value, list):
+                    for item in value:
+                        inner.update(item.keys())
+            else:
+                standalone.add(cid)
+    return groups, standalone
+
+
+def _append_unknown_scalar(encoders, cid, value, actor_lookup):
+    """Append one op's value for an unknown column, re-normalizing actor
+    strings to table indexes and value dicts to valLen/valRaw pairs."""
+    enc = encoders[cid]
+    t = cid & 7
+    if t == COLUMN_TYPE['VALUE_LEN']:
+        entry = value if isinstance(value, dict) else {'value': value}
+        encode_value_to_columns({'action': 'set', 'value': entry.get('value'),
+                                 'datatype': entry.get('datatype')},
+                                enc, encoders[cid + 1])
+    elif t == COLUMN_TYPE['ACTOR_ID'] and value is not None and \
+            actor_lookup is not None and isinstance(value, str):
+        enc.append_value(actor_lookup[value])
+    else:
+        enc.append_value(value)
+
+
+def _encode_unknown_columns(ops, actor_lookup):
+    """Build encoders for unknown forward-compat columns so they survive
+    re-encoding (the reference carries them in its raw block store instead,
+    new_backend_test.js:1857). Returns a list of (column_id, name, encoder)."""
+    groups, standalone = _unknown_column_plan(ops)
+    if not groups and not standalone:
+        return []
+    all_ids = set(standalone) | set(groups)
+    for inner in groups.values():
+        all_ids |= inner
+    encoders = {}
+    for cid in sorted(all_ids):
+        encoders[cid] = encoder_by_column_id(cid)
+        if cid % 8 == COLUMN_TYPE['VALUE_LEN'] and cid + 1 not in encoders:
+            encoders[cid + 1] = Encoder()
+    standalone_order = sorted(standalone)
+    group_order = [(gid, sorted(inner)) for gid, inner in sorted(groups.items())]
+    for op in ops:
+        ucols = op.get('unknownCols', {})
+        for cid in standalone_order:
+            _append_unknown_scalar(encoders, cid, ucols.get(cid), actor_lookup)
+        for gid, inner_order in group_order:
+            items = ucols.get(gid)
+            if items is None:
+                encoders[gid].append_value(None)
+                continue
+            encoders[gid].append_value(len(items))
+            for item in items:
+                for cid in inner_order:
+                    _append_unknown_scalar(encoders, cid, item.get(cid), actor_lookup)
+    return [(cid, f'col_{cid}', enc) for cid, enc in encoders.items()]
+
+
+def encode_ops(ops, for_document, actor_lookup=None):
     """Encode parsed ops into columns; returns a sorted list of
-    (column_id, column_name, encoder) (ref columnar.js:370-436)."""
+    (column_id, column_name, encoder) (ref columnar.js:370-436).
+    `actor_lookup` maps actor id strings to table indexes for re-encoding
+    unknown actor-type columns."""
     columns = {
         'objActor': RLEEncoder('uint'), 'objCtr': RLEEncoder('uint'),
         'keyActor': RLEEncoder('uint'), 'keyCtr': DeltaEncoder(),
@@ -373,6 +456,7 @@ def encode_ops(ops, for_document):
     spec = DOC_OPS_COLUMNS if for_document else CHANGE_COLUMNS
     column_list = [(column_id, name, columns[name])
                    for name, column_id in spec if name in columns]
+    column_list.extend(_encode_unknown_columns(ops, actor_lookup))
     return sorted(column_list, key=lambda c: c[0])
 
 
@@ -476,9 +560,16 @@ def decode_columns(columns, actor_ids, column_spec):
                     columns[col + group_cols]['columnId'] >> 4 == group_id:
                 group_cols += 1
             if column_id % 8 == COLUMN_TYPE['GROUP_CARD']:
-                count = columns[col]['decoder'].read_value() or 0
+                count = columns[col]['decoder'].read_value()
+                # Distinguish null from 0 for unknown group columns so a
+                # re-encode reproduces the original bytes; known group columns
+                # keep the reference's null->[] behavior (columnar.js:590-598)
+                if count is None and 'columnName' not in columns[col]:
+                    row[f'col_{column_id}'] = None
+                    col += group_cols
+                    continue
                 values = []
-                for _ in range(count):
+                for _ in range(count or 0):
                     value = {}
                     for off in range(1, group_cols):
                         _decode_value_columns(columns, col + off, actor_ids, value)
@@ -492,7 +583,13 @@ def decode_columns(columns, actor_ids, column_spec):
 
 
 def decode_ops(rows, for_document):
-    """Convert decoded column rows into op dicts (ref columnar.js:483-510)."""
+    """Convert decoded column rows into op dicts (ref columnar.js:483-510).
+
+    Beyond the reference: unknown columns (decoded under `col_<id>` keys) and
+    the values of unknown actions are preserved on the op under 'unknownCols'
+    / 'value', so that a document save/load round-trip reproduces the original
+    change bytes (and hence hashes) even for forward-compatibility data the
+    engine doesn't understand."""
     ops = []
     for row in rows:
         obj = '_root' if row['objCtr'] is None else f"{row['objCtr']}@{row['objActor']}"
@@ -511,10 +608,13 @@ def decode_ops(rows, for_document):
         else:
             op['key'] = row['keyStr']
         op['insert'] = bool(row['insert'])
-        if action in ('set', 'inc'):
+        if action in ('set', 'inc') or isinstance(action, int):
             op['value'] = row['valLen']
             if row.get('valLen_datatype') is not None:
                 op['datatype'] = row['valLen_datatype']
+        unknown = _collect_unknown_columns(row)
+        if unknown:
+            op['unknownCols'] = unknown
         if (row.get('chldCtr') is None) != (row.get('chldActor') is None):
             raise ValueError(
                 f"Mismatched child columns: {row.get('chldCtr')} and {row.get('chldActor')}")
@@ -529,6 +629,29 @@ def decode_ops(rows, for_document):
             _check_sorted_op_ids([(p['predCtr'], p['predActor']) for p in row['predNum']])
         ops.append(op)
     return ops
+
+
+def _collect_unknown_columns(row):
+    """Gather `col_<id>` entries from a decoded row into {column_id: value}.
+    Unknown VALUE_LEN columns become {'value':..., 'datatype':...} dicts;
+    unknown group columns keep their list-of-dicts shape with the inner dicts
+    normalized recursively."""
+    unknown = {}
+    for k in row:
+        if not k.startswith('col_') or k.endswith('_datatype'):
+            continue
+        column_id = int(k[4:])
+        value = row[k]
+        if column_id % 8 == COLUMN_TYPE['VALUE_LEN']:
+            entry = {'value': value}
+            if row.get(k + '_datatype') is not None:
+                entry['datatype'] = row[k + '_datatype']
+            unknown[column_id] = entry
+        elif isinstance(value, list) and column_id % 8 == COLUMN_TYPE['GROUP_CARD']:
+            unknown[column_id] = [_collect_unknown_columns(item) for item in value]
+        else:
+            unknown[column_id] = value
+    return unknown
 
 
 def _check_sorted_op_ids(keys):
@@ -630,7 +753,8 @@ def encode_change(change_obj):
     body.append_uint53(len(actor_ids) - 1)
     for actor in actor_ids[1:]:
         body.append_hex_string(actor)
-    columns = materialize_columns(encode_ops(change['ops'], False))
+    columns = materialize_columns(encode_ops(
+        change['ops'], False, {a: i for i, a in enumerate(actor_ids)}))
     encode_column_info(body, columns)
     for _cid, _name, buf in columns:
         body.append_raw_bytes(buf)

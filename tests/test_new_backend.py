@@ -656,12 +656,13 @@ class TestConflictShapes:
             {'action': 'insert', 'index': 0, 'elemId': f'2@{A1}',
              'opId': f'3@{A2}',
              'value': {'type': 'value', 'value': 2, 'datatype': 'uint'}}]
-        # opposite order: assignment first, then the delete arrives
+        # opposite order: assignment first, then the delete arrives. The
+        # element stays visible through the set op, so the patch is an update
+        # (ref new_backend_test.js:1698-1707), not a re-insert.
         b2.apply_changes([encode_change(c1), encode_change(c3)])
         patch = b2.apply_changes([encode_change(c2)])
         assert patch['diffs']['props']['list'][f'1@{A1}']['edits'] == [
-            {'action': 'insert', 'index': 0, 'elemId': f'2@{A1}',
-             'opId': f'3@{A2}',
+            {'action': 'update', 'index': 0, 'opId': f'3@{A2}',
              'value': {'type': 'value', 'value': 2, 'datatype': 'uint'}}]
         assert b1.save() == b2.save()
 
