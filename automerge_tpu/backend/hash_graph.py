@@ -92,7 +92,7 @@ class HashGraph:
         for entry in self._deferred:
             if len(entry) == 3:
                 index, batch, i = entry
-                if isinstance(i, (list, tuple)):
+                if isinstance(i, (list, tuple, range)):
                     # One record covering a run of log entries [index, ...)
                     for off, j in enumerate(i):
                         record(index + off, *batch.resolve(int(j)))

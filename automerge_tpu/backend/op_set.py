@@ -808,9 +808,16 @@ class OpSet(HashGraph):
         doc_ops = self._document_ops()
         order, hash_by_index = self._canonical_change_order()
         canonical_index = {hash_by_index[old]: pos for pos, old in enumerate(order)}
-        doc_actor_ids = sorted(self.actor_ids)
+        # Unknown ACTOR_ID columns may reference actors that never authored a
+        # change; they still need actor-table entries (cf. the change-encode
+        # path's _collect_unknown_actors use in parse_all_op_ids)
+        from ..columnar import ParsedOpId, _collect_unknown_actors
+        doc_actor_set = set(self.actor_ids)
+        for op in doc_ops:
+            for cid, value in op.get('unknownCols', {}).items():
+                _collect_unknown_actors(cid, value, doc_actor_set)
+        doc_actor_ids = sorted(doc_actor_set)
         actor_index = {actor: i for i, actor in enumerate(doc_actor_ids)}
-        from ..columnar import ParsedOpId
 
         def parse(op_id_str):
             ctr, actor = parse_op_id(op_id_str)
@@ -886,5 +893,6 @@ class OpSet(HashGraph):
                 changes.append(chunk)
         if changes:
             self.apply_changes(changes)
-        if len(chunks) == 1 and chunks[0][8] == CHUNK_TYPE_DOCUMENT:
-            self.binary_doc = buffer
+        # Deliberately NOT caching `buffer` as binary_doc: save() promises a
+        # canonical encoding, and a loaded document's bytes may be a foreign
+        # (application-order) encoding that converged replicas would not share

@@ -77,6 +77,11 @@ DOC_OPS_COLUMNS = COMMON_COLUMNS + [
     ('succCtr',   8 << 4 | COLUMN_TYPE['INT_DELTA']),
 ]
 
+# Column ids valid only inside the document container (the succ group):
+# change containers treating them as "unknown" would collide on save
+_DOC_RESERVED_COLUMN_IDS = \
+    {cid for _, cid in DOC_OPS_COLUMNS} - {cid for _, cid in CHANGE_COLUMNS}
+
 DOCUMENT_COLUMNS = [
     ('actor',     0 << 4 | COLUMN_TYPE['ACTOR_ID']),
     ('seq',       0 << 4 | COLUMN_TYPE['INT_DELTA']),
@@ -548,6 +553,11 @@ def _decode_value_columns(columns, col_index, actor_ids, result):
 def decode_columns(columns, actor_ids, column_spec):
     """Decode columns into a list of row dicts (ref columnar.js:577-607)."""
     columns = make_decoders(columns, column_spec)
+    # Duplicate column ids make the row scan ambiguous (a duplicate group
+    # member is never drained, spinning the scan forever): reject up front.
+    ids = [c['columnId'] for c in columns]
+    if len(set(ids)) != len(ids):
+        raise ValueError('duplicate column id in columns')
     rows = []
     while any(not c['decoder'].done for c in columns):
         row = {}
@@ -571,8 +581,10 @@ def decode_columns(columns, actor_ids, column_spec):
                 values = []
                 for _ in range(count or 0):
                     value = {}
-                    for off in range(1, group_cols):
-                        _decode_value_columns(columns, col + off, actor_ids, value)
+                    off = 1
+                    while off < group_cols:
+                        off += _decode_value_columns(columns, col + off,
+                                                     actor_ids, value)
                     values.append(value)
                 row[columns[col].get('columnName', f'col_{column_id}')] = values
                 col += group_cols
@@ -614,6 +626,15 @@ def decode_ops(rows, for_document):
                 op['datatype'] = row['valLen_datatype']
         unknown = _collect_unknown_columns(row)
         if unknown:
+            if not for_document:
+                # Change-container columns in the document succ group would
+                # collide with the succ columns the document container adds
+                # on save, making the saved document undecodable
+                bad = sorted(set(unknown) & _DOC_RESERVED_COLUMN_IDS)
+                if bad:
+                    raise ValueError(
+                        f'change column id {bad[0]} is reserved for the '
+                        f'document container')
             op['unknownCols'] = unknown
         if (row.get('chldCtr') is None) != (row.get('chldActor') is None):
             raise ValueError(

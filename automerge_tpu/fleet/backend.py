@@ -1392,18 +1392,25 @@ def _apply_changes_turbo(handles, per_doc_changes):
         return None
 
     flat_buffers, change_doc = [], []
-    per_doc_idx = [None] * len(handles)
+    per_doc_idx = [None] * len(handles)   # (start, stop) contiguous runs
     for d, changes in enumerate(per_doc_changes):
         k = len(flat_buffers)
-        flat_buffers += [bytes(b) for b in changes]
-        per_doc_idx[d] = list(range(k, len(flat_buffers)))
+        if not isinstance(changes, (list, tuple)):
+            changes = list(changes)   # one-shot iterables: materialize once
+        flat_buffers += changes if all(type(b) is bytes for b in changes) \
+            else [bytes(b) for b in changes]
+        per_doc_idx[d] = (k, len(flat_buffers))
         change_doc += [d] * (len(flat_buffers) - k)
     n_changes = len(flat_buffers)
     if not n_changes:
         return handles, [None] * len(handles)
+    blob = b''.join(flat_buffers)
+    buf_lens = np.fromiter(map(len, flat_buffers), dtype=np.uint64,
+                           count=n_changes)
 
     out = native.ingest_changes(flat_buffers, list(range(n_changes)),
-                                with_meta=True, with_seq=True)
+                                with_meta=True, with_seq=True,
+                                blob=blob, lens=buf_lens)
     if out is None:
         return None     # ops outside the fleet subset, or corrupt chunk
     rows, nat_keys, nat_actors, nmeta = out
@@ -1501,16 +1508,17 @@ def _apply_changes_turbo(handles, per_doc_changes):
             engine.clock, engine.heads, engine.queue = clock, heads, queue
 
     for d, engine in enumerate(engines):
-        if not per_doc_idx[d]:
+        start, stop = per_doc_idx[d]
+        if start == stop:
             continue
         if fast_mask[d]:
-            ready[per_doc_idx[d]] = True
+            ready[start:stop] = True
             continue
         backups.append((engine, dict(engine.clock), list(engine.heads),
                         list(engine.queue)))
         try:
             applied, queue = engine._drain_queue(
-                [batch_meta.meta(i) for i in per_doc_idx[d]],
+                [batch_meta.meta(i) for i in range(start, stop)],
                 lambda change: None)
         except Exception:
             restore_all()
@@ -1536,32 +1544,37 @@ def _apply_changes_turbo(handles, per_doc_changes):
     # Count only causally-applied changes: queued ones are re-counted when
     # the exact path drains and flushes them later
     fleet.metrics.changes_ingested += int(ready.sum())
-    fleet.metrics.bytes_ingested += sum(len(flat_buffers[i])
-                                        for i in np.flatnonzero(ready))
+    fleet.metrics.bytes_ingested += int(buf_lens[ready].sum())
 
     # Phase 2 — infallible: record logs, queues, staleness
     start_op = nmeta['startOp']
     nops = nmeta['nops']
     last_op = start_op + nops - 1
     for d in np.flatnonzero(fast_mask):
-        idxs = per_doc_idx[d]
-        if not idxs:
+        start, stop = per_doc_idx[d]
+        if start == stop:
             continue
         engine = engines[d]
         base = len(engine.changes)
-        engine.changes.extend(flat_buffers[i] for i in idxs)
+        engine.changes.extend(flat_buffers[start:stop])
         # One deferred-graph record for the whole run (resolved lazily per
         # change only if a graph query ever needs it)
-        engine._deferred.append((base, batch_meta, idxs))
-        clk = {}
-        for i in idxs:
-            clk[int(actor_id[i])] = int(seqs[i])
-        for a, s in clk.items():
-            engine.clock[nat_actors[a]] = s
-        engine.heads = [batch_meta.hash_hex(idxs[-1])]
-        engine.max_op = max(engine.max_op, int(last_op[idxs].max()))
+        engine._deferred.append((base, batch_meta, range(start, stop)))
+        engine.heads = [batch_meta.hash_hex(stop - 1)]
+        engine.max_op = max(engine.max_op, int(last_op[start:stop].max()))
         engine.stale = True
         engine.binary_doc = None
+    # Clock advance, one write per (doc, actor) group: the sorted grouping
+    # from the seq validation gives each group's final seq directly (stable
+    # sort keeps buffer order, and fast-path seqs are contiguous)
+    if len(group_starts):
+        group_ends = np.r_[group_starts[1:], n_changes] - 1
+        g_key = key_sorted[group_starts]
+        g_doc = g_key // _MA
+        g_final = seqs[order[group_ends]]
+        for gi in np.flatnonzero(fast_mask[g_doc]):
+            engines[int(g_doc[gi])].clock[
+                nat_actors[int(g_key[gi]) % _MA]] = int(g_final[gi])
     for engine, applied, queue in staged:
         for change in applied:
             engine.changes.append(change['buffer'])

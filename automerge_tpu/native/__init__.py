@@ -203,7 +203,8 @@ def decode_boolean_column(buf):
     return _decode_column('boolean', buf)
 
 
-def ingest_changes(buffers, doc_ids, with_meta=False, with_seq=False):
+def ingest_changes(buffers, doc_ids, with_meta=False, with_seq=False,
+                   blob=None, lens=None):
     """Batched native change ingest: parse N binary changes into flat op-row
     arrays (doc, key_id, packed_opid, value, flags) with C++-side dictionary
     encoding of keys and actors.
@@ -222,12 +223,14 @@ def ingest_changes(buffers, doc_ids, with_meta=False, with_seq=False):
     lib = _load()
     if lib is None:
         return None
-    bufs = [bytes(b) for b in buffers]
-    blob = b''.join(bufs)
-    lens = np.fromiter((len(b) for b in bufs), dtype=np.uint64,
-                       count=len(bufs))
-    offsets = np.zeros(len(bufs), dtype=np.uint64)
-    if len(bufs) > 1:
+    n_bufs = len(buffers)
+    if blob is None:
+        bufs = buffers if all(type(b) is bytes for b in buffers) else \
+            [bytes(b) for b in buffers]
+        blob = b''.join(bufs)
+        lens = np.fromiter(map(len, bufs), dtype=np.uint64, count=n_bufs)
+    offsets = np.zeros(n_bufs, dtype=np.uint64)
+    if n_bufs > 1:
         np.cumsum(lens[:-1], out=offsets[1:])
     docs = np.asarray(doc_ids, dtype=np.int32)
     arr, ptr = _u8(blob)
@@ -248,7 +251,7 @@ def ingest_changes(buffers, doc_ids, with_meta=False, with_seq=False):
     preds = None
     seq_cols = None
     if with_meta:
-        metas = _fetch_ingest_meta(lib, len(buffers), len(blob))
+        metas = _fetch_ingest_meta(lib, len(buffers))
         if metas is None:
             return None
         preds = _fetch_ingest_preds(lib, int(n_rows))
@@ -275,8 +278,16 @@ def ingest_changes(buffers, doc_ids, with_meta=False, with_seq=False):
     packed = np.zeros(n, dtype=np.int32)
     val = np.zeros(n, dtype=np.int32)
     flags = np.zeros(n, dtype=np.uint8)
-    key_blob = np.empty(max(len(blob) * 2, 1 << 16), dtype=np.uint8)
-    actor_blob = np.empty(1 << 20, dtype=np.uint8)
+    kb_used = i64(0)
+    ab_used = i64(0)
+    lib.am_ingest_blob_sizes.argtypes = [ctypes.POINTER(i64),
+                                         ctypes.POINTER(i64)]
+    lib.am_ingest_blob_sizes.restype = i64
+    if lib.am_ingest_blob_sizes(ctypes.byref(kb_used),
+                                ctypes.byref(ab_used)) < 0:
+        return None
+    key_blob = np.empty(max(int(kb_used.value), 1), dtype=np.uint8)
+    actor_blob = np.empty(max(int(ab_used.value), 1), dtype=np.uint8)
     n_keys = i64(0)
     n_actors = i64(0)
     i32p = ctypes.POINTER(ctypes.c_int32)
@@ -336,7 +347,7 @@ def _fetch_ingest_preds(lib, n_rows):
     return pred_off[:n_rows + 1], pred_blob[:int(got)]
 
 
-def _fetch_ingest_meta(lib, n_changes, blob_len):
+def _fetch_ingest_meta(lib, n_changes):
     """Copy out the per-change metadata captured by am_ingest_changes.
     Must run before am_ingest_fetch (which frees the native context)."""
     i64 = ctypes.c_int64
@@ -351,9 +362,16 @@ def _fetch_ingest_meta(lib, n_changes, blob_len):
     nops = np.zeros(n, dtype=np.int64)
     hash32 = np.zeros(32 * n, dtype=np.uint8)
     deps_off = np.zeros(n + 1, dtype=np.int64)
-    deps_blob = np.zeros(max(blob_len, 64), dtype=np.uint8)
     msg_off = np.zeros(n + 1, dtype=np.int64)
-    msg_blob = np.zeros(max(blob_len, 64), dtype=np.uint8)
+    deps_bytes = i64(0)
+    msg_bytes = i64(0)
+    lib.am_ingest_meta_sizes.argtypes = [i64p, i64p]
+    lib.am_ingest_meta_sizes.restype = i64
+    if lib.am_ingest_meta_sizes(ctypes.byref(deps_bytes),
+                                ctypes.byref(msg_bytes)) < 0:
+        return None
+    deps_blob = np.zeros(max(int(deps_bytes.value), 1), dtype=np.uint8)
+    msg_blob = np.zeros(max(int(msg_bytes.value), 1), dtype=np.uint8)
     lib.am_ingest_meta_fetch.argtypes = [
         i32p, i64p, i64p, i64p, i64p, u8p, i64p, u8p, ctypes.c_uint64,
         i64p, u8p, ctypes.c_uint64]
@@ -374,7 +392,7 @@ def _fetch_ingest_meta(lib, n_changes, blob_len):
         'startOp': start_op[:n_changes], 'time': time[:n_changes],
         'nops': nops[:n_changes], 'hash32': hash32.reshape(n, 32)[:n_changes],
         'deps_off': deps_off[:n_changes + 1],
-        'deps_blob': deps_blob.tobytes()[:32 * int(deps_off[n_changes])],
+        'deps_blob': deps_blob[:32 * int(deps_off[n_changes])].tobytes(),
         'msg_off': msg_off[:n_changes + 1],
-        'msg_blob': msg_blob.tobytes()[:int(msg_off[n_changes])],
+        'msg_blob': msg_blob[:int(msg_off[n_changes])].tobytes(),
     }

@@ -13,6 +13,10 @@
 #include <cstring>
 #include <cstdlib>
 #include <zlib.h>
+#if defined(__x86_64__) || defined(__i386__)
+#include <immintrin.h>
+#define AM_HAVE_X86 1
+#endif
 
 extern "C" {
 
@@ -64,29 +68,195 @@ static void sha256_block(uint32_t state[8], const uint8_t *p) {
   state[4] += e; state[5] += f; state[6] += g; state[7] += h;
 }
 
-// out must have room for 32 bytes
-void am_sha256(const uint8_t *data, uint64_t len, uint8_t *out) {
-  uint32_t st[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
-                    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
-  uint64_t full = len / 64;
-  for (uint64_t i = 0; i < full; i++) sha256_block(st, data + 64 * i);
+#ifdef AM_HAVE_X86
+// SHA-NI block loop (Intel SHA extensions; FIPS 180-4 schedule expressed
+// through sha256msg1/msg2 + sha256rnds2). Function-level target attribute so
+// the rest of the TU stays baseline; dispatched behind a cpuid check.
+__attribute__((target("sha,sse4.1,ssse3")))
+static void sha256_blocks_shani(uint32_t state[8], const uint8_t *data,
+                                uint64_t nblocks) {
+#define AM_K4(i)                                                            \
+  _mm_set_epi32(int(K256[(i) + 3]), int(K256[(i) + 2]), int(K256[(i) + 1]), \
+                int(K256[(i)]))
+  const __m128i MASK =
+      _mm_set_epi64x(0x0c0d0e0f08090a0bULL, 0x0405060700010203ULL);
+  __m128i TMP = _mm_loadu_si128((const __m128i *)&state[0]);
+  __m128i STATE1 = _mm_loadu_si128((const __m128i *)&state[4]);
+  TMP = _mm_shuffle_epi32(TMP, 0xB1);
+  STATE1 = _mm_shuffle_epi32(STATE1, 0x1B);
+  __m128i STATE0 = _mm_alignr_epi8(TMP, STATE1, 8);
+  STATE1 = _mm_blend_epi16(STATE1, TMP, 0xF0);
+
+  while (nblocks--) {
+    const __m128i ABEF_SAVE = STATE0;
+    const __m128i CDGH_SAVE = STATE1;
+    __m128i MSG, MSG0, MSG1, MSG2, MSG3;
+
+    /* rounds 0-3 */
+    MSG0 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i *)(data + 0)), MASK);
+    MSG = _mm_add_epi32(MSG0, AM_K4(0));
+    STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);
+    MSG = _mm_shuffle_epi32(MSG, 0x0E);
+    STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+
+    /* rounds 4-7 */
+    MSG1 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i *)(data + 16)), MASK);
+    MSG = _mm_add_epi32(MSG1, AM_K4(4));
+    STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);
+    MSG = _mm_shuffle_epi32(MSG, 0x0E);
+    STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+    MSG0 = _mm_sha256msg1_epu32(MSG0, MSG1);
+
+    /* rounds 8-11 */
+    MSG2 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i *)(data + 32)), MASK);
+    MSG = _mm_add_epi32(MSG2, AM_K4(8));
+    STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);
+    MSG = _mm_shuffle_epi32(MSG, 0x0E);
+    STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+    MSG1 = _mm_sha256msg1_epu32(MSG1, MSG2);
+
+    /* rounds 12-15 */
+    MSG3 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i *)(data + 48)), MASK);
+    MSG = _mm_add_epi32(MSG3, AM_K4(12));
+    STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);
+    TMP = _mm_alignr_epi8(MSG3, MSG2, 4);
+    MSG0 = _mm_add_epi32(MSG0, TMP);
+    MSG0 = _mm_sha256msg2_epu32(MSG0, MSG3);
+    MSG = _mm_shuffle_epi32(MSG, 0x0E);
+    STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+    MSG2 = _mm_sha256msg1_epu32(MSG2, MSG3);
+
+#define AM_ROUND4(W0, W1, W2, W3, i, do_msg1)                   \
+    MSG = _mm_add_epi32(W0, AM_K4(i));                          \
+    STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);        \
+    TMP = _mm_alignr_epi8(W0, W3, 4);                           \
+    W1 = _mm_add_epi32(W1, TMP);                                \
+    W1 = _mm_sha256msg2_epu32(W1, W0);                          \
+    MSG = _mm_shuffle_epi32(MSG, 0x0E);                         \
+    STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);        \
+    if (do_msg1) W3 = _mm_sha256msg1_epu32(W3, W0);
+
+    AM_ROUND4(MSG0, MSG1, MSG2, MSG3, 16, 1)   /* rounds 16-19 */
+    AM_ROUND4(MSG1, MSG2, MSG3, MSG0, 20, 1)   /* rounds 20-23 */
+    AM_ROUND4(MSG2, MSG3, MSG0, MSG1, 24, 1)   /* rounds 24-27 */
+    AM_ROUND4(MSG3, MSG0, MSG1, MSG2, 28, 1)   /* rounds 28-31 */
+    AM_ROUND4(MSG0, MSG1, MSG2, MSG3, 32, 1)   /* rounds 32-35 */
+    AM_ROUND4(MSG1, MSG2, MSG3, MSG0, 36, 1)   /* rounds 36-39 */
+    AM_ROUND4(MSG2, MSG3, MSG0, MSG1, 40, 1)   /* rounds 40-43 */
+    AM_ROUND4(MSG3, MSG0, MSG1, MSG2, 44, 1)   /* rounds 44-47 */
+    AM_ROUND4(MSG0, MSG1, MSG2, MSG3, 48, 1)   /* rounds 48-51 */
+    AM_ROUND4(MSG1, MSG2, MSG3, MSG0, 52, 0)   /* rounds 52-55 */
+    AM_ROUND4(MSG2, MSG3, MSG0, MSG1, 56, 0)   /* rounds 56-59 */
+#undef AM_ROUND4
+
+    /* rounds 60-63 */
+    MSG = _mm_add_epi32(MSG3, AM_K4(60));
+    STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);
+    MSG = _mm_shuffle_epi32(MSG, 0x0E);
+    STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+
+    STATE0 = _mm_add_epi32(STATE0, ABEF_SAVE);
+    STATE1 = _mm_add_epi32(STATE1, CDGH_SAVE);
+    data += 64;
+  }
+
+  TMP = _mm_shuffle_epi32(STATE0, 0x1B);
+  STATE1 = _mm_shuffle_epi32(STATE1, 0xB1);
+  STATE0 = _mm_blend_epi16(TMP, STATE1, 0xF0);
+  STATE1 = _mm_alignr_epi8(STATE1, TMP, 8);
+  _mm_storeu_si128((__m128i *)&state[0], STATE0);
+  _mm_storeu_si128((__m128i *)&state[4], STATE1);
+#undef AM_K4
+}
+
+static bool have_shani() {
+  static const bool v = __builtin_cpu_supports("sha") &&
+                        __builtin_cpu_supports("sse4.1") &&
+                        __builtin_cpu_supports("ssse3");
+  return v;
+}
+#endif  // AM_HAVE_X86
+
+static void sha256_blocks(uint32_t state[8], const uint8_t *data,
+                          uint64_t nblocks) {
+#ifdef AM_HAVE_X86
+  if (have_shani()) {
+    sha256_blocks_shani(state, data, nblocks);
+    return;
+  }
+#endif
+  for (uint64_t i = 0; i < nblocks; i++) sha256_block(state, data + 64 * i);
+}
+
+// Streaming context so multi-part inputs (chunk header + body) hash without
+// concatenating into a scratch buffer.
+struct Sha256Stream {
+  uint32_t st[8];
+  uint8_t buf[64];
+  uint64_t total = 0;
+  uint32_t buffered = 0;
+};
+
+static void sha256_stream_init(Sha256Stream &s) {
+  static const uint32_t init[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                   0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                   0x1f83d9ab, 0x5be0cd19};
+  memcpy(s.st, init, sizeof(init));
+  s.total = 0;
+  s.buffered = 0;
+}
+
+static void sha256_stream_update(Sha256Stream &s, const uint8_t *p,
+                                 uint64_t n) {
+  s.total += n;
+  if (s.buffered) {
+    uint64_t take = 64 - s.buffered < n ? 64 - s.buffered : n;
+    memcpy(s.buf + s.buffered, p, take);
+    s.buffered += uint32_t(take);
+    p += take;
+    n -= take;
+    if (s.buffered == 64) {
+      sha256_blocks(s.st, s.buf, 1);
+      s.buffered = 0;
+    }
+  }
+  uint64_t full = n / 64;
+  if (full) {
+    sha256_blocks(s.st, p, full);
+    p += 64 * full;
+    n -= 64 * full;
+  }
+  if (n) {
+    memcpy(s.buf, p, n);
+    s.buffered = uint32_t(n);
+  }
+}
+
+static void sha256_stream_final(Sha256Stream &s, uint8_t *out) {
   uint8_t tail[128];
-  uint64_t rem = len - 64 * full;
-  memcpy(tail, data + 64 * full, rem);
+  uint32_t rem = s.buffered;
+  memcpy(tail, s.buf, rem);
   tail[rem] = 0x80;
   uint64_t tail_len = (rem + 9 <= 64) ? 64 : 128;
   memset(tail + rem + 1, 0, tail_len - rem - 9);
-  uint64_t bits = len * 8;
+  uint64_t bits = s.total * 8;
   for (int i = 0; i < 8; i++)
     tail[tail_len - 1 - i] = uint8_t(bits >> (8 * i));
-  sha256_block(st, tail);
-  if (tail_len == 128) sha256_block(st, tail + 64);
+  sha256_blocks(s.st, tail, tail_len / 64);
   for (int i = 0; i < 8; i++) {
-    out[4 * i] = uint8_t(st[i] >> 24);
-    out[4 * i + 1] = uint8_t(st[i] >> 16);
-    out[4 * i + 2] = uint8_t(st[i] >> 8);
-    out[4 * i + 3] = uint8_t(st[i]);
+    out[4 * i] = uint8_t(s.st[i] >> 24);
+    out[4 * i + 1] = uint8_t(s.st[i] >> 16);
+    out[4 * i + 2] = uint8_t(s.st[i] >> 8);
+    out[4 * i + 3] = uint8_t(s.st[i]);
   }
+}
+
+// out must have room for 32 bytes
+void am_sha256(const uint8_t *data, uint64_t len, uint8_t *out) {
+  Sha256Stream s;
+  sha256_stream_init(s);
+  sha256_stream_update(s, data, len);
+  sha256_stream_final(s, out);
 }
 
 // Batched hashing: n buffers, each lens[i] bytes at data + offsets[i];
@@ -358,8 +528,58 @@ struct Interner {
   }
 };
 
+// Per-change parse scratch, reused across the batch so the hot loop does no
+// heap allocation after the first few changes (clear() keeps capacity).
+struct ParseScratch {
+  std::vector<int32_t> actor_table;
+  std::vector<uint32_t> col_ids;
+  std::vector<uint64_t> col_lens;
+  std::vector<const uint8_t *> col_bufs;
+  std::vector<int32_t> key_ids;
+  std::vector<int64_t> actions, val_lens, obj_ctr, insert_i64;
+  std::vector<uint8_t> actions_ok, val_lens_ok, obj_ctr_ok, insert_ok;
+  std::vector<int64_t> pred_num, pred_actor, pred_ctr;
+  std::vector<uint8_t> pred_num_ok, pred_actor_ok, pred_ctr_ok;
+  std::vector<int64_t> obj_actor, key_actor, key_ctr;
+  std::vector<uint8_t> obj_actor_ok, key_actor_ok, key_ctr_ok;
+  std::vector<int64_t> bool_v;
+  std::vector<uint8_t> bool_m;
+
+  void reset() {
+    actor_table.clear();
+    col_ids.clear();
+    col_lens.clear();
+    col_bufs.clear();
+    key_ids.clear();
+    actions.clear();
+    val_lens.clear();
+    obj_ctr.clear();
+    insert_i64.clear();
+    actions_ok.clear();
+    val_lens_ok.clear();
+    obj_ctr_ok.clear();
+    insert_ok.clear();
+    pred_num.clear();
+    pred_actor.clear();
+    pred_ctr.clear();
+    pred_num_ok.clear();
+    pred_actor_ok.clear();
+    pred_ctr_ok.clear();
+    obj_actor.clear();
+    key_actor.clear();
+    key_ctr.clear();
+    obj_actor_ok.clear();
+    key_actor_ok.clear();
+    key_ctr_ok.clear();
+  }
+};
+
 struct IngestCtx {
   Interner keys, actors;
+  // Raw actor bytes -> interned id, skipping the hex conversion + string
+  // intern on the (hot) repeated-actor case
+  std::unordered_map<std::string, int32_t> actor_raw_cache;
+  ParseScratch scratch;
   std::vector<int32_t> out_doc, out_key, out_packed, out_val;
   std::vector<uint8_t> out_flags;  // 1 = set/del, 2 = inc
   std::string error;
@@ -384,22 +604,44 @@ struct IngestCtx {
   std::vector<uint8_t> out_vtype;
 };
 
+// Intern an actor given its raw (binary) bytes, caching by raw bytes so the
+// hex conversion + string intern runs once per distinct actor per batch.
+static int32_t intern_actor_raw(IngestCtx &ctx, const uint8_t *raw,
+                                uint64_t len) {
+  std::string key((const char *)raw, len);
+  auto it = ctx.actor_raw_cache.find(key);
+  if (it != ctx.actor_raw_cache.end()) return it->second;
+  static const char *hex = "0123456789abcdef";
+  std::string actor_hex;
+  actor_hex.reserve(len * 2);
+  for (uint64_t i = 0; i < len; i++) {
+    actor_hex.push_back(hex[raw[i] >> 4]);
+    actor_hex.push_back(hex[raw[i] & 15]);
+  }
+  int32_t id = ctx.actors.intern(actor_hex);
+  ctx.actor_raw_cache.emplace(std::move(key), id);
+  return id;
+}
+
 // SHA-256 of a change chunk as the reference hashes it (columnar.js:688-708):
 // over [chunk type 1][uleb body length][uncompressed body].
 static void change_chunk_hash(const uint8_t *body, uint64_t body_len,
                               uint8_t out[32]) {
-  std::vector<uint8_t> buf;
-  buf.reserve(body_len + 10);
-  buf.push_back(1);
+  uint8_t header[11];
+  uint64_t n = 0;
+  header[n++] = 1;
   uint64_t v = body_len;
   do {
     uint8_t b = v & 0x7f;
     v >>= 7;
     if (v) b |= 0x80;
-    buf.push_back(b);
+    header[n++] = b;
   } while (v);
-  buf.insert(buf.end(), body, body + body_len);
-  am_sha256(buf.data(), buf.size(), out);
+  Sha256Stream s;
+  sha256_stream_init(s);
+  sha256_stream_update(s, header, n);
+  sha256_stream_update(s, body, body_len);
+  sha256_stream_final(s, out);
 }
 
 constexpr int kColObjActor = 0x01, kColObjCtr = 0x02;
@@ -516,14 +758,7 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
   uint64_t actor_len = c.uleb();
   const uint8_t *actor_bytes = c.bytes(actor_len);
   if (c.fail) return false;
-  static const char *hex = "0123456789abcdef";
-  std::string actor_hex;
-  actor_hex.reserve(actor_len * 2);
-  for (uint64_t i = 0; i < actor_len; i++) {
-    actor_hex.push_back(hex[actor_bytes[i] >> 4]);
-    actor_hex.push_back(hex[actor_bytes[i] & 15]);
-  }
-  int32_t actor_id = ctx.actors.intern(actor_hex);
+  int32_t actor_id = intern_actor_raw(ctx, actor_bytes, actor_len);
   if (actor_id >= (1 << kActorBits)) return false;
   uint64_t seq = c.uleb();
   uint64_t start_op = c.uleb();   // startOp
@@ -541,7 +776,9 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
   } else {
     c.skip(msg_len);
   }
-  std::vector<int32_t> actor_table;
+  ParseScratch &sc = ctx.scratch;
+  sc.reset();
+  std::vector<int32_t> &actor_table = sc.actor_table;
   actor_table.push_back(actor_id);
   uint64_t num_other_actors = c.uleb();
   for (uint64_t i = 0; i < num_other_actors; i++) {
@@ -549,13 +786,7 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
     const uint8_t *abytes = c.bytes(alen);
     if (c.fail) return false;
     if (with_meta) {
-      std::string other_hex;
-      other_hex.reserve(alen * 2);
-      for (uint64_t j = 0; j < alen; j++) {
-        other_hex.push_back(hex[abytes[j] >> 4]);
-        other_hex.push_back(hex[abytes[j] & 15]);
-      }
-      int32_t oid = ctx.actors.intern(other_hex);
+      int32_t oid = intern_actor_raw(ctx, abytes, alen);
       if (oid >= (1 << kActorBits)) return false;
       actor_table.push_back(oid);
     }
@@ -563,9 +794,8 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
   if (c.fail) return false;
 
   uint64_t num_cols = c.uleb();
-  std::vector<std::pair<uint32_t, std::pair<const uint8_t *, uint64_t>>> cols;
-  std::vector<uint64_t> col_lens;
-  std::vector<uint32_t> col_ids;
+  std::vector<uint64_t> &col_lens = sc.col_lens;
+  std::vector<uint32_t> &col_ids = sc.col_ids;
   for (uint64_t i = 0; i < num_cols; i++) {
     uint32_t cid = uint32_t(c.uleb());
     uint64_t blen = c.uleb();
@@ -573,21 +803,29 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
     col_lens.push_back(blen);
   }
   if (c.fail) return false;
-  std::vector<const uint8_t *> col_bufs;
+  std::vector<const uint8_t *> &col_bufs = sc.col_bufs;
   for (uint64_t i = 0; i < num_cols; i++) {
     col_bufs.push_back(c.bytes(col_lens[i]));
   }
   if (c.fail) return false;
 
-  std::vector<int32_t> key_ids;
-  std::vector<int64_t> actions, val_lens, obj_ctr;
-  std::vector<uint8_t> actions_ok, val_lens_ok, obj_ctr_ok, insert_vals,
-      insert_ok;
-  std::vector<int64_t> insert_i64;
-  std::vector<int64_t> pred_num, pred_actor, pred_ctr;
-  std::vector<uint8_t> pred_num_ok, pred_actor_ok, pred_ctr_ok;
-  std::vector<int64_t> obj_actor, key_actor, key_ctr;
-  std::vector<uint8_t> obj_actor_ok, key_actor_ok, key_ctr_ok;
+  std::vector<int32_t> &key_ids = sc.key_ids;
+  std::vector<int64_t> &actions = sc.actions, &val_lens = sc.val_lens,
+                       &obj_ctr = sc.obj_ctr;
+  std::vector<uint8_t> &actions_ok = sc.actions_ok,
+                       &val_lens_ok = sc.val_lens_ok,
+                       &obj_ctr_ok = sc.obj_ctr_ok, &insert_ok = sc.insert_ok;
+  std::vector<int64_t> &insert_i64 = sc.insert_i64;
+  std::vector<int64_t> &pred_num = sc.pred_num, &pred_actor = sc.pred_actor,
+                       &pred_ctr = sc.pred_ctr;
+  std::vector<uint8_t> &pred_num_ok = sc.pred_num_ok,
+                       &pred_actor_ok = sc.pred_actor_ok,
+                       &pred_ctr_ok = sc.pred_ctr_ok;
+  std::vector<int64_t> &obj_actor = sc.obj_actor, &key_actor = sc.key_actor,
+                       &key_ctr = sc.key_ctr;
+  std::vector<uint8_t> &obj_actor_ok = sc.obj_actor_ok,
+                       &key_actor_ok = sc.key_actor_ok,
+                       &key_ctr_ok = sc.key_ctr_ok;
   const uint8_t *val_raw = nullptr;
   uint64_t val_raw_len = 0;
 
@@ -635,9 +873,10 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
       }
       // decode as boolean
       {
-        int64_t cap = 16;
-        std::vector<int64_t> v;
-        std::vector<uint8_t> m;
+        int64_t cap = int64_t(sc.bool_v.size()) < 16
+                          ? 16 : int64_t(sc.bool_v.size());
+        std::vector<int64_t> &v = sc.bool_v;
+        std::vector<uint8_t> &m = sc.bool_m;
         int64_t n = -1;
         while (n < 0 && cap < (int64_t(1) << 30)) {
           v.resize(size_t(cap));
@@ -923,6 +1162,35 @@ int64_t am_ingest_fetch(int32_t *doc, int32_t *key, int32_t *packed,
   delete g_ingest;
   g_ingest = nullptr;
   return kb;
+}
+
+// Bytes used in the actor blob by the last am_ingest_fetch-compatible
+// context; callable BEFORE am_ingest_fetch to size slices (returns the
+// exact serialized sizes of both blobs as (key_bytes, actor_bytes)).
+int64_t am_ingest_blob_sizes(int64_t *key_bytes, int64_t *actor_bytes) {
+  if (!g_ingest) return -1;
+  IngestCtx &ctx = *g_ingest;
+  auto blob_size = [](const std::vector<std::string> &items) -> int64_t {
+    uint64_t pos = 0;
+    for (const auto &s : items) {
+      uint64_t v = s.size();
+      do { pos++; v >>= 7; } while (v);
+      pos += s.size();
+    }
+    return int64_t(pos);
+  };
+  *key_bytes = blob_size(ctx.keys.items);
+  *actor_bytes = blob_size(ctx.actors.items);
+  return 0;
+}
+
+// Exact byte sizes of the pending meta deps/msg blobs so the Python side
+// allocates (and copies) only what is used. Must run before am_ingest_fetch.
+int64_t am_ingest_meta_sizes(int64_t *deps_bytes, int64_t *msg_bytes) {
+  if (!g_ingest) return -1;
+  *deps_bytes = int64_t(g_ingest->m_deps.size());
+  *msg_bytes = int64_t(g_ingest->m_msg.size());
+  return 0;
 }
 
 // Copy per-change metadata captured by am_ingest_changes(with_meta=1).

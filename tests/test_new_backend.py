@@ -772,6 +772,57 @@ class TestUnknownColumns:
         reloaded = OpSet(backend.save())
         assert reloaded.get_patch()['clock'] == {'1234': 1}
 
+    def test_unknown_group_with_value_pair(self):
+        """An unknown group whose members include a VALUE_LEN/VALUE_RAW pair
+        must decode (the pair is one logical column) and re-encode to the
+        original bytes (ref columnar.js:339-361 value-pair handling inside
+        group decode)."""
+        gcid = 0x90   # unknown group (group 9), GROUP_CARD
+        vcid = 0x96   # same group, VALUE_LEN (VALUE_RAW 0x97 implied)
+        change = {
+            'actor': 'aa' * 4, 'seq': 1, 'startOp': 1, 'time': 0,
+            'message': '', 'deps': [], 'ops': [
+                {'action': 'set', 'obj': '_root', 'key': 'x',
+                 'insert': False, 'value': 1, 'datatype': 'int', 'pred': [],
+                 'unknownCols': {gcid: [{vcid: {'value': 'x'}}]}}]}
+        buf = encode_change(change)
+        dec = decode_change(buf)
+        assert dec['ops'][0]['unknownCols'] == {gcid: [{vcid: {'value': 'x'}}]}
+        assert bytes(encode_change(dec)) == bytes(buf)
+
+    def test_unknown_actor_column_through_document(self):
+        """An unknown ACTOR_ID column naming an actor that authored no change
+        must survive apply + save + load: the document actor table has to
+        include actors referenced only from unknown columns (cf. the
+        change-encode path, parse_all_op_ids)."""
+        acid = 0x91   # unknown group 9, ACTOR_ID
+        other = 'bb' * 4
+        change = {
+            'actor': 'aa' * 4, 'seq': 1, 'startOp': 1, 'time': 0,
+            'message': '', 'deps': [], 'ops': [
+                {'action': 'set', 'obj': '_root', 'key': 'y',
+                 'insert': False, 'value': 2, 'datatype': 'int', 'pred': [],
+                 'unknownCols': {acid: other}}]}
+        backend = OpSet()
+        backend.apply_changes([encode_change(change)])
+        reloaded = OpSet(backend.save())
+        assert reloaded.heads == backend.heads
+        assert reloaded.get_patch()['clock'] == {'aa' * 4: 1}
+
+    def test_change_column_in_document_succ_group_rejected(self):
+        """A change using column ids from the document succ group (0x80-0x83)
+        would collide with the succ columns save() adds; such changes are
+        rejected at decode instead of producing an undecodable document."""
+        change = {
+            'actor': 'aa' * 4, 'seq': 1, 'startOp': 1, 'time': 0,
+            'message': '', 'deps': [], 'ops': [
+                {'action': 'set', 'obj': '_root', 'key': 'z',
+                 'insert': False, 'value': 3, 'datatype': 'int', 'pred': [],
+                 'unknownCols': {0x81: 'bb' * 4}}]}
+        buf = encode_change(change)
+        with pytest.raises(ValueError, match='reserved for the document'):
+            decode_change(buf)
+
 
 class TestLongSequences:
     """Long-insertion behavior (ref new_backend_test.js:1907-2193). The
