@@ -161,9 +161,25 @@ def init(options=None):
     return root
 
 
+def normalize_initial_state(initial_state):
+    """Coerce a `from_` initial state to a mapping, per the reference's JS
+    object-spread semantics (ref test/test.js:39-55): sequences and strings
+    become index-keyed maps, scalars contribute nothing, and anything else
+    non-mapping is rejected rather than silently dropped."""
+    if isinstance(initial_state, (list, tuple, str)):
+        return {str(i): v for i, v in enumerate(initial_state)}
+    if initial_state is None or isinstance(initial_state, (int, float, bool)):
+        return {}
+    if not hasattr(initial_state, 'items'):
+        raise TypeError('Unsupported initial state: '
+                        f'{type(initial_state).__name__}')
+    return initial_state
+
+
 def from_(initial_state, options=None):
     return change(init(options), 'Initialization',
-                  lambda doc: doc.update(initial_state))[0]
+                  lambda doc: doc.update(
+                      normalize_initial_state(initial_state)))[0]
 
 
 def change(doc, options=None, callback=None):

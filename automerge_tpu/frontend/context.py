@@ -197,7 +197,11 @@ class Context:
             subpatch = {'objectId': object_id, 'type': 'list', 'edits': []}
             self.insert_list_items(subpatch, 0, list(value), True)
             return subpatch
-        # Map object
+        # Map object (anything else is not an assignable value,
+        # ref context.js:88-91 "Unsupported type of value")
+        if not hasattr(value, 'keys'):
+            raise TypeError(
+                f'Unsupported type of value: {type(value).__name__}')
         op = {'action': 'makeMap', 'obj': obj, 'insert': insert, 'pred': pred}
         op['elemId' if elem_id else 'key'] = elem_id if elem_id else key
         self.add_op(op)
