@@ -45,7 +45,7 @@ def from_(initial_state, options=None):
     (ref src/automerge.js:28-31). Non-mapping initial states follow the
     reference's JS object-spread semantics: sequences and strings become
     index-keyed maps, scalars contribute nothing (ref test/test.js:39-55)."""
-    initial_state = frontend.normalize_initial_state(initial_state)
+    initial_state = Frontend.normalize_initial_state(initial_state)
     return change(init(options), {'message': 'Initialization'},
                   lambda doc: doc.update(initial_state))
 

@@ -166,10 +166,14 @@ def normalize_initial_state(initial_state):
     object-spread semantics (ref test/test.js:39-55): sequences and strings
     become index-keyed maps, scalars contribute nothing, and anything else
     non-mapping is rejected rather than silently dropped."""
+    import datetime as _datetime
+    from .values import Counter, Int, Uint, Float64
     if isinstance(initial_state, (list, tuple, str)):
         return {str(i): v for i, v in enumerate(initial_state)}
-    if initial_state is None or isinstance(initial_state, (int, float, bool)):
-        return {}
+    if initial_state is None or isinstance(
+            initial_state, (int, float, bool, _datetime.datetime,
+                            Counter, Int, Uint, Float64)):
+        return {}    # scalars have no enumerable properties to spread
     if not hasattr(initial_state, 'items'):
         raise TypeError('Unsupported initial state: '
                         f'{type(initial_state).__name__}')

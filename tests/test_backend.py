@@ -874,3 +874,416 @@ class TestFrozenHandles:
         s1, _ = Backend.apply_changes(s0, [encode_change(change1)])
         with pytest.raises(ValueError, match='outdated Automerge document'):
             Backend.apply_changes(s0, [encode_change(change1)])
+
+
+class TestIncrementalDiffsMore:
+    """Remaining incremental-diff cases (ref backend_test.js:452-719)."""
+
+    def test_timestamp_in_a_list(self):
+        actor = 'aaaa11'
+        now_ms = 1589032171000
+        change = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'list', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': '_head',
+             'insert': True, 'value': now_ms, 'datatype': 'timestamp',
+             'pred': []}]}
+        s0 = Backend.init()
+        s1, patch = Backend.apply_changes(s0, [encode_change(change)])
+        assert patch == {
+            'clock': {actor: 1}, 'deps': [hash_of(change)], 'maxOp': 2,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'list': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'list',
+                               'edits': [
+                    {'action': 'insert', 'index': 0, 'elemId': f'2@{actor}',
+                     'opId': f'2@{actor}',
+                     'value': {'type': 'value', 'value': now_ms,
+                               'datatype': 'timestamp'}}]}}}}}
+
+    def test_updates_to_deleted_map_object(self):
+        actor1, actor2 = 'aaaa11', 'bbbb22'
+        change1 = {'actor': actor1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeMap', 'obj': '_root', 'key': 'birds', 'pred': []},
+            set_op(f'1@{actor1}', 'blackbirds', 2)]}
+        change2 = {'actor': actor2, 'seq': 1, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'del', 'obj': '_root', 'key': 'birds',
+             'pred': [f'1@{actor1}']}]}
+        change3 = {'actor': actor1, 'seq': 2, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            set_op(f'1@{actor1}', 'blackbirds', 2)]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [encode_change(change1)])
+        s2, _ = Backend.apply_changes(s1, [encode_change(change2)])
+        s3, patch3 = Backend.apply_changes(s2, [encode_change(change3)])
+        assert patch3 == {
+            'clock': {actor1: 2, actor2: 1}, 'maxOp': 3, 'pendingChanges': 0,
+            'deps': sorted([hash_of(change2), hash_of(change3)]),
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {}}}
+
+    def test_updates_to_deleted_list_element(self):
+        actor1, actor2 = 'aaaa11', 'bbbb22'
+        change1 = {'actor': actor1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'todos', 'pred': []},
+            {'action': 'makeMap', 'obj': f'1@{actor1}', 'elemId': '_head',
+             'insert': True, 'pred': []},
+            set_op(f'2@{actor1}', 'title', 'buy milk'),
+            set_op(f'2@{actor1}', 'done', False)]}
+        change2 = {'actor': actor2, 'seq': 1, 'startOp': 5, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'del', 'obj': f'1@{actor1}', 'elemId': f'2@{actor1}',
+             'pred': [f'2@{actor1}']}]}
+        change3 = {'actor': actor1, 'seq': 2, 'startOp': 5, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            set_op(f'2@{actor1}', 'done', True, [f'4@{actor1}'])]}
+        s0 = Backend.init()
+        s1, patch1 = Backend.apply_changes(
+            s0, [encode_change(change1), encode_change(change2)])
+        s2, patch2 = Backend.apply_changes(s1, [encode_change(change3)])
+        assert patch1 == {
+            'clock': {actor1: 1, actor2: 1}, 'deps': [hash_of(change2)],
+            'maxOp': 5, 'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'todos': {
+                f'1@{actor1}': {'objectId': f'1@{actor1}', 'type': 'list',
+                                'edits': [
+                    {'action': 'insert', 'index': 0, 'elemId': f'2@{actor1}',
+                     'opId': f'2@{actor1}', 'value': {
+                        'objectId': f'2@{actor1}', 'type': 'map', 'props': {
+                            'title': {f'3@{actor1}': {'type': 'value',
+                                                      'value': 'buy milk'}},
+                            'done': {f'4@{actor1}': {'type': 'value',
+                                                     'value': False}}}}},
+                    {'action': 'remove', 'index': 0, 'count': 1}]}}}}}
+        assert patch2 == {
+            'clock': {actor1: 2, actor2: 1},
+            'deps': sorted([hash_of(change2), hash_of(change3)]),
+            'maxOp': 5, 'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {}}}
+
+    def test_nested_maps_in_lists_diff(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'todos', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'insert': True,
+             'elemId': '_head', 'pred': [], 'value': 'first'},
+            {'action': 'makeMap', 'obj': f'1@{actor}', 'elemId': f'2@{actor}',
+             'insert': True, 'pred': []},
+            set_op(f'3@{actor}', 'title', 'water plants'),
+            set_op(f'3@{actor}', 'done', False)]}
+        s0 = Backend.init()
+        s1, patch1 = Backend.apply_changes(s0, [encode_change(change1)])
+        assert patch1 == {
+            'clock': {actor: 1}, 'deps': [hash_of(change1)], 'maxOp': 5,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'todos': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'list',
+                               'edits': [
+                    {'action': 'insert', 'index': 0, 'elemId': f'2@{actor}',
+                     'opId': f'2@{actor}',
+                     'value': {'type': 'value', 'value': 'first'}},
+                    {'action': 'insert', 'index': 1, 'elemId': f'3@{actor}',
+                     'opId': f'3@{actor}', 'value': {
+                        'type': 'map', 'objectId': f'3@{actor}', 'props': {
+                            'title': {f'4@{actor}': {
+                                'type': 'value', 'value': 'water plants'}},
+                            'done': {f'5@{actor}': {
+                                'type': 'value', 'value': False}}}}}]}}}}}
+
+    def _multi_insert_case(self, datatype, values):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'todos', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'insert': True,
+             'elemId': '_head', 'pred': [], 'datatype': datatype,
+             'values': values}]}
+        s0 = Backend.init()
+        s1, patch1 = Backend.apply_changes(s0, [encode_change(change1)])
+        assert patch1 == {
+            'clock': {actor: 1}, 'deps': [hash_of(change1)],
+            'maxOp': 1 + len(values), 'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'todos': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'list',
+                               'edits': [
+                    {'action': 'multi-insert', 'index': 0,
+                     'elemId': f'2@{actor}', 'datatype': datatype,
+                     'values': values}]}}}}}
+
+    def test_multi_insert_uint(self):
+        self._multi_insert_case('uint', [1, 2, 3, 4, 5])
+
+    def test_multi_insert_float64(self):
+        self._multi_insert_case('float64', [1.0, 2.0, 3.3, 4.0, 5.0])
+
+    def test_multi_insert_timestamp(self):
+        self._multi_insert_case('timestamp', [1, 2, 3, 4, 5])
+
+    def test_multi_insert_counter(self):
+        self._multi_insert_case('counter', [1, 2, 3, 4, 5])
+
+    def test_multi_insert_datatype_mismatch_throws(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'todos', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'insert': True,
+             'elemId': '_head', 'pred': [], 'datatype': 'int',
+             'values': [1, True, 'hello']}]}
+        s0 = Backend.init()
+        with pytest.raises(Exception):
+            Backend.apply_local_change(s0, change1)
+
+
+class TestApplyLocalChangeMore:
+    """Remaining applyLocalChange cases (ref backend_test.js:788-1007)."""
+
+    def test_detects_conflicts_based_on_frontend_version(self):
+        local1 = {'requestType': 'change', 'actor': '111111', 'seq': 1,
+                  'time': 0, 'startOp': 1, 'deps': [], 'ops': [
+            set_op('_root', 'bird', 'goldfinch')]}
+        s0 = Backend.init()
+        s1, patch1, _bin = Backend.apply_local_change(s0, local1)
+        first_hash = decode_change(Backend.get_all_changes(s1)[0])['hash']
+        remote1 = {'actor': '222222', 'seq': 1, 'startOp': 2, 'time': 0,
+                   'deps': [first_hash], 'ops': [
+            set_op('_root', 'bird', 'magpie', ['1@111111'])]}
+        local2 = {'requestType': 'change', 'actor': '111111', 'seq': 2,
+                  'time': 0, 'startOp': 2, 'deps': [], 'ops': [
+            set_op('_root', 'bird', 'jay', ['1@111111'])]}
+        s2, patch2 = Backend.apply_changes(s1, [encode_change(remote1)])
+        s3, patch3, _bin = Backend.apply_local_change(s2, local2)
+        changes = [decode_change(c) for c in Backend.get_all_changes(s3)]
+        assert patch3 == {
+            'actor': '111111', 'seq': 2, 'clock': {'111111': 2, '222222': 1},
+            'deps': [hash_of(remote1)], 'maxOp': 2, 'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'bird': {
+                '2@222222': {'type': 'value', 'value': 'magpie'},
+                '2@111111': {'type': 'value', 'value': 'jay'}}}}}
+        assert changes[2]['hash'] == \
+            '7a00e28d7fbf179708a1b0045c7f9bad93366c0e69f9af15e830dae9970a9d19'
+        assert changes[2]['ops'] == [
+            {'action': 'set', 'obj': '_root', 'key': 'bird', 'insert': False,
+             'value': 'jay', 'pred': ['1@111111']}]
+
+    def test_transforms_list_indexes_into_element_ids(self):
+        remote1 = {'actor': '222222', 'seq': 1, 'startOp': 1, 'time': 0,
+                   'deps': [], 'ops': [
+            {'obj': '_root', 'action': 'makeList', 'key': 'birds', 'pred': []}]}
+        remote2 = {'actor': '222222', 'seq': 2, 'startOp': 2, 'time': 0,
+                   'deps': [hash_of(remote1)], 'ops': [
+            {'obj': '1@222222', 'action': 'set', 'elemId': '_head',
+             'insert': True, 'value': 'magpie', 'pred': []}]}
+        local1 = {'actor': '111111', 'seq': 1, 'startOp': 2, 'time': 0,
+                  'deps': [hash_of(remote1)], 'ops': [
+            {'obj': '1@222222', 'action': 'set', 'elemId': '_head',
+             'insert': True, 'value': 'goldfinch', 'pred': []}]}
+        local2 = {'actor': '111111', 'seq': 2, 'startOp': 3, 'time': 0,
+                  'deps': [], 'ops': [
+            {'obj': '1@222222', 'action': 'set', 'elemId': '2@111111',
+             'insert': True, 'value': 'wagtail', 'pred': []}]}
+        local3 = {'actor': '111111', 'seq': 3, 'startOp': 4, 'time': 0,
+                  'deps': [hash_of(remote2)], 'ops': [
+            {'obj': '1@222222', 'action': 'set', 'elemId': '2@222222',
+             'value': 'Magpie', 'pred': ['2@222222']},
+            {'obj': '1@222222', 'action': 'set', 'elemId': '2@111111',
+             'value': 'Goldfinch', 'pred': ['2@111111']}]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [encode_change(remote1)])
+        s2, _, _bin = Backend.apply_local_change(s1, local1)
+        s3, _ = Backend.apply_changes(s2, [encode_change(remote2)])
+        s4, _, _bin = Backend.apply_local_change(s3, local2)
+        s5, _, _bin = Backend.apply_local_change(s4, local3)
+        changes = [decode_change(c) for c in Backend.get_all_changes(s5)]
+        assert changes[1]['hash'] == \
+            '06392148c4a0dfff8b346ad58a3261cc15187cbf8a58779f78d54251126d4ccc'
+        assert changes[3]['hash'] == \
+            '2801c386ec2a140376f3bef285a6e6d294a2d8fb7a180da4fbb6e2bc4f550dd9'
+        assert changes[4]['hash'] == \
+            '734f1dad5fb2f10970bae2baa6ce100c3b85b43072b3799d8f2e15bcd21297fc'
+        assert changes[4]['deps'] == \
+            sorted([hash_of(remote2), changes[3]['hash']])
+        assert changes[4]['ops'] == [
+            {'obj': '1@222222', 'action': 'set', 'elemId': '2@222222',
+             'insert': False, 'value': 'Magpie', 'pred': ['2@222222']},
+            {'obj': '1@222222', 'action': 'set', 'elemId': '2@111111',
+             'insert': False, 'value': 'Goldfinch', 'pred': ['2@111111']}]
+
+    def test_local_multi_insert_int(self):
+        actor = 'aaaa11'
+        local = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'todos', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'insert': True,
+             'elemId': '_head', 'pred': [], 'datatype': 'int',
+             'values': [1, 2, 3, 4, 5]}]}
+        s0 = Backend.init()
+        s1, patch1, _bin = Backend.apply_local_change(s0, local)
+        assert patch1 == {
+            'clock': {actor: 1}, 'deps': [], 'maxOp': 6, 'actor': actor,
+            'seq': 1, 'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'todos': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'list',
+                               'edits': [
+                    {'action': 'multi-insert', 'index': 0,
+                     'elemId': f'2@{actor}', 'datatype': 'int',
+                     'values': [1, 2, 3, 4, 5]}]}}}}}
+
+    def test_local_multi_insert_float64(self):
+        actor = 'aaaa11'
+        local = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'todos', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'insert': True,
+             'elemId': '_head', 'pred': [], 'datatype': 'float64',
+             'values': [1.0, 2.0, 3.3, 4.0, 5.0]}]}
+        s0 = Backend.init()
+        s1, patch1, _bin = Backend.apply_local_change(s0, local)
+        assert patch1 == {
+            'clock': {actor: 1}, 'deps': [], 'maxOp': 6, 'actor': actor,
+            'seq': 1, 'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'todos': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'list',
+                               'edits': [
+                    {'action': 'multi-insert', 'index': 0,
+                     'elemId': f'2@{actor}', 'datatype': 'float64',
+                     'values': [1.0, 2.0, 3.3, 4.0, 5.0]}]}}}}}
+
+    def test_local_multi_delete(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'todos', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'insert': True,
+             'elemId': '_head', 'pred': [], 'datatype': 'int',
+             'values': [1, 2, 3, 4, 5]}]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 7, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'del', 'obj': f'1@{actor}', 'elemId': f'3@{actor}',
+             'multiOp': 3, 'pred': [f'3@{actor}']}]}
+        s0 = Backend.init()
+        s1, _, _bin = Backend.apply_local_change(s0, change1)
+        s2, patch2, _bin = Backend.apply_local_change(s1, change2)
+        assert patch2 == {
+            'clock': {actor: 2}, 'deps': [], 'maxOp': 9, 'actor': actor,
+            'seq': 2, 'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'todos': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'list',
+                               'edits': [
+                    {'action': 'remove', 'index': 1, 'count': 3}]}}}}}
+
+
+class TestSaveLoadMore:
+    """Remaining save/load cases (ref backend_test.js:1043-1058)."""
+
+    def test_loads_floats_correctly(self):
+        # Document bytes generated by the reference's companion Rust backend
+        # (ref backend_test.js:1043-1058): { birds: 3.0 } with float64 kept
+        # as a float through the document container.
+        data = bytes([
+            133, 111, 74, 131, 233, 181, 157, 86, 0, 144, 1, 1, 16, 228, 91,
+            238, 197, 233, 52, 66, 187, 138, 75, 115, 104, 190, 195, 159, 200,
+            1, 221, 158, 172, 238, 121, 38, 160, 123, 25, 33, 97, 124, 142,
+            27, 86, 224, 238, 83, 14, 157, 207, 233, 8, 110, 91, 151, 172, 38,
+            120, 221, 38, 162, 7, 1, 2, 3, 2, 19, 2, 35, 7, 53, 16, 64, 2, 86,
+            2, 8, 21, 7, 33, 2, 35, 2, 52, 1, 66, 2, 86, 3, 87, 8, 128, 1, 2,
+            127, 0, 127, 1, 127, 1, 127, 243, 145, 234, 194, 149, 47, 127, 14,
+            73, 110, 105, 116, 105, 97, 108, 105, 122, 97, 116, 105, 111, 110,
+            127, 0, 127, 7, 127, 5, 98, 105, 114, 100, 115, 127, 0, 127, 1, 1,
+            127, 1, 127, 133, 1, 0, 0, 0, 0, 0, 0, 8, 64, 127, 0])
+        import automerge_tpu as A
+        doc = A.load(data)
+        assert dict(doc) == {'birds': 3.0}
+        assert isinstance(doc['birds'], float)
+
+
+class TestGetPatchMore:
+    """Remaining getPatch cases (ref backend_test.js:1130-1276)."""
+
+    def test_get_patch_creates_nested_maps(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeMap', 'obj': '_root', 'key': 'birds', 'pred': []},
+            set_op(f'1@{actor}', 'wrens', 3)]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'del', 'obj': f'1@{actor}', 'key': 'wrens',
+             'pred': [f'2@{actor}']},
+            set_op(f'1@{actor}', 'sparrows', 15)]}
+        s1 = Backend.load_changes(
+            Backend.init(), [encode_change(change1), encode_change(change2)])
+        assert Backend.get_patch(s1) == {
+            'clock': {actor: 2}, 'deps': [hash_of(change2)], 'maxOp': 4,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'birds': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'map',
+                               'props': {'sparrows': {f'4@{actor}': {
+                                   'type': 'value', 'value': 15,
+                                   'datatype': 'int'}}}}}}}}
+
+    def test_get_patch_creates_lists(self):
+        actor = 'aaaa11'
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'birds', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': '_head',
+             'insert': True, 'value': 'chaffinch', 'pred': []}]}
+        s1 = Backend.load_changes(Backend.init(), [encode_change(change1)])
+        assert Backend.get_patch(s1) == {
+            'clock': {actor: 1}, 'deps': [hash_of(change1)], 'maxOp': 2,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'birds': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'list',
+                               'edits': [
+                    {'action': 'insert', 'index': 0, 'elemId': f'2@{actor}',
+                     'opId': f'2@{actor}',
+                     'value': {'type': 'value', 'value': 'chaffinch'}}]}}}}}
+
+    def test_get_patch_nested_maps_in_lists(self):
+        actor = 'aaaa11'
+        change = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'todos', 'pred': []},
+            {'action': 'makeMap', 'obj': f'1@{actor}', 'elemId': '_head',
+             'insert': True, 'pred': []},
+            set_op(f'2@{actor}', 'title', 'water plants'),
+            set_op(f'2@{actor}', 'done', False)]}
+        s1 = Backend.load_changes(Backend.init(), [encode_change(change)])
+        assert Backend.get_patch(s1) == {
+            'clock': {actor: 1}, 'deps': [hash_of(change)], 'maxOp': 4,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'todos': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'list',
+                               'edits': [
+                    {'action': 'insert', 'index': 0, 'elemId': f'2@{actor}',
+                     'opId': f'2@{actor}', 'value': {
+                        'type': 'map', 'objectId': f'2@{actor}', 'props': {
+                            'title': {f'3@{actor}': {
+                                'type': 'value', 'value': 'water plants'}},
+                            'done': {f'4@{actor}': {
+                                'type': 'value', 'value': False}}}}}]}}}}}
+
+    def test_get_patch_timestamp_at_root(self):
+        actor = 'aaaa11'
+        now_ms = 1589032171000
+        change = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            set_op('_root', 'now', now_ms, datatype='timestamp')]}
+        s1 = Backend.load_changes(Backend.init(), [encode_change(change)])
+        assert Backend.get_patch(s1) == {
+            'clock': {actor: 1}, 'deps': [hash_of(change)], 'maxOp': 1,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'now': {
+                f'1@{actor}': {'type': 'value', 'value': now_ms,
+                               'datatype': 'timestamp'}}}}}
+
+    def test_get_patch_timestamp_in_list(self):
+        actor = 'aaaa11'
+        now_ms = 1589032171000
+        change = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'list', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': '_head',
+             'insert': True, 'value': now_ms, 'datatype': 'timestamp',
+             'pred': []}]}
+        s1 = Backend.load_changes(Backend.init(), [encode_change(change)])
+        assert Backend.get_patch(s1) == {
+            'clock': {actor: 1}, 'deps': [hash_of(change)], 'maxOp': 2,
+            'pendingChanges': 0,
+            'diffs': {'objectId': '_root', 'type': 'map', 'props': {'list': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'list',
+                               'edits': [
+                    {'action': 'insert', 'index': 0, 'elemId': f'2@{actor}',
+                     'opId': f'2@{actor}',
+                     'value': {'type': 'value', 'value': now_ms,
+                               'datatype': 'timestamp'}}]}}}}}

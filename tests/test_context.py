@@ -312,3 +312,104 @@ class TestIncrement:
                                 'pred': [f'1@{ACTOR}']}]
         assert spy.calls == [{'objectId': '_root', 'type': 'map', 'props': {
             'counter': {f'2@{ACTOR}': {'value': 1, 'datatype': 'counter'}}}}]
+
+
+class TestConflictedContexts:
+    """Remaining context cases (ref context_test.js:80-119, 205-218,
+    344-359), built through the real API so conflicts are genuine."""
+
+    def test_assignment_inside_conflicted_maps(self):
+        # Two actors concurrently assign a nested map to the same key; a
+        # write through the winner must patch BOTH conflict branches (the
+        # loser gets an empty props node)
+        doc1 = am.change(am.init('aa11'),
+                         lambda d: d.update({'birds': {'robins': 1}}))
+        doc2 = am.change(am.init('bb22'),
+                         lambda d: d.update({'birds': {'wrens': 2}}))
+        merged = am.merge(doc1, doc2)
+        conflicts = am.get_conflicts(merged, 'birds')
+        assert len(conflicts) == 2
+        winner_id = Frontend.get_object_id(merged['birds'])
+        spy = PatchSpy()
+        context = Context(merged, ACTOR, apply_patch=spy)
+        context.set_map_key([{'key': 'birds', 'objectId': winner_id}],
+                            'goldfinches', 3)
+        assert context.ops == [
+            {'obj': winner_id, 'action': 'set', 'key': 'goldfinches',
+             'insert': False, 'value': 3, 'datatype': 'int', 'pred': []}]
+        branches = spy.calls[0]['props']['birds']
+        assert len(branches) == 2
+        winner_key = next(k for k, v in branches.items()
+                          if v['objectId'] == winner_id)
+        assert list(branches[winner_key]['props']['goldfinches'].values()) \
+            == [{'type': 'value', 'value': 3, 'datatype': 'int'}]
+        loser = next(v for v in branches.values()
+                     if v['objectId'] != winner_id)
+        assert loser['props'] == {}
+
+    def test_conflict_values_of_various_types(self):
+        # Conflicting values of different types all surface in the patch
+        # with their correct datatypes
+        now = datetime.datetime.now(
+            datetime.timezone.utc).replace(microsecond=0)
+        docs = [
+            am.change(am.init('aa11'), lambda d: d.update({'v': now})),
+            am.change(am.init('bb22'), lambda d: d.update({'v': Counter()})),
+            am.change(am.init('cc33'), lambda d: d.update({'v': 42})),
+            am.change(am.init('dd44'), lambda d: d.update({'v': None})),
+            am.change(am.init('ee55'), lambda d: d.update({'v': {'x': 1}})),
+        ]
+        merged = docs[0]
+        for other in docs[1:]:
+            merged = am.merge(merged, other)
+        conflicts = am.get_conflicts(merged, 'v')
+        assert len(conflicts) == 5
+        # Update inside the nested-map branch (if it won) or assign through
+        # the root; either way the context must describe all five branches
+        spy = PatchSpy()
+        context = Context(merged, ACTOR, apply_patch=spy)
+        nested_id = Frontend.get_object_id(docs[4]['v'])
+        context.set_map_key([{'key': 'v', 'objectId': nested_id}], 'x', 2)
+        branches = spy.calls[0]['props']['v']
+        assert len(branches) == 5
+        values = {k: v for k, v in branches.items()}
+        assert {'type': 'value', 'value': 42,
+                'datatype': 'int'} in values.values()
+        assert {'type': 'value', 'value': None} in values.values()
+        assert any(v.get('datatype') == 'timestamp'
+                   for v in values.values())
+        assert any(v.get('datatype') == 'counter' for v in values.values())
+        assert any(v.get('type') == 'map' for v in values.values())
+
+    def test_delete_key_in_nested_object(self):
+        doc, context, spy = make_doc(
+            lambda d: d.update({'birds': {'goldfinches': 3}}))
+        birds_id = Frontend.get_object_id(doc['birds'])
+        context.delete_map_key([{'key': 'birds', 'objectId': birds_id}],
+                               'goldfinches')
+        assert context.ops == [
+            {'obj': birds_id, 'action': 'del', 'key': 'goldfinches',
+             'insert': False, 'pred': [f'2@{ACTOR}']}]
+        branch = next(iter(spy.calls[0]['props']['birds'].values()))
+        assert branch['props'] == {'goldfinches': {}}
+
+    def test_multi_delete_consecutive_preds_after_overwrite(self):
+        # An overwritten element (pred points at the overwrite op) followed
+        # by an original element: preds 3@.. then 2@.. are NOT consecutive,
+        # so two separate del ops are emitted; but overwriting in a way that
+        # leaves preds consecutive compresses (ref context_test.js:344)
+        doc = am.change(am.init(ACTOR),
+                        lambda d: d.update({'birds': ['swallow', 'magpie']}))
+        doc = am.change(doc, lambda d: d['birds'].__setitem__(1, 'sparrow'))
+        spy = PatchSpy()
+        context = Context(doc, ACTOR, apply_patch=spy)
+        list_id = Frontend.get_object_id(doc['birds'])
+        path = [{'key': 'birds', 'objectId': list_id}]
+        context.splice(path, 0, 2, [])
+        # elemIds 2@,3@ are consecutive and preds 2@,4@ are not: the run
+        # must break on preds
+        del_ops = [op for op in context.ops if op['action'] == 'del']
+        assert [op.get('multiOp') for op in del_ops] == [None, None]
+        subpatch = next(iter(spy.calls[-1]['props']['birds'].values()))
+        assert subpatch['edits'] == [
+            {'action': 'remove', 'index': 0, 'count': 2}]

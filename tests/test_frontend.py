@@ -459,3 +459,264 @@ class TestApplyingPatches:
         doc = Frontend.apply_patch(Frontend.init(), patch)
         assert isinstance(doc['text'], Text)
         assert str(doc['text']) == 'hi'
+
+
+class TestApplyingPatchesMore:
+    """Remaining patch-application cases (ref frontend_test.js:478-763)."""
+
+    def test_updates_inside_nested_maps_from_patch(self):
+        birds, actor = uuid(), uuid()
+        patch1 = {'clock': {actor: 1}, 'deps': [], 'maxOp': 2,
+                  'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                      'birds': {actor: {
+                          'objectId': birds, 'type': 'map', 'props': {
+                              'wrens': {actor: {'type': 'value',
+                                                'value': 3}}}}}}}}
+        patch2 = {'clock': {actor: 2}, 'deps': [], 'maxOp': 3,
+                  'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                      'birds': {actor: {
+                          'objectId': birds, 'type': 'map', 'props': {
+                              'sparrows': {actor: {'type': 'value',
+                                                   'value': 15}}}}}}}}
+        doc1 = Frontend.apply_patch(Frontend.init(), patch1)
+        doc2 = Frontend.apply_patch(doc1, patch2)
+        assert doc1 == {'birds': {'wrens': 3}}
+        assert doc2 == {'birds': {'wrens': 3, 'sparrows': 15}}
+
+    def test_updates_inside_map_key_conflicts(self):
+        birds1, birds2 = uuid(), uuid()
+        patch1 = {'clock': {birds1: 1, birds2: 1}, 'deps': [], 'maxOp': 2,
+                  'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                      'favoriteBirds': {
+                          'actor1': {'objectId': birds1, 'type': 'map',
+                                     'props': {'blackbirds': {
+                                         'actor1': {'type': 'value',
+                                                    'value': 1}}}},
+                          'actor2': {'objectId': birds2, 'type': 'map',
+                                     'props': {'wrens': {
+                                         'actor2': {'type': 'value',
+                                                    'value': 3}}}}}}}}
+        patch2 = {'clock': {birds1: 2, birds2: 1}, 'deps': [], 'maxOp': 3,
+                  'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                      'favoriteBirds': {
+                          'actor1': {'objectId': birds1, 'type': 'map',
+                                     'props': {'blackbirds': {
+                                         'actor1': {'value': 2}}}},
+                          'actor2': {'objectId': birds2, 'type': 'map'}}}}}
+        doc1 = Frontend.apply_patch(Frontend.init(), patch1)
+        doc2 = Frontend.apply_patch(doc1, patch2)
+        assert doc1 == {'favoriteBirds': {'wrens': 3}}
+        assert doc2 == {'favoriteBirds': {'wrens': 3}}
+        assert Frontend.get_conflicts(doc1, 'favoriteBirds') == {
+            'actor1': {'blackbirds': 1}, 'actor2': {'wrens': 3}}
+        assert Frontend.get_conflicts(doc2, 'favoriteBirds') == {
+            'actor1': {'blackbirds': 2}, 'actor2': {'wrens': 3}}
+
+    def test_structure_shares_unmodified_objects(self):
+        birds, mammals, actor = uuid(), uuid(), uuid()
+        patch1 = {'clock': {actor: 1}, 'deps': [], 'maxOp': 4,
+                  'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                      'birds': {actor: {
+                          'objectId': birds, 'type': 'map', 'props': {
+                              'wrens': {actor: {'value': 3}}}}},
+                      'mammals': {actor: {
+                          'objectId': mammals, 'type': 'map', 'props': {
+                              'badgers': {actor: {'value': 1}}}}}}}}
+        patch2 = {'clock': {actor: 2}, 'deps': [], 'maxOp': 5,
+                  'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                      'birds': {actor: {
+                          'objectId': birds, 'type': 'map', 'props': {
+                              'sparrows': {actor: {'value': 15}}}}}}}}
+        doc1 = Frontend.apply_patch(Frontend.init(), patch1)
+        doc2 = Frontend.apply_patch(doc1, patch2)
+        assert doc1 == {'birds': {'wrens': 3}, 'mammals': {'badgers': 1}}
+        assert doc2 == {'birds': {'wrens': 3, 'sparrows': 15},
+                        'mammals': {'badgers': 1}}
+        assert doc1['mammals'] is doc2['mammals']
+
+    def test_delete_keys_in_maps_from_patch(self):
+        actor = uuid()
+        patch1 = {'clock': {actor: 1}, 'deps': [], 'maxOp': 2,
+                  'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                      'magpies': {actor: {'value': 2}},
+                      'sparrows': {actor: {'value': 15}}}}}
+        patch2 = {'clock': {actor: 2}, 'deps': [], 'maxOp': 3,
+                  'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                      'magpies': {}}}}
+        doc1 = Frontend.apply_patch(Frontend.init(), patch1)
+        doc2 = Frontend.apply_patch(doc1, patch2)
+        assert doc1 == {'magpies': 2, 'sparrows': 15}
+        assert doc2 == {'sparrows': 15}
+
+    def test_updates_inside_lists_from_patch(self):
+        birds, actor = uuid(), uuid()
+        patch1 = {'clock': {actor: 1}, 'deps': [], 'maxOp': 2,
+                  'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                      'birds': {actor: {
+                          'objectId': birds, 'type': 'list', 'edits': [
+                              {'action': 'insert', 'index': 0,
+                               'elemId': f'2@{actor}', 'opId': f'2@{actor}',
+                               'value': {'value': 'chaffinch'}}]}}}}}
+        patch2 = {'clock': {actor: 2}, 'deps': [], 'maxOp': 3,
+                  'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                      'birds': {actor: {
+                          'objectId': birds, 'type': 'list', 'edits': [
+                              {'action': 'update', 'index': 0,
+                               'opId': f'3@{actor}',
+                               'value': {'value': 'greenfinch'}}]}}}}}
+        doc1 = Frontend.apply_patch(Frontend.init(), patch1)
+        doc2 = Frontend.apply_patch(doc1, patch2)
+        assert doc1 == {'birds': ['chaffinch']}
+        assert doc2 == {'birds': ['greenfinch']}
+
+    def test_updates_inside_list_element_conflicts(self):
+        actor1, actor2 = '01234567', '89abcdef'
+        birds = f'1@{actor1}'
+        patch1 = {'clock': {actor1: 2, actor2: 1}, 'deps': [], 'maxOp': 4,
+                  'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                      'birds': {birds: {
+                          'objectId': birds, 'type': 'list', 'edits': [
+                              {'action': 'insert', 'index': 0,
+                               'elemId': f'2@{actor1}', 'opId': f'2@{actor1}',
+                               'value': {
+                                   'objectId': f'2@{actor1}', 'type': 'map',
+                                   'props': {
+                                       'species': {f'3@{actor1}': {
+                                           'type': 'value',
+                                           'value': 'woodpecker'}},
+                                       'numSeen': {f'4@{actor1}': {
+                                           'type': 'value', 'value': 1}}}}},
+                              {'action': 'update', 'index': 0,
+                               'opId': f'2@{actor2}', 'value': {
+                                   'objectId': f'2@{actor2}', 'type': 'map',
+                                   'props': {
+                                       'species': {f'3@{actor2}': {
+                                           'type': 'value',
+                                           'value': 'lapwing'}},
+                                       'numSeen': {f'4@{actor2}': {
+                                           'type': 'value', 'value': 2}}}}}]}}}}}
+        patch2 = {'clock': {actor1: 3, actor2: 1}, 'deps': [], 'maxOp': 5,
+                  'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                      'birds': {birds: {
+                          'objectId': birds, 'type': 'list', 'edits': [
+                              {'action': 'update', 'index': 0,
+                               'opId': f'2@{actor1}', 'value': {
+                                   'objectId': f'2@{actor1}', 'type': 'map',
+                                   'props': {'numSeen': {f'5@{actor1}': {
+                                       'type': 'value', 'value': 2}}}}},
+                              {'action': 'update', 'index': 0,
+                               'opId': f'2@{actor2}', 'value': {
+                                   'objectId': f'2@{actor2}', 'type': 'map',
+                                   'props': {}}}]}}}}}
+        patch3 = {'clock': {actor1: 3, actor2: 1}, 'deps': [], 'maxOp': 6,
+                  'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                      'birds': {birds: {
+                          'objectId': birds, 'type': 'list', 'edits': [
+                              {'action': 'update', 'index': 0,
+                               'opId': f'2@{actor1}', 'value': {
+                                   'objectId': f'2@{actor1}', 'type': 'map',
+                                   'props': {'numSeen': {f'6@{actor1}': {
+                                       'type': 'value', 'value': 2}}}}}]}}}}}
+        doc1 = Frontend.apply_patch(Frontend.init(), patch1)
+        doc2 = Frontend.apply_patch(doc1, patch2)
+        doc3 = Frontend.apply_patch(doc2, patch3)
+        assert doc1 == {'birds': [{'species': 'lapwing', 'numSeen': 2}]}
+        assert doc2 == {'birds': [{'species': 'lapwing', 'numSeen': 2}]}
+        assert doc3 == {'birds': [{'species': 'woodpecker', 'numSeen': 2}]}
+        assert doc1['birds'][0] is doc2['birds'][0]
+        assert Frontend.get_conflicts(doc1['birds'], 0) == {
+            f'2@{actor1}': {'species': 'woodpecker', 'numSeen': 1},
+            f'2@{actor2}': {'species': 'lapwing', 'numSeen': 2}}
+        assert Frontend.get_conflicts(doc2['birds'], 0) == {
+            f'2@{actor1}': {'species': 'woodpecker', 'numSeen': 2},
+            f'2@{actor2}': {'species': 'lapwing', 'numSeen': 2}}
+        assert Frontend.get_conflicts(doc3['birds'], 0) is None
+
+    def test_delete_list_elements_from_patch(self):
+        birds, actor = uuid(), uuid()
+        patch1 = {'clock': {actor: 1}, 'deps': [], 'maxOp': 3,
+                  'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                      'birds': {f'1@{actor}': {
+                          'objectId': birds, 'type': 'list', 'edits': [
+                              {'action': 'insert', 'index': 0,
+                               'elemId': f'2@{actor}', 'opId': f'2@{actor}',
+                               'value': {'value': 'chaffinch'}},
+                              {'action': 'insert', 'index': 1,
+                               'elemId': f'3@{actor}', 'opId': f'3@{actor}',
+                               'value': {'value': 'goldfinch'}}]}}}}}
+        patch2 = {'clock': {actor: 2}, 'deps': [], 'maxOp': 4,
+                  'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                      'birds': {f'1@{actor}': {
+                          'objectId': birds, 'type': 'list', 'props': {},
+                          'edits': [{'action': 'remove', 'index': 0,
+                                     'count': 1}]}}}}}
+        doc1 = Frontend.apply_patch(Frontend.init(), patch1)
+        doc2 = Frontend.apply_patch(doc1, patch2)
+        assert doc1 == {'birds': ['chaffinch', 'goldfinch']}
+        assert doc2 == {'birds': ['goldfinch']}
+
+    def test_delete_multiple_list_elements_from_patch(self):
+        birds, actor = uuid(), uuid()
+        patch1 = {'clock': {actor: 1}, 'deps': [], 'maxOp': 3,
+                  'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                      'birds': {f'1@{actor}': {
+                          'objectId': birds, 'type': 'list', 'edits': [
+                              {'action': 'insert', 'index': 0,
+                               'elemId': f'2@{actor}', 'opId': f'2@{actor}',
+                               'value': {'value': 'chaffinch'}},
+                              {'action': 'insert', 'index': 1,
+                               'elemId': f'3@{actor}', 'opId': f'3@{actor}',
+                               'value': {'value': 'goldfinch'}}]}}}}}
+        patch2 = {'clock': {actor: 2}, 'deps': [], 'maxOp': 4,
+                  'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                      'birds': {f'1@{actor}': {
+                          'objectId': birds, 'type': 'list', 'props': {},
+                          'edits': [{'action': 'remove', 'index': 0,
+                                     'count': 2}]}}}}}
+        doc1 = Frontend.apply_patch(Frontend.init(), patch1)
+        doc2 = Frontend.apply_patch(doc1, patch2)
+        assert doc1 == {'birds': ['chaffinch', 'goldfinch']}
+        assert doc2 == {'birds': []}
+
+    def test_updates_at_different_tree_levels(self):
+        actor = uuid()
+        patch1 = {'clock': {actor: 1}, 'deps': [], 'maxOp': 6,
+                  'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                      'counts': {f'1@{actor}': {
+                          'objectId': f'1@{actor}', 'type': 'map', 'props': {
+                              'magpies': {f'2@{actor}': {'value': 2}}}}},
+                      'details': {f'3@{actor}': {
+                          'objectId': f'3@{actor}', 'type': 'list', 'edits': [
+                              {'action': 'insert', 'index': 0,
+                               'elemId': f'4@{actor}', 'opId': f'4@{actor}',
+                               'value': {
+                                   'objectId': f'4@{actor}', 'type': 'map',
+                                   'props': {
+                                       'species': {f'5@{actor}': {
+                                           'type': 'value',
+                                           'value': 'magpie'}},
+                                       'family': {f'6@{actor}': {
+                                           'type': 'value',
+                                           'value': 'corvidae'}}}}}]}}}}}
+        patch2 = {'clock': {actor: 2}, 'deps': [], 'maxOp': 8,
+                  'diffs': {'objectId': '_root', 'type': 'map', 'props': {
+                      'counts': {f'1@{actor}': {
+                          'objectId': f'1@{actor}', 'type': 'map', 'props': {
+                              'magpies': {f'7@{actor}': {'type': 'value',
+                                                         'value': 3}}}}},
+                      'details': {f'3@{actor}': {
+                          'objectId': f'3@{actor}', 'type': 'list', 'edits': [
+                              {'action': 'update', 'index': 0,
+                               'opId': f'4@{actor}', 'value': {
+                                   'objectId': f'4@{actor}', 'type': 'map',
+                                   'props': {'species': {f'8@{actor}': {
+                                       'type': 'value',
+                                       'value': 'Eurasian magpie'}}}}}]}}}}}
+        doc1 = Frontend.apply_patch(Frontend.init(), patch1)
+        doc2 = Frontend.apply_patch(doc1, patch2)
+        assert doc1 == {'counts': {'magpies': 2},
+                        'details': [{'species': 'magpie',
+                                     'family': 'corvidae'}]}
+        assert doc2 == {'counts': {'magpies': 3},
+                        'details': [{'species': 'Eurasian magpie',
+                                     'family': 'corvidae'}]}

@@ -942,3 +942,556 @@ class TestLongSequences:
             f'{n + 2}@{A}': {'type': 'value', 'value': 'zzz'}}
         reloaded = OpSet(backend.save())
         assert reloaded.save() == backend.save()
+
+
+class TestRootOverwrites:
+    """ref new_backend_test.js:30-306 (patch grammar only: our engine's
+    block representation is a redesign, so the reference's checkColumns
+    internals don't transfer)."""
+
+    ACTOR = 'aaaa11'
+
+    def test_overwrite_root_properties_1(self):
+        actor = self.ACTOR
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'datatype': 'uint',
+             'value': 3, 'pred': []},
+            {'action': 'set', 'obj': '_root', 'key': 'y', 'datatype': 'uint',
+             'value': 4, 'pred': []}]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'datatype': 'uint',
+             'value': 5, 'pred': [f'1@{actor}']}]}
+        backend = OpSet()
+        assert backend.apply_changes([encode_change(change1)]) == full_patch(
+            {actor: 1}, [hash_of(change1)], 2,
+            {'objectId': '_root', 'type': 'map', 'props': {
+                'x': {f'1@{actor}': {'type': 'value', 'value': 3,
+                                     'datatype': 'uint'}},
+                'y': {f'2@{actor}': {'type': 'value', 'value': 4,
+                                     'datatype': 'uint'}}}})
+        assert backend.apply_changes([encode_change(change2)]) == full_patch(
+            {actor: 2}, [hash_of(change2)], 3,
+            {'objectId': '_root', 'type': 'map', 'props': {
+                'x': {f'3@{actor}': {'type': 'value', 'value': 5,
+                                     'datatype': 'uint'}}}})
+
+    def test_overwrite_root_properties_2(self):
+        actor = self.ACTOR
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'datatype': 'uint',
+             'value': 3, 'pred': []},
+            {'action': 'set', 'obj': '_root', 'key': 'y', 'datatype': 'uint',
+             'value': 4, 'pred': []}]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'set', 'obj': '_root', 'key': 'y', 'datatype': 'uint',
+             'value': 5, 'pred': [f'2@{actor}']},
+            {'action': 'set', 'obj': '_root', 'key': 'z', 'datatype': 'uint',
+             'value': 6, 'pred': []}]}
+        backend = OpSet()
+        backend.apply_changes([encode_change(change1)])
+        assert backend.apply_changes([encode_change(change2)]) == full_patch(
+            {actor: 2}, [hash_of(change2)], 4,
+            {'objectId': '_root', 'type': 'map', 'props': {
+                'y': {f'3@{actor}': {'type': 'value', 'value': 5,
+                                     'datatype': 'uint'}},
+                'z': {f'4@{actor}': {'type': 'value', 'value': 6,
+                                     'datatype': 'uint'}}}})
+
+    def test_concurrent_overwrites_of_same_value(self):
+        actor1, actor2, actor3 = '01234567', '89abcdef', 'fedcba98'
+        change1 = {'actor': actor1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'datatype': 'uint',
+             'value': 1, 'pred': []}]}
+
+        def overwrite(actor, seq, value):
+            return {'actor': actor, 'seq': seq, 'startOp': 2, 'time': 0,
+                    'deps': [hash_of(change1)], 'ops': [
+                {'action': 'set', 'obj': '_root', 'key': 'x',
+                 'datatype': 'uint', 'value': value,
+                 'pred': [f'1@{actor1}']}]}
+        change2 = overwrite(actor1, 2, 2)
+        change3 = overwrite(actor2, 1, 3)
+        change4 = overwrite(actor3, 1, 4)
+
+        def val(actor, v):
+            return {f'2@{actor}': {'type': 'value', 'value': v,
+                                   'datatype': 'uint'}}
+        backend1 = OpSet()
+        backend1.apply_changes([encode_change(change1)])
+        assert backend1.apply_changes([encode_change(change2)]) == full_patch(
+            {actor1: 2}, [hash_of(change2)], 2,
+            {'objectId': '_root', 'type': 'map',
+             'props': {'x': val(actor1, 2)}})
+        assert backend1.apply_changes([encode_change(change3)]) == full_patch(
+            {actor1: 2, actor2: 1},
+            [hash_of(change2), hash_of(change3)], 2,
+            {'objectId': '_root', 'type': 'map',
+             'props': {'x': dict(**val(actor1, 2), **val(actor2, 3))}})
+        assert backend1.apply_changes([encode_change(change4)]) == full_patch(
+            {actor1: 2, actor2: 1, actor3: 1},
+            [hash_of(change2), hash_of(change3), hash_of(change4)], 2,
+            {'objectId': '_root', 'type': 'map',
+             'props': {'x': dict(**val(actor1, 2), **val(actor2, 3),
+                                 **val(actor3, 4))}})
+        # Apply in a different order on a second backend
+        backend2 = OpSet()
+        backend2.apply_changes([encode_change(change1)])
+        assert backend2.apply_changes([encode_change(change4)]) == full_patch(
+            {actor1: 1, actor3: 1}, [hash_of(change4)], 2,
+            {'objectId': '_root', 'type': 'map',
+             'props': {'x': val(actor3, 4)}})
+        assert backend2.apply_changes([encode_change(change3)]) == full_patch(
+            {actor1: 1, actor2: 1, actor3: 1},
+            [hash_of(change3), hash_of(change4)], 2,
+            {'objectId': '_root', 'type': 'map',
+             'props': {'x': dict(**val(actor2, 3), **val(actor3, 4))}})
+        assert backend2.apply_changes([encode_change(change2)]) == full_patch(
+            {actor1: 2, actor2: 1, actor3: 1},
+            [hash_of(change2), hash_of(change3), hash_of(change4)], 2,
+            {'objectId': '_root', 'type': 'map',
+             'props': {'x': dict(**val(actor1, 2), **val(actor2, 3),
+                                 **val(actor3, 4))}})
+
+    def test_conflict_resolution(self):
+        actor1, actor2 = '01234567', '89abcdef'
+        change1 = {'actor': actor1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'datatype': 'uint',
+             'value': 1, 'pred': []}]}
+        change2 = {'actor': actor2, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'datatype': 'uint',
+             'value': 2, 'pred': []}]}
+        change3 = {'actor': actor1, 'seq': 2, 'startOp': 2, 'time': 0,
+                   'deps': [hash_of(change1), hash_of(change2)], 'ops': [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'datatype': 'uint',
+             'value': 3, 'pred': [f'1@{actor1}', f'1@{actor2}']}]}
+        backend = OpSet()
+        backend.apply_changes([encode_change(change1)])
+        assert backend.apply_changes([encode_change(change2)]) == full_patch(
+            {actor1: 1, actor2: 1}, [hash_of(change1), hash_of(change2)], 1,
+            {'objectId': '_root', 'type': 'map', 'props': {'x': {
+                f'1@{actor1}': {'type': 'value', 'value': 1,
+                                'datatype': 'uint'},
+                f'1@{actor2}': {'type': 'value', 'value': 2,
+                                'datatype': 'uint'}}}})
+        assert backend.apply_changes([encode_change(change3)]) == full_patch(
+            {actor1: 2, actor2: 1}, [hash_of(change3)], 2,
+            {'objectId': '_root', 'type': 'map', 'props': {'x': {
+                f'2@{actor1}': {'type': 'value', 'value': 3,
+                                'datatype': 'uint'}}}})
+
+    def test_missing_pred_error_1(self):
+        actor = self.ACTOR
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'datatype': 'uint',
+             'value': 1, 'pred': []},
+            {'action': 'set', 'obj': '_root', 'key': 'y', 'datatype': 'uint',
+             'value': 2, 'pred': []}]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'datatype': 'uint',
+             'value': 3, 'pred': [f'2@{actor}']}]}
+        backend = OpSet()
+        backend.apply_changes([encode_change(change1)])
+        with pytest.raises(Exception, match='[Pp]red'):
+            backend.apply_changes([encode_change(change2)])
+
+    def test_missing_pred_error_2(self):
+        actor1, actor2 = '01234567', '89abcdef'
+        change1 = {'actor': actor1, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'datatype': 'uint',
+             'value': 1, 'pred': []}]}
+        change2 = {'actor': actor2, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'set', 'obj': '_root', 'key': 'w', 'datatype': 'uint',
+             'value': 2, 'pred': []},
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'datatype': 'uint',
+             'value': 2, 'pred': []}]}
+        change3 = {'actor': actor1, 'seq': 2, 'startOp': 2, 'time': 0,
+                   'deps': [hash_of(change1), hash_of(change2)], 'ops': [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'datatype': 'uint',
+             'value': 3, 'pred': [f'1@{actor2}']}]}
+        backend = OpSet()
+        backend.apply_changes([encode_change(change1)])
+        backend.apply_changes([encode_change(change2)])
+        with pytest.raises(Exception, match='[Pp]red'):
+            backend.apply_changes([encode_change(change3)])
+
+
+class TestNestedObjectCreation:
+    """ref new_backend_test.js:308-414"""
+
+    ACTOR = 'aaaa11'
+
+    def test_create_and_update_nested_maps(self):
+        actor = self.ACTOR
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeMap', 'obj': '_root', 'key': 'map', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'key': 'x', 'value': 'a', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'key': 'y', 'value': 'b', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'key': 'z', 'value': 'c', 'pred': []}]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 5, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{actor}', 'key': 'y', 'value': 'B',
+             'pred': [f'3@{actor}']}]}
+        backend = OpSet()
+        assert backend.apply_changes([encode_change(change1)]) == full_patch(
+            {actor: 1}, [hash_of(change1)], 4,
+            {'objectId': '_root', 'type': 'map', 'props': {'map': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'map',
+                               'props': {
+                    'x': {f'2@{actor}': {'type': 'value', 'value': 'a'}},
+                    'y': {f'3@{actor}': {'type': 'value', 'value': 'b'}},
+                    'z': {f'4@{actor}': {'type': 'value', 'value': 'c'}}}}}}})
+        assert backend.apply_changes([encode_change(change2)]) == full_patch(
+            {actor: 2}, [hash_of(change2)], 5,
+            {'objectId': '_root', 'type': 'map', 'props': {'map': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'map',
+                               'props': {'y': {f'5@{actor}': {
+                                   'type': 'value', 'value': 'B'}}}}}}})
+
+    def test_nested_maps_several_levels_deep(self):
+        actor = self.ACTOR
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeMap', 'obj': '_root', 'key': 'a', 'pred': []},
+            {'action': 'makeMap', 'obj': f'1@{actor}', 'key': 'b', 'pred': []},
+            {'action': 'makeMap', 'obj': f'2@{actor}', 'key': 'c', 'pred': []},
+            {'action': 'set', 'obj': f'3@{actor}', 'key': 'd',
+             'datatype': 'uint', 'value': 1, 'pred': []}]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 5, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'set', 'obj': f'3@{actor}', 'key': 'd',
+             'datatype': 'uint', 'value': 2, 'pred': [f'4@{actor}']}]}
+
+        def nested(leaf):
+            return {'objectId': '_root', 'type': 'map', 'props': {'a': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'map',
+                               'props': {'b': {
+                    f'2@{actor}': {'objectId': f'2@{actor}', 'type': 'map',
+                                   'props': {'c': {
+                        f'3@{actor}': {'objectId': f'3@{actor}',
+                                       'type': 'map',
+                                       'props': {'d': leaf}}}}}}}}}}}
+        backend = OpSet()
+        assert backend.apply_changes([encode_change(change1)]) == full_patch(
+            {actor: 1}, [hash_of(change1)], 4,
+            nested({f'4@{actor}': {'type': 'value', 'value': 1,
+                                   'datatype': 'uint'}}))
+        assert backend.apply_changes([encode_change(change2)]) == full_patch(
+            {actor: 2}, [hash_of(change2)], 5,
+            nested({f'5@{actor}': {'type': 'value', 'value': 2,
+                                   'datatype': 'uint'}}))
+
+
+class TestTextOperations:
+    """ref new_backend_test.js:416-910"""
+
+    ACTOR = 'aaaa11'
+
+    def _make_text(self, actor, chars):
+        ops = [{'action': 'makeText', 'obj': '_root', 'key': 'text',
+                'insert': False, 'pred': []}]
+        prev = '_head'
+        for i, ch in enumerate(chars):
+            ops.append({'action': 'set', 'obj': f'1@{actor}', 'elemId': prev,
+                        'insert': True, 'value': ch, 'pred': []})
+            prev = f'{i + 2}@{actor}'
+        return {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0,
+                'deps': [], 'ops': ops}
+
+    def test_create_text_object(self):
+        actor = self.ACTOR
+        change1 = self._make_text(actor, ['a'])
+        backend = OpSet()
+        assert backend.apply_changes([encode_change(change1)]) == full_patch(
+            {actor: 1}, [hash_of(change1)], 2,
+            {'objectId': '_root', 'type': 'map', 'props': {'text': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'text',
+                               'edits': [
+                    {'action': 'insert', 'index': 0, 'elemId': f'2@{actor}',
+                     'opId': f'2@{actor}',
+                     'value': {'type': 'value', 'value': 'a'}}]}}}})
+
+    def test_insert_text_characters(self):
+        actor = self.ACTOR
+        change1 = self._make_text(actor, ['a', 'b'])
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 4, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': f'3@{actor}',
+             'insert': True, 'value': 'c', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': f'4@{actor}',
+             'insert': True, 'value': 'd', 'pred': []}]}
+        backend = OpSet()
+        assert backend.apply_changes([encode_change(change1)]) == full_patch(
+            {actor: 1}, [hash_of(change1)], 3,
+            {'objectId': '_root', 'type': 'map', 'props': {'text': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'text',
+                               'edits': [
+                    {'action': 'multi-insert', 'index': 0,
+                     'elemId': f'2@{actor}', 'values': ['a', 'b']}]}}}})
+        assert backend.apply_changes([encode_change(change2)]) == full_patch(
+            {actor: 2}, [hash_of(change2)], 5,
+            {'objectId': '_root', 'type': 'map', 'props': {'text': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'text',
+                               'edits': [
+                    {'action': 'multi-insert', 'index': 2,
+                     'elemId': f'4@{actor}', 'values': ['c', 'd']}]}}}})
+
+    def test_missing_insertion_reference_error(self):
+        actor = self.ACTOR
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeText', 'obj': '_root', 'key': 'text',
+             'insert': False, 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': '_head',
+             'insert': True, 'value': 'a', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': f'2@{actor}',
+             'insert': True, 'value': 'b', 'pred': []},
+            {'action': 'makeMap', 'obj': '_root', 'key': 'map',
+             'insert': False, 'pred': []},
+            {'action': 'set', 'obj': f'4@{actor}', 'key': 'foo',
+             'insert': False, 'value': 'c', 'pred': []}]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 6, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': f'4@{actor}',
+             'insert': True, 'value': 'd', 'pred': []}]}
+        backend = OpSet()
+        backend.apply_changes([encode_change(change1)])
+        with pytest.raises(Exception):
+            backend.apply_changes([encode_change(change2)])
+
+    def test_non_consecutive_insertions(self):
+        actor = self.ACTOR
+        change1 = self._make_text(actor, ['a', 'c'])
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 4, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': f'2@{actor}',
+             'insert': True, 'value': 'b', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': f'3@{actor}',
+             'insert': True, 'value': 'd', 'pred': []}]}
+        backend = OpSet()
+        backend.apply_changes([encode_change(change1)])
+        assert backend.apply_changes([encode_change(change2)]) == full_patch(
+            {actor: 2}, [hash_of(change2)], 5,
+            {'objectId': '_root', 'type': 'map', 'props': {'text': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'text',
+                               'edits': [
+                    {'action': 'insert', 'index': 1, 'elemId': f'4@{actor}',
+                     'opId': f'4@{actor}',
+                     'value': {'type': 'value', 'value': 'b'}},
+                    {'action': 'insert', 'index': 3, 'elemId': f'5@{actor}',
+                     'opId': f'5@{actor}',
+                     'value': {'type': 'value', 'value': 'd'}}]}}}})
+
+    def test_delete_first_character(self):
+        actor = self.ACTOR
+        change1 = self._make_text(actor, ['a'])
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'del', 'obj': f'1@{actor}', 'elemId': f'2@{actor}',
+             'pred': [f'2@{actor}']}]}
+        backend = OpSet()
+        backend.apply_changes([encode_change(change1)])
+        assert backend.apply_changes([encode_change(change2)]) == full_patch(
+            {actor: 2}, [hash_of(change2)], 3,
+            {'objectId': '_root', 'type': 'map', 'props': {'text': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'text',
+                               'edits': [{'action': 'remove', 'index': 0,
+                                          'count': 1}]}}}})
+
+    def test_delete_character_in_middle(self):
+        actor = self.ACTOR
+        change1 = self._make_text(actor, ['a', 'b', 'c'])
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 5, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'del', 'obj': f'1@{actor}', 'elemId': f'3@{actor}',
+             'insert': False, 'pred': [f'3@{actor}']}]}
+        backend = OpSet()
+        backend.apply_changes([encode_change(change1)])
+        assert backend.apply_changes([encode_change(change2)]) == full_patch(
+            {actor: 2}, [hash_of(change2)], 5,
+            {'objectId': '_root', 'type': 'map', 'props': {'text': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'text',
+                               'edits': [{'action': 'remove', 'index': 1,
+                                          'count': 1}]}}}})
+
+    def test_deleted_element_missing_error(self):
+        actor = self.ACTOR
+        change1 = self._make_text(actor, ['a'])
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'del', 'obj': f'1@{actor}', 'elemId': f'9@{actor}',
+             'pred': [f'9@{actor}']}]}
+        backend = OpSet()
+        backend.apply_changes([encode_change(change1)])
+        with pytest.raises(Exception):
+            backend.apply_changes([encode_change(change2)])
+
+    def test_multiple_list_element_updates(self):
+        actor = self.ACTOR
+        change1 = self._make_text(actor, ['a', 'b', 'c'])
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 5, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': f'2@{actor}',
+             'insert': False, 'value': 'A', 'pred': [f'2@{actor}']},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': f'4@{actor}',
+             'insert': False, 'value': 'C', 'pred': [f'4@{actor}']}]}
+        backend = OpSet()
+        backend.apply_changes([encode_change(change1)])
+        assert backend.apply_changes([encode_change(change2)]) == full_patch(
+            {actor: 2}, [hash_of(change2)], 6,
+            {'objectId': '_root', 'type': 'map', 'props': {'text': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'text',
+                               'edits': [
+                    {'action': 'update', 'index': 0, 'opId': f'5@{actor}',
+                     'value': {'type': 'value', 'value': 'A'}},
+                    {'action': 'update', 'index': 2, 'opId': f'6@{actor}',
+                     'value': {'type': 'value', 'value': 'C'}}]}}}})
+
+    def test_list_element_updates_in_reverse_order(self):
+        actor = self.ACTOR
+        change1 = self._make_text(actor, ['a', 'b', 'c'])
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 5, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': f'4@{actor}',
+             'insert': False, 'value': 'C', 'pred': [f'4@{actor}']},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': f'2@{actor}',
+             'insert': False, 'value': 'A', 'pred': [f'2@{actor}']}]}
+        backend = OpSet()
+        backend.apply_changes([encode_change(change1)])
+        assert backend.apply_changes([encode_change(change2)]) == full_patch(
+            {actor: 2}, [hash_of(change2)], 6,
+            {'objectId': '_root', 'type': 'map', 'props': {'text': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'text',
+                               'edits': [
+                    {'action': 'update', 'index': 2, 'opId': f'5@{actor}',
+                     'value': {'type': 'value', 'value': 'C'}},
+                    {'action': 'update', 'index': 0, 'opId': f'6@{actor}',
+                     'value': {'type': 'value', 'value': 'A'}}]}}}})
+
+
+class TestListObjectsAndCounters:
+    """ref new_backend_test.js:1017-1280"""
+
+    ACTOR = 'aaaa11'
+
+    def test_nested_objects_inside_list_elements(self):
+        actor = self.ACTOR
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'list',
+             'insert': False, 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': '_head',
+             'insert': True, 'datatype': 'uint', 'value': 1, 'pred': []},
+            {'action': 'makeMap', 'obj': f'1@{actor}', 'elemId': f'2@{actor}',
+             'insert': True, 'pred': []}]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 4, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'set', 'obj': f'3@{actor}', 'key': 'x',
+             'insert': False, 'datatype': 'uint', 'value': 2, 'pred': []}]}
+        backend = OpSet()
+        assert backend.apply_changes([encode_change(change1)]) == full_patch(
+            {actor: 1}, [hash_of(change1)], 3,
+            {'objectId': '_root', 'type': 'map', 'props': {'list': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'list',
+                               'edits': [
+                    {'action': 'insert', 'index': 0, 'elemId': f'2@{actor}',
+                     'opId': f'2@{actor}',
+                     'value': {'type': 'value', 'value': 1,
+                               'datatype': 'uint'}},
+                    {'action': 'insert', 'index': 1, 'elemId': f'3@{actor}',
+                     'opId': f'3@{actor}',
+                     'value': {'objectId': f'3@{actor}', 'type': 'map',
+                               'props': {}}}]}}}})
+        assert backend.apply_changes([encode_change(change2)]) == full_patch(
+            {actor: 2}, [hash_of(change2)], 4,
+            {'objectId': '_root', 'type': 'map', 'props': {'list': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'list',
+                               'edits': [
+                    {'action': 'update', 'index': 1, 'opId': f'3@{actor}',
+                     'value': {'objectId': f'3@{actor}', 'type': 'map',
+                               'props': {'x': {f'4@{actor}': {
+                                   'type': 'value', 'value': 2,
+                                   'datatype': 'uint'}}}}}]}}}})
+
+    def test_multiple_list_objects(self):
+        actor = self.ACTOR
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'makeList', 'obj': '_root', 'key': 'list1',
+             'insert': False, 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': '_head',
+             'insert': True, 'datatype': 'uint', 'value': 1, 'pred': []},
+            {'action': 'makeList', 'obj': '_root', 'key': 'list2',
+             'insert': False, 'pred': []},
+            {'action': 'set', 'obj': f'3@{actor}', 'elemId': '_head',
+             'insert': True, 'datatype': 'uint', 'value': 2, 'pred': []}]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 5, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': f'2@{actor}',
+             'insert': True, 'datatype': 'uint', 'value': 3, 'pred': []}]}
+        backend = OpSet()
+        assert backend.apply_changes([encode_change(change1)]) == full_patch(
+            {actor: 1}, [hash_of(change1)], 4,
+            {'objectId': '_root', 'type': 'map', 'props': {
+                'list1': {f'1@{actor}': {
+                    'objectId': f'1@{actor}', 'type': 'list', 'edits': [
+                        {'action': 'insert', 'index': 0,
+                         'elemId': f'2@{actor}', 'opId': f'2@{actor}',
+                         'value': {'type': 'value', 'value': 1,
+                                   'datatype': 'uint'}}]}},
+                'list2': {f'3@{actor}': {
+                    'objectId': f'3@{actor}', 'type': 'list', 'edits': [
+                        {'action': 'insert', 'index': 0,
+                         'elemId': f'4@{actor}', 'opId': f'4@{actor}',
+                         'value': {'type': 'value', 'value': 2,
+                                   'datatype': 'uint'}}]}}}})
+        assert backend.apply_changes([encode_change(change2)]) == full_patch(
+            {actor: 2}, [hash_of(change2)], 5,
+            {'objectId': '_root', 'type': 'map', 'props': {'list1': {
+                f'1@{actor}': {'objectId': f'1@{actor}', 'type': 'list',
+                               'edits': [
+                    {'action': 'insert', 'index': 1, 'elemId': f'5@{actor}',
+                     'opId': f'5@{actor}',
+                     'value': {'type': 'value', 'value': 3,
+                               'datatype': 'uint'}}]}}}})
+
+    def test_counter_inside_map(self):
+        actor = self.ACTOR
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'set', 'obj': '_root', 'key': 'counter', 'value': 1,
+             'datatype': 'counter', 'pred': []}]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 2, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'inc', 'obj': '_root', 'key': 'counter',
+             'datatype': 'uint', 'value': 2, 'pred': [f'1@{actor}']}]}
+        change3 = {'actor': actor, 'seq': 3, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change2)], 'ops': [
+            {'action': 'inc', 'obj': '_root', 'key': 'counter',
+             'datatype': 'uint', 'value': 3, 'pred': [f'1@{actor}']}]}
+        backend = OpSet()
+        for change, value in ((change1, 1), (change2, 3), (change3, 6)):
+            patch = backend.apply_changes([encode_change(change)])
+            assert patch['diffs']['props'] == {'counter': {f'1@{actor}': {
+                'type': 'value', 'value': value, 'datatype': 'counter'}}}
+
+    def test_delete_counter_from_map(self):
+        actor = self.ACTOR
+        change1 = {'actor': actor, 'seq': 1, 'startOp': 1, 'time': 0, 'deps': [], 'ops': [
+            {'action': 'set', 'obj': '_root', 'key': 'counter', 'value': 1,
+             'datatype': 'counter', 'pred': []}]}
+        change2 = {'actor': actor, 'seq': 2, 'startOp': 2, 'time': 0,
+                   'deps': [hash_of(change1)], 'ops': [
+            {'action': 'inc', 'obj': '_root', 'key': 'counter', 'value': 2,
+             'datatype': 'uint', 'pred': [f'1@{actor}']}]}
+        change3 = {'actor': actor, 'seq': 3, 'startOp': 3, 'time': 0,
+                   'deps': [hash_of(change2)], 'ops': [
+            {'action': 'del', 'obj': '_root', 'key': 'counter',
+             'pred': [f'1@{actor}']}]}
+        backend = OpSet()
+        backend.apply_changes([encode_change(change1)])
+        assert backend.apply_changes([encode_change(change2)]) == full_patch(
+            {actor: 2}, [hash_of(change2)], 2,
+            {'objectId': '_root', 'type': 'map', 'props': {'counter': {
+                f'1@{actor}': {'type': 'value', 'value': 3,
+                               'datatype': 'counter'}}}})
+        assert backend.apply_changes([encode_change(change3)]) == full_patch(
+            {actor: 3}, [hash_of(change3)], 3,
+            {'objectId': '_root', 'type': 'map', 'props': {'counter': {}}})

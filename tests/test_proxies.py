@@ -188,3 +188,208 @@ class TestListObject:
         doc = list_doc()
         doc = am.change(doc, lambda d: d['noble'].reverse())
         assert doc['noble'] == ['platinum', 'gold', 'silver']
+
+
+def num_doc():
+    """ref proxies_test.js:97-105 fixture: list [1,2,3] + empty + objects."""
+    return am.change(am.init(), lambda d: d.update(
+        {'list': [1, 2, 3], 'empty': [],
+         'listObjects': [{'id': 'first'}, {'id': 'second'}]}))
+
+
+class TestListReadOnlyMethods:
+    """Pythonic equivalents of the reference's JS Array read-only method
+    suite (ref proxies_test.js:181-392)."""
+
+    def test_concat(self):
+        def check(d):
+            assert list(d['list']) + [4] == [1, 2, 3, 4]
+            assert list(d['list']) + [4, 5, 6] == [1, 2, 3, 4, 5, 6]
+        am.change(num_doc(), check)
+
+    def test_entries(self):
+        def check(d):
+            assert list(enumerate(d['list'])) == [(0, 1), (1, 2), (2, 3)]
+        am.change(num_doc(), check)
+
+    def test_every(self):
+        def check(d):
+            assert all(x > 0 for x in d['list'])
+            assert not all(x > 2 for x in d['list'])
+        am.change(num_doc(), check)
+
+    def test_filter(self):
+        def check(d):
+            assert [x for x in d['list'] if False] == []
+            assert [x for x in d['list'] if x % 2 == 1] == [1, 3]
+            assert [x for x in d['list'] if True] == [1, 2, 3]
+        am.change(num_doc(), check)
+
+    def test_find(self):
+        def check(d):
+            assert next((x for x in d['list'] if x >= 2), None) == 2
+            assert next((x for x in d['list'] if x >= 4), None) is None
+        am.change(num_doc(), check)
+
+    def test_find_index(self):
+        def check(d):
+            assert next((i for i, x in enumerate(d['list']) if x >= 2),
+                        -1) == 1
+            assert next((i for i, x in enumerate(d['list']) if x >= 4),
+                        -1) == -1
+        am.change(num_doc(), check)
+
+    def test_for_each(self):
+        def check(d):
+            copy = []
+            for x in d['list']:
+                copy.append(x)
+            assert copy == [1, 2, 3]
+        am.change(num_doc(), check)
+
+    def test_includes(self):
+        def check(d):
+            assert 3 in list(d['list'])
+            assert 0 not in list(d['list'])
+        am.change(num_doc(), check)
+
+    def test_index_of(self):
+        def check(d):
+            assert d['list'].index(2) == 1
+            with pytest.raises(ValueError):
+                d['list'].index(4)
+        am.change(num_doc(), check)
+
+    def test_index_of_with_objects(self):
+        def check(d):
+            objs = d['listObjects']
+            assert [o['id'] for o in objs].index('second') == 1
+        am.change(num_doc(), check)
+
+    def test_join(self):
+        def check(d):
+            assert ','.join(str(x) for x in d['list']) == '1,2,3'
+            assert ' '.join(str(x) for x in d['list']) == '1 2 3'
+        am.change(num_doc(), check)
+
+    def test_keys(self):
+        def check(d):
+            assert list(range(len(d['list']))) == [0, 1, 2]
+        am.change(num_doc(), check)
+
+    def test_last_index_of(self):
+        doc = am.change(am.init(), lambda d: d.update({'list': [1, 2, 3, 2]}))
+
+        def check(d):
+            lst = list(d['list'])
+            assert len(lst) - 1 - lst[::-1].index(2) == 3
+        am.change(doc, check)
+
+    def test_map(self):
+        def check(d):
+            assert [x * 2 for x in d['list']] == [2, 4, 6]
+        am.change(num_doc(), check)
+
+    def test_reduce(self):
+        import functools
+        def check(d):
+            assert functools.reduce(lambda a, x: a + x, d['list'], 0) == 6
+        am.change(num_doc(), check)
+
+    def test_reduce_right(self):
+        import functools
+        def check(d):
+            assert functools.reduce(lambda a, x: a + str(x),
+                                    reversed(list(d['list'])), '') == '321'
+        am.change(num_doc(), check)
+
+    def test_slice(self):
+        def check(d):
+            assert d['list'][1:] == [2, 3]
+            assert d['list'][:2] == [1, 2]
+            assert d['list'][1:2] == [2]
+        am.change(num_doc(), check)
+
+    def test_some(self):
+        def check(d):
+            assert any(x == 2 for x in d['list'])
+            assert not any(x == 9 for x in d['list'])
+        am.change(num_doc(), check)
+
+    def test_to_string(self):
+        def check(d):
+            assert str(list(d['list'])) == '[1, 2, 3]'
+        am.change(num_doc(), check)
+
+    def test_values(self):
+        def check(d):
+            assert list(iter(d['list'])) == [1, 2, 3]
+        am.change(num_doc(), check)
+
+    def test_mutation_of_objects_from_iteration(self):
+        doc = num_doc()
+
+        def mutate(d):
+            for obj in d['listObjects']:
+                if obj['id'] == 'first':
+                    obj['id'] = 'FIRST'
+        doc = am.change(doc, mutate)
+        assert doc['listObjects'][0]['id'] == 'FIRST'
+
+    def test_mutation_of_objects_from_readonly_lookup(self):
+        doc = num_doc()
+
+        def mutate(d):
+            found = next(o for o in d['listObjects'] if o['id'] == 'second')
+            found['id'] = 'SECOND'
+        doc = am.change(doc, mutate)
+        assert doc['listObjects'][1]['id'] == 'SECOND'
+
+
+class TestListMutationMethods:
+    """ref proxies_test.js:394-456"""
+
+    def test_pop(self):
+        doc = num_doc()
+
+        def m(d):
+            assert d['list'].pop() == 3
+            assert d['list'].pop() == 2
+            assert d['list'].pop() == 1
+            with pytest.raises(IndexError):
+                d['list'].pop()
+        doc = am.change(doc, m)
+        assert list(doc['list']) == []
+
+    def test_push(self):
+        doc = am.change(am.init(), lambda d: d.update({'noodles': []}))
+        doc = am.change(doc, lambda d: d['noodles'].append('udon', 'soba'))
+        doc = am.change(doc, lambda d: d['noodles'].append('ramen'))
+        assert list(doc['noodles']) == ['udon', 'soba', 'ramen']
+        assert len(doc['noodles']) == 3
+
+    def test_shift(self):
+        doc = num_doc()
+
+        def m(d):
+            assert d['list'].pop(0) == 1
+            assert d['list'].pop(0) == 2
+            assert d['list'].pop(0) == 3
+            with pytest.raises(IndexError):
+                d['list'].pop(0)
+        doc = am.change(doc, m)
+        assert list(doc['list']) == []
+
+    def test_splice(self):
+        doc = num_doc()
+        doc = am.change(doc, lambda d: d['list'].delete_at(1, 2))
+        assert list(doc['list']) == [1]
+        doc = am.change(doc, lambda d: d['list'].insert_at(1, 'a', 'b'))
+        assert list(doc['list']) == [1, 'a', 'b']
+
+    def test_unshift(self):
+        doc = am.change(am.init(), lambda d: d.update({'noodles': []}))
+        doc = am.change(doc, lambda d: d['noodles'].insert_at(0, 'soba'))
+        doc = am.change(doc, lambda d: d['noodles'].insert_at(0, 'udon'))
+        assert list(doc['noodles']) == ['udon', 'soba']
+        assert len(doc['noodles']) == 2

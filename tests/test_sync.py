@@ -299,3 +299,450 @@ class TestSyncStateEncoding:
             decode_sync_state(bytes([0x42, 0]))
         with pytest.raises(ValueError, match='Unexpected message type'):
             decode_sync_message(bytes([0x43, 0]))
+
+
+class TestSyncExchangeDetails:
+    """Message-level exchange assertions (ref sync_test.js:127-273)."""
+
+    def test_no_messages_once_synced(self):
+        n1, n2 = A.init('abc123'), A.init('def456')
+        s1, s2 = init_sync_state(), init_sync_state()
+        for i in range(5):
+            n1 = A.change(n1, {'time': 0}, lambda d, i=i: d.update({'x': i}))
+        for i in range(5):
+            n2 = A.change(n2, {'time': 0}, lambda d, i=i: d.update({'y': i}))
+
+        s1, message = A.generate_sync_message(n1, s1)
+        n2, s2, patch = A.receive_sync_message(n2, s2, message)
+        s2, message = A.generate_sync_message(n2, s2)
+        assert len(decode_sync_message(message)['changes']) == 5
+        assert patch is None
+
+        n1, s1, patch = A.receive_sync_message(n1, s1, message)
+        s1, message = A.generate_sync_message(n1, s1)
+        assert len(decode_sync_message(message)['changes']) == 5
+        assert patch['diffs']['props'] == {
+            'y': {'5@def456': {'type': 'value', 'value': 4,
+                               'datatype': 'int'}}}
+
+        n2, s2, patch = A.receive_sync_message(n2, s2, message)
+        s2, message = A.generate_sync_message(n2, s2)
+        assert patch['diffs']['props'] == {
+            'x': {'5@abc123': {'type': 'value', 'value': 4,
+                               'datatype': 'int'}}}
+
+        n1, s1, patch = A.receive_sync_message(n1, s1, message)
+        s1, message = A.generate_sync_message(n1, s1)
+        assert message is None
+        assert patch is None
+        s2, message = A.generate_sync_message(n2, s2)
+        assert message is None
+
+    def test_simultaneous_messages_during_synchronization(self):
+        n1, n2 = A.init('abc123'), A.init('def456')
+        s1, s2 = init_sync_state(), init_sync_state()
+        for i in range(5):
+            n1 = A.change(n1, {'time': 0}, lambda d, i=i: d.update({'x': i}))
+        for i in range(5):
+            n2 = A.change(n2, {'time': 0}, lambda d, i=i: d.update({'y': i}))
+        head1, head2 = get_heads(n1)[0], get_heads(n2)[0]
+
+        s1, msg1to2 = A.generate_sync_message(n1, s1)
+        s2, msg2to1 = A.generate_sync_message(n2, s2)
+        assert len(decode_sync_message(msg1to2)['changes']) == 0
+        assert len(decode_sync_message(msg1to2)['have'][0]['lastSync']) == 0
+        assert len(decode_sync_message(msg2to1)['changes']) == 0
+        assert len(decode_sync_message(msg2to1)['have'][0]['lastSync']) == 0
+
+        n1, s1, patch1 = A.receive_sync_message(n1, s1, msg2to1)
+        assert patch1 is None
+        n2, s2, patch2 = A.receive_sync_message(n2, s2, msg1to2)
+        assert patch2 is None
+
+        s1, msg1to2 = A.generate_sync_message(n1, s1)
+        assert len(decode_sync_message(msg1to2)['changes']) == 5
+        s2, msg2to1 = A.generate_sync_message(n2, s2)
+        assert len(decode_sync_message(msg2to1)['changes']) == 5
+
+        n1, s1, patch1 = A.receive_sync_message(n1, s1, msg2to1)
+        assert Backend.get_missing_deps(
+            A.Frontend.get_backend_state(n1)) == []
+        assert patch1 is not None
+        assert dict(n1) == {'x': 4, 'y': 4}
+
+        n2, s2, patch2 = A.receive_sync_message(n2, s2, msg1to2)
+        assert Backend.get_missing_deps(
+            A.Frontend.get_backend_state(n2)) == []
+        assert patch2 is not None
+        assert dict(n2) == {'x': 4, 'y': 4}
+
+        s1, msg1to2 = A.generate_sync_message(n1, s1)
+        assert len(decode_sync_message(msg1to2)['changes']) == 0
+        s2, msg2to1 = A.generate_sync_message(n2, s2)
+        assert len(decode_sync_message(msg2to1)['changes']) == 0
+
+        n1, s1, patch1 = A.receive_sync_message(n1, s1, msg2to1)
+        n2, s2, patch2 = A.receive_sync_message(n2, s2, msg1to2)
+        assert s1['sharedHeads'] == sorted([head1, head2])
+        assert s2['sharedHeads'] == sorted([head1, head2])
+        assert patch1 is None
+        assert patch2 is None
+
+        s1, msg1to2 = A.generate_sync_message(n1, s1)
+        s2, msg2to1 = A.generate_sync_message(n2, s2)
+        assert msg1to2 is None
+        assert msg2to1 is None
+
+        n1 = A.change(n1, {'time': 0}, lambda d: d.update({'x': 5}))
+        s1, msg1to2 = A.generate_sync_message(n1, s1)
+        assert decode_sync_message(msg1to2)['have'][0]['lastSync'] == \
+            sorted([head1, head2])
+
+    def test_assumes_sent_changes_received_until_heard_otherwise(self):
+        n1, n2 = A.init('01234567'), A.init('89abcdef')
+        s1 = init_sync_state()
+        n1 = A.change(n1, {'time': 0}, lambda d: d.update({'items': []}))
+        n1, n2, s1, _s2 = sync(n1, n2, s1)
+
+        for item in ('x', 'y', 'z'):
+            n1 = A.change(n1, {'time': 0},
+                          lambda d, item=item: d['items'].append(item))
+            s1, message = A.generate_sync_message(n1, s1)
+            assert len(decode_sync_message(message)['changes']) == 1
+
+    def test_works_regardless_of_who_initiates(self):
+        n1, n2 = A.init(), A.init()
+        s1, s2 = init_sync_state(), init_sync_state()
+        for i in range(5):
+            n1 = A.change(n1, {'time': 0}, lambda d, i=i: d.update({'x': i}))
+        n1, n2, s1, s2 = sync(n1, n2, s1, s2)
+        for i in range(5, 10):
+            n1 = A.change(n1, {'time': 0}, lambda d, i=i: d.update({'x': i}))
+        assert not A.equals(n1, n2)
+        n1, n2, s1, s2 = sync(n1, n2, s1, s2)
+        assert A.equals(n1, n2)
+
+
+class TestFalsePositiveDependency:
+    """Bloom false positives on a dependency chain (ref sync_test.js:488-557).
+    The brute-force search runs against OUR BloomFilter, which is bit-
+    compatible with the reference's, so the same construction applies."""
+
+    def _setup(self):
+        n1, n2 = A.init('01234567'), A.init('89abcdef')
+        for i in range(10):
+            n1 = A.change(n1, {'time': 0}, lambda d, i=i: d.update({'x': i}))
+        n1, n2, s1, s2 = sync(n1, n2)
+        i = 1
+        while True:
+            n1us1 = A.change(A.clone(n1, {'actorId': '01234567'}),
+                             {'time': 0},
+                             lambda d, i=i: d.update({'x': f'{i} @ n1'}))
+            n2us1 = A.change(A.clone(n2, {'actorId': '89abcdef'}),
+                             {'time': 0},
+                             lambda d, i=i: d.update({'x': f'{i} @ n2'}))
+            n1hash1 = get_heads(n1us1)[0]
+            n2hash1 = get_heads(n2us1)[0]
+            n1us2 = A.change(n1us1, {'time': 0},
+                             lambda d: d.update({'x': 'final @ n1'}))
+            n2us2 = A.change(n2us1, {'time': 0},
+                             lambda d: d.update({'x': 'final @ n2'}))
+            n1hash2 = get_heads(n1us2)[0]
+            n2hash2 = get_heads(n2us2)[0]
+            if BloomFilter([n1hash1, n1hash2]).contains_hash(n2hash1):
+                return n1us2, n2us2, s1, s2, n1hash2, n2hash2
+            i += 1
+
+    def test_sync_two_nodes_without_connection_reset(self):
+        n1, n2, s1, s2, n1hash2, n2hash2 = self._setup()
+        n1, n2, s1, s2 = sync(n1, n2, s1, s2)
+        assert get_heads(n1) == sorted([n1hash2, n2hash2])
+        assert get_heads(n2) == sorted([n1hash2, n2hash2])
+
+    def test_sync_two_nodes_with_connection_reset(self):
+        n1, n2, s1, s2, n1hash2, n2hash2 = self._setup()
+        s1 = decode_sync_state(encode_sync_state(s1))
+        s2 = decode_sync_state(encode_sync_state(s2))
+        n1, n2, s1, s2 = sync(n1, n2, s1, s2)
+        assert get_heads(n1) == sorted([n1hash2, n2hash2])
+        assert get_heads(n2) == sorted([n1hash2, n2hash2])
+
+    def test_sync_three_nodes(self):
+        n1, n2, s1, s2, n1hash2, n2hash2 = self._setup()
+        s1 = decode_sync_state(encode_sync_state(s1))
+        s2 = decode_sync_state(encode_sync_state(s2))
+
+        s1, m1 = A.generate_sync_message(n1, s1)
+        s2, m2 = A.generate_sync_message(n2, s2)
+        n1, s1, _ = A.receive_sync_message(n1, s1, m2)
+        n2, s2, _ = A.receive_sync_message(n2, s2, m1)
+
+        s1, m1 = A.generate_sync_message(n1, s1)
+        s2, m2 = A.generate_sync_message(n2, s2)
+        n1, s1, _ = A.receive_sync_message(n1, s1, m2)
+        n2, s2, _ = A.receive_sync_message(n2, s2, m1)
+        assert len(decode_sync_message(m1)['changes']) == 2
+        assert len(decode_sync_message(m2)['changes']) == 1
+
+        n3 = A.init('fedcba98')
+        s13, s31 = init_sync_state(), init_sync_state()
+        n1, n3, s13, s31 = sync(n1, n3, s13, s31)
+        assert get_heads(n1) == [n1hash2]
+        assert get_heads(n3) == [n1hash2]
+
+
+class TestFalsePositiveChains:
+    """ref sync_test.js:559-673"""
+
+    def test_false_positive_depending_on_true_negative(self):
+        n1, n2 = A.init('01234567'), A.init('89abcdef')
+        for i in range(5):
+            n1 = A.change(n1, {'time': 0}, lambda d, i=i: d.update({'x': i}))
+        n1, n2, s1, s2 = sync(n1, n2)
+        i = 1
+        while True:
+            n1us1 = A.change(A.clone(n1, {'actorId': '01234567'}),
+                             {'time': 0},
+                             lambda d, i=i: d.update({'x': f'{i} @ n1'}))
+            n2us1 = A.change(A.clone(n2, {'actorId': '89abcdef'}),
+                             {'time': 0},
+                             lambda d, i=i: d.update({'x': f'{i} @ n2'}))
+            n1hash1 = get_heads(n1us1)[0]
+            n1us2 = A.change(n1us1, {'time': 0},
+                             lambda d, i=i: d.update({'x': f'{i + 1} @ n1'}))
+            n2us2 = A.change(n2us1, {'time': 0},
+                             lambda d, i=i: d.update({'x': f'{i + 1} @ n2'}))
+            n1hash2 = get_heads(n1us2)[0]
+            n2hash2 = get_heads(n2us2)[0]
+            n1up3 = A.change(n1us2, {'time': 0},
+                             lambda d: d.update({'x': 'final @ n1'}))
+            n2up3 = A.change(n2us2, {'time': 0},
+                             lambda d: d.update({'x': 'final @ n2'}))
+            n1hash3 = get_heads(n1up3)[0]
+            n2hash3 = get_heads(n2up3)[0]
+            if BloomFilter([n1hash1, n1hash2, n1hash3]).contains_hash(n2hash2):
+                n1, n2 = n1up3, n2up3
+                break
+            i += 1
+        both_heads = sorted([n1hash3, n2hash3])
+        s1 = decode_sync_state(encode_sync_state(s1))
+        s2 = decode_sync_state(encode_sync_state(s2))
+        n1, n2, s1, s2 = sync(n1, n2, s1, s2)
+        assert get_heads(n1) == both_heads
+        assert get_heads(n2) == both_heads
+
+    def test_chains_of_false_positives(self):
+        n1, n2 = A.init('01234567'), A.init('89abcdef')
+        s1, s2 = init_sync_state(), init_sync_state()
+        for i in range(5):
+            n1 = A.change(n1, {'time': 0}, lambda d, i=i: d.update({'x': i}))
+        n1, n2, s1, s2 = sync(n1, n2, s1, s2)
+        n1 = A.change(n1, {'time': 0}, lambda d: d.update({'x': 5}))
+        i = 1
+        while True:
+            n2us1 = A.change(A.clone(n2, {'actorId': '89abcdef'}),
+                             {'time': 0},
+                             lambda d, i=i: d.update({'x': f'{i} @ n2'}))
+            if BloomFilter(get_heads(n1)).contains_hash(get_heads(n2us1)[0]):
+                n2 = n2us1
+                break
+            i += 1
+        i = 1
+        while True:
+            n2us2 = A.change(A.clone(n2, {'actorId': '89abcdef'}),
+                             {'time': 0},
+                             lambda d, i=i: d.update({'x': f'{i} again'}))
+            if BloomFilter(get_heads(n1)).contains_hash(get_heads(n2us2)[0]):
+                n2 = n2us2
+                break
+            i += 1
+        n2 = A.change(n2, {'time': 0}, lambda d: d.update({'x': 'final @ n2'}))
+        all_heads = sorted(get_heads(n1) + get_heads(n2))
+        s1 = decode_sync_state(encode_sync_state(s1))
+        s2 = decode_sync_state(encode_sync_state(s2))
+        n1, n2, s1, s2 = sync(n1, n2, s1, s2)
+        assert get_heads(n1) == all_heads
+        assert get_heads(n2) == all_heads
+
+    def test_false_positive_hash_explicitly_requested(self):
+        n1, n2 = A.init('01234567'), A.init('89abcdef')
+        for i in range(10):
+            n1 = A.change(n1, {'time': 0}, lambda d, i=i: d.update({'x': i}))
+        n1, n2, s1, s2 = sync(n1, n2)
+        s1 = decode_sync_state(encode_sync_state(s1))
+        s2 = decode_sync_state(encode_sync_state(s2))
+        i = 1
+        while True:
+            n1up = A.change(A.clone(n1, {'actorId': '01234567'}),
+                            {'time': 0},
+                            lambda d, i=i: d.update({'x': f'{i} @ n1'}))
+            n2up = A.change(A.clone(n2, {'actorId': '89abcdef'}),
+                            {'time': 0},
+                            lambda d, i=i: d.update({'x': f'{i} @ n2'}))
+            if BloomFilter(get_heads(n1up)).contains_hash(get_heads(n2up)[0]):
+                n1, n2 = n1up, n2up
+                break
+            i += 1
+
+        s1, message = A.generate_sync_message(n1, s1)
+        assert len(decode_sync_message(message)['changes']) == 0
+
+        n2, s2, _ = A.receive_sync_message(n2, s2, message)
+        s2, message = A.generate_sync_message(n2, s2)
+        assert len(decode_sync_message(message)['changes']) == 0
+
+        n1, s1, _ = A.receive_sync_message(n1, s1, message)
+        s1, message = A.generate_sync_message(n1, s1)
+        assert decode_sync_message(message)['need'] == get_heads(n2)
+
+        n2, s2, _ = A.receive_sync_message(n2, s2, message)
+        s2, message = A.generate_sync_message(n2, s2)
+        assert len(decode_sync_message(message)['changes']) == 1
+
+        n1, s1, _ = A.receive_sync_message(n1, s1, message)
+        assert get_heads(n1) == get_heads(n2)
+
+
+class TestProtocolFeatures:
+    """ref sync_test.js:676-830"""
+
+    def test_multiple_bloom_filters(self):
+        from automerge_tpu.backend import encode_sync_message
+        n1, n2, n3 = A.init('01234567'), A.init('89abcdef'), A.init('76543210')
+        s13, s31 = init_sync_state(), init_sync_state()
+        s32, s23 = init_sync_state(), init_sync_state()
+        for i in range(3):
+            n1 = A.change(n1, {'time': 0}, lambda d, i=i: d.update({'x': i}))
+        n1, n2, _, _ = sync(n1, n2)
+        n1, n3, s13, s31 = sync(n1, n3)
+        n3, n2, s32, s23 = sync(n3, n2)
+        for i in range(2):
+            n1 = A.change(n1, {'time': 0},
+                          lambda d, i=i: d.update({'x': f'{i} @ n1'}))
+        for i in range(2):
+            n2 = A.change(n2, {'time': 0},
+                          lambda d, i=i: d.update({'x': f'{i} @ n2'}))
+        n1, _ = A.apply_changes(n1, A.get_all_changes(n2))
+        n2, _ = A.apply_changes(n2, A.get_all_changes(n1))
+        n1 = A.change(n1, {'time': 0}, lambda d: d.update({'x': '3 @ n1'}))
+        n2 = A.change(n2, {'time': 0}, lambda d: d.update({'x': '3 @ n2'}))
+        for i in range(3):
+            n3 = A.change(n3, {'time': 0},
+                          lambda d, i=i: d.update({'x': f'{i} @ n3'}))
+        n1c3, n2c3, n3c3 = get_heads(n1)[0], get_heads(n2)[0], get_heads(n3)[0]
+        s13 = decode_sync_state(encode_sync_state(s13))
+        s31 = decode_sync_state(encode_sync_state(s31))
+        s23 = decode_sync_state(encode_sync_state(s23))
+        s32 = decode_sync_state(encode_sync_state(s32))
+
+        s13, message1 = A.generate_sync_message(n1, s13)
+        assert len(decode_sync_message(message1)['changes']) == 0
+        n3, s31, _ = A.receive_sync_message(n3, s31, message1)
+        s31, message3 = A.generate_sync_message(n3, s31)
+        assert len(decode_sync_message(message3)['changes']) == 3
+        n1, s13, _ = A.receive_sync_message(n1, s13, message3)
+
+        s32, message3 = A.generate_sync_message(n3, s32)
+        modified = decode_sync_message(message3)
+        modified['have'].append(decode_sync_message(message1)['have'][0])
+        assert len(modified['changes']) == 0
+        n2, s23, _ = A.receive_sync_message(
+            n2, s23, encode_sync_message(modified))
+
+        s23, message2 = A.generate_sync_message(n2, s23)
+        assert len(decode_sync_message(message2)['changes']) == 1
+        n3, s32, _ = A.receive_sync_message(n3, s32, message2)
+
+        s13, message1 = A.generate_sync_message(n1, s13)
+        assert len(decode_sync_message(message1)['changes']) == 5
+        n3, s31, _ = A.receive_sync_message(n3, s31, message1)
+        assert get_heads(n3) == sorted([n1c3, n2c3, n3c3])
+
+    def test_any_change_can_be_requested(self):
+        from automerge_tpu.backend import encode_sync_message
+        n1, n2 = A.init('01234567'), A.init('89abcdef')
+        s1, s2 = init_sync_state(), init_sync_state()
+        for i in range(3):
+            n1 = A.change(n1, {'time': 0}, lambda d, i=i: d.update({'x': i}))
+        last_sync = get_heads(n1)
+        for i in range(3, 6):
+            n1 = A.change(n1, {'time': 0}, lambda d, i=i: d.update({'x': i}))
+        n1, n2, s1, s2 = sync(n1, n2)
+        s1['lastSentHeads'] = []
+        s1, message = A.generate_sync_message(n1, s1)
+        mod = decode_sync_message(message)
+        mod['need'] = last_sync
+        n2, s2, _ = A.receive_sync_message(n2, s2, encode_sync_message(mod))
+        s2, message = A.generate_sync_message(n2, s2)
+        assert len(decode_sync_message(message)['changes']) == 1
+        assert A.decode_change(
+            decode_sync_message(message)['changes'][0])['hash'] == last_sync[0]
+
+    def test_ignores_requests_for_nonexistent_change(self):
+        from automerge_tpu.backend import encode_sync_message
+        n1, n2 = A.init('01234567'), A.init('89abcdef')
+        s1, s2 = init_sync_state(), init_sync_state()
+        for i in range(3):
+            n1 = A.change(n1, {'time': 0}, lambda d, i=i: d.update({'x': i}))
+        n2, _ = A.apply_changes(n2, A.get_all_changes(n1))
+        s1, message = A.generate_sync_message(n1, s1)
+        mod = decode_sync_message(message)
+        mod['need'] = ['00' * 32]
+        n2, s2, _ = A.receive_sync_message(n2, s2, encode_sync_message(mod))
+        s2, message = A.generate_sync_message(n2, s2)
+        assert message is None
+
+    def test_subset_of_changes_can_be_sent(self):
+        from automerge_tpu.backend import encode_sync_message
+        n1, n2, n3 = A.init('01234567'), A.init('89abcdef'), A.init('76543210')
+        s1, s2 = init_sync_state(), init_sync_state()
+
+        n1 = A.change(n1, {'time': 0}, lambda d: d.update({'x': 0}))
+        n3 = A.merge(n3, n1)
+        for i in range(1, 3):
+            n1 = A.change(n1, {'time': 0}, lambda d, i=i: d.update({'x': i}))
+        for i in range(3, 5):
+            n3 = A.change(n3, {'time': 0}, lambda d, i=i: d.update({'x': i}))
+        c2, c4 = get_heads(n1)[0], get_heads(n3)[0]
+        n2 = A.merge(n2, n3)
+
+        n1, n2, s1, s2 = sync(n1, n2)
+        s1 = decode_sync_state(encode_sync_state(s1))
+        s2 = decode_sync_state(encode_sync_state(s2))
+        assert s1['sharedHeads'] == sorted([c2, c4])
+        assert s2['sharedHeads'] == sorted([c2, c4])
+
+        n3 = A.change(n3, {'time': 0}, lambda d: d.update({'x': 5}))
+        change5 = A.get_last_local_change(n3)
+        n3 = A.change(n3, {'time': 0}, lambda d: d.update({'x': 6}))
+        change6 = A.get_last_local_change(n3)
+        c6 = get_heads(n3)[0]
+        for i in range(7, 9):
+            n3 = A.change(n3, {'time': 0}, lambda d, i=i: d.update({'x': i}))
+        c8 = get_heads(n3)[0]
+        n2 = A.merge(n2, n3)
+
+        s1, msg = A.generate_sync_message(n1, s1)
+        n2, s2, _ = A.receive_sync_message(n2, s2, msg)
+        s2, msg = A.generate_sync_message(n2, s2)
+        decoded = decode_sync_message(msg)
+        decoded['changes'] = [change5, change6]
+        msg = encode_sync_message(decoded)
+        s2['sentHashes'] = {
+            decode_change_meta(change5, True)['hash'],
+            decode_change_meta(change6, True)['hash']}
+        n1, s1, _ = A.receive_sync_message(n1, s1, msg)
+        assert s1['sharedHeads'] == sorted([c2, c6])
+
+        s1, msg = A.generate_sync_message(n1, s1)
+        n2, s2, _ = A.receive_sync_message(n2, s2, msg)
+        assert decode_sync_message(msg)['need'] == [c8]
+        assert decode_sync_message(msg)['have'][0]['lastSync'] == \
+            sorted([c2, c6])
+        assert s1['sharedHeads'] == sorted([c2, c6])
+        assert s2['sharedHeads'] == sorted([c2, c6])
+
+        s2, msg = A.generate_sync_message(n2, s2)
+        n1, s1, _ = A.receive_sync_message(n1, s1, msg)
+        assert len(decode_sync_message(msg)['changes']) == 2
+        assert s1['sharedHeads'] == sorted([c2, c8])
