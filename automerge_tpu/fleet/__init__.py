@@ -18,6 +18,8 @@ from .apply import apply_op_batch, fleet_merge
 from .bloom import build_bloom_filters, probe_bloom_filters, bloom_filter_bytes
 from .sequence import (SeqState, SeqOpBatch, SeqEncoder, apply_seq_batch,
                        linearize, materialize, visible_text)
+from .sync_driver import (generate_sync_messages_docs,
+                          receive_sync_messages_docs)
 
 __all__ = [
     'FleetState', 'OpBatch', 'TOMBSTONE', 'pack_op_id', 'unpack_op_id',
@@ -25,4 +27,5 @@ __all__ = [
     'build_bloom_filters', 'probe_bloom_filters', 'bloom_filter_bytes',
     'SeqState', 'SeqOpBatch', 'SeqEncoder', 'apply_seq_batch',
     'linearize', 'materialize', 'visible_text',
+    'generate_sync_messages_docs', 'receive_sync_messages_docs',
 ]

@@ -37,7 +37,9 @@ def hashes_to_words(hashes_hex):
 
 def _probe_indexes(words, num_bits):
     """Triple hashing (Dillinger & Manolios): probe p = (x + p*y + C(p)*z)
-    mod m, computed iteratively as in the reference (ref sync.js:88-102)."""
+    mod m, computed iteratively as in the reference (ref sync.js:88-102).
+    `num_bits` may be a scalar (all rows share one capacity) or a [N, 1]
+    array (per-row capacities, for batching filters of differing sizes)."""
     modulo = jnp.asarray(num_bits, dtype=jnp.uint32)
     x = words[..., 0] % modulo
     y = words[..., 1] % modulo
@@ -55,17 +57,6 @@ def num_filter_bits(num_entries):
     return 8 * ((num_entries * BITS_PER_ENTRY + 7) // 8)
 
 
-@jax.jit
-def _build(words, valid, bits_init):
-    n_docs, n_bits = bits_init.shape
-    probes = _probe_indexes(words, n_bits)  # [N, H, P]
-    doc_idx = jnp.broadcast_to(
-        jnp.arange(n_docs, dtype=jnp.int32)[:, None, None], probes.shape)
-    # Invalid hash lanes scatter out of range and are dropped
-    probes = jnp.where(valid[..., None], probes, n_bits)
-    return bits_init.at[doc_idx, probes].set(True, mode='drop')
-
-
 def build_bloom_filters(words, valid, num_entries):
     """Build [N, B] bool filters for N peers, each over `num_entries` hashes
     ([N, H] padded with `valid` mask). All peers share the same B (sized for
@@ -73,19 +64,18 @@ def build_bloom_filters(words, valid, num_entries):
     n_docs = words.shape[0]
     n_bits = max(num_filter_bits(num_entries), 8)
     bits = jnp.zeros((n_docs, n_bits), dtype=bool)
-    return _build(jnp.asarray(words), jnp.asarray(valid), bits)
+    row_bits = jnp.full((n_docs,), n_bits, dtype=jnp.uint32)
+    return _build_varsize(jnp.asarray(words), jnp.asarray(valid), row_bits,
+                          bits)
 
 
-@jax.jit
 def probe_bloom_filters(bits, words, valid):
     """Probe [N, H] hashes against [N, B] filters; returns [N, H] bool
     (True = possibly contained)."""
     n_docs, n_bits = bits.shape
-    probes = _probe_indexes(jnp.asarray(words), n_bits)
-    doc_idx = jnp.broadcast_to(
-        jnp.arange(n_docs, dtype=jnp.int32)[:, None, None], probes.shape)
-    hit = bits[doc_idx, probes]  # [N, H, P]
-    return jnp.all(hit, axis=-1) & jnp.asarray(valid)
+    row_bits = jnp.full((n_docs,), n_bits, dtype=jnp.uint32)
+    return _probe_varsize(jnp.asarray(bits), row_bits, jnp.asarray(words),
+                          jnp.asarray(valid))
 
 
 def bloom_filter_bytes(bits_row, num_entries):
@@ -112,3 +102,96 @@ def bloom_filter_bytes(bits_row, num_entries):
     packed = np.packbits(bits_row, bitorder='little')[:n_bytes]
     encoder.append_raw_bytes(packed.tobytes())
     return encoder.buffer
+
+
+# ---- Variable-size batching -----------------------------------------------
+# Peers generally have different change counts, hence different filter bit
+# capacities (the reference sizes each filter by its entry count,
+# sync.js:44-47). Padding rows to the widest filter and taking the modulo
+# per row (the [N, 1] form of `_probe_indexes`' num_bits) keeps the whole
+# fleet in ONE build dispatch / ONE probe dispatch.
+
+@jax.jit
+def _build_varsize(words, valid, row_bits, bits_init):
+    n_rows, n_bits_max = bits_init.shape
+    probes = _probe_indexes(words, row_bits[:, None])
+    row_idx = jnp.broadcast_to(
+        jnp.arange(n_rows, dtype=jnp.int32)[:, None, None], probes.shape)
+    probes = jnp.where(valid[..., None], probes, n_bits_max)
+    return bits_init.at[row_idx, probes].set(True, mode='drop')
+
+
+@jax.jit
+def _probe_varsize(bits, row_bits, words, valid):
+    n_rows, _ = bits.shape
+    probes = _probe_indexes(words, row_bits[:, None])
+    row_idx = jnp.broadcast_to(
+        jnp.arange(n_rows, dtype=jnp.int32)[:, None, None], probes.shape)
+    hit = bits[row_idx, probes]
+    return jnp.all(hit, axis=-1) & valid
+
+
+def build_bloom_filters_batch(hash_lists):
+    """Build one wire-format Bloom filter per hash list, batched into a
+    single device dispatch despite differing entry counts. Returns a list of
+    `bytes` (b'' for empty lists), byte-identical to the host BloomFilter."""
+    entry_counts = [len(row) for row in hash_lists]
+    live = [i for i, n in enumerate(entry_counts) if n > 0]
+    out = [b''] * len(hash_lists)
+    if not live:
+        return out
+    words, valid = hashes_to_words([hash_lists[i] for i in live])
+    row_bits = np.array([num_filter_bits(entry_counts[i]) for i in live],
+                        dtype=np.uint32)
+    bits = jnp.zeros((len(live), int(row_bits.max())), dtype=bool)
+    built = np.asarray(_build_varsize(jnp.asarray(words), jnp.asarray(valid),
+                                      jnp.asarray(row_bits), bits))
+    for k, i in enumerate(live):
+        n_bits = int(row_bits[k])
+        out[i] = bloom_filter_bytes(built[k, :n_bits], entry_counts[i])
+    return out
+
+
+def probe_bloom_filters_batch(filter_bytes, hash_lists):
+    """Probe each row's hashes against that row's wire-format filter, all
+    rows in one device dispatch. `filter_bytes[i]` is a serialized filter
+    (b'' = empty: contains nothing); `hash_lists[i]` the hex hashes to test.
+    Returns a list of lists of bool (True = possibly contained)."""
+    from ..encoding import Decoder
+    out = [[False] * len(row) for row in hash_lists]
+    rows = []          # (orig index, bits array, n_bits)
+    for i, fb in enumerate(filter_bytes):
+        if not fb or not hash_lists[i]:
+            continue
+        decoder = Decoder(bytes(fb))
+        num_entries = decoder.read_uint32()
+        bits_per_entry = decoder.read_uint32()
+        num_probes = decoder.read_uint32()
+        if num_entries == 0:
+            continue
+        if bits_per_entry != BITS_PER_ENTRY or num_probes != NUM_PROBES:
+            # The wire format carries these so they can vary (sync.js:68-76);
+            # nonstandard peers fall back to the generic host filter rather
+            # than failing the whole batch
+            from ..backend.sync import BloomFilter
+            host = BloomFilter(bytes(fb))
+            out[i] = [host.contains_hash(h) for h in hash_lists[i]]
+            continue
+        raw = decoder.read_raw_bytes(
+            (num_entries * bits_per_entry + 7) // 8)
+        unpacked = np.unpackbits(np.frombuffer(raw, dtype=np.uint8),
+                                 bitorder='little')
+        rows.append((i, unpacked, 8 * len(raw)))
+    if not rows:
+        return out
+    words, valid = hashes_to_words([hash_lists[i] for i, _, _ in rows])
+    max_bits = max(n for _, _, n in rows)
+    bits = np.zeros((len(rows), max_bits), dtype=bool)
+    for k, (_, unpacked, n_bits) in enumerate(rows):
+        bits[k, :n_bits] = unpacked[:n_bits]
+    row_bits = np.array([n for _, _, n in rows], dtype=np.uint32)
+    hit = np.asarray(_probe_varsize(jnp.asarray(bits), jnp.asarray(row_bits),
+                                    jnp.asarray(words), jnp.asarray(valid)))
+    for k, (i, _, _) in enumerate(rows):
+        out[i] = [bool(h) for h in hit[k, :len(hash_lists[i])]]
+    return out

@@ -1,0 +1,241 @@
+"""Fleet-scale batched sync driver.
+
+The host protocol (``backend/sync.py``, ref backend/sync.js:234-306) builds
+one Bloom filter per peer and probes each candidate change hash one at a
+time — fine for two peers, quadratic pain for a fleet syncing with thousands.
+Here the same control flow runs over N (document, peer-state) pairs with the
+two filter-heavy steps batched into ONE device dispatch each per round:
+
+- ``generate_sync_messages_docs``: every doc's Bloom build (over its
+  changes since sharedHeads) lands in one ``build_bloom_filters_batch``
+  dispatch, and every doc's changes-to-send scan probes the peer's filter
+  in one ``probe_bloom_filters_batch`` dispatch. Messages are
+  byte-identical to the host ``generate_sync_message`` outputs.
+- ``receive_sync_messages_docs``: all received changes apply through
+  ``apply_changes_docs`` (one device merge dispatch on the fleet backend's
+  turbo path), then the sharedHeads algebra runs per doc.
+
+Wire format, resets, and the dependents-closure repair of Bloom false
+positives are unchanged — graph traversal stays host-side (SURVEY.md §2.11).
+"""
+
+from ..backend import (
+    get_heads, get_missing_deps, get_changes, get_change_by_hash,
+)
+from ..backend.sync import (
+    _cached_meta, advance_heads, decode_sync_message, encode_sync_message,
+)
+from .backend import apply_changes_docs
+from .bloom import build_bloom_filters_batch, probe_bloom_filters_batch
+
+
+def _changes_to_send_prescan(backend, have, need):
+    """Host prologue of get_changes_to_send: collect candidate change metas
+    and the peer filter to probe. Returns (mode, payload):
+    mode 'need-only'  -> payload = final changes list (no filters attached)
+    mode 'probe'      -> payload = (changes_meta, filter_bytes)"""
+    if not have:
+        return 'need-only', [
+            c for c in (get_change_by_hash(backend, h) for h in need)
+            if c is not None]
+    last_sync_hashes = set()
+    for h in have:
+        last_sync_hashes.update(h['lastSync'])
+    changes = [_cached_meta(c)
+               for c in get_changes(backend, sorted(last_sync_hashes))]
+    return 'probe', (changes, [h['bloom'] for h in have])
+
+
+def _changes_to_send_finish(backend, changes, bloom_hits, need):
+    """Host epilogue of get_changes_to_send, fed the batched probe results:
+    bloom_hits[f][j] = filter f possibly contains changes[j]."""
+    change_hashes = set()
+    dependents = {}
+    hashes_to_send = set()
+    for j, change in enumerate(changes):
+        change_hashes.add(change['hash'])
+        for dep in change['deps']:
+            dependents.setdefault(dep, []).append(change['hash'])
+        if all(not hits[j] for hits in bloom_hits):
+            hashes_to_send.add(change['hash'])
+
+    stack = list(hashes_to_send)
+    while stack:
+        hash = stack.pop()
+        for dep in dependents.get(hash, []):
+            if dep not in hashes_to_send:
+                hashes_to_send.add(dep)
+                stack.append(dep)
+
+    changes_to_send = []
+    for hash in need:
+        hashes_to_send.add(hash)
+        if hash not in change_hashes:
+            change = get_change_by_hash(backend, hash)
+            if change is not None:
+                changes_to_send.append(change)
+    for change in changes:
+        if change['hash'] in hashes_to_send:
+            changes_to_send.append(change['change'])
+    return changes_to_send
+
+
+def generate_sync_messages_docs(backends, sync_states):
+    """Batched ``generate_sync_message`` over N (backend, syncState) pairs.
+    Returns (new_sync_states, messages) with messages[i] = bytes or None,
+    byte-identical to the host function applied per doc. All Bloom builds
+    share one device dispatch; all peer-filter probes share another."""
+    n = len(backends)
+    if len(sync_states) != n:
+        raise ValueError('backends and sync_states must align')
+
+    our_heads = [get_heads(b) for b in backends]
+    our_need = [get_missing_deps(b, s['theirHeads'] or [])
+                for b, s in zip(backends, sync_states)]
+
+    # Phase 1 — which docs attach a filter, and over which hashes
+    bloom_hash_lists = [None] * n
+    for i, (backend, state) in enumerate(zip(backends, sync_states)):
+        their_heads = state['theirHeads']
+        if their_heads is None or all(h in their_heads for h in our_need[i]):
+            new_changes = get_changes(backend, state['sharedHeads'])
+            bloom_hash_lists[i] = [_cached_meta(c)['hash']
+                                   for c in new_changes]
+    built = build_bloom_filters_batch(
+        [row if row is not None else [] for row in bloom_hash_lists])
+    our_have = [[{'lastSync': s['sharedHeads'], 'bloom': built[i]}]
+                if bloom_hash_lists[i] is not None else []
+                for i, s in enumerate(sync_states)]
+
+    # Phase 2 — full-resync resets, and the changes-to-send pre-scan
+    results = [None] * n          # i -> (new_state, message or None)
+    probe_rows = []               # flattened (doc, filter) probe requests
+    probe_meta = []               # i -> ('probe', changes, first_row, n_filters)
+    for i, (backend, state) in enumerate(zip(backends, sync_states)):
+        their_have, their_need = state['theirHave'], state['theirNeed']
+        if their_have:
+            last_sync = their_have[0]['lastSync']
+            if not all(get_change_by_hash(backend, h) is not None
+                       for h in last_sync):
+                reset = {'heads': our_heads[i], 'need': [],
+                         'have': [{'lastSync': [], 'bloom': b''}],
+                         'changes': []}
+                results[i] = (state, encode_sync_message(reset))
+                continue
+        if not (isinstance(their_have, list) and
+                isinstance(their_need, list)):
+            probe_meta.append(None)
+            continue
+        mode, payload = _changes_to_send_prescan(backend, their_have,
+                                                 their_need)
+        if mode == 'need-only':
+            probe_meta.append(('done', i, payload))
+        else:
+            changes, filter_bytes = payload
+            first = len(probe_rows)
+            hashes = [c['hash'] for c in changes]
+            for fb in filter_bytes:
+                probe_rows.append((fb, hashes))
+            probe_meta.append(('probe', i, changes, first,
+                               len(filter_bytes)))
+
+    hits = probe_bloom_filters_batch([r[0] for r in probe_rows],
+                                     [r[1] for r in probe_rows])
+
+    # Phase 3 — assemble messages exactly as the host does
+    changes_to_send_by_doc = {}
+    for entry in probe_meta:
+        if entry is None:
+            continue
+        if entry[0] == 'done':
+            _, i, changes_list = entry
+            changes_to_send_by_doc[i] = changes_list
+        else:
+            _, i, changes, first, n_filters = entry
+            bloom_hits = [hits[first + f] for f in range(n_filters)]
+            changes_to_send_by_doc[i] = _changes_to_send_finish(
+                backends[i], changes, bloom_hits,
+                sync_states[i]['theirNeed'])
+
+    new_states, messages = [], []
+    for i, (backend, state) in enumerate(zip(backends, sync_states)):
+        if results[i] is not None:
+            new_states.append(results[i][0])
+            messages.append(results[i][1])
+            continue
+        changes_to_send = changes_to_send_by_doc.get(i, [])
+        heads_unchanged = isinstance(state['lastSentHeads'], list) and \
+            our_heads[i] == state['lastSentHeads']
+        heads_equal = isinstance(state['theirHeads'], list) and \
+            our_heads[i] == state['theirHeads']
+        if heads_unchanged and heads_equal and not changes_to_send:
+            new_states.append(state)
+            messages.append(None)
+            continue
+        sent_hashes = state['sentHashes']
+        changes_to_send = [c for c in changes_to_send
+                           if _cached_meta(c)['hash'] not in sent_hashes]
+        message = {'heads': our_heads[i], 'have': our_have[i],
+                   'need': our_need[i], 'changes': changes_to_send}
+        if changes_to_send:
+            sent_hashes = set(sent_hashes)
+            for change in changes_to_send:
+                sent_hashes.add(_cached_meta(change)['hash'])
+        new_states.append(dict(state, lastSentHeads=our_heads[i],
+                               sentHashes=sent_hashes))
+        messages.append(encode_sync_message(message))
+    return new_states, messages
+
+
+def receive_sync_messages_docs(backends, sync_states, binary_messages,
+                               mirror=True):
+    """Batched ``receive_sync_message`` over N docs. messages[i] may be None
+    (no-op for that doc). All received changes apply through ONE
+    apply_changes_docs call (device turbo batch with mirror=False on fleet
+    backends). Returns (new_backends, new_sync_states, patches)."""
+    n = len(backends)
+    if len(sync_states) != n or len(binary_messages) != n:
+        raise ValueError('backends, sync_states, and messages must align')
+    decoded = [decode_sync_message(m) if m is not None else None
+               for m in binary_messages]
+    before_heads = [get_heads(b) for b in backends]
+
+    per_doc_changes = [list(d['changes']) if d else [] for d in decoded]
+    if any(per_doc_changes):
+        new_backends, patches = apply_changes_docs(backends, per_doc_changes,
+                                                   mirror=mirror)
+    else:
+        new_backends, patches = list(backends), [None] * n
+
+    new_states = []
+    for i, (backend, state) in enumerate(zip(new_backends, sync_states)):
+        message = decoded[i]
+        if message is None:
+            new_states.append(state)
+            continue
+        shared_heads = state['sharedHeads']
+        last_sent_heads = state['lastSentHeads']
+        sent_hashes = state['sentHashes']
+        if message['changes']:
+            shared_heads = advance_heads(before_heads[i], get_heads(backend),
+                                         shared_heads)
+        if not message['changes'] and message['heads'] == before_heads[i]:
+            last_sent_heads = message['heads']
+        known_heads = [h for h in message['heads']
+                       if get_change_by_hash(backend, h) is not None]
+        if len(known_heads) == len(message['heads']):
+            shared_heads = message['heads']
+            if len(message['heads']) == 0:
+                last_sent_heads = []
+                sent_hashes = set()
+        else:
+            shared_heads = sorted(set(known_heads) | set(shared_heads))
+        new_states.append({
+            'sharedHeads': shared_heads,
+            'lastSentHeads': last_sent_heads,
+            'theirHave': message['have'],
+            'theirHeads': message['heads'],
+            'theirNeed': message['need'],
+            'sentHashes': sent_hashes,
+        })
+    return new_backends, new_states, patches

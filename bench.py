@@ -241,6 +241,53 @@ def bench_sync_bloom(n_docs, hashes_per_doc, seed=0):
     return device_rate, host_rate
 
 
+def bench_sync_driver(n_docs, changes_per_doc=8, seed=0):
+    """Batched fleet sync driver (fleet/sync_driver.py) vs the host per-doc
+    protocol loop: one generate round over n_docs peers, Bloom build for
+    every doc in one dispatch. Returns (batched_docs_per_sec,
+    host_docs_per_sec)."""
+    from automerge_tpu import backend as Backend
+    from automerge_tpu.backend import init_sync_state
+    from automerge_tpu.backend.sync import generate_sync_message
+    from automerge_tpu.columnar import encode_change, decode_change_meta
+    from automerge_tpu.fleet.sync_driver import generate_sync_messages_docs
+    rng = np.random.default_rng(seed)
+
+    def build_docs(n):
+        docs = []
+        for d in range(n):
+            backend = Backend.init()
+            changes, heads = [], []
+            for c in range(changes_per_doc):
+                buf = encode_change({
+                    'actor': f'{d:04x}' * 4, 'seq': c + 1, 'startOp': c + 1,
+                    'time': 0, 'message': '', 'deps': heads,
+                    'ops': [{'action': 'set', 'obj': '_root',
+                             'key': f'k{int(rng.integers(0, 16))}',
+                             'value': int(rng.integers(1, 1 << 20)),
+                             'datatype': 'int', 'pred': []}]})
+                heads = [decode_change_meta(buf, True)['hash']]
+                changes.append(buf)
+            backend = Backend.load_changes(backend, changes)
+            docs.append(backend)
+        return docs
+
+    docs = build_docs(n_docs)
+    states = [init_sync_state() for _ in docs]
+    generate_sync_messages_docs(docs, states)    # warmup compile
+    start = time.perf_counter()
+    _, messages = generate_sync_messages_docs(docs, states)
+    batched_rate = n_docs / (time.perf_counter() - start)
+    assert all(m is not None for m in messages)
+
+    host_n = max(n_docs // 20, 1)
+    start = time.perf_counter()
+    for doc, state in zip(docs[:host_n], states[:host_n]):
+        generate_sync_message(doc, state)
+    host_rate = host_n / (time.perf_counter() - start)
+    return batched_rate, host_rate
+
+
 def bench_zipf(n_docs, zipf_a=1.5, max_per_doc=256, round_width=32, seed=0):
     """Config 5 (BASELINE.md stretch): large fleet with Zipf-skewed per-doc
     change rates, mixed set/inc/del ops. Skew is the scatter design's worst
@@ -450,6 +497,9 @@ def main():
     bloom_dev, bloom_host = bench_sync_bloom(
         int(os.environ.get('BENCH_BLOOM_DOCS', 10000)),
         int(os.environ.get('BENCH_BLOOM_HASHES', 32)))
+    # Batched sync driver: one generate round over the whole peer fleet
+    syncdrv_batched, syncdrv_host = bench_sync_driver(
+        int(os.environ.get('BENCH_SYNCDRV_DOCS', 10000)))
     # Config 5 (stretch): Zipf-skewed change rates over a large fleet
     zipf_rate, zipf_occ = bench_zipf(
         int(os.environ.get('BENCH_ZIPF_DOCS', 100000)))
@@ -470,6 +520,9 @@ def main():
           f'{text_rate:.0f} ops/s', file=sys.stderr)
     print(f'# sync bloom build+probe: device {bloom_dev:.0f} hashes/s, '
           f'host {bloom_host:.0f} hashes/s', file=sys.stderr)
+    print(f'# batched sync driver, one 10k-peer generate round: '
+          f'{syncdrv_batched:.0f} docs/s batched vs {syncdrv_host:.0f} '
+          f'docs/s host loop', file=sys.stderr)
     print(f'# zipf 100k-doc fleet: {zipf_rate:.0f} effective ops/s '
           f'(occupancy {zipf_occ:.2f})', file=sys.stderr)
     print(f'# exact register engine: {reg_rate:.0f} ops/s', file=sys.stderr)

@@ -1,0 +1,140 @@
+"""Batched fleet sync driver: differential equality with the host
+per-document protocol and single-dispatch filter batching
+(fleet/sync_driver.py; ref backend/sync.js:234-306)."""
+
+import pytest
+
+import automerge_tpu as A
+from automerge_tpu import backend as Backend
+from automerge_tpu.backend import init_sync_state
+from automerge_tpu.backend.sync import (
+    generate_sync_message, receive_sync_message)
+from automerge_tpu.fleet import bloom as fleet_bloom
+from automerge_tpu.fleet.sync_driver import (
+    generate_sync_messages_docs, receive_sync_messages_docs)
+from automerge_tpu.frontend import get_backend_state
+
+
+def _backend_of(doc):
+    return get_backend_state(doc)
+
+
+def _make_pairs(n_docs, rounds=3):
+    """n_docs local/remote doc pairs with divergent histories."""
+    pairs = []
+    for d in range(n_docs):
+        a = A.init(f'{d:02x}' * 4 + 'aa')
+        for i in range(1 + d % 3):
+            a = A.change(a, {'time': 0},
+                         lambda doc, i=i: doc.update({'x': i}))
+        b = A.merge(A.init(f'{d:02x}' * 4 + 'bb'), a) if d % 2 else \
+            A.init(f'{d:02x}' * 4 + 'bb')
+        for i in range(d % 4):
+            b = A.change(b, {'time': 0},
+                         lambda doc, i=i: doc.update({'y': i}))
+        pairs.append((a, b))
+    return pairs
+
+
+class TestDifferentialEquality:
+    def test_messages_byte_identical_to_host(self):
+        pairs = _make_pairs(12)
+        batch_sa = [init_sync_state() for _ in pairs]
+        batch_sb = [init_sync_state() for _ in pairs]
+        host_sa = [init_sync_state() for _ in pairs]
+        host_sb = [init_sync_state() for _ in pairs]
+        # Backend handles freeze on use: host and batch drivers need their
+        # own copies of every document
+        host_a = [Backend.clone(_backend_of(a)) for a, _ in pairs]
+        host_b = [Backend.clone(_backend_of(b)) for _, b in pairs]
+        batch_a = [Backend.clone(_backend_of(a)) for a, _ in pairs]
+        batch_b = [Backend.clone(_backend_of(b)) for _, b in pairs]
+
+        for round_no in range(6):
+            batch_sa, msgs_ab = generate_sync_messages_docs(batch_a, batch_sa)
+            host_out = [generate_sync_message(doc, s)
+                        for doc, s in zip(host_a, host_sa)]
+            host_sa = [o[0] for o in host_out]
+            host_msgs = [o[1] for o in host_out]
+            for i in range(len(pairs)):
+                assert (msgs_ab[i] is None) == (host_msgs[i] is None), \
+                    f'round {round_no} doc {i} presence'
+                if msgs_ab[i] is not None:
+                    assert bytes(msgs_ab[i]) == bytes(host_msgs[i]), \
+                        f'round {round_no} doc {i} bytes'
+
+            # deliver a->b on both drivers
+            batch_b, batch_sb, _ = receive_sync_messages_docs(
+                batch_b, batch_sb,
+                [m for m in msgs_ab])
+            for i, m in enumerate(host_msgs):
+                if m is not None:
+                    host_b[i], host_sb[i], _ = receive_sync_message(
+                        host_b[i], host_sb[i], m)
+
+            # and the reply direction b->a
+            batch_sb, msgs_ba = generate_sync_messages_docs(batch_b, batch_sb)
+            host_out = [generate_sync_message(doc, s)
+                        for doc, s in zip(host_b, host_sb)]
+            host_sb = [o[0] for o in host_out]
+            host_msgs_ba = [o[1] for o in host_out]
+            for i in range(len(pairs)):
+                assert (msgs_ba[i] is None) == (host_msgs_ba[i] is None)
+                if msgs_ba[i] is not None:
+                    assert bytes(msgs_ba[i]) == bytes(host_msgs_ba[i]), \
+                        f'round {round_no} reply doc {i} bytes'
+            batch_a, batch_sa, _ = receive_sync_messages_docs(
+                batch_a, batch_sa, [m for m in msgs_ba])
+            for i, m in enumerate(host_msgs_ba):
+                if m is not None:
+                    host_a[i], host_sa[i], _ = receive_sync_message(
+                        host_a[i], host_sa[i], m)
+
+        # Everyone converged
+        for i in range(len(pairs)):
+            assert Backend.get_heads(batch_a[i]) == \
+                Backend.get_heads(batch_b[i]), f'doc {i} diverged'
+            assert Backend.get_heads(batch_a[i]) == \
+                Backend.get_heads(host_a[i])
+
+    def test_two_filter_dispatches_per_generate(self, monkeypatch):
+        pairs = _make_pairs(10)
+        a_docs = [_backend_of(a) for a, _ in pairs]
+        b_docs = [_backend_of(b) for _, b in pairs]
+        sa = [init_sync_state() for _ in pairs]
+        sb = [init_sync_state() for _ in pairs]
+
+        calls = {'build': 0, 'probe': 0}
+        orig_build = fleet_bloom._build_varsize
+        orig_probe = fleet_bloom._probe_varsize
+
+        def count_build(*args):
+            calls['build'] += 1
+            return orig_build(*args)
+
+        def count_probe(*args):
+            calls['probe'] += 1
+            return orig_probe(*args)
+        monkeypatch.setattr(fleet_bloom, '_build_varsize', count_build)
+        monkeypatch.setattr(fleet_bloom, '_probe_varsize', count_probe)
+
+        # Round 1: both sides generate (build only: no peer filters yet)
+        sa, msgs = generate_sync_messages_docs(a_docs, sa)
+        assert calls['build'] == 1
+        b_docs, sb, _ = receive_sync_messages_docs(b_docs, sb, msgs)
+        # Round 2: replies probe the received filters — still one dispatch
+        calls['build'] = calls['probe'] = 0
+        sb, msgs2 = generate_sync_messages_docs(b_docs, sb)
+        assert calls['build'] <= 1
+        assert calls['probe'] <= 1
+        assert calls['build'] + calls['probe'] >= 1
+
+    def test_empty_and_missing_messages(self):
+        pairs = _make_pairs(4)
+        docs = [_backend_of(a) for a, _ in pairs]
+        states = [init_sync_state() for _ in pairs]
+        out_docs, out_states, patches = receive_sync_messages_docs(
+            docs, states, [None] * len(pairs))
+        assert out_docs == docs
+        assert out_states == states
+        assert patches == [None] * len(pairs)
