@@ -189,31 +189,36 @@ def make_bloom_filter(backend, last_sync):
     return {'lastSync': last_sync, 'bloom': BloomFilter(hashes).bytes}
 
 
-def get_changes_to_send(backend, have, need):
-    """Changes since lastSync whose hash misses every peer Bloom filter, plus
-    transitive dependents of Bloom-negative changes, plus explicitly needed
-    hashes (ref sync.js:246-306)."""
+def changes_to_send_prescan(backend, have, need):
+    """Prologue of the changes-to-send scan (ref sync.js:246-306): collect
+    candidate change metas and the peer filters to probe. The probe itself
+    is pluggable so the fleet driver (fleet/sync_driver.py) can batch it on
+    device. Returns ('need-only', final_changes) when no filters were
+    attached, else ('probe', (changes_meta, filter_bytes_list))."""
     if not have:
-        return [c for c in (get_change_by_hash(backend, h) for h in need)
-                if c is not None]
-
+        return 'need-only', [
+            c for c in (get_change_by_hash(backend, h) for h in need)
+            if c is not None]
     last_sync_hashes = set()
-    bloom_filters = []
     for h in have:
         last_sync_hashes.update(h['lastSync'])
-        bloom_filters.append(BloomFilter(h['bloom']))
-
     changes = [_cached_meta(c)
                for c in get_changes(backend, sorted(last_sync_hashes))]
+    return 'probe', (changes, [h['bloom'] for h in have])
 
+
+def changes_to_send_finish(backend, changes, bloom_hits, need):
+    """Epilogue of the changes-to-send scan, fed per-filter probe results
+    (bloom_hits[f][j] = filter f possibly contains changes[j]): Bloom-
+    negative changes, their transitive dependents, and explicit needs."""
     change_hashes = set()
     dependents = {}
     hashes_to_send = set()
-    for change in changes:
+    for j, change in enumerate(changes):
         change_hashes.add(change['hash'])
         for dep in change['deps']:
             dependents.setdefault(dep, []).append(change['hash'])
-        if all(not bloom.contains_hash(change['hash']) for bloom in bloom_filters):
+        if all(not hits[j] for hits in bloom_hits):
             hashes_to_send.add(change['hash'])
 
     # Include any changes that depend on a Bloom-negative change
@@ -237,6 +242,20 @@ def get_changes_to_send(backend, have, need):
         if change['hash'] in hashes_to_send:
             changes_to_send.append(change['change'])
     return changes_to_send
+
+
+def get_changes_to_send(backend, have, need):
+    """Changes since lastSync whose hash misses every peer Bloom filter, plus
+    transitive dependents of Bloom-negative changes, plus explicitly needed
+    hashes (ref sync.js:246-306)."""
+    mode, payload = changes_to_send_prescan(backend, have, need)
+    if mode == 'need-only':
+        return payload
+    changes, filter_bytes = payload
+    bloom_filters = [BloomFilter(fb) for fb in filter_bytes]
+    bloom_hits = [[bloom.contains_hash(c['hash']) for c in changes]
+                  for bloom in bloom_filters]
+    return changes_to_send_finish(backend, changes, bloom_hits, need)
 
 
 def init_sync_state():

@@ -14,11 +14,13 @@ at once. The host keeps only what is inherently host work:
   produced without touching the device,
 - wire encode/decode.
 
-Documents whose changes leave the flat root-map subset (nested objects,
-lists, text, tables) transparently *promote*: their change log replays into
-the host OpSet engine and every later call delegates to it, so the full
-reference semantics are always available — the fleet path is an accelerator,
-never a semantic fork.
+Map trees (nested maps/tables, keyed by two-level (objectId, key) interned
+grid columns) and root-key sequence objects (Text/lists, as device RGA rows)
+stay fleet-resident. Documents whose changes leave that subset (objects
+inside sequences, link ops) transparently *promote*: their change log
+replays into the host OpSet engine and every later call delegates to it, so
+the full reference semantics are always available — the fleet path is an
+accelerator, never a semantic fork.
 
 Scale notes: one fleet packs up to 256 actors (tensor_doc.ACTOR_BITS); actor
 numbers are kept in actor-hex sort order so the device's packed-opId
@@ -68,6 +70,33 @@ class _SeqLink:
 
     def __hash__(self):
         return hash(('_SeqLink', self.object_id))
+
+
+_MAP_MAKE = ('makeMap', 'makeTable')
+
+
+class _MapLink:
+    """Value-table entry marking a key whose value is a nested map/table
+    object. The nested object's own keys live in the same [docs, keys] grid
+    under composite (objectId, key) interned columns (the two-level
+    interning of the reference's objectMeta ancestry, ref new.js:1461-1528),
+    so map trees stay fleet-resident."""
+
+    __slots__ = ('object_id', 'kind')
+
+    def __init__(self, object_id, kind='map'):
+        self.object_id = object_id
+        self.kind = kind
+
+    def __repr__(self):
+        return f'_MapLink({self.object_id}, {self.kind})'
+
+    def __eq__(self, other):
+        return isinstance(other, _MapLink) and \
+            other.object_id == self.object_id and other.kind == self.kind
+
+    def __hash__(self):
+        return hash(('_MapLink', self.object_id, self.kind))
 
 
 def _leaf_value(leaf):
@@ -668,17 +697,26 @@ class DocFleet:
             packed = pack_op_id(ctr, self.actors.intern(actor))
             obj = op['obj']
             action = op['action']
-            if obj != '_root':
+            if obj != '_root' and obj in self.slot_seq.get(d, {}):
                 row = self.slot_seq[d][obj]
                 seq_ops.append(self._pack_seq_op(row, self.seq_rows[row],
                                                  op, packed))
                 continue
-            key_id = self.keys.intern(op['key'])
+            # Root keys intern as bare strings (shared with the native
+            # path); nested map/table keys as (objectId, key) tuples —
+            # the two never collide
+            key_id = self.keys.intern(
+                op['key'] if obj == '_root' else (obj, op['key']))
             if action in _SEQ_MAKE:
                 self._alloc_seq_row(
                     d, op_id, 'text' if action == 'makeText' else 'list')
                 rows.append((d, key_id, packed,
                              self._intern_value_boxed(_SeqLink(op_id)),
+                             True, False))
+            elif action in _MAP_MAKE:
+                rows.append((d, key_id, packed,
+                             self._intern_value_boxed(_MapLink(
+                                 op_id, OBJECT_TYPE[action])),
                              True, False))
             elif action == 'del':
                 rows.append((d, key_id, packed, TOMBSTONE, True, False))
@@ -739,7 +777,7 @@ class DocFleet:
             obj = op['obj']
             action = op['action']
             packed = pack(op_id)
-            if obj != '_root':
+            if obj != '_root' and obj in self.slot_seq.get(d, {}):
                 row = self.slot_seq[d][obj]
                 seq_ops.append(self._pack_seq_op(row, self.seq_rows[row],
                                                  op, packed))
@@ -749,6 +787,9 @@ class DocFleet:
                     d, op_id, 'text' if action == 'makeText' else 'list')
                 val_idx, flags = \
                     self._intern_value_boxed(_SeqLink(op_id)), 1
+            elif action in _MAP_MAKE:
+                val_idx, flags = self._intern_value_boxed(
+                    _MapLink(op_id, OBJECT_TYPE[action])), 1
             elif action == 'del':
                 val_idx, flags = TOMBSTONE, 1
             elif action == 'inc':
@@ -756,7 +797,8 @@ class DocFleet:
             else:
                 val_idx, flags = self._intern_value(op.get('value')), 1
             out_doc.append(d)
-            out_key.append(self.keys.intern(op['key']))
+            out_key.append(self.keys.intern(
+                op['key'] if obj == '_root' else (obj, op['key'])))
             out_packed.append(packed)
             out_val.append(val_idx)
             out_flags.append(flags)
@@ -810,26 +852,49 @@ class DocFleet:
         free = set(self.free_slots)
         rendered = None
         for slot in range(self.n_slots):
-            doc = {}
-            if slot not in free:
-                live = np.flatnonzero(winners[slot, :len(self.keys)])
-                for k in live:
-                    v = int(values[slot, k])
-                    if v == TOMBSTONE:
-                        continue
-                    value = self.value_table[-v - 2] if v <= -2 else v
-                    if isinstance(value, _SeqLink):
-                        if rendered is None:
-                            rendered = self.render_seq_all()
-                        value = self._resolve_link(slot, value, rendered)
-                    else:
-                        c = int(counters[slot, k])
-                        if c and isinstance(value, int) and \
-                                not isinstance(value, bool):
-                            value += c
-                    doc[self.keys.keys[k]] = value
-            out.append(doc)
+            if slot in free:
+                out.append({})
+                continue
+            root_cells = {}      # root key -> value
+            nested = {}          # objectId -> {key: value}
+            live = np.flatnonzero(winners[slot, :len(self.keys)])
+            for k in live:
+                v = int(values[slot, k])
+                if v == TOMBSTONE:
+                    continue
+                value = self.value_table[-v - 2] if v <= -2 else v
+                if isinstance(value, _SeqLink):
+                    if rendered is None:
+                        rendered = self.render_seq_all()
+                    value = self._resolve_link(slot, value, rendered)
+                elif not isinstance(value, _MapLink):
+                    c = int(counters[slot, k])
+                    if c and isinstance(value, int) and \
+                            not isinstance(value, bool):
+                        value += c
+                key = self.keys.keys[k]
+                if isinstance(key, tuple):
+                    nested.setdefault(key[0], {})[key[1]] = value
+                else:
+                    root_cells[key] = value
+            out.append(self._resolve_map_links(root_cells, nested))
         return out
+
+    def _resolve_map_links(self, cells, nested, depth=0):
+        """Resolve _MapLink values in `cells` into nested dicts assembled
+        from `nested` (objectId -> {key: value}). Objects form a tree (one
+        make op = one parent); past the recursion backstop the link is left
+        unresolved, which routes bulk readers to the host mirror (the same
+        fallback device-inexact sequence rows use)."""
+        if depth > 128:
+            return cells
+        doc = {}
+        for key, value in cells.items():
+            if isinstance(value, _MapLink):
+                value = self._resolve_map_links(
+                    nested.get(value.object_id, {}), nested, depth + 1)
+            doc[key] = value
+        return doc
 
     def _resolve_link(self, slot, link, rendered):
         """Device render for a sequence link; returns the link itself when
@@ -859,14 +924,17 @@ class DocFleet:
                 # Keys legitimately set to null keep their None value (the
                 # LWW grid and host mirror both report them; only absent /
                 # fully-deleted keys are omitted)
-                doc = {}
+                root_cells, nested = {}, {}
                 for k, (v, _conflicts) in docs[slot].items():
                     if isinstance(v, _SeqLink):
                         if rendered is None:
                             rendered = self.render_seq_all()
                         v = self._resolve_link(slot, v, rendered)
-                    doc[k] = v
-                out.append(doc)
+                    if isinstance(k, tuple):
+                        nested.setdefault(k[0], {})[k[1]] = v
+                    else:
+                        root_cells[k] = v
+                out.append(self._resolve_map_links(root_cells, nested))
         return out
 
     def conflicts_all(self):
@@ -904,6 +972,7 @@ class _FlatEngine(HashGraph):
         self.mirror = None        # OpSet, built lazily on first exact use
         self.binary_doc = None
         self.seq_objects = {}     # objectId -> 'text' | 'list'
+        self.map_objects = {}     # objectId -> 'map' | 'table'
         # True after a turbo apply (or failed exact apply): the hash graph
         # and device state are current but the mirror is not; reads rebuild
         self.stale = False
@@ -934,6 +1003,9 @@ class _FlatEngine(HashGraph):
         self.seq_objects = {oid: obj.type
                             for oid, obj in self.mirror.objects.items()
                             if oid != '_root' and obj.is_seq}
+        self.map_objects = {oid: obj.type
+                            for oid, obj in self.mirror.objects.items()
+                            if oid != '_root' and not obj.is_seq}
         # Turbo queue entries carry only metadata; re-decode so the exact
         # drain path can apply their ops when deps arrive
         self.queue = [dict(decode_change(bytes(c['buffer'])), buffer=c['buffer'])
@@ -954,15 +1026,19 @@ class _FlatEngine(HashGraph):
 
         # Pre-scan for the supported subset before mutating anything, so
         # promotion to the host engine happens from an untouched state.
-        # `made` tracks sequence objects created earlier in the same batch
-        # so their element ops pass the scan.
-        made = set(self.seq_objects)
+        # `made_seq`/`made_map` track objects created earlier in the same
+        # batch so ops on them pass the scan.
+        made_seq = set(self.seq_objects)
+        made_map = set(self.map_objects)
         for change in decoded:
             start, actor = change['startOp'], change['actor']
             for i, op in enumerate(change['ops']):
-                self._check_supported(op, made)
-                if op['obj'] == '_root' and op['action'] in _SEQ_MAKE:
-                    made.add(f'{start + i}@{actor}')
+                self._check_supported(op, made_seq, made_map)
+                if op['obj'] == '_root' or op['obj'] in made_map:
+                    if op['action'] in _SEQ_MAKE:
+                        made_seq.add(f'{start + i}@{actor}')
+                    elif op['action'] in _MAP_MAKE:
+                        made_map.add(f'{start + i}@{actor}')
         self._ensure_mirror()
 
         from ..backend.op_set import empty_object_patch
@@ -982,10 +1058,12 @@ class _FlatEngine(HashGraph):
         for change in all_applied:
             self._record_applied(change)
             for i, op in enumerate(change['ops']):
-                if op['obj'] == '_root' and op['action'] in _SEQ_MAKE:
-                    self.seq_objects[f"{change['startOp'] + i}"
-                                     f"@{change['actor']}"] = \
-                        OBJECT_TYPE[op['action']]
+                if op['obj'] == '_root' or op['obj'] in self.map_objects:
+                    oid = f"{change['startOp'] + i}@{change['actor']}"
+                    if op['action'] in _SEQ_MAKE:
+                        self.seq_objects[oid] = OBJECT_TYPE[op['action']]
+                    elif op['action'] in _MAP_MAKE:
+                        self.map_objects[oid] = OBJECT_TYPE[op['action']]
         self.queue = queue
         self.max_op = max(self.max_op, self.mirror.max_op)
         self.binary_doc = None
@@ -1000,16 +1078,18 @@ class _FlatEngine(HashGraph):
             patch['seq'] = decoded[0]['seq']
         return patch
 
-    def _check_supported(self, op, made):
-        """Fleet-resident subset: flat root-map set/del/inc, makeText/
-        makeList at root keys, and element ops on those sequence objects.
-        Anything else (nested maps/tables, objects inside sequences, link
-        ops) promotes to the host engine."""
+    def _check_supported(self, op, made_seq, made_map):
+        """Fleet-resident subset: keyed set/del/inc plus nested
+        makeMap/makeTable/makeText/makeList on the root map or any
+        registered map/table object (map trees intern as (objectId, key)
+        grid columns), and element ops on registered sequence objects.
+        Anything else (objects inside sequences, link ops) promotes to the
+        host engine."""
         action = op['action']
-        if op['obj'] == '_root':
+        if op['obj'] == '_root' or op['obj'] in made_map:
             if op.get('insert') or op.get('key') is None:
                 raise _Unsupported()
-            if action in _SEQ_MAKE:
+            if action in _SEQ_MAKE or action in _MAP_MAKE:
                 return
             if action not in _FLAT_ACTIONS:
                 raise _Unsupported()
@@ -1020,7 +1100,7 @@ class _FlatEngine(HashGraph):
                         not -(1 << 31) < delta < (1 << 31):
                     raise _Unsupported()
             return
-        if op['obj'] not in made:
+        if op['obj'] not in made_seq:
             raise _Unsupported()
         # No nested objects inside sequences on the fleet path
         if action not in ('set', 'del', 'inc') or op.get('key') is not None:
@@ -1081,7 +1161,8 @@ class _FlatEngine(HashGraph):
         for field in ('max_op', 'actor_ids', 'heads', 'clock', 'queue',
                       'changes', 'changes_meta', 'change_index_by_hash',
                       'dependencies_by_hash', 'dependents_by_hash',
-                      'hashes_by_actor', 'mirror', 'seq_objects'):
+                      'hashes_by_actor', 'mirror', 'seq_objects',
+                      'map_objects'):
             setattr(other, field, copy.deepcopy(getattr(self, field)))
         return other
 
@@ -1778,6 +1859,17 @@ def _apply_changes_turbo(handles, per_doc_changes):
     return result
 
 
+def _has_unresolved_link(value):
+    """True if a materialized tree still contains a _SeqLink (device-inexact
+    sequence row) or _MapLink (recursion-backstopped subtree) anywhere,
+    including inside nested maps."""
+    if isinstance(value, (_SeqLink, _MapLink)):
+        return True
+    if isinstance(value, dict):
+        return any(_has_unresolved_link(v) for v in value.values())
+    return False
+
+
 def materialize_docs(handles):
     """Bulk {key: value} readback for many documents; fleet-resident docs
     come from one device transfer, promoted docs from their host engine."""
@@ -1803,7 +1895,7 @@ def materialize_docs(handles):
                     out.append(state.materialize())
                     continue
             raw = by_fleet[id(fleet)][state._impl.slot]
-            if any(isinstance(v, _SeqLink) for v in raw.values()):
+            if _has_unresolved_link(raw):
                 # A sequence row is device-inexact (concurrent overwrite,
                 # counter in list): the host mirror serves the whole doc
                 out.append(state.materialize())

@@ -131,24 +131,37 @@ def _probe_varsize(bits, row_bits, words, valid):
     return jnp.all(hit, axis=-1) & valid
 
 
+def _size_class(n_bits):
+    """Power-of-two padding class: keeps batch memory proportional to real
+    filter bytes under skewed per-peer change counts (one huge peer must not
+    inflate every row to its width) and bounds JIT recompiles to one shape
+    per class."""
+    return 1 << max(int(n_bits) - 1, 1).bit_length()
+
+
 def build_bloom_filters_batch(hash_lists):
-    """Build one wire-format Bloom filter per hash list, batched into a
-    single device dispatch despite differing entry counts. Returns a list of
-    `bytes` (b'' for empty lists), byte-identical to the host BloomFilter."""
+    """Build one wire-format Bloom filter per hash list, batched into one
+    device dispatch per power-of-two size class despite differing entry
+    counts. Returns a list of `bytes` (b'' for empty lists), byte-identical
+    to the host BloomFilter."""
     entry_counts = [len(row) for row in hash_lists]
-    live = [i for i, n in enumerate(entry_counts) if n > 0]
     out = [b''] * len(hash_lists)
-    if not live:
-        return out
-    words, valid = hashes_to_words([hash_lists[i] for i in live])
-    row_bits = np.array([num_filter_bits(entry_counts[i]) for i in live],
-                        dtype=np.uint32)
-    bits = jnp.zeros((len(live), int(row_bits.max())), dtype=bool)
-    built = np.asarray(_build_varsize(jnp.asarray(words), jnp.asarray(valid),
-                                      jnp.asarray(row_bits), bits))
-    for k, i in enumerate(live):
-        n_bits = int(row_bits[k])
-        out[i] = bloom_filter_bytes(built[k, :n_bits], entry_counts[i])
+    classes = {}
+    for i, n in enumerate(entry_counts):
+        if n > 0:
+            classes.setdefault(_size_class(num_filter_bits(n)),
+                               []).append(i)
+    for width, live in sorted(classes.items()):
+        words, valid = hashes_to_words([hash_lists[i] for i in live])
+        row_bits = np.array([num_filter_bits(entry_counts[i])
+                             for i in live], dtype=np.uint32)
+        bits = jnp.zeros((len(live), width), dtype=bool)
+        built = np.asarray(_build_varsize(
+            jnp.asarray(words), jnp.asarray(valid), jnp.asarray(row_bits),
+            bits))
+        for k, i in enumerate(live):
+            n_bits = int(row_bits[k])
+            out[i] = bloom_filter_bytes(built[k, :n_bits], entry_counts[i])
     return out
 
 
@@ -182,16 +195,18 @@ def probe_bloom_filters_batch(filter_bytes, hash_lists):
         unpacked = np.unpackbits(np.frombuffer(raw, dtype=np.uint8),
                                  bitorder='little')
         rows.append((i, unpacked, 8 * len(raw)))
-    if not rows:
-        return out
-    words, valid = hashes_to_words([hash_lists[i] for i, _, _ in rows])
-    max_bits = max(n for _, _, n in rows)
-    bits = np.zeros((len(rows), max_bits), dtype=bool)
-    for k, (_, unpacked, n_bits) in enumerate(rows):
-        bits[k, :n_bits] = unpacked[:n_bits]
-    row_bits = np.array([n for _, _, n in rows], dtype=np.uint32)
-    hit = np.asarray(_probe_varsize(jnp.asarray(bits), jnp.asarray(row_bits),
-                                    jnp.asarray(words), jnp.asarray(valid)))
-    for k, (i, _, _) in enumerate(rows):
-        out[i] = [bool(h) for h in hit[k, :len(hash_lists[i])]]
+    classes = {}
+    for row in rows:
+        classes.setdefault(_size_class(row[2]), []).append(row)
+    for width, group in sorted(classes.items()):
+        words, valid = hashes_to_words([hash_lists[i] for i, _, _ in group])
+        bits = np.zeros((len(group), width), dtype=bool)
+        for k, (_, unpacked, n_bits) in enumerate(group):
+            bits[k, :n_bits] = unpacked[:n_bits]
+        row_bits = np.array([n for _, _, n in group], dtype=np.uint32)
+        hit = np.asarray(_probe_varsize(
+            jnp.asarray(bits), jnp.asarray(row_bits), jnp.asarray(words),
+            jnp.asarray(valid)))
+        for k, (i, _, _) in enumerate(group):
+            out[i] = [bool(h) for h in hit[k, :len(hash_lists[i])]]
     return out

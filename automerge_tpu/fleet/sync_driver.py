@@ -4,7 +4,10 @@ The host protocol (``backend/sync.py``, ref backend/sync.js:234-306) builds
 one Bloom filter per peer and probes each candidate change hash one at a
 time — fine for two peers, quadratic pain for a fleet syncing with thousands.
 Here the same control flow runs over N (document, peer-state) pairs with the
-two filter-heavy steps batched into ONE device dispatch each per round:
+two filter-heavy steps batched into ONE device dispatch each per round (per
+power-of-two filter size class — uniform fleets get exactly one each, and a
+skewed fleet at most a handful, with batch memory proportional to real
+filter bytes):
 
 - ``generate_sync_messages_docs``: every doc's Bloom build (over its
   changes since sharedHeads) lands in one ``build_bloom_filters_batch``
@@ -23,61 +26,11 @@ from ..backend import (
     get_heads, get_missing_deps, get_changes, get_change_by_hash,
 )
 from ..backend.sync import (
-    _cached_meta, advance_heads, decode_sync_message, encode_sync_message,
+    _cached_meta, advance_heads, changes_to_send_finish,
+    changes_to_send_prescan, decode_sync_message, encode_sync_message,
 )
 from .backend import apply_changes_docs
 from .bloom import build_bloom_filters_batch, probe_bloom_filters_batch
-
-
-def _changes_to_send_prescan(backend, have, need):
-    """Host prologue of get_changes_to_send: collect candidate change metas
-    and the peer filter to probe. Returns (mode, payload):
-    mode 'need-only'  -> payload = final changes list (no filters attached)
-    mode 'probe'      -> payload = (changes_meta, filter_bytes)"""
-    if not have:
-        return 'need-only', [
-            c for c in (get_change_by_hash(backend, h) for h in need)
-            if c is not None]
-    last_sync_hashes = set()
-    for h in have:
-        last_sync_hashes.update(h['lastSync'])
-    changes = [_cached_meta(c)
-               for c in get_changes(backend, sorted(last_sync_hashes))]
-    return 'probe', (changes, [h['bloom'] for h in have])
-
-
-def _changes_to_send_finish(backend, changes, bloom_hits, need):
-    """Host epilogue of get_changes_to_send, fed the batched probe results:
-    bloom_hits[f][j] = filter f possibly contains changes[j]."""
-    change_hashes = set()
-    dependents = {}
-    hashes_to_send = set()
-    for j, change in enumerate(changes):
-        change_hashes.add(change['hash'])
-        for dep in change['deps']:
-            dependents.setdefault(dep, []).append(change['hash'])
-        if all(not hits[j] for hits in bloom_hits):
-            hashes_to_send.add(change['hash'])
-
-    stack = list(hashes_to_send)
-    while stack:
-        hash = stack.pop()
-        for dep in dependents.get(hash, []):
-            if dep not in hashes_to_send:
-                hashes_to_send.add(dep)
-                stack.append(dep)
-
-    changes_to_send = []
-    for hash in need:
-        hashes_to_send.add(hash)
-        if hash not in change_hashes:
-            change = get_change_by_hash(backend, hash)
-            if change is not None:
-                changes_to_send.append(change)
-    for change in changes:
-        if change['hash'] in hashes_to_send:
-            changes_to_send.append(change['change'])
-    return changes_to_send
 
 
 def generate_sync_messages_docs(backends, sync_states):
@@ -126,8 +79,8 @@ def generate_sync_messages_docs(backends, sync_states):
                 isinstance(their_need, list)):
             probe_meta.append(None)
             continue
-        mode, payload = _changes_to_send_prescan(backend, their_have,
-                                                 their_need)
+        mode, payload = changes_to_send_prescan(backend, their_have,
+                                                their_need)
         if mode == 'need-only':
             probe_meta.append(('done', i, payload))
         else:
@@ -153,7 +106,7 @@ def generate_sync_messages_docs(backends, sync_states):
         else:
             _, i, changes, first, n_filters = entry
             bloom_hits = [hits[first + f] for f in range(n_filters)]
-            changes_to_send_by_doc[i] = _changes_to_send_finish(
+            changes_to_send_by_doc[i] = changes_to_send_finish(
                 backends[i], changes, bloom_hits,
                 sync_states[i]['theirNeed'])
 

@@ -512,7 +512,7 @@ class TestTurboPath:
 
 
 class TestPromotion:
-    def test_nested_object_promotes(self):
+    def test_nested_maps_stay_fleet_resident(self):
         fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=2))
         hb = host_backend.init()
         gb = fb.init()
@@ -527,15 +527,28 @@ class TestPromotion:
              'datatype': 'int', 'pred': []}],
             deps=host_backend.get_heads(hb))
         hb, gb = apply_both(hb, gb, [nested])
-        assert not gb['state'].is_fleet
+        assert gb['state'].is_fleet          # two-level key interning
+        assert gb['state'].fleet.metrics.promotions == 0
         assert host_backend.get_patch(hb) == fleet_backend.get_patch(gb)
-        # Flat ops still work after promotion
+        # Nested-map docs materialize from the device grid
+        from automerge_tpu.fleet.backend import materialize_docs
+        assert materialize_docs([gb]) == [{'a': 1, 'm': {'x': 9}}]
         more = change_buf(ACTORS[0], 3, 4, [
             {'action': 'set', 'obj': '_root', 'key': 'a', 'value': 2,
              'datatype': 'int', 'pred': [f'1@{ACTORS[0]}']}],
             deps=host_backend.get_heads(hb))
         hb, gb = apply_both(hb, gb, [more])
         assert bytes(host_backend.save(hb)) == bytes(fleet_backend.save(gb))
+
+    def test_object_inside_sequence_promotes(self):
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=2))
+        gb = fb.init()
+        nested_in_list = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'makeList', 'obj': '_root', 'key': 'l', 'pred': []},
+            {'action': 'makeMap', 'obj': f'1@{ACTORS[0]}', 'elemId': '_head',
+             'insert': True, 'pred': []}])
+        gb, _ = fleet_backend.apply_changes(gb, [nested_in_list])
+        assert not gb['state'].is_fleet
 
     def test_promotion_preserves_queue(self):
         fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=2))
@@ -550,7 +563,9 @@ class TestPromotion:
         gb, patch = fleet_backend.apply_changes(gb, [c2])
         assert patch['pendingChanges'] == 1
         nested = change_buf(ACTORS[1], 1, 1, [
-            {'action': 'makeMap', 'obj': '_root', 'key': 'm', 'pred': []}])
+            {'action': 'makeList', 'obj': '_root', 'key': 'l', 'pred': []},
+            {'action': 'makeMap', 'obj': f'1@{ACTORS[1]}', 'elemId': '_head',
+             'insert': True, 'pred': []}])
         gb, _ = fleet_backend.apply_changes(gb, [nested])
         assert not gb['state'].is_fleet
         gb, patch = fleet_backend.apply_changes(gb, [c1])

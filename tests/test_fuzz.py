@@ -232,7 +232,8 @@ def test_fuzz_fleet_backend_matches_host(seed):
         for round_ in range(12):
             actor = rnd.choice(actors)
             new_doc, req = Frontend.change(
-                docs[actor], random_mutation(rnd, docs[actor], deletes=False))
+                docs[actor], {'time': 0},
+                random_mutation(rnd, docs[actor], deletes=False))
             if req is not None:
                 docs[actor] = new_doc
             if rnd.random() < 0.6:

@@ -32,9 +32,12 @@ def test_metrics_counters_track_turbo_and_exact():
     assert d['mirror_rebuilds'] == 1
     assert d['graph_builds'] >= 1
 
-    # Exact path and promotion
+    # Exact path and promotion (nested maps are fleet-resident now; an
+    # object inside a sequence is the remaining promotion trigger)
     c = change_buf(ACTORS[0], 2, 2, [
-        {'action': 'makeMap', 'obj': '_root', 'key': 'm', 'pred': []}],
+        {'action': 'makeList', 'obj': '_root', 'key': 'l', 'pred': []},
+        {'action': 'makeMap', 'obj': f'2@{ACTORS[0]}', 'elemId': '_head',
+         'insert': True, 'pred': []}],
         deps=fleet_backend.get_heads(handles[0]))
     h0, _ = fleet_backend.apply_changes(handles[0], [c])
     d = m.delta(base)

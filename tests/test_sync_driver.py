@@ -97,13 +97,7 @@ class TestDifferentialEquality:
             assert Backend.get_heads(batch_a[i]) == \
                 Backend.get_heads(host_a[i])
 
-    def test_two_filter_dispatches_per_generate(self, monkeypatch):
-        pairs = _make_pairs(10)
-        a_docs = [_backend_of(a) for a, _ in pairs]
-        b_docs = [_backend_of(b) for _, b in pairs]
-        sa = [init_sync_state() for _ in pairs]
-        sb = [init_sync_state() for _ in pairs]
-
+    def _count_dispatches(self, monkeypatch):
         calls = {'build': 0, 'probe': 0}
         orig_build = fleet_bloom._build_varsize
         orig_probe = fleet_bloom._probe_varsize
@@ -117,17 +111,57 @@ class TestDifferentialEquality:
             return orig_probe(*args)
         monkeypatch.setattr(fleet_bloom, '_build_varsize', count_build)
         monkeypatch.setattr(fleet_bloom, '_probe_varsize', count_probe)
+        return calls
+
+    def test_two_filter_dispatches_per_generate(self, monkeypatch):
+        # Uniform histories: every filter lands in one size class, so a
+        # whole generate round is exactly one build (and, once peer filters
+        # have arrived, exactly one probe) dispatch
+        pairs = []
+        for d in range(10):
+            a = A.init(f'{d:02x}' * 4 + 'aa')
+            b = A.init(f'{d:02x}' * 4 + 'bb')
+            for i in range(3):
+                a = A.change(a, {'time': 0},
+                             lambda doc, i=i: doc.update({'x': i}))
+                b = A.change(b, {'time': 0},
+                             lambda doc, i=i: doc.update({'y': i}))
+            pairs.append((a, b))
+        a_docs = [_backend_of(a) for a, _ in pairs]
+        b_docs = [_backend_of(b) for _, b in pairs]
+        sa = [init_sync_state() for _ in pairs]
+        sb = [init_sync_state() for _ in pairs]
+        calls = self._count_dispatches(monkeypatch)
 
         # Round 1: both sides generate (build only: no peer filters yet)
         sa, msgs = generate_sync_messages_docs(a_docs, sa)
         assert calls['build'] == 1
+        assert calls['probe'] == 0
         b_docs, sb, _ = receive_sync_messages_docs(b_docs, sb, msgs)
-        # Round 2: replies probe the received filters — still one dispatch
+        # Round 2: the replies probe the received filters in ONE dispatch
         calls['build'] = calls['probe'] = 0
         sb, msgs2 = generate_sync_messages_docs(b_docs, sb)
-        assert calls['build'] <= 1
-        assert calls['probe'] <= 1
-        assert calls['build'] + calls['probe'] >= 1
+        assert calls['probe'] == 1
+
+    def test_skewed_filter_sizes_bucket_by_class(self, monkeypatch):
+        # One high-churn peer must not inflate every row to its width: the
+        # batch buckets rows into power-of-two size classes (memory stays
+        # proportional to real filter bytes), one dispatch per class
+        import hashlib
+        from automerge_tpu.fleet.bloom import (
+            build_bloom_filters_batch, _size_class, num_filter_bits)
+        from automerge_tpu.backend.sync import BloomFilter
+        calls = self._count_dispatches(monkeypatch)
+        hash_lists = [[hashlib.sha256(f'{i}:{j}'.encode()).hexdigest()
+                       for j in range(3)] for i in range(20)]
+        hash_lists.append([hashlib.sha256(f'big:{j}'.encode()).hexdigest()
+                           for j in range(500)])
+        built = build_bloom_filters_batch(hash_lists)
+        n_classes = len({_size_class(num_filter_bits(len(r)))
+                         for r in hash_lists})
+        assert calls['build'] == n_classes == 2
+        for row, fb in zip(hash_lists, built):
+            assert bytes(fb) == bytes(BloomFilter(row).bytes)
 
     def test_empty_and_missing_messages(self):
         pairs = _make_pairs(4)
