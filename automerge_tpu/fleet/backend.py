@@ -75,6 +75,35 @@ class _SeqLink:
 _MAP_MAKE = ('makeMap', 'makeTable')
 
 
+class _ValueTable(list):
+    """Boxed-value store with dedup interning: the table grows with the
+    number of DISTINCT values, not with op count (repeated strings across a
+    long change log were an unbounded leak). Unhashable payloads append
+    without dedup."""
+
+    def __init__(self):
+        super().__init__()
+        self.index = {}
+
+    def intern(self, value):
+        # Key by (type, value): Python equality conflates True/1/1.0 etc.,
+        # and a boxed 1.0 must not read back as an earlier doc's True
+        key = (type(value), value)
+        try:
+            idx = self.index.get(key)
+            hashable = True
+        except TypeError:
+            idx = None
+            hashable = False
+        if idx is not None:
+            return idx
+        idx = len(self)
+        self.append(value)
+        if hashable:
+            self.index[key] = idx
+        return idx
+
+
 class _MapLink:
     """Value-table entry marking a key whose value is a nested map/table
     object. The nested object's own keys live in the same [docs, keys] grid
@@ -193,7 +222,7 @@ class DocFleet:
                  exact_device=False, actor_slot_capacity=8, d_preds=4):
         self.keys = KeyInterner()
         self.actors = _SortedActorTable()
-        self.value_table = []     # non-inline values, referenced as -(i + 2)
+        self.value_table = _ValueTable()   # non-inline values, -(i + 2) refs
         self.state = None         # FleetState, allocated on first flush
         # exact_device=True stores the device state in the multi-value
         # register engine (fleet/registers.py) instead of the LWW
@@ -376,9 +405,7 @@ class DocFleet:
         return self._intern_value_boxed(value)
 
     def _intern_value_boxed(self, value):
-        idx = len(self.value_table)
-        self.value_table.append(value)
-        return -(idx + 2)
+        return -(self.value_table.intern(value) + 2)
 
     def _pack_seq_op(self, row, info, op, packed):
         """One decoded sequence op -> (row, kind, ref, packed, value, pred,

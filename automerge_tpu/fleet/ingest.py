@@ -235,8 +235,7 @@ def changes_to_op_batch(per_doc_changes, key_interner, actor_interner,
                         0 <= value < (1 << 31):
                     val_idx = value
                 elif value_table is not None:
-                    val_idx = -(len(value_table) + 2)
-                    value_table.append(value)
+                    val_idx = -(value_table.intern(value) + 2)
                 else:
                     raise ValueError('non-int value requires a value_table')
                 rows.append((d, key_interner.intern(key),
@@ -364,8 +363,7 @@ def changes_to_op_rows(per_doc_changes, key_interner, actor_interner,
                     0 <= value < (1 << 31):
                 val_idx = value
             elif value_table is not None:
-                val_idx = -(len(value_table) + 2)
-                value_table.append(value)
+                val_idx = -(value_table.intern(value) + 2)
             else:
                 raise ValueError('non-int value requires a value_table')
             out_doc.append(d)

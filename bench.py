@@ -462,7 +462,19 @@ def bench_backend_text(n_docs, trace_len, ops_per_change=32, seed=0):
         jax.block_until_ready(fleet.seq_state.nxt)
 
     run()  # warmup compile
-    return median_rate(run, n_ops), None
+
+    # Host baseline on the same trace (config 2's "vs" column): the host
+    # OpSet engine applying the identical change chain, scaled-down doc
+    # count, rate-normalized
+    from automerge_tpu import backend as Backend
+    host_docs = max(n_docs // 50, 1)
+
+    def run_host():
+        for _ in range(host_docs):
+            backend = Backend.init()
+            Backend.apply_changes(backend, changes)
+    host_rate = median_rate(run_host, len(ops) * host_docs, reps=3)
+    return median_rate(run, n_ops), host_rate
 
 
 def main():
@@ -482,7 +494,7 @@ def main():
                               min(ops_per_round, 20))
 
     # End-to-end text editing through the seam (config 2, honest number)
-    seam_text_rate, _ = bench_backend_text(
+    seam_text_rate, host_text_rate = bench_backend_text(
         int(os.environ.get('BENCH_SEAM_TEXT_DOCS', 200)),
         int(os.environ.get('BENCH_SEAM_TEXT_LEN', 512)))
 
@@ -509,7 +521,9 @@ def main():
     print(f'# HEADLINE backend-seam end-to-end (turbo, incl. hash graph): '
           f'{seam_rate:.0f} changes/s (median of {REPS})', file=sys.stderr)
     print(f'# backend-seam text editing end-to-end: '
-          f'{seam_text_rate:.0f} ops/s (median of {REPS})', file=sys.stderr)
+          f'{seam_text_rate:.0f} ops/s (median of {REPS}) vs host '
+          f'{host_text_rate:.0f} ops/s '
+          f'({seam_text_rate / host_text_rate:.1f}x)', file=sys.stderr)
     print(f'# host reference engine (CPython, full pipeline): '
           f'{host_rate:.0f} changes/s', file=sys.stderr)
     print(f'# kernel-only device merge (pre-built batches): '
