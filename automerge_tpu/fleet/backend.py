@@ -39,7 +39,8 @@ from ..backend.hash_graph import HashGraph, decode_change_buffers
 from ..observability import Metrics
 from ..backend.op_set import OpSet
 from ..columnar import decode_change, OBJECT_TYPE
-from .tensor_doc import FleetState, MAX_ACTORS, TOMBSTONE
+from .tensor_doc import (ACTOR_BITS, CTR_LIMIT, FleetState, MAX_ACTORS,
+                         TOMBSTONE, pack_op_id)
 from .ingest import KeyInterner
 
 _FLAT_ACTIONS = ('set', 'del', 'inc')
@@ -223,6 +224,16 @@ class DocFleet:
         self.keys = KeyInterner()
         self.actors = _SortedActorTable()
         self.value_table = _ValueTable()   # non-inline values, -(i + 2) refs
+        # Packed-opId counter rebasing (round-2 VERDICT item 9): the int32
+        # packing holds counters < 2^23, but a slot's counters may grow
+        # without bound. ctr_base[slot] is subtracted before packing; when
+        # a slot's window fills, _rebase_slot shifts its live winners down
+        # in one device op. Slots whose LIVE counter spread exceeds the
+        # window (or that receive sub-window stragglers after a rebase)
+        # land in grid_overflow: their grid rows stop being authoritative
+        # and bulk reads fall back to the host mirror.
+        self.ctr_base = {}        # slot -> int counter base (default 0)
+        self.grid_overflow = set()
         self.state = None         # FleetState, allocated on first flush
         # exact_device=True stores the device state in the multi-value
         # register engine (fleet/registers.py) instead of the LWW
@@ -263,6 +274,8 @@ class DocFleet:
 
     def free_slot(self, slot):
         self.pending = [(s, b) for (s, b) in self.pending if s != slot]
+        self.ctr_base.pop(slot, None)
+        self.grid_overflow.discard(slot)
         self._zero_row(slot)
         rows = self.slot_seq.pop(slot, {})
         if rows:
@@ -275,6 +288,13 @@ class DocFleet:
     def clone_slot(self, src):
         self.flush()
         dst = self.alloc_slot()
+        # Counter-window state travels with the row copy: without it a
+        # clone of a rebased/overflowed slot would read its grid row with
+        # the wrong base (or as authoritative when it is not)
+        if src in self.ctr_base:
+            self.ctr_base[dst] = self.ctr_base[src]
+        if src in self.grid_overflow:
+            self.grid_overflow.add(dst)
         src_rows, dst_rows = [], []
         for oid, row in list(self.slot_seq.get(src, {}).items()):
             info = self.seq_rows[row]
@@ -643,6 +663,54 @@ class DocFleet:
             reg, move(rs.killed, False), move(rs.value, 0),
             move(rs.counter, 0), rs.inexact)
 
+    def _rebase_slot(self, slot, new_ctr, floor_ctr=None):
+        """Shift a slot's packing window so counters up to `new_ctr` fit:
+        new base = min(live winner counters, incoming batch floor) - 1, with
+        the slot's live winners shifted down in one device update. When the
+        spread itself exceeds the window the slot lands in grid_overflow
+        (reads fall back to the host mirror; history stays unbounded)."""
+        old = self.ctr_base.get(slot, 0)
+        min_live = None
+        if self.state is not None and slot < self.state.winners.shape[0]:
+            row = np.asarray(self.state.winners[slot])
+            live = row[row != 0]
+            if len(live):
+                min_live = int((live >> ACTOR_BITS).min()) + old
+        floor = new_ctr if floor_ctr is None else floor_ctr
+        if min_live is not None:
+            floor = min(floor, min_live)
+        new_base = floor - 1
+        if new_ctr - new_base >= CTR_LIMIT or new_base <= old:
+            self.grid_overflow.add(slot)
+            return old
+        if min_live is not None:
+            import jax.numpy as jnp
+            delta = (new_base - old) << ACTOR_BITS
+            w = self.state.winners
+            shifted = jnp.where(w[slot] != 0, w[slot] - delta, 0)
+            self.state = FleetState(w.at[slot].set(shifted),
+                                    self.state.values, self.state.counters)
+            self.metrics.dispatches += 1
+        self.ctr_base[slot] = new_base
+        return new_base
+
+    def _slot_pack(self, slot, ctr, actor_num):
+        """Pack a grid op's (counter, actor) against the slot's rebased
+        window; overflowing slots still get a clamped packing (their grid
+        rows are no longer authoritative — reads use the mirror)."""
+        base = self.ctr_base.get(slot, 0)
+        if ctr - base >= CTR_LIMIT and slot not in self.grid_overflow:
+            # (an overflowed slot must NOT rebase mid-batch: earlier ops in
+            # this batch already packed against the old base)
+            base = self._rebase_slot(slot, ctr)
+        rel = ctr - base
+        if rel <= 0 or rel >= CTR_LIMIT:
+            # Sub-window straggler after a rebase, or irreducible spread:
+            # mark and clamp (the mirror is authoritative for this slot)
+            self.grid_overflow.add(slot)
+            rel = min(max(rel, 1), CTR_LIMIT - 1)
+        return pack_op_id(rel, actor_num)
+
     def flush(self):
         """Land all pending change buffers on the device: one batched ingest
         and one merge dispatch for the whole fleet."""
@@ -668,7 +736,13 @@ class DocFleet:
             self._flush_exact(per_doc, n_docs)
             return
         batch = None
-        if native.available():
+        rebased_touched = any(
+            d < n_docs and per_doc[d]
+            for d in set(self.ctr_base) | self.grid_overflow)
+        if native.available() and not rebased_touched:
+            # (rebased slots pack against per-slot bases the native batch
+            # does not know about: only flushes touching such slots take
+            # the Python decode — the rest of the fleet keeps the C++ path)
             from .ingest import changes_to_op_batch_native
             batch = changes_to_op_batch_native(per_doc, self.keys,
                                                self.actors)
@@ -717,18 +791,36 @@ class DocFleet:
         from .ingest import changes_to_decoded_ops
         from ..common import parse_op_id
 
+        ops_list = list(changes_to_decoded_ops(per_doc))
+        # Rebase pre-pass: shift any slot whose incoming grid counters
+        # overflow its packing window BEFORE building rows, so one batch
+        # packs against one base per slot
+        slot_max, slot_min = {}, {}
+        for d, op_id, op in ops_list:
+            if op['obj'] == '_root' or \
+                    op['obj'] not in self.slot_seq.get(d, {}):
+                ctr = parse_op_id(op_id)[0]
+                if ctr > slot_max.get(d, 0):
+                    slot_max[d] = ctr
+                if ctr < slot_min.get(d, ctr + 1):
+                    slot_min[d] = ctr
+        for d, ctr in slot_max.items():
+            if ctr - self.ctr_base.get(d, 0) >= CTR_LIMIT:
+                self._rebase_slot(d, ctr, floor_ctr=slot_min[d])
+
         rows = []       # (slot, key_id, packed, value, is_set, is_inc)
         seq_ops = []
-        for d, op_id, op in changes_to_decoded_ops(per_doc):
+        for d, op_id, op in ops_list:
             ctr, actor = parse_op_id(op_id)
-            packed = pack_op_id(ctr, self.actors.intern(actor))
             obj = op['obj']
             action = op['action']
             if obj != '_root' and obj in self.slot_seq.get(d, {}):
                 row = self.slot_seq[d][obj]
+                packed = pack_op_id(ctr, self.actors.intern(actor))
                 seq_ops.append(self._pack_seq_op(row, self.seq_rows[row],
                                                  op, packed))
                 continue
+            packed = self._slot_pack(d, ctr, self.actors.intern(actor))
             # Root keys intern as bare strings (shared with the native
             # path); nested map/table keys as (objectId, key) tuples —
             # the two never collide
@@ -1060,7 +1152,7 @@ class _FlatEngine(HashGraph):
         for change in decoded:
             start, actor = change['startOp'], change['actor']
             for i, op in enumerate(change['ops']):
-                self._check_supported(op, made_seq, made_map)
+                self._check_supported(op, made_seq, made_map, ctr=start + i)
                 if op['obj'] == '_root' or op['obj'] in made_map:
                     if op['action'] in _SEQ_MAKE:
                         made_seq.add(f'{start + i}@{actor}')
@@ -1105,16 +1197,24 @@ class _FlatEngine(HashGraph):
             patch['seq'] = decoded[0]['seq']
         return patch
 
-    def _check_supported(self, op, made_seq, made_map):
+    def _check_supported(self, op, made_seq, made_map, ctr=None):
         """Fleet-resident subset: keyed set/del/inc plus nested
         makeMap/makeTable/makeText/makeList on the root map or any
         registered map/table object (map trees intern as (objectId, key)
         grid columns), and element ops on registered sequence objects.
         Anything else (objects inside sequences, link ops) promotes to the
-        host engine."""
+        host engine.
+
+        Counter headroom: the LWW grid rebases its packing window per slot
+        (unbounded history), but the sequence rows and the exact-device
+        register engine pack raw counters — ops at or past CTR_LIMIT on
+        those paths promote cleanly here, BEFORE any state mutates."""
         action = op['action']
         if op['obj'] == '_root' or op['obj'] in made_map:
             if op.get('insert') or op.get('key') is None:
+                raise _Unsupported()
+            if ctr is not None and ctr >= CTR_LIMIT and \
+                    (self.fleet.exact_device or action in _SEQ_MAKE):
                 raise _Unsupported()
             if action in _SEQ_MAKE or action in _MAP_MAKE:
                 return
@@ -1132,6 +1232,8 @@ class _FlatEngine(HashGraph):
         # No nested objects inside sequences on the fleet path
         if action not in ('set', 'del', 'inc') or op.get('key') is not None:
             raise _Unsupported()
+        if ctr is not None and ctr >= CTR_LIMIT:
+            raise _Unsupported()      # sequence rows pack raw counters
 
     def _rollback(self, backup):
         """Restore gate state; the partially-mutated mirror rebuilds lazily
@@ -1497,6 +1599,13 @@ def _apply_changes_turbo(handles, per_doc_changes):
         engines.append(state._impl)
     fleet = engines[0].fleet
     if any(e.fleet is not fleet for e in engines):
+        return None
+    if (fleet.ctr_base or fleet.grid_overflow) and any(
+            e.slot in fleet.ctr_base or e.slot in fleet.grid_overflow
+            for e in engines):
+        # Rebased/overflowed slots pack against per-slot counter bases the
+        # native turbo parser does not apply: exact path handles them (docs
+        # on unrebased slots keep the turbo path)
         return None
 
     flat_buffers, change_doc = [], []
@@ -1921,6 +2030,11 @@ def materialize_docs(handles):
                     # shape: the host mirror is authoritative
                     out.append(state.materialize())
                     continue
+            if state._impl.slot in fleet.grid_overflow:
+                # Counter spread exceeded the packing window: the grid row
+                # is no longer authoritative for this slot
+                out.append(state.materialize())
+                continue
             raw = by_fleet[id(fleet)][state._impl.slot]
             if _has_unresolved_link(raw):
                 # A sequence row is device-inexact (concurrent overwrite,

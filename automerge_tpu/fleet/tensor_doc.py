@@ -26,7 +26,11 @@ import numpy as np
 
 ACTOR_BITS = 8               # up to 256 distinct actors per fleet
 MAX_ACTORS = 1 << ACTOR_BITS
-CTR_LIMIT = 1 << (31 - ACTOR_BITS)  # op counters must stay below ~8.4M
+# Packed counters occupy 23 bits (~8.4M) — a WINDOW, not a history cap: the
+# LWW grid rebases each slot's window as counters grow (DocFleet.ctr_base /
+# _rebase_slot), so history length is unbounded; only a slot's live-winner
+# counter spread is window-bounded (beyond that, reads use the host mirror)
+CTR_LIMIT = 1 << (31 - ACTOR_BITS)
 TOMBSTONE = -1               # value-table index marking a deleted key
 
 

@@ -1236,3 +1236,136 @@ class TestValueTableDedup:
         boxed = [v for v in fleet.value_table if isinstance(v, str)]
         assert sorted(set(boxed)) == ['active', 'idle']
         assert len(boxed) == 2
+
+
+class TestCounterRebasing:
+    """Packed-opId headroom (round-2 VERDICT item 9): op counters past the
+    int32 packing window (CTR_LIMIT = 2^23) rebase the slot's window on
+    device instead of crashing or promoting — history length is unbounded;
+    only the LIVE counter spread is window-bounded."""
+
+    def _chain(self, start_ops, key_of=None):
+        """Chained single-op changes at the given startOps."""
+        A = ACTORS[0]
+        changes, heads = [], []
+        for seq, start in enumerate(start_ops, 1):
+            buf = change_buf(A, seq, start, [
+                {'action': 'set', 'obj': '_root',
+                 'key': key_of(seq) if key_of else 'k',
+                 'value': seq, 'datatype': 'int',
+                 'pred': []}], deps=heads)
+            heads = [am.decode_change(buf)['hash']]
+            changes.append(buf)
+        return changes
+
+    def test_counters_past_the_window_stay_fleet_resident(self):
+        # A long-lived doc whose winners keep advancing (the editing-trace
+        # regime): each overwrite moves the live window forward, so rebasing
+        # keeps the doc on the grid across multiple windows of history
+        from automerge_tpu.fleet.tensor_doc import CTR_LIMIT
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=4))
+        gb = fb.init()
+        step = CTR_LIMIT - 100
+        starts = [1, step, 2 * step, 3 * step, 4 * step]   # ~4 windows deep
+        fleet = gb['state'].fleet
+        A = ACTORS[0]
+        heads, pred = [], []
+        for seq, start in enumerate(starts, 1):
+            buf = change_buf(A, seq, start, [
+                {'action': 'set', 'obj': '_root', 'key': 'k',
+                 'value': seq, 'datatype': 'int', 'pred': pred}],
+                deps=heads)
+            heads = [am.decode_change(buf)['hash']]
+            pred = [f'{start}@{A}']
+            gb, _ = fleet_backend.apply_changes(gb, [buf])
+            fleet.flush()      # incremental flushes: live window advances
+        assert gb['state'].is_fleet
+        assert fleet.metrics.promotions == 0
+        from automerge_tpu.fleet.backend import materialize_docs
+        assert materialize_docs([gb]) == [{'k': len(starts)}]
+        # The grid itself served the read (no overflow fallback): the live
+        # winner advanced with each overwrite, so every rebase succeeded
+        assert gb['state']._impl.slot not in fleet.grid_overflow
+        assert fleet.ctr_base[gb['state']._impl.slot] > 0
+
+    def test_irreducible_spread_falls_back_to_mirror(self):
+        # A key set once at counter 1 and never touched again, then an op
+        # past 2*CTR_LIMIT: the live spread cannot fit one window; reads
+        # stay correct via the host mirror, still without promotion
+        from automerge_tpu.fleet.tensor_doc import CTR_LIMIT
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=4))
+        gb = fb.init()
+        starts = [1, 2 * CTR_LIMIT + 3]
+        for buf in self._chain(starts, key_of=lambda s: f'k{s}'):
+            gb, _ = fleet_backend.apply_changes(gb, [buf])
+        fleet = gb['state'].fleet
+        fleet.flush()
+        assert gb['state'].is_fleet
+        assert fleet.metrics.promotions == 0
+        from automerge_tpu.fleet.backend import materialize_docs
+        assert materialize_docs([gb]) == [{'k1': 1, 'k2': 2}]
+        assert gb['state']._impl.slot in fleet.grid_overflow
+
+    def test_exact_device_promotes_cleanly_at_the_boundary(self):
+        from automerge_tpu.fleet.tensor_doc import CTR_LIMIT
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=4,
+                                   exact_device=True))
+        gb = fb.init()
+        for buf in self._chain([1, CTR_LIMIT + 1], key_of=lambda s: f'k{s}'):
+            gb, _ = fleet_backend.apply_changes(gb, [buf])
+        # Registers pack raw counters: past the window the doc promotes
+        # (pre-commit, no partial state) and stays correct on host
+        assert not gb['state'].is_fleet
+        assert fleet_backend.get_patch(gb)['diffs']['props']['k2'] == {
+            f'{CTR_LIMIT + 1}@{ACTORS[0]}': {
+                'type': 'value', 'value': 2, 'datatype': 'int'}}
+
+    def test_clone_carries_counter_window_state(self):
+        # A clone of a rebased/overflowed slot must not read its grid row
+        # as authoritative with the wrong base (review regression)
+        from automerge_tpu.fleet.tensor_doc import CTR_LIMIT
+        from automerge_tpu.fleet.backend import materialize_docs
+        fb = FleetBackend(DocFleet(doc_capacity=4, key_capacity=4))
+        gb = fb.init()
+        A = ACTORS[0]
+        b1 = change_buf(A, 1, CTR_LIMIT - 10, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 111,
+             'datatype': 'int', 'pred': []}])
+        h1 = am.decode_change(b1)['hash']
+        b2 = change_buf(A, 2, 2 * CTR_LIMIT + 3, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 222,
+             'datatype': 'int', 'pred': [f'{CTR_LIMIT - 10}@{A}']}],
+            deps=[h1])
+        gb, _ = fleet_backend.apply_changes(gb, [b1])
+        gb['state'].fleet.flush()
+        gb, _ = fleet_backend.apply_changes(gb, [b2])
+        gb['state'].fleet.flush()
+        clone = fleet_backend.clone(gb)
+        assert materialize_docs([gb]) == [{'k': 222}]
+        assert materialize_docs([clone]) == [{'k': 222}]
+
+    def test_rebased_slot_does_not_disable_fleet_turbo(self):
+        # One long-lived doc crossing the window must not push every OTHER
+        # doc in the fleet off the native/turbo paths (review regression)
+        from automerge_tpu.fleet.tensor_doc import CTR_LIMIT
+        fleet = DocFleet(doc_capacity=4, key_capacity=4)
+        fb = FleetBackend(fleet)
+        gb = fb.init()
+        step = CTR_LIMIT - 100
+        heads, pred = [], []
+        for seq, start in enumerate([1, step, 2 * step], 1):
+            buf = change_buf(ACTORS[0], seq, start, [
+                {'action': 'set', 'obj': '_root', 'key': 'k', 'value': seq,
+                 'datatype': 'int', 'pred': pred}], deps=heads)
+            heads = [am.decode_change(buf)['hash']]
+            pred = [f'{start}@{ACTORS[0]}']
+            gb, _ = fleet_backend.apply_changes(gb, [buf])
+            fleet.flush()
+        assert fleet.ctr_base          # the long doc rebased
+        other = fb.init()
+        before = fleet.metrics.turbo_calls
+        handles, _ = fleet_backend.apply_changes_docs(
+            [other], [[change_buf(ACTORS[1], 1, 1, [
+                {'action': 'set', 'obj': '_root', 'key': 'x', 'value': 1,
+                 'datatype': 'int', 'pred': []}])]], mirror=False)
+        assert fleet.metrics.turbo_calls == before + 1
