@@ -305,8 +305,14 @@ def changes_to_op_rows(per_doc_changes, key_interner, actor_interner,
             docs.append(d)
 
     if native.available() and buffers:
+        # with_seq=True so the wire value-type tag rides along: uint /
+        # counter / timestamp set values box into the value table as
+        # TypedValue, letting device-served patches keep exact datatypes
         out = native.ingest_changes(buffers, list(range(len(buffers))),
-                                    with_meta=True)
+                                    with_meta=True, with_seq=True)
+        if out is not None and out[0]['flags'].size and \
+                out[0]['flags'].max() > 2:
+            out = None    # sequence/make rows: not register material
         if out is not None:
             rows, nat_keys, nat_actors, _meta = out
             key_map = np.array([key_interner.intern(k) for k in nat_keys],
@@ -321,11 +327,24 @@ def changes_to_op_rows(per_doc_changes, key_interner, actor_interner,
                     p != 0, (p >> 8 << 8) | actor_map[p & 0xff], 0
                 ).astype(np.int32)
 
+            values = rows['value'].astype(np.int32, copy=True)
+            if value_table is not None and 'vtype' in rows:
+                from .registers import TypedValue
+                from ..columnar import VALUE_TYPE
+                tags = {VALUE_TYPE['LEB128_UINT']: 'uint',
+                        VALUE_TYPE['COUNTER']: 'counter',
+                        VALUE_TYPE['TIMESTAMP']: 'timestamp'}
+                typed = (rows['flags'] == 1) & (values != TOMBSTONE) & \
+                    np.isin(rows['vtype'], list(tags))
+                for ri in np.flatnonzero(typed):
+                    values[ri] = -(value_table.intern(TypedValue(
+                        int(rows['value'][ri]),
+                        tags[int(rows['vtype'][ri])])) + 2)
             return {
                 'doc': np.array(docs, dtype=np.int64)[rows['doc']],
                 'key': key_map[rows['key']],
                 'packed': remap(rows['packed']),
-                'value': rows['value'],
+                'value': values,
                 'flags': rows['flags'],
                 'pred_off': rows['pred_off'],
                 'pred': remap(rows['pred']),
@@ -352,6 +371,7 @@ def changes_to_op_rows(per_doc_changes, key_interner, actor_interner,
             op_id = f"{change['startOp'] + i}@{change['actor']}"
             action = op['action']
             value = op.get('value')
+            datatype = op.get('datatype')
             if action == 'del':
                 val_idx = TOMBSTONE
             elif action == 'inc':
@@ -359,6 +379,12 @@ def changes_to_op_rows(per_doc_changes, key_interner, actor_interner,
                         not -(1 << 31) < value < (1 << 31):
                     raise ValueError('inc delta must be an int32')
                 val_idx = value
+            elif datatype not in (None, 'int') and value_table is not None:
+                # uint/counter/timestamp/float64 set values box with their
+                # datatype so device-served patches stay exact
+                from .registers import TypedValue
+                val_idx = -(value_table.intern(
+                    TypedValue(value, datatype)) + 2)
             elif isinstance(value, int) and not isinstance(value, bool) and \
                     0 <= value < (1 << 31):
                 val_idx = value

@@ -281,6 +281,9 @@ def materialize_registers(state, keys, value_table=None):
 
     def decode(v, c):
         out = value_table[-v - 2] if v <= -2 and value_table is not None else v
+        if isinstance(out, TypedValue):
+            return out.value + int(c) if out.datatype == 'counter' \
+                else out.value
         if isinstance(out, int) and not isinstance(out, bool):
             out += int(c)
         return out
@@ -300,3 +303,71 @@ def materialize_registers(state, keys, value_table=None):
             doc[keys[k]] = (winner_value, conflicts)
         docs.append(doc)
     return docs
+
+
+class TypedValue:
+    """Boxed register value carrying its wire datatype (uint / timestamp /
+    counter / float64 …) so device-served patches reproduce the host patch
+    grammar exactly (datatype survives the int32 value lanes)."""
+
+    __slots__ = ('value', 'datatype')
+
+    def __init__(self, value, datatype):
+        self.value = value
+        self.datatype = datatype
+
+    def __repr__(self):
+        return f'TypedValue({self.value!r}, {self.datatype!r})'
+
+    def __eq__(self, other):
+        return isinstance(other, TypedValue) and \
+            other.value == self.value and other.datatype == self.datatype
+
+    def __hash__(self):
+        return hash(('TypedValue', self.value, self.datatype))
+
+
+def _patch_leaf(raw, counter_fold, value_table):
+    """One visible register lane -> host-grammar patch value leaf."""
+    boxed = value_table[-raw - 2] if raw <= -2 and value_table is not None \
+        else raw
+    if isinstance(boxed, TypedValue):
+        value = boxed.value
+        if boxed.datatype == 'counter':
+            value += int(counter_fold)
+        return {'type': 'value', 'value': value, 'datatype': boxed.datatype}
+    if isinstance(boxed, bool) or boxed is None or isinstance(boxed, str):
+        return {'type': 'value', 'value': boxed}
+    if isinstance(boxed, float):
+        return {'type': 'value', 'value': boxed, 'datatype': 'float64'}
+    if isinstance(boxed, int):
+        return {'type': 'value', 'value': boxed, 'datatype': 'int'}
+    return None    # links / unsupported payloads: caller uses the mirror
+
+
+def register_patch_props(state, slot, keys, value_table=None):
+    """Whole-doc patch props for one document straight from RegisterState:
+    {key: {packed opId: value leaf}} over every visible op (the conflict
+    sets of ref new.js:1604-1635's documentPatch). Returns None when any
+    leaf needs the host mirror (nested/sequence links, unknown payloads)."""
+    # Slice this document's row on device: one get_patch call moves
+    # O(K*A), not the whole fleet's [N, K+1, A] state
+    reg = np.asarray(jax.device_get(state.reg[slot]))
+    killed = np.asarray(jax.device_get(state.killed[slot]))
+    value = np.asarray(jax.device_get(state.value[slot]))
+    counter = np.asarray(jax.device_get(state.counter[slot]))
+    visible = (reg != 0) & ~killed
+    props = {}
+    for k in range(len(keys)):
+        vis = np.flatnonzero(visible[k])
+        if not len(vis):
+            continue
+        cell = {}
+        for s in vis:
+            leaf = _patch_leaf(int(value[k, s]),
+                               int(counter[k, s]), value_table)
+            if leaf is None:
+                return None
+            cell[int(reg[k, s])] = leaf
+        props[keys[k]] = cell
+    return props

@@ -1369,3 +1369,76 @@ class TestCounterRebasing:
                 {'action': 'set', 'obj': '_root', 'key': 'x', 'value': 1,
                  'datatype': 'int', 'pred': []}])]], mirror=False)
         assert fleet.metrics.turbo_calls == before + 1
+
+
+class TestRegisterPatches:
+    """Exact-device get_patch comes straight from RegisterState — no mirror
+    rebuild (round-2 VERDICT item 10). Differentially equal to the host
+    backend's patch on the same history."""
+
+    def _scenarios(self):
+        A, B = ACTORS[0], ACTORS[1]
+        c1 = change_buf(A, 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'bird',
+             'value': 'magpie', 'pred': []},
+            {'action': 'set', 'obj': '_root', 'key': 'n', 'value': 7,
+             'datatype': 'int', 'pred': []},
+            {'action': 'set', 'obj': '_root', 'key': 'u', 'value': 3,
+             'datatype': 'uint', 'pred': []},
+            {'action': 'set', 'obj': '_root', 'key': 'f', 'value': 2.5,
+             'datatype': 'float64', 'pred': []},
+            {'action': 'set', 'obj': '_root', 'key': 'ok', 'value': True,
+             'pred': []},
+            {'action': 'set', 'obj': '_root', 'key': 'nothing',
+             'value': None, 'pred': []},
+            {'action': 'set', 'obj': '_root', 'key': 'when',
+             'value': 1589032171000, 'datatype': 'timestamp', 'pred': []},
+            {'action': 'set', 'obj': '_root', 'key': 'score', 'value': 10,
+             'datatype': 'counter', 'pred': []}])
+        h1 = am.decode_change(c1)['hash']
+        # concurrent conflicting writes + counter inc + delete
+        c2 = change_buf(A, 2, 9, [
+            {'action': 'inc', 'obj': '_root', 'key': 'score', 'value': 5,
+             'pred': [f'8@{A}']},
+            {'action': 'del', 'obj': '_root', 'key': 'nothing',
+             'pred': [f'6@{A}']}], deps=[h1])
+        c3 = change_buf(B, 1, 9, [
+            {'action': 'set', 'obj': '_root', 'key': 'bird',
+             'value': 'wren', 'pred': [f'1@{A}']}], deps=[h1])
+        return [c1, c2, c3]
+
+    def test_patch_differential_and_no_mirror_rebuilds(self):
+        changes = self._scenarios()
+        hb = host_backend.init()
+        for c in changes:
+            hb, _ = host_backend.apply_changes(hb, [c])
+        expected = host_backend.get_patch(hb)
+
+        fleet = DocFleet(doc_capacity=2, key_capacity=16, exact_device=True)
+        fb = FleetBackend(fleet)
+        gb = fb.init()
+        for c in changes:
+            gb, _ = fleet_backend.apply_changes(gb, [c])
+        got = fleet_backend.get_patch(gb)
+        assert got == expected
+        assert gb['state'].is_fleet
+        assert fleet.metrics.mirror_rebuilds == 0
+
+    def test_conflict_patch_from_device(self):
+        A, B = ACTORS[0], ACTORS[1]
+        c1 = change_buf(A, 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        c2 = change_buf(B, 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'value': 2,
+             'datatype': 'int', 'pred': []}])
+        fleet = DocFleet(doc_capacity=2, key_capacity=4, exact_device=True)
+        fb = FleetBackend(fleet)
+        gb = fb.init()
+        gb, _ = fleet_backend.apply_changes(gb, [c1])
+        gb, _ = fleet_backend.apply_changes(gb, [c2])
+        patch = fleet_backend.get_patch(gb)
+        assert patch['diffs']['props']['x'] == {
+            f'1@{A}': {'type': 'value', 'value': 1, 'datatype': 'int'},
+            f'1@{B}': {'type': 'value', 'value': 2, 'datatype': 'int'}}
+        assert fleet.metrics.mirror_rebuilds == 0
