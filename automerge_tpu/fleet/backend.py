@@ -371,34 +371,30 @@ class DocFleet:
         self.seq_state = SeqState(
             st.elem_id.at[idx].set(0),
             st.nxt.at[idx].set(END),
-            st.winner.at[idx].set(0),
-            st.vis.at[idx].set(False),
+            st.reg.at[idx].set(0),
+            st.killed.at[idx].set(False),
             st.val.at[idx].set(0),
             st.n.at[idx].set(0),
             st.inexact.at[idx].set(False))
 
     def _remap_seq_actors(self, perm):
-        """Renumber the actor bits of packed elemIds/winners in every
-        sequence row after a sorted-order actor insertion."""
+        """Renumber the actor bits of packed elemIds/register opIds in every
+        sequence row after a sorted-order actor insertion, permuting the
+        actor-lane axis the same way (lanes are indexed by actor number,
+        like _remap_reg_actors; machinery shared via _lane_permutation)."""
         if self.seq_state is None:
             return
         import jax.numpy as jnp
-        from .sequence import SeqState
-        mask = MAX_ACTORS - 1
-        perm_full = np.arange(MAX_ACTORS, dtype=np.int32)
-        perm_full[:len(perm)] = perm
-        bits = jnp.asarray(perm_full)
-        st = self.seq_state
+        from .sequence import SeqState, grow_seq_state
+        # Grow the lane axis FIRST (same rationale as _remap_reg_actors)
+        st = grow_seq_state(self.seq_state, 0, 0,
+                            _pow2(max(len(self.actors), 4)))
         self.metrics.remaps += 1
-
-        def remap(arr):
-            arr = jnp.asarray(arr)
-            return jnp.where(arr != 0, (arr & ~mask) | bits[arr & mask], 0)
-
+        move, renum = self._lane_permutation(perm, st.reg.shape[2])
         self.seq_state = SeqState(
-            remap(st.elem_id), jnp.asarray(st.nxt), remap(st.winner),
-            jnp.asarray(st.vis), jnp.asarray(st.val), jnp.asarray(st.n),
-            jnp.asarray(st.inexact))
+            renum(st.elem_id), jnp.asarray(st.nxt),
+            renum(move(st.reg, 0)), move(st.killed, False),
+            move(st.val, 0), jnp.asarray(st.n), jnp.asarray(st.inexact))
 
     def _intern_value(self, value):
         """Inline int32 in [0, 2^31) or a value-table ref -(i + 2)."""
@@ -428,9 +424,9 @@ class DocFleet:
         return -(self.value_table.intern(value) + 2)
 
     def _pack_seq_op(self, row, info, op, packed):
-        """One decoded sequence op -> (row, kind, ref, packed, value, pred,
-        flag) with packed opIds in fleet actor numbering."""
-        from .sequence import INSERT, SET, DEL, PAD
+        """One decoded sequence op -> (row, kind, ref, packed, value,
+        pred0..predD-1, flag) with packed opIds in fleet actor numbering."""
+        from .sequence import INSERT, SET, DEL, PAD, SEQ_PRED_LANES
         from .tensor_doc import pack_op_id
         from ..common import parse_op_id
 
@@ -442,9 +438,13 @@ class DocFleet:
 
         action = op['action']
         flag = False
-        pred = 0
-        for p in op.get('pred', []):
-            pred = max(pred, pack_ref(p))
+        lanes = [0] * SEQ_PRED_LANES
+        pred_ids = op.get('pred', [])
+        if len(pred_ids) > SEQ_PRED_LANES:
+            flag = True
+            pred_ids = pred_ids[:SEQ_PRED_LANES]
+        for i, p in enumerate(pred_ids):
+            lanes[i] = pack_ref(p)
         if action == 'inc':
             # Counters inside sequences are host-mirror-only: mark the row
             # inexact so reads route to the mirror (ref new.js:937-965)
@@ -457,28 +457,35 @@ class DocFleet:
             value = self._intern_seq_value(info['type'], op)
             if op.get('datatype') == 'counter':
                 flag = True
-        return (row, kind, pack_ref(op.get('elemId')), packed, value, pred,
-                flag)
+        return (row, kind, pack_ref(op.get('elemId')), packed, value,
+                *lanes, flag)
 
     def _dispatch_seq(self, seq_ops):
         """Grow the SeqState to cover every allocated row and batch-apply
-        all pending sequence ops in one dispatch."""
+        all pending sequence ops in one dispatch. seq_ops rows are
+        (row, kind, ref, packed, value, pred0..predD-1, flag)."""
         import jax.numpy as jnp
         from .sequence import (
-            SeqState, SeqOpBatch, grow_seq_state, apply_seq_batch, INSERT)
+            SeqState, SeqOpBatch, grow_seq_state, apply_seq_batch, INSERT,
+            SEQ_PRED_LANES)
         n_rows = len(self.seq_rows)
         if n_rows == 0:
             return
+        need_a = _pow2(max(len(self.actors), 4))
         if self.seq_state is None:
             self.seq_state = SeqState.empty(_pow2(n_rows),
-                                            self.seq_elem_cap, xp=jnp)
+                                            self.seq_elem_cap,
+                                            actor_slots=need_a, xp=jnp)
         if len(seq_ops) == 0:
-            if n_rows > self.seq_state.elem_id.shape[0]:
+            if n_rows > self.seq_state.elem_id.shape[0] or \
+                    need_a > self.seq_state.actor_slots:
                 self.seq_state = grow_seq_state(self.seq_state,
                                                 _pow2(n_rows),
-                                                self.seq_state.capacity)
+                                                self.seq_state.capacity,
+                                                need_a)
             return
-        arr = np.asarray(seq_ops, dtype=np.int64)   # [M, 7] op tuples
+        D = SEQ_PRED_LANES
+        arr = np.asarray(seq_ops, dtype=np.int64)   # [M, 6 + D] op tuples
         row_a = arr[:, 0]
         counts = np.bincount(row_a, minlength=n_rows)
         ins = np.bincount(row_a[arr[:, 1] == INSERT], minlength=n_rows)
@@ -488,7 +495,7 @@ class DocFleet:
         need_cap = int((cur_n + ins).max())
         self.seq_state = grow_seq_state(
             self.seq_state, _pow2(n_rows),
-            _pow2(max(need_cap, self.seq_elem_cap)))
+            _pow2(max(need_cap, self.seq_elem_cap)), need_a)
         r_cap = self.seq_state.elem_id.shape[0]
         width = max(int(counts.max()), 1)
         order = np.argsort(row_a, kind='stable')
@@ -496,14 +503,16 @@ class DocFleet:
         pos = np.arange(len(row_sorted)) - \
             np.searchsorted(row_sorted, row_sorted, side='left')
         cols = {name: np.zeros((r_cap, width), dtype=np.int32)
-                for name in ('kind', 'ref', 'packed', 'value', 'pred')}
+                for name in ('kind', 'ref', 'packed', 'value')}
+        preds = np.zeros((r_cap, width, D), dtype=np.int32)
         flag = np.zeros((r_cap, width), dtype=bool)
         for j, name in enumerate(('kind', 'ref', 'packed', 'value')):
             cols[name][row_sorted, pos] = arr[order, j + 1]
-        cols['pred'][row_sorted, pos] = arr[order, 5]
-        flag[row_sorted, pos] = arr[order, 6] != 0
+        for d in range(D):
+            preds[row_sorted, pos, d] = arr[order, 5 + d]
+        flag[row_sorted, pos] = arr[order, 5 + D] != 0
         batch = SeqOpBatch(cols['kind'], cols['ref'], cols['packed'],
-                           cols['value'], cols['pred'], flag)
+                           cols['value'], preds, flag)
         self.seq_state, _stats = apply_seq_batch(self.seq_state, batch)
         self.metrics.dispatches += 1
         self.metrics.device_ops += len(seq_ops)
@@ -622,27 +631,23 @@ class DocFleet:
         self.actor_slot_cap = a
         self.reg_state = RegisterState(*grown, inexact)
 
-    def _remap_reg_actors(self, perm):
-        """Renumber actor bits AND permute the actor-slot axis of the
-        register state after a sorted-order actor insertion."""
-        if self.reg_state is None:
-            return
+    @staticmethod
+    def _lane_permutation(perm, n_lanes):
+        """Shared actor-lane permutation machinery for the register and
+        sequence engines: lanes are indexed by actor number, so a
+        sorted-order actor insertion (perm: old actor num -> new actor num)
+        both renumbers packed-id actor bits and moves every lane.
+
+        Returns (move, renum): move(arr, fill) permutes the trailing lane
+        axis of a [..., n_lanes] array — every pre-existing actor appears in
+        perm; lanes not fed by any old actor (newly inserted actors, plus
+        the unused tail) start as `fill` — and renum(arr) rewrites the
+        actor bits of non-zero packed opIds."""
         import jax.numpy as jnp
-        from .registers import RegisterState
-        # Grow the slot axis FIRST: the freshly inserted actors may push an
-        # existing actor's new slot index past the current width, and the
-        # permutation below would silently drop its registers
-        self._ensure_reg_capacity(n_docs=self.n_slots, n_keys=len(self.keys))
-        self.metrics.remaps += 1
-        rs = self.reg_state
-        n, k, a = rs.reg.shape
-        # Old slot feeding each new slot: every pre-existing actor appears
-        # in perm; slots not fed by any old actor (newly inserted actors,
-        # plus the unused tail) start zeroed.
-        old_of_new = np.zeros(a, dtype=np.int32)
-        fresh = np.ones(a, dtype=bool)
+        old_of_new = np.zeros(n_lanes, dtype=np.int32)
+        fresh = np.ones(n_lanes, dtype=bool)
         for old_i, new_i in enumerate(np.asarray(perm)):
-            if new_i < a:
+            if new_i < n_lanes:
                 old_of_new[new_i] = old_i
                 fresh[new_i] = False
         gather = jnp.asarray(old_of_new)
@@ -653,15 +658,31 @@ class DocFleet:
         bits = jnp.asarray(perm_full)
 
         def move(arr, fill):
-            out = arr[:, :, gather]
-            return jnp.where(zero_new[None, None, :],
-                             jnp.full_like(out, fill), out)
+            out = jnp.asarray(arr)[..., gather]
+            return jnp.where(zero_new, jnp.full_like(out, fill), out)
 
-        reg = move(rs.reg, 0)
-        reg = jnp.where(reg != 0, (reg & ~mask) | bits[reg & mask], 0)
+        def renum(arr):
+            arr = jnp.asarray(arr)
+            return jnp.where(arr != 0, (arr & ~mask) | bits[arr & mask], 0)
+
+        return move, renum
+
+    def _remap_reg_actors(self, perm):
+        """Renumber actor bits AND permute the actor-slot axis of the
+        register state after a sorted-order actor insertion."""
+        if self.reg_state is None:
+            return
+        from .registers import RegisterState
+        # Grow the slot axis FIRST: the freshly inserted actors may push an
+        # existing actor's new slot index past the current width, and the
+        # permutation below would silently drop its registers
+        self._ensure_reg_capacity(n_docs=self.n_slots, n_keys=len(self.keys))
+        self.metrics.remaps += 1
+        rs = self.reg_state
+        move, renum = self._lane_permutation(perm, rs.reg.shape[2])
         self.reg_state = RegisterState(
-            reg, move(rs.killed, False), move(rs.value, 0),
-            move(rs.counter, 0), rs.inexact)
+            renum(move(rs.reg, 0)), move(rs.killed, False),
+            move(rs.value, 0), move(rs.counter, 0), rs.inexact)
 
     def _rebase_slot(self, slot, new_ctr, floor_ctr=None):
         """Shift a slot's packing window so counters up to `new_ctr` fit:
@@ -913,6 +934,13 @@ class DocFleet:
                 val_idx, flags = TOMBSTONE, 1
             elif action == 'inc':
                 val_idx, flags = op.get('value', 0), 2
+            elif op.get('datatype') not in (None, 'int'):
+                # uint/counter/timestamp/float64 sets box with their
+                # datatype so device-served patches stay exact (same rule
+                # as ingest.changes_to_op_rows)
+                from .registers import TypedValue
+                val_idx, flags = self._intern_value_boxed(
+                    TypedValue(op.get('value'), op['datatype'])), 1
             else:
                 val_idx, flags = self._intern_value(op.get('value')), 1
             out_doc.append(d)
@@ -1273,8 +1301,11 @@ class _FlatEngine(HashGraph):
         if fleet.reg_state is None:
             return empty
         import numpy as _np
-        if self.slot < fleet.reg_state.inexact.shape[0] and \
-                bool(_np.asarray(fleet.reg_state.inexact[self.slot])):
+        if self.slot >= fleet.reg_state.inexact.shape[0]:
+            # Past the register state's doc capacity: a clamped device
+            # gather would serve another doc's row — mirror serves instead
+            return None
+        if bool(_np.asarray(fleet.reg_state.inexact[self.slot])):
             return None
         from .registers import register_patch_props
         from .tensor_doc import unpack_op_id
@@ -1294,10 +1325,11 @@ class _FlatEngine(HashGraph):
         return {'objectId': '_root', 'type': 'map', 'props': out}
 
     def materialize(self):
-        """Exact current {key: value} view from the host mirror (LWW winner
-        per key, ascending-Lamport max, frontend/apply_patch.js:33-42);
-        sequence-object values render to str (text) / list."""
-        self._ensure_mirror()
+        """Exact current {key: value} view (LWW winner per key,
+        ascending-Lamport max, frontend/apply_patch.js:33-42); sequence
+        values render to str (text) / list. get_patch serves from the
+        device registers when it can and rebuilds the mirror itself when it
+        can't, so no mirror work happens here."""
         from ..common import lamport_key
         doc = {}
         for key, candidates in self.get_patch()['diffs'].get('props',
@@ -1897,12 +1929,24 @@ def _apply_changes_turbo(handles, per_doc_changes):
             fleet._alloc_seq_row(slot, oid, typ)
         kept_vals_all[ri] = fleet._intern_value_boxed(_SeqLink(oid))
         kept_flags_all[ri] = 1
+    if fleet.exact_device:
+        # uint/counter/timestamp root sets box with their wire datatype so
+        # device-served patches keep exact datatypes and counter folds
+        # (same rule as ingest.changes_to_op_rows; dels carry value -1 and
+        # no typed vtype, so they never box)
+        from .registers import TypedValue, typed_wire_tags
+        _tags = typed_wire_tags()
+        typed_sel = keep & (rows['flags'] == 1) & (rows['value'] != -1) & \
+            np.isin(rows['vtype'], list(_tags))
+        for ri in np.flatnonzero(typed_sel):
+            kept_vals_all[ri] = fleet._intern_value_boxed(TypedValue(
+                int(rows['value'][ri]), _tags[int(rows['vtype'][ri])]))
 
     def dispatch_seq_rows():
         """Kept sequence rows -> one SeqState dispatch (fleet numbering)."""
         if not keep_seq.any():
             return
-        from .sequence import INSERT, SET, DEL, PAD
+        from .sequence import INSERT, SET, DEL, PAD, SEQ_PRED_LANES
         sflags = rows['flags'][keep_seq]
         svtype = rows['vtype'][keep_seq]
         svalue = rows['value'][keep_seq].astype(np.int64)
@@ -1920,13 +1964,17 @@ def _apply_changes_turbo(handles, per_doc_changes):
         spacked = remap_ids(rows['packed'][keep_seq].astype(np.int64))
         sref = remap_ids(rows['ref'][keep_seq].astype(np.int64))
         pred_counts = np.diff(rows['pred_off'])
-        entry_keep = np.repeat(keep_seq, pred_counts)
-        spred_flat = remap_ids(rows['pred'][entry_keep].astype(np.int64))
+        spred_all = remap_ids(rows['pred'].astype(np.int64))
         n_seq = int(keep_seq.sum())
-        pred_max = np.zeros(n_seq, dtype=np.int64)
-        if len(spred_flat):
-            seg = np.repeat(np.arange(n_seq), pred_counts[keep_seq])
-            np.maximum.at(pred_max, seg, spred_flat)
+        D = SEQ_PRED_LANES
+        counts_seq = pred_counts[keep_seq]
+        off_seq = rows['pred_off'][:-1][keep_seq]
+        pred_lanes = np.zeros((n_seq, D), dtype=np.int64)
+        for d in range(D):
+            has = counts_seq > d
+            if has.any():
+                pred_lanes[has, d] = spred_all[off_seq[has] + d]
+        pred_overflow = counts_seq > D
         # resolve device rows per unique (doc, objectId)
         pair = np.stack([sdoc, sobj], axis=1)
         uniq, inv = np.unique(pair, axis=0, return_inverse=True)
@@ -1943,14 +1991,16 @@ def _apply_changes_turbo(handles, per_doc_changes):
         is_text = np.array([info is not None and info['type'] == 'text'
                             for info in fleet.seq_rows], dtype=bool)
         txt = is_text[srow]
-        # host-side inexact flags: counter ops (flags 6 / vtype 8), and
-        # payload types the device value column can't carry for this row
-        # type (non-char in text, char in list)
+        # host-side inexact flags: counter ops (flags 6 / vtype 8), pred
+        # lists past the lane width, and payload types the device value
+        # column can't carry for this row type (non-char in text, char in
+        # list)
         val_op = (sflags == 3) | (sflags == 4)
-        hflag = (sflags == 6) | (svtype == 8) | \
+        hflag = (sflags == 6) | (svtype == 8) | pred_overflow | \
             (val_op & (txt != (svtype == 6)))
         fleet._dispatch_seq(np.stack(
-            [srow, skind, sref, spacked, svalue, pred_max,
+            [srow, skind, sref, spacked, svalue,
+             *(pred_lanes[:, d] for d in range(D)),
              hflag.astype(np.int64)], axis=1))
 
     n_kept_root = int(keep_root.sum())

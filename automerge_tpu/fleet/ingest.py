@@ -329,11 +329,11 @@ def changes_to_op_rows(per_doc_changes, key_interner, actor_interner,
 
             values = rows['value'].astype(np.int32, copy=True)
             if value_table is not None and 'vtype' in rows:
-                from .registers import TypedValue
-                from ..columnar import VALUE_TYPE
-                tags = {VALUE_TYPE['LEB128_UINT']: 'uint',
-                        VALUE_TYPE['COUNTER']: 'counter',
-                        VALUE_TYPE['TIMESTAMP']: 'timestamp'}
+                from .registers import TypedValue, typed_wire_tags
+                tags = typed_wire_tags()
+                # values == TOMBSTONE (-1) identifies del rows: the native
+                # parser rejects negative set values outright
+                # (codec.cpp set-value range check), so -1 can only be a del
                 typed = (rows['flags'] == 1) & (values != TOMBSTONE) & \
                     np.isin(rows['vtype'], list(tags))
                 for ri in np.flatnonzero(typed):

@@ -305,6 +305,19 @@ def materialize_registers(state, keys, value_table=None):
     return docs
 
 
+def typed_wire_tags():
+    """Wire value-type tag -> datatype string for root-map set values that
+    must box as TypedValue (uint/counter/timestamp ride int32 value lanes;
+    the datatype survives only via the box). The single source of truth for
+    every ingest path — native rows, turbo, and the mixed Python decode —
+    so device-served patches emit identical datatype leaves regardless of
+    which path a change took."""
+    from ..columnar import VALUE_TYPE
+    return {VALUE_TYPE['LEB128_UINT']: 'uint',
+            VALUE_TYPE['COUNTER']: 'counter',
+            VALUE_TYPE['TIMESTAMP']: 'timestamp'}
+
+
 class TypedValue:
     """Boxed register value carrying its wire datatype (uint / timestamp /
     counter / float64 …) so device-served patches reproduce the host patch

@@ -25,10 +25,17 @@ and per-element LWW — actor numbers MUST be assigned in ascending
 lexicographic order of the actor hex ids (the reference's columnar format
 sorts its actor table the same way, ref backend/columnar.js:133-170).
 
-Semantics note: per-element overwrite resolution here is greatest-opId LWW,
-which matches the host engine for causally-ordered edits; concurrent
-set-vs-delete multi-value conflict shapes route through the host OpSet engine
-(same caveat as the map engine, see tensor_doc.py).
+Per-element overwrite state is an exact multi-value register (the
+fleet/registers.py design applied to sequence elements): each element keeps
+an actor-slotted visible set — packed opId + payload per actor lane, with a
+`killed` bit marking ops that have a successor (ref new.js:1204-1217's
+succNum == 0 visibility rule). A SET/DEL kills exactly its preds, never
+concurrent ops, so the two shapes where single-winner LWW diverges from the
+reference — concurrent set-vs-set (conflict sets) and set-vs-delete
+(element resurrection, ref test/new_backend_test.js:1660) — are exact on
+device. The remaining host-only shapes (counters inside sequences,
+same-actor overwrites that don't pred their own op, pred lists past
+SEQ_PRED_LANES) flag the row `inexact` and route reads to the host mirror.
 """
 
 import numpy as np
@@ -37,7 +44,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .tensor_doc import ACTOR_BITS, pack_op_id, register_pytrees
+from .tensor_doc import ACTOR_BITS, MAX_ACTORS, pack_op_id, register_pytrees
 
 # Op kinds in a SeqOpBatch
 PAD, INSERT, SET, DEL = 0, 1, 2, 3
@@ -45,6 +52,17 @@ PAD, INSERT, SET, DEL = 0, 1, 2, 3
 HEAD_REF = 0  # `ref == 0` means insert at the head ('_head' in the reference)
 
 INT32_MAX = np.int32(2**31 - 1)
+
+ACTOR_MASK = MAX_ACTORS - 1
+
+# Static pred-lane width: ops with more preds flag their row inexact. A pred
+# list wider than the element's current conflict set cannot occur, so lanes
+# bound the *representable* conflict width, matching registers.RegisterOpBatch.
+SEQ_PRED_LANES = 4
+
+# Default actor-lane width for new states; grows on demand (pow2) with the
+# fleet's actor table.
+DEFAULT_ACTOR_SLOTS = 4
 
 
 # Node-id layout, front-anchored so every per-node array shares one shape
@@ -60,18 +78,31 @@ HEAD, END, SCRATCH, SLOT0 = 0, 1, 2, 3
 
 
 class SeqState:
-    """Pytree of per-doc sequence tensors: five [N, S+3] per-node arrays
-    (shared node-id indexing, sentinels at the front) + [N] allocation
-    cursors + [N] inexact flags (device state diverged from reference
-    semantics — concurrent set-vs-delete, counters, unknown referents — so
-    reads must come from the host mirror, cf. registers.RegisterState)."""
+    """Pytree of per-doc sequence tensors.
 
-    def __init__(self, elem_id, nxt, winner, vis, val, n, inexact=None):
-        self.elem_id = elem_id  # packed elemId per slot (0 = unallocated)
-        self.nxt = nxt          # linked-list next pointers over node ids
-        self.winner = winner    # packed opId of the LWW winner op per element
-        self.vis = vis          # element visible (winner is not a delete)
-        self.val = val          # winner's value (char code / value-table idx)
+    Element identity / order (node-id indexed, [N, S+3]):
+      elem_id  packed elemId per slot (0 = unallocated)
+      nxt      linked-list next pointers over node ids
+
+    Per-element multi-value registers ([N, S+3, A], actor-lane indexed by the
+    op's packed actor number — at most one live op per actor per element in
+    causally well-formed histories, since the frontend always preds its own
+    visible op, ref frontend/context.js:576-586):
+      reg      packed opId of actor lane a's op on this element (0 = none)
+      killed   that op has a successor (overwritten / deleted)
+      val      the op's payload (char code / value-table ref)
+
+    Plus [N] allocation cursors `n` and [N] `inexact` flags (device state
+    diverged from reference semantics — counters in sequences, self
+    conflicts, pred overflow, unknown referents — so reads must come from
+    the host mirror, cf. registers.RegisterState)."""
+
+    def __init__(self, elem_id, nxt, reg, killed, val, n, inexact=None):
+        self.elem_id = elem_id
+        self.nxt = nxt
+        self.reg = reg
+        self.killed = killed
+        self.val = val
         self.n = n              # slots allocated per doc
         if inexact is None:
             # .shape is static even on tracers, so this default is jit-safe
@@ -82,21 +113,26 @@ class SeqState:
     def capacity(self):
         return self.elem_id.shape[1] - 3
 
+    @property
+    def actor_slots(self):
+        return self.reg.shape[2]
+
     @classmethod
-    def empty(cls, n_docs, capacity, xp=np):
+    def empty(cls, n_docs, capacity, actor_slots=DEFAULT_ACTOR_SLOTS, xp=np):
         nodes = (n_docs, capacity + 3)
+        lanes = (n_docs, capacity + 3, actor_slots)
         nxt = xp.full(nodes, END, dtype=np.int32)
         return cls(
             xp.zeros(nodes, dtype=np.int32),
             nxt,
-            xp.zeros(nodes, dtype=np.int32),
-            xp.zeros(nodes, dtype=bool),
-            xp.zeros(nodes, dtype=np.int32),
+            xp.zeros(lanes, dtype=np.int32),
+            xp.zeros(lanes, dtype=bool),
+            xp.zeros(lanes, dtype=np.int32),
             xp.zeros((n_docs,), dtype=np.int32),
             xp.zeros((n_docs,), dtype=bool))
 
     def tree_flatten(self):
-        return ((self.elem_id, self.nxt, self.winner, self.vis, self.val,
+        return ((self.elem_id, self.nxt, self.reg, self.killed, self.val,
                  self.n, self.inexact), None)
 
     @classmethod
@@ -104,20 +140,28 @@ class SeqState:
         return cls(*children)
 
 
-def grow_seq_state(state, n_rows, capacity):
-    """Host-side resize to at least (n_rows rows, capacity slots): new rows
-    and tail slots are zeroed/END-filled; existing node ids never move (the
-    sentinels are front-anchored precisely so capacity can grow by appending
-    at the tail). Returns `state` unchanged if already big enough."""
+def grow_seq_state(state, n_rows, capacity, actor_slots=None):
+    """Host-side resize to at least (n_rows rows, capacity slots,
+    actor_slots lanes): new rows/slots/lanes are zeroed/END-filled; existing
+    node ids and actor lanes never move (the sentinels are front-anchored
+    precisely so capacity can grow by appending at the tail). Returns
+    `state` unchanged if already big enough."""
     old_r, old_nodes = state.elem_id.shape
     old_cap = old_nodes - 3
-    if n_rows <= old_r and capacity <= old_cap:
+    old_a = state.reg.shape[2]
+    want_a = old_a if actor_slots is None else actor_slots
+    if n_rows <= old_r and capacity <= old_cap and want_a <= old_a:
         return state
     r, cap = max(n_rows, old_r), max(capacity, old_cap)
+    a = max(want_a, old_a)
 
     def pad(arr, fill, dtype):
         out = jnp.full((r, cap + 3), fill, dtype=dtype)
         return out.at[:old_r, :old_nodes].set(arr)
+
+    def pad_lane(arr, fill, dtype):
+        out = jnp.full((r, cap + 3, a), fill, dtype=dtype)
+        return out.at[:old_r, :old_nodes, :old_a].set(arr)
 
     def pad_vec(arr, dtype):
         out = jnp.zeros((r,), dtype=dtype)
@@ -126,9 +170,9 @@ def grow_seq_state(state, n_rows, capacity):
     return SeqState(
         pad(state.elem_id, 0, jnp.int32),
         pad(state.nxt, END, jnp.int32),
-        pad(state.winner, 0, jnp.int32),
-        pad(state.vis, False, bool),
-        pad(state.val, 0, jnp.int32),
+        pad_lane(state.reg, 0, jnp.int32),
+        pad_lane(state.killed, False, bool),
+        pad_lane(state.val, 0, jnp.int32),
         pad_vec(state.n, jnp.int32),
         pad_vec(state.inexact, bool))
 
@@ -141,26 +185,29 @@ class SeqOpBatch:
                     SET/DEL → packed elemId of the target element
     - packed int32: the op's own packed opId (INSERT: the new elemId)
     - value  int32: INSERT/SET payload
-    - pred   int32: SET/DEL → greatest packed pred opId (0 = none). The
-      device compares it against the element's current winner: a mismatch
-      means the op was concurrent with another overwrite — the one shape
-      where LWW diverges from the reference's multi-value/resurrection
-      semantics — and flags the row inexact.
+    - preds  int32 [N, P, SEQ_PRED_LANES]: packed opIds this op supersedes
+      (0 = unused lane, negative = pred naming an actor unknown to the
+      fleet). The device kills exactly these lanes in the target element's
+      register; concurrent ops survive (multi-value / resurrection
+      semantics, ref new.js:1204-1217).
     - flag   bool: host-detected inexactness for this row (counter ops in
-      sequences, pred overflow): applied unconditionally.
+      sequences, pred-lane overflow): applied unconditionally.
     """
 
-    def __init__(self, kind, ref, packed, value, pred=None, flag=None):
+    def __init__(self, kind, ref, packed, value, preds=None, flag=None):
         self.kind = kind
         self.ref = ref
         self.packed = packed
         self.value = value
-        self.pred = np.zeros_like(np.asarray(kind)) if pred is None else pred
+        if preds is None:
+            preds = np.zeros(np.asarray(kind).shape + (SEQ_PRED_LANES,),
+                             dtype=np.int32)
+        self.preds = preds
         self.flag = np.zeros(np.asarray(kind).shape, dtype=bool) \
             if flag is None else flag
 
     def tree_flatten(self):
-        return ((self.kind, self.ref, self.packed, self.value, self.pred,
+        return ((self.kind, self.ref, self.packed, self.value, self.preds,
                  self.flag), None)
 
     @classmethod
@@ -171,11 +218,11 @@ class SeqOpBatch:
 register_pytrees(SeqState, SeqOpBatch)
 
 
-def _apply_one_doc(carry, op, capacity):
+def _apply_one_doc(carry, op, capacity, n_actor_slots):
     """One op against one doc.
-    carry = (elem_id, nxt, winner, vis, val, n, inexact)."""
-    elem_id, nxt, winner, vis, val, n, inexact = carry
-    kind, ref, packed, value, pred, flag = op
+    carry = (elem_id, nxt, reg, killed, val, n, inexact)."""
+    elem_id, nxt, reg, killed, val, n, inexact = carry
+    kind, ref, packed, value, preds, flag = op
 
     is_ins = kind == INSERT
     is_upd = (kind == SET) | (kind == DEL)
@@ -226,62 +273,125 @@ def _apply_one_doc(carry, op, capacity):
 
     nxt = nxt.at[ins_ptr_new].set(jnp.where(can_ins, j, nxt[ins_ptr_new]))
     nxt = nxt.at[ins_ptr_from].set(jnp.where(can_ins, slot, nxt[ins_ptr_from]))
-    # All four masked writes preserve the scratch node's contents so that
-    # elem_id[SCRATCH] stays 0 — the invariant the one-hot referent match
-    # depends on.
+    # All masked writes preserve the scratch node's elem_id = 0 — the
+    # invariant the one-hot referent match depends on. (Scratch's register
+    # lanes absorb masked lane writes; their contents are never read.)
     elem_id = elem_id.at[ins_slot].set(jnp.where(can_ins, packed,
                                                  elem_id[ins_slot]))
-    winner = winner.at[ins_slot].set(jnp.where(can_ins, packed,
-                                               winner[ins_slot]))
-    vis = vis.at[ins_slot].set(jnp.where(can_ins, True, vis[ins_slot]))
-    val = val.at[ins_slot].set(jnp.where(can_ins, value, val[ins_slot]))
     n = n + can_ins.astype(jnp.int32)
 
-    # ---- SET / DEL: per-element LWW ------------------------------------
+    # Own actor lane (the insert op IS the element's first set op; a SET
+    # occupies its actor's lane the same way, ref registers.py design note)
+    a = (packed & ACTOR_MASK).astype(jnp.int32)
+    a_ok = a < n_actor_slots
+    a_c = jnp.minimum(a, n_actor_slots - 1)
+
+    ins_lane_tgt = jnp.where(can_ins & a_ok, slot, jnp.int32(SCRATCH))
+    w_ins = can_ins & a_ok
+    reg = reg.at[ins_lane_tgt, a_c].set(
+        jnp.where(w_ins, packed, reg[ins_lane_tgt, a_c]))
+    killed = killed.at[ins_lane_tgt, a_c].set(
+        jnp.where(w_ins, False, killed[ins_lane_tgt, a_c]))
+    val = val.at[ins_lane_tgt, a_c].set(
+        jnp.where(w_ins, value, val[ins_lane_tgt, a_c]))
+
+    # ---- SET / DEL: exact multi-value register update -------------------
     # ref == HEAD_REF (0) marks a malformed update (no target): it would
     # "match" every unallocated slot's zero elem_id, so reject it explicitly.
-    # The concurrency check must read the PRE-update winner: an op whose
-    # pred is not the op it actually supersedes was concurrent with another
-    # overwrite — the shape where LWW diverges from the reference's
-    # multi-value / set-vs-delete-resurrection semantics (new.js:1204-1217).
-    concurrent = is_upd & found & (ref != HEAD_REF) & (pred != winner[match])
-    lww = is_upd & found & (ref != HEAD_REF) & (packed > winner[match])
-    upd_slot = jnp.where(lww, match, jnp.int32(SCRATCH))
-    winner = winner.at[upd_slot].set(jnp.where(lww, packed, winner[upd_slot]))
-    vis = vis.at[upd_slot].set(jnp.where(lww, kind == SET, vis[upd_slot]))
-    val = val.at[upd_slot].set(jnp.where(lww & (kind == SET), value,
-                                         val[upd_slot]))
+    upd_ok = is_upd & found & (ref != HEAD_REF)
+    tgt = jnp.where(upd_ok, match, jnp.int32(SCRATCH))
+    reg_row = reg[tgt]          # [A]
+    killed_row = killed[tgt]
+    val_row = val[tgt]
+
+    # Kill preds: each pred lane targets its actor's lane; the kill lands
+    # only if that lane still holds exactly the pred'd op (a pred naming an
+    # already-superseded op is a legitimate no-op succ entry, which the
+    # reference also accepts). Concurrent ops are never killed — that is
+    # the multi-value / resurrection rule (new.js:1204-1217).
+    lane_oob = jnp.bool_(False)
+    d_lanes = preds.shape[0]
+    for d in range(d_lanes):
+        p = preds[d]
+        s = (p & ACTOR_MASK).astype(jnp.int32)
+        s_ok = (s < n_actor_slots) & (p > 0)
+        s_c = jnp.minimum(s, n_actor_slots - 1)
+        lane_oob |= upd_ok & (p != 0) & ~s_ok
+        hit = upd_ok & s_ok & (reg_row[s_c] == p)
+        killed_row = killed_row.at[s_c].set(killed_row[s_c] | hit)
+
+    # SET: occupy own actor lane. If the lane already holds a live op this
+    # op did NOT pred, the reference would keep both visible — outside the
+    # one-op-per-actor shape (only constructible by hand-built changes), so
+    # flag the doc instead of losing data.
+    is_set_live = upd_ok & (kind == SET)
+    own_prev = reg_row[a_c]
+    own_pred = jnp.bool_(False)
+    for d in range(d_lanes):
+        own_pred |= preds[d] == own_prev
+    self_conflict = is_set_live & a_ok & (own_prev != 0) & \
+        ~killed_row[a_c] & ~own_pred & (own_prev != packed)
+    set_actor_oob = is_set_live & ~a_ok
+
+    w_set = is_set_live & a_ok
+    reg_row = reg_row.at[a_c].set(jnp.where(w_set, packed, reg_row[a_c]))
+    killed_row = killed_row.at[a_c].set(
+        jnp.where(w_set, False, killed_row[a_c]))
+    val_row = val_row.at[a_c].set(jnp.where(w_set, value, val_row[a_c]))
+
+    reg = reg.at[tgt].set(reg_row)
+    killed = killed.at[tgt].set(killed_row)
+    val = val.at[tgt].set(val_row)
 
     # Dropped ops (over-capacity or unknown-referent inserts, SET/DELs on
     # unknown targets) report as not-applied so callers can detect loss from
     # the stats instead of getting silent truncation.
-    applied = jnp.where(is_ins, can_ins,
-                        (kind > PAD) & found & (ref != HEAD_REF))
+    applied = jnp.where(is_ins, can_ins, upd_ok)
+    ins_actor_oob = can_ins & ~a_ok
     # Inexactness: host-flagged ops (counters, pred overflow), any dropped
-    # live op, and concurrent overwrites (computed above, pre-update)
-    inexact = inexact | flag | concurrent | ((kind > PAD) & ~applied)
-    return (elem_id, nxt, winner, vis, val, n, inexact), applied
+    # live op, actor numbers past the lane width, self conflicts, and preds
+    # naming unknown/out-of-range actors
+    inexact = inexact | flag | self_conflict | lane_oob | set_actor_oob | \
+        ins_actor_oob | ((kind > PAD) & ~applied)
+    return (elem_id, nxt, reg, killed, val, n, inexact), applied
 
 
 def _apply_seq_batch_impl(state, ops):
     capacity = state.elem_id.shape[1] - 3
+    n_actor_slots = state.reg.shape[2]
 
-    def per_doc(elem_id, nxt, winner, vis, val, n, inexact,
-                kind, ref, packed, value, pred, flag):
-        carry = (elem_id, nxt, winner, vis, val, n, inexact)
-        xs = (kind, ref, packed, value, pred, flag)
+    def per_doc(elem_id, nxt, reg, killed, val, n, inexact,
+                kind, ref, packed, value, preds, flag):
+        carry = (elem_id, nxt, reg, killed, val, n, inexact)
+        xs = (kind, ref, packed, value, preds, flag)
         carry, applied = lax.scan(
-            lambda c, x: _apply_one_doc(c, x, capacity), carry, xs)
+            lambda c, x: _apply_one_doc(c, x, capacity, n_actor_slots),
+            carry, xs)
         return carry, jnp.sum(applied, dtype=jnp.int32)
 
     carry, applied = jax.vmap(per_doc)(
-        state.elem_id, state.nxt, state.winner, state.vis, state.val, state.n,
-        state.inexact, ops.kind, ops.ref, ops.packed, ops.value, ops.pred,
-        ops.flag)
+        state.elem_id, state.nxt, state.reg, state.killed, state.val,
+        state.n, state.inexact, ops.kind, ops.ref, ops.packed, ops.value,
+        ops.preds, ops.flag)
     return SeqState(*carry), jnp.sum(applied)
 
 
 apply_seq_batch = jax.jit(_apply_seq_batch_impl)
+
+
+def _visible_impl(state):
+    """Per-element visibility and Lamport winner from the registers:
+    (vis [N, S+3] bool, winner [N, S+3] int32 packed, value [N, S+3])."""
+    live = (state.reg != 0) & ~state.killed
+    vis = jnp.any(live, axis=-1)
+    masked = jnp.where(live, state.reg, -1)
+    w = jnp.argmax(masked, axis=-1)
+    winner = jnp.max(jnp.where(live, state.reg, 0), axis=-1)
+    value = jnp.take_along_axis(state.val, w[..., None], axis=-1)[..., 0]
+    return vis, winner, value
+
+
+element_visibility = jax.jit(_visible_impl)
 
 
 def _linearize_impl(state):
@@ -321,10 +431,13 @@ def _materialize_impl(state):
 
     vals/vis are scattered into order positions; entries at index >= length
     are zeros. Visible-only extraction (for text strings / patch indexes) is
-    a host-side compress over the vis mask.
-    """
+    a host-side compress over the vis mask. Values are the per-element
+    Lamport winners over the visible register set (conflict sets render
+    their winner, like the reference's applyProperties rule,
+    frontend/apply_patch.js:57-79)."""
     capacity = state.elem_id.shape[1] - 3
     pos, n = _linearize_impl(state)
+    e_vis, _winner, e_val = _visible_impl(state)
 
     def per_doc(pos, vis, val, n):
         node_ids = jnp.arange(capacity + 3, dtype=jnp.int32)
@@ -338,7 +451,7 @@ def _materialize_impl(state):
             jnp.where(alloc, vis, False))
         return out_val[:capacity], out_vis[:capacity]
 
-    vals, vis = jax.vmap(per_doc)(pos, state.vis, state.val, state.n)
+    vals, vis = jax.vmap(per_doc)(pos, e_vis, e_val, state.n)
     return vals, vis, state.n
 
 
@@ -356,11 +469,31 @@ def visible_text(state):
     return out
 
 
+def element_conflicts(state, row):
+    """Host read of one doc's per-element conflict sets: {packed elemId:
+    {packed opId: value}} for every element whose visible register holds
+    more than one op (the list-element analogue of
+    registers.register_patch_props)."""
+    reg = np.asarray(jax.device_get(state.reg[row]))
+    killed = np.asarray(jax.device_get(state.killed[row]))
+    val = np.asarray(jax.device_get(state.val[row]))
+    elem = np.asarray(jax.device_get(state.elem_id[row]))
+    live = (reg != 0) & ~killed
+    out = {}
+    for node in np.flatnonzero(live.sum(axis=-1) > 1):
+        lanes = np.flatnonzero(live[node])
+        out[int(elem[node])] = {int(reg[node, s]): int(val[node, s])
+                                for s in lanes}
+    return out
+
+
 class SeqEncoder:
     """Host-side helper turning 'ctr@actor' string ops into SeqOpBatch
     columns for one fleet. Actor numbers are assigned by ascending hex order
     over a fixed, pre-registered actor set (required for packed-opId
-    comparisons to match host Lamport order)."""
+    comparisons to match host Lamport order). SET/DEL ops default their
+    pred to the target elemId (the element's insert op) when none is given —
+    the common shape for linear edit traces."""
 
     def __init__(self, actors):
         self.actor_num = {a: i for i, a in enumerate(sorted(actors))}
@@ -374,7 +507,8 @@ class SeqEncoder:
     def batch(self, per_doc_ops, pad_to=None):
         """per_doc_ops: list (per doc) of op dicts
         {kind: 'insert'|'set'|'del', ref/target: opId str, id: opId str,
-         value: int}. Returns a SeqOpBatch of numpy columns [N, P]."""
+         value: int, pred: [opId str, ...]}. Returns a SeqOpBatch of numpy
+        columns [N, P]."""
         n_docs = len(per_doc_ops)
         width = max((len(ops) for ops in per_doc_ops), default=0)
         if pad_to is not None:
@@ -383,17 +517,25 @@ class SeqEncoder:
         ref = np.zeros((n_docs, width), dtype=np.int32)
         packed = np.zeros((n_docs, width), dtype=np.int32)
         value = np.zeros((n_docs, width), dtype=np.int32)
-        pred = np.zeros((n_docs, width), dtype=np.int32)
+        preds = np.zeros((n_docs, width, SEQ_PRED_LANES), dtype=np.int32)
         flag = np.zeros((n_docs, width), dtype=bool)
         kinds = {'insert': INSERT, 'set': SET, 'del': DEL}
         for d, ops in enumerate(per_doc_ops):
             for i, op in enumerate(ops):
                 kind[d, i] = kinds[op['kind']]
-                ref[d, i] = self.pack(op.get('ref') or op.get('target'))
+                target = op.get('ref') or op.get('target')
+                ref[d, i] = self.pack(target)
                 packed[d, i] = self.pack(op['id'])
                 value[d, i] = op.get('value', 0)
-                preds = op.get('pred') or []
-                if preds:
-                    pred[d, i] = max(self.pack(p) for p in preds)
-                flag[d, i] = bool(op.get('flag'))
-        return SeqOpBatch(kind, ref, packed, value, pred, flag)
+                pred_ids = op.get('pred')
+                if pred_ids is None and op['kind'] in ('set', 'del'):
+                    pred_ids = [target]
+                pred_ids = pred_ids or []
+                if len(pred_ids) > SEQ_PRED_LANES:
+                    flag[d, i] = True
+                    pred_ids = pred_ids[:SEQ_PRED_LANES]
+                for l, p in enumerate(pred_ids):
+                    preds[d, i, l] = self.pack(p)
+                if op.get('flag'):
+                    flag[d, i] = True
+        return SeqOpBatch(kind, ref, packed, value, preds, flag)

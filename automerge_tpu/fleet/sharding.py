@@ -13,6 +13,7 @@ transport-agnostic peer protocol scaled to a sharded fleet service.
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .tensor_doc import FleetState
@@ -53,44 +54,47 @@ def shard_ops(ops, mesh):
 
 
 def seq_sharding(mesh):
-    """NamedShardings for SeqState / SeqOpBatch: data-parallel over the docs
+    """NamedShardings for SeqState / SeqOpBatch, data-parallel over the docs
     axis only — the per-doc slot axis stays local (the RGA pointer walk is a
-    per-document scan; sharding it would put pointer chasing on ICI)."""
-    row = NamedSharding(mesh, P('docs', None))
-    vec = NamedSharding(mesh, P('docs'))
-    return row, vec
+    per-document scan; sharding it would put pointer chasing on ICI). Arrays
+    pick their spec by rank: [docs] vectors, [docs, slots] node arrays,
+    [docs, slots, lanes] register/pred-lane arrays."""
+    by_ndim = {1: NamedSharding(mesh, P('docs')),
+               2: NamedSharding(mesh, P('docs', None)),
+               3: NamedSharding(mesh, P('docs', None, None))}
+    return by_ndim
+
+
+def _put_by_ndim(tree_obj, by_ndim):
+    import jax.tree_util as tree
+    return tree.tree_map(
+        lambda x: jax.device_put(x, by_ndim[x.ndim]), tree_obj)
+
+
+def _constrain_by_ndim(tree_obj, by_ndim):
+    import jax.tree_util as tree
+    return tree.tree_map(
+        lambda x: jax.lax.with_sharding_constraint(x, by_ndim[x.ndim]),
+        tree_obj)
 
 
 def shard_seq(state, mesh):
-    from .sequence import SeqState
-    row, vec = seq_sharding(mesh)
-    return SeqState(
-        jax.device_put(state.elem_id, row), jax.device_put(state.nxt, row),
-        jax.device_put(state.winner, row), jax.device_put(state.vis, row),
-        jax.device_put(state.val, row), jax.device_put(state.n, vec))
+    return _put_by_ndim(state, seq_sharding(mesh))
 
 
 def shard_seq_ops(ops, mesh):
-    row, _ = seq_sharding(mesh)
-    import jax.tree_util as tree
-    return tree.tree_map(lambda x: jax.device_put(x, row), ops)
+    return _put_by_ndim(ops, seq_sharding(mesh))
 
 
 def sharded_seq_apply(mesh):
     """Jitted sequence-fleet step, data-parallel over docs."""
-    from .sequence import SeqState, _apply_seq_batch_impl
-    row, vec = seq_sharding(mesh)
+    from .sequence import _apply_seq_batch_impl
+    by_ndim = seq_sharding(mesh)
 
     @jax.jit
     def step(state, ops):
         new_state, stats = _apply_seq_batch_impl(state, ops)
-        new_state = SeqState(
-            *(jax.lax.with_sharding_constraint(x, row)
-              for x in (new_state.elem_id, new_state.nxt, new_state.winner,
-                        new_state.vis, new_state.val)),
-            jax.lax.with_sharding_constraint(new_state.n, vec),
-            jax.lax.with_sharding_constraint(new_state.inexact, vec))
-        return new_state, stats
+        return _constrain_by_ndim(new_state, by_ndim), stats
     return step
 
 
@@ -101,9 +105,10 @@ def long_seq_sharding(mesh):
     document is too long for one chip's memory/bandwidth, so its element
     slots, pointers, and values stripe over the whole mesh)."""
     every_axis = mesh.axis_names
-    slots = NamedSharding(mesh, P(None, every_axis))
-    vec = NamedSharding(mesh, P())
-    return slots, vec
+    by_ndim = {1: NamedSharding(mesh, P()),
+               2: NamedSharding(mesh, P(None, every_axis)),
+               3: NamedSharding(mesh, P(None, every_axis, None))}
+    return by_ndim
 
 
 def shard_long_seq(state, mesh):
@@ -111,8 +116,7 @@ def shard_long_seq(state, mesh):
     tail-padding to a device-count multiple first (safe because sentinels
     are front-anchored and padded tail slots read as unallocated)."""
     from .sequence import END, SeqState
-    import numpy as np
-    slots, vec = long_seq_sharding(mesh)
+    by_ndim = long_seq_sharding(mesh)
     n_dev = int(np.prod(mesh.devices.shape))
     size = state.elem_id.shape[1]
     pad = (-size) % n_dev
@@ -120,17 +124,16 @@ def shard_long_seq(state, mesh):
     def padded(x, fill):
         if pad == 0:
             return x
-        out = jnp.full((x.shape[0], size + pad), fill, dtype=x.dtype)
+        shape = (x.shape[0], size + pad) + x.shape[2:]
+        out = jnp.full(shape, fill, dtype=x.dtype)
         return out.at[:, :size].set(x)
 
-    return SeqState(
-        jax.device_put(padded(state.elem_id, 0), slots),
-        jax.device_put(padded(state.nxt, END), slots),
-        jax.device_put(padded(state.winner, 0), slots),
-        jax.device_put(padded(state.vis, False), slots),
-        jax.device_put(padded(state.val, 0), slots),
-        jax.device_put(state.n, vec),
-        jax.device_put(state.inexact, vec))
+    return SeqState(*(
+        jax.device_put(arr, by_ndim[arr.ndim]) for arr in (
+            padded(state.elem_id, 0), padded(state.nxt, END),
+            padded(state.reg, 0), padded(state.killed, False),
+            padded(state.val, 0), jnp.asarray(state.n),
+            jnp.asarray(state.inexact))))
 
 
 def sharded_long_seq_apply(mesh):
@@ -139,19 +142,13 @@ def sharded_long_seq_apply(mesh):
     op) plus the RGA pointer walk's scalar gathers; causality keeps the op
     stream itself sequential — the win is that the document's state never
     has to fit one chip."""
-    from .sequence import SeqState, _apply_seq_batch_impl
-    slots, vec = long_seq_sharding(mesh)
+    from .sequence import _apply_seq_batch_impl
+    by_ndim = long_seq_sharding(mesh)
 
     @jax.jit
     def step(state, ops):
         new_state, stats = _apply_seq_batch_impl(state, ops)
-        new_state = SeqState(
-            *(jax.lax.with_sharding_constraint(x, slots)
-              for x in (new_state.elem_id, new_state.nxt, new_state.winner,
-                        new_state.vis, new_state.val)),
-            jax.lax.with_sharding_constraint(new_state.n, vec),
-            jax.lax.with_sharding_constraint(new_state.inexact, vec))
-        return new_state, stats
+        return _constrain_by_ndim(new_state, by_ndim), stats
     return step
 
 
@@ -164,7 +161,7 @@ def sharded_long_seq_materialize(mesh):
     inserting the cross-shard collectives — the segmented-scan trick the
     survey names as the long-context equivalent (SURVEY.md §5)."""
     from .sequence import _materialize_impl
-    slots, _vec = long_seq_sharding(mesh)
+    slots = long_seq_sharding(mesh)[2]
 
     @jax.jit
     def run(state):

@@ -391,7 +391,12 @@ def bench_text(n_docs, trace_len, n_actors=3, seed=0):
         prior = insert_cols[insert_cols < i]
         choice = prior[rng.integers(0, len(prior), n_docs)]
         ref[:, i] = packed[rows, choice]
-    batch = SeqOpBatch(kind, ref, packed, value)
+    # DELs kill exactly their preds (multi-value register semantics): the
+    # pred is the insert op being deleted, i.e. the ref elemId itself
+    from automerge_tpu.fleet.sequence import SEQ_PRED_LANES
+    preds = np.zeros((n_docs, trace_len, SEQ_PRED_LANES), dtype=np.int32)
+    preds[:, :, 0] = np.where(kind == DEL, ref, 0)
+    batch = SeqOpBatch(kind, ref, packed, value, preds)
 
     state = SeqState.empty(n_docs, trace_len + 1)
     batch = jax.device_put(batch)

@@ -985,10 +985,11 @@ class TestSequenceSeam:
         assert not bool(np.asarray(fb.fleet.seq_state.inexact)[0])
         assert mat == 'bam'   # higher actor's concurrent insert first
 
-    def test_concurrent_set_vs_del_falls_back_to_mirror(self):
+    def test_concurrent_set_vs_del_stays_exact_on_device(self):
         """Delete concurrent with a set: the reference keeps the element
-        visible (the del only kills its preds); device LWW would hide it, so
-        the row flags inexact and reads come from the host mirror."""
+        visible (the del only kills its preds, ref new.js:1204-1217). The
+        actor-slotted element registers resolve this exactly on device —
+        the row must NOT flag inexact."""
         from automerge_tpu.columnar import decode_change
         fb = self._fb()
         gb = fb.init()
@@ -1009,7 +1010,7 @@ class TestSequenceSeam:
         # reference semantics: the concurrent set survives the delete
         assert fleet_backend.materialize_docs([gb]) == [{'l': [9]}]
         fb.fleet.flush()
-        assert bool(np.asarray(fb.fleet.seq_state.inexact)[0])
+        assert not bool(np.asarray(fb.fleet.seq_state.inexact)[0])
 
     def test_counter_in_list_falls_back(self):
         fb = self._fb()
@@ -1423,6 +1424,55 @@ class TestRegisterPatches:
         assert got == expected
         assert gb['state'].is_fleet
         assert fleet.metrics.mirror_rebuilds == 0
+
+    def test_typed_values_survive_mixed_exact_flush(self):
+        """A flush batch mixing one doc's typed root sets (counter + inc)
+        with another doc's sequence ops routes through _flush_exact_mixed —
+        which must box datatypes like changes_to_op_rows does, or the
+        device-served patch degrades counters to plain ints."""
+        changes = self._scenarios()
+        hb = host_backend.init()
+        for c in changes:
+            hb, _ = host_backend.apply_changes(hb, [c])
+        expected = host_backend.get_patch(hb)
+
+        fleet = DocFleet(doc_capacity=4, key_capacity=16, exact_device=True)
+        fb = FleetBackend(fleet)
+        gb = fb.init()
+        other = fb.init()
+        A = ACTORS[0]
+        seq_change = change_buf(A, 1, 1, [
+            {'action': 'makeText', 'obj': '_root', 'key': 't', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': '_head',
+             'insert': True, 'value': 'x', 'pred': []}])
+        for c in changes:
+            gb, _ = fleet_backend.apply_changes(gb, [c])
+        # same pending batch: forces the mixed exact flush for every doc
+        other, _ = fleet_backend.apply_changes(other, [seq_change])
+        fleet.flush()
+        got = fleet_backend.get_patch(gb)
+        assert got == expected
+        assert fleet.metrics.mirror_rebuilds == 0
+
+    def test_typed_values_survive_turbo_exact(self):
+        """The turbo wire->device path on an exact fleet must box typed
+        root sets (counter/uint/timestamp) before the register dispatch so
+        device-served patches keep datatypes and counter folds."""
+        changes = self._scenarios()
+        hb = host_backend.init()
+        for c in changes:
+            hb, _ = host_backend.apply_changes(hb, [c])
+        expected = host_backend.get_patch(hb)
+
+        fleet = DocFleet(doc_capacity=2, key_capacity=16, exact_device=True)
+        fb = FleetBackend(fleet)
+        handles = [fb.init()]
+        handles, patches = fleet_backend.apply_changes_docs(
+            handles, [changes], mirror=False)
+        if fleet.metrics.turbo_calls:
+            got = fleet_backend.get_patch(handles[0])
+            assert got == expected
+            assert fleet.metrics.mirror_rebuilds == 0
 
     def test_conflict_patch_from_device(self):
         A, B = ACTORS[0], ACTORS[1]

@@ -122,6 +122,84 @@ class TestRGAOrdering:
         assert int(applied) == 1
         assert visible_text(state) == ['a']
 
+    def test_concurrent_sets_keep_both_values(self):
+        """Two actors concurrently overwrite the same element: both ops stay
+        in the element's visible register (multi-value conflict), the
+        Lamport winner renders, and the row is NOT inexact (ref
+        new.js:1204-1217 succ visibility rule)."""
+        from automerge_tpu.fleet.sequence import element_conflicts
+        ops = [ins('_head', f'2@{A1}', 'a'),
+               {'kind': 'set', 'target': f'2@{A1}', 'id': f'3@{A1}',
+                'value': ord('X'), 'pred': [f'2@{A1}']},
+               {'kind': 'set', 'target': f'2@{A1}', 'id': f'3@{A2}',
+                'value': ord('Y'), 'pred': [f'2@{A1}']}]
+        state = run_ops([ops], [A1, A2])
+        assert not bool(np.asarray(state.inexact)[0])
+        # winner: same counter 3, higher actor hex (A2='89abcdef' > A1)
+        assert visible_text(state) == ['Y']
+        enc = SeqEncoder([A1, A2])
+        conf = element_conflicts(state, 0)
+        assert conf == {enc.pack(f'2@{A1}'): {
+            enc.pack(f'3@{A1}'): ord('X'), enc.pack(f'3@{A2}'): ord('Y')}}
+
+    def test_concurrent_set_vs_del_resurrects(self):
+        """A set racing a delete of the same element: the delete kills only
+        its pred, the concurrent set survives — element stays visible with
+        the set's value, exactly (ref test/new_backend_test.js:1660), and
+        the row is NOT inexact."""
+        for del_last in (False, True):
+            edits = [
+                {'kind': 'set', 'target': f'2@{A1}', 'id': f'3@{A1}',
+                 'value': ord('Z'), 'pred': [f'2@{A1}']},
+                {'kind': 'del', 'target': f'2@{A1}', 'id': f'3@{A2}',
+                 'pred': [f'2@{A1}']}]
+            if del_last:
+                edits.reverse()
+            ops = [ins('_head', f'2@{A1}', 'a')] + edits
+            state = run_ops([ops], [A1, A2])
+            assert not bool(np.asarray(state.inexact)[0])
+            assert visible_text(state) == ['Z']
+
+    def test_conflict_then_overwrite_multi_pred(self):
+        """Resolving a two-op conflict preds BOTH visible ops: the new set
+        kills both lanes and becomes the sole visible value."""
+        ops = [ins('_head', f'2@{A1}', 'a'),
+               {'kind': 'set', 'target': f'2@{A1}', 'id': f'3@{A1}',
+                'value': ord('X'), 'pred': [f'2@{A1}']},
+               {'kind': 'set', 'target': f'2@{A1}', 'id': f'3@{A2}',
+                'value': ord('Y'), 'pred': [f'2@{A1}']},
+               {'kind': 'set', 'target': f'2@{A1}', 'id': f'4@{A1}',
+                'value': ord('R'), 'pred': [f'3@{A1}', f'3@{A2}']}]
+        state = run_ops([ops], [A1, A2])
+        from automerge_tpu.fleet.sequence import element_conflicts
+        assert not bool(np.asarray(state.inexact)[0])
+        assert visible_text(state) == ['R']
+        assert element_conflicts(state, 0) == {}
+
+    def test_concurrent_dels_both_kill(self):
+        """Two concurrent deletes of one element: idempotent, element gone,
+        row exact."""
+        ops = [ins('_head', f'2@{A1}', 'a'), ins(f'2@{A1}', f'3@{A1}', 'b'),
+               {'kind': 'del', 'target': f'2@{A1}', 'id': f'4@{A1}',
+                'pred': [f'2@{A1}']},
+               {'kind': 'del', 'target': f'2@{A1}', 'id': f'4@{A2}',
+                'pred': [f'2@{A1}']}]
+        state = run_ops([ops], [A1, A2])
+        assert not bool(np.asarray(state.inexact)[0])
+        assert visible_text(state) == ['b']
+
+    def test_self_overwrite_without_pred_flags_inexact(self):
+        """An actor overwriting an element without pred'ing its own visible
+        op (only constructible by hand-built changes) leaves the exact
+        shape: flagged, reads route to the mirror."""
+        ops = [ins('_head', f'2@{A1}', 'a'),
+               {'kind': 'set', 'target': f'2@{A1}', 'id': f'3@{A1}',
+                'value': ord('X'), 'pred': [f'2@{A1}']},
+               {'kind': 'set', 'target': f'2@{A1}', 'id': f'4@{A1}',
+                'value': ord('Y'), 'pred': []}]
+        state = run_ops([ops], [A1])
+        assert bool(np.asarray(state.inexact)[0])
+
     def test_linearize_positions(self):
         from automerge_tpu.fleet.sequence import SLOT0
         ops = [ins('_head', f'2@{A1}', 'a'), ins(f'2@{A1}', f'3@{A1}', 'b')]
@@ -212,10 +290,11 @@ class TestDifferentialFuzz:
                                     'id': op_id, 'value': ord(op['value'])})
                 elif op['action'] == 'set':
                     seq_ops.append({'kind': 'set', 'target': op['elemId'],
-                                    'id': op_id, 'value': ord(op['value'])})
+                                    'id': op_id, 'value': ord(op['value']),
+                                    'pred': op.get('pred')})
                 elif op['action'] == 'del':
                     seq_ops.append({'kind': 'del', 'target': op['elemId'],
-                                    'id': op_id})
+                                    'id': op_id, 'pred': op.get('pred')})
         return seq_ops, actors
 
     @pytest.mark.parametrize('seed', [0, 1, 2])
@@ -231,8 +310,15 @@ class TestDifferentialFuzz:
                 for _ in range(rng.randrange(0, 4)):
                     def edit(d, rng=rng):
                         t = d['text']
-                        if len(t) and rng.random() < 0.3:
+                        roll = rng.random()
+                        if len(t) and roll < 0.3:
                             t.delete_at(rng.randrange(len(t)))
+                        elif len(t) and roll < 0.5:
+                            # overwrites: merged replicas produce the
+                            # concurrent set-vs-set / set-vs-del shapes the
+                            # element registers must resolve exactly
+                            t.set(rng.randrange(len(t)),
+                                  rng.choice(alphabet).upper())
                         else:
                             t.insert_at(rng.randrange(len(t) + 1),
                                         rng.choice(alphabet))
@@ -252,6 +338,9 @@ class TestDifferentialFuzz:
         state = SeqState.empty(1, max(64, len(seq_ops) + 1))
         state, _ = apply_seq_batch(state, batch)
         assert visible_text(state) == [expected]
+        # every shape in this trace (incl. concurrent overwrites/deletes)
+        # must resolve exactly on device — no mirror fallback
+        assert not bool(np.asarray(state.inexact)[0])
 
 
 class TestLongDocSharding:
