@@ -1123,6 +1123,51 @@ class _FlatEngine(HashGraph):
         # True after a turbo apply (or failed exact apply): the hash graph
         # and device state are current but the mirror is not; reads rebuild
         self.stale = False
+        # Bulk document load (fleet/loader.py) installs device state without
+        # touching the change log: the original document chunk parks here and
+        # the per-change buffers materialize only when history is actually
+        # read (the deferred-hash-graph load of ref new.js:1709-1749)
+        self._doc_pending = None
+
+    # The change log is a property so a bulk-loaded document's history can
+    # stay unmaterialized until something genuinely reads or extends it
+    # (sync, save-after-edit, mirror rebuilds, clone, further applies).
+    @property
+    def changes(self):
+        if self._doc_pending is not None:
+            self._materialize_doc()
+        return self._changes
+
+    @changes.setter
+    def changes(self, value):
+        self._changes = value
+
+    def _materialize_doc(self):
+        """Decode the parked document chunk into the real change log (one
+        Python decode + per-change re-encode for hashes; runs at most once
+        per loaded doc, and only when history is needed)."""
+        chunk = self._doc_pending
+        if chunk is None:
+            return
+        self._doc_pending = None
+        from ..columnar import decode_document, encode_change
+        self.fleet.metrics.doc_materializations += 1
+        decoded = decode_document(chunk)
+        self._changes = [encode_change(ch) for ch in decoded]
+        self._doc_decoded = decoded
+
+    def _doc_resolve(self, i):
+        """(hash, deps, actor, meta) for _ensure_graph over a bulk-loaded
+        document's i-th change."""
+        self._materialize_doc()
+        ch = self._doc_decoded[i]
+        meta = {
+            'actor': ch['actor'], 'seq': ch['seq'],
+            'maxOp': ch['startOp'] + len(ch['ops']) - 1,
+            'time': ch.get('time', 0), 'message': ch.get('message') or '',
+            'deps': list(ch['deps']), 'extraBytes': ch.get('extraBytes'),
+        }
+        return ch['hash'], meta['deps'], meta['actor'], meta
 
     def _rebuild_mirror(self):
         """Replay the committed log into a fresh OpSet, bypassing the causal
@@ -1964,16 +2009,19 @@ def _apply_changes_turbo(handles, per_doc_changes):
         spacked = remap_ids(rows['packed'][keep_seq].astype(np.int64))
         sref = remap_ids(rows['ref'][keep_seq].astype(np.int64))
         pred_counts = np.diff(rows['pred_off'])
-        spred_all = remap_ids(rows['pred'].astype(np.int64))
         n_seq = int(keep_seq.sum())
         D = SEQ_PRED_LANES
         counts_seq = pred_counts[keep_seq]
         off_seq = rows['pred_off'][:-1][keep_seq]
         pred_lanes = np.zeros((n_seq, D), dtype=np.int64)
+        pred_col = rows['pred']
         for d in range(D):
             has = counts_seq > d
             if has.any():
-                pred_lanes[has, d] = spred_all[off_seq[has] + d]
+                # gather THEN remap: only the kept seq rows' lanes, not the
+                # whole batch's pred column
+                pred_lanes[has, d] = remap_ids(
+                    pred_col[off_seq[has] + d].astype(np.int64))
         pred_overflow = counts_seq > D
         # resolve device rows per unique (doc, objectId)
         pair = np.stack([sdoc, sobj], axis=1)

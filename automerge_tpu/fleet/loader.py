@@ -1,0 +1,538 @@
+"""Bulk document load: saved containers straight to device state.
+
+This is the native batch-load path (round-2 VERDICT item 8, SURVEY §7 north
+star "decode straight into padded device tensors"): one C++ call
+(`native.parse_documents`, ref columnar.js:1006-1047) parses every saved
+document in the fleet to flat op columns, and the FINAL CRDT state — the
+succ-derived visible sets of ref new.js:1204-1217 — is scattered into the
+device registers in a handful of batched dispatches. Nothing is replayed:
+where the reference's load walks every op through seekToOp (new.js:1604-1635
+documentPatch after decode), this loader reconstructs the end state directly
+from the document columns, because the document format already stores ops in
+final document order with their successors.
+
+The change *log* is not materialized at all (the deferred-hash-graph load of
+ref new.js:1709-1749): the original chunk parks on the engine and per-change
+buffers/hashes are decoded lazily the first time history is genuinely read
+(sync, getChanges, save-after-edit, mirror fallback). An unedited loaded
+document's save() returns the loaded bytes verbatim — a byte-identical
+round-trip; note this skips save()'s usual canonical re-encode, so two
+replicas bulk-loaded from *different* foreign encodings of the same state
+can save different bytes until their first edit.
+
+Documents outside the flat fleet subset (child/link ops, unknown columns,
+objects inside sequences, op counters past the 2^23 packing window, >256
+actors) fall back per-doc to the ordinary load path — the loader is an
+accelerator, never a semantic fork.
+"""
+
+import numpy as np
+
+from .. import native
+from ..columnar import decode_value, split_containers, CHUNK_TYPE_DOCUMENT
+from .tensor_doc import CTR_LIMIT, MAX_ACTORS
+
+# Wire action numbers (ref columnar.js:51-52)
+_A_MAKE_MAP, _A_SET, _A_MAKE_LIST, _A_MAKE_TEXT = 0, 1, 2, 4
+_A_INC, _A_MAKE_TABLE = 5, 6
+_MAKES = (_A_MAKE_MAP, _A_MAKE_LIST, _A_MAKE_TEXT, _A_MAKE_TABLE)
+_SEQ_MAKES = (_A_MAKE_LIST, _A_MAKE_TEXT)
+_TYPE_NAMES = {_A_MAKE_MAP: 'map', _A_MAKE_TABLE: 'table',
+               _A_MAKE_LIST: 'list', _A_MAKE_TEXT: 'text'}
+
+
+class _DocDeferredBatch:
+    """Adapter giving the hash graph lazy access to a bulk-loaded doc's
+    change metadata (resolved through the engine's parked chunk)."""
+
+    __slots__ = ('engine',)
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def resolve(self, i):
+        return self.engine._doc_resolve(i)
+
+
+def _okey(doc, ctr, actor):
+    """Doc-scoped object/op key: collision-free int64 for (doc, ctr, actor)
+    with ctr < 2^23 and actor < 256 (root encodes as ctr=0, actor=-1)."""
+    return doc.astype(np.int64) * (1 << 33) + ctr * 512 + (actor + 1)
+
+
+def _isin_sorted(values, sorted_arr):
+    if len(sorted_arr) == 0:
+        return np.zeros(len(values), dtype=bool)
+    pos = np.clip(np.searchsorted(sorted_arr, values), 0,
+                  len(sorted_arr) - 1)
+    return sorted_arr[pos] == values
+
+
+def load_docs(buffers, fleet=None):
+    """Load N saved documents into fleet-resident handles in one native
+    parse + a few batched device dispatches. Returns handles in input
+    order. Docs the fast path can't represent load through the ordinary
+    per-doc path transparently."""
+    from . import backend as fleet_backend
+
+    fleet = fleet or fleet_backend.default_fleet()
+    n_in = len(buffers)
+    handles = [None] * n_in
+
+    chunks = [None] * n_in
+    if native.available():
+        for i, buf in enumerate(buffers):
+            try:
+                parts = split_containers(bytes(buf))
+            except Exception:
+                parts = []
+            if len(parts) == 1 and parts[0][8] == CHUNK_TYPE_DOCUMENT:
+                chunks[i] = parts[0]
+
+    native_idx = [i for i, c in enumerate(chunks) if c is not None]
+    out = native.parse_documents([chunks[i] for i in native_idx]) \
+        if native_idx else None
+    installed = set()
+    if out is not None and native_idx:
+        installed = _install_parsed(fleet, out, native_idx, chunks, handles,
+                                    fleet_backend)
+    for i in range(n_in):
+        if i not in installed:
+            handles[i] = fleet_backend.load(bytes(buffers[i]), fleet)
+    return handles
+
+
+def _install_parsed(fleet, out, native_idx, chunks, handles, fleet_backend):
+    """Vectorized end-state assembly for every natively parsed doc; returns
+    the set of input indexes successfully installed."""
+    from .backend import FleetDoc, _FlatEngine
+
+    ok = out['ok'].astype(bool)
+
+    # Fleet actor registration (one insert_many + remap for the batch)
+    perm = fleet.actors.insert_many(out['actors'])
+    if perm is not None:
+        if fleet.exact_device:
+            fleet._remap_reg_actors(perm)
+        else:
+            fleet._remap_actors(perm)
+        fleet._remap_seq_actors(perm)
+    amap = np.array([fleet.actors.index.get(a, -1) for a in out['actors']],
+                    dtype=np.int64) if out['actors'] else np.zeros(1, np.int64)
+
+    doc = out['doc'].astype(np.int64)
+    id_ctr = out['id_ctr']
+    id_actor = amap[out['id_actor']]
+    obj_ctr = out['obj_ctr']
+    obj_actor = np.where(out['obj_actor'] >= 0, amap[out['obj_actor']], -1)
+    key_ctr = out['key_ctr']
+    key_actor = np.where(out['key_actor'] >= 0, amap[out['key_actor']], -1)
+    key_str = out['key_str']
+    action = out['action'].astype(np.int64)
+    insert = out['insert'].astype(bool)
+    vtype = out['vtype']
+    val_int = out['val_int']
+    succ_off = out['succ_off']
+    succ_ctr = out['succ_ctr']
+    succ_actor = amap[out['succ_actor']] if len(out['succ_actor']) else \
+        np.zeros(0, dtype=np.int64)
+    n_ops = len(doc)
+
+    # ---- per-doc viability ----------------------------------------------
+    # Overflow badness FIRST: _okey packing assumes ctr < 2^23 and
+    # actor < 256, so rows of overflowing (fallback-bound) docs must be
+    # excluded from classification keys before they can alias another
+    # doc's object identities
+    bad = ~ok.copy()
+    ctr_over = (id_ctr >= CTR_LIMIT) | (key_ctr >= CTR_LIMIT) | \
+        (obj_ctr >= CTR_LIMIT)
+    actor_over = (id_actor >= MAX_ACTORS) | (id_actor < 0) | \
+        (key_actor >= MAX_ACTORS) | (obj_actor >= MAX_ACTORS)
+    for mask in (ctr_over, actor_over):
+        if mask.any():
+            bad[np.unique(doc[mask])] = True
+    n_succ = len(succ_ctr)
+    srow = np.repeat(np.arange(n_ops), np.diff(succ_off)) if n_succ else \
+        np.zeros(0, dtype=np.int64)
+    if n_succ:
+        sc_over = (succ_ctr >= CTR_LIMIT) | (succ_actor >= MAX_ACTORS) | \
+            (succ_actor < 0)
+        if sc_over.any():
+            bad[np.unique(doc[srow[sc_over]])] = True
+
+    row_ok = ~bad[doc]
+    okey = _okey(doc, obj_ctr, obj_actor)           # op's containing object
+    rid = _okey(doc, id_ctr, id_actor)              # op's own id
+    make_mask = np.isin(action, _MAKES)
+    seq_make = np.isin(action, _SEQ_MAKES)
+    seq_objs = np.sort(rid[make_mask & seq_make & row_ok])
+    map_objs = np.sort(rid[make_mask & ~seq_make & row_ok])
+    row_is_seq = _isin_sorted(okey, seq_objs)
+    row_in_map = (obj_actor < 0) | _isin_sorted(okey, map_objs)
+    orphan = row_ok & ~row_is_seq & ~row_in_map
+    make_in_seq = make_mask & row_is_seq
+    for mask in (orphan, make_in_seq):
+        if mask.any():
+            bad[np.unique(doc[mask])] = True
+
+    # ---- alive / counter-fold (succNum==0 visibility; inc successors
+    # accumulate instead of killing, ref new.js:937-965) -------------------
+    inc_mask = action == _A_INC
+    inc_rid = rid[inc_mask]
+    inc_order = np.argsort(inc_rid)
+    inc_sorted = inc_rid[inc_order]
+    inc_vals = val_int[inc_mask][inc_order]
+    n_succ_per = np.diff(succ_off)
+    counter_add = np.zeros(n_ops, dtype=np.int64)
+    if n_succ and len(inc_sorted):
+        skey = _okey(doc[srow], succ_ctr, succ_actor)
+        pos = np.clip(np.searchsorted(inc_sorted, skey), 0,
+                      len(inc_sorted) - 1)
+        succ_is_inc = inc_sorted[pos] == skey
+        inc_per = np.bincount(srow, weights=succ_is_inc.astype(np.float64),
+                              minlength=n_ops).astype(np.int64)
+        fold = np.where(succ_is_inc, inc_vals[pos], 0)
+        counter_add = np.bincount(srow, weights=fold.astype(np.float64),
+                                  minlength=n_ops).astype(np.int64)
+    else:
+        inc_per = np.zeros(n_ops, dtype=np.int64)
+    alive = ~inc_mask & (inc_per == n_succ_per)
+
+    # ---- engines + per-doc metadata --------------------------------------
+    packed32 = ((id_ctr << 8) | id_actor).astype(np.int64)
+    oid_str = {}                       # rid key -> 'ctr@actor' string
+    obj_type = {}                      # rid key -> wire make action
+    # good-doc rows only: a fallback-bound doc's overflowing ids must not
+    # alias (and overwrite) another doc's object identities
+    for j in np.flatnonzero(make_mask & ~bad[doc]):
+        oid_str[int(rid[j])] = \
+            f'{int(id_ctr[j])}@{fleet.actors.actors[int(id_actor[j])]}'
+        obj_type[int(rid[j])] = int(action[j])
+
+    good_docs = np.flatnonzero(~bad)
+    slot_of = np.full(len(ok), -1, dtype=np.int64)
+    engines = {}
+    for d in good_docs:
+        d = int(d)
+        eng = _FlatEngine(fleet, fleet.alloc_slot())
+        slot_of[d] = eng.slot
+        a0, a1 = int(out['actor_off'][d]), int(out['actor_off'][d + 1])
+        eng.actor_ids = [fleet.actors.actors[int(amap[g])]
+                         for g in out['doc_actors'][a0:a1]]
+        h0, h1 = int(out['heads_off'][d]), int(out['heads_off'][d + 1])
+        eng.heads = sorted(out['heads'][h].tobytes().hex()
+                           for h in range(h0, h1))
+        eng.max_op = int(out['max_op'][d])
+        eng.stale = True
+        chunk = bytes(chunks[native_idx[d]])
+        eng._doc_pending = chunk
+        eng.binary_doc = chunk
+        n_changes = int(out['n_changes'][d])
+        if n_changes:
+            eng._deferred.append((0, _DocDeferredBatch(eng),
+                                  range(n_changes)))
+        engines[d] = eng
+        fleet.metrics.docs_bulk_loaded += 1
+    # clock: per (doc, actor) max seq
+    c_doc = out['c_doc'].astype(np.int64)
+    c_actor = amap[out['c_actor']] if len(out['c_actor']) else \
+        np.zeros(0, dtype=np.int64)
+    c_seq = out['c_seq']
+    for j in range(len(c_doc)):
+        d = int(c_doc[j])
+        if d in engines:
+            hexa = fleet.actors.actors[int(c_actor[j])]
+            eng = engines[d]
+            if eng.clock.get(hexa, 0) < int(c_seq[j]):
+                eng.clock[hexa] = int(c_seq[j])
+    # object registries
+    for j in np.flatnonzero(make_mask):
+        d = int(doc[j])
+        if d not in engines:
+            continue
+        a = int(action[j])
+        oid = oid_str[int(rid[j])]
+        if a in _SEQ_MAKES:
+            engines[d].seq_objects[oid] = _TYPE_NAMES[a]
+        else:
+            engines[d].map_objects[oid] = _TYPE_NAMES[a]
+
+    max_slot = int(slot_of.max()) if len(slot_of) else -1
+    if max_slot >= 0:
+        _ensure_caps(fleet, max_slot + 1)
+
+    keep = ~bad[doc] & (slot_of[doc] >= 0)
+    _install_map_cells(fleet, out, keep & ~row_is_seq & ~inc_mask & alive,
+                       doc, slot_of, okey, oid_str, key_str, packed32,
+                       id_actor, vtype, val_int, counter_add, action,
+                       make_mask, rid)
+    _install_seq_rows(fleet, out, keep & row_is_seq, doc, slot_of, okey,
+                      oid_str, obj_type, insert, alive, inc_mask,
+                      packed32, id_actor, key_ctr, key_actor, vtype, val_int)
+
+    installed = set()
+    for d, eng in engines.items():
+        handles[native_idx[d]] = {'state': FleetDoc(fleet, eng),
+                                  'heads': eng.heads}
+        installed.add(native_idx[d])
+    return installed
+
+
+def _ensure_caps(fleet, n_docs):
+    if fleet.exact_device:
+        fleet._ensure_reg_capacity(n_docs=max(n_docs, fleet.n_slots),
+                                   n_keys=len(fleet.keys))
+    else:
+        fleet._ensure_capacity(n_docs=max(n_docs, fleet.n_slots),
+                               n_keys=len(fleet.keys))
+
+
+def _decode_cell_value(fleet, out, j, vtype_j, val_int_j, exact):
+    """One op's value -> int32 register/grid lane value (inline or value
+    table ref), following _intern_value / changes_to_op_rows boxing rules."""
+    from .registers import TypedValue
+    if vtype_j == 4 and 0 <= val_int_j < (1 << 31):
+        return int(val_int_j)
+    off = int(out['val_off'][j])
+    ln = int(out['val_len'][j])
+    decoded = decode_value((ln << 4) | int(vtype_j),
+                           out['val_blob'][off:off + ln])
+    value, datatype = decoded['value'], decoded.get('datatype')
+    if exact and datatype in ('uint', 'counter', 'timestamp'):
+        return fleet._intern_value_boxed(TypedValue(value, datatype))
+    return fleet._intern_value(value)
+
+
+def _install_map_cells(fleet, out, sel, doc, slot_of, okey, oid_str, key_str,
+                       packed32, id_actor, vtype, val_int, counter_add,
+                       action, make_mask, rid):
+    """Scatter alive map-cell ops into the register state (exact mode) or
+    the LWW winners grid, one batched device write per array."""
+    import jax.numpy as jnp
+    from .backend import _MapLink, _SeqLink
+
+    rows = np.flatnonzero(sel)
+    if not len(rows):
+        return
+    # Intern cell keys: root keys as plain strings, nested as (oid, key)
+    key_ids = np.zeros(len(rows), dtype=np.int64)
+    cache = {}
+    for i, j in enumerate(rows):
+        ks = out['keys'][int(key_str[j])]
+        ok_ = int(okey[j])
+        ck = (ok_, ks)
+        kid = cache.get(ck)
+        if kid is None:
+            parent = oid_str.get(ok_)
+            kid = fleet.keys.intern(ks if parent is None else (parent, ks))
+            cache[ck] = kid
+        key_ids[i] = kid
+
+    values = np.zeros(len(rows), dtype=np.int64)
+    for i, j in enumerate(rows):
+        jj = int(j)
+        if make_mask[jj]:
+            oid = oid_str[int(rid[jj])]
+            link = _SeqLink(oid) if int(action[jj]) in _SEQ_MAKES \
+                else _MapLink(oid, _TYPE_NAMES[int(action[jj])])
+            values[i] = fleet._intern_value_boxed(link)
+        else:
+            values[i] = _decode_cell_value(fleet, out, jj, int(vtype[jj]),
+                                           int(val_int[jj]),
+                                           fleet.exact_device)
+
+    slots = slot_of[doc[rows]]
+    lanes = id_actor[rows]
+    packed = packed32[rows]
+    counters = counter_add[rows]
+    _ensure_caps(fleet, int(slots.max()) + 1)
+    if fleet.exact_device:
+        from .registers import RegisterState
+        # one live op per (slot, key, lane); duplicates flag the doc inexact
+        cell = slots * (1 << 33) + key_ids * 512 + lanes
+        uniq, counts = np.unique(cell, return_counts=True)
+        dup_docs = np.unique(slots[np.isin(cell, uniq[counts > 1])]) \
+            if (counts > 1).any() else np.zeros(0, dtype=np.int64)
+        rs = fleet.reg_state
+        idx = (jnp.asarray(slots), jnp.asarray(key_ids), jnp.asarray(lanes))
+        inexact = rs.inexact
+        if len(dup_docs):
+            inexact = inexact.at[jnp.asarray(dup_docs)].set(True)
+        fleet.reg_state = RegisterState(
+            rs.reg.at[idx].set(jnp.asarray(packed.astype(np.int32))),
+            rs.killed.at[idx].set(False),
+            rs.value.at[idx].set(jnp.asarray(values.astype(np.int32))),
+            rs.counter.at[idx].set(jnp.asarray(counters.astype(np.int32))),
+            inexact)
+    else:
+        from .tensor_doc import FleetState
+        # LWW grid: winner per (slot, key) by max packed opId
+        cell = slots * (1 << 33) + key_ids
+        order = np.lexsort((packed, cell))
+        cs = cell[order]
+        last = np.r_[cs[1:] != cs[:-1], True]     # winner = last per group
+        w = order[last]
+        idx = (jnp.asarray(slots[w]), jnp.asarray(key_ids[w]))
+        st = fleet.state
+        fleet.state = FleetState(
+            st.winners.at[idx].set(jnp.asarray(packed[w].astype(np.int32))),
+            st.values.at[idx].set(jnp.asarray(values[w].astype(np.int32))),
+            st.counters.at[idx].set(
+                jnp.asarray(counters[w].astype(np.int32))))
+    fleet.metrics.dispatches += 1
+    fleet.metrics.device_ops += len(rows)
+
+
+def _install_seq_rows(fleet, out, sel, doc, slot_of, okey, oid_str, obj_type,
+                      insert, alive, inc_mask, packed32, id_actor,
+                      key_ctr, key_actor, vtype, val_int):
+    """Reconstruct SeqState rows from document-order sequence ops: element
+    encounter order IS final RGA order, so the linked list is a straight
+    chain — no pointer walking, no replay."""
+    import jax.numpy as jnp
+    from .backend import _pow2
+    from .sequence import SeqState, grow_seq_state, END, HEAD, SLOT0
+
+    rows = np.flatnonzero(sel)
+    if not len(rows):
+        return
+    # (doc, obj) groups; rows of one object are contiguous in doc order
+    gkey = okey[rows]
+    uniq, inv = np.unique(gkey, return_inverse=True)
+    fleet_row = np.zeros(len(uniq), dtype=np.int64)
+    is_text = np.zeros(len(uniq), dtype=bool)
+    first_of_group = np.full(len(uniq), len(rows), dtype=np.int64)
+    np.minimum.at(first_of_group, inv, np.arange(len(rows)))
+    for u, ok_ in enumerate(uniq):
+        oid = oid_str[int(ok_)]
+        d = int(doc[rows[int(first_of_group[u])]])
+        slot = int(slot_of[d])
+        typ = 'text' if obj_type[int(ok_)] == _A_MAKE_TEXT else 'list'
+        fleet_row[u] = fleet._alloc_seq_row(slot, oid, typ)
+        is_text[u] = typ == 'text'
+
+    ins = insert[rows]
+    # element ordinal per insert row within its group (stable group sort
+    # preserves document order inside each group)
+    order = np.argsort(inv, kind='stable')
+    inv_s = inv[order]
+    ins_s = ins[order].astype(np.int64)
+    cum = np.cumsum(ins_s)
+    grp_start = np.searchsorted(inv_s, np.arange(len(uniq)), side='left')
+    grp_sizes = np.diff(np.r_[grp_start, len(ins_s)])
+    base = cum - np.repeat(cum[grp_start] - ins_s[grp_start], grp_sizes)
+    elem_ord = np.zeros(len(rows), dtype=np.int64)
+    elem_ord[order] = base - 1                 # valid where ins
+    n_elems = np.bincount(inv, weights=ins.astype(np.float64),
+                          minlength=len(uniq)).astype(np.int64)
+
+    # update rows: find the target element by its insert op id
+    ins_idx = np.flatnonzero(ins)
+    ikey = inv[ins_idx] * (1 << 33) + packed32[rows][ins_idx]
+    ins_sorted = np.argsort(ikey)
+    ins_keys = ikey[ins_sorted]
+    tgt_packed = (key_ctr[rows] << 8) | np.maximum(key_actor[rows], 0)
+    tkey = inv * (1 << 33) + tgt_packed
+    if len(ins_keys):
+        pos = np.clip(np.searchsorted(ins_keys, tkey), 0, len(ins_keys) - 1)
+        matched = ins_keys[pos] == tkey
+        tgt_ord = elem_ord[ins_idx[ins_sorted[pos]]]
+    else:
+        matched = np.zeros(len(rows), dtype=bool)
+        tgt_ord = np.zeros(len(rows), dtype=np.int64)
+    bad_upd = ~ins & ~matched       # update to unknown element -> inexact
+    node = SLOT0 + np.where(ins, elem_ord, tgt_ord)
+
+    # value lanes (text: single codepoints inline; lists: ints inline;
+    # everything else boxes; counters flag the row, ref new.js:937-965)
+    r_of = fleet_row[inv]
+    txt = is_text[inv]
+    values = np.zeros(len(rows), dtype=np.int64)
+    flag_counter = np.zeros(len(rows), dtype=bool)
+    for i, j in enumerate(rows):
+        jj = int(j)
+        if inc_mask[jj]:
+            flag_counter[i] = True
+            continue
+        vt, vi = int(vtype[jj]), int(val_int[jj])
+        if vt == 8:
+            flag_counter[i] = True
+        elif txt[i] and vt == 6 and vi >= 0:
+            values[i] = vi
+            continue
+        elif not txt[i] and vt == 4 and 0 <= vi < (1 << 31):
+            values[i] = vi
+            continue
+        off, ln = int(out['val_off'][jj]), int(out['val_len'][jj])
+        decoded = decode_value((ln << 4) | vt, out['val_blob'][off:off + ln])
+        values[i] = fleet._intern_value_boxed(decoded['value'])
+
+    live = alive[rows] & ~inc_mask[rows] & ~bad_upd
+
+    # grow the fleet seq state to cover rows, elements, and actor lanes
+    n_rows_total = len(fleet.seq_rows)
+    cap = max(int(n_elems.max()) if len(n_elems) else 1, 1)
+    need_a = _pow2(max(len(fleet.actors), 4))
+    if fleet.seq_state is None:
+        fleet.seq_state = SeqState.empty(
+            _pow2(n_rows_total), _pow2(max(cap, fleet.seq_elem_cap)),
+            actor_slots=need_a, xp=jnp)
+    fleet.seq_state = grow_seq_state(
+        fleet.seq_state, _pow2(n_rows_total),
+        _pow2(max(cap, fleet.seq_elem_cap, fleet.seq_state.capacity)),
+        need_a)
+    st = fleet.seq_state
+    nodes = st.elem_id.shape[1]
+
+    # linked chain per fleet row: HEAD -> SLOT0 .. SLOT0+n-1 -> END
+    touched = np.unique(fleet_row)
+    nxt_host = np.full((len(touched), nodes), END, dtype=np.int32)
+    n_host = np.zeros(len(touched), dtype=np.int32)
+    row_pos = {int(r): i for i, r in enumerate(touched)}
+    for u in range(len(uniq)):
+        i = row_pos[int(fleet_row[u])]
+        n_k = int(n_elems[u])
+        n_host[i] = n_k
+        if n_k:
+            nxt_host[i, HEAD] = SLOT0
+            if n_k > 1:
+                nxt_host[i, SLOT0:SLOT0 + n_k - 1] = \
+                    np.arange(SLOT0 + 1, SLOT0 + n_k, dtype=np.int32)
+            nxt_host[i, SLOT0 + n_k - 1] = END
+
+    tr = jnp.asarray(touched)
+    new_nxt = st.nxt.at[tr].set(jnp.asarray(nxt_host))
+    new_n = st.n.at[tr].set(jnp.asarray(n_host))
+
+    ins_rows = ins_idx
+    eidx = (jnp.asarray(r_of[ins_rows]), jnp.asarray(node[ins_rows]))
+    new_elem = st.elem_id.at[eidx].set(
+        jnp.asarray(packed32[rows][ins_rows].astype(np.int32)))
+
+    live_rows = np.flatnonzero(live)
+    lidx = (jnp.asarray(r_of[live_rows]), jnp.asarray(node[live_rows]),
+            jnp.asarray(id_actor[rows][live_rows]))
+    new_reg = st.reg.at[lidx].set(
+        jnp.asarray(packed32[rows][live_rows].astype(np.int32)))
+    new_killed = st.killed.at[lidx].set(False)
+    new_val = st.val.at[lidx].set(
+        jnp.asarray(values[live_rows].astype(np.int32)))
+
+    # inexact flags: counters in sequences, unmatched update targets, and
+    # duplicate (element, lane) live ops (outside one-op-per-actor)
+    inex_rows = r_of[flag_counter | bad_upd]
+    lane_cell = r_of[live_rows] * (1 << 40) + node[live_rows] * 512 + \
+        id_actor[rows][live_rows]
+    uq, cnt = np.unique(lane_cell, return_counts=True)
+    if (cnt > 1).any():
+        dup = np.isin(lane_cell, uq[cnt > 1])
+        inex_rows = np.r_[inex_rows, r_of[live_rows][dup]]
+    new_inexact = st.inexact
+    if len(inex_rows):
+        new_inexact = new_inexact.at[
+            jnp.asarray(np.unique(inex_rows))].set(True)
+
+    fleet.seq_state = SeqState(new_elem, new_nxt, new_reg, new_killed,
+                               new_val, new_n, new_inexact)
+    fleet.metrics.dispatches += 1
+    fleet.metrics.device_ops += len(rows)

@@ -396,3 +396,151 @@ def _fetch_ingest_meta(lib, n_changes):
         'msg_off': msg_off[:n_changes + 1],
         'msg_blob': msg_blob[:int(msg_off[n_changes])].tobytes(),
     }
+
+
+def parse_documents(buffers):
+    """Batched native document-container parse (ref columnar.js:1006-1047):
+    one call parses N saved documents straight to flat columns — per-doc
+    actor tables / heads / maxOp, per-change (actor, seq, maxOp) metadata,
+    and document-order op rows with succ lists — with no per-change
+    re-encode or hashing (the deferred-hash-graph load of ref
+    new.js:1709-1749).
+
+    Returns None when the native codec is unavailable, else a dict:
+      ok          [N] uint8   1 = parsed; 0 = doc needs the Python path
+      n_changes / n_ops / max_op   [N] int64 per doc
+      heads_off   [N+1] int64 into heads
+      heads       [H, 32] uint8 head hashes
+      actor_off   [N+1] int64 into doc_actors
+      doc_actors  [.] int32   per-doc actor tables (global actor numbers)
+      c_doc/c_actor [C] int32, c_seq/c_max_op [C] int64 per change
+      op columns  [M]: doc(i32), obj_ctr(i64), obj_actor(i32, -1=root),
+                  key_ctr(i64), key_actor(i32, -1=none), key_str(i32,
+                  -1=none), insert(u8), action(u8), vtype(u8), id_ctr(i64),
+                  id_actor(i32), val_int(i64; int-family value or single
+                  text codepoint, -1 = multi-char), val_off(i64)/val_len(i32)
+                  into val_blob, succ_off [M+1] int64 into succ_ctr(i64)/
+                  succ_actor(i32)
+      val_blob    raw value bytes; actors / keys: global string tables
+    Actions are wire numbers (0 makeMap, 1 set, 2 makeList, 4 makeText,
+    5 inc, 6 makeTable); del rows never appear in documents
+    (columnar.js:892)."""
+    lib = _load()
+    if lib is None:
+        return None
+    bufs = buffers if all(type(b) is bytes for b in buffers) else \
+        [bytes(b) for b in buffers]
+    n_docs = len(bufs)
+    blob = b''.join(bufs)
+    lens = np.fromiter(map(len, bufs), dtype=np.uint64, count=n_docs)
+    offsets = np.zeros(max(n_docs, 1), dtype=np.uint64)
+    if n_docs > 1:
+        np.cumsum(lens[:-1], out=offsets[1:])
+    arr, ptr = _u8(blob)
+    u8p_ = ctypes.POINTER(ctypes.c_uint8)
+    u64p_ = ctypes.POINTER(ctypes.c_uint64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.am_parse_documents.argtypes = [u8p_, u64p_, u64p_, ctypes.c_uint64]
+    lib.am_parse_documents.restype = ctypes.c_int64
+    if n_docs == 0:
+        lens_arr = np.zeros(1, dtype=np.uint64)
+    else:
+        lens_arr = lens
+    n_ops = int(lib.am_parse_documents(
+        ptr, offsets.ctypes.data_as(u64p_),
+        lens_arr.ctypes.data_as(u64p_), n_docs))
+    if n_ops < 0:
+        return None
+    sizes = [ctypes.c_int64() for _ in range(9)]
+    lib.am_docparse_sizes.argtypes = [i64p] * 9
+    lib.am_docparse_sizes.restype = ctypes.c_int64
+    if lib.am_docparse_sizes(*(ctypes.byref(s) for s in sizes)) != 0:
+        return None
+    (n_changes, n_succ, n_heads, val_bytes, actor_blob_bytes, n_actors,
+     key_blob_bytes, n_keys, n_doc_actors) = (int(s.value) for s in sizes)
+
+    def a(n, dtype):
+        return np.zeros(max(n, 1), dtype=dtype)
+
+    d_ok = a(n_docs, np.uint8)
+    d_n_changes, d_n_ops, d_max_op = (a(n_docs, np.int64) for _ in range(3))
+    d_heads_off = a(n_docs + 1, np.int64)
+    d_actor_off = a(n_docs + 1, np.int64)
+    d_actor_ids = a(n_doc_actors, np.int32)
+    heads = a(n_heads * 32, np.uint8)
+    c_doc, c_actor = a(n_changes, np.int32), a(n_changes, np.int32)
+    c_seq, c_max_op = a(n_changes, np.int64), a(n_changes, np.int64)
+    o_doc = a(n_ops, np.int32)
+    o_obj_ctr = a(n_ops, np.int64)
+    o_obj_actor = a(n_ops, np.int32)
+    o_key_ctr = a(n_ops, np.int64)
+    o_key_actor = a(n_ops, np.int32)
+    o_key_str = a(n_ops, np.int32)
+    o_insert, o_action, o_vtype = (a(n_ops, np.uint8) for _ in range(3))
+    o_id_ctr = a(n_ops, np.int64)
+    o_id_actor = a(n_ops, np.int32)
+    o_val_int, o_val_off = a(n_ops, np.int64), a(n_ops, np.int64)
+    o_val_len = a(n_ops, np.int32)
+    val_blob = a(val_bytes, np.uint8)
+    o_succ_off = a(n_ops + 1, np.int64)
+    s_ctr, s_actor = a(n_succ, np.int64), a(n_succ, np.int32)
+    key_blob = a(key_blob_bytes, np.uint8)
+    actor_blob = a(actor_blob_bytes, np.uint8)
+
+    lib.am_docparse_fetch.argtypes = [
+        u8p_, i64p, i64p, i64p, i64p, i64p, i32p, u8p_,
+        i32p, i32p, i64p, i64p,
+        i32p, i64p, i32p, i64p, i32p, i32p, u8p_, u8p_, u8p_,
+        i64p, i32p, i64p, i64p, i32p, u8p_, i64p, i64p, i32p,
+        u8p_, ctypes.c_uint64, u8p_, ctypes.c_uint64]
+    lib.am_docparse_fetch.restype = ctypes.c_int64
+    got = lib.am_docparse_fetch(
+        d_ok.ctypes.data_as(u8p_), d_n_changes.ctypes.data_as(i64p),
+        d_n_ops.ctypes.data_as(i64p), d_max_op.ctypes.data_as(i64p),
+        d_heads_off.ctypes.data_as(i64p), d_actor_off.ctypes.data_as(i64p),
+        d_actor_ids.ctypes.data_as(i32p), heads.ctypes.data_as(u8p_),
+        c_doc.ctypes.data_as(i32p), c_actor.ctypes.data_as(i32p),
+        c_seq.ctypes.data_as(i64p), c_max_op.ctypes.data_as(i64p),
+        o_doc.ctypes.data_as(i32p), o_obj_ctr.ctypes.data_as(i64p),
+        o_obj_actor.ctypes.data_as(i32p), o_key_ctr.ctypes.data_as(i64p),
+        o_key_actor.ctypes.data_as(i32p), o_key_str.ctypes.data_as(i32p),
+        o_insert.ctypes.data_as(u8p_), o_action.ctypes.data_as(u8p_),
+        o_vtype.ctypes.data_as(u8p_), o_id_ctr.ctypes.data_as(i64p),
+        o_id_actor.ctypes.data_as(i32p), o_val_int.ctypes.data_as(i64p),
+        o_val_off.ctypes.data_as(i64p), o_val_len.ctypes.data_as(i32p),
+        val_blob.ctypes.data_as(u8p_), o_succ_off.ctypes.data_as(i64p),
+        s_ctr.ctypes.data_as(i64p), s_actor.ctypes.data_as(i32p),
+        key_blob.ctypes.data_as(u8p_), key_blob.size,
+        actor_blob.ctypes.data_as(u8p_), actor_blob.size)
+    if got != n_ops:
+        return None
+
+    def read_blob(blob_arr, count):
+        from ..encoding import Decoder
+        decoder = Decoder(blob_arr.tobytes())
+        return [decoder.read_prefixed_string() for _ in range(count)]
+
+    return {
+        'ok': d_ok[:n_docs], 'n_changes': d_n_changes[:n_docs],
+        'n_ops': d_n_ops[:n_docs], 'max_op': d_max_op[:n_docs],
+        'heads_off': d_heads_off[:n_docs + 1],
+        'heads': heads[:n_heads * 32].reshape(max(n_heads, 1) if n_heads
+                                              else 0, 32),
+        'actor_off': d_actor_off[:n_docs + 1],
+        'doc_actors': d_actor_ids[:n_doc_actors],
+        'c_doc': c_doc[:n_changes], 'c_actor': c_actor[:n_changes],
+        'c_seq': c_seq[:n_changes], 'c_max_op': c_max_op[:n_changes],
+        'doc': o_doc[:n_ops], 'obj_ctr': o_obj_ctr[:n_ops],
+        'obj_actor': o_obj_actor[:n_ops], 'key_ctr': o_key_ctr[:n_ops],
+        'key_actor': o_key_actor[:n_ops], 'key_str': o_key_str[:n_ops],
+        'insert': o_insert[:n_ops], 'action': o_action[:n_ops],
+        'vtype': o_vtype[:n_ops], 'id_ctr': o_id_ctr[:n_ops],
+        'id_actor': o_id_actor[:n_ops], 'val_int': o_val_int[:n_ops],
+        'val_off': o_val_off[:n_ops], 'val_len': o_val_len[:n_ops],
+        'val_blob': val_blob[:val_bytes].tobytes(),
+        'succ_off': o_succ_off[:n_ops + 1], 'succ_ctr': s_ctr[:n_succ],
+        'succ_actor': s_actor[:n_succ],
+        'actors': read_blob(actor_blob, n_actors),
+        'keys': read_blob(key_blob, n_keys),
+    }

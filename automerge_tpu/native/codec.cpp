@@ -1266,3 +1266,566 @@ int64_t am_ingest_pred_fetch(int64_t *pred_off, int32_t *pred_blob,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Batched document-container parse (ref columnar.js:1006-1047): one call
+// parses a whole fleet's saved documents straight to flat op/change columns —
+// actor tables, heads, change metadata, and document-order op rows with succ
+// lists — with NO per-change re-encode or hashing (the deferred-hash-graph
+// load of ref new.js:1709-1749). Docs using features outside the flat subset
+// (child/link columns, unknown columns, unknown value types, extra bytes)
+// get a per-doc ok=0 flag and zero rows; the Python caller routes those
+// through the general decode path.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Known document ops-column ids ((spec << 4) | type; deflate bit 3 cleared)
+constexpr int kColIdActor = 0x21, kColIdCtr = 0x23;
+constexpr int kColChldActor = 0x61, kColChldCtr = 0x63;
+constexpr int kColSuccNum = 0x80, kColSuccActor = 0x81, kColSuccCtr = 0x83;
+// Document change-metadata column ids
+constexpr int kDocActor = 0x01, kDocSeq = 0x03, kDocMaxOp = 0x13;
+constexpr int kDocTime = 0x23, kDocMessage = 0x35;
+constexpr int kDocDepsNum = 0x40, kDocDepsIndex = 0x43;
+constexpr int kDocExtraLen = 0x56, kDocExtraRaw = 0x57;
+constexpr int kDeflateBit = 8;
+
+struct DocParseCtx {
+  Interner keys, actors;        // global across the batch
+  std::string error;
+  // per-doc
+  std::vector<uint8_t> d_ok;    // 1 = parsed; 0 = caller falls back
+  std::vector<int64_t> d_n_changes, d_n_ops, d_max_op, d_heads_off;
+  std::vector<int64_t> d_actor_off;   // into d_actor_ids
+  std::vector<int32_t> d_actor_ids;   // per-doc actor table (global ids)
+  std::vector<uint8_t> heads;         // 32 bytes per head, concatenated
+  // per-change (flat, doc-major)
+  std::vector<int32_t> c_doc, c_actor;
+  std::vector<int64_t> c_seq, c_max_op;
+  // per-op (flat, doc-major, document order)
+  std::vector<int32_t> o_doc;
+  std::vector<int64_t> o_obj_ctr;     // 0 = root object
+  std::vector<int32_t> o_obj_actor;   // global id; -1 = root
+  std::vector<int64_t> o_key_ctr;     // elemId counter; 0 = _head/none
+  std::vector<int32_t> o_key_actor;   // global id; -1 = none
+  std::vector<int32_t> o_key_str;     // interned key; -1 = none (seq op)
+  std::vector<uint8_t> o_insert, o_action, o_vtype;
+  std::vector<int64_t> o_id_ctr;
+  std::vector<int32_t> o_id_actor;    // global id
+  std::vector<int64_t> o_val_int;     // int-family value / single codepoint
+  std::vector<int64_t> o_val_off;     // into val_blob
+  std::vector<int32_t> o_val_len;
+  std::vector<uint8_t> val_blob;      // raw value bytes (strings/doubles/...)
+  std::vector<int64_t> o_succ_off;    // per op, start index into s_*
+  std::vector<int64_t> s_ctr;
+  std::vector<int32_t> s_actor;       // global ids
+};
+
+static DocParseCtx *g_docparse = nullptr;
+
+// Inflate a raw-DEFLATE column of unknown decompressed size.
+static bool inflate_vec(const uint8_t *data, uint64_t len,
+                        std::vector<uint8_t> &out) {
+  out.clear();
+  out.resize(len * 4 + 64);
+  z_stream zs;
+  memset(&zs, 0, sizeof(zs));
+  if (inflateInit2(&zs, -15) != Z_OK) return false;
+  zs.next_in = const_cast<uint8_t *>(data);
+  zs.avail_in = uInt(len);
+  size_t written = 0;
+  int ret = Z_OK;
+  while (ret != Z_STREAM_END) {
+    if (written == out.size()) out.resize(out.size() * 2);
+    zs.next_out = out.data() + written;
+    zs.avail_out = uInt(out.size() - written);
+    ret = inflate(&zs, Z_NO_FLUSH);
+    if (ret != Z_OK && ret != Z_STREAM_END) { inflateEnd(&zs); return false; }
+    written = out.size() - zs.avail_out;
+    if (ret == Z_OK && zs.avail_in == 0 && zs.avail_out != 0) break;
+  }
+  inflateEnd(&zs);
+  out.resize(written);
+  return true;
+}
+
+struct DocColumn {
+  uint32_t id = 0;
+  const uint8_t *buf = nullptr;
+  uint64_t len = 0;
+  std::vector<uint8_t> inflated;  // backing storage when deflated
+};
+
+// Parse one document chunk into ctx; returns false (after truncating any
+// partial rows) when the doc needs the general Python path.
+static bool parse_document_body(DocParseCtx &ctx, const uint8_t *chunk,
+                                uint64_t chunk_len, int32_t doc) {
+  Cursor c{chunk, chunk_len};
+  const uint8_t *magic = c.bytes(4);
+  if (c.fail || memcmp(magic, "\x85\x6f\x4a\x83", 4) != 0) return false;
+  const uint8_t *checksum = c.bytes(4);
+  uint64_t hash_start = c.pos;
+  if (c.fail || c.pos >= chunk_len) return false;
+  uint8_t chunk_type = chunk[c.pos];
+  c.skip(1);
+  uint64_t body_len = c.uleb();
+  if (c.fail || chunk_type != 0) return false;
+  const uint8_t *body = c.bytes(body_len);
+  if (c.fail || c.pos != chunk_len) return false;  // trailing data
+  uint8_t digest[32];
+  {
+    Sha256Stream s;
+    sha256_stream_init(s);
+    sha256_stream_update(s, chunk + hash_start, c.pos - hash_start);
+    sha256_stream_final(s, digest);
+  }
+  if (memcmp(digest, checksum, 4) != 0) return false;
+
+  Cursor b{body, body_len};
+  // Actor table
+  uint64_t n_actors = b.uleb();
+  std::vector<int32_t> local_actors;
+  for (uint64_t i = 0; i < n_actors && !b.fail; i++) {
+    uint64_t alen = b.uleb();
+    const uint8_t *raw = b.bytes(alen);
+    if (b.fail) return false;
+    static const char *hex = "0123456789abcdef";
+    std::string actor_hex;
+    actor_hex.reserve(alen * 2);
+    for (uint64_t j = 0; j < alen; j++) {
+      actor_hex.push_back(hex[raw[j] >> 4]);
+      actor_hex.push_back(hex[raw[j] & 15]);
+    }
+    local_actors.push_back(ctx.actors.intern(actor_hex));
+  }
+  if (b.fail) return false;
+  // Heads
+  uint64_t n_heads = b.uleb();
+  if (b.fail) return false;
+  size_t heads_start = ctx.heads.size();
+  for (uint64_t i = 0; i < n_heads; i++) {
+    const uint8_t *h = b.bytes(32);
+    if (b.fail) { ctx.heads.resize(heads_start); return false; }
+    ctx.heads.insert(ctx.heads.end(), h, h + 32);
+  }
+  auto bail = [&]() { ctx.heads.resize(heads_start); return false; };
+
+  // Column info tables (ids ascending; only non-empty columns present)
+  auto read_col_info = [&](std::vector<DocColumn> &cols) -> bool {
+    uint64_t n = b.uleb();
+    if (b.fail) return false;
+    for (uint64_t i = 0; i < n; i++) {
+      DocColumn col;
+      col.id = uint32_t(b.uleb());
+      col.len = b.uleb();
+      if (b.fail) return false;
+      cols.push_back(col);
+    }
+    return true;
+  };
+  std::vector<DocColumn> ccols, ocols;
+  if (!read_col_info(ccols) || !read_col_info(ocols)) return bail();
+  for (auto *cols : {&ccols, &ocols}) {
+    for (auto &col : *cols) {
+      col.buf = b.bytes(col.len);
+      if (b.fail) return bail();
+      if (col.id & kDeflateBit) {
+        if (!inflate_vec(col.buf, col.len, col.inflated)) return bail();
+        col.id &= ~uint32_t(kDeflateBit);
+        col.buf = col.inflated.data();
+        col.len = col.inflated.size();
+      }
+    }
+  }
+  // headsIndexes (n_heads ulebs, optional) then extraBytes; any non-empty
+  // extraBytes must be preserved -> general path
+  if (b.pos < b.len) {
+    for (uint64_t i = 0; i < n_heads; i++) b.uleb();
+    if (b.fail || b.pos != b.len) return bail();
+  }
+
+  auto find = [](std::vector<DocColumn> &cols, uint32_t id) -> DocColumn * {
+    for (auto &col : cols) if (col.id == id) return &col;
+    return nullptr;
+  };
+
+  // ---- change metadata: actor / seq / maxOp (rest lazily via Python) ----
+  for (auto &col : ccols) {
+    switch (col.id) {
+      case kDocActor: case kDocSeq: case kDocMaxOp: case kDocTime:
+      case kDocMessage: case kDocDepsNum: case kDocDepsIndex:
+      case kDocExtraLen: case kDocExtraRaw:
+        break;
+      default:
+        return bail();      // unknown change-meta column
+    }
+  }
+  std::vector<int64_t> cm_actor, cm_seq, cm_maxop;
+  std::vector<uint8_t> m1, m2, m3;
+  DocColumn *col_a = find(ccols, kDocActor);
+  DocColumn *col_s = find(ccols, kDocSeq);
+  DocColumn *col_m = find(ccols, kDocMaxOp);
+  if (col_a && !decode_i64_col(col_a->buf, col_a->len, false, false,
+                               cm_actor, m1))
+    return bail();
+  if (col_s && !decode_i64_col(col_s->buf, col_s->len, false, true,
+                               cm_seq, m2))
+    return bail();
+  if (col_m && !decode_i64_col(col_m->buf, col_m->len, false, true,
+                               cm_maxop, m3))
+    return bail();
+  size_t n_changes = cm_actor.size();
+  if (cm_seq.size() != n_changes || cm_maxop.size() != n_changes)
+    return bail();
+  for (size_t i = 0; i < n_changes; i++) {
+    if (!m1[i] || !m2[i] || !m3[i]) return bail();
+    if (cm_actor[i] < 0 || uint64_t(cm_actor[i]) >= local_actors.size())
+      return bail();
+  }
+
+  // ---- ops columns ----
+  for (auto &col : ocols) {
+    switch (col.id) {
+      case kColObjActor: case kColObjCtr: case kColKeyActor: case kColKeyCtr:
+      case kColKeyStr: case kColIdActor: case kColIdCtr: case kColInsert:
+      case kColAction: case kColValLen: case kColValRaw:
+      case kColSuccNum: case kColSuccActor: case kColSuccCtr:
+        break;
+      case kColChldActor: case kColChldCtr:
+        if (col.len > 0) return bail();  // child/link ops: general path
+        break;
+      default:
+        return bail();      // unknown ops column: must be preserved
+    }
+  }
+  auto dec = [&](uint32_t id, bool is_signed, bool is_delta,
+                 std::vector<int64_t> &vals, std::vector<uint8_t> &mask) {
+    DocColumn *col = find(ocols, id);
+    if (!col) { vals.clear(); mask.clear(); return true; }
+    return decode_i64_col(col->buf, col->len, is_signed, is_delta, vals,
+                          mask);
+  };
+  std::vector<int64_t> obj_actor, obj_ctr, key_actor, key_ctr, id_actor,
+      id_ctr, insert_v, action_v, val_len, succ_num, succ_actor, succ_ctr;
+  std::vector<uint8_t> obj_actor_m, obj_ctr_m, key_actor_m, key_ctr_m,
+      id_actor_m, id_ctr_m, insert_m, action_m, val_len_m, succ_num_m,
+      succ_actor_m, succ_ctr_m;
+  if (!dec(kColObjActor, false, false, obj_actor, obj_actor_m)) return bail();
+  if (!dec(kColObjCtr, false, false, obj_ctr, obj_ctr_m)) return bail();
+  if (!dec(kColKeyActor, false, false, key_actor, key_actor_m)) return bail();
+  if (!dec(kColKeyCtr, false, true, key_ctr, key_ctr_m)) return bail();
+  if (!dec(kColIdActor, false, false, id_actor, id_actor_m)) return bail();
+  if (!dec(kColIdCtr, false, true, id_ctr, id_ctr_m)) return bail();
+  if (!dec(kColAction, false, false, action_v, action_m)) return bail();
+  if (!dec(kColValLen, false, false, val_len, val_len_m)) return bail();
+  if (!dec(kColSuccNum, false, false, succ_num, succ_num_m)) return bail();
+  if (!dec(kColSuccActor, false, false, succ_actor, succ_actor_m))
+    return bail();
+  if (!dec(kColSuccCtr, false, true, succ_ctr, succ_ctr_m)) return bail();
+  size_t n_ops = id_ctr.size();
+  if (id_actor.size() != n_ops || action_v.size() != n_ops) return bail();
+  {
+    DocColumn *col = find(ocols, kColInsert);
+    insert_v.resize(n_ops);
+    insert_m.resize(n_ops);
+    if (col) {
+      int64_t n = am_decode_boolean(col->buf, col->len, insert_v.data(),
+                                    insert_m.data(), int64_t(n_ops));
+      if (n != int64_t(n_ops)) return bail();
+    } else if (n_ops) {
+      return bail();
+    }
+  }
+  // keyStr: interned string ids, -1 for null rows
+  std::vector<int32_t> key_str;
+  {
+    DocColumn *col = find(ocols, kColKeyStr);
+    if (col) {
+      if (!decode_keystr(col->buf, col->len, ctx.keys, key_str))
+        return bail();
+      if (key_str.size() != n_ops) return bail();
+    } else {
+      key_str.assign(n_ops, -1);
+    }
+  }
+  // Columns that can be all-null (absent): size them as null rows
+  auto pad_null = [&](std::vector<int64_t> &vals, std::vector<uint8_t> &mask) {
+    if (vals.empty()) { vals.assign(n_ops, 0); mask.assign(n_ops, 0); }
+    return vals.size() == n_ops;
+  };
+  if (!pad_null(obj_actor, obj_actor_m) || !pad_null(obj_ctr, obj_ctr_m) ||
+      !pad_null(key_actor, key_actor_m) || !pad_null(key_ctr, key_ctr_m) ||
+      !pad_null(val_len, val_len_m) || !pad_null(succ_num, succ_num_m))
+    return bail();
+  // succ group: total entries must match the sum of succNum
+  uint64_t succ_total = 0;
+  for (size_t i = 0; i < n_ops; i++)
+    succ_total += succ_num_m[i] ? uint64_t(succ_num[i]) : 0;
+  if (succ_actor.size() != succ_total || succ_ctr.size() != succ_total)
+    return bail();
+  DocColumn *vraw = find(ocols, kColValRaw);
+  const uint8_t *raw_buf = vraw ? vraw->buf : nullptr;
+  uint64_t raw_len = vraw ? vraw->len : 0;
+
+  // ---- emit rows (rollback on any failure) ----
+  size_t ops_start = ctx.o_doc.size();
+  size_t succ_start = ctx.s_ctr.size();
+  size_t val_start = ctx.val_blob.size();
+  auto bail_rows = [&]() {
+    ctx.o_doc.resize(ops_start);
+    ctx.o_obj_ctr.resize(ops_start);
+    ctx.o_obj_actor.resize(ops_start);
+    ctx.o_key_ctr.resize(ops_start);
+    ctx.o_key_actor.resize(ops_start);
+    ctx.o_key_str.resize(ops_start);
+    ctx.o_insert.resize(ops_start);
+    ctx.o_action.resize(ops_start);
+    ctx.o_vtype.resize(ops_start);
+    ctx.o_id_ctr.resize(ops_start);
+    ctx.o_id_actor.resize(ops_start);
+    ctx.o_val_int.resize(ops_start);
+    ctx.o_val_off.resize(ops_start);
+    ctx.o_val_len.resize(ops_start);
+    ctx.o_succ_off.resize(ops_start);
+    ctx.s_ctr.resize(succ_start);
+    ctx.s_actor.resize(succ_start);
+    ctx.val_blob.resize(val_start);
+    return bail();
+  };
+  uint64_t raw_pos = 0;
+  uint64_t succ_pos = 0;
+  for (size_t i = 0; i < n_ops; i++) {
+    if (!id_actor_m[i] || !id_ctr_m[i] || !action_m[i]) return bail_rows();
+    int64_t action = action_v[i];
+    if (action < 0 || action > 6 || action == 3) return bail_rows();
+    // (action 3 = del: documents never store del rows, columnar.js:892;
+    //  action 7 = link and anything higher: general path)
+    if (uint64_t(id_actor[i]) >= local_actors.size()) return bail_rows();
+    if (obj_actor_m[i] != obj_ctr_m[i]) return bail_rows();
+    if (obj_actor_m[i] && uint64_t(obj_actor[i]) >= local_actors.size())
+      return bail_rows();
+    if (key_actor_m[i] && uint64_t(key_actor[i]) >= local_actors.size())
+      return bail_rows();
+    // elemId columns must be consistent: a non-zero keyCtr needs its actor
+    // (keyCtr==0 with null actor is the legal _head encoding), and an
+    // actor without a counter is malformed — aliasing either to actor 0
+    // would target the wrong element
+    if (key_ctr_m[i] && !key_actor_m[i] && key_ctr[i] != 0)
+      return bail_rows();
+    if (key_actor_m[i] && !key_ctr_m[i]) return bail_rows();
+    // value
+    uint8_t vtype = 0;
+    int64_t vint = 0, voff = 0;
+    int32_t vlen = 0;
+    if (val_len_m[i]) {
+      uint64_t tag = uint64_t(val_len[i]);
+      vtype = uint8_t(tag & 0xf);
+      vlen = int32_t(tag >> 4);
+      if (vtype >= 10) return bail_rows();      // unknown value types
+      if (raw_pos + uint64_t(vlen) > raw_len) return bail_rows();
+      voff = int64_t(ctx.val_blob.size());
+      ctx.val_blob.insert(ctx.val_blob.end(), raw_buf + raw_pos,
+                          raw_buf + raw_pos + vlen);
+      if (vtype == 3 || vtype == 4 || vtype == 8 || vtype == 9) {
+        uint64_t p = 0;
+        int err = 0;
+        vint = (vtype == 3)
+            ? int64_t(read_uleb(raw_buf + raw_pos, vlen, &p, &err))
+            : read_sleb(raw_buf + raw_pos, vlen, &p, &err);
+        if (err || p != uint64_t(vlen)) return bail_rows();
+      } else if (vtype == 6) {
+        vint = utf8_single_cp(raw_buf + raw_pos, vlen);  // -1 = multi-char
+      }
+      raw_pos += uint64_t(vlen);
+    }
+    ctx.o_doc.push_back(doc);
+    ctx.o_obj_ctr.push_back(obj_ctr_m[i] ? obj_ctr[i] : 0);
+    ctx.o_obj_actor.push_back(
+        obj_actor_m[i] ? local_actors[size_t(obj_actor[i])] : -1);
+    ctx.o_key_ctr.push_back(key_ctr_m[i] ? key_ctr[i] : 0);
+    ctx.o_key_actor.push_back(
+        key_actor_m[i] ? local_actors[size_t(key_actor[i])] : -1);
+    ctx.o_key_str.push_back(key_str[i]);
+    ctx.o_insert.push_back(uint8_t(insert_m[i] ? insert_v[i] : 0));
+    ctx.o_action.push_back(uint8_t(action));
+    ctx.o_vtype.push_back(vtype);
+    ctx.o_id_ctr.push_back(id_ctr[i]);
+    ctx.o_id_actor.push_back(local_actors[size_t(id_actor[i])]);
+    ctx.o_val_int.push_back(vint);
+    ctx.o_val_off.push_back(voff);
+    ctx.o_val_len.push_back(vlen);
+    ctx.o_succ_off.push_back(int64_t(succ_start + succ_pos));
+    uint64_t num = succ_num_m[i] ? uint64_t(succ_num[i]) : 0;
+    for (uint64_t k = 0; k < num; k++, succ_pos++) {
+      if (!succ_actor_m[succ_pos] || !succ_ctr_m[succ_pos])
+        return bail_rows();
+      if (uint64_t(succ_actor[succ_pos]) >= local_actors.size())
+        return bail_rows();
+      ctx.s_ctr.push_back(succ_ctr[succ_pos]);
+      ctx.s_actor.push_back(local_actors[size_t(succ_actor[succ_pos])]);
+    }
+  }
+  if (raw_pos != raw_len || succ_pos != succ_total) return bail_rows();
+
+  // ---- commit per-doc/per-change metadata ----
+  int64_t max_op = 0;
+  for (size_t i = 0; i < n_changes; i++) {
+    ctx.c_doc.push_back(doc);
+    ctx.c_actor.push_back(local_actors[size_t(cm_actor[i])]);
+    ctx.c_seq.push_back(cm_seq[i]);
+    ctx.c_max_op.push_back(cm_maxop[i]);
+    if (cm_maxop[i] > max_op) max_op = cm_maxop[i];
+  }
+  ctx.d_n_changes.push_back(int64_t(n_changes));
+  ctx.d_n_ops.push_back(int64_t(n_ops));
+  ctx.d_max_op.push_back(max_op);
+  ctx.d_heads_off.push_back(int64_t(heads_start / 32));
+  ctx.d_actor_off.push_back(int64_t(ctx.d_actor_ids.size()));
+  ctx.d_actor_ids.insert(ctx.d_actor_ids.end(), local_actors.begin(),
+                         local_actors.end());
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse a batch of document chunks. Returns total op rows across parsed
+// docs, or -1 on allocation-level failure. Per-doc failures set ok=0 and
+// contribute no rows (the caller falls back per doc).
+int64_t am_parse_documents(const uint8_t *blob, const uint64_t *offsets,
+                           const uint64_t *lens, uint64_t n_docs) {
+  delete g_docparse;
+  g_docparse = new DocParseCtx();
+  DocParseCtx &ctx = *g_docparse;
+  for (uint64_t d = 0; d < n_docs; d++) {
+    size_t nc = ctx.c_doc.size();
+    bool ok = parse_document_body(ctx, blob + offsets[d], lens[d],
+                                  int32_t(d));
+    if (!ok) {
+      // parse_document_body rolls back rows/heads; change meta may remain
+      ctx.c_doc.resize(nc);
+      ctx.c_actor.resize(nc);
+      ctx.c_seq.resize(nc);
+      ctx.c_max_op.resize(nc);
+      ctx.d_ok.push_back(0);
+      ctx.d_n_changes.push_back(0);
+      ctx.d_n_ops.push_back(0);
+      ctx.d_max_op.push_back(0);
+      ctx.d_heads_off.push_back(int64_t(ctx.heads.size() / 32));
+      ctx.d_actor_off.push_back(int64_t(ctx.d_actor_ids.size()));
+    } else {
+      ctx.d_ok.push_back(1);
+    }
+  }
+  return int64_t(ctx.o_doc.size());
+}
+
+// Sizes needed to allocate fetch buffers. Returns 0, or -1 with no context.
+int64_t am_docparse_sizes(int64_t *n_changes, int64_t *n_succ,
+                          int64_t *n_heads, int64_t *val_bytes,
+                          int64_t *actor_blob_bytes, int64_t *n_actors,
+                          int64_t *key_blob_bytes, int64_t *n_keys,
+                          int64_t *n_doc_actors) {
+  if (!g_docparse) return -1;
+  DocParseCtx &ctx = *g_docparse;
+  auto blob_size = [](const std::vector<std::string> &items) -> int64_t {
+    uint64_t pos = 0;
+    for (const auto &s : items) {
+      uint64_t v = s.size();
+      do { pos++; v >>= 7; } while (v);
+      pos += s.size();
+    }
+    return int64_t(pos);
+  };
+  *n_changes = int64_t(ctx.c_doc.size());
+  *n_succ = int64_t(ctx.s_ctr.size());
+  *n_heads = int64_t(ctx.heads.size() / 32);
+  *val_bytes = int64_t(ctx.val_blob.size());
+  *actor_blob_bytes = blob_size(ctx.actors.items);
+  *n_actors = int64_t(ctx.actors.items.size());
+  *key_blob_bytes = blob_size(ctx.keys.items);
+  *n_keys = int64_t(ctx.keys.items.size());
+  *n_doc_actors = int64_t(ctx.d_actor_ids.size());
+  return 0;
+}
+
+// Copy out every parsed array. Array sizes follow am_parse_documents'
+// return (n_ops) and am_docparse_sizes. Frees the context on success.
+int64_t am_docparse_fetch(
+    uint8_t *d_ok, int64_t *d_n_changes, int64_t *d_n_ops, int64_t *d_max_op,
+    int64_t *d_heads_off, int64_t *d_actor_off, int32_t *d_actor_ids,
+    uint8_t *heads,
+    int32_t *c_doc, int32_t *c_actor, int64_t *c_seq, int64_t *c_max_op,
+    int32_t *o_doc, int64_t *o_obj_ctr, int32_t *o_obj_actor,
+    int64_t *o_key_ctr, int32_t *o_key_actor, int32_t *o_key_str,
+    uint8_t *o_insert, uint8_t *o_action, uint8_t *o_vtype,
+    int64_t *o_id_ctr, int32_t *o_id_actor,
+    int64_t *o_val_int, int64_t *o_val_off, int32_t *o_val_len,
+    uint8_t *val_blob, int64_t *o_succ_off, int64_t *s_ctr, int32_t *s_actor,
+    uint8_t *key_blob, uint64_t key_blob_cap,
+    uint8_t *actor_blob, uint64_t actor_blob_cap) {
+  if (!g_docparse) return -1;
+  DocParseCtx &ctx = *g_docparse;
+  size_t nd = ctx.d_ok.size(), nc = ctx.c_doc.size(), no = ctx.o_doc.size();
+  memcpy(d_ok, ctx.d_ok.data(), nd);
+  memcpy(d_n_changes, ctx.d_n_changes.data(), nd * 8);
+  memcpy(d_n_ops, ctx.d_n_ops.data(), nd * 8);
+  memcpy(d_max_op, ctx.d_max_op.data(), nd * 8);
+  memcpy(d_heads_off, ctx.d_heads_off.data(), nd * 8);
+  d_heads_off[nd] = int64_t(ctx.heads.size() / 32);
+  memcpy(d_actor_off, ctx.d_actor_off.data(), nd * 8);
+  d_actor_off[nd] = int64_t(ctx.d_actor_ids.size());
+  memcpy(d_actor_ids, ctx.d_actor_ids.data(), ctx.d_actor_ids.size() * 4);
+  memcpy(heads, ctx.heads.data(), ctx.heads.size());
+  memcpy(c_doc, ctx.c_doc.data(), nc * 4);
+  memcpy(c_actor, ctx.c_actor.data(), nc * 4);
+  memcpy(c_seq, ctx.c_seq.data(), nc * 8);
+  memcpy(c_max_op, ctx.c_max_op.data(), nc * 8);
+  memcpy(o_doc, ctx.o_doc.data(), no * 4);
+  memcpy(o_obj_ctr, ctx.o_obj_ctr.data(), no * 8);
+  memcpy(o_obj_actor, ctx.o_obj_actor.data(), no * 4);
+  memcpy(o_key_ctr, ctx.o_key_ctr.data(), no * 8);
+  memcpy(o_key_actor, ctx.o_key_actor.data(), no * 4);
+  memcpy(o_key_str, ctx.o_key_str.data(), no * 4);
+  memcpy(o_insert, ctx.o_insert.data(), no);
+  memcpy(o_action, ctx.o_action.data(), no);
+  memcpy(o_vtype, ctx.o_vtype.data(), no);
+  memcpy(o_id_ctr, ctx.o_id_ctr.data(), no * 8);
+  memcpy(o_id_actor, ctx.o_id_actor.data(), no * 4);
+  memcpy(o_val_int, ctx.o_val_int.data(), no * 8);
+  memcpy(o_val_off, ctx.o_val_off.data(), no * 8);
+  memcpy(o_val_len, ctx.o_val_len.data(), no * 4);
+  memcpy(val_blob, ctx.val_blob.data(), ctx.val_blob.size());
+  memcpy(o_succ_off, ctx.o_succ_off.data(), no * 8);
+  o_succ_off[no] = int64_t(ctx.s_ctr.size());
+  memcpy(s_ctr, ctx.s_ctr.data(), ctx.s_ctr.size() * 8);
+  memcpy(s_actor, ctx.s_actor.data(), ctx.s_actor.size() * 4);
+
+  auto write_blob = [](const std::vector<std::string> &items, uint8_t *out,
+                       uint64_t cap) -> int64_t {
+    uint64_t pos = 0;
+    for (const auto &s : items) {
+      uint64_t len = s.size();
+      uint64_t v = len;
+      do {
+        if (pos >= cap) return -1;
+        uint8_t byte = v & 0x7f;
+        v >>= 7;
+        out[pos++] = byte | (v ? 0x80 : 0);
+      } while (v);
+      if (pos + len > cap) return -1;
+      memcpy(out + pos, s.data(), len);
+      pos += len;
+    }
+    return int64_t(pos);
+  };
+  if (write_blob(ctx.keys.items, key_blob, key_blob_cap) < 0) return -1;
+  if (write_blob(ctx.actors.items, actor_blob, actor_blob_cap) < 0) return -1;
+  delete g_docparse;
+  g_docparse = nullptr;
+  return int64_t(no);
+}
+
+}  // extern "C"

@@ -37,6 +37,8 @@ class Metrics:
         'grows',                 # capacity regrowths (doc/key axes)
         'mirror_rebuilds',       # lazy mirror replays after turbo
         'graph_builds',          # deferred hash-graph materializations
+        'docs_bulk_loaded',      # documents installed by the native loader
+        'doc_materializations',  # bulk-loaded docs whose history was read
     )
 
     def __init__(self):

@@ -482,6 +482,59 @@ def bench_backend_text(n_docs, trace_len, ops_per_change=32, seed=0):
     return median_rate(run, n_ops), host_rate
 
 
+def bench_bulk_load(n_docs, n_changes=40, seed=0):
+    """Fleet bulk load (native document parse -> device state, no replay)
+    vs the ordinary per-doc load path (Python document decode + host OpSet
+    replay). Returns (bulk docs/s, per-doc docs/s)."""
+    import jax
+    from automerge_tpu import backend as Backend
+    from automerge_tpu.columnar import encode_change, decode_change_meta
+    from automerge_tpu.fleet.backend import DocFleet
+    from automerge_tpu.fleet import backend as fleet_backend
+    from automerge_tpu.fleet.loader import load_docs
+    rng = np.random.default_rng(seed)
+    A = 'bb' * 16
+    # One representative saved document, cloned across the fleet with
+    # distinct trailing writes so contents differ per doc
+    base = Backend.init()
+    heads = []
+    for c in range(n_changes):
+        ops = [{'action': 'set', 'obj': '_root', 'key': f'k{int(k)}',
+                'value': int(rng.integers(0, 1 << 20)),
+                'datatype': 'int', 'pred': []}
+               for k in rng.integers(0, 64, size=8)]
+        buf = encode_change({'actor': A, 'seq': c + 1,
+                             'startOp': c * 8 + 1, 'time': 0,
+                             'message': '', 'deps': heads, 'ops': ops})
+        heads = [decode_change_meta(buf, True)['hash']]
+        base, _ = Backend.apply_changes(base, [buf])
+    saved = Backend.save(base)
+    bufs = [saved] * n_docs
+
+    def run_bulk():
+        fleet = DocFleet(doc_capacity=n_docs, key_capacity=128)
+        handles = load_docs(bufs, fleet)
+        if fleet.metrics.docs_bulk_loaded != n_docs:
+            raise RuntimeError('bulk load fell back to the per-doc path')
+        if fleet.state is not None:
+            jax.block_until_ready(fleet.state.winners)
+
+    host_docs = max(n_docs // 100, 1)
+
+    def run_host():
+        fleet = DocFleet(doc_capacity=host_docs, key_capacity=128)
+        for buf in bufs[:host_docs]:
+            fleet_backend.load(buf, fleet)
+
+    host = median_rate(run_host, host_docs, reps=3)
+    from automerge_tpu import native
+    if not native.available():
+        return None, host      # no native codec: bulk path unavailable
+    run_bulk()   # warmup compile
+    bulk = median_rate(run_bulk, n_docs, reps=3)
+    return bulk, host
+
+
 def main():
     n_docs = int(os.environ.get('BENCH_DOCS', 10000))
     n_keys = int(os.environ.get('BENCH_KEYS', 1000))
@@ -522,6 +575,10 @@ def main():
         int(os.environ.get('BENCH_ZIPF_DOCS', 100000)))
     # Exact multi-value register engine (ordered scan formulation)
     reg_rate = bench_registers(int(os.environ.get('BENCH_REG_DOCS', 4000)))
+    # Bulk document load: native parse straight to device state vs the
+    # per-doc Python decode + host replay path
+    bulk_rate, perdoc_rate = bench_bulk_load(
+        int(os.environ.get('BENCH_LOAD_DOCS', 2000)))
 
     print(f'# HEADLINE backend-seam end-to-end (turbo, incl. hash graph): '
           f'{seam_rate:.0f} changes/s (median of {REPS})', file=sys.stderr)
@@ -545,6 +602,14 @@ def main():
     print(f'# zipf 100k-doc fleet: {zipf_rate:.0f} effective ops/s '
           f'(occupancy {zipf_occ:.2f})', file=sys.stderr)
     print(f'# exact register engine: {reg_rate:.0f} ops/s', file=sys.stderr)
+    if bulk_rate is not None:
+        print(f'# bulk document load (native parse -> device state): '
+              f'{bulk_rate:.0f} docs/s vs per-doc path '
+              f'{perdoc_rate:.0f} docs/s '
+              f'({bulk_rate / perdoc_rate:.1f}x)', file=sys.stderr)
+    else:
+        print(f'# bulk document load: native codec unavailable '
+              f'(per-doc path {perdoc_rate:.0f} docs/s)', file=sys.stderr)
 
     result = {
         'metric': 'changes_per_sec_backend_seam_e2e',

@@ -1,0 +1,253 @@
+"""Bulk native document load (fleet/loader.py): saved containers straight to
+device state with no replay and no change-log materialization.
+
+Differential harness (the wasm.js cross-implementation pattern): documents
+built through the public API on the host backend, saved, bulk-loaded into a
+fleet, then compared read-for-read and patch-for-patch against the host
+engine on the same bytes."""
+
+import numpy as np
+import pytest
+
+import automerge_tpu as A
+from automerge_tpu import backend as host_backend
+from automerge_tpu import native
+from automerge_tpu.fleet import backend as fleet_backend
+from automerge_tpu.fleet.backend import DocFleet
+from automerge_tpu.fleet.loader import load_docs
+
+A1, A2, A3 = '01' * 8, '89' * 8, 'fe' * 8
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason='native codec unavailable')
+
+
+def _corpus():
+    """Documents covering the loadable shapes: values of every datatype,
+    counters with incs, nested maps/tables, text/list editing, concurrent
+    merges with conflicts, deletes, multi-actor histories."""
+    docs = []
+    d = A.from_({'x': 1, 's': 'hello', 'c': A.Counter(10), 'f': 2.5,
+                 'ok': True, 'n': None, 'u': A.Uint(3),
+                 'when': A.Int(1589032171000)}, A1)
+    d = A.change(d, lambda r: r['c'].increment(7))
+    docs.append(d)
+
+    d = A.from_({'cfg': {'a': {'deep': 'yes'}, 'b': 2}}, A1)
+    d = A.change(d, lambda r: r['cfg'].__setitem__('b', 9))
+    docs.append(d)
+
+    d = A.from_({'t': A.Text('hello world')}, A1)
+    d = A.change(d, lambda r: r['t'].delete_at(0))
+    d = A.change(d, lambda r: r['t'].insert_at(0, 'H'))
+    docs.append(d)
+
+    d = A.from_({'l': [1, 2, 3, 'four']}, A1)
+    d = A.change(d, lambda r: r['l'].__setitem__(1, 20))
+    d = A.change(d, lambda r: r['l'].delete_at(0))
+    docs.append(d)
+
+    # concurrent conflicting writes (multi-value register shape)
+    b1 = A.from_({'k': 'one', 'shared': 0}, A1)
+    b2 = A.merge(A.init(A2), b1)
+    b1 = A.change(b1, lambda r: r.__setitem__('k', 'from-a'))
+    b2 = A.change(b2, lambda r: r.__setitem__('k', 'from-b'))
+    docs.append(A.merge(b1, b2))
+
+    # concurrent text editing (3 actors)
+    t1 = A.from_({'t': A.Text('base')}, A1)
+    t2 = A.merge(A.init(A2), t1)
+    t3 = A.merge(A.init(A3), t1)
+    t1 = A.change(t1, lambda r: r['t'].insert_at(0, 'X'))
+    t2 = A.change(t2, lambda r: r['t'].set(1, 'A'))
+    t3 = A.change(t3, lambda r: r['t'].delete_at(2))
+    docs.append(A.merge(A.merge(t1, t2), t3))
+
+    # deleted keys + re-set
+    d = A.from_({'gone': 1, 'kept': 2}, A1)
+    d = A.change(d, lambda r: r.__delitem__('gone'))
+    d = A.change(d, lambda r: r.__setitem__('kept', 3))
+    docs.append(d)
+
+    # empty document
+    docs.append(A.init(A1))
+
+    # table rows
+    d = A.from_({'tbl': A.Table()}, A1)
+
+    def add_row(r):
+        r['tbl'].add({'name': 'wren', 'n': 1})
+    d = A.change(d, add_row)
+    docs.append(d)
+    return docs
+
+
+def _host_view(buf):
+    hb = host_backend.load(buf)
+    patch = host_backend.get_patch(hb)
+    return patch
+
+
+class TestBulkLoad:
+    @pytest.mark.parametrize('exact', [False, True])
+    def test_differential_reads_and_patches(self, exact):
+        docs = _corpus()
+        bufs = [A.save(d) for d in docs]
+        fleet = DocFleet(doc_capacity=4, key_capacity=8, exact_device=exact)
+        handles = load_docs(bufs, fleet)
+        assert fleet.metrics.docs_bulk_loaded == len(bufs)
+        # reads match the ordinary (host-OpSet-replay) load path on the
+        # same bytes, with NO change-log materialization on the bulk side
+        oracle_fleet = DocFleet(doc_capacity=4, key_capacity=8)
+        oracle = [fleet_backend.load(bytes(b), oracle_fleet) for b in bufs]
+        expect = fleet_backend.materialize_docs(oracle)
+        mats = fleet_backend.materialize_docs(handles)
+        for i, (m, e) in enumerate(zip(mats, expect)):
+            assert m == e, f'doc {i} mismatch'
+        assert fleet.metrics.doc_materializations == 0
+        # patches match the host backend exactly (mirror may materialize
+        # for nested/sequence docs; flat docs stay lazy in exact mode)
+        for i, (h, buf) in enumerate(zip(handles, bufs)):
+            assert fleet_backend.get_patch(h) == _host_view(buf), \
+                f'doc {i} patch mismatch'
+
+    def test_save_verbatim_until_edit(self):
+        docs = _corpus()
+        bufs = [A.save(d) for d in docs]
+        fleet = DocFleet(doc_capacity=4, key_capacity=8, exact_device=True)
+        handles = load_docs(bufs, fleet)
+        for h, buf in zip(handles, bufs):
+            assert bytes(fleet_backend.save(h)) == bytes(buf)
+        assert fleet.metrics.doc_materializations == 0
+
+    def test_edit_after_load(self):
+        """Further changes apply on top of bulk-loaded state and reads stay
+        correct; save after edit re-encodes canonically (not verbatim)."""
+        d = A.from_({'x': 1, 'c': A.Counter(5)}, A1)
+        buf = A.save(d)
+        fleet = DocFleet(doc_capacity=2, key_capacity=8, exact_device=True)
+        handle = load_docs([buf], fleet)[0]
+        # build the same follow-up change with the host frontend
+        d2 = A.load(buf)
+        d2 = A.change(d2, lambda r: (r.__setitem__('x', 2),
+                                     r['c'].increment(3)))
+        new_change = A.get_last_local_change(d2)
+        handle, _patch = fleet_backend.apply_changes(handle, [new_change])
+        mat = fleet_backend.materialize_docs([handle])[0]
+        assert mat == {'x': 2, 'c': 8}
+        # canonical save after edit equals the host engine's canonical save
+        hb = host_backend.load(buf)
+        hb, _ = host_backend.apply_changes(hb, [new_change])
+        assert bytes(fleet_backend.save(handle)) == \
+            bytes(host_backend.save(hb))
+
+    def test_sync_after_load_materializes_lazily(self):
+        """Sync needs real change history: the parked chunk materializes on
+        demand and the sync round converges against a host peer."""
+        d = A.from_({'x': 1, 't': A.Text('ab')}, A1)
+        buf = A.save(d)
+        fleet = DocFleet(doc_capacity=2, key_capacity=8)
+        handle = load_docs([buf], fleet)[0]
+        peer = host_backend.init()
+        s1 = A.init_sync_state()
+        s2 = A.init_sync_state()
+        for _ in range(10):
+            s1, msg = fleet_backend.generate_sync_message(handle, s1)
+            if msg is not None:
+                peer, s2, _ = host_backend.receive_sync_message(peer, s2, msg)
+            s2, msg2 = host_backend.generate_sync_message(peer, s2)
+            if msg2 is not None:
+                handle, s1, _ = fleet_backend.receive_sync_message(
+                    handle, s1, msg2)
+            if msg is None and msg2 is None:
+                break
+        assert host_backend.get_heads(peer) == fleet_backend.get_heads(handle)
+        assert fleet.metrics.doc_materializations == 1
+
+    def test_counter_in_list_falls_back_to_mirror(self):
+        """Counters inside sequences are host-mirror-only: the loaded row
+        flags inexact and reads still come out right (via materialization)."""
+        d = A.from_({'l': [A.Counter(10)]}, A1)
+        d = A.change(d, lambda r: r['l'][0].increment(5))
+        buf = A.save(d)
+        fleet = DocFleet(doc_capacity=2, key_capacity=8)
+        handle = load_docs([buf], fleet)[0]
+        assert fleet_backend.materialize_docs([handle]) == [{'l': [15]}]
+
+    def test_fallback_paths_still_load(self):
+        """Buffers the native path can't take (concatenated chunks, raw
+        change chunks, objects inside sequences) load via the ordinary
+        path and produce identical reads."""
+        d = A.from_({'l': [{'obj': 'in-list'}]}, A1)   # object inside a seq
+        buf_nested = A.save(d)
+        d2 = A.from_({'x': 1}, A1)
+        raw_changes = b''.join(A.get_all_changes(d2))  # change chunks
+        fleet = DocFleet(doc_capacity=4, key_capacity=8)
+        handles = load_docs([buf_nested, raw_changes], fleet)
+        mats = fleet_backend.materialize_docs(handles)
+        assert mats[0] == {'l': [{'obj': 'in-list'}]}
+        assert mats[1] == {'x': 1}
+
+    def test_heads_clock_graph_match_host(self):
+        docs = _corpus()
+        bufs = [A.save(d) for d in docs]
+        fleet = DocFleet(doc_capacity=4, key_capacity=8)
+        handles = load_docs(bufs, fleet)
+        for h, buf in zip(handles, bufs):
+            hb = host_backend.load(buf)
+            assert fleet_backend.get_heads(h) == host_backend.get_heads(hb)
+            assert h['state'].clock == hb['state'].clock
+            assert h['state'].max_op == hb['state'].max_op
+            # hash-graph queries resolve lazily and agree with the host
+            assert sorted(x.hex() if isinstance(x, bytes) else x
+                          for x in fleet_backend.get_missing_deps(h)) == \
+                sorted(x.hex() if isinstance(x, bytes) else x
+                       for x in host_backend.get_missing_deps(hb))
+            assert [bytes(c) for c in fleet_backend.get_all_changes(h)] == \
+                [bytes(c) for c in host_backend.get_all_changes(hb)]
+
+    def test_fuzz_differential(self):
+        """Randomized multi-actor editing histories: save on host, bulk
+        load, compare whole-doc reads in both device modes."""
+        import random
+        rng = random.Random(7)
+        alphabet = 'abcdefghij'
+        bufs, expects = [], []
+        for trial in range(6):
+            actors = [A1, A2]
+            base = A.from_({'t': A.Text('seed'), 'm': {}, 'k': 0}, actors[0])
+            replicas = [base, A.merge(A.init(actors[1]), base)]
+            for step in range(12):
+                i = rng.randrange(2)
+
+                def edit(r, rng=rng):
+                    roll = rng.random()
+                    t = r['t']
+                    if roll < 0.3 and len(t):
+                        t.delete_at(rng.randrange(len(t)))
+                    elif roll < 0.5:
+                        t.insert_at(rng.randrange(len(t) + 1),
+                                    rng.choice(alphabet))
+                    elif roll < 0.7 and len(t):
+                        t.set(rng.randrange(len(t)),
+                              rng.choice(alphabet).upper())
+                    elif roll < 0.85:
+                        r['m'][rng.choice(alphabet)] = rng.randrange(100)
+                    else:
+                        r['k'] = rng.randrange(1000)
+                replicas[i] = A.change(replicas[i], edit)
+                if rng.random() < 0.3:
+                    a, b = rng.sample(range(2), 2)
+                    replicas[a] = A.merge(replicas[a], replicas[b])
+            final = A.merge(A.clone(replicas[0]), replicas[1])
+            bufs.append(A.save(final))
+            expects.append(dict(final))
+        for exact in (False, True):
+            fleet = DocFleet(doc_capacity=8, key_capacity=16,
+                             exact_device=exact)
+            handles = load_docs(bufs, fleet)
+            assert fleet.metrics.docs_bulk_loaded == len(bufs)
+            mats = fleet_backend.materialize_docs(handles)
+            for i, (m, e) in enumerate(zip(mats, expects)):
+                assert m == e, f'trial {i} exact={exact}'
+            assert fleet.metrics.doc_materializations == 0
