@@ -1341,7 +1341,10 @@ class _FlatEngine(HashGraph):
             return None
         fleet.flush()
         empty = {'objectId': '_root', 'type': 'map', 'props': {}}
-        if not self.changes:
+        # emptiness check must not touch the changes property: on a
+        # bulk-loaded doc that would materialize the whole parked chunk
+        # just to answer a question the device state answers anyway
+        if self._doc_pending is None and not self._changes:
             return empty
         if fleet.reg_state is None:
             return empty
@@ -1385,9 +1388,19 @@ class _FlatEngine(HashGraph):
         return doc
 
     def save(self):
-        """Document container serialization from the mirror's op store plus
-        this engine's hash-graph metadata."""
+        """Canonical document container serialization. The native builder
+        (codec.cpp am_build_document) parses the change log, replays it into
+        a succ-annotated op store, and emits the chunk entirely in C++ — no
+        host mirror; histories it can't represent (link/child ops, unknown
+        columns) fall back to the mirror path, which is the same bytes by
+        construction (differential-tested)."""
         if self.binary_doc is None:
+            if native.available():
+                built = native.build_document(
+                    [bytes(b) for b in self.changes], self.heads)
+                if built is not None:
+                    self.binary_doc = built
+                    return self.binary_doc
             self._ensure_mirror()
             self._ensure_graph()
             m = self.mirror

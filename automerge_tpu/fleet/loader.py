@@ -171,17 +171,25 @@ def _install_parsed(fleet, out, native_idx, chunks, handles, fleet_backend):
     row_in_map = (obj_actor < 0) | _isin_sorted(okey, map_objs)
     orphan = row_ok & ~row_is_seq & ~row_in_map
     make_in_seq = make_mask & row_is_seq
-    for mask in (orphan, make_in_seq):
+    # map rows must carry a string key and cannot be inserts (a crafted
+    # chunk can pass the column-level checks with an elemId on a map row —
+    # out['keys'][-1] must never be dereferenced)
+    map_malformed = row_ok & ~row_is_seq & ((key_str < 0) | insert)
+    for mask in (orphan, make_in_seq, map_malformed):
         if mask.any():
             bad[np.unique(doc[mask])] = True
 
     # ---- alive / counter-fold (succNum==0 visibility; inc successors
-    # accumulate instead of killing, ref new.js:937-965) -------------------
+    # accumulate instead of killing, ref new.js:937-965). The inc lookup
+    # table takes good-doc rows ONLY: a fallback-bound doc's un-packable
+    # op ids alias into other docs' _okey space and would corrupt their
+    # alive/counter computation -------------------------------------------
     inc_mask = action == _A_INC
-    inc_rid = rid[inc_mask]
+    inc_sel = inc_mask & ~bad[doc]
+    inc_rid = rid[inc_sel]
     inc_order = np.argsort(inc_rid)
     inc_sorted = inc_rid[inc_order]
-    inc_vals = val_int[inc_mask][inc_order]
+    inc_vals = val_int[inc_sel][inc_order]
     n_succ_per = np.diff(succ_off)
     counter_add = np.zeros(n_ops, dtype=np.int64)
     if n_succ and len(inc_sorted):
@@ -333,8 +341,19 @@ def _install_map_cells(fleet, out, sel, doc, slot_of, okey, oid_str, key_str,
         jj = int(j)
         if make_mask[jj]:
             oid = oid_str[int(rid[jj])]
-            link = _SeqLink(oid) if int(action[jj]) in _SEQ_MAKES \
-                else _MapLink(oid, _TYPE_NAMES[int(action[jj])])
+            if int(action[jj]) in _SEQ_MAKES:
+                link = _SeqLink(oid)
+                # allocate the device row NOW (the ordinary apply path does
+                # this at make time, backend._flush_mixed): an EMPTY
+                # sequence has no op rows, and an unresolved link would
+                # push every read of the doc to the mirror
+                slot = int(slot_of[doc[jj]])
+                if oid not in fleet.slot_seq.get(slot, {}):
+                    typ = 'text' if int(action[jj]) == _A_MAKE_TEXT \
+                        else 'list'
+                    fleet._alloc_seq_row(slot, oid, typ)
+            else:
+                link = _MapLink(oid, _TYPE_NAMES[int(action[jj])])
             values[i] = fleet._intern_value_boxed(link)
         else:
             values[i] = _decode_cell_value(fleet, out, jj, int(vtype[jj]),
@@ -408,7 +427,11 @@ def _install_seq_rows(fleet, out, sel, doc, slot_of, okey, oid_str, obj_type,
         d = int(doc[rows[int(first_of_group[u])]])
         slot = int(slot_of[d])
         typ = 'text' if obj_type[int(ok_)] == _A_MAKE_TEXT else 'list'
-        fleet_row[u] = fleet._alloc_seq_row(slot, oid, typ)
+        # alive makes already allocated their row in _install_map_cells;
+        # killed/overwritten objects' rows allocate here
+        existing = fleet.slot_seq.get(slot, {}).get(oid)
+        fleet_row[u] = existing if existing is not None else \
+            fleet._alloc_seq_row(slot, oid, typ)
         is_text[u] = typ == 'text'
 
     ins = insert[rows]

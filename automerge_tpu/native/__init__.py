@@ -544,3 +544,41 @@ def parse_documents(buffers):
         'actors': read_blob(actor_blob, n_actors),
         'keys': read_blob(key_blob, n_keys),
     }
+
+
+def build_document(change_buffers, heads):
+    """Native mirror-free save (ref columnar.js:983-1004 + the canonical
+    ordering of op_set.OpSet.save): parse the doc's change log, replay into
+    a succ-annotated op store, and serialize the canonical document chunk —
+    all in C++. `heads` are hex hash strings. Returns the container bytes,
+    or None when the log needs the Python path (link/child ops, unknown
+    columns, or no native codec)."""
+    lib = _load()
+    if lib is None or not change_buffers:
+        return None
+    bufs = [bytes(b) for b in change_buffers]
+    blob = b''.join(bufs)
+    lens = np.fromiter(map(len, bufs), dtype=np.uint64, count=len(bufs))
+    offsets = np.zeros(len(bufs), dtype=np.uint64)
+    if len(bufs) > 1:
+        np.cumsum(lens[:-1], out=offsets[1:])
+    heads_blob = b''.join(bytes.fromhex(h) for h in heads)
+    arr, ptr = _u8(blob)
+    harr, hptr = _u8(heads_blob)
+    u8p_ = ctypes.POINTER(ctypes.c_uint8)
+    u64p_ = ctypes.POINTER(ctypes.c_uint64)
+    lib.am_build_document.argtypes = [u8p_, u64p_, u64p_, ctypes.c_uint64,
+                                      u8p_, ctypes.c_uint64]
+    lib.am_build_document.restype = ctypes.c_int64
+    lib.am_build_fetch.argtypes = [u8p_, ctypes.c_uint64]
+    lib.am_build_fetch.restype = ctypes.c_int64
+    size = int(lib.am_build_document(
+        ptr, offsets.ctypes.data_as(u64p_), lens.ctypes.data_as(u64p_),
+        len(bufs), hptr, len(heads)))
+    if size < 0:
+        return None
+    out = np.zeros(max(size, 1), dtype=np.uint8)
+    got = int(lib.am_build_fetch(out.ctypes.data_as(u8p_), out.size))
+    if got != size:
+        return None
+    return out[:size].tobytes()

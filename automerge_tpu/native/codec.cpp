@@ -1829,3 +1829,1021 @@ int64_t am_docparse_fetch(
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Native document builder: change log -> canonical document container
+// (the mirror-free save of round-2 VERDICT item 8). Parses the engine's
+// binary changes (full op coverage), replays them into a succ-annotated op
+// store (the visibility model of ref new.js:1204-1217, RGA insertion of
+// new.js:145-163), and serializes the document chunk (ref
+// columnar.js:983-1004) with the same canonical change order and byte-exact
+// column encodings as the host engine's save() — no host mirror, no Python
+// per-op work. Bails (caller falls back to the Python path) on link/child
+// ops, unknown columns, or malformed histories.
+// ---------------------------------------------------------------------------
+
+#include <algorithm>
+#include <list>
+#include <map>
+#include <queue>
+
+namespace {
+
+// ---- byte-exact column encoders (mirroring automerge_tpu/encoding.py) ----
+
+struct ByteBuf {
+  std::vector<uint8_t> b;
+  void u8(uint8_t v) { b.push_back(v); }
+  void uleb(uint64_t v) {
+    do {
+      uint8_t byte = v & 0x7f;
+      v >>= 7;
+      b.push_back(byte | (v ? 0x80 : 0));
+    } while (v);
+  }
+  void sleb(int64_t v) {
+    bool more = true;
+    while (more) {
+      uint8_t byte = v & 0x7f;
+      v >>= 7;
+      if ((v == 0 && !(byte & 0x40)) || (v == -1 && (byte & 0x40)))
+        more = false;
+      b.push_back(byte | (more ? 0x80 : 0));
+    }
+  }
+  void raw(const uint8_t *p, size_t n) { b.insert(b.end(), p, p + n); }
+  void prefixed(const std::string &s) {
+    uleb(s.size());
+    raw((const uint8_t *)s.data(), s.size());
+  }
+};
+
+// RLE encoder over int64 values (uint/int wire flavors) or strings, with
+// nulls; exact state machine of encoding.py RLEEncoder.
+struct RleEnc {
+  enum Type { UINT, INT, UTF8 } type;
+  enum State { EMPTY, LONE, REP, LIT, NULLS } state = EMPTY;
+  ByteBuf out;
+  int64_t last_i = 0;
+  std::string last_s;
+  bool last_null = false;
+  uint64_t count = 0;
+  std::vector<std::pair<int64_t, std::string>> literal;
+
+  explicit RleEnc(Type t) : type(t) {}
+
+  void raw_value(int64_t vi, const std::string &vs) {
+    if (type == UINT) out.uleb(uint64_t(vi));
+    else if (type == INT) out.sleb(vi);
+    else out.prefixed(vs);
+  }
+  bool eq_last(bool is_null, int64_t vi, const std::string &vs) const {
+    if (last_null || is_null) return last_null == is_null;
+    return type == UTF8 ? last_s == vs : last_i == vi;
+  }
+  void set_last(bool is_null, int64_t vi, const std::string &vs) {
+    last_null = is_null;
+    last_i = vi;
+    last_s = vs;
+  }
+  void flush() {
+    if (state == LONE) {
+      out.sleb(-1);
+      raw_value(last_i, last_s);
+    } else if (state == REP) {
+      out.sleb(int64_t(count));
+      raw_value(last_i, last_s);
+    } else if (state == LIT) {
+      out.sleb(-int64_t(literal.size()));
+      for (auto &v : literal) raw_value(v.first, v.second);
+      literal.clear();
+    } else if (state == NULLS) {
+      out.sleb(0);
+      out.uleb(count);
+    }
+    state = EMPTY;
+  }
+  void append(bool is_null, int64_t vi, const std::string &vs,
+              uint64_t reps = 1) {
+    if (reps == 0) return;
+    if (state == EMPTY) {
+      state = is_null ? NULLS : (reps == 1 ? LONE : REP);
+      set_last(is_null, vi, vs);
+      count = reps;
+    } else if (state == LONE) {
+      if (is_null) {
+        flush(); state = NULLS; count = reps;
+      } else if (eq_last(false, vi, vs)) {
+        state = REP; count = 1 + reps;
+      } else if (reps > 1) {
+        flush(); state = REP; count = reps; set_last(false, vi, vs);
+      } else {
+        state = LIT;
+        literal.clear();
+        literal.emplace_back(last_i, last_s);
+        set_last(false, vi, vs);
+      }
+    } else if (state == REP) {
+      if (is_null) {
+        flush(); state = NULLS; count = reps;
+      } else if (eq_last(false, vi, vs)) {
+        count += reps;
+      } else if (reps > 1) {
+        flush(); state = REP; count = reps; set_last(false, vi, vs);
+      } else {
+        flush(); state = LONE; set_last(false, vi, vs);
+      }
+    } else if (state == LIT) {
+      if (is_null) {
+        literal.emplace_back(last_i, last_s);
+        flush(); state = NULLS; count = reps;
+      } else if (eq_last(false, vi, vs)) {
+        flush(); state = REP; count = 1 + reps;
+      } else if (reps > 1) {
+        literal.emplace_back(last_i, last_s);
+        flush(); state = REP; count = reps; set_last(false, vi, vs);
+      } else {
+        literal.emplace_back(last_i, last_s);
+        set_last(false, vi, vs);
+      }
+    } else {  // NULLS
+      if (is_null) {
+        count += reps;
+      } else if (reps > 1) {
+        flush(); state = REP; count = reps; set_last(false, vi, vs);
+      } else {
+        flush(); state = LONE; set_last(false, vi, vs);
+      }
+    }
+  }
+  void value(int64_t v) { append(false, v, std::string()); }
+  void str(const std::string &s) { append(false, 0, s); }
+  void null_() { append(true, 0, std::string()); }
+  void finish() {
+    if (state == LIT) literal.emplace_back(last_i, last_s);
+    // an all-null sequence encodes to nothing (encoding.py finish)
+    if (state != NULLS || !out.b.empty()) flush();
+  }
+};
+
+// Delta encoder: RLE('int') over successive differences (encoding.py).
+struct DeltaEnc {
+  RleEnc rle{RleEnc::INT};
+  int64_t absolute = 0;
+  void value(int64_t v) {
+    rle.append(false, v - absolute, std::string());
+    absolute = v;
+  }
+  void null_() { rle.null_(); }
+  void finish() { rle.finish(); }
+};
+
+// Boolean encoder: alternating false/true run lengths starting with false.
+struct BoolEnc {
+  ByteBuf out;
+  bool last = false;
+  uint64_t count = 0;
+  void value(bool v) {
+    if (last == v) {
+      count++;
+    } else {
+      out.uleb(count);
+      last = v;
+      count = 1;
+    }
+  }
+  void finish() {
+    if (count > 0) {
+      out.uleb(count);
+      count = 0;
+    }
+  }
+};
+
+// ---- parsed change / op store --------------------------------------------
+
+struct BOp {
+  int64_t ctr;                 // own opId counter
+  int32_t actor;               // own actor (doc-table number, hex-sorted)
+  uint8_t action;              // wire action 0..6
+  uint8_t insert;
+  int8_t key_kind;             // 0 = map key, 1 = _head, 2 = elemId
+  std::string key;             // map key (utf8)
+  int64_t ek_ctr = 0;          // elemId ref (insert: original referent;
+  int32_t ek_actor = -1;       //  update: target element)
+  int64_t obj_ctr = 0;         // containing object (0/-1 = root)
+  int32_t obj_actor = -1;
+  uint32_t vtag = 0;           // valLen tag (len<<4 | type)
+  uint64_t voff = 0;           // into BuildCtx::vals
+  std::vector<std::pair<int64_t, int32_t>> pred;
+};
+
+struct BChange {
+  std::string actor_hex;
+  int32_t actor = 0;
+  uint64_t seq = 0, start_op = 0;
+  int64_t time = 0;
+  std::string message;
+  std::vector<std::string> deps;     // dep hashes (hex)
+  std::string hash;                  // own hash (hex)
+  std::string extra;                 // change-level extra bytes
+  std::vector<BOp> ops;
+};
+
+struct BRow {
+  int64_t ctr;
+  int32_t actor;
+  uint8_t action;
+  uint8_t insert;
+  int8_t key_kind;
+  int64_t ek_ctr;
+  int32_t ek_actor;
+  uint32_t vtag;
+  uint64_t voff;
+  std::vector<std::pair<int64_t, int32_t>> succ;   // kept lamport-sorted
+};
+
+struct BElem {
+  int64_t ctr;
+  int32_t actor;
+  std::vector<BRow> rows;
+};
+
+struct BObj {
+  uint8_t type = 0;              // wire make action; root = 0 (map)
+  bool is_seq = false;
+  // map keys sorted by UTF-16 code units (op_set._utf16_key)
+  std::map<std::u16string, std::vector<BRow>> keys;
+  std::map<std::u16string, std::string> key_utf8;
+  std::list<BElem> elems;
+  std::unordered_map<int64_t, std::list<BElem>::iterator> elem_index;
+};
+
+struct BuildCtx {
+  std::vector<BChange> changes;
+  std::vector<std::string> actors;             // hex-sorted doc actor table
+  std::unordered_map<std::string, int32_t> actor_index;
+  std::map<std::pair<int64_t, int32_t>, BObj> objects;  // (ctr, actor)
+  BObj root;
+  std::vector<uint8_t> vals;                   // raw value bytes arena
+  std::vector<uint8_t> result;
+  std::string error;
+};
+
+static bool utf8_to_u16(const std::string &s, std::u16string &out) {
+  size_t i = 0;
+  out.clear();
+  while (i < s.size()) {
+    uint8_t b = s[i];
+    uint32_t cp;
+    size_t need;
+    if (b < 0x80) { cp = b; need = 1; }
+    else if ((b >> 5) == 6) { cp = b & 0x1f; need = 2; }
+    else if ((b >> 4) == 14) { cp = b & 0x0f; need = 3; }
+    else if ((b >> 3) == 30) { cp = b & 0x07; need = 4; }
+    else return false;
+    if (i + need > s.size()) return false;
+    for (size_t k = 1; k < need; k++) {
+      if ((uint8_t(s[i + k]) >> 6) != 2) return false;
+      cp = (cp << 6) | (uint8_t(s[i + k]) & 0x3f);
+    }
+    i += need;
+    if (cp >= 0x10000) {
+      cp -= 0x10000;
+      out.push_back(char16_t(0xd800 + (cp >> 10)));
+      out.push_back(char16_t(0xdc00 + (cp & 0x3ff)));
+    } else {
+      out.push_back(char16_t(cp));
+    }
+  }
+  return true;
+}
+
+static const char *kHex = "0123456789abcdef";
+
+static std::string to_hex(const uint8_t *p, size_t n) {
+  std::string s;
+  s.reserve(n * 2);
+  for (size_t i = 0; i < n; i++) {
+    s.push_back(kHex[p[i] >> 4]);
+    s.push_back(kHex[p[i] & 15]);
+  }
+  return s;
+}
+
+// Parse one change chunk (full op coverage; link/child/unknown bail).
+// Pass 1 (actors_only): just collect the author hex id.
+static bool build_parse_change(BuildCtx &ctx, const uint8_t *chunk,
+                               uint64_t chunk_len, bool actors_only,
+                               std::vector<uint8_t> &inflate_scratch) {
+  // container: magic, checksum, type, length
+  if (chunk_len < 11) return false;
+  if (memcmp(chunk, "\x85\x6f\x4a\x83", 4) != 0) return false;
+  uint8_t chunk_type = chunk[8];
+  if (chunk_type == 2) {  // deflated change: inflate body, rebuild chunk
+    Cursor c{chunk, chunk_len};
+    c.skip(9);
+    uint64_t blen = c.uleb();
+    const uint8_t *body = c.bytes(blen);
+    if (c.fail || c.pos != chunk_len) return false;
+    std::vector<uint8_t> raw;
+    if (!inflate_vec(body, blen, raw)) return false;
+    // Reconstruct the uncompressed chunk (magic + original checksum +
+    // type 1 + LEB length + inflated body): the change hash is defined
+    // over exactly these bytes (columnar.js:688-708). The recursive call
+    // sees chunk type 1 and never touches the scratch it is reading from.
+    std::vector<uint8_t> rebuilt(chunk, chunk + 8);
+    rebuilt.push_back(1);
+    uint64_t v = raw.size();
+    do {
+      uint8_t byte = v & 0x7f;
+      v >>= 7;
+      rebuilt.push_back(byte | (v ? 0x80 : 0));
+    } while (v);
+    rebuilt.insert(rebuilt.end(), raw.begin(), raw.end());
+    return build_parse_change(ctx, rebuilt.data(), rebuilt.size(),
+                              actors_only, inflate_scratch);
+  }
+  if (chunk_type != 1) return false;
+  Cursor c{chunk, chunk_len};
+  c.skip(8);
+  uint64_t hash_start = c.pos;
+  c.skip(1);
+  uint64_t body_len = c.uleb();
+  const uint8_t *body = c.bytes(body_len);
+  if (c.fail || c.pos != chunk_len) return false;
+
+  BChange ch;
+  {
+    uint8_t digest[32];
+    Sha256Stream s;
+    sha256_stream_init(s);
+    sha256_stream_update(s, chunk + hash_start, c.pos - hash_start);
+    sha256_stream_final(s, digest);
+    ch.hash = to_hex(digest, 32);
+  }
+
+  Cursor b{body, body_len};
+  uint64_t n_deps = b.uleb();
+  for (uint64_t i = 0; i < n_deps; i++) {
+    const uint8_t *h = b.bytes(32);
+    if (b.fail) return false;
+    ch.deps.push_back(to_hex(h, 32));
+  }
+  uint64_t alen = b.uleb();
+  const uint8_t *araw = b.bytes(alen);
+  if (b.fail) return false;
+  ch.actor_hex = to_hex(araw, alen);
+  ch.seq = b.uleb();
+  ch.start_op = b.uleb();
+  ch.time = b.sleb();
+  uint64_t mlen = b.uleb();
+  const uint8_t *mraw = b.bytes(mlen);
+  if (b.fail) return false;
+  ch.message.assign((const char *)mraw, mlen);
+  // other actors referenced by this change's op columns
+  std::vector<std::string> chg_actors{ch.actor_hex};
+  uint64_t n_more = b.uleb();
+  for (uint64_t i = 0; i < n_more; i++) {
+    uint64_t l = b.uleb();
+    const uint8_t *p = b.bytes(l);
+    if (b.fail) return false;
+    chg_actors.push_back(to_hex(p, l));
+  }
+  if (actors_only) {
+    ctx.changes.push_back(std::move(ch));
+    return true;
+  }
+
+  // column info + buffers
+  std::vector<DocColumn> cols;
+  uint64_t n_cols = b.uleb();
+  if (b.fail) return false;
+  for (uint64_t i = 0; i < n_cols; i++) {
+    DocColumn col;
+    col.id = uint32_t(b.uleb());
+    col.len = b.uleb();
+    if (b.fail) return false;
+    cols.push_back(col);
+  }
+  for (auto &col : cols) {
+    col.buf = b.bytes(col.len);
+    if (b.fail) return false;
+    if (col.id & kDeflateBit) {
+      if (!inflate_vec(col.buf, col.len, col.inflated)) return false;
+      col.id &= ~uint32_t(kDeflateBit);
+      col.buf = col.inflated.data();
+      col.len = col.inflated.size();
+    }
+  }
+  if (b.pos != b.len) {
+    // change-level extraBytes: preserved through the changes columns
+    ch.extra.assign((const char *)(body + b.pos), body_len - b.pos);
+  }
+  for (auto &col : cols) {
+    switch (col.id) {
+      case kColObjActor: case kColObjCtr: case kColKeyActor: case kColKeyCtr:
+      case kColKeyStr: case kColInsert: case kColAction: case kColValLen:
+      case kColValRaw: case kColPredNum: case kColPredActor: case kColPredCtr:
+        break;
+      case kColChldActor: case kColChldCtr:
+        if (col.len > 0) return false;   // link/child ops: Python path
+        break;
+      default:
+        return false;                    // unknown columns: Python path
+    }
+  }
+  auto find = [&](uint32_t id) -> DocColumn * {
+    for (auto &col : cols) if (col.id == id) return &col;
+    return nullptr;
+  };
+  auto dec = [&](uint32_t id, bool sgn, bool delta, std::vector<int64_t> &v,
+                 std::vector<uint8_t> &m) {
+    DocColumn *col = find(id);
+    if (!col) { v.clear(); m.clear(); return true; }
+    return decode_i64_col(col->buf, col->len, sgn, delta, v, m);
+  };
+  std::vector<int64_t> obj_a, obj_c, key_a, key_c, act_v, vlen_v, pn, pa, pc;
+  std::vector<uint8_t> obj_am, obj_cm, key_am, key_cm, act_m, vlen_m, pnm,
+      pam, pcm;
+  if (!dec(kColObjActor, false, false, obj_a, obj_am)) return false;
+  if (!dec(kColObjCtr, false, false, obj_c, obj_cm)) return false;
+  if (!dec(kColKeyActor, false, false, key_a, key_am)) return false;
+  if (!dec(kColKeyCtr, false, true, key_c, key_cm)) return false;
+  if (!dec(kColAction, false, false, act_v, act_m)) return false;
+  if (!dec(kColValLen, false, false, vlen_v, vlen_m)) return false;
+  if (!dec(kColPredNum, false, false, pn, pnm)) return false;
+  if (!dec(kColPredActor, false, false, pa, pam)) return false;
+  if (!dec(kColPredCtr, false, true, pc, pcm)) return false;
+  size_t n_ops = act_v.size();
+  std::vector<int64_t> ins_v(n_ops);
+  std::vector<uint8_t> ins_m(n_ops);
+  {
+    DocColumn *col = find(kColInsert);
+    if (col) {
+      if (am_decode_boolean(col->buf, col->len, ins_v.data(), ins_m.data(),
+                            int64_t(n_ops)) != int64_t(n_ops))
+        return false;
+    } else if (n_ops) {
+      return false;
+    }
+  }
+  // keyStr: decode to per-op strings (-1 = null)
+  std::vector<int32_t> kstr(n_ops, -1);
+  Interner local_keys;
+  {
+    DocColumn *col = find(kColKeyStr);
+    if (col) {
+      std::vector<int32_t> tmp;
+      if (!decode_keystr(col->buf, col->len, local_keys, tmp)) return false;
+      if (tmp.size() != n_ops) return false;
+      kstr = tmp;
+    }
+  }
+  auto pad = [&](std::vector<int64_t> &v, std::vector<uint8_t> &m) {
+    if (v.empty()) { v.assign(n_ops, 0); m.assign(n_ops, 0); }
+    return v.size() == n_ops;
+  };
+  if (!pad(obj_a, obj_am) || !pad(obj_c, obj_cm) || !pad(key_a, key_am) ||
+      !pad(key_c, key_cm) || !pad(vlen_v, vlen_m) || !pad(pn, pnm))
+    return false;
+  uint64_t pred_total = 0;
+  for (size_t i = 0; i < n_ops; i++)
+    pred_total += pnm[i] ? uint64_t(pn[i]) : 0;
+  if (pa.size() != pred_total || pc.size() != pred_total) return false;
+  DocColumn *vraw = find(kColValRaw);
+  const uint8_t *raw_buf = vraw ? vraw->buf : nullptr;
+  uint64_t raw_len = vraw ? vraw->len : 0;
+
+  auto remap = [&](int64_t local) -> int32_t {
+    if (local < 0 || uint64_t(local) >= chg_actors.size()) return -1;
+    auto it = ctx.actor_index.find(chg_actors[size_t(local)]);
+    return it == ctx.actor_index.end() ? -1 : it->second;
+  };
+  uint64_t raw_pos = 0, pred_pos = 0;
+  for (size_t i = 0; i < n_ops; i++) {
+    if (!act_m[i]) return false;
+    // actions 0..6 only (7 = link and above need the Python path)
+    if (act_v[i] < 0 || act_v[i] > 6) return false;
+    BOp op;
+    op.ctr = int64_t(ch.start_op + i);
+    op.actor = remap(0);           // own ops are always by the change actor
+    op.action = uint8_t(act_v[i]);
+    op.insert = uint8_t(ins_m[i] ? ins_v[i] : 0);
+    if (op.actor < 0) return false;
+    // object
+    if (obj_am[i] != obj_cm[i]) return false;
+    if (obj_am[i]) {
+      op.obj_ctr = obj_c[i];
+      op.obj_actor = remap(obj_a[i]);
+      if (op.obj_actor < 0) return false;
+    }
+    // key
+    if (kstr[i] >= 0) {
+      if (key_am[i] || (key_cm[i])) return false;
+      op.key_kind = 0;
+      op.key = local_keys.items[size_t(kstr[i])];
+    } else if (key_cm[i] && key_c[i] == 0 && !key_am[i]) {
+      op.key_kind = 1;   // _head
+    } else if (key_cm[i] && key_am[i]) {
+      op.key_kind = 2;
+      op.ek_ctr = key_c[i];
+      op.ek_actor = remap(key_a[i]);
+      if (op.ek_actor < 0) return false;
+    } else {
+      return false;
+    }
+    // value
+    if (vlen_m[i]) {
+      uint64_t tag = uint64_t(vlen_v[i]);
+      uint32_t ln = uint32_t(tag >> 4);
+      if (raw_pos + ln > raw_len) return false;
+      op.vtag = uint32_t(tag);
+      op.voff = ctx.vals.size();
+      ctx.vals.insert(ctx.vals.end(), raw_buf + raw_pos,
+                      raw_buf + raw_pos + ln);
+      raw_pos += ln;
+    } else {
+      op.vtag = 0;       // VALUE_TYPE NULL, zero length
+      op.voff = ctx.vals.size();
+    }
+    // preds
+    uint64_t np = pnm[i] ? uint64_t(pn[i]) : 0;
+    for (uint64_t k = 0; k < np; k++, pred_pos++) {
+      if (!pam[pred_pos] || !pcm[pred_pos]) return false;
+      int32_t pactor = remap(pa[pred_pos]);
+      if (pactor < 0) return false;
+      op.pred.emplace_back(pc[pred_pos], pactor);
+    }
+    ch.ops.push_back(std::move(op));
+  }
+  if (raw_pos != raw_len || pred_pos != pred_total) return false;
+  ctx.changes.push_back(std::move(ch));
+  return true;
+}
+
+}  // namespace
+
+namespace {
+
+static inline int64_t elem_key(int64_t ctr, int32_t actor) {
+  return (ctr << 8) | int64_t(actor & 0xff);
+}
+
+static inline bool lamport_lt(int64_t c1, int32_t a1, int64_t c2,
+                              int32_t a2) {
+  // actor numbers are hex-sorted doc-table indexes, so (ctr, num) ordering
+  // equals the reference's (counter, actorId-string) lamportCompare
+  return c1 != c2 ? c1 < c2 : a1 < a2;
+}
+
+static BObj *build_resolve_obj(BuildCtx &ctx, int64_t ctr, int32_t actor) {
+  if (actor < 0) return &ctx.root;
+  auto it = ctx.objects.find({ctr, actor});
+  return it == ctx.objects.end() ? nullptr : &it->second;
+}
+
+static BRow build_row_from(const BOp &op) {
+  BRow r;
+  r.ctr = op.ctr;
+  r.actor = op.actor;
+  r.action = op.action;
+  r.insert = op.insert;
+  r.key_kind = op.key_kind;
+  r.ek_ctr = op.ek_ctr;
+  r.ek_actor = op.ek_actor;
+  r.vtag = op.vtag;
+  r.voff = op.voff;
+  return r;
+}
+
+// Apply one op to the store (host op_set._apply_op minus patches):
+// succ marking on preds, lamport-sorted row insertion, RGA element splice
+// with the concurrent-insert skip (ref new.js:145-163, :1204-1217).
+static bool build_apply_op(BuildCtx &ctx, const BOp &op, std::string &key16buf) {
+  if (op.action == 0 || op.action == 2 || op.action == 4 || op.action == 6) {
+    BObj obj;
+    obj.type = op.action;
+    obj.is_seq = (op.action == 2 || op.action == 4);
+    auto ins = ctx.objects.emplace(std::make_pair(op.ctr, op.actor),
+                                   std::move(obj));
+    if (!ins.second) return false;        // duplicate objectId
+  }
+  BObj *parent = build_resolve_obj(ctx, op.obj_ctr, op.obj_actor);
+  if (!parent) return false;
+
+  if (op.insert) {
+    if (!parent->is_seq || op.key_kind == 0) return false;
+    std::list<BElem>::iterator pos;
+    if (op.key_kind == 1) {
+      pos = parent->elems.begin();
+    } else {
+      auto it = parent->elem_index.find(elem_key(op.ek_ctr, op.ek_actor));
+      if (it == parent->elem_index.end()) return false;
+      pos = std::next(it->second);
+    }
+    // concurrent-insert skip: pass elems whose id is greater than ours
+    while (pos != parent->elems.end() &&
+           lamport_lt(op.ctr, op.actor, pos->ctr, pos->actor))
+      ++pos;
+    BElem elem;
+    elem.ctr = op.ctr;
+    elem.actor = op.actor;
+    if (!op.pred.empty()) return false;    // inserts carry no preds
+    elem.rows.push_back(build_row_from(op));
+    auto at = parent->elems.insert(pos, std::move(elem));
+    if (!parent->elem_index.emplace(elem_key(op.ctr, op.actor), at).second)
+      return false;                        // duplicate elemId
+    return true;
+  }
+
+  // update (set / del / inc / make-at-key)
+  std::vector<BRow> *rows;
+  if (parent->is_seq) {
+    if (op.key_kind != 2) return false;
+    auto it = parent->elem_index.find(elem_key(op.ek_ctr, op.ek_actor));
+    if (it == parent->elem_index.end()) return false;  // missing referent
+    rows = &it->second->rows;
+  } else {
+    if (op.key_kind != 0) return false;
+    std::u16string k16;
+    if (!utf8_to_u16(op.key, k16)) return false;
+    auto it = parent->keys.find(k16);
+    if (it == parent->keys.end()) {
+      it = parent->keys.emplace(k16, std::vector<BRow>()).first;
+      parent->key_utf8.emplace(k16, op.key);
+    }
+    rows = &it->second;
+  }
+  // mark succ on preds (kept lamport-sorted), detect duplicates
+  size_t seen = 0;
+  for (auto &row : *rows) {
+    if (row.ctr == op.ctr && row.actor == op.actor) return false;  // dup id
+    for (auto &p : op.pred) {
+      if (row.ctr == p.first && row.actor == p.second) {
+        auto s = std::make_pair(op.ctr, int64_t(op.actor));
+        auto at = std::lower_bound(
+            row.succ.begin(), row.succ.end(),
+            std::make_pair(op.ctr, op.actor),
+            [](const std::pair<int64_t, int32_t> &x,
+               const std::pair<int64_t, int32_t> &y) {
+              return lamport_lt(x.first, x.second, y.first, y.second);
+            });
+        row.succ.insert(at, {op.ctr, op.actor});
+        (void)s;
+        seen++;
+      }
+    }
+  }
+  if (seen != op.pred.size()) return false;   // pred with no matching op
+  if (op.action != 3) {                       // dels are succ-only
+    auto at = std::lower_bound(
+        rows->begin(), rows->end(), op,
+        [](const BRow &r, const BOp &o) {
+          return lamport_lt(r.ctr, r.actor, o.ctr, o.actor);
+        });
+    rows->insert(at, build_row_from(op));
+  }
+  return true;
+}
+
+// Canonical change order: Kahn topological traversal, ties broken on hash,
+// with implicit per-actor seq edges (mirrors op_set._canonical_change_order).
+static bool build_canonical_order(BuildCtx &ctx, std::vector<size_t> &order) {
+  size_t n = ctx.changes.size();
+  std::unordered_map<std::string, size_t> by_hash;
+  for (size_t i = 0; i < n; i++) by_hash[ctx.changes[i].hash] = i;
+  std::vector<std::vector<size_t>> children(n);
+  std::vector<size_t> indeg(n, 0);
+  for (size_t i = 0; i < n; i++) {
+    for (auto &dep : ctx.changes[i].deps) {
+      auto it = by_hash.find(dep);
+      if (it == by_hash.end()) return false;
+      children[it->second].push_back(i);
+      indeg[i]++;
+    }
+  }
+  std::unordered_map<std::string, std::vector<size_t>> by_actor;
+  for (size_t i = 0; i < n; i++)
+    by_actor[ctx.changes[i].actor_hex].push_back(i);
+  for (auto &kv : by_actor) {
+    auto idxs = kv.second;
+    std::sort(idxs.begin(), idxs.end(), [&](size_t a, size_t b) {
+      return ctx.changes[a].seq < ctx.changes[b].seq;
+    });
+    for (size_t k = 0; k + 1 < idxs.size(); k++) {
+      children[idxs[k]].push_back(idxs[k + 1]);
+      indeg[idxs[k + 1]]++;
+    }
+  }
+  using HI = std::pair<std::string, size_t>;
+  std::priority_queue<HI, std::vector<HI>, std::greater<HI>> heap;
+  for (size_t i = 0; i < n; i++)
+    if (indeg[i] == 0) heap.push({ctx.changes[i].hash, i});
+  order.clear();
+  while (!heap.empty()) {
+    size_t i = heap.top().second;
+    heap.pop();
+    order.push_back(i);
+    for (size_t c : children[i])
+      if (--indeg[c] == 0) heap.push({ctx.changes[c].hash, c});
+  }
+  return order.size() == n;
+}
+
+static void emit_doc_row(const BRow &r, int64_t obj_ctr, int32_t obj_actor,
+                         const std::string *map_key, BuildCtx &ctx,
+                         RleEnc &obj_a, RleEnc &obj_c, RleEnc &key_a,
+                         DeltaEnc &key_c, RleEnc &key_s, BoolEnc &ins,
+                         RleEnc &act, RleEnc &vlen, ByteBuf &vraw,
+                         RleEnc &chld_a, DeltaEnc &chld_c, RleEnc &id_a,
+                         DeltaEnc &id_c, RleEnc &succ_n, RleEnc &succ_a,
+                         DeltaEnc &succ_c) {
+  if (obj_actor < 0) {
+    obj_a.null_();
+    obj_c.null_();
+  } else {
+    obj_a.value(obj_actor);
+    obj_c.value(obj_ctr);
+  }
+  if (map_key) {
+    key_a.null_();
+    key_c.null_();
+    key_s.str(*map_key);
+  } else if (r.insert && r.key_kind == 1) {
+    key_a.null_();
+    key_c.value(0);
+    key_s.null_();
+  } else {
+    key_a.value(r.key_kind == 2 ? r.ek_actor : r.actor);
+    key_c.value(r.key_kind == 2 ? r.ek_ctr : r.ctr);
+    key_s.null_();
+  }
+  ins.value(bool(r.insert));
+  act.value(r.action);
+  uint32_t ln = r.vtag >> 4;
+  vlen.value(int64_t(r.vtag));
+  if (ln) vraw.raw(ctx.vals.data() + r.voff, ln);
+  chld_a.null_();
+  chld_c.null_();
+  id_a.value(r.actor);
+  id_c.value(r.ctr);
+  succ_n.value(int64_t(r.succ.size()));
+  for (auto &s : r.succ) {
+    succ_a.value(s.second);
+    succ_c.value(s.first);
+  }
+}
+
+static void deflate_maybe(uint32_t cid, std::vector<uint8_t> &buf,
+                          std::vector<std::pair<uint32_t,
+                                                std::vector<uint8_t>>> &cols) {
+  if (buf.empty()) return;
+  if (buf.size() >= 256) {
+    z_stream zs;
+    memset(&zs, 0, sizeof(zs));
+    if (deflateInit2(&zs, 6, Z_DEFLATED, -15, 8, Z_DEFAULT_STRATEGY) == Z_OK) {
+      std::vector<uint8_t> out(deflateBound(&zs, buf.size()));
+      zs.next_in = buf.data();
+      zs.avail_in = uInt(buf.size());
+      zs.next_out = out.data();
+      zs.avail_out = uInt(out.size());
+      if (deflate(&zs, Z_FINISH) == Z_STREAM_END) {
+        out.resize(out.size() - zs.avail_out);
+        deflateEnd(&zs);
+        cols.emplace_back(cid | 8u, std::move(out));
+        return;
+      }
+      deflateEnd(&zs);
+    }
+  }
+  cols.emplace_back(cid, std::move(buf));
+}
+
+static bool build_serialize(BuildCtx &ctx,
+                            const std::vector<std::string> &heads) {
+  std::vector<size_t> order;
+  if (!build_canonical_order(ctx, order)) return false;
+  std::unordered_map<std::string, size_t> canon;
+  for (size_t pos = 0; pos < order.size(); pos++)
+    canon[ctx.changes[order[pos]].hash] = pos;
+
+  // ---- ops columns in document order ----
+  RleEnc obj_a(RleEnc::UINT), obj_c(RleEnc::UINT), key_a(RleEnc::UINT),
+      key_s(RleEnc::UTF8), act(RleEnc::UINT), vlen(RleEnc::UINT),
+      chld_a(RleEnc::UINT), id_a(RleEnc::UINT), succ_n(RleEnc::UINT),
+      succ_a(RleEnc::UINT);
+  DeltaEnc key_c, chld_c, id_c, succ_c;
+  BoolEnc ins;
+  ByteBuf vraw;
+
+  auto emit_obj = [&](BObj &obj, int64_t octr, int32_t oactor) {
+    if (obj.is_seq) {
+      for (auto &elem : obj.elems)
+        for (auto &r : elem.rows)
+          emit_doc_row(r, octr, oactor, nullptr, ctx, obj_a, obj_c, key_a,
+                       key_c, key_s, ins, act, vlen, vraw, chld_a, chld_c,
+                       id_a, id_c, succ_n, succ_a, succ_c);
+    } else {
+      for (auto &kv : obj.keys) {
+        const std::string &key = obj.key_utf8[kv.first];
+        for (auto &r : kv.second)
+          emit_doc_row(r, octr, oactor, &key, ctx, obj_a, obj_c, key_a,
+                       key_c, key_s, ins, act, vlen, vraw, chld_a, chld_c,
+                       id_a, id_c, succ_n, succ_a, succ_c);
+      }
+    }
+  };
+  emit_obj(ctx.root, 0, -1);
+  for (auto &kv : ctx.objects)
+    emit_obj(kv.second, kv.first.first, kv.first.second);
+
+  // ---- changes metadata columns in canonical order ----
+  RleEnc m_actor(RleEnc::UINT), m_msg(RleEnc::UTF8), m_depsn(RleEnc::UINT),
+      m_extral(RleEnc::UINT);
+  DeltaEnc m_seq, m_maxop, m_time, m_depsi;
+  ByteBuf m_extrar;
+  for (size_t pos = 0; pos < order.size(); pos++) {
+    BChange &ch = ctx.changes[order[pos]];
+    auto it = ctx.actor_index.find(ch.actor_hex);
+    if (it == ctx.actor_index.end()) return false;
+    m_actor.value(it->second);
+    m_seq.value(int64_t(ch.seq));
+    m_maxop.value(int64_t(ch.start_op + ch.ops.size() - 1));
+    m_time.value(ch.time);
+    m_msg.str(ch.message);
+    std::vector<std::string> deps = ch.deps;
+    std::sort(deps.begin(), deps.end());
+    m_depsn.value(int64_t(deps.size()));
+    for (auto &dep : deps) {
+      auto d = canon.find(dep);
+      if (d == canon.end()) return false;
+      m_depsi.value(int64_t(d->second));
+    }
+    if (!ch.extra.empty()) {
+      m_extrar.raw((const uint8_t *)ch.extra.data(), ch.extra.size());
+      m_extral.value(int64_t((ch.extra.size() << 4) | 7));  // BYTES
+    } else {
+      m_extral.value(7);                                    // BYTES, len 0
+    }
+  }
+
+  // ---- assemble container ----
+  for (RleEnc *e : {&obj_a, &obj_c, &key_a, &key_s, &act, &vlen, &chld_a,
+                    &id_a, &succ_n, &succ_a, &m_actor, &m_msg, &m_depsn,
+                    &m_extral})
+    e->finish();
+  for (DeltaEnc *e : {&key_c, &chld_c, &id_c, &succ_c, &m_seq, &m_maxop,
+                      &m_time, &m_depsi})
+    e->finish();
+  ins.finish();
+
+  using Col = std::pair<uint32_t, std::vector<uint8_t>>;
+  std::vector<Col> ccols, ocols;
+  deflate_maybe(0x01, m_actor.out.b, ccols);
+  deflate_maybe(0x03, m_seq.rle.out.b, ccols);
+  deflate_maybe(0x13, m_maxop.rle.out.b, ccols);
+  deflate_maybe(0x23, m_time.rle.out.b, ccols);
+  deflate_maybe(0x35, m_msg.out.b, ccols);
+  deflate_maybe(0x40, m_depsn.out.b, ccols);
+  deflate_maybe(0x43, m_depsi.rle.out.b, ccols);
+  deflate_maybe(0x56, m_extral.out.b, ccols);
+  deflate_maybe(0x57, m_extrar.b, ccols);
+  deflate_maybe(kColObjActor, obj_a.out.b, ocols);
+  deflate_maybe(kColObjCtr, obj_c.out.b, ocols);
+  deflate_maybe(kColKeyActor, key_a.out.b, ocols);
+  deflate_maybe(kColKeyCtr, key_c.rle.out.b, ocols);
+  deflate_maybe(kColKeyStr, key_s.out.b, ocols);
+  deflate_maybe(kColInsert, ins.out.b, ocols);
+  deflate_maybe(kColAction, act.out.b, ocols);
+  deflate_maybe(kColValLen, vlen.out.b, ocols);
+  deflate_maybe(kColValRaw, vraw.b, ocols);
+  deflate_maybe(kColChldActor, chld_a.out.b, ocols);
+  deflate_maybe(kColChldCtr, chld_c.rle.out.b, ocols);
+  deflate_maybe(kColIdActor, id_a.out.b, ocols);
+  deflate_maybe(kColIdCtr, id_c.rle.out.b, ocols);
+  deflate_maybe(kColSuccNum, succ_n.out.b, ocols);
+  deflate_maybe(kColSuccActor, succ_a.out.b, ocols);
+  deflate_maybe(kColSuccCtr, succ_c.rle.out.b, ocols);
+  auto by_id = [](const Col &a, const Col &b) {
+    return (a.first & ~8u) < (b.first & ~8u);
+  };
+  std::sort(ccols.begin(), ccols.end(), by_id);
+  std::sort(ocols.begin(), ocols.end(), by_id);
+
+  ByteBuf body;
+  body.uleb(ctx.actors.size());
+  for (auto &a : ctx.actors) {
+    body.uleb(a.size() / 2);
+    for (size_t i = 0; i + 1 < a.size(); i += 2) {
+      auto nib = [](char ch) -> uint8_t {
+        return ch <= '9' ? ch - '0' : ch - 'a' + 10;
+      };
+      body.u8(uint8_t(nib(a[i]) << 4 | nib(a[i + 1])));
+    }
+  }
+  std::vector<std::string> sheads = heads;
+  std::sort(sheads.begin(), sheads.end());
+  body.uleb(sheads.size());
+  for (auto &h : sheads) {
+    for (size_t i = 0; i + 1 < h.size(); i += 2) {
+      auto nib = [](char ch) -> uint8_t {
+        return ch <= '9' ? ch - '0' : ch - 'a' + 10;
+      };
+      body.u8(uint8_t(nib(h[i]) << 4 | nib(h[i + 1])));
+    }
+  }
+  auto col_info = [&](std::vector<Col> &cols) {
+    body.uleb(cols.size());
+    for (auto &c : cols) {
+      body.uleb(c.first);
+      body.uleb(c.second.size());
+    }
+  };
+  col_info(ccols);
+  col_info(ocols);
+  for (auto &c : ccols) body.raw(c.second.data(), c.second.size());
+  for (auto &c : ocols) body.raw(c.second.data(), c.second.size());
+  for (auto &h : sheads) {
+    auto d = canon.find(h);
+    if (d == canon.end()) return false;
+    body.uleb(d->second);
+  }
+
+  ByteBuf chunk;
+  chunk.u8(0);
+  chunk.uleb(body.b.size());
+  chunk.raw(body.b.data(), body.b.size());
+  uint8_t digest[32];
+  {
+    Sha256Stream s;
+    sha256_stream_init(s);
+    sha256_stream_update(s, chunk.b.data(), chunk.b.size());
+    sha256_stream_final(s, digest);
+  }
+  ctx.result.clear();
+  const uint8_t magic[4] = {0x85, 0x6f, 0x4a, 0x83};
+  ctx.result.insert(ctx.result.end(), magic, magic + 4);
+  ctx.result.insert(ctx.result.end(), digest, digest + 4);
+  ctx.result.insert(ctx.result.end(), chunk.b.begin(), chunk.b.end());
+  return true;
+}
+
+static BuildCtx *g_build = nullptr;
+
+}  // namespace
+
+extern "C" {
+
+// Build a canonical document container from a doc's change log (application
+// order) + current heads (32 bytes each). Returns the result byte size, or
+// -1 when the log needs the Python path (link/child/unknown columns,
+// malformed history). Fetch with am_build_fetch.
+int64_t am_build_document(const uint8_t *blob, const uint64_t *offsets,
+                          const uint64_t *lens, uint64_t n_changes,
+                          const uint8_t *heads, uint64_t n_heads) {
+  delete g_build;
+  g_build = new BuildCtx();
+  BuildCtx &ctx = *g_build;
+  std::vector<uint8_t> scratch;
+  // pass 1: authors -> hex-sorted doc actor table
+  for (uint64_t i = 0; i < n_changes; i++) {
+    if (!build_parse_change(ctx, blob + offsets[i], lens[i], true, scratch))
+      return -1;
+  }
+  std::vector<std::string> authors;
+  for (auto &ch : ctx.changes) authors.push_back(ch.actor_hex);
+  std::sort(authors.begin(), authors.end());
+  authors.erase(std::unique(authors.begin(), authors.end()), authors.end());
+  ctx.actors = authors;
+  for (size_t i = 0; i < ctx.actors.size(); i++)
+    ctx.actor_index[ctx.actors[i]] = int32_t(i);
+  ctx.changes.clear();
+  // pass 2: full parse with doc-table actor numbers
+  for (uint64_t i = 0; i < n_changes; i++) {
+    if (!build_parse_change(ctx, blob + offsets[i], lens[i], false, scratch))
+      return -1;
+  }
+  // replay into the op store
+  std::string k16;
+  for (auto &ch : ctx.changes)
+    for (auto &op : ch.ops)
+      if (!build_apply_op(ctx, op, k16)) return -1;
+  std::vector<std::string> head_hex;
+  for (uint64_t i = 0; i < n_heads; i++)
+    head_hex.push_back(to_hex(heads + 32 * i, 32));
+  if (!build_serialize(ctx, head_hex)) return -1;
+  return int64_t(ctx.result.size());
+}
+
+int64_t am_build_fetch(uint8_t *out, uint64_t cap) {
+  if (!g_build) return -1;
+  if (g_build->result.size() > cap) return -1;
+  memcpy(out, g_build->result.data(), g_build->result.size());
+  int64_t n = int64_t(g_build->result.size());
+  delete g_build;
+  g_build = nullptr;
+  return n;
+}
+
+}  // extern "C"

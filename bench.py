@@ -535,6 +535,41 @@ def bench_bulk_load(n_docs, n_changes=40, seed=0):
     return bulk, host
 
 
+def bench_native_save(n_changes=200, seed=0):
+    """Mirror-free native save (C++ change-log replay + canonical encode)
+    vs the host OpSet replay + Python encode, same change log. Returns
+    (native saves/s, host saves/s) or (None, host) without the codec."""
+    from automerge_tpu import native
+    from automerge_tpu import backend as Backend
+    from automerge_tpu.backend.op_set import OpSet
+    from automerge_tpu.columnar import encode_change, decode_change_meta
+    rng = np.random.default_rng(seed)
+    A = 'cc' * 16
+    changes, heads = [], []
+    for c in range(n_changes):
+        ops = [{'action': 'set', 'obj': '_root', 'key': f'k{int(k)}',
+                'value': int(rng.integers(0, 1 << 20)), 'datatype': 'int',
+                'pred': []} for k in rng.integers(0, 64, size=8)]
+        buf = encode_change({'actor': A, 'seq': c + 1, 'startOp': c * 8 + 1,
+                             'time': 0, 'message': '', 'deps': heads,
+                             'ops': ops})
+        heads = [decode_change_meta(buf, True)['hash']]
+        changes.append(buf)
+
+    def run_host():
+        ops = OpSet()
+        ops.apply_changes(list(changes))
+        ops.binary_doc = None
+        ops.save()
+    host = median_rate(run_host, 1, reps=3)
+    if not native.available():
+        return None, host
+
+    def run_native():
+        assert native.build_document(changes, heads) is not None
+    return median_rate(run_native, 1, reps=3), host
+
+
 def main():
     n_docs = int(os.environ.get('BENCH_DOCS', 10000))
     n_keys = int(os.environ.get('BENCH_KEYS', 1000))
@@ -579,6 +614,8 @@ def main():
     # per-doc Python decode + host replay path
     bulk_rate, perdoc_rate = bench_bulk_load(
         int(os.environ.get('BENCH_LOAD_DOCS', 2000)))
+    save_native, save_host = bench_native_save(
+        int(os.environ.get('BENCH_SAVE_CHANGES', 200)))
 
     print(f'# HEADLINE backend-seam end-to-end (turbo, incl. hash graph): '
           f'{seam_rate:.0f} changes/s (median of {REPS})', file=sys.stderr)
@@ -610,6 +647,11 @@ def main():
     else:
         print(f'# bulk document load: native codec unavailable '
               f'(per-doc path {perdoc_rate:.0f} docs/s)', file=sys.stderr)
+    if save_native is not None:
+        print(f'# mirror-free native save (200-change log): '
+              f'{save_native:.1f} saves/s vs host replay+encode '
+              f'{save_host:.1f} saves/s ({save_native / save_host:.1f}x)',
+              file=sys.stderr)
 
     result = {
         'metric': 'changes_per_sec_backend_seam_e2e',

@@ -160,3 +160,120 @@ class TestIngestPipeline:
                 {'action': 'makeList', 'obj': '_root', 'key': 'l', 'pred': []}]})
         with pytest.raises(ValueError):
             changes_to_op_batch([[change]], KeyInterner(), ActorInterner())
+
+
+class TestBuildDocument:
+    """Native mirror-free save (am_build_document): byte-identical to the
+    host OpSet's canonical save() on the same change log."""
+
+    def _assert_native_matches_host(self, doc):
+        import automerge_tpu as A
+        from automerge_tpu import backend as Backend
+        host_bytes = bytes(A.save(doc))
+        changes = [bytes(c) for c in A.get_all_changes(doc)]
+        hb = Backend.load(host_bytes)
+        built = native.build_document(changes, Backend.get_heads(hb))
+        assert built is not None
+        assert built == host_bytes
+
+    def test_corpus(self):
+        import automerge_tpu as A
+        A1, A2 = '01' * 8, '89' * 8
+        docs = []
+        d = A.from_({'x': 1, 's': 'str', 'c': A.Counter(3), 'f': 1.5,
+                     'b': True, 'n': None, 'u': A.Uint(9),
+                     'ts': A.Int(1589032171000)}, A1)
+        d = A.change(d, lambda r: r['c'].increment(4))
+        docs.append(d)
+        d = A.from_({'cfg': {'deep': {'er': 'x'}}, 'tbl': A.Table()}, A1)
+        d = A.change(d, lambda r: r['tbl'].add({'row': 1}))
+        docs.append(d)
+        d = A.from_({'t': A.Text('hello'), 'l': [1, 2, 3]}, A1)
+        d = A.change(d, lambda r: (r['t'].delete_at(1),
+                                   r['t'].insert_at(0, 'ab'),
+                                   r['l'].delete_at(2),
+                                   r['l'].insert_at(0, 0)))
+        docs.append(d)
+        # unicode keys incl. astral plane (UTF-16 key ordering)
+        d = A.from_({'\U0001F600smile': 1, '�repl': 2, 'plain': 3,
+                     'éacute': 4}, A1)
+        docs.append(d)
+        # multi-actor concurrent conflicts + deletes
+        b1 = A.from_({'k': 'one', 'gone': 1}, A1)
+        b2 = A.merge(A.init(A2), b1)
+        b1 = A.change(b1, lambda r: r.__setitem__('k', 'a'))
+        b2 = A.change(b2, lambda r: (r.__setitem__('k', 'b'),
+                                     r.__delitem__('gone')))
+        docs.append(A.merge(b1, b2))
+        # empty change in history
+        d = A.from_({'v': 1}, A1)
+        d = A.empty_change(d)
+        docs.append(d)
+        for doc in docs:
+            self._assert_native_matches_host(doc)
+
+    def test_long_text_deflated_columns(self):
+        """Documents past DEFLATE_MIN_SIZE exercise the native per-column
+        deflate (must byte-match Python's zlib level-6 raw stream)."""
+        import automerge_tpu as A
+        d = A.from_({'t': A.Text('abcdefgh' * 200)}, '01' * 8)
+        d = A.change(d, lambda r: r['t'].delete_at(5, 50))
+        self._assert_native_matches_host(d)
+
+    def test_fuzz_differential(self):
+        import random
+        import automerge_tpu as A
+        A1, A2, A3 = '01' * 8, '89' * 8, 'fe' * 8
+        rng = random.Random(11)
+        alphabet = 'abcdefghij'
+        for trial in range(5):
+            actors = [A1, A2, A3]
+            base = A.from_({'t': A.Text('seed'), 'm': {}, 'k': 0}, actors[0])
+            reps = [base] + [A.merge(A.init(a), base) for a in actors[1:]]
+            for step in range(15):
+                i = rng.randrange(len(reps))
+
+                def edit(r, rng=rng):
+                    roll = rng.random()
+                    t = r['t']
+                    if roll < 0.25 and len(t):
+                        t.delete_at(rng.randrange(len(t)))
+                    elif roll < 0.45:
+                        t.insert_at(rng.randrange(len(t) + 1),
+                                    rng.choice(alphabet))
+                    elif roll < 0.6 and len(t):
+                        t.set(rng.randrange(len(t)),
+                              rng.choice(alphabet).upper())
+                    elif roll < 0.8:
+                        r['m'][rng.choice(alphabet)] = rng.randrange(50)
+                    else:
+                        r['k'] = rng.randrange(1000)
+                reps[i] = A.change(reps[i], edit)
+                if rng.random() < 0.25:
+                    a, b = rng.sample(range(len(reps)), 2)
+                    reps[a] = A.merge(reps[a], reps[b])
+            final = reps[0]
+            for other in reps[1:]:
+                final = A.merge(final, other)
+            self._assert_native_matches_host(final)
+
+    def test_convergent_replicas_identical_bytes(self):
+        """Two replicas that applied the same changes in different orders
+        must produce identical native saves (canonical ordering)."""
+        import automerge_tpu as A
+        from automerge_tpu import backend as Backend
+        A1, A2 = '01' * 8, '89' * 8
+        b1 = A.from_({'k': 1}, A1)
+        b2 = A.merge(A.init(A2), b1)
+        b1 = A.change(b1, lambda r: r.__setitem__('a', 1))
+        b2 = A.change(b2, lambda r: r.__setitem__('b', 2))
+        m1 = A.merge(A.clone(b1), b2)     # a's changes first
+        m2 = A.merge(A.clone(b2), b1)     # b's changes first
+        c1 = [bytes(c) for c in A.get_all_changes(m1)]
+        c2 = [bytes(c) for c in A.get_all_changes(m2)]
+        assert c1 != c2                   # different application orders
+        h1 = Backend.get_heads(Backend.load(A.save(m1)))
+        s1 = native.build_document(c1, h1)
+        s2 = native.build_document(c2, h1)
+        assert s1 == s2
+        assert s1 == bytes(A.save(m1)) == bytes(A.save(m2))
