@@ -407,17 +407,21 @@ class DocFleet:
         """Sequence-element payload: text rows store single-char codepoints
         inline (table refs are negative, so the two never collide); list
         rows store plain non-negative int32s inline; everything else goes
-        through the value table."""
+        through the value table. uint/counter/timestamp/float64 payloads
+        box with their datatype (TypedValue) so device-served patches keep
+        exact datatype leaves — the same rule as the map register paths."""
+        from .registers import TypedValue
         value = op.get('value')
         datatype = op.get('datatype')
-        if type_ == 'text':
-            if datatype is None and isinstance(value, str) and \
-                    len(value) == 1:
-                return ord(value)
-            return self._intern_value_boxed(value)
-        if isinstance(value, int) and not isinstance(value, bool) and \
-                0 <= value < (1 << 31) and datatype != 'counter':
+        if type_ == 'text' and datatype is None and \
+                isinstance(value, str) and len(value) == 1:
+            return ord(value)
+        if type_ != 'text' and isinstance(value, int) and \
+                not isinstance(value, bool) and 0 <= value < (1 << 31) and \
+                datatype in (None, 'int'):
             return value
+        if datatype not in (None, 'int'):
+            return self._intern_value_boxed(TypedValue(value, datatype))
         return self._intern_value_boxed(value)
 
     def _intern_value_boxed(self, value):
@@ -532,6 +536,12 @@ class DocFleet:
         vals, vis, _n = (np.asarray(x) for x in
                          jax.device_get(seq_materialize(self.seq_state)))
         inexact = np.asarray(self.seq_state.inexact)
+        from .registers import TypedValue
+
+        def unbox(v):
+            boxed = self.value_table[-v - 2]
+            return boxed.value if isinstance(boxed, TypedValue) else boxed
+
         for row, info in enumerate(self.seq_rows):
             if info is None:
                 continue
@@ -544,11 +554,9 @@ class DocFleet:
             items = [int(v) for v in vals[row][vis[row]]]
             if info['type'] == 'text':
                 out[row] = ''.join(
-                    chr(v) if v >= 0 else str(self.value_table[-v - 2])
-                    for v in items)
+                    chr(v) if v >= 0 else str(unbox(v)) for v in items)
             else:
-                out[row] = [v if v >= 0 else self.value_table[-v - 2]
-                            for v in items]
+                out[row] = [v if v >= 0 else unbox(v) for v in items]
         return out
 
     # -- ingest ---------------------------------------------------------
@@ -1332,12 +1340,17 @@ class _FlatEngine(HashGraph):
         return patch
 
     def _register_patch_diffs(self):
-        """Whole-doc patch diffs straight from the device RegisterState
-        (exact mode; round-2 VERDICT item 10) — no mirror rebuild. Returns
-        None when the mirror must serve instead: non-register fleets,
-        device-inexact rows, or nested/sequence objects in the doc."""
+        """Whole-doc patch diffs straight from the device state (exact
+        mode; round-2 VERDICT item 10, extended round 3 to map trees and
+        sequences) — no mirror rebuild. The device's visible register
+        lanes become pseudo op rows fed through the host engine's OWN
+        patch machinery (`op_set._update_patch_property`, ref
+        new.js:884-1040 / documentPatch :1604-1635), so the patch grammar
+        is identical by construction. Returns None when the mirror must
+        serve instead: non-register fleets, device-inexact rows, or
+        payloads the device lanes can't represent."""
         fleet = self.fleet
-        if not fleet.exact_device or self.map_objects or self.seq_objects:
+        if not fleet.exact_device:
             return None
         fleet.flush()
         empty = {'objectId': '_root', 'type': 'map', 'props': {}}
@@ -1355,22 +1368,180 @@ class _FlatEngine(HashGraph):
             return None
         if bool(_np.asarray(fleet.reg_state.inexact[self.slot])):
             return None
-        from .registers import register_patch_props
-        from .tensor_doc import unpack_op_id
-        props = register_patch_props(fleet.reg_state, self.slot,
-                                     fleet.keys.keys,
-                                     value_table=fleet.value_table)
-        if props is None:
+        try:
+            return self._device_patch_diffs()
+        except _Unsupported:
             return None
+
+    def _device_patch_diffs(self):
+        """Assemble the whole-doc diff tree from device register/sequence
+        lanes via the host patch machinery. Raises _Unsupported for any
+        shape the lanes can't serve exactly (callers use the mirror)."""
+        import jax
+        import numpy as _np
+        from ..backend.op_set import OpSet, ObjState, _utf16_key
+        from ..common import lamport_key
+        from .registers import _patch_leaf
+        from .tensor_doc import unpack_op_id
+        fleet = self.fleet
+        rs = fleet.reg_state
+        slot = self.slot
+        reg = _np.asarray(jax.device_get(rs.reg[slot]))
+        killed = _np.asarray(jax.device_get(rs.killed[slot]))
+        value = _np.asarray(jax.device_get(rs.value[slot]))
+        counter = _np.asarray(jax.device_get(rs.counter[slot]))
+        visible = (reg != 0) & ~killed
+
+        def op_id_str(packed):
+            ctr, num = unpack_op_id(int(packed))
+            return f'{ctr}@{fleet.actors.actors[num]}'
+
+        def lane_row(packed, raw, cnt, base, char=None):
+            """Pseudo op row for one live register lane. `char` carries an
+            inline text code point already decoded (so reads never intern
+            into the shared value table)."""
+            row = dict(base)
+            row['id'] = op_id_str(packed)
+            row['succ'] = []
+            if char is not None:
+                row['action'] = 'set'
+                row['value'] = char
+                return row, None
+            boxed = fleet.value_table[-raw - 2] if raw <= -2 else raw
+            if isinstance(boxed, _SeqLink):
+                oid = boxed.object_id
+                row['action'] = 'makeText' \
+                    if self.seq_objects.get(oid) == 'text' else 'makeList'
+                return row, oid
+            if isinstance(boxed, _MapLink):
+                row['action'] = 'makeTable' if boxed.kind == 'table' \
+                    else 'makeMap'
+                return row, boxed.object_id
+            leaf = _patch_leaf(int(raw), int(cnt), fleet.value_table)
+            if leaf is None:
+                raise _Unsupported('payload outside device lanes')
+            row['action'] = 'set'
+            row['value'] = leaf['value']
+            if 'datatype' in leaf:
+                row['datatype'] = leaf['datatype']
+            return row, None
+
+        # group this doc's live cells by (object, key)
+        cells = {}                  # object_id -> {key: [(packed, lane)]}
+        for k in _np.flatnonzero(visible.any(axis=-1)):
+            key = fleet.keys.keys[int(k)]
+            obj, key_str = key if isinstance(key, tuple) else ('_root', key)
+            lanes = sorted((int(reg[k, s]), int(s))
+                           for s in _np.flatnonzero(visible[k]))
+            cells.setdefault(obj, {})[key_str] = [(p, s, int(k))
+                                                  for p, s in lanes]
+        # cells are fleet-global: keep only THIS doc's objects (root keys
+        # are per-slot because register rows are per-slot; nested keys are
+        # (oid, key) and oids are globally unique)
+        mine = {'_root'} | set(self.map_objects) | set(self.seq_objects)
+        cells = {obj: kv for obj, kv in cells.items() if obj in mine}
+
+        # reachability from root through live make lanes
+        shim = OpSet()
+        shim.objects = {'_root': ObjState('map')}
+        for oid, typ in self.map_objects.items():
+            shim.objects[oid] = ObjState(typ)
+        for oid, typ in self.seq_objects.items():
+            shim.objects[oid] = ObjState(typ)
+
+        seq_rows_data = self._fetch_seq_rows()
+        object_order = ['_root'] + sorted(
+            set(self.map_objects) | set(self.seq_objects), key=lamport_key)
+        from ..backend.op_set import root_meta
+        object_meta = {'_root': root_meta()}
+        patches = {'_root': {'objectId': '_root', 'type': 'map',
+                             'props': {}}}
+        for object_id in object_order:
+            obj = shim.objects[object_id]
+            prop_state = {}
+            if obj.is_seq:
+                if object_id not in object_meta:
+                    continue          # unreachable (overwritten) object
+                data = seq_rows_data.get(object_id)
+                if data is None:
+                    raise _Unsupported('sequence rows unavailable')
+                list_index = 0
+                for elem_packed, elem_lanes in data:
+                    elem_str = op_id_str(elem_packed)
+                    vis_elem = False
+                    for packed, raw, cnt, char in elem_lanes:
+                        base = {'insert': True} if packed == elem_packed \
+                            else {'insert': False, 'elemId': elem_str}
+                        row, _child = lane_row(packed, raw, cnt, base, char)
+                        if _child is not None:
+                            raise _Unsupported('object inside sequence')
+                        shim._update_patch_property(
+                            patches, object_id, row, prop_state, list_index,
+                            0, object_meta, whole_doc=True)
+                        vis_elem = True
+                    if vis_elem:
+                        list_index += 1
+            else:
+                if object_id != '_root' and object_id not in object_meta:
+                    continue          # unreachable (overwritten) object
+                for key_str in sorted(cells.get(object_id, {}),
+                                      key=_utf16_key):
+                    for packed, s, k in cells[object_id][key_str]:
+                        row, _child = lane_row(packed, int(value[k, s]),
+                                               int(counter[k, s]),
+                                               {'key': key_str,
+                                                'insert': False})
+                        shim._update_patch_property(
+                            patches, object_id, row, prop_state, 0, 0,
+                            object_meta, whole_doc=True)
+        return patches['_root']
+
+    def _fetch_seq_rows(self):
+        """Read this doc's sequence rows off the device: {objectId:
+        [(elem packed id, [(packed, raw, counter, char)])] in RGA order},
+        where `char` is the decoded inline text code point (None for
+        table-boxed payloads — reads never write the shared value table).
+        Raises _Unsupported when a row is device-inexact."""
+        import jax
+        import numpy as _np
+        from .sequence import HEAD, END, SLOT0
+        fleet = self.fleet
+        rows_map = fleet.slot_seq.get(self.slot, {})
         out = {}
-        for key, cell in props.items():
-            if isinstance(key, tuple):
-                return None       # nested maps: mirror serves the tree
-            out[key] = {
-                f'{ctr}@{fleet.actors.actors[num]}': leaf
-                for packed, leaf in cell.items()
-                for ctr, num in [unpack_op_id(packed)]}
-        return {'objectId': '_root', 'type': 'map', 'props': out}
+        if not rows_map:
+            return out
+        st = fleet.seq_state
+        for oid, row in rows_map.items():
+            if st is None or row >= st.elem_id.shape[0]:
+                out[oid] = []          # allocated but never written: empty
+                continue
+            if bool(_np.asarray(st.inexact[row])):
+                raise _Unsupported('sequence row inexact')
+            elem_id = _np.asarray(jax.device_get(st.elem_id[row]))
+            nxt = _np.asarray(jax.device_get(st.nxt[row]))
+            reg = _np.asarray(jax.device_get(st.reg[row]))
+            killed = _np.asarray(jax.device_get(st.killed[row]))
+            val = _np.asarray(jax.device_get(st.val[row]))
+            is_text = self.seq_objects.get(oid) == 'text'
+            elems = []
+            node = int(nxt[HEAD])
+            hops = 0
+            limit = elem_id.shape[0]
+            while node != END and hops <= limit:
+                lanes = []
+                live = (reg[node] != 0) & ~killed[node]
+                for s in _np.flatnonzero(live):
+                    raw = int(val[node, s])
+                    char = chr(raw) if is_text and raw >= 0 else None
+                    lanes.append((int(reg[node, s]), raw, 0, char))
+                lanes.sort(key=lambda lane: lane[0])
+                elems.append((int(elem_id[node]), lanes))
+                node = int(nxt[node])
+                hops += 1
+            if hops > limit:
+                raise _Unsupported('corrupt sequence chain')
+            out[oid] = elems
+        return out
 
     def materialize(self):
         """Exact current {key: value} view (LWW winner per key,
@@ -2059,6 +2230,16 @@ def _apply_changes_turbo(handles, per_doc_changes):
         val_op = (sflags == 3) | (sflags == 4)
         hflag = (sflags == 6) | (svtype == 8) | pred_overflow | \
             (val_op & (txt != (svtype == 6)))
+        # uint/timestamp list elements rebox as TypedValue so device-served
+        # patches keep their datatype (rare; same tag table as the map
+        # paths — counters are already hflag'd out above)
+        from .registers import TypedValue, typed_wire_tags
+        tags = typed_wire_tags()
+        typed = np.flatnonzero(val_op & ~txt & ~hflag &
+                               np.isin(svtype, list(tags)))
+        for i in typed:
+            svalue[i] = fleet._intern_value_boxed(TypedValue(
+                int(svalue[i]), tags[int(svtype[i])]))
         fleet._dispatch_seq(np.stack(
             [srow, skind, sref, spacked, svalue,
              *(pred_lanes[:, d] for d in range(D)),

@@ -488,7 +488,15 @@ def _install_seq_rows(fleet, out, sel, doc, slot_of, okey, oid_str, obj_type,
             continue
         off, ln = int(out['val_off'][jj]), int(out['val_len'][jj])
         decoded = decode_value((ln << 4) | vt, out['val_blob'][off:off + ln])
-        values[i] = fleet._intern_value_boxed(decoded['value'])
+        dt = decoded.get('datatype')
+        if dt not in (None, 'int'):
+            # keep the wire datatype for device-served patches (same
+            # TypedValue rule as the map register paths)
+            from .registers import TypedValue
+            values[i] = fleet._intern_value_boxed(
+                TypedValue(decoded['value'], dt))
+        else:
+            values[i] = fleet._intern_value_boxed(decoded['value'])
 
     live = alive[rows] & ~inc_mask[rows] & ~bad_upd
 

@@ -358,29 +358,3 @@ def _patch_leaf(raw, counter_fold, value_table):
     return None    # links / unsupported payloads: caller uses the mirror
 
 
-def register_patch_props(state, slot, keys, value_table=None):
-    """Whole-doc patch props for one document straight from RegisterState:
-    {key: {packed opId: value leaf}} over every visible op (the conflict
-    sets of ref new.js:1604-1635's documentPatch). Returns None when any
-    leaf needs the host mirror (nested/sequence links, unknown payloads)."""
-    # Slice this document's row on device: one get_patch call moves
-    # O(K*A), not the whole fleet's [N, K+1, A] state
-    reg = np.asarray(jax.device_get(state.reg[slot]))
-    killed = np.asarray(jax.device_get(state.killed[slot]))
-    value = np.asarray(jax.device_get(state.value[slot]))
-    counter = np.asarray(jax.device_get(state.counter[slot]))
-    visible = (reg != 0) & ~killed
-    props = {}
-    for k in range(len(keys)):
-        vis = np.flatnonzero(visible[k])
-        if not len(vis):
-            continue
-        cell = {}
-        for s in vis:
-            leaf = _patch_leaf(int(value[k, s]),
-                               int(counter[k, s]), value_table)
-            if leaf is None:
-                return None
-            cell[int(reg[k, s])] = leaf
-        props[keys[k]] = cell
-    return props

@@ -2815,6 +2815,9 @@ int64_t am_build_document(const uint8_t *blob, const uint64_t *offsets,
   for (auto &ch : ctx.changes) authors.push_back(ch.actor_hex);
   std::sort(authors.begin(), authors.end());
   authors.erase(std::unique(authors.begin(), authors.end()), authors.end());
+  // elem_key packs actor indexes into 8 bits: larger actor populations
+  // must take the Python path rather than alias elemIds
+  if (authors.size() > 256) return -1;
   ctx.actors = authors;
   for (size_t i = 0; i < ctx.actors.size(); i++)
     ctx.actor_index[ctx.actors[i]] = int32_t(i);

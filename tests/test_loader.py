@@ -105,11 +105,15 @@ class TestBulkLoad:
         for i, (m, e) in enumerate(zip(mats, expect)):
             assert m == e, f'doc {i} mismatch'
         assert fleet.metrics.doc_materializations == 0
-        # patches match the host backend exactly (mirror may materialize
-        # for nested/sequence docs; flat docs stay lazy in exact mode)
+        # patches match the host backend exactly; in exact mode nested and
+        # sequence docs are ALSO device-served (no chunk materialization —
+        # only counter-in-list style inexact rows fall back)
         for i, (h, buf) in enumerate(zip(handles, bufs)):
             assert fleet_backend.get_patch(h) == _host_view(buf), \
                 f'doc {i} patch mismatch'
+        if exact:
+            assert fleet.metrics.mirror_rebuilds == 0
+            assert fleet.metrics.doc_materializations == 0
 
     def test_save_verbatim_until_edit(self):
         docs = _corpus()
