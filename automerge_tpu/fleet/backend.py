@@ -410,22 +410,34 @@ class DocFleet:
         through the value table. uint/counter/timestamp/float64 payloads
         box with their datatype (TypedValue) so device-served patches keep
         exact datatype leaves — the same rule as the map register paths."""
-        from .registers import TypedValue
         value = op.get('value')
         datatype = op.get('datatype')
         if type_ == 'text' and datatype is None and \
                 isinstance(value, str) and len(value) == 1:
             return ord(value)
-        if type_ != 'text' and isinstance(value, int) and \
-                not isinstance(value, bool) and 0 <= value < (1 << 31) and \
-                datatype in (None, 'int'):
-            return value
-        if datatype not in (None, 'int'):
-            return self._intern_value_boxed(TypedValue(value, datatype))
-        return self._intern_value_boxed(value)
+        if type_ == 'text' and datatype in (None, 'int'):
+            # non-char text payloads box raw (never inline: a text lane's
+            # non-negative ints mean code points)
+            return self._intern_value_boxed(value)
+        return self._intern_typed(value, datatype)
 
     def _intern_value_boxed(self, value):
         return -(self.value_table.intern(value) + 2)
+
+    def _intern_typed(self, value, datatype):
+        """THE datatype-boxing rule for device value lanes (one source of
+        truth for the per-op, turbo, and loader ingest paths): payloads
+        whose wire datatype an int32 lane can't carry ('uint', 'counter',
+        'timestamp', 'float64', …) box as TypedValue so device-served
+        patches keep exact datatype leaves; plain ints in range stay
+        inline; everything else boxes raw."""
+        from .registers import TypedValue
+        if datatype not in (None, 'int'):
+            return self._intern_value_boxed(TypedValue(value, datatype))
+        if isinstance(value, int) and not isinstance(value, bool) and \
+                0 <= value < (1 << 31):
+            return value
+        return self._intern_value_boxed(value)
 
     def _pack_seq_op(self, row, info, op, packed):
         """One decoded sequence op -> (row, kind, ref, packed, value,
@@ -942,15 +954,12 @@ class DocFleet:
                 val_idx, flags = TOMBSTONE, 1
             elif action == 'inc':
                 val_idx, flags = op.get('value', 0), 2
-            elif op.get('datatype') not in (None, 'int'):
-                # uint/counter/timestamp/float64 sets box with their
-                # datatype so device-served patches stay exact (same rule
-                # as ingest.changes_to_op_rows)
-                from .registers import TypedValue
-                val_idx, flags = self._intern_value_boxed(
-                    TypedValue(op.get('value'), op['datatype'])), 1
             else:
-                val_idx, flags = self._intern_value(op.get('value')), 1
+                # _intern_typed is THE datatype-boxing rule: uint/counter/
+                # timestamp/float64 sets box with their datatype so
+                # device-served patches stay exact
+                val_idx, flags = self._intern_typed(
+                    op.get('value'), op.get('datatype')), 1
             out_doc.append(d)
             out_key.append(self.keys.intern(
                 op['key'] if obj == '_root' else (obj, op['key'])))
@@ -1517,11 +1526,11 @@ class _FlatEngine(HashGraph):
                 continue
             if bool(_np.asarray(st.inexact[row])):
                 raise _Unsupported('sequence row inexact')
-            elem_id = _np.asarray(jax.device_get(st.elem_id[row]))
-            nxt = _np.asarray(jax.device_get(st.nxt[row]))
-            reg = _np.asarray(jax.device_get(st.reg[row]))
-            killed = _np.asarray(jax.device_get(st.killed[row]))
-            val = _np.asarray(jax.device_get(st.val[row]))
+            # one transfer for all five arrays (not five round-trips)
+            elem_id, nxt, reg, killed, val = (
+                _np.asarray(x) for x in jax.device_get(
+                    (st.elem_id[row], st.nxt[row], st.reg[row],
+                     st.killed[row], st.val[row])))
             is_text = self.seq_objects.get(oid) == 'text'
             elems = []
             node = int(nxt[HEAD])
@@ -2163,13 +2172,13 @@ def _apply_changes_turbo(handles, per_doc_changes):
         # device-served patches keep exact datatypes and counter folds
         # (same rule as ingest.changes_to_op_rows; dels carry value -1 and
         # no typed vtype, so they never box)
-        from .registers import TypedValue, typed_wire_tags
+        from .registers import typed_wire_tags
         _tags = typed_wire_tags()
         typed_sel = keep & (rows['flags'] == 1) & (rows['value'] != -1) & \
             np.isin(rows['vtype'], list(_tags))
         for ri in np.flatnonzero(typed_sel):
-            kept_vals_all[ri] = fleet._intern_value_boxed(TypedValue(
-                int(rows['value'][ri]), _tags[int(rows['vtype'][ri])]))
+            kept_vals_all[ri] = fleet._intern_typed(
+                int(rows['value'][ri]), _tags[int(rows['vtype'][ri])])
 
     def dispatch_seq_rows():
         """Kept sequence rows -> one SeqState dispatch (fleet numbering)."""
@@ -2233,13 +2242,13 @@ def _apply_changes_turbo(handles, per_doc_changes):
         # uint/timestamp list elements rebox as TypedValue so device-served
         # patches keep their datatype (rare; same tag table as the map
         # paths — counters are already hflag'd out above)
-        from .registers import TypedValue, typed_wire_tags
+        from .registers import typed_wire_tags
         tags = typed_wire_tags()
         typed = np.flatnonzero(val_op & ~txt & ~hflag &
                                np.isin(svtype, list(tags)))
         for i in typed:
-            svalue[i] = fleet._intern_value_boxed(TypedValue(
-                int(svalue[i]), tags[int(svtype[i])]))
+            svalue[i] = fleet._intern_typed(int(svalue[i]),
+                                            tags[int(svtype[i])])
         fleet._dispatch_seq(np.stack(
             [srow, skind, sref, spacked, svalue,
              *(pred_lanes[:, d] for d in range(D)),

@@ -297,8 +297,9 @@ def _ensure_caps(fleet, n_docs):
 
 def _decode_cell_value(fleet, out, j, vtype_j, val_int_j, exact):
     """One op's value -> int32 register/grid lane value (inline or value
-    table ref), following _intern_value / changes_to_op_rows boxing rules."""
-    from .registers import TypedValue
+    table ref). Exact mode uses fleet._intern_typed — THE datatype-boxing
+    rule; the LWW grid boxes raw (its reader folds counters onto plain
+    ints and never unwraps TypedValue)."""
     if vtype_j == 4 and 0 <= val_int_j < (1 << 31):
         return int(val_int_j)
     off = int(out['val_off'][j])
@@ -306,8 +307,11 @@ def _decode_cell_value(fleet, out, j, vtype_j, val_int_j, exact):
     decoded = decode_value((ln << 4) | int(vtype_j),
                            out['val_blob'][off:off + ln])
     value, datatype = decoded['value'], decoded.get('datatype')
-    if exact and datatype in ('uint', 'counter', 'timestamp'):
-        return fleet._intern_value_boxed(TypedValue(value, datatype))
+    if exact:
+        # int datatype tags (bytes / unknown wire types) box raw: their
+        # patch leaves are mirror territory, same as before
+        return fleet._intern_typed(
+            value, datatype if isinstance(datatype, str) else None)
     return fleet._intern_value(value)
 
 
@@ -489,13 +493,14 @@ def _install_seq_rows(fleet, out, sel, doc, slot_of, okey, oid_str, obj_type,
         off, ln = int(out['val_off'][jj]), int(out['val_len'][jj])
         decoded = decode_value((ln << 4) | vt, out['val_blob'][off:off + ln])
         dt = decoded.get('datatype')
-        if dt not in (None, 'int'):
-            # keep the wire datatype for device-served patches (same
-            # TypedValue rule as the map register paths)
-            from .registers import TypedValue
-            values[i] = fleet._intern_value_boxed(
-                TypedValue(decoded['value'], dt))
+        if isinstance(dt, str) and dt != 'int':
+            # fleet._intern_typed — THE datatype-boxing rule (shared with
+            # every other ingest path); int datatype tags (bytes/unknown
+            # wire types) box raw below
+            values[i] = fleet._intern_typed(decoded['value'], dt)
         else:
+            # plain payloads box raw: sequence lanes reserve inline ints
+            # for text code points / list ints handled by the fast path
             values[i] = fleet._intern_value_boxed(decoded['value'])
 
     live = alive[rows] & ~inc_mask[rows] & ~bad_upd

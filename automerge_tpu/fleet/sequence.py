@@ -472,8 +472,8 @@ def visible_text(state):
 def element_conflicts(state, row):
     """Host read of one doc's per-element conflict sets: {packed elemId:
     {packed opId: value}} for every element whose visible register holds
-    more than one op (the list-element analogue of
-    registers.register_patch_props)."""
+    more than one op (the raw-engine view of what
+    fleet.backend._FlatEngine._device_patch_diffs serves as patch edits)."""
     reg = np.asarray(jax.device_get(state.reg[row]))
     killed = np.asarray(jax.device_get(state.killed[row]))
     val = np.asarray(jax.device_get(state.val[row]))

@@ -62,10 +62,10 @@ def build_workload(n_docs, n_keys, n_actors, rounds, ops_per_round, seed=0):
     return batches
 
 
-def bench_fleet(n_docs, n_keys, rounds, ops_per_round):
+def bench_fleet(n_docs, n_keys, rounds, ops_per_round, use_pallas=False):
     import jax
     from automerge_tpu.fleet import FleetState, apply_op_batch
-    if os.environ.get('BENCH_PALLAS'):
+    if use_pallas:
         from automerge_tpu.fleet.pallas_merge import pallas_apply_op_batch
         apply_op_batch = pallas_apply_op_batch
 
@@ -86,6 +86,41 @@ def bench_fleet(n_docs, n_keys, rounds, ops_per_round):
 
     total_ops = n_docs * ops_per_round * rounds
     return median_rate(run, total_ops), None
+
+
+def bench_pallas_merge(n_docs, n_keys, rounds, ops_per_round):
+    """Fused Pallas merge kernel (interpret=False: real Mosaic compile) on
+    the same workload as bench_fleet, with a correctness cross-check
+    against the jnp path. Runs whenever a TPU is the default backend (or
+    BENCH_PALLAS=1 forces it elsewhere); returns None when unavailable or
+    on a compile failure (reported, never fatal to the bench)."""
+    import jax
+    if not os.environ.get('BENCH_PALLAS') and \
+            jax.default_backend() != 'tpu':
+        return None
+    try:
+        from automerge_tpu.fleet import FleetState, apply_op_batch
+        from automerge_tpu.fleet.pallas_merge import pallas_apply_op_batch
+        # differential check on a small batch before timing
+        check = build_workload(64, n_keys, 3, 1, 32)[0]
+        st0 = FleetState.empty(64, n_keys)
+        want, _ = apply_op_batch(st0, check)
+        got, _ = pallas_apply_op_batch(st0, check, interpret=False)
+        for name in ('winners', 'values', 'counters'):
+            w = np.asarray(getattr(want, name))[:, :n_keys]
+            g = np.asarray(getattr(got, name))[:, :n_keys]
+            if not np.array_equal(w, g):
+                raise AssertionError(f'pallas/jnp mismatch in {name}')
+        rate, _ = bench_fleet(n_docs, n_keys, rounds, ops_per_round,
+                              use_pallas=True)
+        return rate
+    except AssertionError:
+        raise              # a MISCOMPILED kernel must fail loudly, not
+                           # masquerade as a benign compile failure
+    except Exception as exc:   # Mosaic lowering/compile issues: report only
+        print(f'# pallas merge kernel unavailable: '
+              f'{type(exc).__name__}: {str(exc)[:200]}', file=sys.stderr)
+        return None
 
 
 def bench_host(n_docs, n_keys, rounds, ops_per_round, seed=0):
@@ -594,6 +629,7 @@ def main():
     # KERNEL-ONLY numbers (device ceilings on pre-built batches — NOT
     # end-to-end; decode/hashing excluded):
     fleet_rate, _ = bench_fleet(n_docs, n_keys, rounds, ops_per_round)
+    pallas_rate = bench_pallas_merge(n_docs, n_keys, rounds, ops_per_round)
     pipe_rate, _ = bench_pipeline(int(os.environ.get('BENCH_PIPE_DOCS', 500)),
                                   n_keys, 20)
     text_rate, _ = bench_text(int(os.environ.get('BENCH_TEXT_DOCS', 2000)),
@@ -627,6 +663,11 @@ def main():
           f'{host_rate:.0f} changes/s', file=sys.stderr)
     print(f'# kernel-only device merge (pre-built batches): '
           f'{fleet_rate:.0f} ops/s', file=sys.stderr)
+    if pallas_rate is not None:
+        print(f'# fused pallas merge kernel (interpret=False, '
+              f'differentially checked): {pallas_rate:.0f} ops/s '
+              f'({pallas_rate / fleet_rate:.2f}x the jnp scatter path)',
+              file=sys.stderr)
     print(f'# kernel-only pipeline (native decode, no hash graph): '
           f'{pipe_rate:.0f} changes/s', file=sys.stderr)
     print(f'# kernel-only sequence engine (packed text traces): '
