@@ -432,6 +432,11 @@ class DocFleet:
         patches keep exact datatype leaves; plain ints in range stay
         inline; everything else boxes raw."""
         from .registers import TypedValue
+        if not isinstance(datatype, str):
+            # int datatype tags (bytes / unknown wire types,
+            # columnar.decode_value) box raw: their patch leaves are
+            # mirror territory, not TypedValue material
+            datatype = None
         if datatype not in (None, 'int'):
             return self._intern_value_boxed(TypedValue(value, datatype))
         if isinstance(value, int) and not isinstance(value, bool) and \

@@ -308,10 +308,7 @@ def _decode_cell_value(fleet, out, j, vtype_j, val_int_j, exact):
                            out['val_blob'][off:off + ln])
     value, datatype = decoded['value'], decoded.get('datatype')
     if exact:
-        # int datatype tags (bytes / unknown wire types) box raw: their
-        # patch leaves are mirror territory, same as before
-        return fleet._intern_typed(
-            value, datatype if isinstance(datatype, str) else None)
+        return fleet._intern_typed(value, datatype)
     return fleet._intern_value(value)
 
 
@@ -495,12 +492,12 @@ def _install_seq_rows(fleet, out, sel, doc, slot_of, okey, oid_str, obj_type,
         dt = decoded.get('datatype')
         if isinstance(dt, str) and dt != 'int':
             # fleet._intern_typed — THE datatype-boxing rule (shared with
-            # every other ingest path); int datatype tags (bytes/unknown
-            # wire types) box raw below
+            # every other ingest path; it normalizes int wire tags itself)
             values[i] = fleet._intern_typed(decoded['value'], dt)
         else:
-            # plain payloads box raw: sequence lanes reserve inline ints
-            # for text code points / list ints handled by the fast path
+            # plain payloads box raw here (NOT _intern_typed): sequence
+            # lanes reserve inline ints for text code points, and the list
+            # inline-int fast path already ran above
             values[i] = fleet._intern_value_boxed(decoded['value'])
 
     live = alive[rows] & ~inc_mask[rows] & ~bad_upd

@@ -29,6 +29,49 @@ import numpy as np
 
 REPS = int(os.environ.get('BENCH_REPS', 5))
 
+BENCH_PLATFORM = None
+
+
+def _guard_dead_accelerator():
+    """The TPU is reached through a local tunnel; when the tunnel daemon is
+    down or half-dead, the platform plugin HANGS on first device query (it
+    retries forever) and the whole bench run would time out recording
+    nothing. A socket probe is not reliable (a flapping tunnel can accept
+    and even answer while the device behind it is gone), so probe by
+    actually initializing the device in a SUBPROCESS under a hard timeout
+    and fall back to CPU — clearly labeled in the output — when it cannot.
+    An honest slower record beats silence."""
+    global BENCH_PLATFORM
+    import subprocess
+    import jax
+    if os.environ.get('JAX_PLATFORMS') == 'cpu':
+        BENCH_PLATFORM = 'cpu-forced'
+        jax.config.update('jax_platforms', 'cpu')
+        return
+    probe_s = int(os.environ.get('BENCH_DEVICE_PROBE_TIMEOUT', 60))
+    if probe_s == 0:
+        return    # probe disabled
+    # The probe tries the real device, so a healthy accelerator (tunneled
+    # or directly attached) always passes; only a device that genuinely
+    # cannot initialize+compute within the timeout demotes the run.
+    try:
+        proc = subprocess.run(
+            [sys.executable, '-c',
+             'import jax, jax.numpy as jnp;'
+             'print(int(jnp.arange(4).sum()), jax.devices()[0].platform)'],
+            timeout=probe_s, capture_output=True)
+        ok = proc.returncode == 0 and proc.stdout.startswith(b'6')
+    except subprocess.TimeoutExpired:
+        ok = False
+    if ok:
+        BENCH_PLATFORM = None      # device initializes and computes
+        return
+    print(f'# WARNING: accelerator failed to initialize within {probe_s}s '
+          f'-> benchmarking on CPU fallback (BENCH_DEVICE_PROBE_TIMEOUT=0 '
+          f'disables this probe)', file=sys.stderr)
+    BENCH_PLATFORM = 'cpu-fallback'
+    jax.config.update('jax_platforms', 'cpu')
+
 
 def median_rate(run, total, reps=None):
     """Median ops-per-second over `reps` timed runs of run()."""
@@ -606,6 +649,7 @@ def bench_native_save(n_changes=200, seed=0):
 
 
 def main():
+    _guard_dead_accelerator()
     n_docs = int(os.environ.get('BENCH_DOCS', 10000))
     n_keys = int(os.environ.get('BENCH_KEYS', 1000))
     rounds = int(os.environ.get('BENCH_ROUNDS', 10))
@@ -700,6 +744,8 @@ def main():
         'unit': 'changes/s',
         'vs_baseline': round(seam_rate / host_rate, 2),
     }
+    if BENCH_PLATFORM is not None:
+        result['platform'] = BENCH_PLATFORM
     print(json.dumps(result))
 
 
