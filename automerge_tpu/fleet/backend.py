@@ -250,14 +250,18 @@ class DocFleet:
         self.pending = []         # (slot, [change buffers])
         self.pending_actors = set()
         self.metrics = Metrics()  # per-dispatch counters (observability.py)
-        # Sequence-object fleet: one SeqState row per (doc slot, objectId).
-        # Text/list CRDT state lives here as RGA linked-list tensors
-        # (fleet/sequence.py), applied in the same flush as the map grid.
-        self.seq_state = None     # SeqState, allocated on first seq flush
+        # Sequence-object fleet: one device row per (doc slot, objectId).
+        # Text/list CRDT state lives in pow2 size-class pools of SeqStates
+        # (fleet/sequence.py SeqPools) so memory follows each document's
+        # own length — one long document no longer pads the whole fleet.
+        from .sequence import SeqPools
+        self.seq_elem_cap = 64    # base (smallest) class capacity
+        self.seq_pools = SeqPools(self.seq_elem_cap)
         self.seq_rows = []        # row -> {'slot','object_id','type'} | None
+        self.seq_place = []       # row -> (cls, idx) | None (unwritten)
+        self.seq_len = []         # row -> host upper bound on elements
         self.seq_free = []
         self.slot_seq = {}        # slot -> {objectId: row}
-        self.seq_elem_cap = 64    # initial element capacity (grows pow2)
 
     @property
     def dispatches(self):
@@ -295,21 +299,21 @@ class DocFleet:
             self.ctr_base[dst] = self.ctr_base[src]
         if src in self.grid_overflow:
             self.grid_overflow.add(dst)
-        src_rows, dst_rows = [], []
+        copies = {}    # cls -> ([src idx], [dst idx])
+        lanes = self._seq_lane_width()
         for oid, row in list(self.slot_seq.get(src, {}).items()):
             info = self.seq_rows[row]
-            src_rows.append(row)
-            dst_rows.append(self._alloc_seq_row(dst, oid, info['type']))
-        if src_rows and self.seq_state is not None:
-            from .sequence import grow_seq_state, SeqState
-            self.seq_state = grow_seq_state(
-                self.seq_state, _pow2(max(dst_rows) + 1),
-                self.seq_state.capacity)
-            st = self.seq_state
-            s = np.array(src_rows, dtype=np.int32)
-            t = np.array(dst_rows, dtype=np.int32)
-            self.seq_state = SeqState(
-                *(arr.at[t].set(arr[s]) for arr in st.tree_flatten()[0]))
+            dst_row = self._alloc_seq_row(dst, oid, info['type'])
+            place = self.seq_place[row]
+            if place is not None:
+                idx = self.seq_pools.alloc(place[0], lanes)
+                self.seq_place[dst_row] = (place[0], idx)
+                self.seq_len[dst_row] = self.seq_len[row]
+                srcs, dsts = copies.setdefault(place[0], ([], []))
+                srcs.append(place[1])
+                dsts.append(idx)
+        for cls, (srcs, dsts) in copies.items():
+            self.seq_pools.copy_rows(cls, srcs, cls, dsts)
         if self.state is not None and src < self.state.winners.shape[0]:
             self._ensure_capacity(n_docs=dst + 1, n_keys=len(self.keys))
             st = self.state
@@ -351,50 +355,79 @@ class DocFleet:
         if self.seq_free:
             row = self.seq_free.pop()
             self.seq_rows[row] = info
+            self.seq_place[row] = None
+            self.seq_len[row] = 0
         else:
             row = len(self.seq_rows)
             self.seq_rows.append(info)
+            self.seq_place.append(None)
+            self.seq_len.append(0)
         self.slot_seq.setdefault(slot, {})[object_id] = row
         return row
 
+    def _seq_lane_width(self):
+        return _pow2(max(len(self.actors), 4))
+
+    def _place_seq_row(self, row, need_len):
+        """Ensure row has a device placement with capacity >= need_len,
+        migrating up a size class when it outgrows its current one.
+        Returns (cls, idx)."""
+        self.seq_len[row] = max(self.seq_len[row], need_len, 1)
+        pools = self.seq_pools
+        need_cls = pools.cls_for(self.seq_len[row])
+        place = self.seq_place[row]
+        lanes = self._seq_lane_width()
+        if place is None:
+            idx = pools.alloc(need_cls, lanes)
+            place = (need_cls, idx)
+        elif need_cls > place[0]:
+            idx = pools.migrate(place[0], place[1], need_cls, lanes)
+            place = (need_cls, idx)
+        self.seq_place[row] = place
+        return place
+
+    def seq_row_inexact(self, row):
+        """Host read of one device row's inexact flag (False when the row
+        was never written)."""
+        place = self.seq_place[row] if row < len(self.seq_place) else None
+        if place is None:
+            return False
+        st = self.seq_pools.state(place[0])
+        return bool(np.asarray(st.inexact[place[1]]))
+
     def _zero_seq_rows(self, rows):
-        from .sequence import SeqState, END
-        st = self.seq_state
-        if st is None:
-            return
-        rows = [r for r in rows if r < st.elem_id.shape[0]]
-        if not rows:
-            return
-        import jax.numpy as jnp
-        idx = np.array(rows, dtype=np.int32)
-        st = SeqState(*(jnp.asarray(x) for x in st.tree_flatten()[0]))
-        self.seq_state = SeqState(
-            st.elem_id.at[idx].set(0),
-            st.nxt.at[idx].set(END),
-            st.reg.at[idx].set(0),
-            st.killed.at[idx].set(False),
-            st.val.at[idx].set(0),
-            st.n.at[idx].set(0),
-            st.inexact.at[idx].set(False))
+        by_cls = {}
+        for row in rows:
+            place = self.seq_place[row] if row < len(self.seq_place) \
+                else None
+            if place is not None:
+                by_cls.setdefault(place[0], []).append(place[1])
+                self.seq_place[row] = None
+            if row < len(self.seq_len):
+                self.seq_len[row] = 0
+        if by_cls:
+            self.seq_pools.release_rows(by_cls)
 
     def _remap_seq_actors(self, perm):
         """Renumber the actor bits of packed elemIds/register opIds in every
-        sequence row after a sorted-order actor insertion, permuting the
+        sequence pool after a sorted-order actor insertion, permuting the
         actor-lane axis the same way (lanes are indexed by actor number,
         like _remap_reg_actors; machinery shared via _lane_permutation)."""
-        if self.seq_state is None:
+        if not self.seq_pools.pools:
             return
         import jax.numpy as jnp
-        from .sequence import SeqState, grow_seq_state
-        # Grow the lane axis FIRST (same rationale as _remap_reg_actors)
-        st = grow_seq_state(self.seq_state, 0, 0,
-                            _pow2(max(len(self.actors), 4)))
+        from .sequence import SeqState
+        # Grow every pool's lane axis FIRST (same rationale as
+        # _remap_reg_actors)
+        self.seq_pools.ensure_lanes(self._seq_lane_width())
         self.metrics.remaps += 1
-        move, renum = self._lane_permutation(perm, st.reg.shape[2])
-        self.seq_state = SeqState(
-            renum(st.elem_id), jnp.asarray(st.nxt),
-            renum(move(st.reg, 0)), move(st.killed, False),
-            move(st.val, 0), jnp.asarray(st.n), jnp.asarray(st.inexact))
+        for cls, st in list(self.seq_pools.pools.items()):
+            move, renum = self._lane_permutation(perm, st.reg.shape[2])
+            self.seq_pools.pools[cls] = SeqState(
+                renum(st.elem_id), jnp.asarray(st.nxt),
+                renum(move(st.reg, 0)), move(st.killed, False),
+                move(st.val, 0), jnp.asarray(st.n),
+                jnp.asarray(st.inexact))
 
     def _intern_value(self, value):
         """Inline int32 in [0, 2^31) or a value-table ref -(i + 2)."""
@@ -482,98 +515,107 @@ class DocFleet:
                 *lanes, flag)
 
     def _dispatch_seq(self, seq_ops):
-        """Grow the SeqState to cover every allocated row and batch-apply
-        all pending sequence ops in one dispatch. seq_ops rows are
-        (row, kind, ref, packed, value, pred0..predD-1, flag)."""
-        import jax.numpy as jnp
-        from .sequence import (
-            SeqState, SeqOpBatch, grow_seq_state, apply_seq_batch, INSERT,
-            SEQ_PRED_LANES)
-        n_rows = len(self.seq_rows)
-        if n_rows == 0:
+        """Place every touched row in a size-class pool with enough
+        capacity (migrating rows that outgrew their class) and batch-apply
+        all pending sequence ops — ONE dispatch per active size class.
+        seq_ops rows are (row, kind, ref, packed, value, pred0..D-1, flag)."""
+        from .sequence import SeqOpBatch, apply_seq_batch, INSERT, \
+            SEQ_PRED_LANES
+        if len(self.seq_rows) == 0 or len(seq_ops) == 0:
             return
-        need_a = _pow2(max(len(self.actors), 4))
-        if self.seq_state is None:
-            self.seq_state = SeqState.empty(_pow2(n_rows),
-                                            self.seq_elem_cap,
-                                            actor_slots=need_a, xp=jnp)
-        if len(seq_ops) == 0:
-            if n_rows > self.seq_state.elem_id.shape[0] or \
-                    need_a > self.seq_state.actor_slots:
-                self.seq_state = grow_seq_state(self.seq_state,
-                                                _pow2(n_rows),
-                                                self.seq_state.capacity,
-                                                need_a)
-            return
+        # Widen every pool's lane axis FIRST: a new actor whose hex sorts
+        # after all existing ones produces no remap (identity perm), yet
+        # its lane must exist before its ops apply
+        self.seq_pools.ensure_lanes(self._seq_lane_width())
         D = SEQ_PRED_LANES
         arr = np.asarray(seq_ops, dtype=np.int64)   # [M, 6 + D] op tuples
         row_a = arr[:, 0]
+        n_rows = len(self.seq_rows)
         counts = np.bincount(row_a, minlength=n_rows)
         ins = np.bincount(row_a[arr[:, 1] == INSERT], minlength=n_rows)
-        cur_n = np.zeros(n_rows, dtype=np.int64)
-        have = np.asarray(self.seq_state.n)
-        cur_n[:min(n_rows, len(have))] = have[:n_rows]
-        need_cap = int((cur_n + ins).max())
-        self.seq_state = grow_seq_state(
-            self.seq_state, _pow2(n_rows),
-            _pow2(max(need_cap, self.seq_elem_cap)), need_a)
-        r_cap = self.seq_state.elem_id.shape[0]
-        width = max(int(counts.max()), 1)
+        # Placement pass: host-tracked element counts give each row's
+        # needed capacity class without any device reads
+        cls_of = {}
+        for row in np.unique(row_a):
+            row = int(row)
+            cls_of[row], _ = self._place_seq_row(
+                row, self.seq_len[row] + int(ins[row]))
+        # One batch per active class, rows addressed by pool index
+        by_cls = {}
+        for row, cls in cls_of.items():
+            by_cls.setdefault(cls, []).append(row)
         order = np.argsort(row_a, kind='stable')
         row_sorted = row_a[order]
-        pos = np.arange(len(row_sorted)) - \
+        pos_in_row = np.arange(len(row_sorted)) - \
             np.searchsorted(row_sorted, row_sorted, side='left')
-        cols = {name: np.zeros((r_cap, width), dtype=np.int32)
-                for name in ('kind', 'ref', 'packed', 'value')}
-        preds = np.zeros((r_cap, width, D), dtype=np.int32)
-        flag = np.zeros((r_cap, width), dtype=bool)
-        for j, name in enumerate(('kind', 'ref', 'packed', 'value')):
-            cols[name][row_sorted, pos] = arr[order, j + 1]
-        for d in range(D):
-            preds[row_sorted, pos, d] = arr[order, 5 + d]
-        flag[row_sorted, pos] = arr[order, 5 + D] != 0
-        batch = SeqOpBatch(cols['kind'], cols['ref'], cols['packed'],
-                           cols['value'], preds, flag)
-        self.seq_state, _stats = apply_seq_batch(self.seq_state, batch)
-        self.metrics.dispatches += 1
+        for cls, rows in by_cls.items():
+            st = self.seq_pools.state(cls)
+            r_cap = st.elem_id.shape[0]
+            sel = np.isin(row_sorted, rows)
+            sub = order[sel]
+            idx_of = np.zeros(n_rows, dtype=np.int64)
+            for row in rows:
+                idx_of[row] = self.seq_place[row][1]
+            rows_idx = idx_of[row_sorted[sel]]
+            pos = pos_in_row[sel]
+            width = max(int(counts[rows].max()), 1)
+            cols = {name: np.zeros((r_cap, width), dtype=np.int32)
+                    for name in ('kind', 'ref', 'packed', 'value')}
+            preds = np.zeros((r_cap, width, D), dtype=np.int32)
+            flag = np.zeros((r_cap, width), dtype=bool)
+            for j, name in enumerate(('kind', 'ref', 'packed', 'value')):
+                cols[name][rows_idx, pos] = arr[sub, j + 1]
+            for d in range(D):
+                preds[rows_idx, pos, d] = arr[sub, 5 + d]
+            flag[rows_idx, pos] = arr[sub, 5 + D] != 0
+            batch = SeqOpBatch(cols['kind'], cols['ref'], cols['packed'],
+                               cols['value'], preds, flag)
+            new_state, _stats = apply_seq_batch(st, batch)
+            self.seq_pools.pools[cls] = new_state
+            self.metrics.dispatches += 1
         self.metrics.device_ops += len(seq_ops)
 
     def render_seq_all(self):
-        """One-transfer render of every live sequence row: {row: str/list},
-        with None for rows whose device state is inexact (host mirror must
-        serve those reads)."""
+        """Render every live sequence row: {row: str/list}, with None for
+        rows whose device state is inexact (host mirror must serve those
+        reads). One materialize + transfer per ACTIVE size class."""
         import jax
         from .sequence import materialize as seq_materialize
-        out = {}
-        if self.seq_state is None:
-            for row, info in enumerate(self.seq_rows):
-                if info is not None:
-                    out[row] = '' if info['type'] == 'text' else []
-            return out
-        vals, vis, _n = (np.asarray(x) for x in
-                         jax.device_get(seq_materialize(self.seq_state)))
-        inexact = np.asarray(self.seq_state.inexact)
         from .registers import TypedValue
+        out = {}
+        per_cls = {}
+        for row, info in enumerate(self.seq_rows):
+            if info is None:
+                continue
+            place = self.seq_place[row]
+            if place is None:
+                out[row] = '' if info['type'] == 'text' else []
+            else:
+                per_cls.setdefault(place[0], []).append(row)
+        mats = {}
+        for cls in per_cls:
+            st = self.seq_pools.state(cls)
+            vals, vis, _n = (np.asarray(x) for x in
+                             jax.device_get(seq_materialize(st)))
+            mats[cls] = (vals, vis, np.asarray(st.inexact))
 
         def unbox(v):
             boxed = self.value_table[-v - 2]
             return boxed.value if isinstance(boxed, TypedValue) else boxed
 
-        for row, info in enumerate(self.seq_rows):
-            if info is None:
-                continue
-            if row >= vals.shape[0]:
-                out[row] = '' if info['type'] == 'text' else []
-                continue
-            if inexact[row]:
-                out[row] = None
-                continue
-            items = [int(v) for v in vals[row][vis[row]]]
-            if info['type'] == 'text':
-                out[row] = ''.join(
-                    chr(v) if v >= 0 else str(unbox(v)) for v in items)
-            else:
-                out[row] = [v if v >= 0 else unbox(v) for v in items]
+        for cls, rows in per_cls.items():
+            vals, vis, inexact = mats[cls]
+            for row in rows:
+                idx = self.seq_place[row][1]
+                if inexact[idx]:
+                    out[row] = None
+                    continue
+                items = [int(v) for v in vals[idx][vis[idx]]]
+                if self.seq_rows[row]['type'] == 'text':
+                    out[row] = ''.join(
+                        chr(v) if v >= 0 else str(unbox(v)) for v in items)
+                else:
+                    out[row] = [v if v >= 0 else unbox(v) for v in items]
         return out
 
     # -- ingest ---------------------------------------------------------
@@ -1524,18 +1566,20 @@ class _FlatEngine(HashGraph):
         out = {}
         if not rows_map:
             return out
-        st = fleet.seq_state
         for oid, row in rows_map.items():
-            if st is None or row >= st.elem_id.shape[0]:
+            place = fleet.seq_place[row]
+            if place is None:
                 out[oid] = []          # allocated but never written: empty
                 continue
-            if bool(_np.asarray(st.inexact[row])):
+            st = fleet.seq_pools.state(place[0])
+            idx = place[1]
+            if bool(_np.asarray(st.inexact[idx])):
                 raise _Unsupported('sequence row inexact')
             # one transfer for all five arrays (not five round-trips)
             elem_id, nxt, reg, killed, val = (
                 _np.asarray(x) for x in jax.device_get(
-                    (st.elem_id[row], st.nxt[row], st.reg[row],
-                     st.killed[row], st.val[row])))
+                    (st.elem_id[idx], st.nxt[idx], st.reg[idx],
+                     st.killed[idx], st.val[idx])))
             is_text = self.seq_objects.get(oid) == 'text'
             elems = []
             node = int(nxt[HEAD])

@@ -410,8 +410,7 @@ def _install_seq_rows(fleet, out, sel, doc, slot_of, okey, oid_str, obj_type,
     encounter order IS final RGA order, so the linked list is a straight
     chain — no pointer walking, no replay."""
     import jax.numpy as jnp
-    from .backend import _pow2
-    from .sequence import SeqState, grow_seq_state, END, HEAD, SLOT0
+    from .sequence import SeqState, END, HEAD, SLOT0
 
     rows = np.flatnonzero(sel)
     if not len(rows):
@@ -469,7 +468,6 @@ def _install_seq_rows(fleet, out, sel, doc, slot_of, okey, oid_str, obj_type,
 
     # value lanes (text: single codepoints inline; lists: ints inline;
     # everything else boxes; counters flag the row, ref new.js:937-965)
-    r_of = fleet_row[inv]
     txt = is_text[inv]
     values = np.zeros(len(rows), dtype=np.int64)
     flag_counter = np.zeros(len(rows), dtype=bool)
@@ -501,71 +499,75 @@ def _install_seq_rows(fleet, out, sel, doc, slot_of, okey, oid_str, obj_type,
             values[i] = fleet._intern_value_boxed(decoded['value'])
 
     live = alive[rows] & ~inc_mask[rows] & ~bad_upd
-
-    # grow the fleet seq state to cover rows, elements, and actor lanes
-    n_rows_total = len(fleet.seq_rows)
-    cap = max(int(n_elems.max()) if len(n_elems) else 1, 1)
-    need_a = _pow2(max(len(fleet.actors), 4))
-    if fleet.seq_state is None:
-        fleet.seq_state = SeqState.empty(
-            _pow2(n_rows_total), _pow2(max(cap, fleet.seq_elem_cap)),
-            actor_slots=need_a, xp=jnp)
-    fleet.seq_state = grow_seq_state(
-        fleet.seq_state, _pow2(n_rows_total),
-        _pow2(max(cap, fleet.seq_elem_cap, fleet.seq_state.capacity)),
-        need_a)
-    st = fleet.seq_state
-    nodes = st.elem_id.shape[1]
-
-    # linked chain per fleet row: HEAD -> SLOT0 .. SLOT0+n-1 -> END
-    touched = np.unique(fleet_row)
-    nxt_host = np.full((len(touched), nodes), END, dtype=np.int32)
-    n_host = np.zeros(len(touched), dtype=np.int32)
-    row_pos = {int(r): i for i, r in enumerate(touched)}
-    for u in range(len(uniq)):
-        i = row_pos[int(fleet_row[u])]
-        n_k = int(n_elems[u])
-        n_host[i] = n_k
-        if n_k:
-            nxt_host[i, HEAD] = SLOT0
-            if n_k > 1:
-                nxt_host[i, SLOT0:SLOT0 + n_k - 1] = \
-                    np.arange(SLOT0 + 1, SLOT0 + n_k, dtype=np.int32)
-            nxt_host[i, SLOT0 + n_k - 1] = END
-
-    tr = jnp.asarray(touched)
-    new_nxt = st.nxt.at[tr].set(jnp.asarray(nxt_host))
-    new_n = st.n.at[tr].set(jnp.asarray(n_host))
-
-    ins_rows = ins_idx
-    eidx = (jnp.asarray(r_of[ins_rows]), jnp.asarray(node[ins_rows]))
-    new_elem = st.elem_id.at[eidx].set(
-        jnp.asarray(packed32[rows][ins_rows].astype(np.int32)))
-
-    live_rows = np.flatnonzero(live)
-    lidx = (jnp.asarray(r_of[live_rows]), jnp.asarray(node[live_rows]),
-            jnp.asarray(id_actor[rows][live_rows]))
-    new_reg = st.reg.at[lidx].set(
-        jnp.asarray(packed32[rows][live_rows].astype(np.int32)))
-    new_killed = st.killed.at[lidx].set(False)
-    new_val = st.val.at[lidx].set(
-        jnp.asarray(values[live_rows].astype(np.int32)))
+    live_mask = np.zeros(len(rows), dtype=bool)
+    live_mask[np.flatnonzero(live)] = True
 
     # inexact flags: counters in sequences, unmatched update targets, and
-    # duplicate (element, lane) live ops (outside one-op-per-actor)
-    inex_rows = r_of[flag_counter | bad_upd]
-    lane_cell = r_of[live_rows] * (1 << 40) + node[live_rows] * 512 + \
-        id_actor[rows][live_rows]
+    # duplicate (element, lane) live ops (outside one-op-per-actor) —
+    # computed on op rows, applied per placement below
+    inex_obj = np.zeros(len(uniq), dtype=bool)
+    np.logical_or.at(inex_obj, inv[flag_counter | bad_upd], True)
+    lane_cell = inv[live_mask] * (1 << 42) + node[live_mask] * 512 + \
+        id_actor[rows][live_mask]
     uq, cnt = np.unique(lane_cell, return_counts=True)
     if (cnt > 1).any():
         dup = np.isin(lane_cell, uq[cnt > 1])
-        inex_rows = np.r_[inex_rows, r_of[live_rows][dup]]
-    new_inexact = st.inexact
-    if len(inex_rows):
-        new_inexact = new_inexact.at[
-            jnp.asarray(np.unique(inex_rows))].set(True)
+        np.logical_or.at(inex_obj, inv[live_mask][dup], True)
 
-    fleet.seq_state = SeqState(new_elem, new_nxt, new_reg, new_killed,
-                               new_val, new_n, new_inexact)
-    fleet.metrics.dispatches += 1
+    # place each object in its size class (host-tracked lengths), then
+    # install per class: one chain/element/lane scatter set per class
+    place = [fleet._place_seq_row(int(fleet_row[u]), int(n_elems[u]))
+             for u in range(len(uniq))]
+    cls_arr = np.array([p[0] for p in place], dtype=np.int64)
+    idx_arr = np.array([p[1] for p in place], dtype=np.int64)
+    idx_of_op = idx_arr[inv]
+
+    for cls in np.unique(cls_arr):
+        cls = int(cls)
+        objs = np.flatnonzero(cls_arr == cls)
+        st = fleet.seq_pools.state(cls)
+        nodes = st.elem_id.shape[1]
+
+        # linked chain per pool row: HEAD -> SLOT0 .. SLOT0+n-1 -> END
+        nxt_host = np.full((len(objs), nodes), END, dtype=np.int32)
+        n_host = np.zeros(len(objs), dtype=np.int32)
+        for i, u in enumerate(objs):
+            n_k = int(n_elems[u])
+            n_host[i] = n_k
+            if n_k:
+                nxt_host[i, HEAD] = SLOT0
+                if n_k > 1:
+                    nxt_host[i, SLOT0:SLOT0 + n_k - 1] = \
+                        np.arange(SLOT0 + 1, SLOT0 + n_k, dtype=np.int32)
+                nxt_host[i, SLOT0 + n_k - 1] = END
+        tr = jnp.asarray(idx_arr[objs])
+        new_nxt = st.nxt.at[tr].set(jnp.asarray(nxt_host))
+        new_n = st.n.at[tr].set(jnp.asarray(n_host))
+
+        in_cls = np.isin(inv, objs)
+        ins_sel = np.flatnonzero(ins & in_cls)
+        eidx = (jnp.asarray(idx_of_op[ins_sel]),
+                jnp.asarray(node[ins_sel]))
+        new_elem = st.elem_id.at[eidx].set(
+            jnp.asarray(packed32[rows][ins_sel].astype(np.int32)))
+
+        live_sel = np.flatnonzero(live_mask & in_cls)
+        lidx = (jnp.asarray(idx_of_op[live_sel]),
+                jnp.asarray(node[live_sel]),
+                jnp.asarray(id_actor[rows][live_sel]))
+        new_reg = st.reg.at[lidx].set(
+            jnp.asarray(packed32[rows][live_sel].astype(np.int32)))
+        new_killed = st.killed.at[lidx].set(False)
+        new_val = st.val.at[lidx].set(
+            jnp.asarray(values[live_sel].astype(np.int32)))
+
+        new_inexact = st.inexact
+        inex = objs[inex_obj[objs]]
+        if len(inex):
+            new_inexact = new_inexact.at[jnp.asarray(idx_arr[inex])].set(
+                True)
+        fleet.seq_pools.pools[cls] = SeqState(
+            new_elem, new_nxt, new_reg, new_killed, new_val, new_n,
+            new_inexact)
+        fleet.metrics.dispatches += 1
     fleet.metrics.device_ops += len(rows)

@@ -539,3 +539,136 @@ class SeqEncoder:
                 if op.get('flag'):
                     flag[d, i] = True
         return SeqOpBatch(kind, ref, packed, value, preds, flag)
+
+
+class SeqPools:
+    """Size-class pools of sequence rows.
+
+    A single SeqState is rectangular: one 10k-element document would force
+    every row in the fleet to 10k slots × A actor lanes — the long-document
+    analogue of padding a whole batch to its longest member. Pools bucket
+    rows by pow2 capacity class (class c holds rows of capacity
+    `base << c`), so memory follows each document's own length; a row that
+    outgrows its class migrates up by a prefix copy (front-anchored
+    sentinels make the tail padding inert, see the node-layout note above).
+    The per-flush cost is one apply dispatch per ACTIVE class instead of
+    one total — bounded by log2(longest/base) — which is the same
+    size-class trick the sync driver uses for variable Bloom filter sizes
+    (fleet/bloom.py).
+
+    Addressing: callers hold (cls, idx) placements; this object owns the
+    per-class SeqStates, free lists, and growth/migration. It is
+    deliberately host-side bookkeeping — all device work stays in the
+    SeqState kernels."""
+
+    def __init__(self, base_capacity=64):
+        self.base = base_capacity
+        self.pools = {}     # cls -> SeqState
+        self.free = {}      # cls -> [idx, ...]
+        self.used = {}      # cls -> high-water row count
+
+    def cls_for(self, capacity):
+        c = 0
+        while (self.base << c) < capacity:
+            c += 1
+        return c
+
+    def capacity(self, cls):
+        return self.base << cls
+
+    def state(self, cls):
+        return self.pools.get(cls)
+
+    def _ensure(self, cls, n_rows, actor_slots):
+        import jax.numpy as jnp
+        pow2 = 1
+        while pow2 < n_rows:
+            pow2 *= 2
+        st = self.pools.get(cls)
+        if st is None:
+            self.pools[cls] = SeqState.empty(
+                pow2, self.capacity(cls), actor_slots=actor_slots, xp=jnp)
+        else:
+            self.pools[cls] = grow_seq_state(st, pow2, self.capacity(cls),
+                                             actor_slots)
+        return self.pools[cls]
+
+    def ensure_lanes(self, actor_slots):
+        """Grow every pool's actor-lane axis (before a lane permutation)."""
+        for cls in list(self.pools):
+            self.pools[cls] = grow_seq_state(
+                self.pools[cls], 0, 0, actor_slots)
+
+    def alloc(self, cls, actor_slots):
+        free = self.free.setdefault(cls, [])
+        if free:
+            # a pool built under a narrower actor table must still widen
+            # its lane axis before the recycled row is written
+            self._ensure(cls, self.used.get(cls, 1), actor_slots)
+            return free.pop()
+        idx = self.used.get(cls, 0)
+        self.used[cls] = idx + 1
+        self._ensure(cls, idx + 1, actor_slots)
+        return idx
+
+    def release(self, cls, idx):
+        """Zero a row and return it to its class's free list."""
+        self.release_rows({cls: [idx]})
+
+    def release_rows(self, by_cls):
+        """Zero rows and return them to their free lists; one batched
+        indexed update per touched class ({cls: [idx, ...]})."""
+        import jax.numpy as jnp
+        for cls, idxs in by_cls.items():
+            st = self.pools.get(cls)
+            live = [i for i in idxs if st is not None and
+                    i < st.elem_id.shape[0]]
+            if live:
+                i = jnp.asarray(np.array(live, dtype=np.int32))
+                self.pools[cls] = SeqState(
+                    st.elem_id.at[i].set(0),
+                    st.nxt.at[i].set(END),
+                    st.reg.at[i].set(0),
+                    st.killed.at[i].set(False),
+                    st.val.at[i].set(0),
+                    st.n.at[i].set(0),
+                    st.inexact.at[i].set(False))
+            self.free.setdefault(cls, []).extend(idxs)
+
+    def copy_row(self, src, dst):
+        """Copy row (cls, idx) -> (cls2, idx2); dst class must be >= src
+        (prefix copy; END-filled tail stays inert)."""
+        self.copy_rows(src[0], [src[1]], dst[0], [dst[1]])
+
+    def copy_rows(self, src_cls, src_idxs, dst_cls, dst_idxs):
+        """Batched row copies between two classes (dst capacity >= src);
+        one indexed gather/scatter per array."""
+        import jax.numpy as jnp
+        width = max(self.pools[src_cls].reg.shape[2],
+                    self.pools[dst_cls].reg.shape[2])
+        if self.pools[src_cls].reg.shape[2] != \
+                self.pools[dst_cls].reg.shape[2]:
+            self.ensure_lanes(width)
+        s = self.pools[src_cls]
+        d = self.pools[dst_cls]
+        nodes = s.elem_id.shape[1]
+        si = jnp.asarray(np.array(src_idxs, dtype=np.int32))
+        di = jnp.asarray(np.array(dst_idxs, dtype=np.int32))
+
+        def put(darr, sarr):
+            if darr.ndim == 2:
+                return darr.at[di, :nodes].set(sarr[si])
+            return darr.at[di, :nodes, :].set(sarr[si])
+
+        self.pools[dst_cls] = SeqState(
+            put(d.elem_id, s.elem_id), put(d.nxt, s.nxt),
+            put(d.reg, s.reg), put(d.killed, s.killed), put(d.val, s.val),
+            d.n.at[di].set(s.n[si]),
+            d.inexact.at[di].set(s.inexact[si]))
+
+    def migrate(self, cls, idx, new_cls, actor_slots):
+        """Move a row to a bigger class; returns its new idx."""
+        new_idx = self.alloc(new_cls, actor_slots)
+        self.copy_row((cls, idx), (new_cls, new_idx))
+        self.release(cls, idx)
+        return new_idx

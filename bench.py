@@ -542,7 +542,7 @@ def bench_backend_text(n_docs, trace_len, ops_per_change=32, seed=0):
         handles = init_docs(n_docs, fleet)
         handles, _ = apply_changes_docs(handles, per_doc, mirror=False)
         assert fleet.metrics.fallbacks == 0
-        jax.block_until_ready(fleet.seq_state.nxt)
+        jax.block_until_ready([p.nxt for p in fleet.seq_pools.pools.values()])
 
     run()  # warmup compile
 

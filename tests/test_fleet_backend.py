@@ -934,7 +934,7 @@ class TestSequenceSeam:
         assert fleet_backend.materialize_docs([gb]) == [{'t': 'i'}]
         # device row stayed exact: the render above came from the device
         fb.fleet.flush()
-        assert not bool(np.asarray(fb.fleet.seq_state.inexact)[0])
+        assert not fb.fleet.seq_row_inexact(0)
         # patches match host throughout (apply_both asserted) and so does
         # the serialized document
         assert bytes(fleet_backend.save(gb)) == bytes(host_backend.save(hb))
@@ -954,7 +954,7 @@ class TestSequenceSeam:
         gb, _ = fleet_backend.apply_changes(gb, [c1])
         assert fleet_backend.materialize_docs([gb]) == [{'l': [7, 'str', -5]}]
         fb.fleet.flush()
-        assert not bool(np.asarray(fb.fleet.seq_state.inexact)[0])
+        assert not fb.fleet.seq_row_inexact(0)
 
     def test_rga_concurrent_insert_order_matches_host(self):
         """Two actors inserting at the same position: device RGA order must
@@ -982,7 +982,7 @@ class TestSequenceSeam:
         # device render agrees with the host's element order
         mat = fleet_backend.materialize_docs([gb])[0]['t']
         fb.fleet.flush()
-        assert not bool(np.asarray(fb.fleet.seq_state.inexact)[0])
+        assert not fb.fleet.seq_row_inexact(0)
         assert mat == 'bam'   # higher actor's concurrent insert first
 
     def test_concurrent_set_vs_del_stays_exact_on_device(self):
@@ -1010,7 +1010,7 @@ class TestSequenceSeam:
         # reference semantics: the concurrent set survives the delete
         assert fleet_backend.materialize_docs([gb]) == [{'l': [9]}]
         fb.fleet.flush()
-        assert not bool(np.asarray(fb.fleet.seq_state.inexact)[0])
+        assert not fb.fleet.seq_row_inexact(0)
 
     def test_counter_in_list_falls_back(self):
         fb = self._fb()
@@ -1028,7 +1028,7 @@ class TestSequenceSeam:
         gb, _ = fleet_backend.apply_changes(gb, [c2])
         assert fleet_backend.materialize_docs([gb]) == [{'l': [15]}]
         fb.fleet.flush()
-        assert bool(np.asarray(fb.fleet.seq_state.inexact)[0])
+        assert fb.fleet.seq_row_inexact(0)
 
     def test_clone_and_free_with_seq_rows(self):
         fb = self._fb()
@@ -1071,7 +1071,7 @@ class TestSequenceSeam:
         gb, _ = fleet_backend.apply_changes(gb, [c2])
         assert fleet_backend.materialize_docs([gb]) == [{'t': 'ab'}]
         fb.fleet.flush()
-        assert not bool(np.asarray(fb.fleet.seq_state.inexact)[0])
+        assert not fb.fleet.seq_row_inexact(0)
 
     def test_public_api_text_promotionless(self):
         import automerge_tpu as am
@@ -1128,7 +1128,7 @@ class TestSequenceSeam:
         assert fleet_backend.materialize_docs([g1, g2]) == \
             [{'t': ''}, {'k': 1}]
         fb.fleet.flush()
-        assert not bool(np.asarray(fb.fleet.seq_state.inexact)[0])
+        assert not fb.fleet.seq_row_inexact(0)
 
 
 class TestTurboSequence:
@@ -1167,7 +1167,7 @@ class TestTurboSequence:
         assert fleet_backend.materialize_docs(handles) == [{'t': 'ac'}]
         # the device served the read: no lazy mirror rebuild happened
         assert fb.fleet.metrics.mirror_rebuilds == 0
-        assert not bool(np.asarray(fb.fleet.seq_state.inexact)[0])
+        assert not fb.fleet.seq_row_inexact(0)
 
     def test_turbo_text_differential_vs_exact(self):
         """Turbo and exact paths produce identical patches and bytes."""
@@ -1214,6 +1214,110 @@ class TestTurboSequence:
              'insert': True, 'value': 'x', 'pred': []}])
         with pytest.raises(ValueError, match='unknown object'):
             fleet_backend.apply_changes_docs([g], [[bogus]], mirror=False)
+
+
+class TestSeqSizeClasses:
+    """Sequence rows live in pow2 size-class pools (fleet/sequence.py
+    SeqPools): memory follows each document's own length, and a long
+    document no longer pads the whole fleet's sequence arrays."""
+
+    def _text_doc(self, fb, actor, text):
+        import automerge_tpu as A
+        from automerge_tpu import backend as _hb
+        d = A.from_({'t': A.Text(text)}, actor)
+        gb = fb.init()
+        gb, _ = fleet_backend.apply_changes(
+            gb, [bytes(c) for c in A.get_all_changes(d)])
+        return gb
+
+    def test_long_doc_does_not_inflate_small_class(self):
+        fleet = DocFleet(doc_capacity=4, key_capacity=8)
+        fb = FleetBackend(fleet)
+        short = self._text_doc(fb, ACTORS[0], 'hi')
+        long = self._text_doc(fb, ACTORS[1], 'x' * 300)
+        fleet.flush()
+        assert fleet_backend.materialize_docs([short, long]) == \
+            [{'t': 'hi'}, {'t': 'x' * 300}]
+        pools = fleet.seq_pools
+        classes = sorted(pools.pools)
+        assert len(classes) >= 2
+        # the small class stays at base capacity: the 300-element doc
+        # lives in its own class instead of padding everyone
+        assert pools.state(classes[0]).capacity == fleet.seq_elem_cap
+        assert pools.state(classes[-1]).capacity >= 300
+        short_place = fleet.seq_place[fleet.slot_seq[
+            short['state']._impl.slot].popitem()[1]]
+        assert short_place[0] == classes[0]
+
+    def test_row_migrates_up_classes_preserving_content(self):
+        import automerge_tpu as A
+        fleet = DocFleet(doc_capacity=2, key_capacity=8)
+        A.set_default_backend(FleetBackend(fleet))
+        try:
+            d = A.from_({'t': A.Text('ab')}, ACTORS[0])
+            fleet.flush()
+            row = next(iter(fleet.slot_seq[list(fleet.slot_seq)[0]].values()))
+            first_place = fleet.seq_place[row]
+            for chunk in range(6):
+                d = A.change(d, lambda r: r['t'].insert_at(
+                    len(r['t']), *('y' * 40)))
+            fleet.flush()
+            assert str(d['t']) == 'ab' + 'y' * 240
+            second_place = fleet.seq_place[row]
+            assert second_place[0] > first_place[0]   # moved up a class
+            # the vacated idx is reusable
+            assert first_place[1] in fleet.seq_pools.free.get(
+                first_place[0], [])
+        finally:
+            A.set_default_backend(host_backend)
+
+    def test_tail_sorted_new_actor_widens_lanes(self):
+        """A 5th actor whose hex id sorts AFTER all existing actors causes
+        no remap (identity perm); the pools must still widen their lane
+        axis before its ops apply, or the row would flag inexact and lose
+        the device path forever."""
+        import automerge_tpu as A
+        fleet = DocFleet(doc_capacity=8, key_capacity=8)
+        A.set_default_backend(FleetBackend(fleet))
+        try:
+            first = ['01' * 8, '22' * 8, '44' * 8, '66' * 8]
+            base = A.from_({'t': A.Text('abcd')}, first[0])
+            replicas = [base] + [A.merge(A.init(a), base) for a in first[1:]]
+            for i, rep in enumerate(replicas[1:], start=1):
+                replicas[i] = A.change(rep, lambda r, i=i: r['t'].set(i, '!'))
+            merged = replicas[0]
+            for rep in replicas[1:]:
+                merged = A.merge(merged, rep)
+            fleet.flush()
+            assert len(fleet.actors) == 4
+            # 5th actor sorts after every existing one -> identity perm
+            late = A.merge(A.init('ff' * 8), merged)
+            late = A.change(late, lambda r: r['t'].insert_at(0, 'Z'))
+            fleet.flush()
+            for row, info in enumerate(fleet.seq_rows):
+                if info is not None:
+                    assert not fleet.seq_row_inexact(row)
+            assert str(late['t']) == 'Za!!!'
+        finally:
+            A.set_default_backend(host_backend)
+
+    def test_free_slot_releases_pool_rows(self):
+        fleet = DocFleet(doc_capacity=2, key_capacity=8)
+        fb = FleetBackend(fleet)
+        gb = self._text_doc(fb, ACTORS[0], 'abc')
+        fleet.flush()
+        slot = gb['state']._impl.slot
+        row = next(iter(fleet.slot_seq[slot].values()))
+        place = fleet.seq_place[row]
+        assert place is not None
+        fleet_backend.free(gb)
+        assert place[1] in fleet.seq_pools.free.get(place[0], [])
+        # the freed idx is handed to the next allocation in that class
+        gb2 = self._text_doc(fb, ACTORS[0], 'def')
+        fleet.flush()
+        row2 = next(iter(fleet.slot_seq[gb2['state']._impl.slot].values()))
+        assert fleet.seq_place[row2] == place
+        assert fleet_backend.materialize_docs([gb2]) == [{'t': 'def'}]
 
 
 class TestValueTableDedup:
