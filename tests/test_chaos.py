@@ -1,0 +1,219 @@
+"""Cross-backend chaos differential: one randomized multi-actor workload
+driven through the host backend and BOTH fleet device modes at once, with
+save/load round-trips, bulk loads, clones, sync convergence, and history
+queries interleaved — every read compared across implementations.
+
+This is the wasm.js differential harness (ref test/wasm.js:27-36) scaled to
+the whole surface: the host OpSet is the executable spec; the fleet paths
+must be observationally identical through the public Backend contract."""
+
+import random
+
+import pytest
+
+import automerge_tpu as A
+from automerge_tpu import backend as host_backend
+from automerge_tpu import native
+from automerge_tpu.fleet import backend as fleet_backend
+from automerge_tpu.fleet.backend import DocFleet, FleetBackend
+from automerge_tpu.fleet.loader import load_docs
+
+A1, A2, A3 = '01' * 8, '89' * 8, 'fe' * 8
+ACTORS = [A1, A2, A3]
+ALPHA = 'abcdefghijklmnop'
+
+
+def _random_edit(edit_seed):
+    """One random mutation closure over the public proxy API. All draws
+    come from a per-edit PRNG seeded up front, so applying the closure to
+    identical documents in different universes performs identical edits."""
+
+    def edit(r):
+        rng = random.Random(edit_seed)
+        roll = rng.random()
+        t = r['text']
+        lst = r['list']
+        if roll < 0.14:
+            t.insert_at(rng.randrange(len(t) + 1), rng.choice(ALPHA))
+        elif roll < 0.22 and len(t):
+            t.delete_at(rng.randrange(len(t)))
+        elif roll < 0.30 and len(t):
+            t.set(rng.randrange(len(t)), rng.choice(ALPHA).upper())
+        elif roll < 0.40:
+            key = rng.choice(ALPHA)
+            choice = rng.random()
+            if choice < 0.5:
+                r[key] = rng.randrange(1000)
+            elif choice < 0.7:
+                r[key] = rng.choice(['str', 2.5, True, None])
+            else:
+                r[key] = A.Int(1589032171000) if choice < 0.8 else \
+                    A.Uint(rng.randrange(99))
+        elif roll < 0.48:
+            r['counts'][rng.choice('xyz')] = A.Counter(rng.randrange(10))
+        elif roll < 0.56:
+            m = r['counts']
+            k = rng.choice('xyz')
+            if k in m and hasattr(m[k], 'increment'):
+                m[k].increment(rng.randrange(-3, 9))
+            else:
+                m[k] = A.Counter(0)
+        elif roll < 0.66:
+            lst.insert(rng.randrange(len(lst) + 1), rng.randrange(100))
+        elif roll < 0.72 and len(lst):
+            lst[rng.randrange(len(lst))] = rng.randrange(100, 200)
+        elif roll < 0.78 and len(lst):
+            lst.delete_at(rng.randrange(len(lst)))
+        elif roll < 0.86:
+            r['nested'][rng.choice('pq')] = {'v': rng.randrange(50)}
+        elif roll < 0.93:
+            key = rng.choice(ALPHA)
+            if key in r:
+                del r[key]
+        else:
+            pass    # empty change
+    return edit
+
+
+class _Universe:
+    """One backend implementation's replica set for the shared trace."""
+
+    def __init__(self, name, backend):
+        self.name = name
+        self.backend = backend
+        self.docs = []
+
+    def with_backend(self, fn):
+        prev = A.Backend()
+        A.set_default_backend(self.backend)
+        try:
+            return fn()
+        finally:
+            A.set_default_backend(prev)
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason='native codec unavailable')
+@pytest.mark.parametrize('seed', [0, 1])
+def test_chaos_differential(seed):
+    rng = random.Random(seed)
+    fleet_lww = DocFleet(doc_capacity=8, key_capacity=64)
+    fleet_exact = DocFleet(doc_capacity=8, key_capacity=64,
+                           exact_device=True)
+    universes = [
+        _Universe('host', host_backend),
+        _Universe('fleet-lww', FleetBackend(fleet_lww)),
+        _Universe('fleet-exact', FleetBackend(fleet_exact)),
+    ]
+
+    def compare(tag):
+        base = None
+        for u in universes:
+            views = [dict(d) for d in u.docs]
+            saves = [bytes(u.with_backend(lambda d=d: A.save(d)))
+                     for d in u.docs]
+            if base is None:
+                base = (u.name, views, saves)
+            else:
+                assert views == base[1], \
+                    f'{tag}: {u.name} reads diverge from {base[0]}'
+                assert saves == base[2], \
+                    f'{tag}: {u.name} save bytes diverge from {base[0]}'
+        return base[2]
+
+    # seed replicas (same initial change everywhere: same actor, time 0)
+    for u in universes:
+        def build():
+            base = A.from_({'text': A.Text('seed'), 'list': [1, 2],
+                            'counts': {}, 'nested': {}}, ACTORS[0])
+            return [base] + [A.merge(A.init(a), base) for a in ACTORS[1:]]
+        u.docs = u.with_backend(build)
+
+    for step in range(30):
+        i = rng.randrange(len(ACTORS))
+        action = rng.random()
+        if action < 0.55:
+            edit = _random_edit(rng.getrandbits(32))
+            for u in universes:
+                u.docs[i] = u.with_backend(
+                    lambda u=u, i=i: A.change(u.docs[i], edit))
+        elif action < 0.75:
+            j = rng.randrange(len(ACTORS))
+            if j != i:
+                for u in universes:
+                    u.docs[i] = u.with_backend(
+                        lambda u=u: A.merge(u.docs[i], u.docs[j]))
+        elif action < 0.85:
+            # save/load round-trip replaces the replica
+            for u in universes:
+                def reload(u=u, i=i):
+                    buf = A.save(u.docs[i])
+                    return A.load(buf, ACTORS[i])
+                u.docs[i] = u.with_backend(reload)
+        elif action < 0.95:
+            for u in universes:
+                u.docs[i] = u.with_backend(
+                    lambda u=u, i=i: A.clone(u.docs[i], ACTORS[i]))
+        else:
+            for u in universes:
+                u.docs[i] = u.with_backend(
+                    lambda u=u, i=i: A.empty_change(u.docs[i]))
+        if step % 10 == 9:
+            # full convergence point: merge everything into replica 0
+            for u in universes:
+                def converge(u=u):
+                    out = A.clone(u.docs[0])
+                    for d in u.docs[1:]:
+                        out = A.merge(out, d)
+                    return out
+                merged = u.with_backend(converge)
+                u.docs.append(merged)
+            compare(f'step {step}')
+            for u in universes:
+                u.docs.pop()
+
+    saves = compare('final')
+
+    # histories and heads agree everywhere
+    for u in universes[1:]:
+        for d0, d1 in zip(universes[0].docs, u.docs):
+            h0 = universes[0].with_backend(lambda: A.get_history(d0))
+            h1 = u.with_backend(lambda: A.get_history(d1))
+            assert [e.change['hash'] for e in h0] == \
+                [e.change['hash'] for e in h1]
+
+    # bulk-load every final save into fresh fleets: reads must match
+    for exact in (False, True):
+        fresh = DocFleet(doc_capacity=8, key_capacity=64,
+                         exact_device=exact)
+        handles = load_docs(saves, fresh)
+        mats = fleet_backend.materialize_docs(handles)
+        expect = [dict(d) for d in universes[0].docs]
+        for k, (m, e) in enumerate(zip(mats, expect)):
+            assert m == e, f'bulk-load(exact={exact}) doc {k}'
+        # and the loaded docs save back verbatim
+        for h, buf in zip(handles, saves):
+            assert bytes(fleet_backend.save(h)) == buf
+
+    # sync convergence: bulk-loaded fleet replicas (BOTH device modes)
+    # sync against fresh host peers until both sides go quiet, ending on
+    # identical heads
+    for exact in (False, True):
+        sync_fleet = DocFleet(doc_capacity=4, key_capacity=64,
+                              exact_device=exact)
+        handle = load_docs([saves[0]], sync_fleet)[0]
+        peer = host_backend.init()
+        s1, s2 = A.init_sync_state(), A.init_sync_state()
+        for _ in range(16):
+            s1, msg = fleet_backend.generate_sync_message(handle, s1)
+            if msg is not None:
+                peer, s2, _ = host_backend.receive_sync_message(peer, s2,
+                                                                msg)
+            s2, msg2 = host_backend.generate_sync_message(peer, s2)
+            if msg2 is not None:
+                handle, s1, _ = fleet_backend.receive_sync_message(
+                    handle, s1, msg2)
+            if msg is None and msg2 is None:
+                break
+        assert host_backend.get_heads(peer) == \
+            fleet_backend.get_heads(handle), f'sync exact={exact}'
