@@ -2047,27 +2047,60 @@ def _apply_changes_turbo(handles, per_doc_changes):
     flags_all = rows['flags']
     seq_sel = (flags_all >= 3) & (flags_all <= 6)
     make_sel = flags_all >= 7
-    if seq_sel.any() or make_sel.any():
+    nested_sel = (flags_all <= 2) & (rows['obj'] != 0)
+    if seq_sel.any() or make_sel.any() or nested_sel.any():
         # RGA application is order-sensitive: if any doc needs the general
         # causal gate (whose applied order can differ from buffer order),
         # route the whole call to the exact path
         if (~fast_mask[doc_of]).any():
             return None
-        # Every sequence op's object must resolve to a registered object or
-        # a make earlier in this batch; dangling objects get exact-path
-        # error handling
-        made = [set() for _ in engines]
+        # Every op's containing object must resolve to a registered object
+        # or a make earlier in this batch; dangling objects get exact-path
+        # error handling. Seq ops must target seq objects, keyed ops map
+        # objects — a type mismatch is an exact-path error too.
+        made_seq = [set() for _ in engines]
+        made_map = [set() for _ in engines]
         for ri in np.flatnonzero(make_sel):
             d = change_doc[int(rows['doc'][ri])]
             p = int(rows['packed'][ri])
-            made[d].add(f'{p >> 8}@{nat_actors[p & (_MA - 1)]}')
+            oid = f'{p >> 8}@{nat_actors[p & (_MA - 1)]}'
+            (made_seq if rows['flags'][ri] <= 8 else made_map)[d].add(oid)
         for d, obj_nat in {(change_doc[int(rows['doc'][ri])],
                             int(rows['obj'][ri]))
                            for ri in np.flatnonzero(seq_sel)}:
             oid = f'{obj_nat >> 8}@{nat_actors[obj_nat & (_MA - 1)]}'
-            if oid not in made[d] and \
+            if oid not in made_seq[d] and \
                     oid not in engines[d].seq_objects:
                 return None
+        for d, obj_nat in {(change_doc[int(rows['doc'][ri])],
+                            int(rows['obj'][ri]))
+                           for ri in np.flatnonzero(nested_sel | (
+                               make_sel & (rows['obj'] != 0)))}:
+            oid = f'{obj_nat >> 8}@{nat_actors[obj_nat & (_MA - 1)]}'
+            if oid not in made_map[d] and \
+                    oid not in engines[d].map_objects:
+                return None
+    # Decode every arena-boxed payload BEFORE the commit point: a payload
+    # decode_value rejects (out-of-range leb, invalid UTF-8, bad float
+    # width) must fall back to the exact path, not corrupt state after
+    # heads/clock/logs have already advanced
+    vlen_all = rows['vlen']
+    voff_all = np.cumsum(vlen_all, dtype=np.int64) - vlen_all
+    vblob = rows['vblob']
+    vtype_all = rows['vtype']
+    decode_sel = np.isin(flags_all, (1, 3, 4)) & (rows['value'] != -1) & \
+        ((vlen_all > 0) | np.isin(vtype_all, (0, 1, 2)))
+    decoded_cache = {}
+    if decode_sel.any():
+        from ..columnar import decode_value
+        try:
+            for ri in np.flatnonzero(decode_sel):
+                ln, vt = int(vlen_all[ri]), int(vtype_all[ri])
+                decoded_cache[int(ri)] = decode_value(
+                    (ln << 4) | vt, vblob[voff_all[ri]:voff_all[ri] + ln])
+        except Exception:
+            return None
+
     # From here on the batch is committed to turbo (counted as such)
     fleet.metrics.turbo_calls += 1
 
@@ -2201,33 +2234,52 @@ def _apply_changes_turbo(handles, per_doc_changes):
     keep_root = keep & ~seq_sel
     keep_seq = keep & seq_sel
 
-    # Make ops: register the object with its engine, allocate its device
-    # row, and substitute the grid value with a _SeqLink table ref
+    # Make ops: register the object with its engine (plus its device row
+    # for sequences) and substitute the grid value with a link table ref
     kept_vals_all = rows['value'].astype(np.int32, copy=True)
     kept_flags_all = rows['flags'].copy()
     for ri in np.flatnonzero(make_sel & keep):
         p = int(rows['packed'][ri])
         oid = f'{p >> 8}@{nat_actors[p & (_MA - 1)]}'
         d = change_doc[int(rows['doc'][ri])]
-        typ = 'text' if rows['flags'][ri] == 7 else 'list'
-        engines[d].seq_objects[oid] = typ
-        slot = engines[d].slot
-        if oid not in fleet.slot_seq.get(slot, {}):
-            fleet._alloc_seq_row(slot, oid, typ)
-        kept_vals_all[ri] = fleet._intern_value_boxed(_SeqLink(oid))
+        mk = int(rows['flags'][ri])
+        if mk <= 8:              # 7 makeText / 8 makeList
+            typ = 'text' if mk == 7 else 'list'
+            engines[d].seq_objects[oid] = typ
+            slot = engines[d].slot
+            if oid not in fleet.slot_seq.get(slot, {}):
+                fleet._alloc_seq_row(slot, oid, typ)
+            kept_vals_all[ri] = fleet._intern_value_boxed(_SeqLink(oid))
+        else:                    # 9 makeMap / 10 makeTable
+            typ = 'map' if mk == 9 else 'table'
+            engines[d].map_objects[oid] = typ
+            kept_vals_all[ri] = fleet._intern_value_boxed(
+                _MapLink(oid, typ))
         kept_flags_all[ri] = 1
     if fleet.exact_device:
-        # uint/counter/timestamp root sets box with their wire datatype so
+        # uint/counter/timestamp sets box with their wire datatype so
         # device-served patches keep exact datatypes and counter folds
         # (same rule as ingest.changes_to_op_rows; dels carry value -1 and
         # no typed vtype, so they never box)
         from .registers import typed_wire_tags
         _tags = typed_wire_tags()
         typed_sel = keep & (rows['flags'] == 1) & (rows['value'] != -1) & \
-            np.isin(rows['vtype'], list(_tags))
+            (vlen_all == 0) & np.isin(rows['vtype'], list(_tags))
         for ri in np.flatnonzero(typed_sel):
             kept_vals_all[ri] = fleet._intern_typed(
                 int(rows['value'][ri]), _tags[int(rows['vtype'][ri])])
+    # arena-boxed map-cell payloads (strings/bools/None/floats/bytes,
+    # out-of-lane ints): decode and intern by the shared rule (exact mode
+    # keeps TypedValue datatypes; the LWW grid boxes raw)
+    boxed_sel = keep & (rows['flags'] == 1) & (rows['value'] != -1) & \
+        ((vlen_all > 0) | np.isin(rows['vtype'], (0, 1, 2)))
+    for ri in np.flatnonzero(boxed_sel):
+        decoded = decoded_cache[int(ri)]
+        if fleet.exact_device:
+            kept_vals_all[ri] = fleet._intern_typed(
+                decoded['value'], decoded.get('datatype'))
+        else:
+            kept_vals_all[ri] = fleet._intern_value(decoded['value'])
 
     def dispatch_seq_rows():
         """Kept sequence rows -> one SeqState dispatch (fleet numbering)."""
@@ -2281,23 +2333,31 @@ def _apply_changes_turbo(handles, per_doc_changes):
         is_text = np.array([info is not None and info['type'] == 'text'
                             for info in fleet.seq_rows], dtype=bool)
         txt = is_text[srow]
-        # host-side inexact flags: counter ops (flags 6 / vtype 8), pred
-        # lists past the lane width, and payload types the device value
-        # column can't carry for this row type (non-char in text, char in
-        # list)
+        # host-side inexact flags: counter ops (flags 6 / vtype 8) and
+        # pred lists past the lane width
         val_op = (sflags == 3) | (sflags == 4)
-        hflag = (sflags == 6) | (svtype == 8) | pred_overflow | \
-            (val_op & (txt != (svtype == 6)))
-        # uint/timestamp list elements rebox as TypedValue so device-served
-        # patches keep their datatype (rare; same tag table as the map
-        # paths — counters are already hflag'd out above)
-        from .registers import typed_wire_tags
-        tags = typed_wire_tags()
-        typed = np.flatnonzero(val_op & ~txt & ~hflag &
-                               np.isin(svtype, list(tags)))
-        for i in typed:
-            svalue[i] = fleet._intern_typed(int(svalue[i]),
-                                            tags[int(svtype[i])])
+        hflag = (sflags == 6) | (svtype == 8) | pred_overflow
+        # Re-intern every payload the device lane can't carry inline
+        # through _intern_seq_value — THE shared sequence-value rule:
+        # text rows inline single code points, lists inline plain ints,
+        # everything else (arena-boxed strings/bools/floats, datatyped
+        # ints) boxes into the value table
+        svlen = vlen_all[keep_seq]
+        seq_ri = np.flatnonzero(keep_seq)
+        tag_names = {3: 'uint', 4: 'int', 9: 'timestamp'}
+        inline_ok = (svlen == 0) & np.where(txt, svtype == 6, svtype == 4)
+        rebox = np.flatnonzero(val_op & ~hflag & ~inline_ok)
+        for i in rebox:
+            ln, vt = int(svlen[i]), int(svtype[i])
+            if ln > 0 or vt in (0, 1, 2):
+                decoded = decoded_cache[int(seq_ri[i])]  # pre-validated
+            else:
+                decoded = {'value': int(svalue[i]),
+                           'datatype': tag_names.get(vt)}
+            svalue[i] = fleet._intern_seq_value(
+                'text' if txt[i] else 'list',
+                {'value': decoded['value'],
+                 'datatype': decoded.get('datatype')})
         fleet._dispatch_seq(np.stack(
             [srow, skind, sref, spacked, svalue,
              *(pred_lanes[:, d] for d in range(D)),
@@ -2307,10 +2367,12 @@ def _apply_changes_turbo(handles, per_doc_changes):
     doc_arr = np.array(change_doc, dtype=np.int32)[rows['doc'][keep_root]]
     slots = slot_of_doc.astype(np.int32)[doc_arr]
     kept_packed_root = rows['packed'][keep_root]
-    key_map = np.zeros(max(len(nat_keys), 1), dtype=np.int32)
-    for k in np.unique(rows['key'][keep_root]) if n_kept_root else []:
-        key_map[k] = fleet.keys.intern(nat_keys[k])
-    key = key_map[rows['key'][keep_root]]
+    # Key interning: root keys as bare strings; nested map/table cells as
+    # composite (objectId, key) — shared with the register ingest
+    from .ingest import intern_composite_keys
+    key = intern_composite_keys(rows['obj'][keep_root],
+                                rows['key'][keep_root], nat_keys,
+                                nat_actors, fleet.keys)
     ctr = kept_packed_root >> 8
     actor = actor_map[kept_packed_root & (_MA - 1)]
     packed = (ctr << 8) | actor

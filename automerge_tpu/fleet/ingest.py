@@ -286,6 +286,29 @@ def changes_to_decoded_ops(per_doc_changes):
     return out
 
 
+def intern_composite_keys(obj, key_nat, nat_keys, nat_actors, key_interner):
+    """Intern fleet key ids for rows that may live on nested objects:
+    obj == 0 rows intern their bare key string, others the composite
+    (objectId, key) tuple — one intern per unique (obj, key) pair.
+    Shared by the turbo path and the register ingest."""
+    n = len(obj)
+    out = np.zeros(n, dtype=np.int32)
+    if not n:
+        return out
+    pairs = obj.astype(np.int64) * (1 << 32) + key_nat.astype(np.int64)
+    uniq, inv = np.unique(pairs, return_inverse=True)
+    u_ids = np.empty(len(uniq), dtype=np.int32)
+    for ui, pv in enumerate(uniq):
+        o = int(pv >> 32)
+        ks = nat_keys[int(pv & 0xffffffff)]
+        if o == 0:
+            u_ids[ui] = key_interner.intern(ks)
+        else:
+            oid = f'{o >> 8}@{nat_actors[o & 0xff]}'
+            u_ids[ui] = key_interner.intern((oid, ks))
+    return u_ids[inv]
+
+
 def changes_to_op_rows(per_doc_changes, key_interner, actor_interner,
                        value_table=None):
     """Flat op rows with per-op pred lists, for the exact register engine
@@ -315,9 +338,11 @@ def changes_to_op_rows(per_doc_changes, key_interner, actor_interner,
             out = None    # sequence/make rows: not register material
         if out is not None:
             rows, nat_keys, nat_actors, _meta = out
-            key_map = np.array([key_interner.intern(k) for k in nat_keys],
-                               dtype=np.int32) if nat_keys else \
-                np.zeros(1, np.int32)
+            # root keys intern bare; nested map cells (rows['obj'] != 0)
+            # intern composite (objectId, key) like the Python decode path
+            key_ids = intern_composite_keys(rows['obj'], rows['key'],
+                                            nat_keys, nat_actors,
+                                            key_interner)
             actor_map = np.array([actor_interner.intern(a)
                                   for a in nat_actors], dtype=np.int32) \
                 if nat_actors else np.zeros(1, np.int32)
@@ -329,20 +354,40 @@ def changes_to_op_rows(per_doc_changes, key_interner, actor_interner,
 
             values = rows['value'].astype(np.int32, copy=True)
             if value_table is not None and 'vtype' in rows:
+                from ..columnar import decode_value
                 from .registers import TypedValue, typed_wire_tags
                 tags = typed_wire_tags()
                 # values == TOMBSTONE (-1) identifies del rows: the native
-                # parser rejects negative set values outright
-                # (codec.cpp set-value range check), so -1 can only be a del
+                # parser boxes negative set values via the arena, so -1 on
+                # a flags==1 row can only be a del
                 typed = (rows['flags'] == 1) & (values != TOMBSTONE) & \
-                    np.isin(rows['vtype'], list(tags))
+                    (rows['vlen'] == 0) & np.isin(rows['vtype'], list(tags))
                 for ri in np.flatnonzero(typed):
                     values[ri] = -(value_table.intern(TypedValue(
                         int(rows['value'][ri]),
                         tags[int(rows['vtype'][ri])])) + 2)
+                # arena-boxed payloads (strings/bools/None/floats/bytes,
+                # out-of-lane ints): decode the raw wire bytes and box by
+                # the shared datatype rule
+                vlen = rows['vlen']
+                off = np.cumsum(vlen, dtype=np.int64) - vlen
+                # dels (value TOMBSTONE, vtype 0) are NOT boxed nulls
+                boxed_sel = (rows['flags'] == 1) & (values != TOMBSTONE) & \
+                    ((vlen > 0) | np.isin(rows['vtype'], (0, 1, 2)))
+                blob = rows['vblob']
+                for ri in np.flatnonzero(boxed_sel):
+                    ln, vt = int(vlen[ri]), int(rows['vtype'][ri])
+                    decoded = decode_value((ln << 4) | vt,
+                                           blob[off[ri]:off[ri] + ln])
+                    dt = decoded.get('datatype')
+                    if isinstance(dt, str) and dt != 'int':
+                        box = TypedValue(decoded['value'], dt)
+                    else:
+                        box = decoded['value']
+                    values[ri] = -(value_table.intern(box) + 2)
             return {
                 'doc': np.array(docs, dtype=np.int64)[rows['doc']],
-                'key': key_map[rows['key']],
+                'key': key_ids,
                 'packed': remap(rows['packed']),
                 'value': values,
                 'flags': rows['flags'],

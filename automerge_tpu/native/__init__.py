@@ -215,11 +215,12 @@ def ingest_changes(buffers, doc_ids, with_meta=False, with_seq=False,
     per-change header metadata (the whole hash-graph feed: SHA-256 hash with
     checksum verification, deps, actor/seq/startOp/time/message, op counts)
     so no Python-side header decode is needed. With with_seq=True, the
-    parser also accepts sequence ops (makeText/makeList at root keys,
-    insert/set/del/inc on sequence objects) and the rows dict gains
-    obj/ref/vtype columns (packed objectId, packed referent elemId, wire
+    parser also accepts sequence ops (insert/set/del/inc on sequence
+    objects), make ops at map keys (root or nested), and keyed set/del/inc
+    on nested map/table objects; the rows dict gains obj/ref/vtype columns
+    (packed containing objectId — 0 = root, packed referent elemId, wire
     value-type tag); flags extend to 3=seq insert, 4=seq set, 5=seq del,
-    6=seq inc, 7=makeText, 8=makeList."""
+    6=seq inc, 7=makeText, 8=makeList, 9=makeMap, 10=makeTable."""
     lib = _load()
     if lib is None:
         return None
@@ -272,6 +273,22 @@ def ingest_changes(buffers, doc_ids, with_meta=False, with_seq=False,
             return None
         seq_cols = (obj[:int(n_rows)], ref[:int(n_rows)],
                     vtype[:int(n_rows)])
+        # boxed-value passthrough: per-row wire byte lengths + raw arena
+        lib.am_ingest_val_size.argtypes = []
+        lib.am_ingest_val_size.restype = i64
+        arena_size = int(lib.am_ingest_val_size())
+        if arena_size < 0:
+            return None
+        vlen = np.zeros(max(int(n_rows), 1), dtype=np.int32)
+        arena = np.zeros(max(arena_size, 1), dtype=np.uint8)
+        lib.am_ingest_val_fetch.argtypes = [i32p_, u8p_, ctypes.c_uint64]
+        lib.am_ingest_val_fetch.restype = i64
+        if lib.am_ingest_val_fetch(vlen.ctypes.data_as(i32p_),
+                                   arena.ctypes.data_as(u8p_),
+                                   arena.size) != arena_size:
+            return None
+        seq_cols = seq_cols + (vlen[:int(n_rows)],
+                               arena[:arena_size].tobytes())
     n = max(int(n_rows), 1)
     doc = np.zeros(n, dtype=np.int32)
     key = np.zeros(n, dtype=np.int32)
@@ -317,7 +334,8 @@ def ingest_changes(buffers, doc_ids, with_meta=False, with_seq=False,
             'packed': packed[:int(n_rows)], 'value': val[:int(n_rows)],
             'flags': flags[:int(n_rows)]}
     if seq_cols is not None:
-        rows['obj'], rows['ref'], rows['vtype'] = seq_cols
+        (rows['obj'], rows['ref'], rows['vtype'], rows['vlen'],
+         rows['vblob']) = seq_cols
     if with_meta:
         rows['pred_off'], rows['pred'] = preds
         return rows, keys, actors, metas

@@ -602,6 +602,11 @@ struct IngestCtx {
   // packed referent elemId (0 = head/none), wire value-type tag low nibble
   std::vector<int32_t> out_obj, out_ref;
   std::vector<uint8_t> out_vtype;
+  // Boxed-value passthrough (with_seq only): rows whose payload an int32
+  // lane can't carry (strings/floats/bytes, multi-char text) get their raw
+  // wire value bytes appended here; out_vlen is 0 for inline-value rows
+  std::vector<int32_t> out_vlen;
+  std::vector<uint8_t> val_arena;
 };
 
 // Intern an actor given its raw (binary) bytes, caching by raw bytes so the
@@ -650,7 +655,8 @@ constexpr int kColInsert = 0x34, kColAction = 0x42;
 constexpr int kColValLen = 0x56, kColValRaw = 0x57;
 constexpr int kColPredNum = 0x70, kColPredActor = 0x71, kColPredCtr = 0x73;
 constexpr int kActionSet = 1, kActionDel = 3, kActionInc = 5;
-constexpr int kActionMakeList = 2, kActionMakeText = 4;
+constexpr int kActionMakeMap = 0, kActionMakeList = 2;
+constexpr int kActionMakeText = 4, kActionMakeTable = 6;
 constexpr int kActorBits = 8;
 
 // Decode a UTF-8 buffer holding EXACTLY one code point; returns it or -1.
@@ -933,19 +939,24 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
     if (ctr >= (int64_t(1) << (31 - kActorBits))) return false;
     int32_t self_packed = int32_t((ctr << kActorBits) | actor_id);
 
-    if (!is_root && with_seq) {
-      // ---- sequence element op (flags 3-6) ----
-      if (key >= 0) return false;     // keyed op on an object: table/map
-      if (action != kActionSet && action != kActionDel &&
-          action != kActionInc)
-        return false;                 // nested make / link: host engine
+    // Containing object for non-root ops, packed (ctr << bits) | actor
+    int32_t obj_packed = 0;
+    if (!is_root) {
       if (i >= obj_actor.size() || !obj_actor_ok[i]) return false;
       uint64_t ta = uint64_t(obj_actor[i]);
       if (ta >= actor_table.size()) return false;
       int64_t objc = (i < obj_ctr.size()) ? obj_ctr[i] : 0;
       if (objc <= 0 || objc >= (int64_t(1) << (31 - kActorBits)))
         return false;
-      int32_t obj = int32_t((objc << kActorBits) | actor_table[ta]);
+      obj_packed = int32_t((objc << kActorBits) | actor_table[ta]);
+    }
+
+    if (!is_root && with_seq && key < 0) {
+      // ---- sequence element op (flags 3-6) ----
+      if (action != kActionSet && action != kActionDel &&
+          action != kActionInc)
+        return false;                 // make inside a sequence: host engine
+      int32_t obj = obj_packed;
       // referent elemId: keyCtr 0 = '_head' (insert only); else packed
       if (i >= key_ctr.size() || !key_ctr_ok[i]) return false;
       int64_t kc = key_ctr[i];
@@ -979,20 +990,46 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
       } else {
         uint64_t p = 0;
         int err = 0;
+        bool boxed = false;
         if (vtype == 3) {
           value = int64_t(read_uleb(vbytes, vsize, &p, &err));
         } else if (vtype == 4 || vtype == 8 || vtype == 9) {
           value = read_sleb(vbytes, vsize, &p, &err);
-        } else if (vtype == 6) {      // UTF-8: single code point inline
+        } else if (vtype == 6) {      // UTF-8: single code point inline,
           value = utf8_single_cp(vbytes, vsize);
-          if (value < 0) return false;
+          if (value < 0) {            // multi-char spans box via the arena
+            value = 0;
+            boxed = true;
+          }
+        } else if (vtype <= 9) {      // null/bool/float/bytes: arena
+          value = 0;
+          boxed = true;
         } else {
-          return false;               // null/bool/float/bytes: host table
+          return false;               // unknown value types: host engine
         }
         if (err) return false;
-        if (vtype != 6 && (value < 0 || value >= (int64_t(1) << 31)))
-          return false;
+        if (!boxed && vtype != 6 &&
+            (value < 0 || value >= (int64_t(1) << 31))) {
+          value = 0;                  // out-of-int32-lane ints box too
+          boxed = true;
+        }
         flags = insert ? 3 : 4;
+        if (boxed) {
+          if (vsize == 0 && vtype >= 5) return false;  // malformed
+          ctx.out_vlen.push_back(int32_t(vsize));
+          ctx.val_arena.insert(ctx.val_arena.end(), vbytes, vbytes + vsize);
+        } else {
+          ctx.out_vlen.push_back(0);
+        }
+        ctx.out_doc.push_back(doc);
+        ctx.out_key.push_back(-1);
+        ctx.out_packed.push_back(self_packed);
+        ctx.out_val.push_back(int32_t(value));
+        ctx.out_flags.push_back(flags);
+        ctx.out_obj.push_back(obj);
+        ctx.out_ref.push_back(ref);
+        ctx.out_vtype.push_back(uint8_t(vtype));
+        continue;
       }
       ctx.out_doc.push_back(doc);
       ctx.out_key.push_back(-1);
@@ -1002,28 +1039,37 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
       ctx.out_obj.push_back(obj);
       ctx.out_ref.push_back(ref);
       ctx.out_vtype.push_back(uint8_t(vtype));
+      ctx.out_vlen.push_back(0);
       continue;
     }
 
-    // ---- root-map op ----
-    if (!is_root) return false;       // seq op without with_seq
+    // ---- keyed map/table op (root, or a nested object under with_seq;
+    // without with_seq the flat register path accepts root only) ----
+    if (!is_root && !with_seq) return false;
     if (insert) return false;
     if (key < 0) return false;
-    if (with_seq &&
-        (action == kActionMakeText || action == kActionMakeList)) {
+    if (with_seq && (action == kActionMakeText || action == kActionMakeList ||
+                     action == kActionMakeMap || action == kActionMakeTable)) {
+      // makes become flag-coded rows: 7 makeText, 8 makeList, 9 makeMap,
+      // 10 makeTable; out_obj carries the (possibly nested) parent
       if (vsize != 0) return false;
+      uint8_t mk = action == kActionMakeText ? 7
+          : action == kActionMakeList ? 8
+          : action == kActionMakeMap ? 9 : 10;
       ctx.out_doc.push_back(doc);
       ctx.out_key.push_back(key);
       ctx.out_packed.push_back(self_packed);
       ctx.out_val.push_back(0);
-      ctx.out_flags.push_back(action == kActionMakeText ? 7 : 8);
-      ctx.out_obj.push_back(0);
+      ctx.out_flags.push_back(mk);
+      ctx.out_obj.push_back(obj_packed);
       ctx.out_ref.push_back(0);
       ctx.out_vtype.push_back(0);
+      ctx.out_vlen.push_back(0);
       continue;
     }
 
     int64_t value = 0;
+    bool boxed = false;
     if (action == kActionSet || action == kActionInc) {
       uint64_t p = 0;
       int err = 0;
@@ -1031,20 +1077,30 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
         value = int64_t(read_uleb(vbytes, vsize, &p, &err));
       } else if (vtype == 4 || vtype == 8 || vtype == 9) {  // int/counter/ts
         value = read_sleb(vbytes, vsize, &p, &err);
+      } else if (with_seq && action == kActionSet && vtype <= 9) {
+        // null/bool/str/float/bytes set values ride the arena and box
+        // host-side (the flat register path without with_seq keeps its
+        // int-only contract)
+        boxed = true;
       } else {
-        return false;  // non-integer value: general engine path
+        return false;  // inc of a non-int / unknown value type: host path
       }
       if (err) return false;
       // inc deltas are raw int32 addends (negatives allowed); set values
-      // must be non-negative inline ints (others use the host value table)
+      // must be non-negative inline ints (others box via the arena)
       if (action == kActionInc) {
         if (value <= -(int64_t(1) << 31) || value >= (int64_t(1) << 31))
           return false;
-      } else if (value < 0 || value >= (int64_t(1) << 31)) {
-        return false;
+      } else if (!boxed && (value < 0 || value >= (int64_t(1) << 31))) {
+        if (!with_seq) return false;
+        boxed = true;               // out-of-lane ints box too
+      }
+      if (boxed) {
+        if (vsize == 0 && vtype >= 5) return false;  // empty str/bytes/f64
+        value = 0;
       }
     } else if (action != kActionDel) {
-      return false;  // make*/link need the general engine
+      return false;  // link needs the general engine
     }
 
     ctx.out_doc.push_back(doc);
@@ -1055,9 +1111,15 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
     ctx.out_val.push_back(action == kActionDel ? -1 : int32_t(value));
     ctx.out_flags.push_back(action == kActionInc ? 2 : 1);
     if (with_seq) {
-      ctx.out_obj.push_back(0);
+      ctx.out_obj.push_back(obj_packed);   // 0 = root; else nested parent
       ctx.out_ref.push_back(0);
       ctx.out_vtype.push_back(uint8_t(vtype));
+      if (boxed) {
+        ctx.out_vlen.push_back(int32_t(vsize));
+        ctx.val_arena.insert(ctx.val_arena.end(), vbytes, vbytes + vsize);
+      } else {
+        ctx.out_vlen.push_back(0);
+      }
     }
   }
   if (with_meta) ctx.m_nops.push_back(int64_t(ctx.out_doc.size() - rows_before));
@@ -1244,6 +1306,25 @@ int64_t am_ingest_seq_fetch(int32_t *obj, int32_t *ref, uint8_t *vtype) {
 
 // Number of pred entries captured by the last am_ingest_changes call
 // (with_meta=1), so the caller can size the fetch buffer exactly.
+// Boxed-value arena size for the pending ingest (with_seq only).
+int64_t am_ingest_val_size() {
+  return g_ingest ? int64_t(g_ingest->val_arena.size()) : -1;
+}
+
+// Copy per-row boxed-value lengths + the raw value arena. Rows with
+// vlen == 0 carry inline values (or none); boxed rows' wire bytes
+// concatenate in row order. Must run before am_ingest_fetch.
+int64_t am_ingest_val_fetch(int32_t *vlen, uint8_t *arena, uint64_t cap) {
+  if (!g_ingest) return -1;
+  IngestCtx &ctx = *g_ingest;
+  if (ctx.out_vlen.size() != ctx.out_doc.size()) return -1;
+  if (ctx.val_arena.size() > cap) return -1;
+  memcpy(vlen, ctx.out_vlen.data(), ctx.out_vlen.size() * 4);
+  if (!ctx.val_arena.empty())
+    memcpy(arena, ctx.val_arena.data(), ctx.val_arena.size());
+  return int64_t(ctx.val_arena.size());
+}
+
 int64_t am_ingest_pred_count() {
   if (!g_ingest) return -1;
   return int64_t(g_ingest->out_pred.size());

@@ -121,11 +121,15 @@ def test_chaos_differential(seed):
                     f'{tag}: {u.name} save bytes diverge from {base[0]}'
         return base[2]
 
-    # seed replicas (same initial change everywhere: same actor, time 0)
+    # seed replicas: identical initial change everywhere — change times are
+    # pinned to 0 throughout, or wall-clock seconds straddling a universe
+    # boundary would legitimately fork the change hashes
     for u in universes:
         def build():
-            base = A.from_({'text': A.Text('seed'), 'list': [1, 2],
-                            'counts': {}, 'nested': {}}, ACTORS[0])
+            base = A.change(
+                A.init(ACTORS[0]), {'message': 'Initialization', 'time': 0},
+                lambda d: d.update({'text': A.Text('seed'), 'list': [1, 2],
+                                    'counts': {}, 'nested': {}}))
             return [base] + [A.merge(A.init(a), base) for a in ACTORS[1:]]
         u.docs = u.with_backend(build)
 
@@ -136,7 +140,7 @@ def test_chaos_differential(seed):
             edit = _random_edit(rng.getrandbits(32))
             for u in universes:
                 u.docs[i] = u.with_backend(
-                    lambda u=u, i=i: A.change(u.docs[i], edit))
+                    lambda u=u, i=i: A.change(u.docs[i], {'time': 0}, edit))
         elif action < 0.75:
             j = rng.randrange(len(ACTORS))
             if j != i:
@@ -157,7 +161,7 @@ def test_chaos_differential(seed):
         else:
             for u in universes:
                 u.docs[i] = u.with_backend(
-                    lambda u=u, i=i: A.empty_change(u.docs[i]))
+                    lambda u=u, i=i: A.empty_change(u.docs[i], {'time': 0}))
         if step % 10 == 9:
             # full convergence point: merge everything into replica 0
             for u in universes:

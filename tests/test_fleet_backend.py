@@ -1216,6 +1216,177 @@ class TestTurboSequence:
             fleet_backend.apply_changes_docs([g], [[bogus]], mirror=False)
 
 
+class TestTurboNestedMaps:
+    """Nested map/table changes take the native turbo wire->device path
+    (the parser emits keyed rows with their containing object; makes
+    flag-code as 9/10) — no fallback to the Python decode."""
+
+    @pytest.mark.parametrize('exact', [False, True])
+    def test_nested_tree_through_turbo(self, exact):
+        from automerge_tpu.columnar import encode_change, decode_change_meta
+        A1 = ACTORS[0]
+        fleet = DocFleet(doc_capacity=4, key_capacity=16,
+                         exact_device=exact)
+        fb = FleetBackend(fleet)
+        handles = [fb.init() for _ in range(2)]
+        per_doc = []
+        for d in range(2):
+            c1 = encode_change({
+                'actor': A1, 'seq': 1, 'startOp': 1, 'time': 0,
+                'message': '', 'deps': [], 'ops': [
+                    {'action': 'makeMap', 'obj': '_root', 'key': 'cfg',
+                     'pred': []},
+                    {'action': 'set', 'obj': f'1@{A1}', 'key': 'x',
+                     'value': 5 + d, 'datatype': 'int', 'pred': []},
+                    {'action': 'makeTable', 'obj': '_root', 'key': 'tbl',
+                     'pred': []}]})
+            heads = [decode_change_meta(c1, True)['hash']]
+            c2 = encode_change({
+                'actor': A1, 'seq': 2, 'startOp': 4, 'time': 0,
+                'message': '', 'deps': heads, 'ops': [
+                    {'action': 'set', 'obj': f'1@{A1}', 'key': 'y',
+                     'value': 7, 'datatype': 'int', 'pred': []},
+                    {'action': 'del', 'obj': f'1@{A1}', 'key': 'x',
+                     'pred': [f'2@{A1}']},
+                    {'action': 'set', 'obj': '_root', 'key': 'top',
+                     'value': 1, 'datatype': 'int', 'pred': []}]})
+            per_doc.append([c1, c2])
+        handles, _ = fleet_backend.apply_changes_docs(handles, per_doc,
+                                                      mirror=False)
+        assert fleet.metrics.turbo_calls == 1
+        assert fleet.metrics.fallbacks == 0
+        mats = fleet_backend.materialize_docs(handles)
+        assert mats == [{'cfg': {'y': 7}, 'tbl': {}, 'top': 1}] * 2
+        if exact:
+            # nested patches still device-served after turbo
+            patch = fleet_backend.get_patch(handles[0])
+            cfg = patch['diffs']['props']['cfg'][f'1@{A1}']
+            assert cfg['props']['y'] == {
+                f'4@{A1}': {'type': 'value', 'value': 7,
+                            'datatype': 'int'}}
+            assert fleet.metrics.mirror_rebuilds == 0
+
+    @pytest.mark.parametrize('exact', [False, True])
+    def test_boxed_values_ride_turbo(self, exact):
+        """Strings, bools, None, floats, negative ints, and nested trees
+        built with the real frontend all take the turbo wire->device path
+        (the native parser boxes non-inline payloads via its value arena)
+        with reads and patches identical to the host."""
+        import automerge_tpu as A
+        fleet = DocFleet(doc_capacity=8, key_capacity=64,
+                         exact_device=exact)
+        src = []
+        for i in range(3):
+            d = A.from_({'cfg': {'name': f'doc{i}', 'opts': {'d': 2}},
+                         'tbl': A.Table(), 'n': i, 'f': 2.5, 'ok': True,
+                         'nil': None, 'neg': -7}, ACTORS[0])
+            d = A.change(d, lambda r: (
+                r['cfg'].__setitem__('rev', 3),
+                r['tbl'].add({'row': 'textual'})))
+            d = A.change(d, lambda r: r['cfg']['opts'].__setitem__(
+                'extra', 'yes!'))
+            src.append(d)
+        per_doc = [[bytes(c) for c in A.get_all_changes(d)] for d in src]
+        fb = FleetBackend(fleet)
+        handles = [fb.init() for _ in src]
+        handles, _ = fleet_backend.apply_changes_docs(handles, per_doc,
+                                                      mirror=False)
+        assert fleet.metrics.turbo_calls == 1
+        assert fleet.metrics.fallbacks == 0
+        mats = fleet_backend.materialize_docs(handles)
+        assert mats[0]['cfg'] == {'name': 'doc0',
+                                  'opts': {'d': 2, 'extra': 'yes!'},
+                                  'rev': 3}
+        assert mats[1]['f'] == 2.5 and mats[1]['nil'] is None
+        assert mats[2]['neg'] == -7 and mats[2]['ok'] is True
+        expected = [host_backend.get_patch(host_backend.load(A.save(d)))
+                    for d in src]
+        got = [fleet_backend.get_patch(h) for h in handles]
+        assert got == expected
+        if exact:
+            assert fleet.metrics.mirror_rebuilds == 0
+
+    def test_undecodable_boxed_payload_falls_back_cleanly(self):
+        """A crafted wire change whose boxed payload decode_value rejects
+        (uint64 past the 2^53 read limit — constructible only by a foreign
+        or malicious peer, our encoder caps at 53 bits) must route to the
+        exact path BEFORE the turbo commit point: the doc stays untouched
+        instead of heads/logs advancing around a raised decode."""
+        from automerge_tpu.columnar import encode_container, \
+            CHUNK_TYPE_CHANGE
+        from automerge_tpu.encoding import Encoder, RLEEncoder
+        A1 = ACTORS[0]
+
+        def uleb(v):
+            out = bytearray()
+            while True:
+                b = v & 0x7f
+                v >>= 7
+                out.append(b | (0x80 if v else 0))
+                if not v:
+                    return bytes(out)
+
+        raw = uleb(2 ** 60)                 # 9-byte LEB128 uint
+        ks = RLEEncoder('utf8')
+        ks.append_value('x')
+        ks.finish()
+        act = RLEEncoder('uint')
+        act.append_value(1)                 # set
+        act.finish()
+        vlen = RLEEncoder('uint')
+        vlen.append_value((len(raw) << 4) | 3)   # LEB128_UINT tag
+        vlen.finish()
+        pn = RLEEncoder('uint')
+        pn.append_value(0)
+        pn.finish()
+        cols = [(0x15, ks.buffer), (0x42, act.buffer),
+                (0x56, vlen.buffer), (0x57, raw), (0x70, pn.buffer)]
+        body = Encoder()
+        body.append_uint53(0)               # deps
+        body.append_hex_string(A1)
+        body.append_uint53(1)               # seq
+        body.append_uint53(1)               # startOp
+        body.append_int53(0)                # time
+        body.append_prefixed_string('')     # message
+        body.append_uint53(0)               # other actors
+        body.append_uint53(len(cols))
+        for cid, buf in cols:
+            body.append_uint53(cid)
+            body.append_uint53(len(buf))
+        for _cid, buf in cols:
+            body.append_raw_bytes(buf)
+        _h, big = encode_container(CHUNK_TYPE_CHANGE, body.buffer)
+
+        fleet = DocFleet(doc_capacity=2, key_capacity=8)
+        fb = FleetBackend(fleet)
+        handle = fb.init()
+        with pytest.raises(ValueError):
+            fleet_backend.apply_changes_docs([handle], [[big]],
+                                             mirror=False)
+        # the turbo guard bailed pre-commit; the exact path raised with
+        # the doc untouched
+        assert fleet.metrics.turbo_calls == 0
+        assert handle['state'].heads == []
+        assert len(handle['state'].changes) == 0
+
+    def test_dangling_nested_object_falls_back(self):
+        """A keyed op on an unknown map object routes to the exact path
+        (which raises the reference's error) instead of corrupting."""
+        from automerge_tpu.columnar import encode_change
+        A1 = ACTORS[0]
+        fleet = DocFleet(doc_capacity=2, key_capacity=8)
+        fb = FleetBackend(fleet)
+        handle = fb.init()
+        bad = encode_change({
+            'actor': A1, 'seq': 1, 'startOp': 1, 'time': 0, 'message': '',
+            'deps': [], 'ops': [
+                {'action': 'set', 'obj': f'99@{A1}', 'key': 'x',
+                 'value': 1, 'datatype': 'int', 'pred': []}]})
+        with pytest.raises(Exception):
+            fleet_backend.apply_changes_docs([handle], [[bad]],
+                                             mirror=False)
+
+
 class TestSeqSizeClasses:
     """Sequence rows live in pow2 size-class pools (fleet/sequence.py
     SeqPools): memory follows each document's own length, and a long
