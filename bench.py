@@ -613,6 +613,52 @@ def bench_bulk_load(n_docs, n_changes=40, seed=0):
     return bulk, host
 
 
+def bench_backend_mixed(n_docs, n_changes=16, seed=0):
+    """End-to-end seam rate on a REALISTIC document shape: nested config
+    maps, tables, strings/floats/bools, counters — the workload that used
+    to fall off the turbo path entirely (flat-int-only) and now rides the
+    native parser's nested rows + value arena. Returns (turbo changes/s,
+    host changes/s)."""
+    import jax
+    import automerge_tpu as am
+    from automerge_tpu import backend as Backend
+    from automerge_tpu.fleet.backend import (
+        DocFleet, init_docs, apply_changes_docs)
+    rng = np.random.default_rng(seed)
+    d = am.from_({'cfg': {'name': 'base', 'opts': {'depth': 1}},
+                  'tags': {}, 'n': 0, 'rate': 1.5, 'on': True}, 'ab' * 16)
+    for c in range(n_changes - 1):
+        k = f'k{int(rng.integers(0, 12))}'
+
+        def edit(r, c=c, k=k):
+            r['cfg']['opts'][k] = f'value-{c}'
+            r['tags'][k] = float(c) if c % 3 else c
+            r['n'] = c
+        d = am.change(d, edit)
+    changes = [bytes(b) for b in am.get_all_changes(d)]
+    per_doc = [list(changes) for _ in range(n_docs)]
+    n_total = n_changes * n_docs
+
+    def run():
+        fleet = DocFleet(doc_capacity=n_docs, key_capacity=64)
+        handles = init_docs(n_docs, fleet)
+        handles, _ = apply_changes_docs(handles, per_doc, mirror=False)
+        assert fleet.metrics.fallbacks == 0 and fleet.metrics.turbo_calls
+        if fleet.state is not None:
+            jax.block_until_ready(fleet.state.winners)
+
+    run()
+    rate = median_rate(run, n_total, reps=3)
+    host_docs = max(n_docs // 50, 1)
+
+    def run_host():
+        for _ in range(host_docs):
+            backend = Backend.init()
+            Backend.apply_changes(backend, changes)
+    host = median_rate(run_host, n_changes * host_docs, reps=3)
+    return rate, host
+
+
 def bench_native_save(n_changes=200, seed=0):
     """Mirror-free native save (C++ change-log replay + canonical encode)
     vs the host OpSet replay + Python encode, same change log. Returns
@@ -696,6 +742,8 @@ def main():
         int(os.environ.get('BENCH_LOAD_DOCS', 2000)))
     save_native, save_host = bench_native_save(
         int(os.environ.get('BENCH_SAVE_CHANGES', 200)))
+    mixed_rate, mixed_host = bench_backend_mixed(
+        int(os.environ.get('BENCH_MIXED_DOCS', 500)))
 
     print(f'# HEADLINE backend-seam end-to-end (turbo, incl. hash graph): '
           f'{seam_rate:.0f} changes/s (median of {REPS})', file=sys.stderr)
@@ -737,6 +785,10 @@ def main():
               f'{save_native:.1f} saves/s vs host replay+encode '
               f'{save_host:.1f} saves/s ({save_native / save_host:.1f}x)',
               file=sys.stderr)
+    print(f'# backend-seam e2e, realistic mixed docs (nested trees, '
+          f'strings/floats/bools): {mixed_rate:.0f} changes/s vs host '
+          f'{mixed_host:.0f} changes/s ({mixed_rate / mixed_host:.1f}x)',
+          file=sys.stderr)
 
     result = {
         'metric': 'changes_per_sec_backend_seam_e2e',
