@@ -267,6 +267,32 @@ class DocFleet:
     def dispatches(self):
         return self.metrics.dispatches
 
+    def memory_stats(self):
+        """Device-state byte accounting per component: the LWW grid or
+        register state, and each sequence size-class pool (observability
+        for capacity planning; host-side shapes only, no transfers)."""
+        def nbytes(arrs):
+            return int(sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                           for a in arrs))
+
+        out = {'total': 0}
+        if self.state is not None:
+            out['lww_grid'] = nbytes(self.state.tree_flatten()[0])
+        if self.reg_state is not None:
+            out['registers'] = nbytes(self.reg_state.tree_flatten()[0])
+        pools = {}
+        for cls, st in sorted(self.seq_pools.pools.items()):
+            pools[cls] = {'capacity': st.capacity,
+                          'rows': int(st.elem_id.shape[0]),
+                          'actor_lanes': int(st.actor_slots),
+                          'bytes': nbytes(st.tree_flatten()[0])}
+        if pools:
+            out['seq_pools'] = pools
+        out['total'] = out.get('lww_grid', 0) + out.get('registers', 0) + \
+            sum(p['bytes'] for p in pools.values())
+        out['value_table_entries'] = len(self.value_table)
+        return out
+
     # -- slot management ------------------------------------------------
 
     def alloc_slot(self):

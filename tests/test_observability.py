@@ -56,3 +56,28 @@ def test_metrics_repr_and_timed():
     assert snap['dispatches'] == 3
     d = m.delta(snap)
     assert d['dispatches'] == 0
+
+
+def test_fleet_memory_stats():
+    """DocFleet.memory_stats reports per-component device byte accounting
+    (grid/registers + each sequence size-class pool)."""
+    import automerge_tpu as A
+    from automerge_tpu.fleet.backend import DocFleet, FleetBackend
+    fleet = DocFleet(doc_capacity=4, key_capacity=8)
+    A.set_default_backend(FleetBackend(fleet))
+    try:
+        d = A.from_({'t': A.Text('hello'), 'x': 1}, '01' * 8)
+        big = A.from_({'t': A.Text('y' * 200)}, '89' * 8)
+        fleet.flush()
+        stats = fleet.memory_stats()
+        assert stats['total'] > 0
+        assert 'lww_grid' in stats
+        assert len(stats['seq_pools']) >= 2      # two size classes in use
+        for pool in stats['seq_pools'].values():
+            assert pool['bytes'] > 0 and pool['capacity'] >= 64
+        # the 200-char Text span interned at least one boxed value
+        assert stats['value_table_entries'] >= 1
+        del d, big
+    finally:
+        from automerge_tpu import backend as host_backend
+        A.set_default_backend(host_backend)
