@@ -50,7 +50,12 @@ def _random_edit(edit_seed):
                 r[key] = A.Int(1589032171000) if choice < 0.8 else \
                     A.Uint(rng.randrange(99))
         elif roll < 0.48:
-            r['counts'][rng.choice('xyz')] = A.Counter(rng.randrange(10))
+            k = rng.choice('xyz')
+            m = r['counts']
+            if k in m and hasattr(m[k], 'increment'):
+                m[k].increment(1)    # Counters cannot be overwritten
+            else:
+                m[k] = A.Counter(rng.randrange(10))
         elif roll < 0.56:
             m = r['counts']
             k = rng.choice('xyz')
