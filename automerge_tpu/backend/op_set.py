@@ -385,6 +385,15 @@ class OpSet(HashGraph):
             self._apply_op(patches, op_id, op, object_ids)
 
     def _apply_op(self, patches, op_id, op, object_ids):
+        if op['action'] == 'link':
+            # `link` is a reserved slot in the wire-format action table
+            # (ref columnar.js:51-52) that the reference engine never
+            # emits or applies (open TODO at new.js:893, zero test
+            # coverage). Storing the op anyway would leave an untracked
+            # parent-child edge and a patch referencing a child object
+            # that never resolves, so we reject loudly instead of
+            # diverging silently. Documented in PARITY.md.
+            raise ValueError(f'link operations are not supported (op {op_id})')
         object_id = op['obj']
         obj = self.objects.get(object_id)
         if obj is None:

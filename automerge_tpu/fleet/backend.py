@@ -1373,6 +1373,12 @@ class _FlatEngine(HashGraph):
         register engine pack raw counters — ops at or past CTR_LIMIT on
         those paths promote cleanly here, BEFORE any state mutates."""
         action = op['action']
+        if action == 'link':
+            # Reserved wire-table action the reference never applies
+            # (new.js:893 TODO). Reject here in the pre-scan — before the
+            # _Unsupported promotion path — so a bogus change cannot cost
+            # the document its device slot (see PARITY.md).
+            raise ValueError('link operations are not supported')
         if op['obj'] == '_root' or op['obj'] in made_map:
             if op.get('insert') or op.get('key') is None:
                 raise _Unsupported()

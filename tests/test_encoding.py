@@ -375,13 +375,17 @@ class TestRLE:
         assert encode_rle('uint', [1, 1, 1, 1, 1, None, None, None, 1]) == \
             bytes([5, 1, 0, 3, 0x7f, 1])
 
-    def test_round_trip(self):
+    def test_round_trip_without_nulls(self):
         for seq in ([], [1, 2, 3], [0, 1, 2, 2, 3], [1, 1, 1, 1, 1, 1],
-                    [1, 1, 1, 4, 4, 4], [0xff], [None, 1], [1, None],
+                    [1, 1, 1, 4, 4, 4], [0xff]):
+            assert decode_rle('uint', encode_rle('uint', seq)) == seq
+        assert decode_rle('int', encode_rle('int', [-0x40])) == [-0x40]
+
+    def test_round_trip_with_nulls(self):
+        for seq in ([None, 1], [1, None],
                     [1, 1, 1, None], [None, None, None, 3, 4, 5, None],
                     [None, None, None, 9, 9, 9], [1, 1, 1, 1, 1, None, None, None, 1]):
             assert decode_rle('uint', encode_rle('uint', seq)) == seq
-        assert decode_rle('int', encode_rle('int', [-0x40])) == [-0x40]
 
     def test_string_values(self):
         assert encode_rle('utf8', ['a']) == bytes([0x7f, 1, 0x61])
@@ -392,6 +396,8 @@ class TestRLE:
             bytes([2, 1, 0x61, 0, 2, 2, 1, 0x61])
         assert encode_rle('utf8', [None, None, None, None, 'abc']) == \
             bytes([0, 4, 0x7f, 3, 0x61, 0x62, 0x63])
+
+    def test_round_trip_string_values(self):
         for seq in (['a'], ['a', 'b', 'c', 'd'], ['a', 'a', 'a', 'a'],
                     ['a', 'a', None, None, 'a', 'a'], [None, None, None, None, 'abc']):
             assert decode_rle('utf8', encode_rle('utf8', seq)) == seq

@@ -550,6 +550,31 @@ class TestPromotion:
         gb, _ = fleet_backend.apply_changes(gb, [nested_in_list])
         assert not gb['state'].is_fleet
 
+    def test_link_op_rejected_loudly(self):
+        """`link` is a reserved action the reference never applies
+        (new.js:893 TODO); both engines reject it with the same error
+        instead of silently promoting or storing a dangling child edge."""
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=2))
+        gb = fb.init()
+        link = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'link', 'obj': '_root', 'key': 'x',
+             'child': f'1@{ACTORS[1]}', 'pred': []}])
+        with pytest.raises(ValueError, match='link operations are not supported'):
+            fleet_backend.apply_changes(gb, [link])
+        with pytest.raises(ValueError, match='link operations are not supported'):
+            host_backend.apply_changes(host_backend.init(), [link])
+        # The rejection must be free: no promotion, no lost device slot
+        assert gb['state'].is_fleet
+        assert fb.fleet.metrics.promotions == 0
+        # The failed call must not corrupt the handle: it still applies
+        # ordinary changes afterwards, still fleet-resident
+        ok = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 5,
+             'datatype': 'int', 'pred': []}])
+        gb, patch = fleet_backend.apply_changes(gb, [ok])
+        assert patch['clock'] == {ACTORS[0]: 1}
+        assert gb['state'].is_fleet
+
     def test_promotion_preserves_queue(self):
         fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=2))
         gb = fb.init()
