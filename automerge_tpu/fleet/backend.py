@@ -15,12 +15,15 @@ at once. The host keeps only what is inherently host work:
 - wire encode/decode.
 
 Map trees (nested maps/tables, keyed by two-level (objectId, key) interned
-grid columns) and root-key sequence objects (Text/lists, as device RGA rows)
-stay fleet-resident. Documents whose changes leave that subset (objects
-inside sequences, link ops) transparently *promote*: their change log
-replays into the host OpSet engine and every later call delegates to it, so
-the full reference semantics are always available — the fleet path is an
-accelerator, never a semantic fork.
+grid columns), sequence objects (Text/lists, as device RGA rows), and
+objects nested inside sequences (rows-in-lists: the element value links to
+the child object, which interns like any registered object) all stay
+fleet-resident. Documents whose changes leave that subset (packed-counter
+overflow on sequence paths, oversized actor populations) transparently
+*promote*: their change log replays into the host OpSet engine and every
+later call delegates to it, so the full reference semantics are always
+available — the fleet path is an accelerator, never a semantic fork.
+`link` ops reject loudly in the pre-scan (see PARITY.md).
 
 Scale notes: one fleet packs up to 256 actors (tensor_doc.ACTOR_BITS); actor
 numbers are kept in actor-hex sort order so the device's packed-opId
@@ -503,7 +506,7 @@ class DocFleet:
             return value
         return self._intern_value_boxed(value)
 
-    def _pack_seq_op(self, row, info, op, packed):
+    def _pack_seq_op(self, row, info, op, packed, op_id=None):
         """One decoded sequence op -> (row, kind, ref, packed, value,
         pred0..predD-1, flag) with packed opIds in fleet actor numbering."""
         from .sequence import INSERT, SET, DEL, PAD, SEQ_PRED_LANES
@@ -532,6 +535,25 @@ class DocFleet:
             flag = True
         elif action == 'del':
             kind, value = DEL, 0
+        elif action in _SEQ_MAKE or action in _MAP_MAKE:
+            # Nested object as a sequence element (rows-in-lists, lists in
+            # lists; ref new.js:1461-1528 objectMeta ancestry): the element
+            # value is a link to the child object, which registers like any
+            # fleet object — (objectId, key) grid columns for maps/tables,
+            # its own SeqState row for text/lists.
+            kind = INSERT if op.get('insert') else SET
+            if action in _SEQ_MAKE:
+                if op_id not in self.slot_seq.get(info['slot'], {}):
+                    self._alloc_seq_row(info['slot'], op_id,
+                                        OBJECT_TYPE[action])
+                value = self._intern_value_boxed(_SeqLink(op_id))
+            else:
+                value = self._intern_value_boxed(
+                    _MapLink(op_id, OBJECT_TYPE[action]))
+            if info['type'] == 'text':
+                # Object elements inside Text render as spans, which stay
+                # mirror territory: flag the row so reads route there
+                flag = True
         else:
             kind = INSERT if op.get('insert') else SET
             value = self._intern_seq_value(info['type'], op)
@@ -932,7 +954,7 @@ class DocFleet:
                 row = self.slot_seq[d][obj]
                 packed = pack_op_id(ctr, self.actors.intern(actor))
                 seq_ops.append(self._pack_seq_op(row, self.seq_rows[row],
-                                                 op, packed))
+                                                 op, packed, op_id=op_id))
                 continue
             packed = self._slot_pack(d, ctr, self.actors.intern(actor))
             # Root keys intern as bare strings (shared with the native
@@ -1013,7 +1035,7 @@ class DocFleet:
             if obj != '_root' and obj in self.slot_seq.get(d, {}):
                 row = self.slot_seq[d][obj]
                 seq_ops.append(self._pack_seq_op(row, self.seq_rows[row],
-                                                 op, packed))
+                                                 op, packed, op_id=op_id))
                 continue
             if action in _SEQ_MAKE:
                 self._alloc_seq_row(
@@ -1094,6 +1116,7 @@ class DocFleet:
                 continue
             root_cells = {}      # root key -> value
             nested = {}          # objectId -> {key: value}
+            any_seq = False
             live = np.flatnonzero(winners[slot, :len(self.keys)])
             for k in live:
                 v = int(values[slot, k])
@@ -1101,9 +1124,7 @@ class DocFleet:
                     continue
                 value = self.value_table[-v - 2] if v <= -2 else v
                 if isinstance(value, _SeqLink):
-                    if rendered is None:
-                        rendered = self.render_seq_all()
-                    value = self._resolve_link(slot, value, rendered)
+                    any_seq = True
                 elif not isinstance(value, _MapLink):
                     c = int(counters[slot, k])
                     if c and isinstance(value, int) and \
@@ -1114,33 +1135,40 @@ class DocFleet:
                     nested.setdefault(key[0], {})[key[1]] = value
                 else:
                     root_cells[key] = value
-            out.append(self._resolve_map_links(root_cells, nested))
+            if any_seq and rendered is None:
+                rendered = self.render_seq_all()
+            out.append({key: self._resolve_value(slot, v, rendered or {},
+                                                 nested)
+                        for key, v in root_cells.items()})
         return out
 
-    def _resolve_map_links(self, cells, nested, depth=0):
-        """Resolve _MapLink values in `cells` into nested dicts assembled
-        from `nested` (objectId -> {key: value}). Objects form a tree (one
-        make op = one parent); past the recursion backstop the link is left
-        unresolved, which routes bulk readers to the host mirror (the same
-        fallback device-inexact sequence rows use)."""
+    def _resolve_value(self, slot, value, rendered, nested, depth=0):
+        """Resolve link values into rendered subtrees with slot context:
+        _MapLink -> nested dict assembled from the (objectId, key) grid
+        cells; _SeqLink -> the rendered device sequence row, with list
+        elements resolved recursively so objects nested inside sequences
+        materialize straight from device state (the two-level interning of
+        the reference's objectMeta ancestry, ref new.js:1461-1528).
+        Unresolved links (device-inexact rows, recursion backstop) stay in
+        place, which routes bulk readers to the host mirror."""
         if depth > 128:
-            return cells
-        doc = {}
-        for key, value in cells.items():
-            if isinstance(value, _MapLink):
-                value = self._resolve_map_links(
-                    nested.get(value.object_id, {}), nested, depth + 1)
-            doc[key] = value
-        return doc
-
-    def _resolve_link(self, slot, link, rendered):
-        """Device render for a sequence link; returns the link itself when
-        the row is device-inexact (callers fall back to the host mirror)."""
-        row = self.slot_seq.get(slot, {}).get(link.object_id)
-        if row is None:
-            return link
-        r = rendered.get(row)
-        return link if r is None else r
+            return value
+        if isinstance(value, _SeqLink):
+            row = self.slot_seq.get(slot, {}).get(value.object_id)
+            if row is None:
+                return value
+            r = rendered.get(row)
+            if r is None:
+                return value
+            if isinstance(r, list):
+                return [self._resolve_value(slot, v, rendered, nested,
+                                            depth + 1) for v in r]
+            return r
+        if isinstance(value, _MapLink):
+            return {k: self._resolve_value(slot, v, rendered, nested,
+                                           depth + 1)
+                    for k, v in nested.get(value.object_id, {}).items()}
+        return value
 
     def materialize(self, slot):
         return self.materialize_all()[slot]
@@ -1162,16 +1190,19 @@ class DocFleet:
                 # LWW grid and host mirror both report them; only absent /
                 # fully-deleted keys are omitted)
                 root_cells, nested = {}, {}
+                any_seq = False
                 for k, (v, _conflicts) in docs[slot].items():
                     if isinstance(v, _SeqLink):
-                        if rendered is None:
-                            rendered = self.render_seq_all()
-                        v = self._resolve_link(slot, v, rendered)
+                        any_seq = True
                     if isinstance(k, tuple):
                         nested.setdefault(k[0], {})[k[1]] = v
                     else:
                         root_cells[k] = v
-                out.append(self._resolve_map_links(root_cells, nested))
+                if any_seq and rendered is None:
+                    rendered = self.render_seq_all()
+                out.append({k: self._resolve_value(slot, v, rendered or {},
+                                                   nested)
+                            for k, v in root_cells.items()})
         return out
 
     def conflicts_all(self):
@@ -1316,7 +1347,8 @@ class _FlatEngine(HashGraph):
             start, actor = change['startOp'], change['actor']
             for i, op in enumerate(change['ops']):
                 self._check_supported(op, made_seq, made_map, ctr=start + i)
-                if op['obj'] == '_root' or op['obj'] in made_map:
+                if op['obj'] == '_root' or op['obj'] in made_map or \
+                        op['obj'] in made_seq:
                     if op['action'] in _SEQ_MAKE:
                         made_seq.add(f'{start + i}@{actor}')
                     elif op['action'] in _MAP_MAKE:
@@ -1340,7 +1372,8 @@ class _FlatEngine(HashGraph):
         for change in all_applied:
             self._record_applied(change)
             for i, op in enumerate(change['ops']):
-                if op['obj'] == '_root' or op['obj'] in self.map_objects:
+                if op['obj'] == '_root' or op['obj'] in self.map_objects \
+                        or op['obj'] in self.seq_objects:
                     oid = f"{change['startOp'] + i}@{change['actor']}"
                     if op['action'] in _SEQ_MAKE:
                         self.seq_objects[oid] = OBJECT_TYPE[op['action']]
@@ -1398,8 +1431,12 @@ class _FlatEngine(HashGraph):
             return
         if op['obj'] not in made_seq:
             raise _Unsupported()
-        # No nested objects inside sequences on the fleet path
-        if action not in ('set', 'del', 'inc') or op.get('key') is not None:
+        if action in _SEQ_MAKE or action in _MAP_MAKE:
+            # Nested object as a sequence element: the element value links
+            # to the child, which interns like any registered object
+            if op.get('key') is not None:
+                raise _Unsupported()
+        elif action not in ('set', 'del', 'inc') or op.get('key') is not None:
             raise _Unsupported()
         if ctr is not None and ctr >= CTR_LIMIT:
             raise _Unsupported()      # sequence rows pack raw counters
@@ -2482,11 +2519,13 @@ def _apply_changes_turbo(handles, per_doc_changes):
 def _has_unresolved_link(value):
     """True if a materialized tree still contains a _SeqLink (device-inexact
     sequence row) or _MapLink (recursion-backstopped subtree) anywhere,
-    including inside nested maps."""
+    including inside nested maps and rendered lists."""
     if isinstance(value, (_SeqLink, _MapLink)):
         return True
     if isinstance(value, dict):
         return any(_has_unresolved_link(v) for v in value.values())
+    if isinstance(value, list):
+        return any(_has_unresolved_link(v) for v in value)
     return False
 
 

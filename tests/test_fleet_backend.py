@@ -540,15 +540,26 @@ class TestPromotion:
         hb, gb = apply_both(hb, gb, [more])
         assert bytes(host_backend.save(hb)) == bytes(fleet_backend.save(gb))
 
-    def test_object_inside_sequence_promotes(self):
+    def test_object_inside_sequence_stays_fleet_resident(self):
+        """Rows-in-lists (a map created as a list element,
+        ref new.js:1461-1528) ride the device: the element value links to
+        the child object, whose keys intern as (objectId, key) grid
+        columns like any nested map."""
         fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=2))
+        hb = host_backend.init()
         gb = fb.init()
         nested_in_list = change_buf(ACTORS[0], 1, 1, [
             {'action': 'makeList', 'obj': '_root', 'key': 'l', 'pred': []},
             {'action': 'makeMap', 'obj': f'1@{ACTORS[0]}', 'elemId': '_head',
-             'insert': True, 'pred': []}])
-        gb, _ = fleet_backend.apply_changes(gb, [nested_in_list])
-        assert not gb['state'].is_fleet
+             'insert': True, 'pred': []},
+            {'action': 'set', 'obj': f'2@{ACTORS[0]}', 'key': 'row',
+             'value': 3, 'datatype': 'int', 'pred': []}])
+        hb, gb = apply_both(hb, gb, [nested_in_list])
+        assert gb['state'].is_fleet
+        assert fb.fleet.metrics.promotions == 0
+        from automerge_tpu.fleet.backend import materialize_docs
+        assert materialize_docs([gb]) == [{'l': [{'row': 3}]}]
+        assert bytes(host_backend.save(hb)) == bytes(fleet_backend.save(gb))
 
     def test_link_op_rejected_loudly(self):
         """`link` is a reserved action the reference never applies
@@ -587,11 +598,11 @@ class TestPromotion:
              'datatype': 'int', 'pred': [f'1@{ACTORS[0]}']}], deps=[h1])
         gb, patch = fleet_backend.apply_changes(gb, [c2])
         assert patch['pendingChanges'] == 1
-        nested = change_buf(ACTORS[1], 1, 1, [
-            {'action': 'makeList', 'obj': '_root', 'key': 'l', 'pred': []},
-            {'action': 'makeMap', 'obj': f'1@{ACTORS[1]}', 'elemId': '_head',
-             'insert': True, 'pred': []}])
-        gb, _ = fleet_backend.apply_changes(gb, [nested])
+        # A sequence make past the packed-counter window still promotes
+        from automerge_tpu.fleet.tensor_doc import CTR_LIMIT
+        big = change_buf(ACTORS[1], 1, CTR_LIMIT + 1, [
+            {'action': 'makeList', 'obj': '_root', 'key': 'l', 'pred': []}])
+        gb, _ = fleet_backend.apply_changes(gb, [big])
         assert not gb['state'].is_fleet
         gb, patch = fleet_backend.apply_changes(gb, [c1])
         assert patch['pendingChanges'] == 0

@@ -32,12 +32,12 @@ def test_metrics_counters_track_turbo_and_exact():
     assert d['mirror_rebuilds'] == 1
     assert d['graph_builds'] >= 1
 
-    # Exact path and promotion (nested maps are fleet-resident now; an
-    # object inside a sequence is the remaining promotion trigger)
-    c = change_buf(ACTORS[0], 2, 2, [
-        {'action': 'makeList', 'obj': '_root', 'key': 'l', 'pred': []},
-        {'action': 'makeMap', 'obj': f'2@{ACTORS[0]}', 'elemId': '_head',
-         'insert': True, 'pred': []}],
+    # Exact path and promotion (nested maps AND objects inside sequences
+    # are fleet-resident now; a sequence make past the packed-counter
+    # window is the remaining promotion trigger)
+    from automerge_tpu.fleet.tensor_doc import CTR_LIMIT
+    c = change_buf(ACTORS[0], 2, CTR_LIMIT + 1, [
+        {'action': 'makeList', 'obj': '_root', 'key': 'l', 'pred': []}],
         deps=fleet_backend.get_heads(handles[0]))
     h0, _ = fleet_backend.apply_changes(handles[0], [c])
     d = m.delta(base)
