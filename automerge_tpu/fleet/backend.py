@@ -223,7 +223,16 @@ class DocFleet:
     per flush (lazy: reads flush first)."""
 
     def __init__(self, doc_capacity=64, key_capacity=64,
-                 exact_device=False, actor_slot_capacity=8, d_preds=4):
+                 exact_device=False, actor_slot_capacity=8, d_preds=4,
+                 mesh=None):
+        # Optional jax.sharding.Mesh with a 'docs' axis: the fleet's
+        # grid/register state and every merge batch shard data-parallel
+        # over the docs axis, so the turbo/exact merge dispatches run SPMD
+        # across the mesh (SURVEY.md §2.12 — documents are independent, the
+        # batch axis is the dp axis). Sequence pools stay device-local: the
+        # RGA pointer walk is a per-document scan and their row axis is not
+        # slot-aligned. mesh=None (default) keeps everything single-device.
+        self.mesh = mesh
         self.keys = KeyInterner()
         self.actors = _SortedActorTable()
         self.value_table = _ValueTable()   # non-inline values, -(i + 2) refs
@@ -265,6 +274,33 @@ class DocFleet:
         self.seq_len = []         # row -> host upper bound on elements
         self.seq_free = []
         self.slot_seq = {}        # slot -> {objectId: row}
+
+    def _cap_docs(self, n_docs):
+        """Doc-capacity sizing shared by the grid and register allocators:
+        pow2 growth, raised to a multiple of the mesh docs axis so sharded
+        device_put divides evenly (a bare pow2 fails on e.g. a 6-device
+        axis)."""
+        need = _pow2(max(n_docs, self.doc_cap))
+        if self.mesh is not None:
+            m = self.mesh.shape.get('docs', 1)
+            need = ((need + m - 1) // m) * m
+        return need
+
+    def _shard_docs(self, tree):
+        """Place a pytree of [docs, ...] arrays sharded over the mesh's
+        docs axis (identity when the fleet has no mesh). Used for state
+        allocation/growth and for op batches entering a dispatch, so the
+        jitted merge runs SPMD with XLA inserting any needed collectives."""
+        if self.mesh is None:
+            return tree
+        import jax
+        import jax.tree_util as tree_util
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def put(x):
+            spec = P('docs', *([None] * (x.ndim - 1)))
+            return jax.device_put(x, NamedSharding(self.mesh, spec))
+        return tree_util.tree_map(put, tree)
 
     @property
     def dispatches(self):
@@ -674,14 +710,15 @@ class DocFleet:
             self.pending_actors.update(actors)
 
     def _ensure_capacity(self, n_docs, n_keys):
-        need_docs = _pow2(max(n_docs, self.doc_cap))
+        need_docs = self._cap_docs(n_docs)
         need_keys = _pow2(max(n_keys + 1, self.key_cap))
         if self.state is None:
             import jax.numpy as jnp
             self.doc_cap, self.key_cap = need_docs, need_keys
             # Allocate on device: host-side zeros would ship the whole grid
             # over the transfer link for no reason
-            self.state = FleetState.empty(need_docs, need_keys, xp=jnp)
+            self.state = self._shard_docs(
+                FleetState.empty(need_docs, need_keys, xp=jnp))
             return
         old_n, old_k = self.state.winners.shape
         if need_docs <= old_n and need_keys + 1 <= old_k:
@@ -697,7 +734,7 @@ class DocFleet:
             out = out.at[:old_n, :old_k - 1].set(arr[:, :old_k - 1])
             grown.append(out)
         self.doc_cap, self.key_cap = n, k - 1
-        self.state = FleetState(*grown)
+        self.state = self._shard_docs(FleetState(*grown))
 
     def _remap_actors(self, perm):
         """Renumber the actor bits of every packed opId on the device."""
@@ -716,14 +753,15 @@ class DocFleet:
     def _ensure_reg_capacity(self, n_docs, n_keys):
         from .registers import RegisterState
         import jax.numpy as jnp
-        need_docs = _pow2(max(n_docs, self.doc_cap))
+        need_docs = self._cap_docs(n_docs)
         need_keys = _pow2(max(n_keys + 1, self.key_cap))
         need_slots = _pow2(max(len(self.actors), self.actor_slot_cap))
         if self.reg_state is None:
             self.doc_cap, self.key_cap = need_docs, need_keys
             self.actor_slot_cap = need_slots
-            self.reg_state = RegisterState.empty(need_docs, need_keys - 1,
-                                                 need_slots, xp=jnp)
+            self.reg_state = self._shard_docs(
+                RegisterState.empty(need_docs, need_keys - 1,
+                                    need_slots, xp=jnp))
             return
         old_n, old_k, old_a = self.reg_state.reg.shape
         if need_docs <= old_n and need_keys <= old_k and \
@@ -744,7 +782,7 @@ class DocFleet:
         inexact = inexact.at[:old_n].set(self.reg_state.inexact)
         self.doc_cap, self.key_cap = n, k - 1
         self.actor_slot_cap = a
-        self.reg_state = RegisterState(*grown, inexact)
+        self.reg_state = self._shard_docs(RegisterState(*grown, inexact))
 
     @staticmethod
     def _lane_permutation(perm, n_lanes):
@@ -893,7 +931,8 @@ class DocFleet:
             pad = self.state.winners.shape[0] - batch.key_id.shape[0]
             batch = type(batch)(*(np.pad(col, ((0, pad), (0, 0)))
                                   for col in batch.tree_flatten()[0]))
-        self.state, _stats = apply_op_batch(self.state, batch)
+        self.state, _stats = apply_op_batch(self.state,
+                                            self._shard_docs(batch))
         self.metrics.dispatches += 1
         self.metrics.device_ops += int(batch.valid.sum())
 
@@ -915,7 +954,8 @@ class DocFleet:
             rows['doc'], rows['flags'], rows['key'], rows['packed'],
             rows['value'], rows['pred_off'], rows['pred'],
             n_docs=n_cap, d_preds=self.d_preds)
-        self.reg_state, _stats = apply_register_batch(self.reg_state, batch)
+        self.reg_state, _stats = apply_register_batch(
+            self.reg_state, self._shard_docs(batch))
         self.metrics.dispatches += 1
         self.metrics.device_ops += len(rows['doc'])
 
@@ -1007,7 +1047,8 @@ class DocFleet:
                 valid[d, j] = True
             batch = OpBatch(cols['key_id'], cols['packed'], cols['value'],
                             is_set, is_inc, valid)
-            self.state, _stats = apply_op_batch(self.state, batch)
+            self.state, _stats = apply_op_batch(self.state,
+                                                self._shard_docs(batch))
             self.metrics.dispatches += 1
             self.metrics.device_ops += len(rows)
         self._dispatch_seq(seq_ops)
@@ -1076,8 +1117,8 @@ class DocFleet:
                 np.array(pred_off, dtype=np.int64),
                 np.array(preds, dtype=np.int32),
                 n_docs=n_cap, d_preds=self.d_preds)
-            self.reg_state, _stats = apply_register_batch(self.reg_state,
-                                                          batch)
+            self.reg_state, _stats = apply_register_batch(
+                self.reg_state, self._shard_docs(batch))
             self.metrics.dispatches += 1
             self.metrics.device_ops += len(out_doc)
         self._dispatch_seq(seq_ops)
@@ -2477,8 +2518,8 @@ def _apply_changes_turbo(handles, per_doc_changes):
                 packed, kept_vals_all[keep_root], off_kept, preds_kept,
                 n_docs=n_cap, d_preds=fleet.d_preds,
                 force_overflow=bad_rows)
-            fleet.reg_state, _stats = apply_register_batch(fleet.reg_state,
-                                                           reg_batch)
+            fleet.reg_state, _stats = apply_register_batch(
+                fleet.reg_state, fleet._shard_docs(reg_batch))
             fleet.metrics.dispatches += 1
         dispatch_seq_rows()
         fleet.metrics.device_ops += int(keep.sum())
@@ -2509,7 +2550,8 @@ def _apply_changes_turbo(handles, per_doc_changes):
             pad = n_cap - batch.key_id.shape[0]
             batch = OpBatch(*(np.pad(col, ((0, pad), (0, 0)))
                               for col in batch.tree_flatten()[0]))
-        fleet.state, _stats = apply_op_batch(fleet.state, batch)
+        fleet.state, _stats = apply_op_batch(fleet.state,
+                                             fleet._shard_docs(batch))
         fleet.metrics.dispatches += 1
     dispatch_seq_rows()
     fleet.metrics.device_ops += int(keep.sum())

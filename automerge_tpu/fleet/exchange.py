@@ -122,3 +122,35 @@ def sync_round_sharded(mesh, axis, backends, sync_states, generate, receive):
                 receive(dst, src, inboxes[dst, src, :length].tobytes())
                 moved += 1
     return moved
+
+
+def drive_pairwise_sync(mesh, axis, docs, backend_module, max_rounds=None):
+    """Converge every ordered pair of shard documents with the mesh as the
+    wire: per-pair sync states on host, one all_to_all per round, until a
+    round moves nothing (the sync_test.js driver loop, shard-to-shard).
+    `backend_module` supplies init_sync_state / generate_sync_message /
+    receive_sync_message (host backend or fleet backend — both satisfy the
+    Backend contract). Mutates `docs` in place; returns the round count."""
+    n = mesh.shape[axis]
+    sync_states = {(i, j): backend_module.init_sync_state()
+                   for i in range(n) for j in range(n) if i != j}
+
+    def generate(src, dst):
+        state, msg = backend_module.generate_sync_message(
+            docs[src], sync_states[(src, dst)])
+        sync_states[(src, dst)] = state
+        return msg
+
+    def receive(dst, src, payload):
+        doc, state, _patch = backend_module.receive_sync_message(
+            docs[dst], sync_states[(dst, src)], payload)
+        docs[dst] = doc
+        sync_states[(dst, src)] = state
+
+    rounds = 0
+    for _ in range(max_rounds if max_rounds is not None else 2 * n):
+        rounds += 1
+        if sync_round_sharded(mesh, axis, docs, sync_states,
+                              generate, receive) == 0:
+            break
+    return rounds
