@@ -247,6 +247,16 @@ class DocFleet:
         self.ctr_base = {}        # slot -> int counter base (default 0)
         self.grid_overflow = set()
         self.state = None         # FleetState, allocated on first flush
+        # Host mirror of the grid's scatter-max winners (LWW mode only,
+        # same packing basis per path). The device counter cell cannot
+        # attribute an inc to its pred (apply.py's documented corner: an
+        # inc whose pred lost the key is credited to the winner), so every
+        # flush checks each inc's pred against the post-batch winner here
+        # and flags mismatching slots into grid_overflow — reads for those
+        # slots fall back to the exact host mirror instead of serving the
+        # over-counted cell. Exact-device mode needs none of this (the
+        # register engine applies pred kills exactly).
+        self.host_winners = None  # np.int32 [doc_cap, key_cap + 1]
         # exact_device=True stores the device state in the multi-value
         # register engine (fleet/registers.py) instead of the LWW
         # scatter-max grid: conflict sets, set-vs-delete resurrection, and
@@ -386,6 +396,8 @@ class DocFleet:
                 st.winners.at[dst].set(st.winners[src]),
                 st.values.at[dst].set(st.values[src]),
                 st.counters.at[dst].set(st.counters[src]))
+            if self.host_winners is not None:
+                self.host_winners[dst] = self.host_winners[src]
         if self.reg_state is not None and src < self.reg_state.reg.shape[0]:
             from .registers import RegisterState
             self._ensure_reg_capacity(n_docs=dst + 1, n_keys=len(self.keys))
@@ -404,6 +416,8 @@ class DocFleet:
             self.state = FleetState(st.winners.at[slot].set(0),
                                     st.values.at[slot].set(0),
                                     st.counters.at[slot].set(0))
+            if self.host_winners is not None:
+                self.host_winners[slot] = 0
         if self.reg_state is not None and \
                 slot < self.reg_state.reg.shape[0]:
             from .registers import RegisterState
@@ -719,6 +733,8 @@ class DocFleet:
             # over the transfer link for no reason
             self.state = self._shard_docs(
                 FleetState.empty(need_docs, need_keys, xp=jnp))
+            self.host_winners = np.zeros((need_docs, need_keys + 1),
+                                         dtype=np.int32)
             return
         old_n, old_k = self.state.winners.shape
         if need_docs <= old_n and need_keys + 1 <= old_k:
@@ -733,6 +749,10 @@ class DocFleet:
             out = jnp.zeros((n, k), dtype=arr.dtype)
             out = out.at[:old_n, :old_k - 1].set(arr[:, :old_k - 1])
             grown.append(out)
+        hw = np.zeros((n, k), dtype=np.int32)
+        if self.host_winners is not None:
+            hw[:old_n, :old_k - 1] = self.host_winners[:, :old_k - 1]
+        self.host_winners = hw
         self.doc_cap, self.key_cap = n, k - 1
         self.state = self._shard_docs(FleetState(*grown))
 
@@ -749,6 +769,11 @@ class DocFleet:
         remapped = (w & ~mask) | jnp.asarray(perm_full)[w & mask]
         self.state = FleetState(jnp.where(w != 0, remapped, 0),
                                 self.state.values, self.state.counters)
+        if self.host_winners is not None:
+            hw = self.host_winners
+            hw_new = (hw & ~mask) | perm_full[hw & mask]
+            self.host_winners = np.where(hw != 0, hw_new, 0) \
+                .astype(np.int32)
 
     def _ensure_reg_capacity(self, n_docs, n_keys):
         from .registers import RegisterState
@@ -865,8 +890,57 @@ class DocFleet:
             self.state = FleetState(w.at[slot].set(shifted),
                                     self.state.values, self.state.counters)
             self.metrics.dispatches += 1
+            if self.host_winners is not None and \
+                    slot < self.host_winners.shape[0]:
+                hw = self.host_winners[slot]
+                self.host_winners[slot] = np.where(hw != 0, hw - delta, 0)
         self.ctr_base[slot] = new_base
         return new_base
+
+    def _pack_pred(self, slot, op):
+        """Pack an inc op's single pred against the slot's current window
+        WITHOUT rebase side effects; -1 when it cannot be packed (absent,
+        multiple, unregistered actor, outside the window) — which
+        _note_grid_batch treats as an attribution mismatch."""
+        from ..common import parse_op_id
+        preds = op.get('pred') or []
+        if len(preds) != 1:
+            return -1
+        try:
+            ctr, actor = parse_op_id(preds[0])
+            num = self.actors.intern(actor)
+        except (KeyError, ValueError):
+            return -1
+        rel = ctr - self.ctr_base.get(slot, 0)
+        if rel <= 0 or rel >= CTR_LIMIT:
+            return -1
+        from .tensor_doc import pack_op_id
+        return pack_op_id(rel, num)
+
+    def _note_grid_batch(self, set_doc, set_key, set_packed,
+                         inc_doc, inc_key, inc_pred):
+        """Advance the host winner mirror with a batch's set rows (same
+        scatter-max the device applies), then verify every inc op's pred
+        against the post-batch winner. An inc whose pred is not the
+        winner would be credited to the wrong counter by the device cell
+        (apply.py's documented corner), so its slot goes mirror-
+        authoritative via grid_overflow. inc_pred == -1 marks preds that
+        could not be packed (absent, multiple, or outside the window) and
+        always flags."""
+        hw = self.host_winners
+        if hw is None:
+            return
+        if len(set_doc):
+            np.maximum.at(hw, (np.asarray(set_doc, dtype=np.int64),
+                               np.asarray(set_key, dtype=np.int64)),
+                          np.asarray(set_packed, dtype=np.int32))
+        if len(inc_doc):
+            inc_doc = np.asarray(inc_doc, dtype=np.int64)
+            inc_key = np.asarray(inc_key, dtype=np.int64)
+            inc_pred = np.asarray(inc_pred, dtype=np.int64)
+            bad = inc_pred != hw[inc_doc, inc_key]
+            for d in np.unique(inc_doc[bad]):
+                self.grid_overflow.add(int(d))
 
     def _slot_pack(self, slot, ctr, actor_num):
         """Pack a grid op's (counter, actor) against the slot's rebased
@@ -913,13 +987,15 @@ class DocFleet:
         rebased_touched = any(
             d < n_docs and per_doc[d]
             for d in set(self.ctr_base) | self.grid_overflow)
+        hazard = []
         if native.available() and not rebased_touched:
             # (rebased slots pack against per-slot bases the native batch
             # does not know about: only flushes touching such slots take
             # the Python decode — the rest of the fleet keeps the C++ path)
             from .ingest import changes_to_op_batch_native
             batch = changes_to_op_batch_native(per_doc, self.keys,
-                                               self.actors)
+                                               self.actors,
+                                               hazard_out=hazard)
         if batch is None:
             # Sequence ops, non-inline values, or no native codec: Python
             # decode once, routing flat rows to the grid and sequence ops
@@ -935,6 +1011,8 @@ class DocFleet:
                                             self._shard_docs(batch))
         self.metrics.dispatches += 1
         self.metrics.device_ops += int(batch.valid.sum())
+        if hazard:
+            self._note_grid_batch(*hazard[0])
 
     def _flush_exact(self, per_doc, n_docs):
         """Exact-device flush: flat rows (with preds) into the multi-value
@@ -986,6 +1064,7 @@ class DocFleet:
 
         rows = []       # (slot, key_id, packed, value, is_set, is_inc)
         seq_ops = []
+        inc_checks = []  # (slot, key_id, pred packed | -1)
         for d, op_id, op in ops_list:
             ctr, actor = parse_op_id(op_id)
             obj = op['obj']
@@ -1018,6 +1097,7 @@ class DocFleet:
             elif action == 'inc':
                 rows.append((d, key_id, packed, op.get('value', 0),
                              False, True))
+                inc_checks.append((d, key_id, self._pack_pred(d, op)))
             else:
                 rows.append((d, key_id, packed,
                              self._intern_value(op.get('value')),
@@ -1051,6 +1131,12 @@ class DocFleet:
                                                 self._shard_docs(batch))
             self.metrics.dispatches += 1
             self.metrics.device_ops += len(rows)
+            sets = [(r[0], r[1], r[2]) for r in rows if r[4]]
+            self._note_grid_batch([s[0] for s in sets], [s[1] for s in sets],
+                                  [s[2] for s in sets],
+                                  [c[0] for c in inc_checks],
+                                  [c[1] for c in inc_checks],
+                                  [c[2] for c in inc_checks])
         self._dispatch_seq(seq_ops)
 
     def _flush_exact_mixed(self, per_doc, n_docs):
@@ -2553,6 +2639,25 @@ def _apply_changes_turbo(handles, per_doc_changes):
         fleet.state, _stats = apply_op_batch(fleet.state,
                                              fleet._shard_docs(batch))
         fleet.metrics.dispatches += 1
+        # Counter-attribution check (see _note_grid_batch): advance the
+        # host winner mirror with this batch's set rows and verify each
+        # inc's pred against the post-batch winner
+        flags_root = kept_flags_all[keep_root]
+        set_sel = flags_root == 1
+        inc_sel = flags_root == 2
+        if set_sel.any() or inc_sel.any():
+            pred_counts = np.diff(rows['pred_off'])
+            counts_root = pred_counts[keep_root]
+            off_root = rows['pred_off'][:-1][keep_root]
+            inc_preds = np.full(int(inc_sel.sum()), -1, dtype=np.int64)
+            one = counts_root[inc_sel] == 1
+            if one.any() and len(rows['pred']):
+                raw = rows['pred'][off_root[inc_sel][one]].astype(np.int64)
+                pa = actor_map[raw & (_MA - 1)].astype(np.int64)
+                inc_preds[one] = np.where(pa >= 0, (raw >> 8 << 8) | pa, -1)
+            fleet._note_grid_batch(slots[set_sel], key[set_sel],
+                                   packed[set_sel], slots[inc_sel],
+                                   key[inc_sel], inc_preds)
     dispatch_seq_rows()
     fleet.metrics.device_ops += int(keep.sum())
     return result

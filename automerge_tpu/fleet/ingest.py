@@ -123,11 +123,18 @@ class KeyInterner:
         return len(self.keys)
 
 
-def changes_to_op_batch_native(per_doc_changes, key_interner, actor_interner):
+def changes_to_op_batch_native(per_doc_changes, key_interner, actor_interner,
+                               hazard_out=None):
     """Fast path: the whole parse + dictionary-encode runs in C++
     (native.ingest_changes), and the flat op rows scatter into OpBatch
     tensors with vectorized numpy. Returns None if any change falls outside
-    the fleet subset (caller falls back to the host engine)."""
+    the fleet subset (caller falls back to the host engine).
+
+    When `hazard_out` is a list, the parse runs with_meta so pred columns
+    are available, and one tuple (set_doc, set_key, set_packed, inc_doc,
+    inc_key, inc_pred) in fleet numbering is appended — the feed for
+    DocFleet._note_grid_batch's counter-attribution check (inc_pred is -1
+    for incs whose pred is absent/multiple)."""
     buffers, doc_ids = [], []
     for d, changes in enumerate(per_doc_changes):
         for change in changes:
@@ -137,10 +144,14 @@ def changes_to_op_batch_native(per_doc_changes, key_interner, actor_interner):
         return OpBatch(*(np.zeros((len(per_doc_changes), 1), dtype=dt)
                          for dt in (np.int32, np.int32, np.int32, bool, bool,
                                     bool)))
-    out = native.ingest_changes(buffers, doc_ids)
+    out = native.ingest_changes(buffers, doc_ids,
+                                with_meta=hazard_out is not None)
     if out is None:
         return None
-    rows, keys, actors = out
+    if hazard_out is not None:
+        rows, keys, actors, _meta = out
+    else:
+        rows, keys, actors = out
     # Merge the C++ interning into the fleet-level interners
     key_map = np.array([key_interner.intern(k) for k in keys], dtype=np.int32)
     actor_map = np.array([actor_interner.intern(a) for a in actors],
@@ -151,6 +162,20 @@ def changes_to_op_batch_native(per_doc_changes, key_interner, actor_interner):
     ctr = rows['packed'] >> 8
     actor = actor_map[rows['packed'] & 0xff] if len(actors) else 0
     packed = (ctr << 8) | actor
+    if hazard_out is not None:
+        flags_flat = rows['flags']
+        set_sel = flags_flat == 1
+        inc_sel = flags_flat == 2
+        pred_counts = np.diff(rows['pred_off'])
+        first = rows['pred_off'][:-1][inc_sel]
+        preds = np.full(int(inc_sel.sum()), -1, dtype=np.int64)
+        one = pred_counts[inc_sel] == 1
+        if one.any() and len(rows['pred']):
+            raw = rows['pred'][first[one]]
+            pa = actor_map[raw & 0xff] if len(actors) else 0
+            preds[one] = (raw >> 8 << 8) | pa
+        hazard_out.append((doc[set_sel], key[set_sel], packed[set_sel],
+                           doc[inc_sel], key[inc_sel], preds))
     # Lay out rows into [N, P] with per-doc positions
     order = np.argsort(doc, kind='stable')
     doc_sorted = doc[order]

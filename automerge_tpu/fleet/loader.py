@@ -399,6 +399,11 @@ def _install_map_cells(fleet, out, sel, doc, slot_of, okey, oid_str, key_str,
             st.values.at[idx].set(jnp.asarray(values[w].astype(np.int32))),
             st.counters.at[idx].set(
                 jnp.asarray(counters[w].astype(np.int32))))
+        if fleet.host_winners is not None:
+            # Seed the host winner mirror (counter-attribution checks for
+            # later incs run against these loaded winners)
+            np.maximum.at(fleet.host_winners, (slots[w], key_ids[w]),
+                          packed[w].astype(np.int32))
     fleet.metrics.dispatches += 1
     fleet.metrics.device_ops += len(rows)
 

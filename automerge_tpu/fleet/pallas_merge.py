@@ -29,6 +29,7 @@ op lanes are masked out by `valid`.
 """
 
 import functools
+import os
 
 import numpy as np
 
@@ -39,9 +40,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .tensor_doc import FleetState
 
-DOC_TILE = 32
-KEY_TILE = 128
-OP_CHUNK = 128
+# Tile sizes are env-tunable (PALLAS_DOC_TILE / PALLAS_KEY_TILE /
+# PALLAS_OP_CHUNK) so on-chip VMEM pressure can be dialed without code
+# edits: the dense one-hot kernel materializes [DOC_TILE, OP_CHUNK,
+# KEY_TILE] int32 temporaries (32x128x128 = 2 MB each), several of which
+# live at once — near the 16 MB/core VMEM budget at the defaults.
+DOC_TILE = int(os.environ.get('PALLAS_DOC_TILE', 32))
+KEY_TILE = int(os.environ.get('PALLAS_KEY_TILE', 128))
+OP_CHUNK = int(os.environ.get('PALLAS_OP_CHUNK', 128))
 
 _INT32_MIN = np.iinfo(np.int32).min
 
@@ -108,6 +114,70 @@ def _merge_kernel(key_ref, packed_ref, value_ref, is_set_ref, is_inc_ref,
             jnp.where(keep, base_c_ref[:], 0)
 
 
+def _merge_kernel_loop(key_ref, packed_ref, value_ref, is_set_ref,
+                       is_inc_ref, valid_ref, winners_in, values_in,
+                       counters_in, winners_out, values_out, counters_out,
+                       orig_w_ref, base_c_ref):
+    """VMEM-conservative variant: instead of materializing the dense
+    [DOC_TILE, OP_CHUNK, KEY_TILE] one-hot, walk the op lanes with a
+    fori_loop carrying the [DOC_TILE, KEY_TILE] state tile. Same total
+    VPU work (each lane still touches the whole key tile), a fraction of
+    the VMEM footprint — the fallback when Mosaic rejects the 3D
+    formulation or its temporaries overflow VMEM. Lane order preserves
+    the sequential take-if-greater semantics, which equals the chunk-max
+    formulation for LWW (ties keep the first-seen equal value)."""
+    j = pl.program_id(1)
+    c = pl.program_id(2)
+    k_base = j * KEY_TILE
+    dn, p = key_ref.shape
+
+    @pl.when(c == 0)
+    def _seed():
+        winners_out[:] = winners_in[:]
+        values_out[:] = values_in[:]
+        orig_w_ref[:] = winners_in[:]
+        base_c_ref[:] = counters_in[:]
+        counters_out[:] = jnp.zeros_like(counters_in)
+
+    k_ids = jax.lax.broadcasted_iota(jnp.int32, (dn, KEY_TILE), 1) + k_base
+    keys = key_ref[:]
+    packeds = packed_ref[:]
+    values = value_ref[:]
+    is_sets = is_set_ref[:]
+    is_incs = is_inc_ref[:]
+    valids = valid_ref[:]
+
+    def lane(t, carry):
+        w, v, cnt = carry
+        key_c = jax.lax.dynamic_slice(keys, (0, t), (dn, 1))
+        packed_c = jax.lax.dynamic_slice(packeds, (0, t), (dn, 1))
+        value_c = jax.lax.dynamic_slice(values, (0, t), (dn, 1))
+        live = jax.lax.dynamic_slice(valids, (0, t), (dn, 1)) != 0
+        in_tile = (key_c == k_ids) & live
+        setk = in_tile & (jax.lax.dynamic_slice(is_sets, (0, t),
+                                                (dn, 1)) != 0)
+        cand = jnp.where(setk, packed_c, 0)
+        take = cand > w
+        w = jnp.where(take, cand, w)
+        v = jnp.where(take, value_c, v)
+        inck = in_tile & (jax.lax.dynamic_slice(is_incs, (0, t),
+                                                (dn, 1)) != 0)
+        cnt = cnt + jnp.where(inck, value_c, 0)
+        return w, v, cnt
+
+    w, v, cnt = jax.lax.fori_loop(
+        0, p, lane, (winners_out[:], values_out[:], counters_out[:]))
+    winners_out[:] = w
+    values_out[:] = v
+    counters_out[:] = cnt
+
+    @pl.when(c == pl.num_programs(2) - 1)
+    def _finalize():
+        keep = winners_out[:] == orig_w_ref[:]
+        counters_out[:] = counters_out[:] + \
+            jnp.where(keep, base_c_ref[:], 0)
+
+
 def _pad_to(x, axis, multiple):
     size = x.shape[axis]
     rem = (-size) % multiple
@@ -118,10 +188,15 @@ def _pad_to(x, axis, multiple):
     return jnp.pad(x, pad)
 
 
-@functools.partial(jax.jit, static_argnames=('interpret',))
-def pallas_apply_op_batch(state, ops, interpret=False):
-    """Drop-in fused-kernel equivalent of fleet.apply.apply_op_batch."""
+@functools.partial(jax.jit, static_argnames=('interpret', 'variant'))
+def pallas_apply_op_batch(state, ops, interpret=False, variant='dense'):
+    """Drop-in fused-kernel equivalent of fleet.apply.apply_op_batch.
+
+    variant='dense' materializes the 3D one-hot (best VPU shape, highest
+    VMEM pressure); variant='loop' walks op lanes with a carried state
+    tile (same semantics, minimal VMEM — the Mosaic fallback)."""
     n_docs, n_slots = state.winners.shape
+    kernel = _merge_kernel if variant == 'dense' else _merge_kernel_loop
 
     def prep_state(x):
         return _pad_to(_pad_to(x, 0, DOC_TILE), 1, KEY_TILE)
@@ -149,7 +224,7 @@ def pallas_apply_op_batch(state, ops, interpret=False):
     state_spec = pl.BlockSpec((DOC_TILE, KEY_TILE), lambda i, j, c: (i, j))
 
     out_w, out_v, out_c = pl.pallas_call(
-        _merge_kernel,
+        kernel,
         grid=grid,
         in_specs=[ops_spec] * 6 + [state_spec] * 3,
         out_specs=[state_spec] * 3,

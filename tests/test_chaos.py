@@ -7,6 +7,7 @@ This is the wasm.js differential harness (ref test/wasm.js:27-36) scaled to
 the whole surface: the host OpSet is the executable spec; the fleet paths
 must be observationally identical through the public Backend contract."""
 
+import os
 import random
 
 import pytest
@@ -18,9 +19,20 @@ from automerge_tpu.fleet import backend as fleet_backend
 from automerge_tpu.fleet.backend import DocFleet, FleetBackend
 from automerge_tpu.fleet.loader import load_docs
 
-A1, A2, A3 = '01' * 8, '89' * 8, 'fe' * 8
-ACTORS = [A1, A2, A3]
+# Three founding actors plus two that join mid-history. The joiners' hex
+# sorts BEFORE every founder, so a join forces the fleet's sorted actor
+# renumbering (tensor remap) in the middle of live device state.
+FOUNDERS = ['89' * 8, 'ab' * 8, 'fe' * 8]
+JOINERS = ['01' * 8, '34' * 8]
 ALPHA = 'abcdefghijklmnop'
+
+# Dose knobs: the in-tree default is ~10x the round-3 dose (5 seeds x 80
+# steps x up to 5 actors + mid-run joins + rows-in-lists edits, vs
+# 2 x 30 x 3) while staying inside the CI budget on this image's single
+# core (~4 min); CHAOS_SEEDS / CHAOS_STEPS scale it 50x+ for deeper
+# offline fuzzing (e.g. CHAOS_SEEDS=20 CHAOS_STEPS=250).
+N_SEEDS = int(os.environ.get('CHAOS_SEEDS', '5'))
+N_STEPS = int(os.environ.get('CHAOS_STEPS', '80'))
 
 
 def _random_edit(edit_seed):
@@ -63,15 +75,29 @@ def _random_edit(edit_seed):
                 m[k].increment(rng.randrange(-3, 9))
             else:
                 m[k] = A.Counter(0)
-        elif roll < 0.66:
+        elif roll < 0.62:
             lst.insert(rng.randrange(len(lst) + 1), rng.randrange(100))
-        elif roll < 0.72 and len(lst):
+        elif roll < 0.67 and len(lst):
             lst[rng.randrange(len(lst))] = rng.randrange(100, 200)
-        elif roll < 0.78 and len(lst):
+        elif roll < 0.72 and len(lst):
             lst.delete_at(rng.randrange(len(lst)))
-        elif roll < 0.86:
+        elif roll < 0.78:
+            # Objects nested inside sequences (fleet-resident since round
+            # 4): insert a row map into the rows list
+            rows = r['rows']
+            rows.insert(rng.randrange(len(rows) + 1),
+                        {'v': rng.randrange(50)})
+        elif roll < 0.83 and len(r['rows']):
+            # ... or edit a key inside an existing row
+            rows = r['rows']
+            row = rows[rng.randrange(len(rows))]
+            if hasattr(row, 'keys'):
+                row[rng.choice('vw')] = rng.randrange(500)
+        elif roll < 0.86 and len(r['rows']):
+            r['rows'].delete_at(rng.randrange(len(r['rows'])))
+        elif roll < 0.90:
             r['nested'][rng.choice('pq')] = {'v': rng.randrange(50)}
-        elif roll < 0.93:
+        elif roll < 0.96:
             key = rng.choice(ALPHA)
             if key in r:
                 del r[key]
@@ -99,7 +125,7 @@ class _Universe:
 
 @pytest.mark.skipif(not native.available(),
                     reason='native codec unavailable')
-@pytest.mark.parametrize('seed', [0, 1])
+@pytest.mark.parametrize('seed', list(range(N_SEEDS)))
 def test_chaos_differential(seed):
     rng = random.Random(seed)
     fleet_lww = DocFleet(doc_capacity=8, key_capacity=64)
@@ -110,6 +136,11 @@ def test_chaos_differential(seed):
         _Universe('fleet-lww', FleetBackend(fleet_lww)),
         _Universe('fleet-exact', FleetBackend(fleet_exact)),
     ]
+    actors = list(FOUNDERS)
+    # Actors joining mid-history (exercises the fleet's sorted-actor
+    # renumbering: both joiners sort before every founder)
+    joins = {N_STEPS * 2 // 5: JOINERS[0], N_STEPS * 3 // 5: JOINERS[1]}
+    compare_every = max(10, N_STEPS // 4)
 
     def compare(tag):
         base = None
@@ -132,14 +163,20 @@ def test_chaos_differential(seed):
     for u in universes:
         def build():
             base = A.change(
-                A.init(ACTORS[0]), {'message': 'Initialization', 'time': 0},
+                A.init(actors[0]), {'message': 'Initialization', 'time': 0},
                 lambda d: d.update({'text': A.Text('seed'), 'list': [1, 2],
-                                    'counts': {}, 'nested': {}}))
-            return [base] + [A.merge(A.init(a), base) for a in ACTORS[1:]]
+                                    'rows': [], 'counts': {}, 'nested': {}}))
+            return [base] + [A.merge(A.init(a), base) for a in actors[1:]]
         u.docs = u.with_backend(build)
 
-    for step in range(30):
-        i = rng.randrange(len(ACTORS))
+    for step in range(N_STEPS):
+        if step in joins:
+            actor = joins[step]
+            actors.append(actor)
+            for u in universes:
+                u.docs.append(u.with_backend(
+                    lambda u=u: A.merge(A.init(actor), u.docs[0])))
+        i = rng.randrange(len(actors))
         action = rng.random()
         if action < 0.55:
             edit = _random_edit(rng.getrandbits(32))
@@ -147,7 +184,7 @@ def test_chaos_differential(seed):
                 u.docs[i] = u.with_backend(
                     lambda u=u, i=i: A.change(u.docs[i], {'time': 0}, edit))
         elif action < 0.75:
-            j = rng.randrange(len(ACTORS))
+            j = rng.randrange(len(actors))
             if j != i:
                 for u in universes:
                     u.docs[i] = u.with_backend(
@@ -157,17 +194,17 @@ def test_chaos_differential(seed):
             for u in universes:
                 def reload(u=u, i=i):
                     buf = A.save(u.docs[i])
-                    return A.load(buf, ACTORS[i])
+                    return A.load(buf, actors[i])
                 u.docs[i] = u.with_backend(reload)
         elif action < 0.95:
             for u in universes:
                 u.docs[i] = u.with_backend(
-                    lambda u=u, i=i: A.clone(u.docs[i], ACTORS[i]))
+                    lambda u=u, i=i: A.clone(u.docs[i], actors[i]))
         else:
             for u in universes:
                 u.docs[i] = u.with_backend(
                     lambda u=u, i=i: A.empty_change(u.docs[i], {'time': 0}))
-        if step % 10 == 9:
+        if step % compare_every == compare_every - 1:
             # full convergence point: merge everything into replica 0
             for u in universes:
                 def converge(u=u):

@@ -250,6 +250,46 @@ class TestDeviceMaterialization:
         device = fleet_backend.materialize_docs(handles)
         assert device == mirror
 
+    def test_counter_inc_of_overwritten_set_not_served_wrong(self):
+        """Round-4 chaos find: the grid's counter cell cannot attribute an
+        inc to its pred, so an inc whose counter set lost (or was
+        overwritten in the same batch) was credited to the winning counter
+        and materialize_docs served base+1. The host winner mirror now
+        flags such slots into grid_overflow and reads fall back to the
+        exact mirror (ref new.js:937-965 counter succ semantics)."""
+        import automerge_tpu as am
+        a, b = ACTORS[0], ACTORS[1]
+        c1 = change_buf(a, 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'value': 5,
+             'datatype': 'counter', 'pred': []}])
+        h1 = am.decode_change(c1)['hash']
+        c2 = change_buf(a, 2, 2, [
+            {'action': 'inc', 'obj': '_root', 'key': 'x', 'value': 1,
+             'datatype': 'counter', 'pred': [f'1@{a}']}], deps=[h1])
+        c3 = change_buf(b, 1, 3, [
+            {'action': 'set', 'obj': '_root', 'key': 'x', 'value': 6,
+             'datatype': 'counter', 'pred': [f'1@{a}']}], deps=[h1])
+        for split in (False, True):
+            for mirror in (True, False):
+                fleet = DocFleet(doc_capacity=2, key_capacity=4)
+                h = fleet_backend.init(fleet)
+                groups = [[c1, c2], [c3]] if split else [[c1, c2, c3]]
+                for g in groups:
+                    if mirror:
+                        h, _ = fleet_backend.apply_changes(h, g)
+                    else:
+                        [h], _ = fleet_backend.apply_changes_docs(
+                            [h], [g], mirror=False)
+                assert fleet_backend.materialize_docs([h]) == [{'x': 6}], \
+                    (split, mirror)
+        # The happy path — incs of the standing winner — must NOT flag
+        fleet = DocFleet(doc_capacity=2, key_capacity=4)
+        h = fleet_backend.init(fleet)
+        [h], _ = fleet_backend.apply_changes_docs([h], [[c1, c2]],
+                                                  mirror=False)
+        assert fleet_backend.materialize_docs([h]) == [{'x': 6}]
+        assert 0 not in fleet.grid_overflow
+
     def test_negative_inc_delta_device_parity(self):
         """Negative inc deltas must land inline in the value column, not as
         value-table references (regression: device counters were corrupted

@@ -32,6 +32,7 @@ def assert_states_match(a, b, n_keys):
         np.testing.assert_array_equal(got, want, err_msg=name)
 
 
+@pytest.mark.parametrize('variant', ['dense', 'loop'])
 @pytest.mark.parametrize('n_docs,n_keys,p', [
     (8, 17, 12),      # everything unaligned -> exercises padding
     (128, 127, 32),   # exact doc tile
@@ -39,12 +40,13 @@ def assert_states_match(a, b, n_keys):
     (16, 40, 200),    # multi-chunk op axis (> OP_CHUNK=128): chunk carry
     (8, 130, 300),    # multi-chunk AND multiple key tiles
 ])
-def test_matches_jnp_path(n_docs, n_keys, p):
+def test_matches_jnp_path(n_docs, n_keys, p, variant):
     rng = np.random.default_rng(n_docs + n_keys)
     state = FleetState.empty(n_docs, n_keys)
     ops = random_batch(rng, n_docs, n_keys, p)
     want, want_stats = apply_op_batch(state, ops)
-    got, got_stats = pallas_apply_op_batch(state, ops, interpret=True)
+    got, got_stats = pallas_apply_op_batch(state, ops, interpret=True,
+                                           variant=variant)
     assert int(got_stats) == int(want_stats)
     assert_states_match(got, want, n_keys)
 
