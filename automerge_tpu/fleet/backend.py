@@ -536,6 +536,18 @@ class DocFleet:
     def _intern_value_boxed(self, value):
         return -(self.value_table.intern(value) + 2)
 
+    def _make_link_value(self, slot, oid, type_name):
+        """THE make-op link rule, shared by the apply and bulk-load ingest
+        paths: a child object created by a make op is represented as a
+        boxed link value; sequence children (text/list) allocate their
+        device row immediately — an empty child would otherwise push every
+        read of the doc to the mirror via an unresolved link."""
+        if type_name in ('text', 'list'):
+            if oid not in self.slot_seq.get(slot, {}):
+                self._alloc_seq_row(slot, oid, type_name)
+            return self._intern_value_boxed(_SeqLink(oid))
+        return self._intern_value_boxed(_MapLink(oid, type_name))
+
     def _intern_typed(self, value, datatype):
         """THE datatype-boxing rule for device value lanes (one source of
         truth for the per-op, turbo, and loader ingest paths): payloads
@@ -592,14 +604,8 @@ class DocFleet:
             # fleet object — (objectId, key) grid columns for maps/tables,
             # its own SeqState row for text/lists.
             kind = INSERT if op.get('insert') else SET
-            if action in _SEQ_MAKE:
-                if op_id not in self.slot_seq.get(info['slot'], {}):
-                    self._alloc_seq_row(info['slot'], op_id,
-                                        OBJECT_TYPE[action])
-                value = self._intern_value_boxed(_SeqLink(op_id))
-            else:
-                value = self._intern_value_boxed(
-                    _MapLink(op_id, OBJECT_TYPE[action]))
+            value = self._make_link_value(info['slot'], op_id,
+                                          OBJECT_TYPE[action])
             if info['type'] == 'text':
                 # Object elements inside Text render as spans, which stay
                 # mirror territory: flag the row so reads route there

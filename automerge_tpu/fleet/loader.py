@@ -20,10 +20,11 @@ round-trip; note this skips save()'s usual canonical re-encode, so two
 replicas bulk-loaded from *different* foreign encodings of the same state
 can save different bytes until their first edit.
 
-Documents outside the flat fleet subset (child/link ops, unknown columns,
-objects inside sequences, op counters past the 2^23 packing window, >256
-actors) fall back per-doc to the ordinary load path — the loader is an
-accelerator, never a semantic fork.
+Documents outside the fleet subset (link ops, unknown columns, op counters
+past the 2^23 packing window, >256 actors) fall back per-doc to the
+ordinary load path — the loader is an accelerator, never a semantic fork.
+Objects inside sequences (rows-in-lists) bulk-load natively: make element
+rows install as links (round 4).
 """
 
 import numpy as np
@@ -170,12 +171,13 @@ def _install_parsed(fleet, out, native_idx, chunks, handles, fleet_backend):
     row_is_seq = _isin_sorted(okey, seq_objs)
     row_in_map = (obj_actor < 0) | _isin_sorted(okey, map_objs)
     orphan = row_ok & ~row_is_seq & ~row_in_map
-    make_in_seq = make_mask & row_is_seq
     # map rows must carry a string key and cannot be inserts (a crafted
     # chunk can pass the column-level checks with an elemId on a map row —
-    # out['keys'][-1] must never be dereferenced)
+    # out['keys'][-1] must never be dereferenced). Makes inside sequences
+    # are legal element rows (rows-in-lists): their value lane becomes a
+    # link to the child object, handled in _install_seq_rows.
     map_malformed = row_ok & ~row_is_seq & ((key_str < 0) | insert)
-    for mask in (orphan, make_in_seq, map_malformed):
+    for mask in (orphan, map_malformed):
         if mask.any():
             bad[np.unique(doc[mask])] = True
 
@@ -276,7 +278,8 @@ def _install_parsed(fleet, out, native_idx, chunks, handles, fleet_backend):
                        make_mask, rid)
     _install_seq_rows(fleet, out, keep & row_is_seq, doc, slot_of, okey,
                       oid_str, obj_type, insert, alive, inc_mask,
-                      packed32, id_actor, key_ctr, key_actor, vtype, val_int)
+                      packed32, id_actor, key_ctr, key_actor, vtype, val_int,
+                      make_mask, rid)
 
     installed = set()
     for d, eng in engines.items():
@@ -318,7 +321,6 @@ def _install_map_cells(fleet, out, sel, doc, slot_of, okey, oid_str, key_str,
     """Scatter alive map-cell ops into the register state (exact mode) or
     the LWW winners grid, one batched device write per array."""
     import jax.numpy as jnp
-    from .backend import _MapLink, _SeqLink
 
     rows = np.flatnonzero(sel)
     if not len(rows):
@@ -341,21 +343,11 @@ def _install_map_cells(fleet, out, sel, doc, slot_of, okey, oid_str, key_str,
     for i, j in enumerate(rows):
         jj = int(j)
         if make_mask[jj]:
-            oid = oid_str[int(rid[jj])]
-            if int(action[jj]) in _SEQ_MAKES:
-                link = _SeqLink(oid)
-                # allocate the device row NOW (the ordinary apply path does
-                # this at make time, backend._flush_mixed): an EMPTY
-                # sequence has no op rows, and an unresolved link would
-                # push every read of the doc to the mirror
-                slot = int(slot_of[doc[jj]])
-                if oid not in fleet.slot_seq.get(slot, {}):
-                    typ = 'text' if int(action[jj]) == _A_MAKE_TEXT \
-                        else 'list'
-                    fleet._alloc_seq_row(slot, oid, typ)
-            else:
-                link = _MapLink(oid, _TYPE_NAMES[int(action[jj])])
-            values[i] = fleet._intern_value_boxed(link)
+            # fleet._make_link_value — THE shared make-op link rule
+            # (allocates an empty child sequence's device row too)
+            values[i] = fleet._make_link_value(
+                int(slot_of[doc[jj]]), oid_str[int(rid[jj])],
+                _TYPE_NAMES[int(action[jj])])
         else:
             values[i] = _decode_cell_value(fleet, out, jj, int(vtype[jj]),
                                            int(val_int[jj]),
@@ -410,10 +402,12 @@ def _install_map_cells(fleet, out, sel, doc, slot_of, okey, oid_str, key_str,
 
 def _install_seq_rows(fleet, out, sel, doc, slot_of, okey, oid_str, obj_type,
                       insert, alive, inc_mask, packed32, id_actor,
-                      key_ctr, key_actor, vtype, val_int):
+                      key_ctr, key_actor, vtype, val_int, make_mask, rid):
     """Reconstruct SeqState rows from document-order sequence ops: element
     encounter order IS final RGA order, so the linked list is a straight
-    chain — no pointer walking, no replay."""
+    chain — no pointer walking, no replay. Make rows (objects nested inside
+    sequences) become link-valued elements, matching the ordinary apply
+    path (backend._pack_seq_op)."""
     import jax.numpy as jnp
     from .sequence import SeqState, END, HEAD, SLOT0
 
@@ -480,6 +474,18 @@ def _install_seq_rows(fleet, out, sel, doc, slot_of, okey, oid_str, obj_type,
         jj = int(j)
         if inc_mask[jj]:
             flag_counter[i] = True
+            continue
+        if make_mask[jj]:
+            # Nested object as a sequence element: fleet._make_link_value
+            # is THE shared make-op link rule (links the child, allocates
+            # an empty child sequence's device row)
+            values[i] = fleet._make_link_value(
+                int(slot_of[int(doc[jj])]), oid_str[int(rid[jj])],
+                _TYPE_NAMES[obj_type[int(rid[jj])]])
+            if txt[i]:
+                # object elements inside Text render as spans: mirror
+                # serves those reads (same rule as _pack_seq_op)
+                flag_counter[i] = True
             continue
         vt, vi = int(vtype[jj]), int(val_int[jj])
         if vt == 8:

@@ -173,6 +173,65 @@ def bench_pallas_merge(n_docs, n_keys, rounds, ops_per_round):
     return None, None
 
 
+def capture_trace(n_docs, n_keys, ops_per_round, pallas_variant=None):
+    """Write a jax.profiler trace of steady-state merge + sequence + (when
+    compiled) Pallas dispatches to BENCH_TRACE_DIR (default traces/bench).
+    Runs on a real TPU backend, or anywhere with BENCH_TRACE=1; the trace is
+    the evidence base for BASELINE.md's bandwidth accounting. Returns the
+    trace dir or None (failure is reported, never fatal)."""
+    import jax
+    if not os.environ.get('BENCH_TRACE') and jax.default_backend() != 'tpu':
+        return None
+    try:
+        from automerge_tpu import observability
+        from automerge_tpu.fleet import FleetState, apply_op_batch
+        from automerge_tpu.fleet.sequence import (
+            SeqState, apply_seq_batch, SeqOpBatch, INSERT, SEQ_PRED_LANES)
+        from automerge_tpu.fleet.tensor_doc import ACTOR_BITS
+        batches = [jax.device_put(b) for b in
+                   build_workload(n_docs, n_keys, 2, 3, ops_per_round)]
+        state = jax.tree_util.tree_map(jax.device_put,
+                                       FleetState.empty(n_docs, n_keys))
+        warm, _ = apply_op_batch(state, batches[0])    # compile outside
+        jax.block_until_ready(warm.winners)
+        # small sequence batch: chained inserts per doc
+        sd, sl = 256, 64
+        kind = np.full((sd, sl), INSERT, dtype=np.int32)
+        ctrs = 2 + np.arange(sl, dtype=np.int32)
+        packed = np.broadcast_to(ctrs << ACTOR_BITS, (sd, sl)).astype(np.int32)
+        ref = np.zeros((sd, sl), dtype=np.int32)
+        ref[:, 1:] = packed[:, :-1]
+        seq_batch = jax.device_put(SeqOpBatch(
+            kind, ref, packed, np.full((sd, sl), 97, dtype=np.int32),
+            np.zeros((sd, sl, SEQ_PRED_LANES), dtype=np.int32)))
+        seq_state = jax.tree_util.tree_map(jax.device_put,
+                                           SeqState.empty(sd, sl + 1))
+        warm_seq, _ = apply_seq_batch(seq_state, seq_batch)
+        jax.block_until_ready(warm_seq.nxt)
+        if pallas_variant:
+            from automerge_tpu.fleet.pallas_merge import pallas_apply_op_batch
+            warm_p, _ = pallas_apply_op_batch(state, batches[0],
+                                              variant=pallas_variant)
+            jax.block_until_ready(warm_p.winners)
+        trace_dir = os.environ.get('BENCH_TRACE_DIR', 'traces/bench')
+        with observability.trace(trace_dir):
+            s = state
+            for b in batches:
+                s, _ = apply_op_batch(s, b)
+            jax.block_until_ready(s.winners)
+            out, _ = apply_seq_batch(seq_state, seq_batch)
+            jax.block_until_ready(out.nxt)
+            if pallas_variant:
+                s2, _ = pallas_apply_op_batch(state, batches[0],
+                                              variant=pallas_variant)
+                jax.block_until_ready(s2.winners)
+        return trace_dir
+    except Exception as exc:
+        print(f'# profiler trace capture failed: '
+              f'{type(exc).__name__}: {str(exc)[:200]}', file=sys.stderr)
+        return None
+
+
 def bench_host(n_docs, n_keys, rounds, ops_per_round, seed=0):
     """Same workload shape through the host OpSet engine (single-op changes,
     matching the backend_test.js concurrent-key-set shape)."""
@@ -771,6 +830,12 @@ def main():
         int(os.environ.get('BENCH_SAVE_CHANGES', 200)))
     mixed_rate, mixed_host = bench_backend_mixed(
         int(os.environ.get('BENCH_MIXED_DOCS', 500)))
+    trace_dir = capture_trace(n_docs, n_keys, ops_per_round,
+                              pallas_variant=pallas_variant)
+    if trace_dir is not None:
+        print(f'# profiler trace (merge + sequence'
+              f'{" + pallas " + pallas_variant if pallas_variant else ""}) '
+              f'written to {trace_dir}', file=sys.stderr)
 
     print(f'# HEADLINE backend-seam end-to-end (turbo, incl. hash graph): '
           f'{seam_rate:.0f} changes/s (median of {REPS}; single-dispatch '

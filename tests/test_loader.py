@@ -222,6 +222,30 @@ class TestBulkLoad:
             [{'t': '', 'l': [], 'x': 1}]
         assert fleet.metrics.doc_materializations == 0
 
+    def test_objects_inside_lists_bulk_load(self):
+        """Documents holding rows-in-lists (maps, nested lists, Text as
+        list elements) take the native bulk path, not the per-doc
+        fallback: the make element rows install as links, child objects
+        install like any registered object, and the loaded docs
+        materialize from device state and save back verbatim."""
+        d = A.init(A1)
+        d = A.change(d, lambda r: r.update(
+            {'todo': [{'t': 'wash', 'n': 1}, [1, 2], A.Text('hi')],
+             'k': 9}))
+        d = A.change(d, lambda r: r['todo'][0].update({'n': 2}))
+        d = A.change(d, lambda r: r['todo'][1].append(3))
+        d = A.change(d, lambda r: r['todo'].delete_at(2))
+        buf = bytes(A.save(d))
+        want = {'todo': [{'t': 'wash', 'n': 2}, [1, 2, 3]], 'k': 9}
+        for exact in (False, True):
+            fleet = DocFleet(doc_capacity=4, key_capacity=16,
+                             exact_device=exact)
+            handles = load_docs([buf, buf], fleet)
+            assert fleet.metrics.docs_bulk_loaded == 2, exact
+            assert fleet_backend.materialize_docs(handles) == [want, want]
+            assert bytes(fleet_backend.save(handles[0])) == buf
+            assert fleet.metrics.doc_materializations == 0
+
     def test_get_patch_stays_lazy_in_exact_mode(self):
         """get_patch on a flat bulk-loaded doc serves from the device
         registers without materializing the parked chunk."""
