@@ -2248,9 +2248,11 @@ def _apply_changes_turbo(handles, per_doc_changes):
 
     flags_all = rows['flags']
     seq_sel = (flags_all >= 3) & (flags_all <= 6)
-    make_sel = flags_all >= 7
+    make_sel = (flags_all >= 7) & (flags_all <= 10)
+    seq_make_sel = flags_all >= 11      # makes inside sequences (11-14)
     nested_sel = (flags_all <= 2) & (rows['obj'] != 0)
-    if seq_sel.any() or make_sel.any() or nested_sel.any():
+    if seq_sel.any() or make_sel.any() or nested_sel.any() or \
+            seq_make_sel.any():
         # RGA application is order-sensitive: if any doc needs the general
         # causal gate (whose applied order can differ from buffer order),
         # route the whole call to the exact path
@@ -2262,14 +2264,15 @@ def _apply_changes_turbo(handles, per_doc_changes):
         # objects — a type mismatch is an exact-path error too.
         made_seq = [set() for _ in engines]
         made_map = [set() for _ in engines]
-        for ri in np.flatnonzero(make_sel):
+        for ri in np.flatnonzero(make_sel | seq_make_sel):
             d = change_doc[int(rows['doc'][ri])]
             p = int(rows['packed'][ri])
             oid = f'{p >> 8}@{nat_actors[p & (_MA - 1)]}'
-            (made_seq if rows['flags'][ri] <= 8 else made_map)[d].add(oid)
+            (made_seq if int(rows['flags'][ri]) in (7, 8, 11, 12)
+             else made_map)[d].add(oid)
         for d, obj_nat in {(change_doc[int(rows['doc'][ri])],
                             int(rows['obj'][ri]))
-                           for ri in np.flatnonzero(seq_sel)}:
+                           for ri in np.flatnonzero(seq_sel | seq_make_sel)}:
             oid = f'{obj_nat >> 8}@{nat_actors[obj_nat & (_MA - 1)]}'
             if oid not in made_seq[d] and \
                     oid not in engines[d].seq_objects:
@@ -2433,31 +2436,31 @@ def _apply_changes_turbo(handles, per_doc_changes):
                          dtype=np.int32) if nat_actors else np.zeros(1, np.int32)
     slot_of_doc = np.array([e.slot for e in engines], dtype=np.int64)
 
-    keep_root = keep & ~seq_sel
-    keep_seq = keep & seq_sel
+    keep_root = keep & ~seq_sel & ~seq_make_sel
+    keep_seq = keep & (seq_sel | seq_make_sel)
 
     # Make ops: register the object with its engine (plus its device row
     # for sequences) and substitute the grid value with a link table ref
     kept_vals_all = rows['value'].astype(np.int32, copy=True)
     kept_flags_all = rows['flags'].copy()
-    for ri in np.flatnonzero(make_sel & keep):
+    for ri in np.flatnonzero((make_sel | seq_make_sel) & keep):
         p = int(rows['packed'][ri])
         oid = f'{p >> 8}@{nat_actors[p & (_MA - 1)]}'
         d = change_doc[int(rows['doc'][ri])]
         mk = int(rows['flags'][ri])
-        if mk <= 8:              # 7 makeText / 8 makeList
-            typ = 'text' if mk == 7 else 'list'
+        typ = {7: 'text', 8: 'list', 9: 'map', 10: 'table',
+               11: 'text', 12: 'list', 13: 'map', 14: 'table'}[mk]
+        if typ in ('text', 'list'):
             engines[d].seq_objects[oid] = typ
-            slot = engines[d].slot
-            if oid not in fleet.slot_seq.get(slot, {}):
-                fleet._alloc_seq_row(slot, oid, typ)
-            kept_vals_all[ri] = fleet._intern_value_boxed(_SeqLink(oid))
-        else:                    # 9 makeMap / 10 makeTable
-            typ = 'map' if mk == 9 else 'table'
+        else:
             engines[d].map_objects[oid] = typ
-            kept_vals_all[ri] = fleet._intern_value_boxed(
-                _MapLink(oid, typ))
-        kept_flags_all[ri] = 1
+        # kept_vals_all carries the boxed link for BOTH make kinds; makes
+        # inside sequences (mk >= 11) keep their wire insert bit in
+        # rows['value'] and route to the seq dispatch, while map-key makes
+        # become grid/register cell rows (flag 1)
+        kept_vals_all[ri] = fleet._make_link_value(engines[d].slot, oid, typ)
+        if mk <= 10:
+            kept_flags_all[ri] = 1
     if fleet.exact_device:
         # uint/counter/timestamp sets box with their wire datatype so
         # device-served patches keep exact datatypes and counter folds
@@ -2490,7 +2493,12 @@ def _apply_changes_turbo(handles, per_doc_changes):
         from .sequence import INSERT, SET, DEL, PAD, SEQ_PRED_LANES
         sflags = rows['flags'][keep_seq]
         svtype = rows['vtype'][keep_seq]
+        is_mk = sflags >= 11            # make element rows (11-14)
+        s_insert = rows['value'][keep_seq] != 0   # wire insert bit (makes)
         svalue = rows['value'][keep_seq].astype(np.int64)
+        if is_mk.any():
+            # make rows carry their boxed link value, not the insert bit
+            svalue[is_mk] = kept_vals_all[keep_seq][is_mk]
         sdoc = np.array(change_doc, dtype=np.int64)[rows['doc'][keep_seq]]
         sobj = rows['obj'][keep_seq].astype(np.int64)
 
@@ -2528,17 +2536,21 @@ def _apply_changes_turbo(handles, per_doc_changes):
                   f'@{nat_actors[int(obj_nat) & (_MA - 1)]}'
             urow[i] = fleet.slot_seq[int(slot_of_doc[int(d)])][oid]
         srow = urow[inv]
-        kind_lut = np.zeros(9, dtype=np.int64)
+        kind_lut = np.zeros(15, dtype=np.int64)
         kind_lut[3], kind_lut[4] = INSERT, SET
         kind_lut[5], kind_lut[6] = DEL, PAD
         skind = kind_lut[sflags]
+        if is_mk.any():
+            skind[is_mk] = np.where(s_insert[is_mk], INSERT, SET)
         is_text = np.array([info is not None and info['type'] == 'text'
                             for info in fleet.seq_rows], dtype=bool)
         txt = is_text[srow]
-        # host-side inexact flags: counter ops (flags 6 / vtype 8) and
-        # pred lists past the lane width
+        # host-side inexact flags: counter ops (flags 6 / vtype 8), pred
+        # lists past the lane width, and object elements inside Text rows
+        # (span rendering is mirror territory — same rule as _pack_seq_op)
         val_op = (sflags == 3) | (sflags == 4)
-        hflag = (sflags == 6) | (svtype == 8) | pred_overflow
+        hflag = (sflags == 6) | ((svtype == 8) & ~is_mk) | pred_overflow | \
+            (is_mk & txt)
         # Re-intern every payload the device lane can't carry inline
         # through _intern_seq_value — THE shared sequence-value rule:
         # text rows inline single code points, lists inline plain ints,

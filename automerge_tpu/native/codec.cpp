@@ -952,10 +952,12 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
     }
 
     if (!is_root && with_seq && key < 0) {
-      // ---- sequence element op (flags 3-6) ----
-      if (action != kActionSet && action != kActionDel &&
+      // ---- sequence element op (flags 3-6; makes 11-14) ----
+      bool is_make = action == kActionMakeMap || action == kActionMakeList ||
+          action == kActionMakeText || action == kActionMakeTable;
+      if (!is_make && action != kActionSet && action != kActionDel &&
           action != kActionInc)
-        return false;                 // make inside a sequence: host engine
+        return false;                 // link inside a sequence: host engine
       int32_t obj = obj_packed;
       // referent elemId: keyCtr 0 = '_head' (insert only); else packed
       if (i >= key_ctr.size() || !key_ctr_ok[i]) return false;
@@ -969,6 +971,25 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
         uint64_t ka = uint64_t(key_actor[i]);
         if (ka >= actor_table.size()) return false;
         ref = int32_t((kc << kActorBits) | actor_table[ka]);
+      }
+      if (is_make) {
+        // Object nested inside a sequence (rows-in-lists): flag-coded
+        // 11 makeText, 12 makeList, 13 makeMap, 14 makeTable; the value
+        // lane carries the insert bit (makes have no payload)
+        if (vsize != 0) return false;
+        uint8_t mk = action == kActionMakeText ? 11
+            : action == kActionMakeList ? 12
+            : action == kActionMakeMap ? 13 : 14;
+        ctx.out_doc.push_back(doc);
+        ctx.out_key.push_back(-1);
+        ctx.out_packed.push_back(self_packed);
+        ctx.out_val.push_back(insert ? 1 : 0);
+        ctx.out_flags.push_back(mk);
+        ctx.out_obj.push_back(obj);
+        ctx.out_ref.push_back(ref);
+        ctx.out_vtype.push_back(0);
+        ctx.out_vlen.push_back(0);
+        continue;
       }
       int64_t value = 0;
       uint8_t flags;

@@ -693,10 +693,10 @@ def bench_bulk_load(n_docs, n_changes=40, seed=0):
 
 def bench_backend_mixed(n_docs, n_changes=16, seed=0):
     """End-to-end seam rate on a REALISTIC document shape: nested config
-    maps, tables, strings/floats/bools, counters — the workload that used
-    to fall off the turbo path entirely (flat-int-only) and now rides the
-    native parser's nested rows + value arena. Returns (turbo changes/s,
-    host changes/s)."""
+    maps, rows-in-lists, strings/floats/bools — workloads that used to
+    fall off the turbo path entirely (flat-int-only) and now ride the
+    native parser's nested rows + value arena + seq-make rows. Returns
+    (turbo changes/s, host changes/s)."""
     import jax
     import automerge_tpu as am
     from automerge_tpu import backend as Backend
@@ -704,7 +704,8 @@ def bench_backend_mixed(n_docs, n_changes=16, seed=0):
         DocFleet, init_docs, apply_changes_docs)
     rng = np.random.default_rng(seed)
     d = am.from_({'cfg': {'name': 'base', 'opts': {'depth': 1}},
-                  'tags': {}, 'n': 0, 'rate': 1.5, 'on': True}, 'ab' * 16)
+                  'tags': {}, 'todo': [{'t': 'first', 'done': False}],
+                  'n': 0, 'rate': 1.5, 'on': True}, 'ab' * 16)
     for c in range(n_changes - 1):
         k = f'k{int(rng.integers(0, 12))}'
 
@@ -712,6 +713,10 @@ def bench_backend_mixed(n_docs, n_changes=16, seed=0):
             r['cfg']['opts'][k] = f'value-{c}'
             r['tags'][k] = float(c) if c % 3 else c
             r['n'] = c
+            if c % 4 == 0:
+                r['todo'].append({'t': f'task-{c}', 'done': False})
+            else:
+                r['todo'][0]['done'] = c % 2 == 1
         d = am.change(d, edit)
     changes = [bytes(b) for b in am.get_all_changes(d)]
     per_doc = [list(changes) for _ in range(n_docs)]

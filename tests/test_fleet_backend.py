@@ -601,6 +601,48 @@ class TestPromotion:
         assert materialize_docs([gb]) == [{'l': [{'row': 3}]}]
         assert bytes(host_backend.save(hb)) == bytes(fleet_backend.save(gb))
 
+    def test_turbo_rows_in_lists_no_fallback(self):
+        """The native turbo parser emits make-inside-sequence rows (flags
+        11-14), so rows-in-lists workloads keep the wire->device path:
+        one turbo call, zero fallbacks, device reads and saves identical
+        to the host engine."""
+        import automerge_tpu as am
+        a = ACTORS[0]
+        ops1 = [
+            {'action': 'makeList', 'obj': '_root', 'key': 'todo',
+             'pred': []},
+            {'action': 'makeMap', 'obj': f'1@{a}', 'elemId': '_head',
+             'insert': True, 'pred': []},
+            {'action': 'set', 'obj': f'2@{a}', 'key': 't', 'value': 'wash',
+             'pred': []},
+            {'action': 'makeList', 'obj': f'1@{a}', 'elemId': f'2@{a}',
+             'insert': True, 'pred': []},
+            {'action': 'set', 'obj': f'4@{a}', 'elemId': '_head',
+             'insert': True, 'value': 1, 'datatype': 'int', 'pred': []},
+        ]
+        c1 = change_buf(a, 1, 1, ops1)
+        c2 = change_buf(a, 2, 6, [
+            {'action': 'set', 'obj': f'2@{a}', 'key': 'n', 'value': 5,
+             'datatype': 'int', 'pred': []},
+            {'action': 'set', 'obj': f'4@{a}', 'elemId': f'5@{a}',
+             'insert': True, 'value': 2, 'datatype': 'int', 'pred': []}],
+            deps=[am.decode_change(c1)['hash']])
+        for exact in (False, True):
+            fleet = DocFleet(doc_capacity=2, key_capacity=8,
+                             exact_device=exact)
+            handles = fleet_backend.init_docs(2, fleet)
+            handles, _ = fleet_backend.apply_changes_docs(
+                handles, [[c1, c2]] * 2, mirror=False)
+            assert fleet.metrics.turbo_calls == 1, exact
+            assert fleet.metrics.fallbacks == 0, exact
+            assert fleet.metrics.promotions == 0, exact
+            want = {'todo': [{'t': 'wash', 'n': 5}, [1, 2]]}
+            assert fleet_backend.materialize_docs(handles) == [want] * 2
+            hb = host_backend.init()
+            hb, _ = host_backend.apply_changes(hb, [c1, c2])
+            assert bytes(host_backend.save(hb)) == \
+                bytes(fleet_backend.save(handles[0]))
+
     def test_link_op_rejected_loudly(self):
         """`link` is a reserved action the reference never applies
         (new.js:893 TODO); both engines reject it with the same error
