@@ -1730,9 +1730,11 @@ class _FlatEngine(HashGraph):
                     for packed, raw, cnt, char in elem_lanes:
                         base = {'insert': True} if packed == elem_packed \
                             else {'insert': False, 'elemId': elem_str}
+                        # object elements (rows-in-lists) flow through the
+                        # same make-row path the map cells use: the child
+                        # registers in object_meta and its own rows link
+                        # in when its (later) object_id is processed
                         row, _child = lane_row(packed, raw, cnt, base, char)
-                        if _child is not None:
-                            raise _Unsupported('object inside sequence')
                         shim._update_patch_property(
                             patches, object_id, row, prop_state, list_index,
                             0, object_meta, whole_doc=True)

@@ -1949,6 +1949,31 @@ class TestRegisterPatches:
         for turbo in (False, True):
             self._differential([c1], turbo=turbo)
 
+    def test_objects_inside_lists_patch_from_device(self):
+        """Rows-in-lists serve whole-doc patches straight from the device
+        registers (round 4): the make element rows flow through the same
+        child-linking path map cells use, no mirror rebuild."""
+        A = ACTORS[0]
+        c1 = change_buf(A, 1, 1, [
+            {'action': 'makeList', 'obj': '_root', 'key': 'todo',
+             'pred': []},
+            {'action': 'makeMap', 'obj': f'1@{A}', 'elemId': '_head',
+             'insert': True, 'pred': []},
+            {'action': 'set', 'obj': f'2@{A}', 'key': 't', 'value': 'wash',
+             'pred': []},
+            {'action': 'makeList', 'obj': f'1@{A}', 'elemId': f'2@{A}',
+             'insert': True, 'pred': []},
+            {'action': 'set', 'obj': f'4@{A}', 'elemId': '_head',
+             'insert': True, 'value': 7, 'datatype': 'int', 'pred': []},
+            {'action': 'set', 'obj': f'1@{A}', 'elemId': f'4@{A}',
+             'insert': True, 'value': 3, 'datatype': 'int', 'pred': []}])
+        h1 = am.decode_change(c1)['hash']
+        c2 = change_buf(A, 2, 7, [
+            {'action': 'set', 'obj': f'2@{A}', 'key': 'n', 'value': 5,
+             'datatype': 'int', 'pred': []}], deps=[h1])
+        for turbo in (False, True):
+            self._differential([c1, c2], turbo=turbo)
+
     def test_typed_list_elements_patch_from_device(self):
         """uint/timestamp/float64 list elements keep their datatypes in
         device-served patches (TypedValue boxing on the seq paths)."""
