@@ -78,6 +78,10 @@ class _SeqLink:
 
 _MAP_MAKE = ('makeMap', 'makeTable')
 
+# Deferred host-winner-mirror backlog cap (rows) before a forced fold; see
+# DocFleet._pending_winner_rows
+_WINNER_FOLD_LIMIT = 1 << 20
+
 
 class _ValueTable(list):
     """Boxed-value store with dedup interning: the table grows with the
@@ -257,6 +261,13 @@ class DocFleet:
         # over-counted cell. Exact-device mode needs none of this (the
         # register engine applies pred kills exactly).
         self.host_winners = None  # np.int32 [doc_cap, key_cap + 1]
+        # Set rows fold into host_winners lazily: inc-free batches (the
+        # common case) just append their arrays here, and the scatter-max
+        # replays only when an inc needs checking, a maintenance op
+        # (rebase/remap/clone/free/load) touches the mirror, or the
+        # backlog passes _WINNER_FOLD_LIMIT rows
+        self._pending_winner_rows = []     # [(doc, key, packed) arrays]
+        self._pending_winner_count = 0
         # exact_device=True stores the device state in the multi-value
         # register engine (fleet/registers.py) instead of the LWW
         # scatter-max grid: conflict sets, set-vs-delete resurrection, and
@@ -397,6 +408,7 @@ class DocFleet:
                 st.values.at[dst].set(st.values[src]),
                 st.counters.at[dst].set(st.counters[src]))
             if self.host_winners is not None:
+                self._fold_pending_winners()
                 self.host_winners[dst] = self.host_winners[src]
         if self.reg_state is not None and src < self.reg_state.reg.shape[0]:
             from .registers import RegisterState
@@ -417,6 +429,7 @@ class DocFleet:
                                     st.values.at[slot].set(0),
                                     st.counters.at[slot].set(0))
             if self.host_winners is not None:
+                self._fold_pending_winners()
                 self.host_winners[slot] = 0
         if self.reg_state is not None and \
                 slot < self.reg_state.reg.shape[0]:
@@ -776,6 +789,7 @@ class DocFleet:
         self.state = FleetState(jnp.where(w != 0, remapped, 0),
                                 self.state.values, self.state.counters)
         if self.host_winners is not None:
+            self._fold_pending_winners()
             hw = self.host_winners
             hw_new = (hw & ~mask) | perm_full[hw & mask]
             self.host_winners = np.where(hw != 0, hw_new, 0) \
@@ -890,6 +904,7 @@ class DocFleet:
             return old
         if min_live is not None:
             import jax.numpy as jnp
+            self._fold_pending_winners()
             delta = (new_base - old) << ACTOR_BITS
             w = self.state.winners
             shifted = jnp.where(w[slot] != 0, w[slot] - delta, 0)
@@ -937,16 +952,36 @@ class DocFleet:
         if hw is None:
             return
         if len(set_doc):
-            np.maximum.at(hw, (np.asarray(set_doc, dtype=np.int64),
-                               np.asarray(set_key, dtype=np.int64)),
-                          np.asarray(set_packed, dtype=np.int32))
+            self._pending_winner_rows.append(
+                (np.asarray(set_doc, dtype=np.int64),
+                 np.asarray(set_key, dtype=np.int64),
+                 np.asarray(set_packed, dtype=np.int32)))
+            self._pending_winner_count += len(set_doc)
         if len(inc_doc):
+            self._fold_pending_winners()
             inc_doc = np.asarray(inc_doc, dtype=np.int64)
             inc_key = np.asarray(inc_key, dtype=np.int64)
             inc_pred = np.asarray(inc_pred, dtype=np.int64)
             bad = inc_pred != hw[inc_doc, inc_key]
             for d in np.unique(inc_doc[bad]):
                 self.grid_overflow.add(int(d))
+        elif self._pending_winner_count > _WINNER_FOLD_LIMIT or \
+                len(self._pending_winner_rows) > 4096:
+            # Two caps: total rows (bounds the fold's work) and batch
+            # count (bounds per-batch numpy/tuple overhead under many
+            # tiny inc-free flushes)
+            self._fold_pending_winners()
+
+    def _fold_pending_winners(self):
+        """Replay the deferred set rows into the host winner mirror (one
+        scatter-max per backlog batch)."""
+        if not self._pending_winner_rows:
+            return
+        hw = self.host_winners
+        for set_doc, set_key, set_packed in self._pending_winner_rows:
+            np.maximum.at(hw, (set_doc, set_key), set_packed)
+        self._pending_winner_rows = []
+        self._pending_winner_count = 0
 
     def _slot_pack(self, slot, ctr, actor_num):
         """Pack a grid op's (counter, actor) against the slot's rebased
