@@ -338,6 +338,9 @@ class DocFleet:
         out = {'total': 0}
         if self.state is not None:
             out['lww_grid'] = nbytes(self.state.tree_flatten()[0])
+        if self.host_winners is not None:
+            # host-RAM mirror for counter-attribution checks (not device)
+            out['host_winner_mirror'] = int(self.host_winners.nbytes)
         if self.reg_state is not None:
             out['registers'] = nbytes(self.reg_state.tree_flatten()[0])
         pools = {}
