@@ -922,24 +922,29 @@ class DocFleet:
         return new_base
 
     def _pack_pred(self, slot, op):
-        """Pack an inc op's single pred against the slot's current window
-        WITHOUT rebase side effects; -1 when it cannot be packed (absent,
-        multiple, unregistered actor, outside the window) — which
-        _note_grid_batch treats as an attribution mismatch."""
+        """Pack an inc op's attribution pred against the slot's current
+        window WITHOUT rebase side effects. Multi-pred incs (conflicted
+        counters) attribute to the LAMPORT-MAX pred, matching the
+        reference's counterStates overwrite (new.js:942-945). Returns -1
+        when no pred can be packed (absent, unregistered actor, outside
+        the window) — which _note_grid_batch treats as a mismatch."""
         from ..common import parse_op_id
-        preds = op.get('pred') or []
-        if len(preds) != 1:
-            return -1
-        try:
-            ctr, actor = parse_op_id(preds[0])
-            num = self.actors.intern(actor)
-        except (KeyError, ValueError):
-            return -1
-        rel = ctr - self.ctr_base.get(slot, 0)
-        if rel <= 0 or rel >= CTR_LIMIT:
-            return -1
         from .tensor_doc import pack_op_id
-        return pack_op_id(rel, num)
+        preds = op.get('pred') or []
+        if not preds:
+            return -1
+        packed = []
+        for pr in preds:
+            try:
+                ctr, actor = parse_op_id(pr)
+                num = self.actors.intern(actor)
+            except (KeyError, ValueError):
+                return -1
+            rel = ctr - self.ctr_base.get(slot, 0)
+            if rel <= 0 or rel >= CTR_LIMIT:
+                return -1
+            packed.append(pack_op_id(rel, num))
+        return max(packed)
 
     def _note_grid_batch(self, set_doc, set_key, set_packed,
                          inc_doc, inc_key, inc_pred):
@@ -2707,18 +2712,39 @@ def _apply_changes_turbo(handles, per_doc_changes):
             pred_counts = np.diff(rows['pred_off'])
             counts_root = pred_counts[keep_root]
             off_root = rows['pred_off'][:-1][keep_root]
-            inc_preds = np.full(int(inc_sel.sum()), -1, dtype=np.int64)
-            one = counts_root[inc_sel] == 1
-            if one.any() and len(rows['pred']):
-                raw = rows['pred'][off_root[inc_sel][one]].astype(np.int64)
-                pa = actor_map[raw & (_MA - 1)].astype(np.int64)
-                inc_preds[one] = np.where(pa >= 0, (raw >> 8 << 8) | pa, -1)
+            inc_preds = _max_pred_per_inc(
+                rows['pred'], off_root[inc_sel], counts_root[inc_sel],
+                actor_map)
             fleet._note_grid_batch(slots[set_sel], key[set_sel],
                                    packed[set_sel], slots[inc_sel],
                                    key[inc_sel], inc_preds)
     dispatch_seq_rows()
     fleet.metrics.device_ops += int(keep.sum())
     return result
+
+
+def _max_pred_per_inc(pred_col, offs, counts, actor_map):
+    """Per inc row: the Lamport-max remapped pred packed id (the
+    reference's counter attribution target, new.js:942-945), or -1 when
+    absent or any pred names an unregistered actor. The single-pred
+    common case is fully vectorized; only multi-pred rows (conflicted
+    counters) loop."""
+    out = np.full(len(offs), -1, dtype=np.int64)
+    offs = np.asarray(offs)
+    counts = np.asarray(counts)
+    one = counts == 1
+    if one.any() and len(pred_col):
+        raw = pred_col[offs[one]].astype(np.int64)
+        pa = actor_map[raw & (MAX_ACTORS - 1)].astype(np.int64)
+        out[one] = np.where(pa >= 0, (raw >> 8 << 8) | pa, -1)
+    for i in np.flatnonzero(counts > 1):
+        off, cnt = int(offs[i]), int(counts[i])
+        raw = pred_col[off:off + cnt].astype(np.int64)
+        pa = actor_map[raw & (MAX_ACTORS - 1)].astype(np.int64)
+        if (pa < 0).any():
+            continue
+        out[i] = int(((raw >> 8 << 8) | pa).max())
+    return out
 
 
 def _has_unresolved_link(value):

@@ -133,8 +133,9 @@ def changes_to_op_batch_native(per_doc_changes, key_interner, actor_interner,
     When `hazard_out` is a list, the parse runs with_meta so pred columns
     are available, and one tuple (set_doc, set_key, set_packed, inc_doc,
     inc_key, inc_pred) in fleet numbering is appended — the feed for
-    DocFleet._note_grid_batch's counter-attribution check (inc_pred is -1
-    for incs whose pred is absent/multiple)."""
+    DocFleet._note_grid_batch's counter-attribution check (inc_pred is the
+    Lamport-max pred, the reference's attribution target; -1 when absent
+    or unresolvable)."""
     buffers, doc_ids = [], []
     for d, changes in enumerate(per_doc_changes):
         for change in changes:
@@ -163,17 +164,16 @@ def changes_to_op_batch_native(per_doc_changes, key_interner, actor_interner,
     actor = actor_map[rows['packed'] & 0xff] if len(actors) else 0
     packed = (ctr << 8) | actor
     if hazard_out is not None:
+        from .backend import _max_pred_per_inc
         flags_flat = rows['flags']
         set_sel = flags_flat == 1
         inc_sel = flags_flat == 2
         pred_counts = np.diff(rows['pred_off'])
-        first = rows['pred_off'][:-1][inc_sel]
-        preds = np.full(int(inc_sel.sum()), -1, dtype=np.int64)
-        one = pred_counts[inc_sel] == 1
-        if one.any() and len(rows['pred']):
-            raw = rows['pred'][first[one]]
-            pa = actor_map[raw & 0xff] if len(actors) else 0
-            preds[one] = (raw >> 8 << 8) | pa
+        amap_full = np.full(256, -1, dtype=np.int64)
+        amap_full[:len(actor_map)] = actor_map
+        preds = _max_pred_per_inc(rows['pred'],
+                                  rows['pred_off'][:-1][inc_sel],
+                                  pred_counts[inc_sel], amap_full)
         hazard_out.append((doc[set_sel], key[set_sel], packed[set_sel],
                            doc[inc_sel], key[inc_sel], preds))
     # Lay out rows into [N, P] with per-doc positions

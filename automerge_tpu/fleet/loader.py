@@ -199,9 +199,29 @@ def _install_parsed(fleet, out, native_idx, chunks, handles, fleet_backend):
         pos = np.clip(np.searchsorted(inc_sorted, skey), 0,
                       len(inc_sorted) - 1)
         succ_is_inc = inc_sorted[pos] == skey
-        inc_per = np.bincount(srow, weights=succ_is_inc.astype(np.float64),
+        # Counter attribution (new.js:942-945): an inc shared as succ by
+        # multiple counter sets (conflicted counter) is consumed and
+        # folded ONLY by the Lamport-max set; the other sets keep an
+        # unconsumed succ, so they fail the all-succs-are-incs rule below
+        # and stay invisible — matching the reference's counterStates
+        # overwrite (round-4 50x-chaos find)
+        succ_ok = np.zeros(len(srow), dtype=bool)
+        # good-doc rows only: a fallback-bound doc's overflow-aliased succ
+        # rows must not steal a good doc's winner group (same defense as
+        # the inc lookup table above)
+        idx = np.flatnonzero(succ_is_inc & ~bad[doc[srow]])
+        if len(idx):
+            packed32_pre = ((id_ctr << 8) | id_actor).astype(np.int64)
+            sk = skey[idx]
+            order2 = np.lexsort((packed32_pre[srow[idx]], sk))
+            sk_s = sk[order2]
+            last = np.r_[sk_s[1:] != sk_s[:-1], True]
+            keep = np.zeros(len(idx), dtype=bool)
+            keep[order2[last]] = True
+            succ_ok[idx[keep]] = True
+        inc_per = np.bincount(srow, weights=succ_ok.astype(np.float64),
                               minlength=n_ops).astype(np.int64)
-        fold = np.where(succ_is_inc, inc_vals[pos], 0)
+        fold = np.where(succ_ok, inc_vals[pos], 0)
         counter_add = np.bincount(srow, weights=fold.astype(np.float64),
                                   minlength=n_ops).astype(np.int64)
     else:

@@ -136,19 +136,39 @@ def _apply_step(state, op, n_slots, n_actor_slots):
         do_kill = hit & kills
         killed_row = killed_row.at[docs, s].set(killed_row[docs, s] | do_kill)
 
-    # INC: accumulate into the (single) live pred target's slot
-    inc_target = jnp.zeros((n_docs,), dtype=jnp.int32)
-    inc_hit = jnp.zeros((n_docs,), dtype=bool)
+    # INC: an increment on a conflicted counter carries one pred per
+    # conflicting set op (the frontend preds every conflict opId). The
+    # reference attributes such an inc to the LAMPORT-MAX pred — even a
+    # dead one: `counterStates[succOp] = counterState` overwrites earlier
+    # sets' registrations (new.js:942-945) — and every other pred'd set
+    # never completes its counter state, so it stays invisible forever
+    # (round-4 50x-chaos find, seed 18). Device equivalent: add to the
+    # max pred's lane iff that lane still holds it live; kill every other
+    # live pred'd lane (a dead max pred consumes the inc silently, and
+    # the lower branches hide either way).
+    is_inc = kind == INC
+    max_pred = jnp.zeros((n_docs,), dtype=jnp.int32)
+    any_live_hit = jnp.zeros((n_docs,), dtype=bool)
     for d in range(d_preds):
         p = preds[:, d]
         s = (p & ACTOR_MASK).astype(jnp.int32)
-        hit = (kind == INC) & (p != 0) & (s < n_actor_slots) & \
+        max_pred = jnp.where(is_inc & (p != 0),
+                             jnp.maximum(max_pred, p), max_pred)
+        any_live_hit |= is_inc & (p != 0) & (s < n_actor_slots) & \
             (reg_row[docs, s] == p) & ~killed_row[docs, s]
-        inc_target = jnp.where(hit & ~inc_hit, s, inc_target)
-        inc_hit |= hit
-    inc_slot = jnp.where(inc_hit, inc_target, n_actor_slots)  # OOB drops
-    counter_row = counter_row.at[docs, inc_slot].add(
-        jnp.where(inc_hit, val, 0), mode='drop')
+    s_max = (max_pred & ACTOR_MASK).astype(jnp.int32)
+    max_live = is_inc & (max_pred != 0) & (s_max < n_actor_slots) & \
+        (reg_row[docs, s_max] == max_pred) & ~killed_row[docs, s_max]
+    counter_row = counter_row.at[
+        docs, jnp.where(max_live, s_max, n_actor_slots)].add(
+        jnp.where(max_live, val, 0), mode='drop')
+    for d in range(d_preds):
+        p = preds[:, d]
+        s = (p & ACTOR_MASK).astype(jnp.int32)
+        lose = is_inc & (p != 0) & (s < n_actor_slots) & \
+            (reg_row[docs, s] == p) & ~killed_row[docs, s] & (p != max_pred)
+        killed_row = killed_row.at[docs, s].set(killed_row[docs, s] | lose)
+    inc_hit = any_live_hit | max_live
 
     # SET: occupy own actor slot. If the slot already holds a live op this
     # op did NOT pred, the reference would keep both visible — outside the

@@ -323,29 +323,32 @@ def bench_backend_pipeline(n_docs, n_keys, changes_per_doc, seed=0,
     asynchronous, so the host parse/hash/gate of chunk k+1 overlaps the
     device merge of chunk k — the double-buffering that keeps the chip from
     serializing behind the host-bound wire work (the only sync point is the
-    final block_until_ready)."""
+    final block_until_ready).
+
+    One change chain is shared by every doc (the bench_backend_text
+    pattern): the measured pipeline memoizes nothing by content — every
+    buffer is parsed, hashed, and gated per document — so this only makes
+    the 10k-doc setup affordable, not the measurement cheaper."""
     from automerge_tpu.columnar import encode_change, decode_change_meta
     from automerge_tpu.fleet.backend import (
         DocFleet, init_docs, apply_changes_docs, materialize_docs)
     rng = np.random.default_rng(seed)
     actors = ['aa' * 16, 'bb' * 16]
-    per_doc = []
-    for d in range(n_docs):
-        changes, heads = [], []
-        seqs = [0, 0]
-        for c in range(changes_per_doc):
-            a = c % 2
-            seqs[a] += 1
-            buf = encode_change({
-                'actor': actors[a], 'seq': seqs[a], 'startOp': c + 1,
-                'time': 0, 'message': '', 'deps': heads,
-                'ops': [{'action': 'set', 'obj': '_root',
-                         'key': f'k{int(rng.integers(0, n_keys))}',
-                         'value': int(rng.integers(1, 1 << 20)),
-                         'datatype': 'int', 'pred': []}]})
-            heads = [decode_change_meta(buf, True)['hash']]
-            changes.append(buf)
-        per_doc.append(changes)
+    changes, heads = [], []
+    seqs = [0, 0]
+    for c in range(changes_per_doc):
+        a = c % 2
+        seqs[a] += 1
+        buf = encode_change({
+            'actor': actors[a], 'seq': seqs[a], 'startOp': c + 1,
+            'time': 0, 'message': '', 'deps': heads,
+            'ops': [{'action': 'set', 'obj': '_root',
+                     'key': f'k{int(rng.integers(0, n_keys))}',
+                     'value': int(rng.integers(1, 1 << 20)),
+                     'datatype': 'int', 'pred': []}]})
+        heads = [decode_change_meta(buf, True)['hash']]
+        changes.append(buf)
+    per_doc = [list(changes) for _ in range(n_docs)]
     step = max(changes_per_doc // max(chunks, 1), 1)
     chunked = [[doc[lo:lo + step] for doc in per_doc]
                for lo in range(0, changes_per_doc, step)]
@@ -789,7 +792,9 @@ def main():
     # AND chunk-overlapped (host parse of chunk k+1 overlapping the device
     # merge of chunk k via async dispatch); the headline is the better of
     # the two — both are the identical public pipeline.
-    seam_docs = int(os.environ.get('BENCH_SEAM_DOCS', 2000))
+    # 10k docs = the BASELINE.json north-star config ("changes/sec on a
+    # 10k-doc concurrent-merge batch")
+    seam_docs = int(os.environ.get('BENCH_SEAM_DOCS', 10000))
     seam_chunks = int(os.environ.get('BENCH_SEAM_CHUNKS', 4))
     seam_rate_1, _ = bench_backend_pipeline(seam_docs, n_keys, 20)
     seam_rate_k, _ = bench_backend_pipeline(seam_docs, n_keys, 20,

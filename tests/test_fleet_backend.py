@@ -250,6 +250,101 @@ class TestDeviceMaterialization:
         device = fleet_backend.materialize_docs(handles)
         assert device == mirror
 
+    def test_conflicted_counter_increment_matches_reference(self):
+        """An inc on a conflicted counter preds EVERY conflicting set; the
+        reference attributes it to the Lamport-MAX pred'd set
+        (counterStates[succOp] overwrites earlier registrations,
+        new.js:942-945) and the other conflicting sets never complete
+        their counter state — they stay invisible. The register engine
+        must do the same: add to the max live pred'd lane, hide the rest
+        (round-4 50x-chaos find, seed 18)."""
+        import automerge_tpu as am
+        a, b, c = ACTORS[0], ACTORS[1], ACTORS[2]
+        c1 = change_buf(a, 1, 1, [
+            {'action': 'makeMap', 'obj': '_root', 'key': 'm', 'pred': []}])
+        h1 = am.decode_change(c1)['hash']
+        # concurrent counter creations under the same key -> conflict
+        c2 = change_buf(a, 2, 2, [
+            {'action': 'set', 'obj': f'1@{a}', 'key': 'y', 'value': 0,
+             'datatype': 'counter', 'pred': []}], deps=[h1])
+        c3 = change_buf(b, 1, 2, [
+            {'action': 'set', 'obj': f'1@{a}', 'key': 'y', 'value': 3,
+             'datatype': 'counter', 'pred': []}], deps=[h1])
+        h2 = am.decode_change(c2)['hash']
+        h3 = am.decode_change(c3)['hash']
+        # an actor that has seen BOTH increments the conflicted counter:
+        # pred lists every conflicting set op
+        c4 = change_buf(c, 1, 3, [
+            {'action': 'inc', 'obj': f'1@{a}', 'key': 'y', 'value': 1,
+             'datatype': 'counter', 'pred': [f'2@{a}', f'2@{b}']}],
+            deps=sorted([h2, h3]))
+        hb = host_backend.init()
+        for ch in (c1, c2, c3, c4):
+            hb, _ = host_backend.apply_changes(hb, [ch])
+        want = host_backend.get_patch(hb)
+        for turbo in (False, True):
+            fleet = DocFleet(doc_capacity=2, key_capacity=8,
+                             exact_device=True)
+            gb = fleet_backend.init(fleet)
+            if turbo:
+                [gb], _ = fleet_backend.apply_changes_docs(
+                    [gb], [[c1, c2, c3, c4]], mirror=False)
+            else:
+                for ch in (c1, c2, c3, c4):
+                    gb, _ = fleet_backend.apply_changes(gb, [ch])
+            got = fleet_backend.get_patch(gb)
+            assert got == want, turbo
+            assert fleet.metrics.mirror_rebuilds == 0
+            # winner (higher actor) shows base 3 + the shared inc
+            assert fleet_backend.materialize_docs([gb]) == [{'m': {'y': 4}}]
+
+    def test_conflicted_counter_inc_with_dead_max_pred(self):
+        """The attribution target is the Lamport-max pred even when that
+        set was already overwritten: the inc is consumed silently by the
+        dead set, and the LIVE lower branch still hides (its succ never
+        completes). The reference shows only the overwriting value."""
+        import automerge_tpu as am
+        a, b, c = ACTORS[0], ACTORS[1], ACTORS[2]
+        c1 = change_buf(a, 1, 1, [
+            {'action': 'makeMap', 'obj': '_root', 'key': 'm', 'pred': []}])
+        h1 = am.decode_change(c1)['hash']
+        c2 = change_buf(a, 2, 2, [
+            {'action': 'set', 'obj': f'1@{a}', 'key': 'y', 'value': 0,
+             'datatype': 'counter', 'pred': []}], deps=[h1])
+        c3 = change_buf(b, 1, 2, [
+            {'action': 'set', 'obj': f'1@{a}', 'key': 'y', 'value': 3,
+             'datatype': 'counter', 'pred': []}], deps=[h1])
+        h2 = am.decode_change(c2)['hash']
+        h3 = am.decode_change(c3)['hash']
+        # b overwrites its own counter with a plain value...
+        c4 = change_buf(b, 2, 3, [
+            {'action': 'set', 'obj': f'1@{a}', 'key': 'y', 'value': 9,
+             'datatype': 'int', 'pred': [f'2@{b}']}], deps=[h3])
+        h4 = am.decode_change(c4)['hash']
+        # ...while c, who saw only the two counters, incs the conflict
+        c5 = change_buf(c, 1, 3, [
+            {'action': 'inc', 'obj': f'1@{a}', 'key': 'y', 'value': 1,
+             'datatype': 'counter', 'pred': [f'2@{a}', f'2@{b}']}],
+            deps=sorted([h2, h3]))
+        hb = host_backend.init()
+        for ch in (c1, c2, c3, c4, c5):
+            hb, _ = host_backend.apply_changes(hb, [ch])
+        want = host_backend.get_patch(hb)
+        for turbo in (False, True):
+            fleet = DocFleet(doc_capacity=2, key_capacity=8,
+                             exact_device=True)
+            gb = fleet_backend.init(fleet)
+            if turbo:
+                [gb], _ = fleet_backend.apply_changes_docs(
+                    [gb], [[c1, c2, c3, c4, c5]], mirror=False)
+            else:
+                for ch in (c1, c2, c3, c4, c5):
+                    gb, _ = fleet_backend.apply_changes(gb, [ch])
+            got = fleet_backend.get_patch(gb)
+            assert got == want, turbo
+            assert fleet_backend.materialize_docs([gb]) == \
+                [{'m': {'y': 9}}], turbo
+
     def test_counter_inc_of_overwritten_set_not_served_wrong(self):
         """Round-4 chaos find: the grid's counter cell cannot attribute an
         inc to its pred, so an inc whose counter set lost (or was
