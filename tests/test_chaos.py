@@ -142,19 +142,29 @@ def test_chaos_differential(seed):
     joins = {N_STEPS * 2 // 5: JOINERS[0], N_STEPS * 3 // 5: JOINERS[1]}
     compare_every = max(10, N_STEPS // 4)
 
+    def conflict_views(doc):
+        """Conflict sets for every root key (winners can agree while the
+        losing branches diverge — the round-4 counter-attribution bug hid
+        exactly there)."""
+        return {k: A.get_conflicts(doc, k) for k in doc.keys()}
+
     def compare(tag):
         base = None
         for u in universes:
             views = [dict(d) for d in u.docs]
+            conflicts = [u.with_backend(lambda d=d: conflict_views(d))
+                         for d in u.docs]
             saves = [bytes(u.with_backend(lambda d=d: A.save(d)))
                      for d in u.docs]
             if base is None:
-                base = (u.name, views, saves)
+                base = (u.name, views, saves, conflicts)
             else:
                 assert views == base[1], \
                     f'{tag}: {u.name} reads diverge from {base[0]}'
                 assert saves == base[2], \
                     f'{tag}: {u.name} save bytes diverge from {base[0]}'
+                assert conflicts == base[3], \
+                    f'{tag}: {u.name} conflicts diverge from {base[0]}'
         return base[2]
 
     # seed replicas: identical initial change everywhere — change times are
