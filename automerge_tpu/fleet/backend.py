@@ -521,7 +521,7 @@ class DocFleet:
             self.seq_pools.pools[cls] = SeqState(
                 renum(st.elem_id), jnp.asarray(st.nxt),
                 renum(move(st.reg, 0)), move(st.killed, False),
-                move(st.val, 0), jnp.asarray(st.n),
+                move(st.val, 0), move(st.counter, 0), jnp.asarray(st.n),
                 jnp.asarray(st.inexact))
 
     def _intern_value(self, value):
@@ -607,10 +607,19 @@ class DocFleet:
         for i, p in enumerate(pred_ids):
             lanes[i] = pack_ref(p)
         if action == 'inc':
-            # Counters inside sequences are host-mirror-only: mark the row
-            # inexact so reads route to the mirror (ref new.js:937-965)
-            kind, value = PAD, 0
-            flag = True
+            # Exact on device: the INC kind accumulates into the pred'd
+            # counter lane with Lamport-max attribution (new.js:937-965).
+            # The lane bit-packs (sum << 2) | count-bits, so deltas are
+            # bounded at +/-2^29 — larger ones flag the row inexact
+            # instead of wrapping.
+            from .sequence import INC
+            kind = INC
+            delta = op.get('value', 0)
+            if isinstance(delta, int) and not isinstance(delta, bool) and \
+                    -(1 << 29) < delta < (1 << 29):
+                value = delta
+            else:
+                kind, value, flag = PAD, 0, True   # unencodable delta
         elif action == 'del':
             kind, value = DEL, 0
         elif action in _SEQ_MAKE or action in _MAP_MAKE:
@@ -629,8 +638,6 @@ class DocFleet:
         else:
             kind = INSERT if op.get('insert') else SET
             value = self._intern_seq_value(info['type'], op)
-            if op.get('datatype') == 'counter':
-                flag = True
         return (row, kind, pack_ref(op.get('elemId')), packed, value,
                 *lanes, flag)
 
@@ -715,27 +722,36 @@ class DocFleet:
         mats = {}
         for cls in per_cls:
             st = self.seq_pools.state(cls)
-            vals, vis, _n = (np.asarray(x) for x in
-                             jax.device_get(seq_materialize(st)))
-            mats[cls] = (vals, vis, np.asarray(st.inexact))
+            vals, cnts, vis, _n = (np.asarray(x) for x in
+                                   jax.device_get(seq_materialize(st)))
+            mats[cls] = (vals, cnts, vis, np.asarray(st.inexact))
 
-        def unbox(v):
+        def unbox(v, c):
             boxed = self.value_table[-v - 2]
-            return boxed.value if isinstance(boxed, TypedValue) else boxed
+            if isinstance(boxed, TypedValue):
+                # counter display = set base + accumulated inc deltas
+                # (ref new.js:937-965)
+                return boxed.value + c if boxed.datatype == 'counter' \
+                    else boxed.value
+            return boxed
 
         for cls, rows in per_cls.items():
-            vals, vis, inexact = mats[cls]
+            vals, cnts, vis, inexact = mats[cls]
             for row in rows:
                 idx = self.seq_place[row][1]
                 if inexact[idx]:
                     out[row] = None
                     continue
-                items = [int(v) for v in vals[idx][vis[idx]]]
+                # counter lanes bit-pack (sum << 2) | count-bits
+                items = [(int(v), int(c) >> 2) for v, c in
+                         zip(vals[idx][vis[idx]], cnts[idx][vis[idx]])]
                 if self.seq_rows[row]['type'] == 'text':
                     out[row] = ''.join(
-                        chr(v) if v >= 0 else str(unbox(v)) for v in items)
+                        chr(v) if v >= 0 else str(unbox(v, c))
+                        for v, c in items)
                 else:
-                    out[row] = [v if v >= 0 else unbox(v) for v in items]
+                    out[row] = [v if v >= 0 else unbox(v, c)
+                                for v, c in items]
         return out
 
     # -- ingest ---------------------------------------------------------
@@ -1770,17 +1786,48 @@ class _FlatEngine(HashGraph):
                 for elem_packed, elem_lanes in data:
                     elem_str = op_id_str(elem_packed)
                     vis_elem = False
-                    for packed, raw, cnt, char in elem_lanes:
-                        base = {'insert': True} if packed == elem_packed \
-                            else {'insert': False, 'elemId': elem_str}
+                    for packed, raw, cnt, char, n_incs in elem_lanes:
                         # object elements (rows-in-lists) flow through the
                         # same make-row path the map cells use: the child
                         # registers in object_meta and its own rows link
                         # in when its (later) object_id is processed
-                        row, _child = lane_row(packed, raw, cnt, base, char)
-                        shim._update_patch_property(
-                            patches, object_id, row, prop_state, list_index,
-                            0, object_meta, whole_doc=True)
+                        base = {'insert': True} if packed == elem_packed \
+                            else {'insert': False, 'elemId': elem_str}
+                        if n_incs == 0:
+                            row, _child = lane_row(packed, raw, cnt, base,
+                                                   char)
+                            shim._update_patch_property(
+                                patches, object_id, row, prop_state,
+                                list_index, 0, object_meta, whole_doc=True)
+                        else:
+                            # Replay the reference's counterStates walk
+                            # (new.js:936-965): the counter set with its
+                            # inc succs, then the incs — the edit shape
+                            # (insert for one consumed inc, the transient
+                            # remove->update for two or more) falls out
+                            # of the same ported machinery
+                            opid = op_id_str(packed)
+                            base_row, _child = lane_row(packed, raw, 0,
+                                                        base, char)
+                            if base_row.get('datatype') != 'counter':
+                                raise _Unsupported('inc on non-counter')
+                            succs = [f'{opid}+inc{i}'
+                                     for i in range(n_incs)]
+                            base_row['succ'] = list(succs)
+                            shim._update_patch_property(
+                                patches, object_id, base_row, prop_state,
+                                list_index, len(succs), object_meta,
+                                whole_doc=True)
+                            for i, sid in enumerate(succs):
+                                inc_row = {
+                                    'id': sid, 'succ': [], 'action': 'inc',
+                                    'insert': False, 'elemId': elem_str,
+                                    'value': cnt if i == n_incs - 1 else 0,
+                                }
+                                shim._update_patch_property(
+                                    patches, object_id, inc_row,
+                                    prop_state, list_index, 0, object_meta,
+                                    whole_doc=True)
                         vis_elem = True
                     if vis_elem:
                         list_index += 1
@@ -1822,11 +1869,11 @@ class _FlatEngine(HashGraph):
             idx = place[1]
             if bool(_np.asarray(st.inexact[idx])):
                 raise _Unsupported('sequence row inexact')
-            # one transfer for all five arrays (not five round-trips)
-            elem_id, nxt, reg, killed, val = (
+            # one transfer for all six arrays (not six round-trips)
+            elem_id, nxt, reg, killed, val, cnt = (
                 _np.asarray(x) for x in jax.device_get(
                     (st.elem_id[idx], st.nxt[idx], st.reg[idx],
-                     st.killed[idx], st.val[idx])))
+                     st.killed[idx], st.val[idx], st.counter[idx])))
             is_text = self.seq_objects.get(oid) == 'text'
             elems = []
             node = int(nxt[HEAD])
@@ -1838,7 +1885,14 @@ class _FlatEngine(HashGraph):
                 for s in _np.flatnonzero(live):
                     raw = int(val[node, s])
                     char = chr(raw) if is_text and raw >= 0 else None
-                    lanes.append((int(reg[node, s]), raw, 0, char))
+                    # counter lanes bit-pack (sum << 2) | count-bits
+                    # (0, 1, or 3; 3 = two or more); the count rides along
+                    # so the patch walk can replay the reference's
+                    # counterStates edit shapes
+                    bits = int(cnt[node, s]) & 3
+                    lanes.append((int(reg[node, s]), raw,
+                                  int(cnt[node, s]) >> 2, char,
+                                  2 if bits == 3 else bits))
                 lanes.sort(key=lambda lane: lane[0])
                 elems.append((int(elem_id[node]), lanes))
                 node = int(nxt[node])
@@ -2535,7 +2589,7 @@ def _apply_changes_turbo(handles, per_doc_changes):
         """Kept sequence rows -> one SeqState dispatch (fleet numbering)."""
         if not keep_seq.any():
             return
-        from .sequence import INSERT, SET, DEL, PAD, SEQ_PRED_LANES
+        from .sequence import INC, INSERT, SET, DEL, PAD, SEQ_PRED_LANES
         sflags = rows['flags'][keep_seq]
         svtype = rows['vtype'][keep_seq]
         is_mk = sflags >= 11            # make element rows (11-14)
@@ -2583,19 +2637,21 @@ def _apply_changes_turbo(handles, per_doc_changes):
         srow = urow[inv]
         kind_lut = np.zeros(15, dtype=np.int64)
         kind_lut[3], kind_lut[4] = INSERT, SET
-        kind_lut[5], kind_lut[6] = DEL, PAD
+        kind_lut[5], kind_lut[6] = DEL, INC
         skind = kind_lut[sflags]
         if is_mk.any():
             skind[is_mk] = np.where(s_insert[is_mk], INSERT, SET)
         is_text = np.array([info is not None and info['type'] == 'text'
                             for info in fleet.seq_rows], dtype=bool)
         txt = is_text[srow]
-        # host-side inexact flags: counter ops (flags 6 / vtype 8), pred
-        # lists past the lane width, and object elements inside Text rows
-        # (span rendering is mirror territory — same rule as _pack_seq_op)
+        # host-side inexact flags: pred lists past the lane width, object
+        # elements inside Text rows (span rendering is mirror territory —
+        # same rule as _pack_seq_op), and inc deltas past the bit-packed
+        # counter lane's +/-2^29 envelope; counters in sequences are
+        # otherwise exact (INC kind + per-lane counter registers)
         val_op = (sflags == 3) | (sflags == 4)
-        hflag = (sflags == 6) | ((svtype == 8) & ~is_mk) | pred_overflow | \
-            (is_mk & txt)
+        hflag = pred_overflow | (is_mk & txt) | \
+            ((sflags == 6) & (np.abs(svalue) >= (1 << 29)))
         # Re-intern every payload the device lane can't carry inline
         # through _intern_seq_value — THE shared sequence-value rule:
         # text rows inline single code points, lists inline plain ints,
@@ -2603,7 +2659,7 @@ def _apply_changes_turbo(handles, per_doc_changes):
         # ints) boxes into the value table
         svlen = vlen_all[keep_seq]
         seq_ri = np.flatnonzero(keep_seq)
-        tag_names = {3: 'uint', 4: 'int', 9: 'timestamp'}
+        tag_names = {3: 'uint', 4: 'int', 8: 'counter', 9: 'timestamp'}
         inline_ok = (svlen == 0) & np.where(txt, svtype == 6, svtype == 4)
         rebox = np.flatnonzero(val_op & ~hflag & ~inline_ok)
         for i in rebox:

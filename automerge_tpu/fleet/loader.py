@@ -296,10 +296,18 @@ def _install_parsed(fleet, out, native_idx, chunks, handles, fleet_backend):
                        doc, slot_of, okey, oid_str, key_str, packed32,
                        id_actor, vtype, val_int, counter_add, action,
                        make_mask, rid)
+    # sequence counter lanes bit-pack (sum << 2) | count-bits, where the
+    # count bits are 0, 1, or 3 (3 = two or more incs consumed) — the
+    # patch walk replays the reference's counterStates edit shapes, which
+    # depend on whether 0, 1, or >= 2 incs were consumed. Sums past the
+    # +/-2^29 envelope cannot pack; those rows go inexact in
+    # _install_seq_rows (mirror-served) instead of wrapping.
+    seq_counter = counter_add * 4 + np.minimum(inc_per, 2) + (inc_per >= 2)
+    seq_counter_over = np.abs(counter_add) >= (1 << 29)
     _install_seq_rows(fleet, out, keep & row_is_seq, doc, slot_of, okey,
                       oid_str, obj_type, insert, alive, inc_mask,
                       packed32, id_actor, key_ctr, key_actor, vtype, val_int,
-                      make_mask, rid)
+                      make_mask, rid, seq_counter, seq_counter_over)
 
     installed = set()
     for d, eng in engines.items():
@@ -422,7 +430,8 @@ def _install_map_cells(fleet, out, sel, doc, slot_of, okey, oid_str, key_str,
 
 def _install_seq_rows(fleet, out, sel, doc, slot_of, okey, oid_str, obj_type,
                       insert, alive, inc_mask, packed32, id_actor,
-                      key_ctr, key_actor, vtype, val_int, make_mask, rid):
+                      key_ctr, key_actor, vtype, val_int, make_mask, rid,
+                      counter_add, counter_over):
     """Reconstruct SeqState rows from document-order sequence ops: element
     encounter order IS final RGA order, so the linked list is a straight
     chain — no pointer walking, no replay. Make rows (objects nested inside
@@ -493,8 +502,7 @@ def _install_seq_rows(fleet, out, sel, doc, slot_of, okey, oid_str, obj_type,
     for i, j in enumerate(rows):
         jj = int(j)
         if inc_mask[jj]:
-            flag_counter[i] = True
-            continue
+            continue   # consumed via succ attribution into counter lanes
         if make_mask[jj]:
             # Nested object as a sequence element: fleet._make_link_value
             # is THE shared make-op link rule (links the child, allocates
@@ -508,9 +516,7 @@ def _install_seq_rows(fleet, out, sel, doc, slot_of, okey, oid_str, obj_type,
                 flag_counter[i] = True
             continue
         vt, vi = int(vtype[jj]), int(val_int[jj])
-        if vt == 8:
-            flag_counter[i] = True
-        elif txt[i] and vt == 6 and vi >= 0:
+        if txt[i] and vt == 6 and vi >= 0:
             values[i] = vi
             continue
         elif not txt[i] and vt == 4 and 0 <= vi < (1 << 31):
@@ -533,11 +539,13 @@ def _install_seq_rows(fleet, out, sel, doc, slot_of, okey, oid_str, obj_type,
     live_mask = np.zeros(len(rows), dtype=bool)
     live_mask[np.flatnonzero(live)] = True
 
-    # inexact flags: counters in sequences, unmatched update targets, and
-    # duplicate (element, lane) live ops (outside one-op-per-actor) —
-    # computed on op rows, applied per placement below
+    # inexact flags: unmatched update targets, counter sums past the
+    # packable envelope, object elements in Text rows, and duplicate
+    # (element, lane) live ops (outside one-op-per-actor) — computed on
+    # op rows, applied per placement below
     inex_obj = np.zeros(len(uniq), dtype=bool)
-    np.logical_or.at(inex_obj, inv[flag_counter | bad_upd], True)
+    np.logical_or.at(
+        inex_obj, inv[flag_counter | bad_upd | counter_over[rows]], True)
     lane_cell = inv[live_mask] * (1 << 42) + node[live_mask] * 512 + \
         id_actor[rows][live_mask]
     uq, cnt = np.unique(lane_cell, return_counts=True)
@@ -591,6 +599,8 @@ def _install_seq_rows(fleet, out, sel, doc, slot_of, okey, oid_str, obj_type,
         new_killed = st.killed.at[lidx].set(False)
         new_val = st.val.at[lidx].set(
             jnp.asarray(values[live_sel].astype(np.int32)))
+        new_counter = st.counter.at[lidx].set(
+            jnp.asarray(counter_add[rows][live_sel].astype(np.int32)))
 
         new_inexact = st.inexact
         inex = objs[inex_obj[objs]]
@@ -598,7 +608,7 @@ def _install_seq_rows(fleet, out, sel, doc, slot_of, okey, oid_str, obj_type,
             new_inexact = new_inexact.at[jnp.asarray(idx_arr[inex])].set(
                 True)
         fleet.seq_pools.pools[cls] = SeqState(
-            new_elem, new_nxt, new_reg, new_killed, new_val, new_n,
-            new_inexact)
+            new_elem, new_nxt, new_reg, new_killed, new_val, new_counter,
+            new_n, new_inexact)
         fleet.metrics.dispatches += 1
     fleet.metrics.device_ops += len(rows)

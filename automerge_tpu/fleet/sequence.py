@@ -33,9 +33,11 @@ succNum == 0 visibility rule). A SET/DEL kills exactly its preds, never
 concurrent ops, so the two shapes where single-winner LWW diverges from the
 reference — concurrent set-vs-set (conflict sets) and set-vs-delete
 (element resurrection, ref test/new_backend_test.js:1660) — are exact on
-device. The remaining host-only shapes (counters inside sequences,
-same-actor overwrites that don't pred their own op, pred lists past
-SEQ_PRED_LANES) flag the row `inexact` and route reads to the host mirror.
+device, and counters inside sequences accumulate exactly in per-lane
+counter registers with the reference's Lamport-max attribution
+(new.js:942-945). The remaining host-only shapes (same-actor overwrites
+that don't pred their own op, pred lists past SEQ_PRED_LANES) flag the row
+`inexact` and route reads to the host mirror.
 """
 
 import numpy as np
@@ -47,7 +49,7 @@ from jax import lax
 from .tensor_doc import ACTOR_BITS, MAX_ACTORS, pack_op_id, register_pytrees
 
 # Op kinds in a SeqOpBatch
-PAD, INSERT, SET, DEL = 0, 1, 2, 3
+PAD, INSERT, SET, DEL, INC = 0, 1, 2, 3, 4
 
 HEAD_REF = 0  # `ref == 0` means insert at the head ('_head' in the reference)
 
@@ -91,18 +93,29 @@ class SeqState:
       reg      packed opId of actor lane a's op on this element (0 = none)
       killed   that op has a successor (overwritten / deleted)
       val      the op's payload (char code / value-table ref)
+      counter  accumulated inc deltas for the lane's op, bit-packed as
+               (sum << 2) | count-bits, where the count bits are 0, 1,
+               or 3 (3 = two or more incs consumed) — the reference defers
+               a counter element's whole-doc patch through its counter
+               state, and the edit shape depends on the count (0 or 1 inc
+               emits `insert`, >= 2 emits `update` via the transient
+               remove->update conversion) — so the patch walk replays a
+               shape-equivalent row sequence; display value =
+               val + (counter >> 2), ref new.js:937-965
 
     Plus [N] allocation cursors `n` and [N] `inexact` flags (device state
-    diverged from reference semantics — counters in sequences, self
-    conflicts, pred overflow, unknown referents — so reads must come from
-    the host mirror, cf. registers.RegisterState)."""
+    diverged from reference semantics — self conflicts, pred overflow,
+    unknown referents — so reads must come from the host mirror, cf.
+    registers.RegisterState)."""
 
-    def __init__(self, elem_id, nxt, reg, killed, val, n, inexact=None):
+    def __init__(self, elem_id, nxt, reg, killed, val, counter, n,
+                 inexact=None):
         self.elem_id = elem_id
         self.nxt = nxt
         self.reg = reg
         self.killed = killed
         self.val = val
+        self.counter = counter
         self.n = n              # slots allocated per doc
         if inexact is None:
             # .shape is static even on tracers, so this default is jit-safe
@@ -128,12 +141,13 @@ class SeqState:
             xp.zeros(lanes, dtype=np.int32),
             xp.zeros(lanes, dtype=bool),
             xp.zeros(lanes, dtype=np.int32),
+            xp.zeros(lanes, dtype=np.int32),
             xp.zeros((n_docs,), dtype=np.int32),
             xp.zeros((n_docs,), dtype=bool))
 
     def tree_flatten(self):
         return ((self.elem_id, self.nxt, self.reg, self.killed, self.val,
-                 self.n, self.inexact), None)
+                 self.counter, self.n, self.inexact), None)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -173,6 +187,7 @@ def grow_seq_state(state, n_rows, capacity, actor_slots=None):
         pad_lane(state.reg, 0, jnp.int32),
         pad_lane(state.killed, False, bool),
         pad_lane(state.val, 0, jnp.int32),
+        pad_lane(state.counter, 0, jnp.int32),
         pad_vec(state.n, jnp.int32),
         pad_vec(state.inexact, bool))
 
@@ -190,8 +205,11 @@ class SeqOpBatch:
       fleet). The device kills exactly these lanes in the target element's
       register; concurrent ops survive (multi-value / resurrection
       semantics, ref new.js:1204-1217).
-    - flag   bool: host-detected inexactness for this row (counter ops in
-      sequences, pred-lane overflow): applied unconditionally.
+    - kind INC increments a counter element: ref targets the element,
+      value carries the delta, preds name the counter set op(s) — the
+      Lamport-max pred is the attribution target (new.js:942-945).
+    - flag   bool: host-detected inexactness for this row (pred-lane
+      overflow, object elements in Text rows): applied unconditionally.
     """
 
     def __init__(self, kind, ref, packed, value, preds=None, flag=None):
@@ -220,12 +238,13 @@ register_pytrees(SeqState, SeqOpBatch)
 
 def _apply_one_doc(carry, op, capacity, n_actor_slots):
     """One op against one doc.
-    carry = (elem_id, nxt, reg, killed, val, n, inexact)."""
-    elem_id, nxt, reg, killed, val, n, inexact = carry
+    carry = (elem_id, nxt, reg, killed, val, counter, n, inexact)."""
+    elem_id, nxt, reg, killed, val, counter, n, inexact = carry
     kind, ref, packed, value, preds, flag = op
 
     is_ins = kind == INSERT
     is_upd = (kind == SET) | (kind == DEL)
+    is_inc = kind == INC
 
     # Referent / target node: packed elemIds are unique and non-zero, so an
     # equality one-hot over the node axis finds it (sentinel and scratch
@@ -294,15 +313,19 @@ def _apply_one_doc(carry, op, capacity, n_actor_slots):
         jnp.where(w_ins, False, killed[ins_lane_tgt, a_c]))
     val = val.at[ins_lane_tgt, a_c].set(
         jnp.where(w_ins, value, val[ins_lane_tgt, a_c]))
+    counter = counter.at[ins_lane_tgt, a_c].set(
+        jnp.where(w_ins, 0, counter[ins_lane_tgt, a_c]))
 
-    # ---- SET / DEL: exact multi-value register update -------------------
+    # ---- SET / DEL / INC: exact multi-value register update -------------
     # ref == HEAD_REF (0) marks a malformed update (no target): it would
     # "match" every unallocated slot's zero elem_id, so reject it explicitly.
     upd_ok = is_upd & found & (ref != HEAD_REF)
-    tgt = jnp.where(upd_ok, match, jnp.int32(SCRATCH))
+    inc_ok = is_inc & found & (ref != HEAD_REF)
+    tgt = jnp.where(upd_ok | inc_ok, match, jnp.int32(SCRATCH))
     reg_row = reg[tgt]          # [A]
     killed_row = killed[tgt]
     val_row = val[tgt]
+    counter_row = counter[tgt]
 
     # Kill preds: each pred lane targets its actor's lane; the kill lands
     # only if that lane still holds exactly the pred'd op (a pred naming an
@@ -316,9 +339,50 @@ def _apply_one_doc(carry, op, capacity, n_actor_slots):
         s = (p & ACTOR_MASK).astype(jnp.int32)
         s_ok = (s < n_actor_slots) & (p > 0)
         s_c = jnp.minimum(s, n_actor_slots - 1)
-        lane_oob |= upd_ok & (p != 0) & ~s_ok
+        lane_oob |= (upd_ok | inc_ok) & (p != 0) & ~s_ok
         hit = upd_ok & s_ok & (reg_row[s_c] == p)
         killed_row = killed_row.at[s_c].set(killed_row[s_c] | hit)
+
+    # INC: counter attribution follows the reference (new.js:942-945):
+    # the inc is consumed by its LAMPORT-MAX pred (even a dead one); it
+    # accumulates into that lane iff the lane still holds the op live, and
+    # every OTHER live pred'd lane hides forever (its counter state never
+    # completes). Same rule as registers._apply_step.
+    max_pred = jnp.int32(0)
+    any_live_hit = jnp.bool_(False)
+    for d in range(d_lanes):
+        p = preds[d]
+        s = (p & ACTOR_MASK).astype(jnp.int32)
+        s_ok = (s < n_actor_slots) & (p > 0)
+        s_c = jnp.minimum(s, n_actor_slots - 1)
+        max_pred = jnp.where(is_inc & (p > 0),
+                             jnp.maximum(max_pred, p), max_pred)
+        any_live_hit |= inc_ok & s_ok & (reg_row[s_c] == p) & \
+            ~killed_row[s_c]
+    s_max = (max_pred & ACTOR_MASK).astype(jnp.int32)
+    s_max_ok = (s_max < n_actor_slots) & (max_pred != 0)
+    s_max_c = jnp.minimum(s_max, n_actor_slots - 1)
+    max_live = inc_ok & s_max_ok & (reg_row[s_max_c] == max_pred) & \
+        ~killed_row[s_max_c]
+    # (sum << 2) | count-bits packing (bits 0 -> 1 -> 3, 3 = "two or
+    # more", saturating) — see the SeqState docstring. The shifted add
+    # leaves the count bits alone. Sums are bounded to +/-2^29 by the
+    # ingest-side delta guards; larger deltas flag their rows inexact
+    # before reaching this kernel.
+    old_cnt = counter_row[s_max_c]
+    stepped = (old_cnt & ~3) + (value << 2)
+    stepped = stepped | jnp.where((old_cnt & 3) == 0, 1, 3)
+    counter_row = counter_row.at[s_max_c].set(
+        jnp.where(max_live, stepped, old_cnt))
+    for d in range(d_lanes):
+        p = preds[d]
+        s = (p & ACTOR_MASK).astype(jnp.int32)
+        s_ok = (s < n_actor_slots) & (p > 0)
+        s_c = jnp.minimum(s, n_actor_slots - 1)
+        lose = inc_ok & s_ok & (reg_row[s_c] == p) & ~killed_row[s_c] & \
+            (p != max_pred)
+        killed_row = killed_row.at[s_c].set(killed_row[s_c] | lose)
+    bad_inc = inc_ok & ~any_live_hit & ~max_live
 
     # SET: occupy own actor lane. If the lane already holds a live op this
     # op did NOT pred, the reference would keep both visible — outside the
@@ -338,31 +402,34 @@ def _apply_one_doc(carry, op, capacity, n_actor_slots):
     killed_row = killed_row.at[a_c].set(
         jnp.where(w_set, False, killed_row[a_c]))
     val_row = val_row.at[a_c].set(jnp.where(w_set, value, val_row[a_c]))
+    counter_row = counter_row.at[a_c].set(
+        jnp.where(w_set, 0, counter_row[a_c]))
 
     reg = reg.at[tgt].set(reg_row)
     killed = killed.at[tgt].set(killed_row)
     val = val.at[tgt].set(val_row)
+    counter = counter.at[tgt].set(counter_row)
 
     # Dropped ops (over-capacity or unknown-referent inserts, SET/DELs on
     # unknown targets) report as not-applied so callers can detect loss from
     # the stats instead of getting silent truncation.
-    applied = jnp.where(is_ins, can_ins, upd_ok)
+    applied = jnp.where(is_ins, can_ins, jnp.where(is_inc, inc_ok, upd_ok))
     ins_actor_oob = can_ins & ~a_ok
-    # Inexactness: host-flagged ops (counters, pred overflow), any dropped
-    # live op, actor numbers past the lane width, self conflicts, and preds
-    # naming unknown/out-of-range actors
+    # Inexactness: host-flagged ops (pred overflow), any dropped live op,
+    # actor numbers past the lane width, self conflicts, preds naming
+    # unknown/out-of-range actors, and incs with no consumable target
     inexact = inexact | flag | self_conflict | lane_oob | set_actor_oob | \
-        ins_actor_oob | ((kind > PAD) & ~applied)
-    return (elem_id, nxt, reg, killed, val, n, inexact), applied
+        ins_actor_oob | bad_inc | ((kind > PAD) & ~applied)
+    return (elem_id, nxt, reg, killed, val, counter, n, inexact), applied
 
 
 def _apply_seq_batch_impl(state, ops):
     capacity = state.elem_id.shape[1] - 3
     n_actor_slots = state.reg.shape[2]
 
-    def per_doc(elem_id, nxt, reg, killed, val, n, inexact,
+    def per_doc(elem_id, nxt, reg, killed, val, counter, n, inexact,
                 kind, ref, packed, value, preds, flag):
-        carry = (elem_id, nxt, reg, killed, val, n, inexact)
+        carry = (elem_id, nxt, reg, killed, val, counter, n, inexact)
         xs = (kind, ref, packed, value, preds, flag)
         carry, applied = lax.scan(
             lambda c, x: _apply_one_doc(c, x, capacity, n_actor_slots),
@@ -371,8 +438,8 @@ def _apply_seq_batch_impl(state, ops):
 
     carry, applied = jax.vmap(per_doc)(
         state.elem_id, state.nxt, state.reg, state.killed, state.val,
-        state.n, state.inexact, ops.kind, ops.ref, ops.packed, ops.value,
-        ops.preds, ops.flag)
+        state.counter, state.n, state.inexact, ops.kind, ops.ref,
+        ops.packed, ops.value, ops.preds, ops.flag)
     return SeqState(*carry), jnp.sum(applied)
 
 
@@ -381,14 +448,16 @@ apply_seq_batch = jax.jit(_apply_seq_batch_impl)
 
 def _visible_impl(state):
     """Per-element visibility and Lamport winner from the registers:
-    (vis [N, S+3] bool, winner [N, S+3] int32 packed, value [N, S+3])."""
+    (vis [N, S+3] bool, winner [N, S+3] int32 packed, value [N, S+3],
+    counter [N, S+3] — the winning lane's accumulated inc deltas)."""
     live = (state.reg != 0) & ~state.killed
     vis = jnp.any(live, axis=-1)
     masked = jnp.where(live, state.reg, -1)
     w = jnp.argmax(masked, axis=-1)
     winner = jnp.max(jnp.where(live, state.reg, 0), axis=-1)
     value = jnp.take_along_axis(state.val, w[..., None], axis=-1)[..., 0]
-    return vis, winner, value
+    cnt = jnp.take_along_axis(state.counter, w[..., None], axis=-1)[..., 0]
+    return vis, winner, value, cnt
 
 
 element_visibility = jax.jit(_visible_impl)
@@ -427,19 +496,22 @@ linearize = jax.jit(_linearize_impl)
 
 
 def _materialize_impl(state):
-    """Return (vals [N, S], vis [N, S], length [N]) in sequence order.
+    """Return (vals [N, S], cnts [N, S], vis [N, S], length [N]) in
+    sequence order.
 
-    vals/vis are scattered into order positions; entries at index >= length
-    are zeros. Visible-only extraction (for text strings / patch indexes) is
-    a host-side compress over the vis mask. Values are the per-element
-    Lamport winners over the visible register set (conflict sets render
-    their winner, like the reference's applyProperties rule,
-    frontend/apply_patch.js:57-79)."""
+    vals/cnts/vis are scattered into order positions; entries at index >=
+    length are zeros. Visible-only extraction (for text strings / patch
+    indexes) is a host-side compress over the vis mask. Values are the
+    per-element Lamport winners over the visible register set (conflict
+    sets render their winner, like the reference's applyProperties rule,
+    frontend/apply_patch.js:57-79); cnts carry the winning lane's
+    accumulated counter deltas (display value = val + cnt for counter
+    payloads)."""
     capacity = state.elem_id.shape[1] - 3
     pos, n = _linearize_impl(state)
-    e_vis, _winner, e_val = _visible_impl(state)
+    e_vis, _winner, e_val, e_cnt = _visible_impl(state)
 
-    def per_doc(pos, vis, val, n):
+    def per_doc(pos, vis, val, cnt, n):
         node_ids = jnp.arange(capacity + 3, dtype=jnp.int32)
         alloc = (node_ids >= SLOT0) & (node_ids < SLOT0 + n)
         # Scatter into sequence order; masked lanes land on a trailing
@@ -447,12 +519,14 @@ def _materialize_impl(state):
         tgt = jnp.where(alloc, jnp.clip(pos, 0, capacity), capacity)
         out_val = jnp.zeros((capacity + 1,), val.dtype).at[tgt].set(
             jnp.where(alloc, val, 0))
+        out_cnt = jnp.zeros((capacity + 1,), cnt.dtype).at[tgt].set(
+            jnp.where(alloc, cnt, 0))
         out_vis = jnp.zeros((capacity + 1,), jnp.bool_).at[tgt].set(
             jnp.where(alloc, vis, False))
-        return out_val[:capacity], out_vis[:capacity]
+        return out_val[:capacity], out_cnt[:capacity], out_vis[:capacity]
 
-    vals, vis = jax.vmap(per_doc)(pos, e_vis, e_val, state.n)
-    return vals, vis, state.n
+    vals, cnts, vis = jax.vmap(per_doc)(pos, e_vis, e_val, e_cnt, state.n)
+    return vals, cnts, vis, state.n
 
 
 materialize = jax.jit(_materialize_impl)
@@ -461,7 +535,7 @@ materialize = jax.jit(_materialize_impl)
 def visible_text(state):
     """Host helper: decode each doc's visible values as a Python string
     (values interpreted as Unicode code points)."""
-    vals, vis, n = jax.device_get(materialize(state))
+    vals, _cnts, vis, n = jax.device_get(materialize(state))
     out = []
     for d in range(vals.shape[0]):
         row_vis = vis[d]
@@ -519,7 +593,8 @@ class SeqEncoder:
         value = np.zeros((n_docs, width), dtype=np.int32)
         preds = np.zeros((n_docs, width, SEQ_PRED_LANES), dtype=np.int32)
         flag = np.zeros((n_docs, width), dtype=bool)
-        kinds = {'insert': INSERT, 'set': SET, 'del': DEL}
+        kinds = {'insert': INSERT, 'set': SET, 'del': DEL,
+                 'inc': INC}
         for d, ops in enumerate(per_doc_ops):
             for i, op in enumerate(ops):
                 kind[d, i] = kinds[op['kind']]
@@ -631,6 +706,7 @@ class SeqPools:
                     st.reg.at[i].set(0),
                     st.killed.at[i].set(False),
                     st.val.at[i].set(0),
+                    st.counter.at[i].set(0),
                     st.n.at[i].set(0),
                     st.inexact.at[i].set(False))
             self.free.setdefault(cls, []).extend(idxs)
@@ -663,6 +739,7 @@ class SeqPools:
         self.pools[dst_cls] = SeqState(
             put(d.elem_id, s.elem_id), put(d.nxt, s.nxt),
             put(d.reg, s.reg), put(d.killed, s.killed), put(d.val, s.val),
+            put(d.counter, s.counter),
             d.n.at[di].set(s.n[si]),
             d.inexact.at[di].set(s.inexact[si]))
 

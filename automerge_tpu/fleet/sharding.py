@@ -132,8 +132,8 @@ def shard_long_seq(state, mesh):
         jax.device_put(arr, by_ndim[arr.ndim]) for arr in (
             padded(state.elem_id, 0), padded(state.nxt, END),
             padded(state.reg, 0), padded(state.killed, False),
-            padded(state.val, 0), jnp.asarray(state.n),
-            jnp.asarray(state.inexact))))
+            padded(state.val, 0), padded(state.counter, 0),
+            jnp.asarray(state.n), jnp.asarray(state.inexact))))
 
 
 def sharded_long_seq_apply(mesh):
@@ -165,8 +165,9 @@ def sharded_long_seq_materialize(mesh):
 
     @jax.jit
     def run(state):
-        vals, vis, n = _materialize_impl(state)
+        vals, cnts, vis, n = _materialize_impl(state)
         return (jax.lax.with_sharding_constraint(vals, slots),
+                jax.lax.with_sharding_constraint(cnts, slots),
                 jax.lax.with_sharding_constraint(vis, slots), n)
     return run
 

@@ -1225,7 +1225,10 @@ class TestSequenceSeam:
         fb.fleet.flush()
         assert not fb.fleet.seq_row_inexact(0)
 
-    def test_counter_in_list_falls_back(self):
+    def test_counter_in_list_exact_on_device(self):
+        """Counters inside sequences accumulate exactly in per-lane
+        counter registers (round 4) — no inexact fallback; device reads
+        fold the winning lane's deltas onto the boxed counter base."""
         fb = self._fb()
         gb = fb.init()
         A = ACTORS[0]
@@ -1241,7 +1244,50 @@ class TestSequenceSeam:
         gb, _ = fleet_backend.apply_changes(gb, [c2])
         assert fleet_backend.materialize_docs([gb]) == [{'l': [15]}]
         fb.fleet.flush()
-        assert fb.fleet.seq_row_inexact(0)
+        assert not fb.fleet.seq_row_inexact(0)
+
+    def test_counter_in_list_patch_shapes_match_host(self):
+        """Whole-doc patches for counters in lists replay the reference's
+        counterStates edit shapes: insert for 0/1 consumed incs, the
+        remove->update conversion for >= 2 — across per-doc, turbo, and
+        bulk-load paths in both fleet modes."""
+        import automerge_tpu as am
+        from automerge_tpu.fleet.loader import load_docs
+        A, B = ACTORS[0], ACTORS[1]
+        for n_incs in (1, 2, 3):
+            ops = [{'action': 'makeList', 'obj': '_root', 'key': 'l',
+                    'pred': []},
+                   {'action': 'set', 'obj': f'1@{A}', 'elemId': '_head',
+                    'insert': True, 'value': 10, 'datatype': 'counter',
+                    'pred': []}]
+            for i in range(n_incs):
+                ops.append({'action': 'inc', 'obj': f'1@{A}',
+                            'elemId': f'2@{A}', 'value': i + 1,
+                            'datatype': 'counter', 'pred': [f'2@{A}']})
+            c1 = change_buf(A, 1, 1, ops)
+            hb = host_backend.init()
+            hb, _ = host_backend.apply_changes(hb, [c1])
+            want = host_backend.get_patch(hb)
+            saved = bytes(host_backend.save(hb))
+            for exact in (False, True):
+                for turbo in (False, True):
+                    fleet = DocFleet(doc_capacity=2, key_capacity=8,
+                                     exact_device=exact)
+                    gb = fleet_backend.init(fleet)
+                    if turbo:
+                        [gb], _ = fleet_backend.apply_changes_docs(
+                            [gb], [[c1]], mirror=False)
+                    else:
+                        gb, _ = fleet_backend.apply_changes(gb, [c1])
+                    assert fleet_backend.get_patch(gb) == want, \
+                        (n_incs, exact, turbo)
+                    assert bytes(fleet_backend.save(gb)) == saved
+                fresh = DocFleet(doc_capacity=2, key_capacity=8,
+                                 exact_device=exact)
+                hb2 = load_docs([saved], fresh)[0]
+                assert fresh.metrics.docs_bulk_loaded == 1
+                assert fleet_backend.get_patch(hb2) == want, \
+                    ('bulk', n_incs, exact)
 
     def test_clone_and_free_with_seq_rows(self):
         fb = self._fb()

@@ -391,8 +391,9 @@ class TestLongDocSharding:
         local, _ = apply_seq_batch(state, extra)
         sharded, _ = sharded_long_seq_apply(mesh)(sharded, extra)
 
-        lv, lvis, ln = jax.device_get(materialize(local))
-        sv, svis, sn = jax.device_get(sharded_long_seq_materialize(mesh)(sharded))
+        lv, _lc, lvis, ln = jax.device_get(materialize(local))
+        sv, _sc, svis, sn = jax.device_get(
+            sharded_long_seq_materialize(mesh)(sharded))
         # The sharded state may be tail-padded to a device-count multiple;
         # padded slots are unallocated, so the real prefix must match exactly
         np.testing.assert_array_equal(lv, sv[:, :lv.shape[1]])
