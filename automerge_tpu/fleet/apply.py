@@ -17,8 +17,7 @@ import jax.numpy as jnp
 from .tensor_doc import FleetState
 
 
-@jax.jit
-def apply_op_batch(state, ops):
+def _apply_op_batch_impl(state, ops):
     """Apply one OpBatch to the fleet. Returns (new_state, stats).
 
     `stats` is a per-fleet vector of ops applied (useful as a psum'd health
@@ -62,6 +61,24 @@ def apply_op_batch(state, ops):
 
     stats = jnp.sum(ops.valid, dtype=jnp.int32)
     return FleetState(winners, values, counters), stats
+
+
+apply_op_batch = jax.jit(_apply_op_batch_impl)
+
+# The fleet's own dispatch paths donate the input state: the scatters then
+# update the [docs, keys] grids in place instead of rewriting ~all of HBM
+# per dispatch (the state is replaced by the result at every call site, so
+# the donated buffers are never read again). External callers use the
+# non-donating apply_op_batch, which keeps the input alive for reuse.
+#
+# Failure contract: if a donated dispatch fails at execution time (e.g.
+# transient device OOM), the input buffers are already gone and the fleet's
+# device state is unrecoverable — unlike the non-donating path, the error
+# is not retryable in place. That is an accepted trade: the host-side
+# change logs remain the source of truth, so documents rebuild into a
+# fresh fleet (or promote to the host engine) from their logs; device
+# state is always a derived cache.
+apply_op_batch_donated = jax.jit(_apply_op_batch_impl, donate_argnums=(0,))
 
 
 def fleet_merge(state, op_batches):

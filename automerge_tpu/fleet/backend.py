@@ -646,7 +646,8 @@ class DocFleet:
         capacity (migrating rows that outgrew their class) and batch-apply
         all pending sequence ops — ONE dispatch per active size class.
         seq_ops rows are (row, kind, ref, packed, value, pred0..D-1, flag)."""
-        from .sequence import SeqOpBatch, apply_seq_batch, INSERT, \
+        from .sequence import SeqOpBatch, apply_seq_batch_donated, \
+            INSERT, \
             SEQ_PRED_LANES
         if len(self.seq_rows) == 0 or len(seq_ops) == 0:
             return
@@ -697,7 +698,7 @@ class DocFleet:
             flag[rows_idx, pos] = arr[sub, 5 + D] != 0
             batch = SeqOpBatch(cols['kind'], cols['ref'], cols['packed'],
                                cols['value'], preds, flag)
-            new_state, _stats = apply_seq_batch(st, batch)
+            new_state, _stats = apply_seq_batch_donated(st, batch)
             self.seq_pools.pools[cls] = new_state
             self.metrics.dispatches += 1
         self.metrics.device_ops += len(seq_ops)
@@ -1029,7 +1030,7 @@ class DocFleet:
         and one merge dispatch for the whole fleet."""
         if not self.pending:
             return
-        from .apply import apply_op_batch
+        from .apply import apply_op_batch_donated
         perm = self.actors.insert_many(self.pending_actors)
         if perm is not None:
             if self.exact_device:
@@ -1072,8 +1073,8 @@ class DocFleet:
             pad = self.state.winners.shape[0] - batch.key_id.shape[0]
             batch = type(batch)(*(np.pad(col, ((0, pad), (0, 0)))
                                   for col in batch.tree_flatten()[0]))
-        self.state, _stats = apply_op_batch(self.state,
-                                            self._shard_docs(batch))
+        self.state, _stats = apply_op_batch_donated(
+            self.state, self._shard_docs(batch))
         self.metrics.dispatches += 1
         self.metrics.device_ops += int(batch.valid.sum())
         if hazard:
@@ -1084,7 +1085,8 @@ class DocFleet:
         register engine, one ordered-scan dispatch. Batches containing
         sequence ops route through the mixed Python parse."""
         from .ingest import changes_to_op_rows
-        from .registers import apply_register_batch, rows_to_register_batch
+        from .registers import (apply_register_batch_donated,
+                                rows_to_register_batch)
         try:
             rows = changes_to_op_rows(per_doc, self.keys, self.actors,
                                       value_table=self.value_table)
@@ -1097,7 +1099,7 @@ class DocFleet:
             rows['doc'], rows['flags'], rows['key'], rows['packed'],
             rows['value'], rows['pred_off'], rows['pred'],
             n_docs=n_cap, d_preds=self.d_preds)
-        self.reg_state, _stats = apply_register_batch(
+        self.reg_state, _stats = apply_register_batch_donated(
             self.reg_state, self._shard_docs(batch))
         self.metrics.dispatches += 1
         self.metrics.device_ops += len(rows['doc'])
@@ -1105,7 +1107,7 @@ class DocFleet:
     def _flush_mixed(self, per_doc, n_docs):
         """Python-decode flush splitting flat root-map rows (LWW grid) from
         sequence-object ops (SeqState fleet). per_doc is indexed by slot."""
-        from .apply import apply_op_batch
+        from .apply import apply_op_batch_donated
         from .tensor_doc import OpBatch, pack_op_id
         from .ingest import changes_to_decoded_ops
         from ..common import parse_op_id
@@ -1192,8 +1194,8 @@ class DocFleet:
                 valid[d, j] = True
             batch = OpBatch(cols['key_id'], cols['packed'], cols['value'],
                             is_set, is_inc, valid)
-            self.state, _stats = apply_op_batch(self.state,
-                                                self._shard_docs(batch))
+            self.state, _stats = apply_op_batch_donated(
+                self.state, self._shard_docs(batch))
             self.metrics.dispatches += 1
             self.metrics.device_ops += len(rows)
             sets = [(r[0], r[1], r[2]) for r in rows if r[4]]
@@ -1208,7 +1210,8 @@ class DocFleet:
         """Mixed-content flush for exact-device mode: flat rows (with pred
         lists) into the register engine, sequence ops into the SeqState
         fleet."""
-        from .registers import apply_register_batch, rows_to_register_batch
+        from .registers import (apply_register_batch_donated,
+                                rows_to_register_batch)
         from .tensor_doc import pack_op_id
         from .ingest import changes_to_decoded_ops
         from ..common import parse_op_id
@@ -1268,7 +1271,7 @@ class DocFleet:
                 np.array(pred_off, dtype=np.int64),
                 np.array(preds, dtype=np.int32),
                 n_docs=n_cap, d_preds=self.d_preds)
-            self.reg_state, _stats = apply_register_batch(
+            self.reg_state, _stats = apply_register_batch_donated(
                 self.reg_state, self._shard_docs(batch))
             self.metrics.dispatches += 1
             self.metrics.device_ops += len(out_doc)
@@ -2241,7 +2244,7 @@ def _apply_changes_turbo(handles, per_doc_changes):
     graph with no per-change dict work, the rest go through the general
     causal gate. The call is atomic: any gate error rolls back every doc."""
     from .. import native
-    from .apply import apply_op_batch
+    from .apply import apply_op_batch_donated
     from .tensor_doc import OpBatch, MAX_ACTORS as _MA
 
     if not native.available() or not handles:
@@ -2693,7 +2696,8 @@ def _apply_changes_turbo(handles, per_doc_changes):
     packed = (ctr << 8) | actor
 
     if fleet.exact_device:
-        from .registers import apply_register_batch, rows_to_register_batch
+        from .registers import (apply_register_batch_donated,
+                                rows_to_register_batch)
         if n_kept_root:
             # Slice the kept rows' pred segments and remap their actor bits
             pred_counts = np.diff(rows['pred_off'])
@@ -2723,7 +2727,7 @@ def _apply_changes_turbo(handles, per_doc_changes):
                 packed, kept_vals_all[keep_root], off_kept, preds_kept,
                 n_docs=n_cap, d_preds=fleet.d_preds,
                 force_overflow=bad_rows)
-            fleet.reg_state, _stats = apply_register_batch(
+            fleet.reg_state, _stats = apply_register_batch_donated(
                 fleet.reg_state, fleet._shard_docs(reg_batch))
             fleet.metrics.dispatches += 1
         dispatch_seq_rows()
@@ -2755,8 +2759,8 @@ def _apply_changes_turbo(handles, per_doc_changes):
             pad = n_cap - batch.key_id.shape[0]
             batch = OpBatch(*(np.pad(col, ((0, pad), (0, 0)))
                               for col in batch.tree_flatten()[0]))
-        fleet.state, _stats = apply_op_batch(fleet.state,
-                                             fleet._shard_docs(batch))
+        fleet.state, _stats = apply_op_batch_donated(
+            fleet.state, fleet._shard_docs(batch))
         fleet.metrics.dispatches += 1
         # Counter-attribution check (see _note_grid_batch): advance the
         # host winner mirror with this batch's set rows and verify each

@@ -219,6 +219,11 @@ def _apply_register_batch_impl(state, ops):
 
 
 apply_register_batch = jax.jit(_apply_register_batch_impl)
+# In-place variant for the fleet's own dispatch paths (see
+# apply.apply_op_batch_donated): the register tensors update without a
+# full-state rewrite; callers must replace their state reference.
+apply_register_batch_donated = jax.jit(_apply_register_batch_impl,
+                                       donate_argnums=(0,))
 
 
 @jax.jit

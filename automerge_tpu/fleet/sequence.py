@@ -444,6 +444,10 @@ def _apply_seq_batch_impl(state, ops):
 
 
 apply_seq_batch = jax.jit(_apply_seq_batch_impl)
+# In-place variant for the fleet's own dispatch paths (see
+# apply.apply_op_batch_donated)
+apply_seq_batch_donated = jax.jit(_apply_seq_batch_impl,
+                                  donate_argnums=(0,))
 
 
 def _visible_impl(state):
