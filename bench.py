@@ -800,6 +800,8 @@ def main():
     seam_rate_k, _ = bench_backend_pipeline(seam_docs, n_keys, 20,
                                             chunks=seam_chunks)
     seam_rate = max(seam_rate_1, seam_rate_k)
+    # Cross-round continuity: rounds 1-3 measured the seam at 2000 docs
+    seam_rate_2k, _ = bench_backend_pipeline(2000, n_keys, 20)
 
     # Host reference engine on the same workload shape (rate-based)
     host_docs = int(os.environ.get('BENCH_HOST_DOCS', 20))
@@ -847,10 +849,12 @@ def main():
               f'{" + pallas " + pallas_variant if pallas_variant else ""}) '
               f'written to {trace_dir}', file=sys.stderr)
 
-    print(f'# HEADLINE backend-seam end-to-end (turbo, incl. hash graph): '
+    print(f'# HEADLINE backend-seam end-to-end (turbo, incl. hash graph, '
+          f'{seam_docs}-doc north-star config): '
           f'{seam_rate:.0f} changes/s (median of {REPS}; single-dispatch '
           f'{seam_rate_1:.0f}, {seam_chunks}-chunk overlapped '
-          f'{seam_rate_k:.0f})', file=sys.stderr)
+          f'{seam_rate_k:.0f}; rounds 1-3 config at 2000 docs: '
+          f'{seam_rate_2k:.0f})', file=sys.stderr)
     print(f'# backend-seam text editing end-to-end: '
           f'{seam_text_rate:.0f} ops/s (median of {REPS}) vs host '
           f'{host_text_rate:.0f} ops/s '
