@@ -1789,7 +1789,7 @@ class _FlatEngine(HashGraph):
                 for elem_packed, elem_lanes in data:
                     elem_str = op_id_str(elem_packed)
                     vis_elem = False
-                    for packed, raw, cnt, char, n_incs in elem_lanes:
+                    for packed, raw, cnt, char, n_incs, dead in elem_lanes:
                         # object elements (rows-in-lists) flow through the
                         # same make-row path the map cells use: the child
                         # registers in object_meta and its own rows link
@@ -1807,8 +1807,11 @@ class _FlatEngine(HashGraph):
                             # (new.js:936-965): the counter set with its
                             # inc succs, then the incs — the edit shape
                             # (insert for one consumed inc, the transient
-                            # remove->update for two or more) falls out
-                            # of the same ported machinery
+                            # remove->update for two or more, the phantom
+                            # remove of a deleted inc'd counter) falls
+                            # out of the same ported machinery. A dead
+                            # lane gets an extra never-consumed del succ
+                            # so its counter state never completes.
                             opid = op_id_str(packed)
                             base_row, _child = lane_row(packed, raw, 0,
                                                         base, char)
@@ -1816,10 +1819,12 @@ class _FlatEngine(HashGraph):
                                 raise _Unsupported('inc on non-counter')
                             succs = [f'{opid}+inc{i}'
                                      for i in range(n_incs)]
-                            base_row['succ'] = list(succs)
+                            all_succs = succs + ([f'{opid}+del'] if dead
+                                                 else [])
+                            base_row['succ'] = all_succs
                             shim._update_patch_property(
                                 patches, object_id, base_row, prop_state,
-                                list_index, len(succs), object_meta,
+                                list_index, len(all_succs), object_meta,
                                 whole_doc=True)
                             for i, sid in enumerate(succs):
                                 inc_row = {
@@ -1831,6 +1836,10 @@ class _FlatEngine(HashGraph):
                                     patches, object_id, inc_row,
                                     prop_state, list_index, 0, object_meta,
                                     whole_doc=True)
+                        # a dead inc'd counter lane still counts: its inc
+                        # rows are succ-free, so the host walk treats the
+                        # element as visible and bumps the index past the
+                        # phantom remove
                         vis_elem = True
                     if vis_elem:
                         list_index += 1
@@ -1851,10 +1860,14 @@ class _FlatEngine(HashGraph):
 
     def _fetch_seq_rows(self):
         """Read this doc's sequence rows off the device: {objectId:
-        [(elem packed id, [(packed, raw, counter, char)])] in RGA order},
-        where `char` is the decoded inline text code point (None for
-        table-boxed payloads — reads never write the shared value table).
-        Raises _Unsupported when a row is device-inexact."""
+        [(elem packed id, [(packed, raw, counter_sum, char, n_incs,
+        dead)])] in RGA order}. `char` is the decoded inline text code
+        point (None for table-boxed payloads — reads never write the
+        shared value table); `n_incs` is the consumed-inc count (0, 1,
+        or 2 meaning "two or more"); `dead` marks killed inc'd counter
+        lanes, which ride along because the reference's dangling inc
+        rows still shape the whole-doc patch. Raises _Unsupported when
+        a row is device-inexact."""
         import jax
         import numpy as _np
         from .sequence import HEAD, END, SLOT0
@@ -1885,7 +1898,13 @@ class _FlatEngine(HashGraph):
             while node != END and hops <= limit:
                 lanes = []
                 live = (reg[node] != 0) & ~killed[node]
-                for s in _np.flatnonzero(live):
+                # Dead lanes whose op consumed incs still shape the
+                # whole-doc patch: the reference's dangling inc rows emit
+                # a phantom remove (converted to update by a surviving
+                # lane), so they ride along marked dead
+                dead_incd = (reg[node] != 0) & killed[node] & \
+                    ((cnt[node] & 3) != 0)
+                for s in _np.flatnonzero(live | dead_incd):
                     raw = int(val[node, s])
                     char = chr(raw) if is_text and raw >= 0 else None
                     # counter lanes bit-pack (sum << 2) | count-bits
@@ -1895,7 +1914,8 @@ class _FlatEngine(HashGraph):
                     bits = int(cnt[node, s]) & 3
                     lanes.append((int(reg[node, s]), raw,
                                   int(cnt[node, s]) >> 2, char,
-                                  2 if bits == 3 else bits))
+                                  2 if bits == 3 else bits,
+                                  bool(dead_incd[s])))
                 lanes.sort(key=lambda lane: lane[0])
                 elems.append((int(elem_id[node]), lanes))
                 node = int(nxt[node])

@@ -601,6 +601,36 @@ def _install_seq_rows(fleet, out, sel, doc, slot_of, okey, oid_str, obj_type,
             jnp.asarray(values[live_sel].astype(np.int32)))
         new_counter = st.counter.at[lidx].set(
             jnp.asarray(counter_add[rows][live_sel].astype(np.int32)))
+        # Dead counter sets that consumed incs install as KILLED lanes
+        # with their counter bits: the patch walk needs them to emit the
+        # reference's phantom remove / remove->update edits for deleted
+        # or overwritten inc'd counters
+        dead_sel = np.flatnonzero(
+            in_cls & ~live_mask & ~inc_mask[rows] & ~bad_upd &
+            ((counter_add[rows] & 3) != 0))
+        if len(dead_sel):
+            # A dead inc'd counter whose lane was reclaimed by the same
+            # actor cannot be represented (sequence.py flags the same
+            # shape reclaim_incd): route the object to the mirror rather
+            # than clobber the live lane
+            lane_key = (idx_of_op.astype(np.int64) * (1 << 40) +
+                        node.astype(np.int64) * 512 +
+                        id_actor[rows].astype(np.int64))
+            taken = np.isin(lane_key[dead_sel], lane_key[live_sel])
+            if taken.any():
+                np.logical_or.at(inex_obj, inv[dead_sel[taken]], True)
+                dead_sel = dead_sel[~taken]
+        if len(dead_sel):
+            didx = (jnp.asarray(idx_of_op[dead_sel]),
+                    jnp.asarray(node[dead_sel]),
+                    jnp.asarray(id_actor[rows][dead_sel]))
+            new_reg = new_reg.at[didx].set(
+                jnp.asarray(packed32[rows][dead_sel].astype(np.int32)))
+            new_killed = new_killed.at[didx].set(True)
+            new_val = new_val.at[didx].set(
+                jnp.asarray(values[dead_sel].astype(np.int32)))
+            new_counter = new_counter.at[didx].set(
+                jnp.asarray(counter_add[rows][dead_sel].astype(np.int32)))
 
         new_inexact = st.inexact
         inex = objs[inex_obj[objs]]

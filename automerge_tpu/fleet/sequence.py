@@ -398,6 +398,10 @@ def _apply_one_doc(carry, op, capacity, n_actor_slots):
     set_actor_oob = is_set_live & ~a_ok
 
     w_set = is_set_live & a_ok
+    # Reclaiming a lane whose previous op consumed incs loses the dead
+    # counter's phantom-remove patch trace (the reference's dangling inc
+    # rows still emit edits for it): flag the row inexact instead
+    reclaim_incd = w_set & ((counter_row[a_c] & 3) != 0)
     reg_row = reg_row.at[a_c].set(jnp.where(w_set, packed, reg_row[a_c]))
     killed_row = killed_row.at[a_c].set(
         jnp.where(w_set, False, killed_row[a_c]))
@@ -419,7 +423,7 @@ def _apply_one_doc(carry, op, capacity, n_actor_slots):
     # actor numbers past the lane width, self conflicts, preds naming
     # unknown/out-of-range actors, and incs with no consumable target
     inexact = inexact | flag | self_conflict | lane_oob | set_actor_oob | \
-        ins_actor_oob | bad_inc | ((kind > PAD) & ~applied)
+        ins_actor_oob | bad_inc | reclaim_incd | ((kind > PAD) & ~applied)
     return (elem_id, nxt, reg, killed, val, counter, n, inexact), applied
 
 

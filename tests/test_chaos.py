@@ -123,6 +123,17 @@ class _Universe:
             A.set_default_backend(prev)
 
 
+@pytest.fixture(autouse=True)
+def _bounded_jit_cache():
+    """Each seed spawns fresh fleets whose pool shapes compile anew; at
+    high offline doses (~20+ seeds in one process) the accumulated XLA
+    CPU compile cache has crashed the compiler (segfault inside
+    backend_compile_and_load). Clearing per seed bounds it."""
+    yield
+    import jax
+    jax.clear_caches()
+
+
 @pytest.mark.skipif(not native.available(),
                     reason='native codec unavailable')
 @pytest.mark.parametrize('seed', list(range(N_SEEDS)))

@@ -1289,6 +1289,115 @@ class TestSequenceSeam:
                 assert fleet_backend.get_patch(hb2) == want, \
                     ('bulk', n_incs, exact)
 
+    def test_randomized_sequence_counter_differential(self):
+        """Backend-level fuzz of counters inside lists with REAL
+        concurrency: two replicas diverge (each creating counter elements
+        and incrementing what they see) and periodically merge, so
+        conflicted counter sets, cross-branch incs, and deletes all occur;
+        every converged state is compared across host and both fleet modes
+        (patches, reads, and save bytes)."""
+        import automerge_tpu as am
+        rng = np.random.default_rng(7)
+        A, B = ACTORS[0], ACTORS[1]
+
+        for trial in range(4):
+            # Two host replicas drive op generation (their visible state
+            # decides preds, like a real frontend would)
+            reps = [host_backend.init(), host_backend.init()]
+            boot = change_buf(A, 1, 1, [
+                {'action': 'makeList', 'obj': '_root', 'key': 'l',
+                 'pred': []}])
+            for i in (0, 1):
+                reps[i], _ = host_backend.apply_changes(reps[i], [boot])
+            list_id = f'1@{A}'
+            seqs = {A: 1, B: 0}
+
+            def visible_elems(rep):
+                """[(elemId, [set opIds], is_counter)] via the host patch."""
+                diffs = host_backend.get_patch(rep)['diffs']
+                lst = diffs['props'].get('l', {}).get(list_id)
+                out = []
+                if not lst:
+                    return out
+                idx = -1
+                for edit in lst.get('edits', []):
+                    if edit['action'] in ('insert', 'update'):
+                        ops = [edit['opId']]
+                        val = edit['value']
+                        out.append((edit.get('elemId', ops[0]), ops,
+                                    isinstance(val, dict) and
+                                    val.get('datatype') == 'counter'))
+                return out
+
+            for step in range(int(rng.integers(12, 20))):
+                r = int(rng.integers(0, 2))
+                actor = (A, B)[r]
+                rep = reps[r]
+                elems = visible_elems(rep)
+                roll = rng.random()
+                counters = [e for e in elems if e[2]]
+                if roll < 0.45 or not elems:
+                    # insert a counter (or plain) element at random ref
+                    ref = '_head' if not elems or rng.random() < 0.4 \
+                        else elems[int(rng.integers(0, len(elems)))][0]
+                    op = {'action': 'set', 'obj': list_id, 'elemId': ref,
+                          'insert': True,
+                          'value': int(rng.integers(0, 50)),
+                          'pred': []}
+                    if rng.random() < 0.7:
+                        op['datatype'] = 'counter'
+                    else:
+                        op['datatype'] = 'int'
+                elif roll < 0.8 and counters:
+                    eid, preds, _ = counters[int(rng.integers(
+                        0, len(counters)))]
+                    op = {'action': 'inc', 'obj': list_id, 'elemId': eid,
+                          'value': int(rng.integers(-3, 9)),
+                          'datatype': 'counter', 'pred': preds}
+                else:
+                    eid, preds, _ = elems[int(rng.integers(0, len(elems)))]
+                    op = {'action': 'del', 'obj': list_id, 'elemId': eid,
+                          'pred': preds}
+                seqs[actor] += 1
+                # startOp = maxOp + 1 like the reference frontend: op
+                # counters must exceed every causally-seen op's counter
+                start = host_backend.get_patch(rep)['maxOp'] + 1
+                buf = change_buf(actor, seqs[actor], start, [op],
+                                 deps=host_backend.get_heads(rep))
+                reps[r], _ = host_backend.apply_changes(reps[r], [buf])
+                if rng.random() < 0.3:
+                    # merge the other replica in (concurrency point):
+                    # get_changes_added(a, b) = changes in b missing
+                    # from a
+                    other = reps[1 - r]
+                    missing = host_backend.get_changes_added(reps[r], other)
+                    if missing:
+                        reps[r], _ = host_backend.apply_changes(
+                            reps[r], [bytes(c) for c in missing])
+
+            # converge both replicas, then differentially replay the full
+            # history through both fleet modes
+            for r in (0, 1):
+                missing = host_backend.get_changes_added(reps[r],
+                                                         reps[1 - r])
+                if missing:
+                    reps[r], _ = host_backend.apply_changes(
+                        reps[r], [bytes(c) for c in missing])
+            assert host_backend.get_heads(reps[0]) == \
+                host_backend.get_heads(reps[1])
+            history = [bytes(c) for c in
+                       host_backend.get_all_changes(reps[0])]
+            want = host_backend.get_patch(reps[0])
+            saved = bytes(host_backend.save(reps[0]))
+            for exact in (False, True):
+                fleet = DocFleet(doc_capacity=2, key_capacity=8,
+                                 exact_device=exact)
+                gb = fleet_backend.init(fleet)
+                gb, _ = fleet_backend.apply_changes(gb, history)
+                assert fleet_backend.get_patch(gb) == want, (trial, exact)
+                assert bytes(fleet_backend.save(gb)) == saved, \
+                    (trial, exact)
+
     def test_clone_and_free_with_seq_rows(self):
         fb = self._fb()
         gb = fb.init()
