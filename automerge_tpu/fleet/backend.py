@@ -2289,10 +2289,12 @@ def _apply_changes_turbo(handles, per_doc_changes):
         k = len(flat_buffers)
         if not isinstance(changes, (list, tuple)):
             changes = list(changes)   # one-shot iterables: materialize once
-        flat_buffers += changes if all(type(b) is bytes for b in changes) \
-            else [bytes(b) for b in changes]
+        flat_buffers += changes
         per_doc_idx[d] = (k, len(flat_buffers))
         change_doc += [d] * (len(flat_buffers) - k)
+    if not all(type(b) is bytes for b in flat_buffers):
+        # one normalization pass instead of a genexpr per document
+        flat_buffers = [bytes(b) for b in flat_buffers]
     n_changes = len(flat_buffers)
     if not n_changes:
         return handles, [None] * len(handles)
