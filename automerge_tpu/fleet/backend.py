@@ -300,12 +300,16 @@ class DocFleet:
         """Doc-capacity sizing shared by the grid and register allocators:
         pow2 growth, raised to a multiple of the mesh docs axis so sharded
         device_put divides evenly (a bare pow2 fails on e.g. a 6-device
-        axis)."""
-        need = _pow2(max(n_docs, self.doc_cap))
-        if self.mesh is not None:
-            m = self.mesh.shape.get('docs', 1)
-            need = ((need + m - 1) // m) * m
-        return need
+        axis). An already-sufficient mesh-aligned capacity is returned
+        unchanged: on a non-pow2 mesh the stored doc_cap is itself non-pow2
+        (e.g. 66 on a 6-device axis), and re-deriving pow2 from it
+        (128 -> 132) would regrow state ~2x on every call. A constructor
+        doc_capacity that is NOT yet a mesh multiple still rounds up."""
+        m = self.mesh.shape.get('docs', 1) if self.mesh is not None else 1
+        if n_docs <= self.doc_cap and self.doc_cap % m == 0:
+            return self.doc_cap
+        need = max(_pow2(max(n_docs, 1)), self.doc_cap)
+        return ((need + m - 1) // m) * m
 
     def _shard_docs(self, tree):
         """Place a pytree of [docs, ...] arrays sharded over the mesh's

@@ -366,10 +366,14 @@ def _apply_one_doc(carry, op, capacity, n_actor_slots):
         ~killed_row[s_max_c]
     # (sum << 2) | count-bits packing (bits 0 -> 1 -> 3, 3 = "two or
     # more", saturating) — see the SeqState docstring. The shifted add
-    # leaves the count bits alone. Sums are bounded to +/-2^29 by the
-    # ingest-side delta guards; larger deltas flag their rows inexact
-    # before reaching this kernel.
+    # leaves the count bits alone. The ingest-side guards bound each
+    # DELTA to +/-2^29, but the accumulated SUM can still leave the
+    # packed envelope (two +2^28 incs): flag the row inexact when it
+    # does, mirroring the bulk loader's counter_over rule, so live-applied
+    # and bulk-loaded replicas agree instead of wrapping silently.
     old_cnt = counter_row[s_max_c]
+    new_sum = (old_cnt >> 2) + value
+    bad_sum = max_live & (jnp.abs(new_sum) >= jnp.int32(1 << 29))
     stepped = (old_cnt & ~3) + (value << 2)
     stepped = stepped | jnp.where((old_cnt & 3) == 0, 1, 3)
     counter_row = counter_row.at[s_max_c].set(
@@ -423,7 +427,8 @@ def _apply_one_doc(carry, op, capacity, n_actor_slots):
     # actor numbers past the lane width, self conflicts, preds naming
     # unknown/out-of-range actors, and incs with no consumable target
     inexact = inexact | flag | self_conflict | lane_oob | set_actor_oob | \
-        ins_actor_oob | bad_inc | reclaim_incd | ((kind > PAD) & ~applied)
+        ins_actor_oob | bad_inc | bad_sum | reclaim_incd | \
+        ((kind > PAD) & ~applied)
     return (elem_id, nxt, reg, killed, val, counter, n, inexact), applied
 
 

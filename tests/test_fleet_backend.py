@@ -1094,6 +1094,27 @@ class TestAdviceRegressions:
         # and it matches the host mirror's view
         assert gb['state'].materialize() == {'k': None, 'm': 3}
 
+    def test_cap_docs_stable_on_non_pow2_mesh_capacity(self):
+        """Round-4 advisor finding: on a non-pow2 docs axis the stored
+        doc_cap is mesh-rounded (e.g. 66 on 6 devices); _cap_docs must
+        return it unchanged when sufficient instead of re-deriving
+        pow2(66)=128 -> 132 and regrowing state ~2x on every flush."""
+        import jax
+        from jax.sharding import Mesh
+        mesh = Mesh(np.array(jax.devices()[:6]), ('docs',))
+        fleet = DocFleet(doc_capacity=4, key_capacity=4, mesh=mesh)
+        fleet.doc_cap = 66  # a previously mesh-rounded capacity
+        assert fleet._cap_docs(10) == 66
+        assert fleet._cap_docs(66) == 66
+        # growth past capacity still pow2-then-rounds
+        assert fleet._cap_docs(67) == 132
+        # and an actual growth sequence reaches a fixed point: growing to
+        # the value just returned must be a no-op
+        cap = fleet._cap_docs(67)
+        assert fleet._cap_docs(cap) >= cap
+        fleet.doc_cap = cap
+        assert fleet._cap_docs(cap) == cap
+
 
 class TestSequenceTermination:
     def test_cyclic_nxt_chain_terminates(self):

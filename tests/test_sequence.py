@@ -400,3 +400,35 @@ class TestLongDocSharding:
         np.testing.assert_array_equal(lvis, svis[:, :lvis.shape[1]])
         assert not svis[:, lvis.shape[1]:].any()
         assert visible_text(local) == visible_text(sharded)
+
+
+class TestCounterSumOverflow:
+    """Round-4 advisor finding: the INC kernel's (sum << 2) bit-packed
+    counter lane must flag the row inexact when the ACCUMULATED sum leaves
+    the +/-2^29 envelope — each delta passes the ingest guards, but two
+    +2^28 incs would wrap the packed int32 silently, diverging live-applied
+    replicas from bulk-loaded ones (loader.py's counter_over rule)."""
+
+    def _inc_trace(self, deltas):
+        ops = [ins('_head', f'2@{A1}', 'a')]
+        for i, d in enumerate(deltas):
+            ops.append({'kind': 'inc', 'ref': f'2@{A1}', 'id': f'{3 + i}@{A1}',
+                        'value': d, 'pred': [f'2@{A1}']})
+        return run_ops([ops], [A1], capacity=8)
+
+    def test_in_envelope_sum_stays_exact(self):
+        state = self._inc_trace([(1 << 28), (1 << 28) - 1])
+        assert not bool(np.asarray(state.inexact)[0])
+        # accumulated value reads back exactly
+        from automerge_tpu.fleet.sequence import element_visibility
+        _, _, _, cnt = element_visibility(state)
+        sums = np.asarray(cnt) >> 2
+        assert (1 << 29) - 1 in sums[0]
+
+    def test_overflowing_sum_flags_inexact(self):
+        state = self._inc_trace([(1 << 28), (1 << 28)])
+        assert bool(np.asarray(state.inexact)[0])
+
+    def test_negative_overflow_flags_inexact(self):
+        state = self._inc_trace([-(1 << 28), -(1 << 28)])
+        assert bool(np.asarray(state.inexact)[0])
