@@ -41,7 +41,17 @@ def decode_change_buffers(change_buffers):
 
 
 class HashGraph:
-    """Hash-graph + causal-gate state over a change log."""
+    """Hash-graph + causal-gate state over a change log.
+
+    __slots__ keeps per-document construction cheap: fleets create one
+    engine per doc, so bulk init at 10k+ docs is on the turbo seam's
+    critical path. Subclasses that want ad-hoc attributes (the host OpSet)
+    simply omit __slots__ and get a __dict__ as usual."""
+
+    __slots__ = ('max_op', 'actor_ids', 'heads', 'clock', 'queue',
+                 'changes', 'changes_meta', 'change_index_by_hash',
+                 'dependencies_by_hash', 'dependents_by_hash',
+                 'hashes_by_actor', '_deferred')
 
     def __init__(self):
         self.max_op = 0

@@ -577,8 +577,11 @@ struct ParseScratch {
 struct IngestCtx {
   Interner keys, actors;
   // Raw actor bytes -> interned id, skipping the hex conversion + string
-  // intern on the (hot) repeated-actor case
+  // intern on the (hot) repeated-actor case. The first 32 distinct actors
+  // also land in a linear memcmp cache (no per-lookup allocation).
   std::unordered_map<std::string, int32_t> actor_raw_cache;
+  std::vector<std::string> actor_lin_keys;
+  std::vector<int32_t> actor_lin_ids;
   ParseScratch scratch;
   std::vector<int32_t> out_doc, out_key, out_packed, out_val;
   std::vector<uint8_t> out_flags;  // 1 = set/del, 2 = inc
@@ -611,8 +614,17 @@ struct IngestCtx {
 
 // Intern an actor given its raw (binary) bytes, caching by raw bytes so the
 // hex conversion + string intern runs once per distinct actor per batch.
+// The hit path scans a small linear cache with memcmp — batches hold a
+// handful of distinct actors, and the hash-map path's std::string key
+// construction per change was a measurable slice of the meta parse.
 static int32_t intern_actor_raw(IngestCtx &ctx, const uint8_t *raw,
                                 uint64_t len) {
+  size_t n_lin = ctx.actor_lin_keys.size();
+  for (size_t i = 0; i < n_lin; i++) {
+    const std::string &k = ctx.actor_lin_keys[i];
+    if (k.size() == len && memcmp(k.data(), raw, len) == 0)
+      return ctx.actor_lin_ids[i];
+  }
   std::string key((const char *)raw, len);
   auto it = ctx.actor_raw_cache.find(key);
   if (it != ctx.actor_raw_cache.end()) return it->second;
@@ -624,6 +636,10 @@ static int32_t intern_actor_raw(IngestCtx &ctx, const uint8_t *raw,
     actor_hex.push_back(hex[raw[i] & 15]);
   }
   int32_t id = ctx.actors.intern(actor_hex);
+  if (ctx.actor_lin_keys.size() < 32) {
+    ctx.actor_lin_keys.push_back(key);
+    ctx.actor_lin_ids.push_back(id);
+  }
   ctx.actor_raw_cache.emplace(std::move(key), id);
   return id;
 }
@@ -1157,6 +1173,36 @@ int64_t am_ingest_changes(const uint8_t *blob, const uint64_t *offsets,
                           uint64_t n_changes, int with_meta, int with_seq) {
   delete g_ingest;
   g_ingest = new IngestCtx();
+  {
+    // One-op-per-change is the common bulk shape: pre-size the output
+    // vectors to the batch so the hot loop never pays geometric-growth
+    // memcpys over multi-MB buffers.
+    IngestCtx &ctx = *g_ingest;
+    ctx.out_doc.reserve(n_changes);
+    ctx.out_key.reserve(n_changes);
+    ctx.out_packed.reserve(n_changes);
+    ctx.out_val.reserve(n_changes);
+    ctx.out_flags.reserve(n_changes);
+    if (with_meta) {
+      ctx.m_actor.reserve(n_changes);
+      ctx.m_seq.reserve(n_changes);
+      ctx.m_start_op.reserve(n_changes);
+      ctx.m_time.reserve(n_changes);
+      ctx.m_nops.reserve(n_changes);
+      ctx.m_hash.reserve(32 * n_changes);
+      ctx.m_deps.reserve(32 * n_changes);
+      ctx.m_deps_off.reserve(n_changes);
+      ctx.m_msg_off.reserve(n_changes);
+      ctx.out_pred_off.reserve(n_changes);
+      ctx.out_pred.reserve(n_changes);
+    }
+    if (with_seq) {
+      ctx.out_obj.reserve(n_changes);
+      ctx.out_ref.reserve(n_changes);
+      ctx.out_vtype.reserve(n_changes);
+      ctx.out_vlen.reserve(n_changes);
+    }
+  }
   for (uint64_t i = 0; i < n_changes; i++) {
     const uint8_t *chunk = blob + offsets[i];
     uint64_t chunk_len = lens[i];
