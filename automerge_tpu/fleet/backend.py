@@ -1455,6 +1455,38 @@ class _FlatEngine(HashGraph):
         # read (the deferred-hash-graph load of ref new.js:1709-1749)
         self._doc_pending = None
 
+    @classmethod
+    def _bulk_new(cls, fleet, slot):
+        """Allocation-only constructor for init_docs: __new__ + the same
+        attribute sets as __init__, skipping the constructor call chain
+        (measurable at 10k+ docs). MUST stay equivalent to
+        __init__/HashGraph.__init__ — test_bulk_init_matches_constructor
+        pins the attribute-set equivalence."""
+        e = cls.__new__(cls)
+        # HashGraph.__init__ body
+        e.max_op = 0
+        e.actor_ids = []
+        e.heads = []
+        e.clock = {}
+        e.queue = []
+        e.changes = []
+        e.changes_meta = []
+        e.change_index_by_hash = {}
+        e.dependencies_by_hash = {}
+        e.dependents_by_hash = {}
+        e.hashes_by_actor = {}
+        e._deferred = []
+        # _FlatEngine.__init__ body
+        e.fleet = fleet
+        e.slot = slot
+        e.mirror = None
+        e.binary_doc = None
+        e.seq_objects = {}
+        e.map_objects = {}
+        e.stale = False
+        e._doc_pending = None
+        return e
+
     # The change log is a property so a bulk-loaded document's history can
     # stay unmaterialized until something genuinely reads or extends it
     # (sync, save-after-edit, mirror rebuilds, clone, further applies).
@@ -2162,43 +2194,19 @@ class FleetBackend:
 def init_docs(n, fleet=None):
     """Create n fleet documents sharing one device fleet.
 
-    Bulk-constructs the engines directly instead of going through init():
-    the per-doc constructor chain (init -> FleetDoc -> _FlatEngine ->
-    HashGraph -> alloc_slot) costs ~8us/doc in CPython, which at 10k+ docs
-    is a measurable slice of the turbo seam. The attribute sets below are
-    the inlined union of HashGraph.__init__ and _FlatEngine.__init__ —
-    keep all three in sync."""
+    Bulk-constructs the engines via _FlatEngine._bulk_new instead of
+    going through init(): the per-doc constructor chain (init -> FleetDoc
+    -> _FlatEngine -> HashGraph -> alloc_slot) costs ~8us/doc in CPython,
+    which at 10k+ docs is a measurable slice of the turbo seam."""
     fleet = fleet or _default_fleet
     out = []
     append = out.append
     alloc_slot = fleet.alloc_slot
+    bulk_new = _FlatEngine._bulk_new
     for _ in range(n):
-        e = _FlatEngine.__new__(_FlatEngine)
-        # HashGraph.__init__
-        e.max_op = 0
-        e.actor_ids = []
-        e.heads = []
-        e.clock = {}
-        e.queue = []
-        e.changes = []
-        e.changes_meta = []
-        e.change_index_by_hash = {}
-        e.dependencies_by_hash = {}
-        e.dependents_by_hash = {}
-        e.hashes_by_actor = {}
-        e._deferred = []
-        # _FlatEngine.__init__
-        e.fleet = fleet
-        e.slot = alloc_slot()
-        e.mirror = None
-        e.binary_doc = None
-        e.seq_objects = {}
-        e.map_objects = {}
-        e.stale = False
-        e._doc_pending = None
         d = FleetDoc.__new__(FleetDoc)
         d.fleet = fleet
-        d._impl = e
+        d._impl = bulk_new(fleet, alloc_slot())
         append({'state': d, 'heads': []})
     return out
 
@@ -2336,7 +2344,10 @@ def _apply_changes_turbo(handles, per_doc_changes):
         return None
     flat_buffers = []
     per_doc_idx = [None] * len(handles)   # (start, stop) contiguous runs
-    doc_counts = np.empty(len(handles), dtype=np.int64)
+    # zeros, not empty: a per_doc_changes shorter than handles must leave
+    # the trailing docs' counts at 0 (the exact path's zip-truncate
+    # semantics), not uninitialized garbage feeding np.repeat
+    doc_counts = np.zeros(len(handles), dtype=np.int64)
     for d, changes in enumerate(per_doc_changes):
         k = len(flat_buffers)
         if not isinstance(changes, (list, tuple)):

@@ -45,6 +45,12 @@ from .tensor_doc import FleetState
 # edits: the dense one-hot kernel materializes [DOC_TILE, OP_CHUNK,
 # KEY_TILE] int32 temporaries (32x128x128 = 2 MB each), several of which
 # live at once — near the 16 MB/core VMEM budget at the defaults.
+# AOT-validated against a v5e topology (tests/test_pallas.py
+# TestMosaicAOT): Mosaic compiles BOTH variants at these defaults, and
+# 32x128x128 is exactly the dense variant's VMEM ceiling — every larger
+# axis (64 docs, 256 keys, or 256-op chunks) fails with
+# RESOURCE_EXHAUSTED in vmem, so these defaults are the maximal tiles,
+# not a guess.
 DOC_TILE = int(os.environ.get('PALLAS_DOC_TILE', 32))
 KEY_TILE = int(os.environ.get('PALLAS_KEY_TILE', 128))
 OP_CHUNK = int(os.environ.get('PALLAS_OP_CHUNK', 128))
@@ -119,13 +125,21 @@ def _merge_kernel_loop(key_ref, packed_ref, value_ref, is_set_ref,
                        counters_in, winners_out, values_out, counters_out,
                        orig_w_ref, base_c_ref):
     """VMEM-conservative variant: instead of materializing the dense
-    [DOC_TILE, OP_CHUNK, KEY_TILE] one-hot, walk the op lanes with a
-    fori_loop carrying the [DOC_TILE, KEY_TILE] state tile. Same total
-    VPU work (each lane still touches the whole key tile), a fraction of
-    the VMEM footprint — the fallback when Mosaic rejects the 3D
-    formulation or its temporaries overflow VMEM. Lane order preserves
-    the sequential take-if-greater semantics, which equals the chunk-max
-    formulation for LWW (ties keep the first-seen equal value)."""
+    [DOC_TILE, OP_CHUNK, KEY_TILE] one-hot, a STATIC unrolled loop walks
+    the [DOC_TILE, OP_CHUNK] op block one width-1 column slice at a time,
+    carrying the [DOC_TILE, KEY_TILE] state tile in VMEM across grid
+    steps (TPU revisiting semantics). Same total VPU work (each lane
+    still touches the whole
+    key tile), a fraction of the VMEM footprint — the op block holds only
+    [DOC_TILE, OP_CHUNK] columns (~100 KB) instead of the dense variant's
+    [DOC_TILE, OP_CHUNK, KEY_TILE] 3D temporaries (MBs). Two earlier
+    formulations failed Mosaic lowering — fori_loop + lax.dynamic_slice
+    (minor-dim dynamic_slice unimplemented) and a [DOC_TILE, 1] op block
+    (minor block dims must be 128-divisible or full) — which is why the
+    walk is unrolled at trace time with static slices. Lane order
+    preserves the sequential take-if-greater semantics, which equals the
+    chunk-max formulation for LWW (ties keep the first-seen equal
+    value)."""
     j = pl.program_id(1)
     c = pl.program_id(2)
     k_base = j * KEY_TILE
@@ -146,27 +160,19 @@ def _merge_kernel_loop(key_ref, packed_ref, value_ref, is_set_ref,
     is_sets = is_set_ref[:]
     is_incs = is_inc_ref[:]
     valids = valid_ref[:]
-
-    def lane(t, carry):
-        w, v, cnt = carry
-        key_c = jax.lax.dynamic_slice(keys, (0, t), (dn, 1))
-        packed_c = jax.lax.dynamic_slice(packeds, (0, t), (dn, 1))
-        value_c = jax.lax.dynamic_slice(values, (0, t), (dn, 1))
-        live = jax.lax.dynamic_slice(valids, (0, t), (dn, 1)) != 0
-        in_tile = (key_c == k_ids) & live
-        setk = in_tile & (jax.lax.dynamic_slice(is_sets, (0, t),
-                                                (dn, 1)) != 0)
-        cand = jnp.where(setk, packed_c, 0)
+    w = winners_out[:]
+    v = values_out[:]
+    cnt = counters_out[:]
+    for t in range(p):
+        # (dn, 1) static column broadcast against the (dn, KEY_TILE) tile
+        in_tile = (keys[:, t:t + 1] == k_ids) & (valids[:, t:t + 1] != 0)
+        setk = in_tile & (is_sets[:, t:t + 1] != 0)
+        cand = jnp.where(setk, packeds[:, t:t + 1], 0)
         take = cand > w
         w = jnp.where(take, cand, w)
-        v = jnp.where(take, value_c, v)
-        inck = in_tile & (jax.lax.dynamic_slice(is_incs, (0, t),
-                                                (dn, 1)) != 0)
-        cnt = cnt + jnp.where(inck, value_c, 0)
-        return w, v, cnt
-
-    w, v, cnt = jax.lax.fori_loop(
-        0, p, lane, (winners_out[:], values_out[:], counters_out[:]))
+        v = jnp.where(take, values[:, t:t + 1], v)
+        inck = in_tile & (is_incs[:, t:t + 1] != 0)
+        cnt = cnt + jnp.where(inck, values[:, t:t + 1], 0)
     winners_out[:] = w
     values_out[:] = v
     counters_out[:] = cnt

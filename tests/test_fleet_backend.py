@@ -2283,3 +2283,32 @@ class TestRegisterPatches:
             f'1@{A}': {'type': 'value', 'value': 1, 'datatype': 'int'},
             f'1@{B}': {'type': 'value', 'value': 2, 'datatype': 'int'}}
         assert fleet.metrics.mirror_rebuilds == 0
+
+
+class TestBulkInitEquivalence:
+    def test_bulk_init_matches_constructor(self):
+        """init_docs' allocation-only constructor (_FlatEngine._bulk_new)
+        must initialize exactly the attributes the real constructor chain
+        does — the keep-in-sync contract for the bulk fast path."""
+        from automerge_tpu.fleet.backend import _FlatEngine
+
+        fleet = DocFleet(doc_capacity=4, key_capacity=4)
+        via_bulk = fleet_backend.init_docs(1, fleet)[0]['state']._impl
+        via_ctor = _FlatEngine(fleet, fleet.alloc_slot())
+
+        def slot_attrs(obj):
+            out = {}
+            for klass in type(obj).__mro__:
+                for name in getattr(klass, '__slots__', ()):
+                    if hasattr(obj, name):
+                        out[name] = type(getattr(obj, name))
+            return out
+
+        a, b = slot_attrs(via_bulk), slot_attrs(via_ctor)
+        assert a == b
+        # every HashGraph slot must be live on both (nothing skipped)
+        from automerge_tpu.backend.hash_graph import HashGraph
+        for name in HashGraph.__slots__:
+            if name == 'changes':
+                name = '_changes'   # property shadow (see _FlatEngine)
+            assert name in a, name
