@@ -65,6 +65,56 @@ def _apply_op_batch_impl(state, ops):
 
 apply_op_batch = jax.jit(_apply_op_batch_impl)
 
+
+def _apply_op_batch_kills_impl(state, ops, kill_key, kill_packed):
+    """Apply one OpBatch plus delete "kill lanes" with the reference's
+    pred-scoped delete semantics (ref backend/new.js:1204-1217: a delete
+    adds succ entries ONLY to the ops it preds; concurrent sets it never
+    saw stay visible and resurrect the key).
+
+    kill_key/kill_packed are [N, Q] lanes: each carries the packed opId a
+    delete op preds (0 = unused lane) and the fleet key the delete
+    targets. A kill (1) clears the standing winner iff it holds exactly
+    that packed opId, and (2) masks any same-batch set lane carrying that
+    opId. Nothing else is touched — in particular a concurrent set with a
+    LOWER packed id than the delete wins the key afterwards, which the
+    old tombstone-scatter model got wrong (the delete's own opId beat it).
+
+    Causality makes this exact for single-winner semantics across
+    batches: a delete can only pred ops its change causally saw, so an op
+    arriving in a LATER batch can never be a target of this delete —
+    clearing to 0 and letting later scatter-max resurrect is precisely
+    the reference's succNum == 0 visibility rule, projected onto the
+    grid's Lamport-max single-winner view."""
+    n_docs, n_slots = state.winners.shape
+    scratch = n_slots - 1
+    kvalid = kill_packed > 0
+    kdoc = jnp.broadcast_to(jnp.arange(n_docs, dtype=jnp.int32)[:, None],
+                            kill_key.shape)
+    kkey = jnp.where(kvalid, kill_key, scratch)
+    standing = state.winners[kdoc, kkey]
+    hit = kvalid & (standing == kill_packed)
+    killed = jnp.zeros(state.winners.shape, dtype=jnp.bool_) \
+        .at[kdoc, jnp.where(hit, kill_key, scratch)].max(hit)
+    # The scratch column absorbs miss lanes; its contents are garbage by
+    # contract, so clearing it along the way is harmless
+    cleared = FleetState(jnp.where(killed, 0, state.winners),
+                         jnp.where(killed, 0, state.values),
+                         jnp.where(killed, 0, state.counters))
+    # Same-batch kills: a set lane whose packed id any kill lane names
+    # never lands (the delete pred'd it)
+    lane_killed = jnp.any(
+        (ops.packed[:, :, None] == kill_packed[:, None, :]) &
+        kvalid[:, None, :], axis=-1)
+    masked = type(ops)(ops.key_id, ops.packed, ops.value,
+                       ops.is_set & ~lane_killed, ops.is_inc, ops.valid)
+    return _apply_op_batch_impl(cleared, masked)
+
+
+apply_op_batch_kills = jax.jit(_apply_op_batch_kills_impl)
+apply_op_batch_kills_donated = jax.jit(_apply_op_batch_kills_impl,
+                                       donate_argnums=(0,))
+
 # The fleet's own dispatch paths donate the input state: the scatters then
 # update the [docs, keys] grids in place instead of rewriting ~all of HBM
 # per dispatch (the state is replaced by the result at every call site, so

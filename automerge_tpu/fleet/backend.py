@@ -261,12 +261,23 @@ class DocFleet:
         # over-counted cell. Exact-device mode needs none of this (the
         # register engine applies pred kills exactly).
         self.host_winners = None  # np.int32 [doc_cap, key_cap + 1]
+        # Slots whose history contains any delete: bulk reads route to
+        # the exact host mirror. The single-winner grid cannot resurrect
+        # a concurrent LOSER it never stored, and per-cell visible-op
+        # accounting is unsound under shared preds (two concurrent ops
+        # may pred the same target) and same-batch supersession chains —
+        # so ANY kill lane flags its slot here, bluntly and soundly.
+        # Unlike grid_overflow this does NOT block the turbo apply path:
+        # packing stays trustworthy, only reads fall back.
+        self.del_fallback = set()
         # Set rows fold into host_winners lazily: inc-free batches (the
         # common case) just append their arrays here, and the scatter-max
         # replays only when an inc needs checking, a maintenance op
         # (rebase/remap/clone/free/load) touches the mirror, or the
         # backlog passes _WINNER_FOLD_LIMIT rows
-        self._pending_winner_rows = []     # [(doc, key, packed) arrays]
+        # [(kill_doc, kill_key, kill_packed, set_doc, set_key,
+        #   set_packed) array 6-tuples], one entry per dispatched batch
+        self._pending_winner_rows = []
         self._pending_winner_count = 0
         # exact_device=True stores the device state in the multi-value
         # register engine (fleet/registers.py) instead of the LWW
@@ -373,6 +384,7 @@ class DocFleet:
         self.pending = [(s, b) for (s, b) in self.pending if s != slot]
         self.ctr_base.pop(slot, None)
         self.grid_overflow.discard(slot)
+        self.del_fallback.discard(slot)
         self._zero_row(slot)
         rows = self.slot_seq.pop(slot, {})
         if rows:
@@ -392,6 +404,8 @@ class DocFleet:
             self.ctr_base[dst] = self.ctr_base[src]
         if src in self.grid_overflow:
             self.grid_overflow.add(dst)
+        if src in self.del_fallback:
+            self.del_fallback.add(dst)
         copies = {}    # cls -> ([src idx], [dst idx])
         lanes = self._seq_lane_width()
         for oid, row in list(self.slot_seq.get(src, {}).items()):
@@ -967,25 +981,62 @@ class DocFleet:
             packed.append(pack_op_id(rel, num))
         return max(packed)
 
+    def _dispatch_grid(self, batch, kills=None):
+        """One LWW-grid merge dispatch. With `kills` (a (kill_key,
+        kill_packed) [N, Q] pair from delete preds), the kills-aware
+        kernel runs so deletes only kill the ops they pred
+        (apply.apply_op_batch_kills — ref new.js:1204-1217); without, the
+        plain scatter kernel. The batch must already be padded to the
+        state's doc capacity; kills are padded here."""
+        from .apply import apply_op_batch_donated, apply_op_batch_kills_donated
+        if kills is None:
+            self.state, _stats = apply_op_batch_donated(
+                self.state, self._shard_docs(batch))
+        else:
+            kill_key, kill_packed = kills
+            n_cap = self.state.winners.shape[0]
+            if kill_key.shape[0] < n_cap:
+                pad = n_cap - kill_key.shape[0]
+                kill_key = np.pad(kill_key, ((0, pad), (0, 0)))
+                kill_packed = np.pad(kill_packed, ((0, pad), (0, 0)))
+            self.state, _stats = apply_op_batch_kills_donated(
+                self.state, self._shard_docs(batch),
+                self._shard_docs(kill_key), self._shard_docs(kill_packed))
+        self.metrics.dispatches += 1
+
     def _note_grid_batch(self, set_doc, set_key, set_packed,
-                         inc_doc, inc_key, inc_pred):
+                         inc_doc, inc_key, inc_pred,
+                         kill_doc=(), kill_key=(), kill_packed=()):
         """Advance the host winner mirror with a batch's set rows (same
-        scatter-max the device applies), then verify every inc op's pred
-        against the post-batch winner. An inc whose pred is not the
-        winner would be credited to the wrong counter by the device cell
-        (apply.py's documented corner), so its slot goes mirror-
-        authoritative via grid_overflow. inc_pred == -1 marks preds that
-        could not be packed (absent, multiple, or outside the window) and
-        always flags."""
+        scatter-max the device applies, minus sets a same-batch kill
+        names — the device masks those lanes) and kill rows (delete preds
+        — clear the mirrored winner iff it holds exactly the pred'd opId,
+        matching apply.apply_op_batch_kills), route every kill-touched
+        slot's reads to the exact mirror (del_fallback), then verify
+        every inc op's pred against the post-batch winner. An inc whose
+        pred is not the winner would be credited to the wrong counter by
+        the device cell (apply.py's documented corner), so its slot goes
+        mirror-authoritative via grid_overflow. inc_pred == -1 marks
+        preds that could not be packed (absent, multiple, or outside the
+        window) and always flags."""
+        if len(kill_doc):
+            # Blunt-but-sound delete rule (see del_fallback): the grid's
+            # winner view after kills is best-effort only. Runs BEFORE the
+            # mirror guard — read-routing soundness must not depend on the
+            # optional winner mirror being allocated.
+            self.del_fallback.update(int(d) for d in np.unique(kill_doc))
         hw = self.host_winners
         if hw is None:
             return
-        if len(set_doc):
+        if len(set_doc) or len(kill_doc):
             self._pending_winner_rows.append(
-                (np.asarray(set_doc, dtype=np.int64),
+                (np.asarray(kill_doc, dtype=np.int64),
+                 np.asarray(kill_key, dtype=np.int64),
+                 np.asarray(kill_packed, dtype=np.int32),
+                 np.asarray(set_doc, dtype=np.int64),
                  np.asarray(set_key, dtype=np.int64),
                  np.asarray(set_packed, dtype=np.int32)))
-            self._pending_winner_count += len(set_doc)
+            self._pending_winner_count += len(set_doc) + len(kill_doc)
         if len(inc_doc):
             self._fold_pending_winners()
             inc_doc = np.asarray(inc_doc, dtype=np.int64)
@@ -1002,13 +1053,30 @@ class DocFleet:
             self._fold_pending_winners()
 
     def _fold_pending_winners(self):
-        """Replay the deferred set rows into the host winner mirror (one
-        scatter-max per backlog batch)."""
+        """Replay the deferred batches into the host winner mirror. Per
+        batch, preserving the device dispatch order: (1) kills clear a
+        cell iff it holds exactly the pred'd opId (the device's
+        standing-winner kill); (2) set rows scatter-max — EXCLUDING sets
+        a same-batch kill names, which the device masks at the lane
+        level. Kill-touched slots are already read-routed to the mirror
+        (del_fallback), so this winner view is only consumed by the
+        counter-attribution check on delete-free slots."""
         if not self._pending_winner_rows:
             return
         hw = self.host_winners
-        for set_doc, set_key, set_packed in self._pending_winner_rows:
-            np.maximum.at(hw, (set_doc, set_key), set_packed)
+        for (kill_doc, kill_key, kill_packed,
+             set_doc, set_key, set_packed) in self._pending_winner_rows:
+            if len(kill_doc):
+                m = hw[kill_doc, kill_key] == kill_packed
+                hw[kill_doc[m], kill_key[m]] = 0
+            if len(set_doc):
+                keep = np.ones(len(set_doc), dtype=bool)
+                if len(kill_doc):
+                    kill_combo = kill_doc * (1 << 32) + kill_packed
+                    keep = ~np.isin(set_doc * (1 << 32) + set_packed,
+                                    kill_combo)
+                np.maximum.at(hw, (set_doc[keep], set_key[keep]),
+                              set_packed[keep])
         self._pending_winner_rows = []
         self._pending_winner_count = 0
 
@@ -1034,7 +1102,6 @@ class DocFleet:
         and one merge dispatch for the whole fleet."""
         if not self.pending:
             return
-        from .apply import apply_op_batch_donated
         perm = self.actors.insert_many(self.pending_actors)
         if perm is not None:
             if self.exact_device:
@@ -1058,6 +1125,7 @@ class DocFleet:
             d < n_docs and per_doc[d]
             for d in set(self.ctr_base) | self.grid_overflow)
         hazard = []
+        kills = []
         if native.available() and not rebased_touched:
             # (rebased slots pack against per-slot bases the native batch
             # does not know about: only flushes touching such slots take
@@ -1065,7 +1133,8 @@ class DocFleet:
             from .ingest import changes_to_op_batch_native
             batch = changes_to_op_batch_native(per_doc, self.keys,
                                                self.actors,
-                                               hazard_out=hazard)
+                                               hazard_out=hazard,
+                                               kills_out=kills)
         if batch is None:
             # Sequence ops, non-inline values, or no native codec: Python
             # decode once, routing flat rows to the grid and sequence ops
@@ -1077,9 +1146,7 @@ class DocFleet:
             pad = self.state.winners.shape[0] - batch.key_id.shape[0]
             batch = type(batch)(*(np.pad(col, ((0, pad), (0, 0)))
                                   for col in batch.tree_flatten()[0]))
-        self.state, _stats = apply_op_batch_donated(
-            self.state, self._shard_docs(batch))
-        self.metrics.dispatches += 1
+        self._dispatch_grid(batch, kills[0] if kills else None)
         self.metrics.device_ops += int(batch.valid.sum())
         if hazard:
             self._note_grid_batch(*hazard[0])
@@ -1111,7 +1178,6 @@ class DocFleet:
     def _flush_mixed(self, per_doc, n_docs):
         """Python-decode flush splitting flat root-map rows (LWW grid) from
         sequence-object ops (SeqState fleet). per_doc is indexed by slot."""
-        from .apply import apply_op_batch_donated
         from .tensor_doc import OpBatch, pack_op_id
         from .ingest import changes_to_decoded_ops
         from ..common import parse_op_id
@@ -1136,6 +1202,7 @@ class DocFleet:
         rows = []       # (slot, key_id, packed, value, is_set, is_inc)
         seq_ops = []
         inc_checks = []  # (slot, key_id, pred packed | -1)
+        kill_rows = []   # (slot, key_id, pred packed): delete kill lanes
         for d, op_id, op in ops_list:
             ctr, actor = parse_op_id(op_id)
             obj = op['obj']
@@ -1164,7 +1231,24 @@ class DocFleet:
                                  op_id, OBJECT_TYPE[action])),
                              True, False))
             elif action == 'del':
-                rows.append((d, key_id, packed, TOMBSTONE, True, False))
+                # Pred-scoped delete (ref new.js:1204-1217): each pred
+                # becomes a kill lane; the del writes no winner of its
+                # own, so concurrent sets it never saw stay visible. An
+                # unpackable pred (outside the slot's counter window,
+                # unknown actor) can't kill exactly — the mirror goes
+                # authoritative for that slot instead.
+                for pr in op.get('pred') or []:
+                    try:
+                        pctr, pactor = parse_op_id(pr)
+                        num = self.actors.intern(pactor)
+                    except (KeyError, ValueError):
+                        self.grid_overflow.add(d)
+                        continue
+                    rel = pctr - self.ctr_base.get(d, 0)
+                    if rel <= 0 or rel >= CTR_LIMIT:
+                        self.grid_overflow.add(d)
+                        continue
+                    kill_rows.append((d, key_id, pack_op_id(rel, num)))
             elif action == 'inc':
                 rows.append((d, key_id, packed, op.get('value', 0),
                              False, True))
@@ -1173,7 +1257,7 @@ class DocFleet:
                 rows.append((d, key_id, packed,
                              self._intern_value(op.get('value')),
                              True, False))
-        if rows:
+        if rows or kill_rows:
             counts = np.zeros(n_docs, dtype=np.int64)
             for r in rows:
                 counts[r[0]] += 1
@@ -1198,16 +1282,26 @@ class DocFleet:
                 valid[d, j] = True
             batch = OpBatch(cols['key_id'], cols['packed'], cols['value'],
                             is_set, is_inc, valid)
-            self.state, _stats = apply_op_batch_donated(
-                self.state, self._shard_docs(batch))
-            self.metrics.dispatches += 1
-            self.metrics.device_ops += len(rows)
+            kills = None
+            if kill_rows:
+                from .ingest import layout_doc_rows
+                kd = np.array([k[0] for k in kill_rows], dtype=np.int64)
+                kk = np.array([k[1] for k in kill_rows], dtype=np.int64)
+                kp = np.array([k[2] for k in kill_rows], dtype=np.int64)
+                (kk_arr, kp_arr), _ = layout_doc_rows(
+                    kd, n_cap, (kk, kp), (np.int32, np.int32))
+                kills = (kk_arr, kp_arr)
+            self._dispatch_grid(batch, kills)
+            self.metrics.device_ops += len(rows) + len(kill_rows)
             sets = [(r[0], r[1], r[2]) for r in rows if r[4]]
             self._note_grid_batch([s[0] for s in sets], [s[1] for s in sets],
                                   [s[2] for s in sets],
                                   [c[0] for c in inc_checks],
                                   [c[1] for c in inc_checks],
-                                  [c[2] for c in inc_checks])
+                                  [c[2] for c in inc_checks],
+                                  [k[0] for k in kill_rows],
+                                  [k[1] for k in kill_rows],
+                                  [k[2] for k in kill_rows])
         self._dispatch_seq(seq_ops)
 
     def _flush_exact_mixed(self, per_doc, n_docs):
@@ -2323,7 +2417,6 @@ def _apply_changes_turbo(handles, per_doc_changes):
     graph with no per-change dict work, the rest go through the general
     causal gate. The call is atomic: any gate error rolls back every doc."""
     from .. import native
-    from .apply import apply_op_batch_donated
     from .tensor_doc import OpBatch, MAX_ACTORS as _MA
 
     if not native.available() or not handles:
@@ -2852,6 +2945,15 @@ def _apply_changes_turbo(handles, per_doc_changes):
 
     if n_kept_root:
         n_slots = fleet.n_slots
+        # Pred-scoped deletes (ref new.js:1204-1217): del rows (flags 1,
+        # TOMBSTONE value — boxed values are <= -2, so -1 is del-only)
+        # write no winner; their preds become kill lanes for the
+        # kills-aware grid kernel. A pred naming an actor the fleet never
+        # registered can't kill exactly — that slot's reads go
+        # mirror-authoritative instead of mis-killing actor 0.
+        vals_root = kept_vals_all[keep_root]
+        flags_root = kept_flags_all[keep_root]
+        del_sel = (flags_root == 1) & (vals_root == TOMBSTONE)
         counts = np.bincount(slots, minlength=n_slots)
         max_ops = max(int(counts.max()) if counts.size else 0, 1)
         order = np.argsort(slots, kind='stable')
@@ -2864,10 +2966,41 @@ def _apply_changes_turbo(handles, per_doc_changes):
         flags = np.zeros(shape, dtype=np.int8)
         cols['key_id'][slot_sorted, pos] = key[order]
         cols['packed'][slot_sorted, pos] = packed[order]
-        cols['value'][slot_sorted, pos] = kept_vals_all[keep_root][order]
-        flags[slot_sorted, pos] = kept_flags_all[keep_root][order]
+        cols['value'][slot_sorted, pos] = vals_root[order]
+        flags_laid = np.where(del_sel, 0, flags_root)[order]
+        flags[slot_sorted, pos] = flags_laid
         batch = OpBatch(cols['key_id'], cols['packed'], cols['value'],
                         flags == 1, flags == 2, flags != 0)
+
+        kills = None
+        kill_doc = kill_key_f = kill_packed_f = ()
+        pred_counts = np.diff(rows['pred_off'])
+        counts_root = pred_counts[keep_root]
+        off_root = rows['pred_off'][:-1][keep_root]
+        if del_sel.any():
+            dcounts = counts_root[del_sel]
+            kill_doc = np.repeat(slots[del_sel].astype(np.int64), dcounts)
+            kill_key_f = np.repeat(key[del_sel].astype(np.int64), dcounts)
+            # same np.repeat-based pred-run selection as ingest.py: del
+            # rows' pred runs are contiguous in pred_off order. Build the
+            # full-batch del mask (keep_root-aligned del_sel scattered
+            # back) and repeat it over every op's pred count.
+            del_all = np.zeros(len(pred_counts), dtype=bool)
+            del_all[np.flatnonzero(keep_root)[del_sel]] = True
+            praw = rows['pred'][np.repeat(del_all, pred_counts)]
+            pactor = actor_map[praw & (_MA - 1)]
+            bad_k = (praw != 0) & (pactor < 0)
+            if bad_k.any():
+                for s in np.unique(kill_doc[bad_k]):
+                    fleet.grid_overflow.add(int(s))
+            kill_packed_f = np.where(
+                (praw != 0) & (pactor >= 0),
+                (praw >> 8 << 8) | pactor, 0).astype(np.int32)
+            from .ingest import layout_doc_rows
+            (kk_arr, kp_arr), _ = layout_doc_rows(
+                kill_doc, n_slots, (kill_key_f, kill_packed_f),
+                (np.int32, np.int32))
+            kills = (kk_arr, kp_arr)
 
         fleet._ensure_capacity(n_docs=n_slots, n_keys=len(fleet.keys))
         n_cap = fleet.state.winners.shape[0]
@@ -2875,25 +3008,20 @@ def _apply_changes_turbo(handles, per_doc_changes):
             pad = n_cap - batch.key_id.shape[0]
             batch = OpBatch(*(np.pad(col, ((0, pad), (0, 0)))
                               for col in batch.tree_flatten()[0]))
-        fleet.state, _stats = apply_op_batch_donated(
-            fleet.state, fleet._shard_docs(batch))
-        fleet.metrics.dispatches += 1
+        fleet._dispatch_grid(batch, kills)
         # Counter-attribution check (see _note_grid_batch): advance the
-        # host winner mirror with this batch's set rows and verify each
-        # inc's pred against the post-batch winner
-        flags_root = kept_flags_all[keep_root]
-        set_sel = flags_root == 1
+        # host winner mirror with this batch's set and kill rows and
+        # verify each inc's pred against the post-batch winner
+        set_sel = (flags_root == 1) & ~del_sel
         inc_sel = flags_root == 2
-        if set_sel.any() or inc_sel.any():
-            pred_counts = np.diff(rows['pred_off'])
-            counts_root = pred_counts[keep_root]
-            off_root = rows['pred_off'][:-1][keep_root]
+        if set_sel.any() or inc_sel.any() or del_sel.any():
             inc_preds = _max_pred_per_inc(
                 rows['pred'], off_root[inc_sel], counts_root[inc_sel],
                 actor_map)
             fleet._note_grid_batch(slots[set_sel], key[set_sel],
                                    packed[set_sel], slots[inc_sel],
-                                   key[inc_sel], inc_preds)
+                                   key[inc_sel], inc_preds,
+                                   kill_doc, kill_key_f, kill_packed_f)
     dispatch_seq_rows()
     fleet.metrics.device_ops += int(keep.sum())
     return result
@@ -2960,9 +3088,12 @@ def materialize_docs(handles):
                     # shape: the host mirror is authoritative
                     out.append(state.materialize())
                     continue
-            if state._impl.slot in fleet.grid_overflow:
-                # Counter spread exceeded the packing window: the grid row
-                # is no longer authoritative for this slot
+            if state._impl.slot in fleet.grid_overflow or \
+                    state._impl.slot in fleet.del_fallback:
+                # Counter spread exceeded the packing window, or the
+                # doc's history contains deletes (the grid's winner view
+                # after kills is best-effort): the exact host mirror is
+                # authoritative for this slot
                 out.append(state.materialize())
                 continue
             raw = by_fleet[id(fleet)][state._impl.slot]

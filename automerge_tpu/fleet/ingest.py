@@ -123,8 +123,27 @@ class KeyInterner:
         return len(self.keys)
 
 
+def layout_doc_rows(doc, n_docs, cols, dtypes):
+    """Scatter flat doc-major rows into padded [N, P] arrays (per-doc
+    positions in arrival order). Returns the laid-out arrays plus the
+    (doc_sorted, pos) coordinates so callers can add more columns."""
+    order = np.argsort(doc, kind='stable')
+    doc_sorted = doc[order]
+    pos = np.arange(len(doc_sorted)) - \
+        np.searchsorted(doc_sorted, doc_sorted, side='left')
+    counts = np.bincount(doc, minlength=n_docs)
+    max_ops = max(int(counts.max()) if counts.size else 0, 1)
+    shape = (n_docs, max_ops)
+    out = []
+    for col, dt in zip(cols, dtypes):
+        arr = np.zeros(shape, dtype=dt)
+        arr[doc_sorted, pos] = col[order]
+        out.append(arr)
+    return out, (order, doc_sorted, pos)
+
+
 def changes_to_op_batch_native(per_doc_changes, key_interner, actor_interner,
-                               hazard_out=None):
+                               hazard_out=None, kills_out=None):
     """Fast path: the whole parse + dictionary-encode runs in C++
     (native.ingest_changes), and the flat op rows scatter into OpBatch
     tensors with vectorized numpy. Returns None if any change falls outside
@@ -132,24 +151,31 @@ def changes_to_op_batch_native(per_doc_changes, key_interner, actor_interner,
 
     When `hazard_out` is a list, the parse runs with_meta so pred columns
     are available, and one tuple (set_doc, set_key, set_packed, inc_doc,
-    inc_key, inc_pred) in fleet numbering is appended — the feed for
-    DocFleet._note_grid_batch's counter-attribution check (inc_pred is the
-    Lamport-max pred, the reference's attribution target; -1 when absent
-    or unresolvable)."""
+    inc_key, inc_pred, kill_doc, kill_key, kill_packed) in fleet numbering
+    is appended — the feed for DocFleet._note_grid_batch's mirror advance
+    and counter-attribution check (inc_pred is the Lamport-max pred, the
+    reference's attribution target; -1 when absent or unresolvable).
+
+    When `kills_out` is a list, delete ops take the reference's
+    pred-scoped semantics (new.js:1204-1217): del rows are EXCLUDED from
+    the set lanes and their preds land as kill lanes — one
+    (kill_key [N, Q], kill_packed [N, Q]) pair appended to kills_out, for
+    apply.apply_op_batch_kills. Without kills_out, dels keep the legacy
+    tombstone-scatter behavior (the standalone benchmark subset)."""
     buffers, doc_ids = [], []
     for d, changes in enumerate(per_doc_changes):
         for change in changes:
             buffers.append(change)
             doc_ids.append(d)
+    want_meta = hazard_out is not None or kills_out is not None
     if not buffers:
         return OpBatch(*(np.zeros((len(per_doc_changes), 1), dtype=dt)
                          for dt in (np.int32, np.int32, np.int32, bool, bool,
                                     bool)))
-    out = native.ingest_changes(buffers, doc_ids,
-                                with_meta=hazard_out is not None)
+    out = native.ingest_changes(buffers, doc_ids, with_meta=want_meta)
     if out is None:
         return None
-    if hazard_out is not None:
+    if want_meta:
         rows, keys, actors, _meta = out
     else:
         rows, keys, actors = out
@@ -163,10 +189,29 @@ def changes_to_op_batch_native(per_doc_changes, key_interner, actor_interner,
     ctr = rows['packed'] >> 8
     actor = actor_map[rows['packed'] & 0xff] if len(actors) else 0
     packed = (ctr << 8) | actor
+    flags_flat = rows['flags']
+    del_sel = np.zeros(len(doc), dtype=bool)
+    kill_doc = kill_key = kill_packed = np.zeros(0, dtype=np.int64)
+    if kills_out is not None:
+        del_sel = (flags_flat == 1) & (rows['value'] == TOMBSTONE)
+        if del_sel.any():
+            pred_counts_all = np.diff(rows['pred_off'])
+            dcounts = pred_counts_all[del_sel]
+            kill_doc = np.repeat(doc[del_sel], dcounts)
+            kill_key = np.repeat(key[del_sel], dcounts)
+            entry_sel = np.repeat(del_sel, pred_counts_all)
+            praw = rows['pred'][entry_sel]
+            kill_packed = np.where(
+                praw != 0,
+                (praw >> 8 << 8) | actor_map[praw & 0xff],
+                0).astype(np.int32) if len(praw) else praw
+            (kk_arr, kp_arr), _ = layout_doc_rows(
+                kill_doc, n_docs, (kill_key, kill_packed),
+                (np.int32, np.int32))
+            kills_out.append((kk_arr, kp_arr))
     if hazard_out is not None:
         from .backend import _max_pred_per_inc
-        flags_flat = rows['flags']
-        set_sel = flags_flat == 1
+        set_sel = (flags_flat == 1) & ~del_sel
         inc_sel = flags_flat == 2
         pred_counts = np.diff(rows['pred_off'])
         amap_full = np.full(256, -1, dtype=np.int64)
@@ -175,26 +220,17 @@ def changes_to_op_batch_native(per_doc_changes, key_interner, actor_interner,
                                   rows['pred_off'][:-1][inc_sel],
                                   pred_counts[inc_sel], amap_full)
         hazard_out.append((doc[set_sel], key[set_sel], packed[set_sel],
-                           doc[inc_sel], key[inc_sel], preds))
+                           doc[inc_sel], key[inc_sel], preds,
+                           kill_doc, kill_key, kill_packed))
     # Lay out rows into [N, P] with per-doc positions
-    order = np.argsort(doc, kind='stable')
-    doc_sorted = doc[order]
-    pos = np.arange(len(doc_sorted)) - \
-        np.searchsorted(doc_sorted, doc_sorted, side='left')
-    counts = np.bincount(doc, minlength=n_docs)
-    max_ops = max(int(counts.max()) if counts.size else 0, 1)
-    shape = (n_docs, max_ops)
-    key_id = np.zeros(shape, dtype=np.int32)
-    packed_arr = np.zeros(shape, dtype=np.int32)
-    value = np.zeros(shape, dtype=np.int32)
-    is_set = np.zeros(shape, dtype=bool)
-    is_inc = np.zeros(shape, dtype=bool)
-    valid = np.zeros(shape, dtype=bool)
-    key_id[doc_sorted, pos] = key[order]
-    packed_arr[doc_sorted, pos] = packed[order]
-    value[doc_sorted, pos] = rows['value'][order]
-    flags = rows['flags'][order]
-    is_set[doc_sorted, pos] = flags == 1
+    (key_id, packed_arr, value), (order, doc_sorted, pos) = layout_doc_rows(
+        doc, n_docs, (key, packed, rows['value']),
+        (np.int32, np.int32, np.int32))
+    is_set = np.zeros(key_id.shape, dtype=bool)
+    is_inc = np.zeros(key_id.shape, dtype=bool)
+    valid = np.zeros(key_id.shape, dtype=bool)
+    flags = flags_flat[order]
+    is_set[doc_sorted, pos] = (flags == 1) & ~del_sel[order]
     is_inc[doc_sorted, pos] = flags == 2
     valid[doc_sorted, pos] = True
     return OpBatch(key_id, packed_arr, value, is_set, is_inc, valid)

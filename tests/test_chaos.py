@@ -247,6 +247,19 @@ def test_chaos_differential(seed):
 
     saves = compare('final')
 
+    # LIVE-fleet bulk device reads (materialize_docs — the default-mode
+    # grid / register readback, incl. the round-5 pred-scoped delete
+    # semantics) must match the host frontend views in BOTH device modes
+    host_views = [dict(d) for d in universes[0].docs]
+    for u in universes[1:]:
+        handles = u.with_backend(
+            lambda u=u: [A.frontend.get_backend_state(d, 'chaos')
+                         for d in u.docs])
+        mats = u.with_backend(
+            lambda h=handles: fleet_backend.materialize_docs(h))
+        for k, (m, e) in enumerate(zip(mats, host_views)):
+            assert m == e, f'live bulk read {u.name} doc {k}'
+
     # histories and heads agree everywhere
     for u in universes[1:]:
         for d0, d1 in zip(universes[0].docs, u.docs):

@@ -2312,3 +2312,253 @@ class TestBulkInitEquivalence:
             if name == 'changes':
                 name = '_changes'   # property shadow (see _FlatEngine)
             assert name in a, name
+
+
+class TestDeleteResurrection:
+    """Pred-scoped delete semantics in the default (LWW grid) mode, ref
+    new.js:1204-1217 / test/new_backend_test.js:1660-class histories: a
+    delete kills ONLY the ops it preds. A concurrent set the delete never
+    saw stays visible — even when the delete's own opId packs higher —
+    and a causally-later straggler set resurrects a deleted key."""
+
+    A, B = 'aa' * 16, 'bb' * 16   # sorted: A -> actor 0, B -> actor 1
+
+    def _chain(self):
+        from automerge_tpu.columnar import decode_change_meta
+        c1 = change_buf(self.A, 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        h1 = decode_change_meta(c1, True)['hash']
+        # concurrent wrt each other; the del's packed id (2@B) is HIGHER
+        # than the concurrent set's (2@A)
+        c_del = change_buf(self.B, 1, 2, [
+            {'action': 'del', 'obj': '_root', 'key': 'k',
+             'pred': [f'1@{self.A}']}], deps=[h1])
+        c_set = change_buf(self.A, 2, 2, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 7,
+             'datatype': 'int', 'pred': [f'1@{self.A}']}], deps=[h1])
+        return c1, c_del, c_set
+
+    def _host_result(self, batches):
+        doc = am.init()
+        for chs in batches:
+            doc, _ = am.apply_changes(doc, [bytes(b) for b in chs])
+        return dict(doc)
+
+    @pytest.mark.parametrize('mirror', [True, False])
+    def test_concurrent_del_and_set_same_batch(self, mirror):
+        c1, c_del, c_set = self._chain()
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=4))
+        handles = fleet_backend.init_docs(1, fb.fleet)
+        handles, _ = fleet_backend.apply_changes_docs(
+            handles, [[c1, c_del, c_set]], mirror=mirror)
+        want = self._host_result([[c1, c_del, c_set]])
+        assert fleet_backend.materialize_docs(handles) == [want]
+        assert want == {'k': 7}   # the un-pred'd set survives
+
+    @pytest.mark.parametrize('mirror', [True, False])
+    def test_concurrent_del_then_set_across_batches(self, mirror):
+        """Standing-winner kill first, then the concurrent set arrives in
+        a LATER apply: the key must resurrect with the set's value."""
+        c1, c_del, c_set = self._chain()
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=4))
+        handles = fleet_backend.init_docs(1, fb.fleet)
+        handles, _ = fleet_backend.apply_changes_docs(
+            handles, [[c1, c_del]], mirror=mirror)
+        assert fleet_backend.materialize_docs(handles) == [{}]
+        handles, _ = fleet_backend.apply_changes_docs(
+            handles, [[c_set]], mirror=mirror)
+        want = self._host_result([[c1, c_del], [c_set]])
+        assert fleet_backend.materialize_docs(handles) == [want] == [{'k': 7}]
+
+    @pytest.mark.parametrize('mirror', [True, False])
+    def test_delete_still_deletes_when_it_pred_everything(self, mirror):
+        c1, c_del, _ = self._chain()
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=4))
+        handles = fleet_backend.init_docs(1, fb.fleet)
+        handles, _ = fleet_backend.apply_changes_docs(
+            handles, [[c1, c_del]], mirror=mirror)
+        assert fleet_backend.materialize_docs(handles) == \
+            [self._host_result([[c1, c_del]])] == [{}]
+
+    @pytest.mark.parametrize('mirror', [True, False])
+    def test_set_after_delete_overwrites(self, mirror):
+        """A set that preds the delete's surviving state (normal causal
+        overwrite after deletion) lands as usual."""
+        from automerge_tpu.columnar import decode_change_meta
+        c1, c_del, _ = self._chain()
+        h_del = decode_change_meta(c_del, True)['hash']
+        c_new = change_buf(self.B, 2, 3, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 9,
+             'datatype': 'int', 'pred': []}], deps=[h_del])
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=4))
+        handles = fleet_backend.init_docs(1, fb.fleet)
+        handles, _ = fleet_backend.apply_changes_docs(
+            handles, [[c1, c_del, c_new]], mirror=mirror)
+        want = self._host_result([[c1, c_del, c_new]])
+        assert fleet_backend.materialize_docs(handles) == [want] == \
+            [{'k': 9}]
+
+
+class TestDeleteHiddenLosers:
+    """Round-5 review finds: the single-winner grid cannot resurrect a
+    concurrent LOSER it never stored. (1) When a delete clears a standing
+    winner while other visible ops remain from earlier batches, the slot
+    must go mirror-authoritative and reads must still match the
+    reference. (2) The host winner mirror must replicate the device's
+    same-batch lane masking, or later counter-attribution checks pass
+    against a winner the device never kept."""
+
+    A, B, C = 'aa' * 16, 'bb' * 16, 'cc' * 16
+
+    def _host(self, batches):
+        doc = am.init()
+        for chs in batches:
+            doc, _ = am.apply_changes(doc, [bytes(b) for b in chs])
+        return dict(doc)
+
+    def test_cross_batch_kill_with_hidden_loser(self):
+        """Batch 1: concurrent sets 1@A (loses LWW) and 1@C (wins).
+        Batch 2: delete preds ONLY 1@C. Reference: 1@A resurrects
+        (k = 5). The grid dropped 1@A's value, so the slot must fall
+        back to the mirror and still answer k = 5."""
+        from automerge_tpu.columnar import decode_change_meta
+        cA = change_buf(self.A, 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 5,
+             'datatype': 'int', 'pred': []}])
+        cC = change_buf(self.C, 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 9,
+             'datatype': 'int', 'pred': []}])
+        hC = decode_change_meta(cC, True)['hash']
+        c_del = change_buf(self.B, 1, 2, [
+            {'action': 'del', 'obj': '_root', 'key': 'k',
+             'pred': [f'1@{self.C}']}], deps=[hC])
+        for mirror in (True, False):
+            fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=4))
+            handles = fleet_backend.init_docs(1, fb.fleet)
+            handles, _ = fleet_backend.apply_changes_docs(
+                handles, [[cA, cC]], mirror=mirror)
+            handles, _ = fleet_backend.apply_changes_docs(
+                handles, [[c_del]], mirror=mirror)
+            want = self._host([[cA, cC], [c_del]])
+            got = fleet_backend.materialize_docs(handles)
+            assert got == [want] == [{'k': 5}], f'mirror={mirror}: {got}'
+            fb.fleet.flush()
+            slot = handles[0]['state']._impl.slot
+            assert slot in fb.fleet.del_fallback
+
+    def test_mirror_replicates_same_batch_lane_masking(self):
+        """Same batch: set 2@B (pred 1@A), del pred [2@B], concurrent
+        set 2@A. Device winner is 2@A; the mirror must agree — and a
+        later inc pred'ing the dead 2@B must flag, not pass."""
+        from automerge_tpu.columnar import decode_change_meta
+        c1 = change_buf(self.A, 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        h1 = decode_change_meta(c1, True)['hash']
+        cB = change_buf(self.B, 1, 2, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 2,
+             'datatype': 'int', 'pred': [f'1@{self.A}']}], deps=[h1])
+        hB = decode_change_meta(cB, True)['hash']
+        c_del = change_buf(self.C, 1, 3, [
+            {'action': 'del', 'obj': '_root', 'key': 'k',
+             'pred': [f'2@{self.B}']}], deps=[hB])
+        cA2 = change_buf(self.A, 2, 2, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 7,
+             'datatype': 'int', 'pred': [f'1@{self.A}']}], deps=[h1])
+        for mirror in (True, False):
+            fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=4))
+            handles = fleet_backend.init_docs(1, fb.fleet)
+            handles, _ = fleet_backend.apply_changes_docs(
+                handles, [[c1, cB, c_del, cA2]], mirror=mirror)
+            want = self._host([[c1, cB, c_del, cA2]])
+            got = fleet_backend.materialize_docs(handles)
+            assert got == [want] == [{'k': 7}], f'mirror={mirror}: {got}'
+            fleet = fb.fleet
+            fleet.flush()
+            fleet._fold_pending_winners()
+            slot = handles[0]['state']._impl.slot
+            kx = fleet.keys.index['k']
+            a_num = fleet.actors.index[self.A]
+            # mirror holds the device's winner 2@A, not the masked 2@B
+            assert int(fleet.host_winners[slot, kx]) == (2 << 8) | a_num, \
+                f'mirror={mirror}'
+
+
+class TestDeleteChains:
+    """Round-5 second-review finds: same-batch supersession chains and
+    shared preds across concurrent ops — shapes where single-winner
+    bookkeeping is provably insufficient, so the slot must serve reads
+    from the exact mirror and match the reference."""
+
+    A, B, C = 'aa' * 16, 'bb' * 16, 'cc' * 16
+
+    def _host(self, batches):
+        doc = am.init()
+        for chs in batches:
+            doc, _ = am.apply_changes(doc, [bytes(b) for b in chs])
+        return dict(doc)
+
+    @pytest.mark.parametrize('mirror', [True, False])
+    def test_set_then_delete_same_batch_after_standing_winner(self, mirror):
+        """Batch 1: set k=1 (1@A). Batch 2 (one flush): overwrite set
+        k=2 (2@A pred 1@A) then del (3@A pred 2@A). Reference: key
+        deleted. An ordinary sequential edit split across two syncs."""
+        from automerge_tpu.columnar import decode_change_meta
+        c1 = change_buf(self.A, 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        h1 = decode_change_meta(c1, True)['hash']
+        c2 = change_buf(self.A, 2, 2, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 2,
+             'datatype': 'int', 'pred': [f'1@{self.A}']}], deps=[h1])
+        h2 = decode_change_meta(c2, True)['hash']
+        c3 = change_buf(self.A, 3, 3, [
+            {'action': 'del', 'obj': '_root', 'key': 'k',
+             'pred': [f'2@{self.A}']}], deps=[h2])
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=4))
+        handles = fleet_backend.init_docs(1, fb.fleet)
+        handles, _ = fleet_backend.apply_changes_docs(
+            handles, [[c1]], mirror=mirror)
+        handles, _ = fleet_backend.apply_changes_docs(
+            handles, [[c2, c3]], mirror=mirror)
+        want = self._host([[c1], [c2, c3]])
+        got = fleet_backend.materialize_docs(handles)
+        assert got == [want] == [{}], f'mirror={mirror}: {got}'
+
+    @pytest.mark.parametrize('mirror', [True, False])
+    def test_concurrent_ops_sharing_a_pred(self, mirror):
+        """Concurrent set 2@A and del 2@B both pred the same 1@A (both
+        causally saw only it), with a hidden concurrent loser 1@C from
+        batch 1; batch 3 deletes the surviving winner. Reference: the
+        hidden loser 1@C resurrects (k = 9)."""
+        from automerge_tpu.columnar import decode_change_meta
+        cA = change_buf(self.A, 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 5,
+             'datatype': 'int', 'pred': []}])
+        hA = decode_change_meta(cA, True)['hash']
+        cC = change_buf(self.C, 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 9,
+             'datatype': 'int', 'pred': []}])
+        set2 = change_buf(self.A, 2, 2, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 6,
+             'datatype': 'int', 'pred': [f'1@{self.A}']}], deps=[hA])
+        h2 = decode_change_meta(set2, True)['hash']
+        del2 = change_buf(self.B, 1, 2, [
+            {'action': 'del', 'obj': '_root', 'key': 'k',
+             'pred': [f'1@{self.A}']}], deps=[hA])
+        hd = decode_change_meta(del2, True)['hash']
+        del3 = change_buf(self.B, 2, 3, [
+            {'action': 'del', 'obj': '_root', 'key': 'k',
+             'pred': [f'2@{self.A}']}], deps=sorted([h2, hd]))
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=4))
+        handles = fleet_backend.init_docs(1, fb.fleet)
+        handles, _ = fleet_backend.apply_changes_docs(
+            handles, [[cA, cC]], mirror=mirror)
+        handles, _ = fleet_backend.apply_changes_docs(
+            handles, [[set2, del2]], mirror=mirror)
+        handles, _ = fleet_backend.apply_changes_docs(
+            handles, [[del3]], mirror=mirror)
+        want = self._host([[cA, cC], [set2, del2], [del3]])
+        got = fleet_backend.materialize_docs(handles)
+        assert got == [want] == [{'k': 9}], f'mirror={mirror}: {got}'
