@@ -108,6 +108,14 @@ def get_changes(backend, have_deps):
     return _backend_state(backend).get_changes(list(have_deps))
 
 
+def get_change_hashes(backend, have_deps):
+    """Hashes of get_changes(backend, have_deps) without decoding the
+    change buffers (the fleet sync driver's Bloom feed)."""
+    if not isinstance(have_deps, (list, tuple)):
+        raise TypeError('Pass an array of hashes to Backend.getChanges()')
+    return _backend_state(backend).get_change_hashes(list(have_deps))
+
+
 def get_changes_added(backend1, backend2):
     return _backend_state(backend2).get_changes_added(_backend_state(backend1))
 

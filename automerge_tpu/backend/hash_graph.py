@@ -189,9 +189,28 @@ class HashGraph:
     # ------------------------------------------------------------------
 
     def get_changes(self, have_deps):
-        self._ensure_graph()
         if not have_deps:
+            self._ensure_graph()
             return list(self.changes)
+        return [self.changes[self.change_index_by_hash[h]]
+                for h in self.get_change_hashes(have_deps)]
+
+    def get_change_hashes(self, have_deps):
+        """Hashes of get_changes(have_deps), without touching the change
+        buffers — the sync driver's Bloom builds need only hashes, and
+        re-decoding every buffer per round (the reference's own TODO at
+        sync.js:378) dominated fleet-scale sync profiles. get_changes is
+        a buffer lookup over this (single copy of the traversal)."""
+        self._ensure_graph()
+
+        def ordered_hashes():
+            out = [None] * len(self.changes)
+            for h, i in self.change_index_by_hash.items():
+                out[i] = h
+            return out
+
+        if not have_deps:
+            return ordered_hashes()
         stack, seen, to_return = [], set(), []
         for h in have_deps:
             seen.add(h)
@@ -207,8 +226,7 @@ class HashGraph:
                 break
             stack.extend(self.dependents_by_hash[h])
         if not stack and all(head in seen for head in self.heads):
-            return [self.changes[self.change_index_by_hash[h]] for h in to_return]
-
+            return to_return
         # Slow path: collect ancestors of have_deps, return everything else
         stack, seen = list(have_deps), set()
         while stack:
@@ -219,8 +237,7 @@ class HashGraph:
                     raise ValueError(f'hash not found: {h}')
                 stack.extend(deps)
                 seen.add(h)
-        return [change for change in self.changes
-                if decode_change_meta(change, True)['hash'] not in seen]
+        return [h for h in ordered_hashes() if h not in seen]
 
     def get_changes_added(self, other):
         self._ensure_graph()

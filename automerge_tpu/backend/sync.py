@@ -94,16 +94,11 @@ class BloomFilter:
 
 
 def _encode_hashes(encoder, hashes):
-    if not isinstance(hashes, (list, tuple)):
-        raise TypeError('hashes must be an array')
-    encoder.append_uint32(len(hashes))
-    for i, hash in enumerate(hashes):
-        if i > 0 and hashes[i - 1] >= hash:
-            raise ValueError('hashes must be sorted')
-        data = hex_string_to_bytes(hash)
-        if len(data) != HASH_SIZE:
-            raise TypeError('heads hashes must be 256 bits')
-        encoder.append_raw_bytes(data)
+    out = bytearray()
+    _hashes_raw(out, hashes)
+    # (delegates to the bytearray fast path; the count uleb matches
+    # append_uint32's encoding)
+    encoder.append_raw_bytes(bytes(out))
 
 
 def _decode_hashes(decoder):
@@ -111,20 +106,63 @@ def _decode_hashes(decoder):
             for _ in range(decoder.read_uint32())]
 
 
+def _uleb(out, v):
+    if v < 0 or v > 0xffffffff:
+        raise ValueError('number out of range')
+    while True:
+        b = v & 0x7f
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _hashes_raw(out, hashes):
+    """Encode a sorted hash run: count uleb + raw 32-byte hashes, with
+    one C-level hex decode for the whole run instead of a per-hash
+    convert+append (sync messages encode by the thousand in the fleet
+    driver, and this was its hottest line). Per-hash length is validated
+    up front — a joined decode alone would let malformed hashes whose
+    lengths cancel out slip through as shifted garbage."""
+    if not isinstance(hashes, (list, tuple)):
+        raise TypeError('hashes must be an array')
+    _uleb(out, len(hashes))
+    if not hashes:
+        return
+    if any(a >= b for a, b in zip(hashes, hashes[1:])):
+        raise ValueError('hashes must be sorted')
+    if any(len(h) != 2 * HASH_SIZE for h in hashes):
+        raise TypeError('heads hashes must be 256 bits')
+    try:
+        data = bytes.fromhex(''.join(hashes))
+    except ValueError:
+        raise TypeError('heads hashes must be 256 bits')
+    if len(data) != HASH_SIZE * len(hashes):
+        raise TypeError('heads hashes must be 256 bits')
+    out += data
+
+
 def encode_sync_message(message):
-    """(ref sync.js:157-172)"""
-    encoder = Encoder()
-    encoder.append_byte(MESSAGE_TYPE_SYNC)
-    _encode_hashes(encoder, message['heads'])
-    _encode_hashes(encoder, message['need'])
-    encoder.append_uint32(len(message['have']))
+    """(ref sync.js:157-172). Built with direct bytearray ops — the
+    fleet driver encodes thousands of messages per round, and the
+    general Encoder's per-int checks dominated its profile."""
+    out = bytearray([MESSAGE_TYPE_SYNC])
+    _hashes_raw(out, message['heads'])
+    _hashes_raw(out, message['need'])
+    _uleb(out, len(message['have']))
     for have in message['have']:
-        _encode_hashes(encoder, have['lastSync'])
-        encoder.append_prefixed_bytes(have['bloom'])
-    encoder.append_uint32(len(message['changes']))
+        _hashes_raw(out, have['lastSync'])
+        bloom = bytes(have['bloom'])
+        _uleb(out, len(bloom))
+        out += bloom
+    _uleb(out, len(message['changes']))
     for change in message['changes']:
-        encoder.append_prefixed_bytes(change)
-    return encoder.buffer
+        change = bytes(change)
+        _uleb(out, len(change))
+        out += change
+    return bytes(out)
 
 
 def decode_sync_message(data):
@@ -184,8 +222,8 @@ def _cached_meta(change):
 
 def make_bloom_filter(backend, last_sync):
     """Bloom filter over changes applied since `last_sync` (ref sync.js:234-238)."""
-    new_changes = get_changes(backend, last_sync)
-    hashes = [_cached_meta(c)['hash'] for c in new_changes]
+    from . import get_change_hashes
+    hashes = get_change_hashes(backend, last_sync)
     return {'lastSync': last_sync, 'bloom': BloomFilter(hashes).bytes}
 
 

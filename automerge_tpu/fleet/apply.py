@@ -102,10 +102,18 @@ def _apply_op_batch_kills_impl(state, ops, kill_key, kill_packed):
                          jnp.where(killed, 0, state.values),
                          jnp.where(killed, 0, state.counters))
     # Same-batch kills: a set lane whose packed id any kill lane names
-    # never lands (the delete pred'd it)
-    lane_killed = jnp.any(
-        (ops.packed[:, :, None] == kill_packed[:, None, :]) &
-        kvalid[:, None, :], axis=-1)
+    # never lands (the delete pred'd it). Per-doc sorted membership test
+    # — a dense [N, P, Q] one-hot would scale device memory with
+    # doc_capacity x batch_width x kill_lanes (GBs on delete-heavy
+    # flushes of large fleets), while sort + searchsorted stays
+    # O(N x (P + Q)).
+    int32_max = jnp.iinfo(jnp.int32).max
+    kill_sorted = jnp.sort(
+        jnp.where(kvalid, kill_packed, int32_max), axis=1)
+    pos = jax.vmap(jnp.searchsorted)(kill_sorted, ops.packed)
+    pos = jnp.clip(pos, 0, kill_sorted.shape[1] - 1)
+    lane_killed = (jnp.take_along_axis(kill_sorted, pos, axis=1) ==
+                   ops.packed) & (ops.packed > 0)
     masked = type(ops)(ops.key_id, ops.packed, ops.value,
                        ops.is_set & ~lane_killed, ops.is_inc, ops.valid)
     return _apply_op_batch_impl(cleared, masked)

@@ -270,6 +270,18 @@ class DocFleet:
         # Unlike grid_overflow this does NOT block the turbo apply path:
         # packing stays trustworthy, only reads fall back.
         self.del_fallback = set()
+        # Per-slot index of every map-key op row ever applied, as sorted
+        # int64 combos (key_id << 32) | packed — the turbo path's
+        # dangling-pred oracle (ref op_set.py: a pred must name a non-del
+        # row on its key; ref new.js rejects invalid op references during
+        # the merge). Fed by every ingest path; slots whose ops landed
+        # without indexing (bulk document loads) are marked incomplete
+        # and skip validation rather than risk a false reject — their
+        # dangling preds surface at the next mirror rebuild as before.
+        # ~8 bytes/op of host memory, vs the ~60+ bytes/op change log.
+        self._op_index = {}            # slot -> sorted np.int64 combos
+        self._op_index_pending = []    # [(slots, combos)] flat batches
+        self._op_index_incomplete = set()
         # Set rows fold into host_winners lazily: inc-free batches (the
         # common case) just append their arrays here, and the scatter-max
         # replays only when an inc needs checking, a maintenance op
@@ -356,6 +368,11 @@ class DocFleet:
         if self.host_winners is not None:
             # host-RAM mirror for counter-attribution checks (not device)
             out['host_winner_mirror'] = int(self.host_winners.nbytes)
+        if self._op_index or self._op_index_pending:
+            # host-RAM dangling-pred oracle: 8 bytes per applied op row
+            out['op_index'] = int(
+                sum(a.nbytes for a in self._op_index.values()) +
+                sum(p[1].nbytes for p in self._op_index_pending))
         if self.reg_state is not None:
             out['registers'] = nbytes(self.reg_state.tree_flatten()[0])
         pools = {}
@@ -385,6 +402,9 @@ class DocFleet:
         self.ctr_base.pop(slot, None)
         self.grid_overflow.discard(slot)
         self.del_fallback.discard(slot)
+        self._index_consolidate()
+        self._op_index.pop(slot, None)
+        self._op_index_incomplete.discard(slot)
         self._zero_row(slot)
         rows = self.slot_seq.pop(slot, {})
         if rows:
@@ -406,6 +426,12 @@ class DocFleet:
             self.grid_overflow.add(dst)
         if src in self.del_fallback:
             self.del_fallback.add(dst)
+        if src in self._op_index_incomplete:
+            self._op_index_incomplete.add(dst)
+        self._index_consolidate()
+        src_idx = self._op_index.get(src)
+        if src_idx is not None:
+            self._op_index[dst] = src_idx.copy()
         copies = {}    # cls -> ([src idx], [dst idx])
         lanes = self._seq_lane_width()
         for oid, row in list(self.slot_seq.get(src, {}).items()):
@@ -815,12 +841,13 @@ class DocFleet:
 
     def _remap_actors(self, perm):
         """Renumber the actor bits of every packed opId on the device."""
+        perm_full = np.arange(MAX_ACTORS, dtype=np.int32)
+        perm_full[:len(perm)] = perm
+        self._index_remap_actors(perm_full)
         if self.state is None:
             return
         import jax.numpy as jnp
         mask = MAX_ACTORS - 1
-        perm_full = np.arange(MAX_ACTORS, dtype=np.int32)
-        perm_full[:len(perm)] = perm
         self.metrics.remaps += 1
         w = self.state.winners
         remapped = (w & ~mask) | jnp.asarray(perm_full)[w & mask]
@@ -906,6 +933,9 @@ class DocFleet:
     def _remap_reg_actors(self, perm):
         """Renumber actor bits AND permute the actor-slot axis of the
         register state after a sorted-order actor insertion."""
+        perm_full = np.arange(MAX_ACTORS, dtype=np.int32)
+        perm_full[:len(perm)] = perm
+        self._index_remap_actors(perm_full)
         if self.reg_state is None:
             return
         from .registers import RegisterState
@@ -953,6 +983,7 @@ class DocFleet:
                     slot < self.host_winners.shape[0]:
                 hw = self.host_winners[slot]
                 self.host_winners[slot] = np.where(hw != 0, hw - delta, 0)
+        self._index_rebase(slot, (new_base - old) << ACTOR_BITS)
         self.ctr_base[slot] = new_base
         return new_base
 
@@ -980,6 +1011,78 @@ class DocFleet:
                 return -1
             packed.append(pack_op_id(rel, num))
         return max(packed)
+
+    # -- dangling-pred oracle (see _op_index in __init__) ---------------
+
+    def _index_ops(self, slots, key_ids, packeds):
+        """Record applied map-key op rows (sets, incs, makes — never
+        dels) for later pred-existence checks. slots/key_ids/packeds are
+        parallel arrays in fleet numbering. O(1) per batch: the per-slot
+        split is deferred to consolidation (lookup/clone/rebase time), so
+        pred-free bulk workloads pay only the combo pack."""
+        if not len(slots):
+            return
+        combos = (np.asarray(key_ids, dtype=np.int64) << 32) | \
+            np.asarray(packeds, dtype=np.int64)
+        self._op_index_pending.append(
+            (np.asarray(slots, dtype=np.int64), combos))
+
+    def _index_consolidate(self):
+        """Drain the flat pending batches into per-slot sorted arrays."""
+        if not self._op_index_pending:
+            return
+        slots = np.concatenate([p[0] for p in self._op_index_pending])
+        combos = np.concatenate([p[1] for p in self._op_index_pending])
+        self._op_index_pending = []
+        order = np.argsort(slots, kind='stable')
+        ss = slots[order]
+        cs = combos[order]
+        bounds = np.flatnonzero(np.r_[True, ss[1:] != ss[:-1]])
+        ends = np.r_[bounds[1:], len(ss)]
+        for b, e in zip(bounds, ends):
+            slot = int(ss[b])
+            old = self._op_index.get(slot)
+            if old is None:
+                self._op_index[slot] = np.sort(cs[b:e])
+            else:
+                self._op_index[slot] = np.sort(
+                    np.concatenate([old, cs[b:e]]))
+
+    def _index_lookup(self, slot, combos):
+        """Membership of (key << 32 | packed) combos in the slot's
+        applied-op index (consolidates the pending backlog first)."""
+        self._index_consolidate()
+        arr = self._op_index.get(slot)
+        if arr is None or not len(arr):
+            return np.zeros(len(combos), dtype=bool)
+        pos = np.searchsorted(arr, combos)
+        pos = np.clip(pos, 0, len(arr) - 1)
+        return arr[pos] == combos
+
+    def _index_remap_actors(self, perm_full):
+        """Renumber the actor bits of every indexed packed opId (actor
+        table re-sort) — consolidated arrays and pending batches alike."""
+        mask = np.int64(MAX_ACTORS - 1)
+        perm64 = perm_full.astype(np.int64)
+
+        def remap(arr):
+            return (arr & ~mask) | perm64[arr & mask]
+
+        for slot, arr in self._op_index.items():
+            self._op_index[slot] = np.sort(remap(arr))
+        self._op_index_pending = [(s, remap(c))
+                                  for s, c in self._op_index_pending]
+
+    def _index_rebase(self, slot, delta_packed):
+        """Shift a slot's indexed packed ids down by a counter rebase."""
+        self._index_consolidate()
+        arr = self._op_index.get(slot)
+        if arr is None or not len(arr):
+            return
+        low = arr & 0xffffffff
+        shifted = np.maximum(low - delta_packed, 0)
+        self._op_index[slot] = np.sort(
+            (arr & ~np.int64(0xffffffff)) | shifted)
 
     def _dispatch_grid(self, batch, kills=None):
         """One LWW-grid merge dispatch. With `kills` (a (kill_key,
@@ -1126,6 +1229,7 @@ class DocFleet:
             for d in set(self.ctr_base) | self.grid_overflow)
         hazard = []
         kills = []
+        index_rows = []
         if native.available() and not rebased_touched:
             # (rebased slots pack against per-slot bases the native batch
             # does not know about: only flushes touching such slots take
@@ -1134,7 +1238,8 @@ class DocFleet:
             batch = changes_to_op_batch_native(per_doc, self.keys,
                                                self.actors,
                                                hazard_out=hazard,
-                                               kills_out=kills)
+                                               kills_out=kills,
+                                               index_out=index_rows)
         if batch is None:
             # Sequence ops, non-inline values, or no native codec: Python
             # decode once, routing flat rows to the grid and sequence ops
@@ -1146,6 +1251,8 @@ class DocFleet:
             pad = self.state.winners.shape[0] - batch.key_id.shape[0]
             batch = type(batch)(*(np.pad(col, ((0, pad), (0, 0)))
                                   for col in batch.tree_flatten()[0]))
+        if index_rows:
+            self._index_ops(*index_rows[0])
         self._dispatch_grid(batch, kills[0] if kills else None)
         self.metrics.device_ops += int(batch.valid.sum())
         if hazard:
@@ -1166,6 +1273,10 @@ class DocFleet:
             return
         self._ensure_reg_capacity(n_docs=n_docs, n_keys=len(self.keys))
         n_cap = self.reg_state.reg.shape[0]
+        idx_sel = ((rows['flags'] == 1) & (rows['value'] != TOMBSTONE)) | \
+            (rows['flags'] == 2)
+        self._index_ops(rows['doc'][idx_sel], rows['key'][idx_sel],
+                        rows['packed'][idx_sel])
         batch = rows_to_register_batch(
             rows['doc'], rows['flags'], rows['key'], rows['packed'],
             rows['value'], rows['pred_off'], rows['pred'],
@@ -1282,6 +1393,10 @@ class DocFleet:
                 valid[d, j] = True
             batch = OpBatch(cols['key_id'], cols['packed'], cols['value'],
                             is_set, is_inc, valid)
+            # every rows entry is a map-key set/inc/make (dels became
+            # kill lanes): feed the dangling-pred oracle
+            self._index_ops([r[0] for r in rows], [r[1] for r in rows],
+                            [r[2] for r in rows])
             kills = None
             if kill_rows:
                 from .ingest import layout_doc_rows
@@ -1360,12 +1475,17 @@ class DocFleet:
         if out_doc:
             self._ensure_reg_capacity(n_docs=n_docs, n_keys=len(self.keys))
             n_cap = self.reg_state.reg.shape[0]
+            doc_a = np.array(out_doc, dtype=np.int64)
+            key_a = np.array(out_key, dtype=np.int32)
+            packed_a = np.array(out_packed, dtype=np.int32)
+            flags_a = np.array(out_flags, dtype=np.uint8)
+            val_a = np.array(out_val, dtype=np.int32)
+            idx_sel = ((flags_a == 1) & (val_a != TOMBSTONE)) | \
+                (flags_a == 2)
+            self._index_ops(doc_a[idx_sel], key_a[idx_sel],
+                            packed_a[idx_sel])
             batch = rows_to_register_batch(
-                np.array(out_doc, dtype=np.int64),
-                np.array(out_flags, dtype=np.uint8),
-                np.array(out_key, dtype=np.int32),
-                np.array(out_packed, dtype=np.int32),
-                np.array(out_val, dtype=np.int32),
+                doc_a, flags_a, key_a, packed_a, val_a,
                 np.array(pred_off, dtype=np.int64),
                 np.array(preds, dtype=np.int32),
                 n_docs=n_cap, d_preds=self.d_preds)
@@ -2186,6 +2306,9 @@ class FleetDoc:
     def get_changes(self, have_deps):
         return self._impl.get_changes(have_deps)
 
+    def get_change_hashes(self, have_deps):
+        return self._impl.get_change_hashes(have_deps)
+
     def get_changes_added(self, other):
         return self._impl.get_changes_added(other)
 
@@ -2320,13 +2443,16 @@ def apply_changes_docs(handles, per_doc_changes, mirror=True):
     lazily. Sync protocol functions need only the hash graph, so they work
     on turbo documents without any rebuild.
 
-    Trust note: turbo validates the causal gate (seq contiguity, deps),
-    chunk checksums/hashes, and intra-batch duplicate opIds, but NOT per-op
-    pred well-formedness (that requires decoding op objects — the cost turbo
-    exists to skip). A change with a dangling pred is rejected up front by
-    mirror=True but only at the next mirror rebuild under turbo. Use
-    mirror=True for untrusted peers; per-op pred columns in the native
-    parser are the planned lift."""
+    Validation: turbo checks the causal gate (seq contiguity, deps),
+    chunk checksums/hashes, intra-batch duplicate opIds, AND map-key pred
+    well-formedness — a change whose pred names no existing op row is
+    rejected at apply time with the exact path's error and full rollback
+    (the per-slot applied-op index, DocFleet._op_index, is the oracle;
+    round-5, closing the old trust note). Residual envelope: sequence
+    refs/preds drop-and-flag-inexact instead of raising (the mirror
+    serves those docs), bulk-loaded docs validate only from their first
+    post-load op onward, and a pred-less inc on a non-counter key
+    surfaces at the next mirror read rather than at apply."""
     if not mirror:
         turbo = _apply_changes_turbo(handles, per_doc_changes)
         if turbo is not None:
@@ -2561,24 +2687,40 @@ def _apply_changes_turbo(handles, per_doc_changes):
         # objects — a type mismatch is an exact-path error too.
         made_seq = [set() for _ in engines]
         made_map = [set() for _ in engines]
-        for ri in np.flatnonzero(make_sel | seq_make_sel):
-            d = change_doc[int(rows['doc'][ri])]
-            p = int(rows['packed'][ri])
-            oid = f'{p >> 8}@{nat_actors[p & (_MA - 1)]}'
-            (made_seq if int(rows['flags'][ri]) in (7, 8, 11, 12)
-             else made_map)[d].add(oid)
-        for d, obj_nat in {(change_doc[int(rows['doc'][ri])],
-                            int(rows['obj'][ri]))
-                           for ri in np.flatnonzero(seq_sel | seq_make_sel)}:
-            oid = f'{obj_nat >> 8}@{nat_actors[obj_nat & (_MA - 1)]}'
+        _oid_memo = {}
+
+        def _oid_of(p):
+            oid = _oid_memo.get(p)
+            if oid is None:
+                oid = f'{p >> 8}@{nat_actors[p & (_MA - 1)]}'
+                _oid_memo[p] = oid
+            return oid
+
+        mk_rows = np.flatnonzero(make_sel | seq_make_sel)
+        mk_docs = change_doc[rows['doc'][mk_rows]].tolist()
+        mk_packed = rows['packed'][mk_rows].tolist()
+        mk_is_seq = np.isin(rows['flags'][mk_rows],
+                            (7, 8, 11, 12)).tolist()
+        for d, p, isq in zip(mk_docs, mk_packed, mk_is_seq):
+            (made_seq if isq else made_map)[d].add(_oid_of(p))
+        sq_rows = np.flatnonzero(seq_sel | seq_make_sel)
+        sq_combo = np.unique(
+            (change_doc[rows['doc'][sq_rows]] << 32) |
+            rows['obj'][sq_rows].astype(np.int64))
+        for cv in sq_combo.tolist():
+            d, obj_nat = cv >> 32, cv & 0xffffffff
+            oid = _oid_of(obj_nat)
             if oid not in made_seq[d] and \
                     oid not in engines[d].seq_objects:
                 return None
-        for d, obj_nat in {(change_doc[int(rows['doc'][ri])],
-                            int(rows['obj'][ri]))
-                           for ri in np.flatnonzero(nested_sel | (
-                               make_sel & (rows['obj'] != 0)))}:
-            oid = f'{obj_nat >> 8}@{nat_actors[obj_nat & (_MA - 1)]}'
+        nm_rows = np.flatnonzero(nested_sel | (
+            make_sel & (rows['obj'] != 0)))
+        nm_combo = np.unique(
+            (change_doc[rows['doc'][nm_rows]] << 32) |
+            rows['obj'][nm_rows].astype(np.int64))
+        for cv in nm_combo.tolist():
+            d, obj_nat = cv >> 32, cv & 0xffffffff
+            oid = _oid_of(obj_nat)
             if oid not in made_map[d] and \
                     oid not in engines[d].map_objects:
                 return None
@@ -2595,11 +2737,33 @@ def _apply_changes_turbo(handles, per_doc_changes):
     decoded_cache = {}
     if decode_sel.any():
         from ..columnar import decode_value
+        sel_idx = np.flatnonzero(decode_sel)
+        vb = vblob if isinstance(vblob, np.ndarray) else \
+            np.frombuffer(vblob, dtype=np.uint8)
         try:
-            for ri in np.flatnonzero(decode_sel):
-                ln, vt = int(vlen_all[ri]), int(vtype_all[ri])
-                decoded_cache[int(ri)] = decode_value(
-                    (ln << 4) | vt, vblob[voff_all[ri]:voff_all[ri] + ln])
+            # Group rows by (len, vtype) and dedupe payload bytes within
+            # each group, so every DISTINCT wire value decodes exactly
+            # once per batch — fleets repeat values heavily (the mixed
+            # seam spent more time in per-op decode_value than in the
+            # native parse). Equal payloads share one decoded dict, which
+            # also lets the intern loops below memoize by object id.
+            combos = (vlen_all[sel_idx].astype(np.int64) << 8) | \
+                vtype_all[sel_idx]
+            for combo in np.unique(combos):
+                grp = sel_idx[combos == combo]
+                ln, vt = int(combo >> 8), int(combo & 0xff)
+                if ln == 0:
+                    val = decode_value(vt, b'')
+                    for ri in grp.tolist():
+                        decoded_cache[ri] = val
+                    continue
+                mat = vb[voff_all[grp][:, None] + np.arange(ln)[None, :]]
+                uq, inv = np.unique(mat, axis=0, return_inverse=True)
+                uvals = [decode_value((ln << 4) | vt, uq[u].tobytes())
+                         for u in range(len(uq))]
+                inv_l = inv.tolist()
+                for j, ri in enumerate(grp.tolist()):
+                    decoded_cache[ri] = uvals[inv_l[j]]
         except Exception:
             return None
 
@@ -2639,10 +2803,8 @@ def _apply_changes_turbo(handles, per_doc_changes):
             ready[change['_change_index']] = True
 
     keep = ready[rows['doc']]
-    # Partial validation from the native rows: duplicate opIds *within* the
+    # Validation from the native rows: duplicate opIds *within* the
     # applied batch are detectable per doc without decoding op objects.
-    # (Pred well-formedness and duplicates against history are NOT checkable
-    # here — see the trust note in apply_changes_docs.)
     kept_change = rows['doc'][keep]      # native 'doc' is the change index
     kept_packed_nat = rows['packed'][keep]
     if len(kept_packed_nat):
@@ -2651,6 +2813,19 @@ def _apply_changes_turbo(handles, per_doc_changes):
         if len(np.unique(pairs)) != len(pairs):
             restore_all()
             raise ValueError('duplicate operation ID in turbo batch')
+
+    # Dangling-pred validation (map-key rows): every pred must name an op
+    # ROW on its key — in the slot's applied-op index (_op_index) or
+    # earlier in this batch — exactly the exact path's rule
+    # (op_set.py `no matching operation for pred`; the reference rejects
+    # invalid op references during the merge, new.js:1219-1220). Sequence
+    # refs/preds keep their existing envelope (unknown targets drop and
+    # flag inexact; the mirror serves). Bulk-loaded docs' indexes are
+    # incomplete, so their rows skip the check rather than false-reject —
+    # for them a dangling pred still surfaces at the next mirror rebuild.
+    _validate_turbo_preds(fleet, engines, rows, keep, seq_sel, seq_make_sel,
+                          change_doc, nat_keys, nat_actors, _MA,
+                          restore_all)
 
     # Count only causally-applied changes: queued ones are re-counted when
     # the exact path drains and flushes them later
@@ -2751,25 +2926,43 @@ def _apply_changes_turbo(handles, per_doc_changes):
     keep_seq = keep & (seq_sel | seq_make_sel)
 
     # Make ops: register the object with its engine (plus its device row
-    # for sequences) and substitute the grid value with a link table ref
+    # for sequences) and substitute the grid value with a link table ref.
+    # Fleets repeat the same objectIds across docs, so the oid string and
+    # the boxed link value (value-table interned by equality — slots
+    # share it) memoize per packed id; only the per-slot seq-row
+    # allocation and engine registration stay per doc.
     kept_vals_all = rows['value'].astype(np.int32, copy=True)
     kept_flags_all = rows['flags'].copy()
-    for ri in np.flatnonzero((make_sel | seq_make_sel) & keep):
+    _typ_lut = {7: 'text', 8: 'list', 9: 'map', 10: 'table',
+                11: 'text', 12: 'list', 13: 'map', 14: 'table'}
+    _mk_memo = {}    # packed -> (oid, typ, boxed link value)
+    for ri in np.flatnonzero((make_sel | seq_make_sel) & keep).tolist():
         p = int(rows['packed'][ri])
-        oid = f'{p >> 8}@{nat_actors[p & (_MA - 1)]}'
-        d = change_doc[int(rows['doc'][ri])]
         mk = int(rows['flags'][ri])
-        typ = {7: 'text', 8: 'list', 9: 'map', 10: 'table',
-               11: 'text', 12: 'list', 13: 'map', 14: 'table'}[mk]
+        memo = _mk_memo.get(p)
+        if memo is None:
+            oid = f'{p >> 8}@{nat_actors[p & (_MA - 1)]}'
+            typ = _typ_lut[mk]
+            if typ in ('text', 'list'):
+                boxed = fleet._intern_value_boxed(_SeqLink(oid))
+            else:
+                boxed = fleet._intern_value_boxed(_MapLink(oid, typ))
+            memo = (oid, typ, boxed)
+            _mk_memo[p] = memo
+        oid, typ, boxed = memo
+        d = change_doc[int(rows['doc'][ri])]
         if typ in ('text', 'list'):
             engines[d].seq_objects[oid] = typ
+            slot = engines[d].slot
+            if oid not in fleet.slot_seq.get(slot, {}):
+                fleet._alloc_seq_row(slot, oid, typ)
         else:
             engines[d].map_objects[oid] = typ
         # kept_vals_all carries the boxed link for BOTH make kinds; makes
         # inside sequences (mk >= 11) keep their wire insert bit in
         # rows['value'] and route to the seq dispatch, while map-key makes
         # become grid/register cell rows (flag 1)
-        kept_vals_all[ri] = fleet._make_link_value(engines[d].slot, oid, typ)
+        kept_vals_all[ri] = boxed
         if mk <= 10:
             kept_flags_all[ri] = 1
     if fleet.exact_device:
@@ -2781,21 +2974,33 @@ def _apply_changes_turbo(handles, per_doc_changes):
         _tags = typed_wire_tags()
         typed_sel = keep & (rows['flags'] == 1) & (rows['value'] != -1) & \
             (vlen_all == 0) & np.isin(rows['vtype'], list(_tags))
-        for ri in np.flatnonzero(typed_sel):
-            kept_vals_all[ri] = fleet._intern_typed(
-                int(rows['value'][ri]), _tags[int(rows['vtype'][ri])])
+        typed_memo = {}
+        for ri in np.flatnonzero(typed_sel).tolist():
+            tk = (int(rows['value'][ri]), int(rows['vtype'][ri]))
+            vid = typed_memo.get(tk)
+            if vid is None:
+                vid = fleet._intern_typed(tk[0], _tags[tk[1]])
+                typed_memo[tk] = vid
+            kept_vals_all[ri] = vid
     # arena-boxed map-cell payloads (strings/bools/None/floats/bytes,
     # out-of-lane ints): decode and intern by the shared rule (exact mode
-    # keeps TypedValue datatypes; the LWW grid boxes raw)
+    # keeps TypedValue datatypes; the LWW grid boxes raw). Equal payloads
+    # share one decoded dict (see decoded_cache), so interning memoizes
+    # by object identity — one table walk per distinct value per batch.
     boxed_sel = keep & (rows['flags'] == 1) & (rows['value'] != -1) & \
         ((vlen_all > 0) | np.isin(rows['vtype'], (0, 1, 2)))
-    for ri in np.flatnonzero(boxed_sel):
-        decoded = decoded_cache[int(ri)]
-        if fleet.exact_device:
-            kept_vals_all[ri] = fleet._intern_typed(
-                decoded['value'], decoded.get('datatype'))
-        else:
-            kept_vals_all[ri] = fleet._intern_value(decoded['value'])
+    intern_memo = {}
+    for ri in np.flatnonzero(boxed_sel).tolist():
+        decoded = decoded_cache[ri]
+        vid = intern_memo.get(id(decoded))
+        if vid is None:
+            if fleet.exact_device:
+                vid = fleet._intern_typed(decoded['value'],
+                                          decoded.get('datatype'))
+            else:
+                vid = fleet._intern_value(decoded['value'])
+            intern_memo[id(decoded)] = vid
+        kept_vals_all[ri] = vid
 
     def dispatch_seq_rows():
         """Kept sequence rows -> one SeqState dispatch (fleet numbering)."""
@@ -2838,14 +3043,20 @@ def _apply_changes_turbo(handles, per_doc_changes):
                 pred_lanes[has, d] = remap_ids(
                     pred_col[off_seq[has] + d].astype(np.int64))
         pred_overflow = counts_seq > D
-        # resolve device rows per unique (doc, objectId)
-        pair = np.stack([sdoc, sobj], axis=1)
-        uniq, inv = np.unique(pair, axis=0, return_inverse=True)
+        # resolve device rows per unique (doc, objectId) — packed into one
+        # int64 so the unique is a 1D sort, not np.unique(axis=0)'s
+        # void-view compare (doc < 2^31, packed objectId < 2^31)
+        combo = (sdoc << 32) | sobj
+        uniq, inv = np.unique(combo, return_inverse=True)
         urow = np.empty(len(uniq), dtype=np.int64)
-        for i, (d, obj_nat) in enumerate(uniq):
-            oid = f'{int(obj_nat) >> 8}' \
-                  f'@{nat_actors[int(obj_nat) & (_MA - 1)]}'
-            urow[i] = fleet.slot_seq[int(slot_of_doc[int(d)])][oid]
+        oid_memo = {}
+        for i, cv in enumerate(uniq.tolist()):
+            d, obj_nat = cv >> 32, cv & 0xffffffff
+            oid = oid_memo.get(obj_nat)
+            if oid is None:
+                oid = f'{obj_nat >> 8}@{nat_actors[obj_nat & (_MA - 1)]}'
+                oid_memo[obj_nat] = oid
+            urow[i] = fleet.slot_seq[int(slot_of_doc[d])][oid]
         srow = urow[inv]
         kind_lut = np.zeros(15, dtype=np.int64)
         kind_lut[3], kind_lut[4] = INSERT, SET
@@ -2874,17 +3085,24 @@ def _apply_changes_turbo(handles, per_doc_changes):
         tag_names = {3: 'uint', 4: 'int', 8: 'counter', 9: 'timestamp'}
         inline_ok = (svlen == 0) & np.where(txt, svtype == 6, svtype == 4)
         rebox = np.flatnonzero(val_op & ~hflag & ~inline_ok)
-        for i in rebox:
+        seq_memo = {}
+        for i in rebox.tolist():
             ln, vt = int(svlen[i]), int(svtype[i])
             if ln > 0 or vt in (0, 1, 2):
                 decoded = decoded_cache[int(seq_ri[i])]  # pre-validated
+                mk = (id(decoded), bool(txt[i]))
             else:
                 decoded = {'value': int(svalue[i]),
                            'datatype': tag_names.get(vt)}
-            svalue[i] = fleet._intern_seq_value(
-                'text' if txt[i] else 'list',
-                {'value': decoded['value'],
-                 'datatype': decoded.get('datatype')})
+                mk = (decoded['value'], decoded['datatype'], bool(txt[i]))
+            vid = seq_memo.get(mk)
+            if vid is None:
+                vid = fleet._intern_seq_value(
+                    'text' if txt[i] else 'list',
+                    {'value': decoded['value'],
+                     'datatype': decoded.get('datatype')})
+                seq_memo[mk] = vid
+            svalue[i] = vid
         fleet._dispatch_seq(np.stack(
             [srow, skind, sref, spacked, svalue,
              *(pred_lanes[:, d] for d in range(D)),
@@ -2903,6 +3121,13 @@ def _apply_changes_turbo(handles, per_doc_changes):
     ctr = kept_packed_root >> 8
     actor = actor_map[kept_packed_root & (_MA - 1)]
     packed = (ctr << 8) | actor
+    # Feed the dangling-pred oracle: kept map-key rows that create op
+    # rows (sets incl. makes folded to flags 1 with non-TOMBSTONE
+    # values, and incs — never dels)
+    _f = kept_flags_all[keep_root]
+    _v = kept_vals_all[keep_root]
+    _idx_sel = ((_f == 1) & (_v != TOMBSTONE)) | (_f == 2)
+    fleet._index_ops(slots[_idx_sel], key[_idx_sel], packed[_idx_sel])
 
     if fleet.exact_device:
         from .registers import (apply_register_batch_donated,
@@ -2978,25 +3203,18 @@ def _apply_changes_turbo(handles, per_doc_changes):
         counts_root = pred_counts[keep_root]
         off_root = rows['pred_off'][:-1][keep_root]
         if del_sel.any():
-            dcounts = counts_root[del_sel]
-            kill_doc = np.repeat(slots[del_sel].astype(np.int64), dcounts)
-            kill_key_f = np.repeat(key[del_sel].astype(np.int64), dcounts)
-            # same np.repeat-based pred-run selection as ingest.py: del
-            # rows' pred runs are contiguous in pred_off order. Build the
+            from .ingest import build_kill_lanes, layout_doc_rows
             # full-batch del mask (keep_root-aligned del_sel scattered
-            # back) and repeat it over every op's pred count.
+            # back) selects the del rows' pred runs out of the
+            # full-batch pred_off layout
             del_all = np.zeros(len(pred_counts), dtype=bool)
             del_all[np.flatnonzero(keep_root)[del_sel]] = True
-            praw = rows['pred'][np.repeat(del_all, pred_counts)]
-            pactor = actor_map[praw & (_MA - 1)]
-            bad_k = (praw != 0) & (pactor < 0)
-            if bad_k.any():
-                for s in np.unique(kill_doc[bad_k]):
-                    fleet.grid_overflow.add(int(s))
-            kill_packed_f = np.where(
-                (praw != 0) & (pactor >= 0),
-                (praw >> 8 << 8) | pactor, 0).astype(np.int32)
-            from .ingest import layout_doc_rows
+            kill_doc, kill_key_f, kill_packed_f = build_kill_lanes(
+                slots[del_sel].astype(np.int64),
+                key[del_sel].astype(np.int64), counts_root[del_sel],
+                rows['pred'][np.repeat(del_all, pred_counts)], actor_map,
+                on_bad_actor=lambda ds: fleet.grid_overflow.update(
+                    int(s) for s in ds))
             (kk_arr, kp_arr), _ = layout_doc_rows(
                 kill_doc, n_slots, (kill_key_f, kill_packed_f),
                 (np.int32, np.int32))
@@ -3025,6 +3243,100 @@ def _apply_changes_turbo(handles, per_doc_changes):
     dispatch_seq_rows()
     fleet.metrics.device_ops += int(keep.sum())
     return result
+
+
+def _validate_turbo_preds(fleet, engines, rows, keep, seq_sel, seq_make_sel,
+                          change_doc, nat_keys, nat_actors, _MA,
+                          restore_all):
+    """Reject kept map-key rows whose preds name no existing op row —
+    the turbo-path equivalent of op_set.py's per-op pred check. A pred
+    exists iff it is (a) an earlier kept non-del map-key row of the same
+    (doc, object, key) in THIS batch (ops arrive causally, so a valid
+    pred's packed id is strictly below its op's), or (b) in the slot's
+    standing applied-op index. Raises ValueError (after restore_all)
+    with the exact path's message on the first dangling pred. The fast
+    path — no preds, or every pred resolved batch-internally — is fully
+    vectorized; only genuinely-missing candidates take the per-pred
+    standing-index walk (they either resolve via the index or raise)."""
+    pc = np.diff(rows['pred_off'])
+    root_rows = keep & ~seq_sel & ~seq_make_sel
+    check_rows = root_rows & (pc > 0)
+    if not check_rows.any():
+        return
+    row_doc = change_doc[rows['doc']]
+    slot_arr = np.fromiter((e.slot for e in engines), dtype=np.int64,
+                           count=len(engines))
+    if fleet._op_index_incomplete:
+        inc = np.fromiter(
+            (s in fleet._op_index_incomplete for s in slot_arr),
+            dtype=bool, count=len(slot_arr))
+        check_rows &= ~inc[row_doc]
+        if not check_rows.any():
+            return
+    # Batch-internal pred targets: kept, non-seq, non-del rows (dels have
+    # no rows in the reference representation; incs and makes do). Dense
+    # collision-free ids for (doc, obj, key) triples via np.unique.
+    tgt = root_rows & ~((rows['flags'] == 1) & (rows['value'] == TOMBSTONE))
+    _uq, inv = np.unique(
+        np.stack([row_doc, rows['obj'].astype(np.int64),
+                  rows['key'].astype(np.int64)], axis=1),
+        axis=0, return_inverse=True)
+    inv = inv.astype(np.int64)
+    tgt_combo = np.sort(inv[tgt] * (1 << 32) + rows['packed'][tgt])
+    # Pred entries of the rows under check
+    entry_sel = np.repeat(check_rows, pc)
+    pred_nat = rows['pred'][entry_sel].astype(np.int64)
+    owner = np.repeat(np.arange(len(pc)), pc)[entry_sel]
+    pred_combo = inv[owner] * (1 << 32) + pred_nat
+    in_batch = np.zeros(len(pred_nat), dtype=bool)
+    if len(tgt_combo):
+        pos = np.clip(np.searchsorted(tgt_combo, pred_combo), 0,
+                      len(tgt_combo) - 1)
+        in_batch = (tgt_combo[pos] == pred_combo) & \
+            (pred_nat < rows['packed'][owner])
+    missing = (pred_nat > 0) & ~in_batch
+    if not missing.any():
+        return
+    # Lazily-pending earlier changes haven't fed the index yet: land
+    # them before consulting it (they were already accepted — flushing
+    # here mutates only fleet device state, never the engines' causal
+    # state that restore_all guards)
+    if fleet.pending:
+        fleet.flush()
+    # Standing-index check for the remainder, in fleet numbering (reads
+    # only — unknown actors/keys simply have no standing ops)
+    amap = np.array([fleet.actors.index.get(a, -1) for a in nat_actors],
+                    dtype=np.int64) if nat_actors else np.zeros(1, np.int64)
+
+    def raise_dangling(p):
+        restore_all()
+        pred = f'{p >> 8}@{nat_actors[p & (_MA - 1)]}'
+        raise ValueError(f'no matching operation for pred: {pred}')
+
+    key_cache = {}
+    for i in np.flatnonzero(missing):
+        p = int(pred_nat[i])
+        pa = int(amap[p & (_MA - 1)])
+        if pa < 0:
+            raise_dangling(p)
+        o = int(rows['obj'][owner[i]])
+        kn = int(rows['key'][owner[i]])
+        fk = key_cache.get((o, kn), -2)
+        if fk == -2:
+            ks = nat_keys[kn]
+            if o == 0:
+                fk = fleet.keys.index.get(ks)
+            else:
+                oid = f'{o >> 8}@{nat_actors[o & (_MA - 1)]}'
+                fk = fleet.keys.index.get((oid, ks))
+            key_cache[(o, kn)] = fk
+        if fk is None:
+            raise_dangling(p)
+        pf = (p >> 8 << 8) | pa
+        slot = int(slot_arr[int(row_doc[owner[i]])])
+        if not bool(fleet._index_lookup(
+                slot, np.array([(fk << 32) | pf], dtype=np.int64))[0]):
+            raise_dangling(p)
 
 
 def _max_pred_per_inc(pred_col, offs, counts, actor_map):

@@ -22,16 +22,26 @@ NUM_PROBES = 7
 def hashes_to_words(hashes_hex):
     """Convert a list of hash lists (hex strings) into an [N, H, 3] uint32
     array of the first three little-endian words of each hash, padded with
-    an all-ones sentinel row mask. Returns (words, valid_mask)."""
+    an all-ones sentinel row mask. Returns (words, valid_mask).
+
+    One C-level hex decode + reshape for the whole fleet instead of a
+    per-hash fromhex/frombuffer pair (this fed every Bloom build)."""
     n = len(hashes_hex)
-    h = max((len(row) for row in hashes_hex), default=0)
+    counts = np.fromiter(map(len, hashes_hex), dtype=np.int64, count=n)
+    h = int(counts.max()) if n else 0
     words = np.zeros((n, max(h, 1), 3), dtype=np.uint32)
     valid = np.zeros((n, max(h, 1)), dtype=bool)
-    for i, row in enumerate(hashes_hex):
-        for j, hash in enumerate(row):
-            raw = bytes.fromhex(hash)[:12]
-            words[i, j] = np.frombuffer(raw, dtype='<u4')
-            valid[i, j] = True
+    total = int(counts.sum())
+    if total:
+        raw = np.frombuffer(
+            bytes.fromhex(''.join(h for row in hashes_hex for h in row)),
+            dtype=np.uint8).reshape(total, 32)
+        w3 = raw[:, :12].copy().view('<u4').reshape(total, 3)
+        rows = np.repeat(np.arange(n), counts)
+        starts = np.cumsum(counts) - counts
+        cols = np.arange(total) - starts[rows]
+        words[rows, cols] = w3
+        valid[rows, cols] = True
     return words, valid
 
 
@@ -94,14 +104,16 @@ def bloom_filter_bytes(bits_row, num_entries):
             f'filter row has {bits_row.shape[-1]} bits but num_entries='
             f'{num_entries} requires {num_filter_bits(num_entries)}; '
             f'serialize only rows built with matching sizing')
-    encoder = Encoder()
-    encoder.append_uint32(num_entries)
-    encoder.append_uint32(BITS_PER_ENTRY)
-    encoder.append_uint32(NUM_PROBES)
+    # direct uleb bytes (the Encoder round-trip showed up at fleet scale)
+    from ..backend.sync import _uleb
+    out = bytearray()
+    _uleb(out, num_entries)
+    out.append(BITS_PER_ENTRY)
+    out.append(NUM_PROBES)
     n_bytes = (num_entries * BITS_PER_ENTRY + 7) // 8
     packed = np.packbits(bits_row, bitorder='little')[:n_bytes]
-    encoder.append_raw_bytes(packed.tobytes())
-    return encoder.buffer
+    out += packed.tobytes()
+    return bytes(out)
 
 
 # ---- Variable-size batching -----------------------------------------------

@@ -142,8 +142,32 @@ def layout_doc_rows(doc, n_docs, cols, dtypes):
     return out, (order, doc_sorted, pos)
 
 
+def build_kill_lanes(del_doc, del_key, del_pred_counts, praw, actor_map,
+                     on_bad_actor=None):
+    """Shared delete kill-lane construction (used by the native flush and
+    the turbo path): expand per-del (doc, key) rows over their pred runs
+    into flat (kill_doc, kill_key, kill_packed) lanes with pred actor
+    bits remapped to fleet numbering. `praw` is the concatenated native
+    pred entries of the del rows, aligned with del_pred_counts. Preds
+    naming an actor outside actor_map (< 0 after remap) pack as 0
+    (inert) and report via `on_bad_actor(doc_ids)`."""
+    kill_doc = np.repeat(del_doc, del_pred_counts)
+    kill_key = np.repeat(del_key, del_pred_counts)
+    if not len(praw):
+        return kill_doc, kill_key, np.zeros(0, dtype=np.int32)
+    pactor = actor_map[praw & 0xff]
+    bad = (praw != 0) & (pactor < 0)
+    if bad.any() and on_bad_actor is not None:
+        on_bad_actor(np.unique(kill_doc[bad]))
+    kill_packed = np.where(
+        (praw != 0) & (pactor >= 0),
+        (praw >> 8 << 8) | pactor, 0).astype(np.int32)
+    return kill_doc, kill_key, kill_packed
+
+
 def changes_to_op_batch_native(per_doc_changes, key_interner, actor_interner,
-                               hazard_out=None, kills_out=None):
+                               hazard_out=None, kills_out=None,
+                               index_out=None):
     """Fast path: the whole parse + dictionary-encode runs in C++
     (native.ingest_changes), and the flat op rows scatter into OpBatch
     tensors with vectorized numpy. Returns None if any change falls outside
@@ -155,6 +179,11 @@ def changes_to_op_batch_native(per_doc_changes, key_interner, actor_interner,
     is appended — the feed for DocFleet._note_grid_batch's mirror advance
     and counter-attribution check (inc_pred is the Lamport-max pred, the
     reference's attribution target; -1 when absent or unresolvable).
+
+    When `index_out` is a list, one (doc, key, packed) triple of flat
+    arrays covering every map-key op ROW (sets and incs — never dels) is
+    appended, in fleet numbering — the feed for the turbo path's
+    dangling-pred oracle (DocFleet._index_ops).
 
     When `kills_out` is a list, delete ops take the reference's
     pred-scoped semantics (new.js:1204-1217): del rows are EXCLUDED from
@@ -190,25 +219,29 @@ def changes_to_op_batch_native(per_doc_changes, key_interner, actor_interner,
     actor = actor_map[rows['packed'] & 0xff] if len(actors) else 0
     packed = (ctr << 8) | actor
     flags_flat = rows['flags']
+    # Dels are identifiable whenever either consumer needs them, but the
+    # set-lane exclusion is gated on kills_out ALONE: without kill lanes
+    # the legacy tombstone-scatter representation must stay intact, or
+    # deletes would silently become no-ops (index_out never changes
+    # device semantics — it only filters what gets indexed).
     del_sel = np.zeros(len(doc), dtype=bool)
     kill_doc = kill_key = kill_packed = np.zeros(0, dtype=np.int64)
-    if kills_out is not None:
+    if kills_out is not None or index_out is not None:
         del_sel = (flags_flat == 1) & (rows['value'] == TOMBSTONE)
-        if del_sel.any():
-            pred_counts_all = np.diff(rows['pred_off'])
-            dcounts = pred_counts_all[del_sel]
-            kill_doc = np.repeat(doc[del_sel], dcounts)
-            kill_key = np.repeat(key[del_sel], dcounts)
-            entry_sel = np.repeat(del_sel, pred_counts_all)
-            praw = rows['pred'][entry_sel]
-            kill_packed = np.where(
-                praw != 0,
-                (praw >> 8 << 8) | actor_map[praw & 0xff],
-                0).astype(np.int32) if len(praw) else praw
-            (kk_arr, kp_arr), _ = layout_doc_rows(
-                kill_doc, n_docs, (kill_key, kill_packed),
-                (np.int32, np.int32))
-            kills_out.append((kk_arr, kp_arr))
+    if kills_out is not None and del_sel.any():
+        pred_counts_all = np.diff(rows['pred_off'])
+        kill_doc, kill_key, kill_packed = build_kill_lanes(
+            doc[del_sel], key[del_sel], pred_counts_all[del_sel],
+            rows['pred'][np.repeat(del_sel, pred_counts_all)], actor_map)
+        (kk_arr, kp_arr), _ = layout_doc_rows(
+            kill_doc, n_docs, (kill_key, kill_packed),
+            (np.int32, np.int32))
+        kills_out.append((kk_arr, kp_arr))
+    del_for_sets = del_sel if kills_out is not None else \
+        np.zeros(len(doc), dtype=bool)
+    if index_out is not None:
+        row_sel = ((flags_flat == 1) & ~del_sel) | (flags_flat == 2)
+        index_out.append((doc[row_sel], key[row_sel], packed[row_sel]))
     if hazard_out is not None:
         from .backend import _max_pred_per_inc
         set_sel = (flags_flat == 1) & ~del_sel
@@ -230,7 +263,7 @@ def changes_to_op_batch_native(per_doc_changes, key_interner, actor_interner,
     is_inc = np.zeros(key_id.shape, dtype=bool)
     valid = np.zeros(key_id.shape, dtype=bool)
     flags = flags_flat[order]
-    is_set[doc_sorted, pos] = (flags == 1) & ~del_sel[order]
+    is_set[doc_sorted, pos] = (flags == 1) & ~del_for_sets[order]
     is_inc[doc_sorted, pos] = flags == 2
     valid[doc_sorted, pos] = True
     return OpBatch(key_id, packed_arr, value, is_set, is_inc, valid)

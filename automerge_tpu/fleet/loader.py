@@ -246,6 +246,10 @@ def _install_parsed(fleet, out, native_idx, chunks, handles, fleet_backend):
         d = int(d)
         eng = _FlatEngine(fleet, fleet.alloc_slot())
         slot_of[d] = eng.slot
+        # Bulk-loaded history bypasses the applied-op index, so the
+        # turbo dangling-pred check must not run for this slot (it
+        # would false-reject valid preds against the loaded ops)
+        fleet._op_index_incomplete.add(eng.slot)
         a0, a1 = int(out['actor_off'][d]), int(out['actor_off'][d + 1])
         eng.actor_ids = [fleet.actors.actors[int(amap[g])]
                          for g in out['doc_actors'][a0:a1]]

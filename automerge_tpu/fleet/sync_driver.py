@@ -23,7 +23,7 @@ positives are unchanged — graph traversal stays host-side (SURVEY.md §2.11).
 """
 
 from ..backend import (
-    get_heads, get_missing_deps, get_changes, get_change_by_hash,
+    get_heads, get_missing_deps, get_change_by_hash, get_change_hashes,
 )
 from ..backend.sync import (
     _cached_meta, advance_heads, changes_to_send_finish,
@@ -51,9 +51,8 @@ def generate_sync_messages_docs(backends, sync_states):
     for i, (backend, state) in enumerate(zip(backends, sync_states)):
         their_heads = state['theirHeads']
         if their_heads is None or all(h in their_heads for h in our_need[i]):
-            new_changes = get_changes(backend, state['sharedHeads'])
-            bloom_hash_lists[i] = [_cached_meta(c)['hash']
-                                   for c in new_changes]
+            bloom_hash_lists[i] = get_change_hashes(
+                backend, state['sharedHeads'])
     built = build_bloom_filters_batch(
         [row if row is not None else [] for row in bloom_hash_lists])
     our_have = [[{'lastSync': s['sharedHeads'], 'bloom': built[i]}]

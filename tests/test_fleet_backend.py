@@ -1050,9 +1050,12 @@ class TestAdviceRegressions:
         reloaded = fb.load(fleet_backend.save(handles[0]))
         assert fleet_backend.get_heads(reloaded) == [h2]
 
-    def test_turbo_unknown_pred_actor_flags_inexact(self):
-        """A pred naming an actor the fleet never registered must flag the
-        doc inexact, not renumber to actor 0 and kill its register."""
+    def test_turbo_unknown_pred_actor_raises(self):
+        """A pred naming an actor the fleet never registered is a
+        dangling pred: turbo now rejects it at apply time with the exact
+        path's error (round 5 — it used to defer to the next mirror
+        rebuild via an inexact flag), and actor 0's register survives
+        untouched via rollback."""
         fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=4,
                                    exact_device=True))
         gb = fb.init()
@@ -1061,22 +1064,23 @@ class TestAdviceRegressions:
              'datatype': 'int', 'pred': []}])
         handles, _ = fleet_backend.apply_changes_docs([gb], [[c1]],
                                                       mirror=False)
-        # actor 'cc…' never authored a change with this fleet; '1@cc…'
-        # dangles. Exact path rejects it; turbo defers validation.
+        # actor 'cc…' never authored a change with this fleet: '1@cc…'
+        # dangles, exactly like the exact path's reject
         c2 = change_buf(ACTORS[1], 1, 1, [
             {'action': 'del', 'obj': '_root', 'key': 'x',
              'pred': [f'1@{ACTORS[2]}']}],
             deps=handles[0]['heads'])
-        handles, _ = fleet_backend.apply_changes_docs(handles, [[c2]],
-                                                      mirror=False)
+        with pytest.raises(ValueError,
+                           match='no matching operation for pred'):
+            fleet_backend.apply_changes_docs(handles, [[c2]], mirror=False)
         fleet = fb.fleet
         fleet.flush()
         slot = handles[0]['state']._impl.slot
-        assert slot in fleet.inexact_slots()
         # actor 0's register for key 'x' must NOT have been killed
         kx = fleet.keys.index['x']
         a0 = fleet.actors.index[ACTORS[0]]
         assert not bool(np.asarray(fleet.reg_state.killed)[slot, kx, a0])
+        assert fleet_backend.materialize_docs(handles) == [{'x': 7}]
 
     def test_null_value_survives_register_materialize(self):
         """A key legitimately set to null must appear (as None) in
@@ -2562,3 +2566,103 @@ class TestDeleteChains:
         want = self._host([[cA, cC], [set2, del2], [del3]])
         got = fleet_backend.materialize_docs(handles)
         assert got == [want] == [{'k': 9}], f'mirror={mirror}: {got}'
+
+
+class TestTurboDanglingPreds:
+    """Round-5 VERDICT item 4: the turbo path rejects dangling preds at
+    apply time with the exact path's error and full rollback, instead of
+    deferring detection to the next mirror rebuild."""
+
+    def _setup_turbo(self, exact=False):
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=8,
+                                   exact_device=exact))
+        handles = fleet_backend.init_docs(1, fb.fleet)
+        setup = change_buf(ACTORS[0], 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        handles, _ = fleet_backend.apply_changes_docs(handles, [[setup]],
+                                                      mirror=False)
+        return fb, handles
+
+    @pytest.mark.parametrize('exact', [False, True])
+    def test_dangling_pred_raises_and_rolls_back(self, exact):
+        fb, handles = self._setup_turbo(exact)
+        heads = handles[0]['heads']
+        bad = change_buf(ACTORS[0], 2, 2, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 9,
+             'datatype': 'int', 'pred': [f'9@{ACTORS[1]}']}], deps=heads)
+        with pytest.raises(ValueError,
+                           match='no matching operation for pred'):
+            fleet_backend.apply_changes_docs(handles, [[bad]], mirror=False)
+        # state unchanged, handle still live
+        assert handles[0]['state'].heads == heads
+        assert fleet_backend.materialize_docs(handles) == [{'k': 1}]
+
+    @pytest.mark.parametrize('exact', [False, True])
+    def test_dangling_inc_pred_raises(self, exact):
+        fb, handles = self._setup_turbo(exact)
+        bad = change_buf(ACTORS[0], 2, 2, [
+            {'action': 'inc', 'obj': '_root', 'key': 'k', 'value': 1,
+             'pred': [f'7@{ACTORS[0]}']}], deps=handles[0]['heads'])
+        with pytest.raises(ValueError,
+                           match='no matching operation for pred'):
+            fleet_backend.apply_changes_docs(handles, [[bad]], mirror=False)
+
+    def test_valid_preds_still_apply(self):
+        """Overwrites pred'ing standing ops, batch-internal preds, and
+        preds resolved via the op index across separate turbo calls."""
+        from automerge_tpu.columnar import decode_change_meta
+        fb, handles = self._setup_turbo()
+        c2 = change_buf(ACTORS[0], 2, 2, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 2,
+             'datatype': 'int', 'pred': [f'1@{ACTORS[0]}']}],
+            deps=handles[0]['heads'])
+        h2 = decode_change_meta(c2, True)['hash']
+        c3 = change_buf(ACTORS[0], 3, 3, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 3,
+             'datatype': 'int', 'pred': [f'2@{ACTORS[0]}']}], deps=[h2])
+        # same batch (batch-internal pred) ...
+        handles, _ = fleet_backend.apply_changes_docs(handles, [[c2, c3]],
+                                                      mirror=False)
+        assert fleet_backend.materialize_docs(handles) == [{'k': 3}]
+        # ... and across calls (standing-index pred)
+        c4 = change_buf(ACTORS[0], 4, 4, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 4,
+             'datatype': 'int', 'pred': [f'3@{ACTORS[0]}']}],
+            deps=handles[0]['heads'])
+        handles, _ = fleet_backend.apply_changes_docs(handles, [[c4]],
+                                                      mirror=False)
+        assert fleet_backend.materialize_docs(handles) == [{'k': 4}]
+
+    def test_mixed_exact_then_turbo_pred_resolves(self):
+        """Ops applied via the EXACT path must be visible to the turbo
+        pred check (index fed from every ingest path)."""
+        fb, handles = self._setup_turbo()
+        c2 = change_buf(ACTORS[1], 1, 2, [
+            {'action': 'set', 'obj': '_root', 'key': 'm', 'value': 5,
+             'datatype': 'int', 'pred': []}], deps=handles[0]['heads'])
+        handles, _ = fleet_backend.apply_changes_docs(handles, [[c2]],
+                                                      mirror=True)
+        c3 = change_buf(ACTORS[1], 2, 3, [
+            {'action': 'set', 'obj': '_root', 'key': 'm', 'value': 6,
+             'datatype': 'int', 'pred': [f'2@{ACTORS[1]}']}],
+            deps=handles[0]['heads'])
+        handles, _ = fleet_backend.apply_changes_docs(handles, [[c3]],
+                                                      mirror=False)
+        assert fleet_backend.materialize_docs(handles) == [{'k': 1, 'm': 6}]
+
+    def test_loaded_docs_skip_validation(self):
+        """Bulk-loaded docs have incomplete indexes: valid preds against
+        loaded history must NOT false-reject."""
+        from automerge_tpu.fleet.loader import load_docs
+        fb, handles = self._setup_turbo()
+        data = fleet_backend.save(handles[0])
+        fresh = DocFleet(doc_capacity=2, key_capacity=8)
+        loaded = load_docs([data], fresh)
+        c2 = change_buf(ACTORS[0], 2, 2, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 2,
+             'datatype': 'int', 'pred': [f'1@{ACTORS[0]}']}],
+            deps=loaded[0]['heads'])
+        loaded, _ = fleet_backend.apply_changes_docs(loaded, [[c2]],
+                                                     mirror=False)
+        assert fleet_backend.materialize_docs(loaded) == [{'k': 2}]
