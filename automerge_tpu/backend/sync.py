@@ -11,7 +11,8 @@ The batched fleet-scale Bloom build/probe lives in automerge_tpu.fleet.bloom;
 this module is the host-side protocol driver.
 """
 
-from ..encoding import Encoder, Decoder, hex_string_to_bytes, bytes_to_hex_string
+from ..encoding import (Encoder, Decoder, hex_string_to_bytes,
+    bytes_to_hex_string, uleb_append as _uleb)
 from ..columnar import decode_change_meta
 from . import get_heads, get_missing_deps, get_change_by_hash, get_changes, \
     apply_changes
@@ -104,19 +105,6 @@ def _encode_hashes(encoder, hashes):
 def _decode_hashes(decoder):
     return [bytes_to_hex_string(decoder.read_raw_bytes(HASH_SIZE))
             for _ in range(decoder.read_uint32())]
-
-
-def _uleb(out, v):
-    if v < 0 or v > 0xffffffff:
-        raise ValueError('number out of range')
-    while True:
-        b = v & 0x7f
-        v >>= 7
-        if v:
-            out.append(b | 0x80)
-        else:
-            out.append(b)
-            return
 
 
 def _hashes_raw(out, hashes):

@@ -35,6 +35,22 @@ def _check_int(value):
         raise ValueError('value is not an integer')
 
 
+def uleb_append(out, value):
+    """Append an unsigned LEB128 to a bytearray (the allocation-free
+    counterpart of Encoder._append_uleb, shared by the sync message and
+    Bloom filter fast paths)."""
+    if value < 0 or value > 0xffffffffffffffff:
+        raise ValueError('number out of range')
+    while True:
+        b = value & 0x7f
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
 class Encoder:
     """Growable byte buffer with LEB128 append operations (ref encoding.js:57-286)."""
 

@@ -105,9 +105,9 @@ def bloom_filter_bytes(bits_row, num_entries):
             f'{num_entries} requires {num_filter_bits(num_entries)}; '
             f'serialize only rows built with matching sizing')
     # direct uleb bytes (the Encoder round-trip showed up at fleet scale)
-    from ..backend.sync import _uleb
+    from ..encoding import uleb_append
     out = bytearray()
-    _uleb(out, num_entries)
+    uleb_append(out, num_entries)
     out.append(BITS_PER_ENTRY)
     out.append(NUM_PROBES)
     n_bytes = (num_entries * BITS_PER_ENTRY + 7) // 8

@@ -2666,3 +2666,103 @@ class TestTurboDanglingPreds:
         loaded, _ = fleet_backend.apply_changes_docs(loaded, [[c2]],
                                                      mirror=False)
         assert fleet_backend.materialize_docs(loaded) == [{'k': 2}]
+
+
+class TestFleetRebuild:
+    """The donation-failure contract (fleet/apply.py): after a device
+    state loss, documents rebuild into a fresh fleet from their change
+    logs — heads, reads, and further edits identical to never losing
+    the device."""
+
+    def test_rebuild_from_logs(self):
+        from automerge_tpu.columnar import encode_change, decode_change_meta
+        fb = FleetBackend(DocFleet(doc_capacity=4, key_capacity=8))
+        handles = fleet_backend.init_docs(3, fb.fleet)
+        actor = ACTORS[0]
+        per_doc = []
+        for d in range(3):
+            c1 = change_buf(actor, 1, 1, [
+                {'action': 'set', 'obj': '_root', 'key': 'k',
+                 'value': d, 'datatype': 'int', 'pred': []}])
+            h1 = decode_change_meta(c1, True)['hash']
+            c2 = change_buf(actor, 2, 2, [
+                {'action': 'set', 'obj': '_root', 'key': 's',
+                 'value': 'x' * (d + 1), 'pred': []}], deps=[h1])
+            per_doc.append([c1, c2])
+        handles, _ = fleet_backend.apply_changes_docs(handles, per_doc,
+                                                      mirror=False)
+        want = fleet_backend.materialize_docs(handles)
+        heads = [h['heads'] for h in handles]
+        # simulate total device loss: rebuild into a FRESH fleet
+        fresh = DocFleet(doc_capacity=4, key_capacity=8)
+        rebuilt = fleet_backend.rebuild_docs(handles, fresh)
+        assert [h['heads'] for h in rebuilt] == heads
+        assert fleet_backend.materialize_docs(rebuilt) == want
+        # further edits land on the new fleet
+        c3 = change_buf(actor, 3, 3, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 99,
+             'datatype': 'int', 'pred': [f'1@{actor}']}], deps=heads[0])
+        rebuilt, _ = fleet_backend.apply_changes_docs(
+            rebuilt, [[c3], [], []], mirror=False)
+        assert fleet_backend.materialize_docs(rebuilt)[0]['k'] == 99
+
+    def test_rebuild_requeues_held_back_changes(self):
+        """Causally-premature queue entries survive the rebuild and apply
+        once their deps arrive."""
+        from automerge_tpu.columnar import encode_change, decode_change_meta
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=8))
+        handles = fleet_backend.init_docs(1, fb.fleet)
+        actor = ACTORS[0]
+        c1 = change_buf(actor, 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'a', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        h1 = decode_change_meta(c1, True)['hash']
+        c2 = change_buf(actor, 2, 2, [
+            {'action': 'set', 'obj': '_root', 'key': 'b', 'value': 2,
+             'datatype': 'int', 'pred': []}], deps=[h1])
+        h2 = decode_change_meta(c2, True)['hash']
+        c3 = change_buf(actor, 3, 3, [
+            {'action': 'set', 'obj': '_root', 'key': 'c', 'value': 3,
+             'datatype': 'int', 'pred': []}], deps=[h2])
+        # apply c1 and c3 (c3 queues: missing c2)
+        handles, _ = fleet_backend.apply_changes_docs(handles, [[c1, c3]],
+                                                      mirror=False)
+        assert fleet_backend.materialize_docs(handles) == [{'a': 1}]
+        rebuilt = fleet_backend.rebuild_docs(
+            handles, DocFleet(doc_capacity=2, key_capacity=8))
+        assert fleet_backend.materialize_docs(rebuilt) == [{'a': 1}]
+        # c2 arrives: the re-queued c3 must drain
+        rebuilt, _ = fleet_backend.apply_changes_docs(rebuilt, [[c2]],
+                                                      mirror=False)
+        assert fleet_backend.materialize_docs(rebuilt) == \
+            [{'a': 1, 'b': 2, 'c': 3}]
+
+
+class TestMakeKindMemo:
+    def test_same_opid_different_make_kinds_across_docs(self):
+        """Round-5 review find: one turbo batch where the SAME packed
+        opId is makeMap on doc A but makeText on doc B (independent docs
+        share actor numbering). Each doc must get its own object type —
+        the memo must not leak doc A's kind into doc B."""
+        actor = ACTORS[0]
+        cA = change_buf(actor, 1, 1, [
+            {'action': 'makeMap', 'obj': '_root', 'key': 'obj', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'key': 'x', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        cB = change_buf(actor, 1, 1, [
+            {'action': 'makeText', 'obj': '_root', 'key': 'obj',
+             'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': '_head',
+             'insert': True, 'value': 'h', 'pred': []}])
+        fb = FleetBackend(DocFleet(doc_capacity=4, key_capacity=8))
+        handles = fleet_backend.init_docs(2, fb.fleet)
+        handles, _ = fleet_backend.apply_changes_docs(
+            handles, [[cA], [cB]], mirror=False)
+        got = fleet_backend.materialize_docs(handles)
+        assert got[0] == {'obj': {'x': 1}}, got[0]
+        assert got[1] == {'obj': 'h'}, got[1]
+        # engine-side object registries agree with the types
+        eA = handles[0]['state']._impl
+        eB = handles[1]['state']._impl
+        assert f'1@{actor}' in eA.map_objects
+        assert f'1@{actor}' in eB.seq_objects
