@@ -2518,9 +2518,11 @@ def apply_changes_docs(handles, per_doc_changes, mirror=True):
     (the per-slot applied-op index, DocFleet._op_index, is the oracle;
     round-5, closing the old trust note). Residual envelope: sequence
     refs/preds drop-and-flag-inexact instead of raising (the mirror
-    serves those docs), bulk-loaded docs validate only from their first
-    post-load op onward, and a pred-less inc on a non-counter key
-    surfaces at the next mirror read rather than at apply."""
+    serves those docs), bulk-loaded docs skip the apply-time check for
+    the slot's lifetime (their loaded history never fed the index;
+    dangling preds there surface at the next mirror read), and a
+    pred-less inc on a non-counter key surfaces at the next mirror read
+    rather than at apply."""
     if not mirror:
         turbo = _apply_changes_turbo(handles, per_doc_changes)
         if turbo is not None:
