@@ -2661,13 +2661,10 @@ def _apply_changes_turbo(handles, per_doc_changes):
         # native turbo parser does not apply: batches that actually touch
         # such a slot take the exact path; everything else keeps turbo
         return None
-    blob = b''.join(flat_buffers)
-    buf_lens = np.fromiter(map(len, flat_buffers), dtype=np.uint64,
-                           count=n_changes)
-
-    out = native.ingest_changes(flat_buffers, list(range(n_changes)),
-                                with_meta=True, with_seq=True,
-                                blob=blob, lens=buf_lens)
+    # doc_ids=None: the zero-copy list entry (C walks the bytes objects
+    # in place — no blob join, no length array; buffer i IS doc i here)
+    out = native.ingest_changes(flat_buffers, None,
+                                with_meta=True, with_seq=True)
     if out is None:
         return None     # ops outside the fleet subset, or corrupt chunk
     rows, nat_keys, nat_actors, nmeta = out
@@ -2900,7 +2897,11 @@ def _apply_changes_turbo(handles, per_doc_changes):
     # Count only causally-applied changes: queued ones are re-counted when
     # the exact path drains and flushes them later
     fleet.metrics.changes_ingested += int(ready.sum())
-    fleet.metrics.bytes_ingested += int(buf_lens[ready].sum())
+    if ready.all():
+        fleet.metrics.bytes_ingested += sum(map(len, flat_buffers))
+    else:
+        fleet.metrics.bytes_ingested += sum(
+            len(flat_buffers[i]) for i in np.flatnonzero(ready).tolist())
 
     # Phase 2 — infallible: record logs, queues, staleness
     start_op = nmeta['startOp']

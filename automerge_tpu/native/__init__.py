@@ -22,9 +22,42 @@ _lib = None
 _load_error = None
 
 
+_pylib = None
+
+
+def _load_pydll():
+    """PyDLL handle (GIL held during calls) for the zero-copy list
+    entry; None when the .so was built without CPython headers."""
+    global _pylib
+    if _pylib is not None:
+        return _pylib if _pylib is not False else None
+    if _load() is None:
+        _pylib = False
+        return None
+    try:
+        lib = ctypes.PyDLL(_LIB_PATH)
+        lib.am_ingest_changes_list.argtypes = [ctypes.py_object,
+                                               ctypes.c_int, ctypes.c_int]
+        lib.am_ingest_changes_list.restype = ctypes.c_int64
+        _pylib = lib
+        return lib
+    except (OSError, AttributeError):
+        _pylib = False
+        return None
+
+
 def _build():
     cmd = ['g++', '-O3', '-shared', '-fPIC', '-std=c++17', _SRC, '-lz',
            '-o', _LIB_PATH]
+    # CPython headers enable the zero-copy list ingest entry
+    # (am_ingest_changes_list); codec.cpp compiles without them too
+    try:
+        import sysconfig
+        inc = sysconfig.get_paths().get('include')
+        if inc and os.path.exists(os.path.join(inc, 'Python.h')):
+            cmd.insert(1, f'-I{inc}')
+    except Exception:
+        pass
     subprocess.run(cmd, check=True, capture_output=True)
 
 
@@ -220,34 +253,56 @@ def ingest_changes(buffers, doc_ids, with_meta=False, with_seq=False,
     on nested map/table objects; the rows dict gains obj/ref/vtype columns
     (packed containing objectId — 0 = root, packed referent elemId, wire
     value-type tag); flags extend to 3=seq insert, 4=seq set, 5=seq del,
-    6=seq inc, 7=makeText, 8=makeList, 9=makeMap, 10=makeTable."""
+    6=seq inc, 7=makeText, 8=makeList, 9=makeMap, 10=makeTable.
+
+    doc_ids=None means the identity mapping (buffer i -> doc i, the
+    turbo shape) and enables the zero-copy list entry: C walks the
+    Python list's bytes objects in place — no blob join, no length
+    array, no type scan (those Python-side passes cost more than the
+    parse itself at fleet scale)."""
     lib = _load()
     if lib is None:
         return None
-    n_bufs = len(buffers)
-    if blob is None:
-        bufs = buffers if all(type(b) is bytes for b in buffers) else \
-            [bytes(b) for b in buffers]
-        blob = b''.join(bufs)
-        lens = np.fromiter(map(len, bufs), dtype=np.uint64, count=n_bufs)
-    offsets = np.zeros(n_bufs, dtype=np.uint64)
-    if n_bufs > 1:
-        np.cumsum(lens[:-1], out=offsets[1:])
-    docs = np.asarray(doc_ids, dtype=np.int32)
-    arr, ptr = _u8(blob)
     i64 = ctypes.c_int64
-    lib.am_ingest_changes.argtypes = [
-        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint64),
-        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int32),
-        ctypes.c_uint64, ctypes.c_int, ctypes.c_int]
-    lib.am_ingest_changes.restype = i64
-    n_rows = lib.am_ingest_changes(
-        ptr, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
-        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
-        docs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(buffers),
-        1 if with_meta else 0, 1 if with_seq else 0)
-    if n_rows < 0:
-        return None
+    n_rows = None
+    if doc_ids is None:
+        if blob is None:
+            plib = _load_pydll()
+            if plib is not None and type(buffers) is list:
+                # no Python-side type scan: the C entry PyBytes-checks
+                # each item and returns -2 to select the blob path
+                n_rows = plib.am_ingest_changes_list(
+                    buffers, 1 if with_meta else 0, 1 if with_seq else 0)
+                if n_rows == -2:
+                    n_rows = None    # non-bytes item: blob path below
+                elif n_rows < 0:
+                    return None
+        if n_rows is None:
+            doc_ids = list(range(len(buffers)))
+    if n_rows is None:
+        n_bufs = len(buffers)
+        if blob is None:
+            bufs = buffers if all(type(b) is bytes for b in buffers) else \
+                [bytes(b) for b in buffers]
+            blob = b''.join(bufs)
+            lens = np.fromiter(map(len, bufs), dtype=np.uint64, count=n_bufs)
+        offsets = np.zeros(n_bufs, dtype=np.uint64)
+        if n_bufs > 1:
+            np.cumsum(lens[:-1], out=offsets[1:])
+        docs = np.asarray(doc_ids, dtype=np.int32)
+        arr, ptr = _u8(blob)
+        lib.am_ingest_changes.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_int]
+        lib.am_ingest_changes.restype = i64
+        n_rows = lib.am_ingest_changes(
+            ptr, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            docs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(buffers), 1 if with_meta else 0, 1 if with_seq else 0)
+        if n_rows < 0:
+            return None
     metas = None
     preds = None
     seq_cols = None

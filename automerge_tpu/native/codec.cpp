@@ -9,6 +9,17 @@
 //
 // Exposed as a plain C ABI consumed from Python via ctypes.
 
+// Python.h must precede every standard header (it sets libc feature-test
+// macros); it is optional — without CPython headers everything except the
+// zero-copy list ingest entry still builds (platform-independent: not
+// tied to the x86 SIMD guard below).
+#if defined(__has_include)
+#if __has_include(<Python.h>)
+#define AM_HAVE_PYTHON 1
+#include <Python.h>
+#endif
+#endif
+
 #include <cstdint>
 #include <cstring>
 #include <cstdlib>
@@ -1168,77 +1179,86 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
 // am_ingest_fetch (two-phase because row count is not known in advance).
 static IngestCtx *g_ingest = nullptr;
 
+// One-op-per-change is the common bulk shape: pre-size the output
+// vectors to the batch so the hot loop never pays geometric-growth
+// memcpys over multi-MB buffers.
+static void ingest_reserve(IngestCtx &ctx, uint64_t n_changes,
+                           int with_meta, int with_seq) {
+  ctx.out_doc.reserve(n_changes);
+  ctx.out_key.reserve(n_changes);
+  ctx.out_packed.reserve(n_changes);
+  ctx.out_val.reserve(n_changes);
+  ctx.out_flags.reserve(n_changes);
+  if (with_meta) {
+    ctx.m_actor.reserve(n_changes);
+    ctx.m_seq.reserve(n_changes);
+    ctx.m_start_op.reserve(n_changes);
+    ctx.m_time.reserve(n_changes);
+    ctx.m_nops.reserve(n_changes);
+    ctx.m_hash.reserve(32 * n_changes);
+    ctx.m_deps.reserve(32 * n_changes);
+    ctx.m_deps_off.reserve(n_changes);
+    ctx.m_msg_off.reserve(n_changes);
+    ctx.out_pred_off.reserve(n_changes);
+    ctx.out_pred.reserve(n_changes);
+  }
+  if (with_seq) {
+    ctx.out_obj.reserve(n_changes);
+    ctx.out_ref.reserve(n_changes);
+    ctx.out_vtype.reserve(n_changes);
+    ctx.out_vlen.reserve(n_changes);
+  }
+}
+
+// One change chunk into the global ingest context; returns false on any
+// malformed/unsupported input (caller tears the context down).
+static bool ingest_one_chunk(IngestCtx &ctx, const uint8_t *chunk,
+                             uint64_t chunk_len, int32_t doc_id,
+                             int with_meta, int with_seq) {
+  if (chunk_len < 12) return false;
+  const uint8_t *body;
+  uint64_t body_len;
+  std::vector<uint8_t> inflated;
+  Cursor hc{chunk, chunk_len};
+  hc.skip(8);  // magic + checksum
+  uint8_t chunk_type = *hc.bytes(1);
+  uint64_t blen = hc.uleb();
+  const uint8_t *bptr = hc.bytes(blen);
+  if (hc.fail) return false;
+  if (chunk_type == 2) {  // deflated change
+    size_t cap = blen * 16 + 1024;
+    int64_t n = -1;
+    while (n < 0 && cap < (size_t(1) << 28)) {
+      inflated.resize(cap);
+      n = am_inflate_raw(bptr, blen, inflated.data(), cap);
+      if (n < 0) cap *= 4;
+    }
+    if (n < 0) return false;
+    body = inflated.data();
+    body_len = uint64_t(n);
+  } else if (chunk_type == 1) {
+    body = bptr;
+    body_len = blen;
+  } else {
+    return false;
+  }
+  // The chunk header + declared body must span the whole buffer: buffers
+  // holding concatenated chunks (split_containers territory) take the
+  // exact path, where every chunk is applied
+  if (hc.pos != chunk_len) return false;
+  return parse_change_body(ctx, body, body_len, doc_id, with_meta,
+                           with_seq, chunk + 4);
+}
+
 int64_t am_ingest_changes(const uint8_t *blob, const uint64_t *offsets,
                           const uint64_t *lens, const int32_t *doc_ids,
                           uint64_t n_changes, int with_meta, int with_seq) {
   delete g_ingest;
   g_ingest = new IngestCtx();
-  {
-    // One-op-per-change is the common bulk shape: pre-size the output
-    // vectors to the batch so the hot loop never pays geometric-growth
-    // memcpys over multi-MB buffers.
-    IngestCtx &ctx = *g_ingest;
-    ctx.out_doc.reserve(n_changes);
-    ctx.out_key.reserve(n_changes);
-    ctx.out_packed.reserve(n_changes);
-    ctx.out_val.reserve(n_changes);
-    ctx.out_flags.reserve(n_changes);
-    if (with_meta) {
-      ctx.m_actor.reserve(n_changes);
-      ctx.m_seq.reserve(n_changes);
-      ctx.m_start_op.reserve(n_changes);
-      ctx.m_time.reserve(n_changes);
-      ctx.m_nops.reserve(n_changes);
-      ctx.m_hash.reserve(32 * n_changes);
-      ctx.m_deps.reserve(32 * n_changes);
-      ctx.m_deps_off.reserve(n_changes);
-      ctx.m_msg_off.reserve(n_changes);
-      ctx.out_pred_off.reserve(n_changes);
-      ctx.out_pred.reserve(n_changes);
-    }
-    if (with_seq) {
-      ctx.out_obj.reserve(n_changes);
-      ctx.out_ref.reserve(n_changes);
-      ctx.out_vtype.reserve(n_changes);
-      ctx.out_vlen.reserve(n_changes);
-    }
-  }
+  ingest_reserve(*g_ingest, n_changes, with_meta, with_seq);
   for (uint64_t i = 0; i < n_changes; i++) {
-    const uint8_t *chunk = blob + offsets[i];
-    uint64_t chunk_len = lens[i];
-    if (chunk_len < 12) { delete g_ingest; g_ingest = nullptr; return -1; }
-    const uint8_t *body;
-    uint64_t body_len;
-    std::vector<uint8_t> inflated;
-    Cursor hc{chunk, chunk_len};
-    hc.skip(8);  // magic + checksum
-    uint8_t chunk_type = *hc.bytes(1);
-    uint64_t blen = hc.uleb();
-    const uint8_t *bptr = hc.bytes(blen);
-    if (hc.fail) { delete g_ingest; g_ingest = nullptr; return -1; }
-    if (chunk_type == 2) {  // deflated change
-      size_t cap = blen * 16 + 1024;
-      int64_t n = -1;
-      while (n < 0 && cap < (size_t(1) << 28)) {
-        inflated.resize(cap);
-        n = am_inflate_raw(bptr, blen, inflated.data(), cap);
-        if (n < 0) cap *= 4;
-      }
-      if (n < 0) { delete g_ingest; g_ingest = nullptr; return -1; }
-      body = inflated.data();
-      body_len = uint64_t(n);
-    } else if (chunk_type == 1) {
-      body = bptr;
-      body_len = blen;
-    } else {
-      delete g_ingest; g_ingest = nullptr; return -1;
-    }
-    // The chunk header + declared body must span the whole buffer: buffers
-    // holding concatenated chunks (split_containers territory) take the
-    // exact path, where every chunk is applied
-    if (hc.pos != chunk_len) { delete g_ingest; g_ingest = nullptr; return -1; }
-    if (!parse_change_body(*g_ingest, body, body_len, doc_ids[i],
-                           with_meta, with_seq, chunk + 4)) {
+    if (!ingest_one_chunk(*g_ingest, blob + offsets[i], lens[i],
+                          doc_ids[i], with_meta, with_seq)) {
       delete g_ingest;
       g_ingest = nullptr;
       return -1;
@@ -1246,6 +1266,38 @@ int64_t am_ingest_changes(const uint8_t *blob, const uint64_t *offsets,
   }
   return int64_t(g_ingest->out_doc.size());
 }
+
+#ifdef AM_HAVE_PYTHON
+// Zero-copy list ingest: walk a Python list of bytes objects directly
+// (no join into a contiguous blob, no per-buffer length array — those
+// Python-side passes cost more than the parse itself at fleet scale).
+// Each buffer's doc id is its list index (the turbo path's shape).
+// MUST be called through ctypes.PyDLL so the GIL stays held. Returns
+// -2 for a non-list / non-bytes item (caller falls back to the blob
+// entry), -1 for malformed chunks, row count otherwise.
+int64_t am_ingest_changes_list(PyObject *buffers, int with_meta,
+                               int with_seq) {
+  if (!PyList_Check(buffers)) return -2;
+  Py_ssize_t n = PyList_GET_SIZE(buffers);
+  delete g_ingest;
+  g_ingest = new IngestCtx();
+  ingest_reserve(*g_ingest, uint64_t(n), with_meta, with_seq);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *it = PyList_GET_ITEM(buffers, i);
+    if (!PyBytes_Check(it)) {
+      delete g_ingest; g_ingest = nullptr; return -2;
+    }
+    if (!ingest_one_chunk(
+            *g_ingest,
+            reinterpret_cast<const uint8_t *>(PyBytes_AS_STRING(it)),
+            uint64_t(PyBytes_GET_SIZE(it)), int32_t(i),
+            with_meta, with_seq)) {
+      delete g_ingest; g_ingest = nullptr; return -1;
+    }
+  }
+  return int64_t(g_ingest->out_doc.size());
+}
+#endif  // AM_HAVE_PYTHON
 
 // Copy results out after am_ingest_changes. key_blob receives the interned
 // keys as length-prefixed (uleb) strings; returns bytes written or -1 if cap

@@ -21,6 +21,15 @@ import time
 
 os.environ['PALLAS_AXON_POOL_IPS'] = ''
 os.environ['JAX_PLATFORMS'] = 'cpu'
+# The axon site hook may have imported jax at interpreter startup (before
+# the env overrides above), so pin the already-imported config too — the
+# same trap tests/conftest.py documents; without this the rebuild legs
+# hang trying to initialize the tunnel backend.
+try:
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+except ImportError:
+    pass
 flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (
