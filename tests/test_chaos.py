@@ -123,6 +123,12 @@ class _Universe:
             A.set_default_backend(prev)
 
 
+def fleet_handles(u):
+    """Backend handles for every replica in a fleet universe."""
+    return u.with_backend(
+        lambda: [A.frontend.get_backend_state(d, 'chaos') for d in u.docs])
+
+
 _seeds_run = [0]
 
 
@@ -158,6 +164,11 @@ def test_chaos_differential(seed):
     # renumbering: both joiners sort before every founder)
     joins = {N_STEPS * 2 // 5: JOINERS[0], N_STEPS * 3 // 5: JOINERS[1]}
     compare_every = max(10, N_STEPS // 4)
+    # Mid-run total device loss: at this step every fleet replica is
+    # rebuilt from its change logs into a FRESH DocFleet (the donation-
+    # failure contract, fleet/apply.py) and the run continues on the
+    # rebuilt state — all later compares prove the loss was invisible.
+    rebuild_at = N_STEPS // 2
 
     def conflict_views(doc):
         """Conflict sets for every root key (winners can agree while the
@@ -197,6 +208,14 @@ def test_chaos_differential(seed):
         u.docs = u.with_backend(build)
 
     for step in range(N_STEPS):
+        if step == rebuild_at:
+            for u in universes[1:]:
+                fresh = DocFleet(doc_capacity=8, key_capacity=64,
+                                 exact_device=u.backend.fleet.exact_device)
+                rebuilt = fleet_backend.rebuild_docs(fleet_handles(u), fresh)
+                for d, h in zip(u.docs, rebuilt):
+                    d._state['backendState'] = h
+                u.backend.fleet = fresh
         if step in joins:
             actor = joins[step]
             actors.append(actor)
@@ -252,9 +271,7 @@ def test_chaos_differential(seed):
     # semantics) must match the host frontend views in BOTH device modes
     host_views = [dict(d) for d in universes[0].docs]
     for u in universes[1:]:
-        handles = u.with_backend(
-            lambda u=u: [A.frontend.get_backend_state(d, 'chaos')
-                         for d in u.docs])
+        handles = fleet_handles(u)
         mats = u.with_backend(
             lambda h=handles: fleet_backend.materialize_docs(h))
         for k, (m, e) in enumerate(zip(mats, host_views)):
