@@ -706,10 +706,24 @@ class DocFleet:
         counts = np.bincount(row_a, minlength=n_rows)
         ins = np.bincount(row_a[arr[:, 1] == INSERT], minlength=n_rows)
         # Placement pass: host-tracked element counts give each row's
-        # needed capacity class without any device reads
+        # needed capacity class without any device reads. Reserve each
+        # pool's capacity ONCE for all rows landing in it this dispatch
+        # (the round-5 on-chip mixed-seam dispatch storm: per-alloc pow2
+        # growth cost 72 device copies at 500 fresh docs).
+        pools = self.seq_pools
+        lanes = self._seq_lane_width()
+        uniq_rows = [int(r) for r in np.unique(row_a)]
+        new_by_cls = {}
+        for row in uniq_rows:
+            need_cls = pools.cls_for(max(self.seq_len[row] + int(ins[row]),
+                                         1))
+            place = self.seq_place[row]
+            if place is None or need_cls > place[0]:
+                new_by_cls[need_cls] = new_by_cls.get(need_cls, 0) + 1
+        for cls, count in new_by_cls.items():
+            pools.reserve(cls, count, lanes)
         cls_of = {}
-        for row in np.unique(row_a):
-            row = int(row)
+        for row in uniq_rows:
             cls_of[row], _ = self._place_seq_row(
                 row, self.seq_len[row] + int(ins[row]))
         # One batch per active class, rows addressed by pool index

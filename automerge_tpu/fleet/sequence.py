@@ -699,6 +699,17 @@ class SeqPools:
         self._ensure(cls, idx + 1, actor_slots)
         return idx
 
+    def reserve(self, cls, count, actor_slots):
+        """Pre-size a pool for `count` upcoming alloc() calls in one
+        growth: growing inside each alloc re-pads the whole pool's arrays
+        eagerly on device per pow2 step (~log2(rows) growths of 8 arrays
+        each for a batch of fresh rows — a dispatch storm on a real TPU).
+        Reservation is capacity-only; alloc() still does the bookkeeping,
+        it just finds the pool already big enough."""
+        fresh = count - len(self.free.get(cls, ()))
+        if fresh > 0:
+            self._ensure(cls, self.used.get(cls, 0) + fresh, actor_slots)
+
     def release(self, cls, idx):
         """Zero a row and return it to its class's free list."""
         self.release_rows({cls: [idx]})

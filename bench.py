@@ -780,6 +780,18 @@ def bench_native_save(n_changes=200, seed=0):
     return median_rate(run_native, 1, reps=3), host
 
 
+def _fence():
+    """Collect cyclic garbage between bench sections. Fleets sit in
+    engine<->fleet reference cycles, so a finished section's device pools
+    and multi-million-object host heap stay live until a gen-2 collection;
+    left to chance, the NEXT section pays for them (gen-2 pauses mid-rep,
+    device memory pressure). The round-5 on-chip run measured the mixed
+    seam 10x slower inside the full suite than standalone for exactly
+    this cross-section bleed."""
+    import gc
+    gc.collect()
+
+
 def main():
     _guard_dead_accelerator()
     n_docs = int(os.environ.get('BENCH_DOCS', 10000))
@@ -802,6 +814,7 @@ def main():
     seam_rate = max(seam_rate_1, seam_rate_k)
     # Cross-round continuity: rounds 1-3 measured the seam at 2000 docs
     seam_rate_2k, _ = bench_backend_pipeline(2000, n_keys, 20)
+    _fence()
 
     # Host reference engine on the same workload shape (rate-based).
     # 500 docs x 20 changes (round-4 VERDICT weak #3): the host engine
@@ -811,39 +824,51 @@ def main():
     # the denominator honest.
     host_docs = int(os.environ.get('BENCH_HOST_DOCS', 500))
     host_rate, _ = bench_host(host_docs, n_keys, 1, 20)
+    _fence()
 
     # End-to-end text editing through the seam (config 2, honest number)
     seam_text_rate, host_text_rate = bench_backend_text(
         int(os.environ.get('BENCH_SEAM_TEXT_DOCS', 200)),
         int(os.environ.get('BENCH_SEAM_TEXT_LEN', 512)))
+    _fence()
 
     # KERNEL-ONLY numbers (device ceilings on pre-built batches — NOT
     # end-to-end; decode/hashing excluded):
     fleet_rate, _ = bench_fleet(n_docs, n_keys, rounds, ops_per_round)
+    _fence()
     pallas_rate, pallas_variant = bench_pallas_merge(n_docs, n_keys, rounds,
                                                      ops_per_round)
+    _fence()
     pipe_rate, _ = bench_pipeline(int(os.environ.get('BENCH_PIPE_DOCS', 500)),
                                   n_keys, 20)
+    _fence()
     text_rate, _ = bench_text(int(os.environ.get('BENCH_TEXT_DOCS', 2000)),
                               int(os.environ.get('BENCH_TEXT_LEN', 512)))
+    _fence()
     # Config 4: sync Bloom filters, device fleet vs per-peer host loop
     bloom_dev, bloom_host = bench_sync_bloom(
         int(os.environ.get('BENCH_BLOOM_DOCS', 10000)),
         int(os.environ.get('BENCH_BLOOM_HASHES', 32)))
+    _fence()
     # Batched sync driver: one generate round over the whole peer fleet
     syncdrv_batched, syncdrv_host = bench_sync_driver(
         int(os.environ.get('BENCH_SYNCDRV_DOCS', 10000)))
+    _fence()
     # Config 5 (stretch): Zipf-skewed change rates over a large fleet
     zipf_rate, zipf_occ = bench_zipf(
         int(os.environ.get('BENCH_ZIPF_DOCS', 100000)))
+    _fence()
     # Exact multi-value register engine (ordered scan formulation)
     reg_rate = bench_registers(int(os.environ.get('BENCH_REG_DOCS', 4000)))
+    _fence()
     # Bulk document load: native parse straight to device state vs the
     # per-doc Python decode + host replay path
     bulk_rate, perdoc_rate = bench_bulk_load(
         int(os.environ.get('BENCH_LOAD_DOCS', 2000)))
+    _fence()
     save_native, save_host = bench_native_save(
         int(os.environ.get('BENCH_SAVE_CHANGES', 200)))
+    _fence()
     mixed_rate, mixed_host = bench_backend_mixed(
         int(os.environ.get('BENCH_MIXED_DOCS', 500)))
     trace_dir = capture_trace(n_docs, n_keys, ops_per_round,

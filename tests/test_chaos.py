@@ -33,6 +33,11 @@ ALPHA = 'abcdefghijklmnop'
 # offline fuzzing (e.g. CHAOS_SEEDS=20 CHAOS_STEPS=250).
 N_SEEDS = int(os.environ.get('CHAOS_SEEDS', '5'))
 N_STEPS = int(os.environ.get('CHAOS_STEPS', '80'))
+# Offset for chunked offline doses: tools/chaos_dose.py runs the deep dose
+# as several fresh pytest processes (the accumulated XLA CPU compile cache
+# can segfault the compiler inside one long-lived process), each covering
+# seeds [BASE, BASE + N_SEEDS).
+SEED_BASE = int(os.environ.get('CHAOS_SEED_BASE', '0'))
 
 
 def _random_edit(edit_seed):
@@ -148,7 +153,7 @@ def _bounded_jit_cache():
 
 @pytest.mark.skipif(not native.available(),
                     reason='native codec unavailable')
-@pytest.mark.parametrize('seed', list(range(N_SEEDS)))
+@pytest.mark.parametrize('seed', list(range(SEED_BASE, SEED_BASE + N_SEEDS)))
 def test_chaos_differential(seed):
     rng = random.Random(seed)
     fleet_lww = DocFleet(doc_capacity=8, key_capacity=64)
