@@ -507,13 +507,22 @@ class DocFleet:
     def _seq_lane_width(self):
         return _pow2(max(len(self.actors), 4))
 
+    def _seq_need(self, row, need_len):
+        """(size class, performs-a-fresh-pool-alloc) for placing `row` at
+        need_len elements — the ONE sizing policy driving both the
+        reserve() pre-pass and _place_seq_row, so they cannot drift."""
+        need_cls = self.seq_pools.cls_for(
+            max(self.seq_len[row], need_len, 1))
+        place = self.seq_place[row]
+        return need_cls, place is None or need_cls > place[0]
+
     def _place_seq_row(self, row, need_len):
         """Ensure row has a device placement with capacity >= need_len,
         migrating up a size class when it outgrows its current one.
         Returns (cls, idx)."""
+        need_cls, _ = self._seq_need(row, need_len)
         self.seq_len[row] = max(self.seq_len[row], need_len, 1)
         pools = self.seq_pools
-        need_cls = pools.cls_for(self.seq_len[row])
         place = self.seq_place[row]
         lanes = self._seq_lane_width()
         if place is None:
@@ -715,10 +724,9 @@ class DocFleet:
         uniq_rows = [int(r) for r in np.unique(row_a)]
         new_by_cls = {}
         for row in uniq_rows:
-            need_cls = pools.cls_for(max(self.seq_len[row] + int(ins[row]),
-                                         1))
-            place = self.seq_place[row]
-            if place is None or need_cls > place[0]:
+            need_cls, fresh = self._seq_need(
+                row, self.seq_len[row] + int(ins[row]))
+            if fresh:
                 new_by_cls[need_cls] = new_by_cls.get(need_cls, 0) + 1
         for cls, count in new_by_cls.items():
             pools.reserve(cls, count, lanes)
