@@ -2846,36 +2846,45 @@ def _apply_changes_turbo(handles, per_doc_changes):
     vtype_all = rows['vtype']
     decode_sel = np.isin(flags_all, (1, 3, 4)) & (rows['value'] != -1) & \
         ((vlen_all > 0) | np.isin(vtype_all, (0, 1, 2)))
-    decoded_cache = {}
+    # Distinct-value table for this batch: decoded_vals holds one dict per
+    # DISTINCT wire payload, decoded_gid maps op rows into it (-1 = row
+    # not decoded). Fleets repeat values heavily, so downstream interning
+    # works per distinct value (vectorized scatter back to rows), never
+    # per row — the old per-row dict cache cost more than the native parse
+    # on the mixed seam.
+    decoded_vals = []
+    decoded_gid = np.full(len(flags_all), -1, dtype=np.int32)
     if decode_sel.any():
         from ..columnar import decode_value
         sel_idx = np.flatnonzero(decode_sel)
         vb = vblob if isinstance(vblob, np.ndarray) else \
             np.frombuffer(vblob, dtype=np.uint8)
         try:
-            # Group rows by (len, vtype) and dedupe payload bytes within
-            # each group, so every DISTINCT wire value decodes exactly
-            # once per batch — fleets repeat values heavily (the mixed
-            # seam spent more time in per-op decode_value than in the
-            # native parse). Equal payloads share one decoded dict, which
-            # also lets the intern loops below memoize by object id.
+            # Group rows by (len, vtype), then dedupe payload bytes within
+            # each group so every distinct value decodes exactly once.
             combos = (vlen_all[sel_idx].astype(np.int64) << 8) | \
                 vtype_all[sel_idx]
-            for combo in np.unique(combos):
-                grp = sel_idx[combos == combo]
-                ln, vt = int(combo >> 8), int(combo & 0xff)
+            corder = np.argsort(combos, kind='stable')
+            csorted = combos[corder]
+            starts = np.flatnonzero(np.r_[True, csorted[1:] != csorted[:-1]])
+            stops = np.r_[starts[1:], len(csorted)]
+            for gi in range(len(starts)):
+                combo = int(csorted[starts[gi]])
+                grp = sel_idx[corder[starts[gi]:stops[gi]]]
+                ln, vt = combo >> 8, combo & 0xff
                 if ln == 0:
-                    val = decode_value(vt, b'')
-                    for ri in grp.tolist():
-                        decoded_cache[ri] = val
+                    decoded_gid[grp] = len(decoded_vals)
+                    decoded_vals.append(decode_value(vt, b''))
                     continue
                 mat = vb[voff_all[grp][:, None] + np.arange(ln)[None, :]]
-                uq, inv = np.unique(mat, axis=0, return_inverse=True)
-                uvals = [decode_value((ln << 4) | vt, uq[u].tobytes())
-                         for u in range(len(uq))]
-                inv_l = inv.tolist()
-                for j, ri in enumerate(grp.tolist()):
-                    decoded_cache[ri] = uvals[inv_l[j]]
+                # one sort of packed rows (void view) instead of
+                # np.unique(axis=0)'s per-byte-column lexsort
+                packed_rows = np.ascontiguousarray(mat).view(
+                    np.dtype((np.void, ln))).ravel()
+                uq, inv = np.unique(packed_rows, return_inverse=True)
+                decoded_gid[grp] = len(decoded_vals) + inv
+                decoded_vals += [decode_value((ln << 4) | vt, u.tobytes())
+                                 for u in uq]
         except Exception:
             return None
 
@@ -3103,23 +3112,28 @@ def _apply_changes_turbo(handles, per_doc_changes):
             kept_vals_all[ri] = vid
     # arena-boxed map-cell payloads (strings/bools/None/floats/bytes,
     # out-of-lane ints): decode and intern by the shared rule (exact mode
-    # keeps TypedValue datatypes; the LWW grid boxes raw). Equal payloads
-    # share one decoded dict (see decoded_cache), so interning memoizes
-    # by object identity — one table walk per distinct value per batch.
+    # keeps TypedValue datatypes; the LWW grid boxes raw). One table walk
+    # per DISTINCT value per batch, scattered back to rows in one indexed
+    # assign via the decoded_gid grouping.
     boxed_sel = keep & (rows['flags'] == 1) & (rows['value'] != -1) & \
         ((vlen_all > 0) | np.isin(rows['vtype'], (0, 1, 2)))
-    intern_memo = {}
-    for ri in np.flatnonzero(boxed_sel).tolist():
-        decoded = decoded_cache[ri]
-        vid = intern_memo.get(id(decoded))
-        if vid is None:
-            if fleet.exact_device:
-                vid = fleet._intern_typed(decoded['value'],
-                                          decoded.get('datatype'))
-            else:
-                vid = fleet._intern_value(decoded['value'])
-            intern_memo[id(decoded)] = vid
-        kept_vals_all[ri] = vid
+    boxed_idx = np.flatnonzero(boxed_sel)
+    if len(boxed_idx):
+        gids = decoded_gid[boxed_idx]
+        if gids.min(initial=0) < 0:
+            # boxed_sel ⊆ decode_sel; a -1 here is a parser-contract break
+            # and must fail loudly, not index decoded_vals[-1]
+            raise AssertionError('undecoded arena payload in turbo batch')
+        uniq_g = np.unique(gids)
+        if fleet.exact_device:
+            vids = [fleet._intern_typed(decoded_vals[g]['value'],
+                                        decoded_vals[g].get('datatype'))
+                    for g in uniq_g.tolist()]
+        else:
+            vids = [fleet._intern_value(decoded_vals[g]['value'])
+                    for g in uniq_g.tolist()]
+        kept_vals_all[boxed_idx] = np.asarray(vids, dtype=np.int32)[
+            np.searchsorted(uniq_g, gids)]
 
     def dispatch_seq_rows():
         """Kept sequence rows -> one SeqState dispatch (fleet numbering)."""
@@ -3208,8 +3222,13 @@ def _apply_changes_turbo(handles, per_doc_changes):
         for i in rebox.tolist():
             ln, vt = int(svlen[i]), int(svtype[i])
             if ln > 0 or vt in (0, 1, 2):
-                decoded = decoded_cache[int(seq_ri[i])]  # pre-validated
-                mk = (id(decoded), bool(txt[i]))
+                # pre-validated: decode_sel covers every arena row here
+                gid = int(decoded_gid[int(seq_ri[i])])
+                if gid < 0:
+                    raise AssertionError(
+                        'undecoded arena payload in turbo seq batch')
+                decoded = decoded_vals[gid]
+                mk = (gid, bool(txt[i]))
             else:
                 decoded = {'value': int(svalue[i]),
                            'datatype': tag_names.get(vt)}
