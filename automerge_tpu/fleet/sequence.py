@@ -654,6 +654,8 @@ class SeqPools:
         self.pools = {}     # cls -> SeqState
         self.free = {}      # cls -> [idx, ...]
         self.used = {}      # cls -> high-water row count
+        self.grow_events = 0   # device-copy growths (reserve() keeps this
+                               # at ~1 per class per dispatch, not per row)
 
     def cls_for(self, capacity):
         c = 0
@@ -676,16 +678,22 @@ class SeqPools:
         if st is None:
             self.pools[cls] = SeqState.empty(
                 pow2, self.capacity(cls), actor_slots=actor_slots, xp=jnp)
+            self.grow_events += 1
         else:
-            self.pools[cls] = grow_seq_state(st, pow2, self.capacity(cls),
-                                             actor_slots)
+            grown = grow_seq_state(st, pow2, self.capacity(cls),
+                                   actor_slots)
+            if grown is not st:
+                self.grow_events += 1
+            self.pools[cls] = grown
         return self.pools[cls]
 
     def ensure_lanes(self, actor_slots):
         """Grow every pool's actor-lane axis (before a lane permutation)."""
         for cls in list(self.pools):
-            self.pools[cls] = grow_seq_state(
-                self.pools[cls], 0, 0, actor_slots)
+            grown = grow_seq_state(self.pools[cls], 0, 0, actor_slots)
+            if grown is not self.pools[cls]:
+                self.grow_events += 1
+            self.pools[cls] = grown
 
     def alloc(self, cls, actor_slots):
         free = self.free.setdefault(cls, [])

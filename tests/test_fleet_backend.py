@@ -2766,3 +2766,28 @@ class TestMakeKindMemo:
         eB = handles[1]['state']._impl
         assert f'1@{actor}' in eA.map_objects
         assert f'1@{actor}' in eB.seq_objects
+
+
+class TestSeqPoolReserve:
+    def test_bulk_fresh_rows_grow_each_pool_once(self):
+        """Round-5 on-chip find: placing N fresh sequence rows one alloc
+        at a time grew the size-class pool ~log2(N) times, each growth an
+        eager device re-pad of all 8 pool arrays — a dispatch storm on a
+        tunneled TPU. The reserve() pre-pass must bound growth to O(1)
+        device copies per size class per dispatch."""
+        actor = ACTORS[0]
+        n_docs = 64
+        c1 = change_buf(actor, 1, 1, [
+            {'action': 'makeList', 'obj': '_root', 'key': 'l', 'pred': []},
+            {'action': 'set', 'obj': f'1@{actor}', 'elemId': '_head',
+             'insert': True, 'value': 7, 'datatype': 'int', 'pred': []}])
+        fb = FleetBackend(DocFleet(doc_capacity=n_docs, key_capacity=8))
+        handles = fleet_backend.init_docs(n_docs, fb.fleet)
+        handles, _ = fleet_backend.apply_changes_docs(
+            handles, [[c1]] * n_docs, mirror=False)
+        pools = fb.fleet.seq_pools
+        # one class in play (all rows are 1-element lists): the initial
+        # empty() plus at most one growth — NOT ~log2(64) regrowths
+        assert pools.grow_events <= 2, pools.grow_events
+        assert fleet_backend.materialize_docs(handles) == \
+            [{'l': [7]}] * n_docs
