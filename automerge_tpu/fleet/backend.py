@@ -1752,6 +1752,28 @@ class _FlatEngine(HashGraph):
         self._changes = [encode_change(ch) for ch in decoded]
         self._doc_decoded = decoded
 
+    def _install_parked_chunk(self, chunk, n_changes):
+        """THE parked form, in one place (loader bulk-load and park_docs
+        both install it): host history collapses to the document chunk —
+        change log empty, graph dicts empty, one full-range deferred
+        record resolving through the chunk, mirrors and any previously
+        decoded history dropped. Causal state (heads/clock/max_op/
+        actor_ids) is NOT touched; callers own it."""
+        from .loader import _DocDeferredBatch
+        self._changes = []
+        self._doc_pending = chunk
+        self._doc_decoded = None
+        self.binary_doc = chunk
+        self.changes_meta = []
+        self.change_index_by_hash = {}
+        self.dependencies_by_hash = {}
+        self.dependents_by_hash = {}
+        self.hashes_by_actor = {}
+        self._deferred = [(0, _DocDeferredBatch(self), range(n_changes))] \
+            if n_changes else []
+        self.mirror = None
+        self.stale = True
+
     def _doc_resolve(self, i):
         """(hash, deps, actor, meta) for _ensure_graph over a bulk-loaded
         document's i-th change."""
@@ -2481,7 +2503,7 @@ def host_memory_stats(handles):
     (winner mirror, applied-op index, value table entry count). Device
     bytes live in DocFleet.memory_stats()."""
     log_bytes = queue_bytes = parked_bytes = 0
-    mirrors = 0
+    mirrors = decoded = 0
     fleet = None
     for handle in handles:
         state = handle.get('state')
@@ -2499,11 +2521,17 @@ def host_memory_stats(handles):
                 queue_bytes += len(buf)
         if impl.mirror is not None:
             mirrors += 1
+        if getattr(impl, '_doc_decoded', None) is not None:
+            decoded += 1
     out = {
         'change_log_bytes': log_bytes,
         'parked_doc_bytes': parked_bytes,
         'queue_bytes': queue_bytes,
         'docs_with_host_mirror': mirrors,
+        # rematerialized histories pin their decoded change dicts (larger
+        # than the binary log) until the next park_docs — visible here so
+        # the accounting cannot claim reclaim while they linger
+        'docs_with_decoded_history': decoded,
         'n_docs': len(handles),
     }
     if fleet is not None:
@@ -2514,6 +2542,51 @@ def host_memory_stats(handles):
             sum(p[1].nbytes for p in fleet._op_index_pending))
         out['value_table_entries'] = len(fleet.value_table)
     return out
+
+
+def park_docs(handles):
+    """Demote cold documents to their canonical saved chunk — the
+    loader's parked form (`_doc_pending`), made available to LIVE docs:
+    the host-side change log, deferred hash-graph records, graph dicts,
+    and read mirrors collapse into ONE compressed document chunk per doc
+    (BASELINE.md's 100k-doc host-memory plan, operational). Device state
+    is untouched and causal state (heads/clock/maxOp/actorIds) stays
+    live, so parked docs keep accepting changes through the turbo gate,
+    serving sync, and answering bulk device reads; any history read
+    rematerializes the log lazily from the chunk (the same machinery
+    bulk-loaded documents already exercise, ref new.js:1709-1749 — the
+    deferred document-chunk load).
+
+    Soundness: the chunk is decoded once at park time —
+    `decode_document` recomputes every change hash by canonical
+    re-encoding and raises unless the heads reproduce exactly
+    (columnar.py decode_document_changes), so a doc whose history cannot
+    round-trip (e.g. foreign non-canonically-encoded changes) is left
+    live rather than parked. Docs with queued changes or parked already
+    are skipped. Returns the number of docs parked."""
+    from ..columnar import decode_document
+    parked = 0
+    flushed = set()
+    for handle in handles:
+        state = handle.get('state')
+        if not isinstance(state, FleetDoc) or not state.is_fleet:
+            continue
+        impl = state._impl
+        fleet = impl.fleet
+        if id(fleet) not in flushed:
+            fleet.flush()
+            flushed.add(id(fleet))
+        if impl.queue or impl._doc_pending is not None or \
+                not impl.changes:
+            continue
+        chunk = bytes(impl.save())
+        try:
+            n = len(decode_document(chunk))
+        except Exception:
+            continue          # cannot round-trip: stays live
+        impl._install_parked_chunk(chunk, n)
+        parked += 1
+    return parked
 
 
 def rebuild_docs(handles, fleet=None, mirror=False):

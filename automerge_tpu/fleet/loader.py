@@ -257,14 +257,8 @@ def _install_parsed(fleet, out, native_idx, chunks, handles, fleet_backend):
         eng.heads = sorted(out['heads'][h].tobytes().hex()
                            for h in range(h0, h1))
         eng.max_op = int(out['max_op'][d])
-        eng.stale = True
         chunk = bytes(chunks[native_idx[d]])
-        eng._doc_pending = chunk
-        eng.binary_doc = chunk
-        n_changes = int(out['n_changes'][d])
-        if n_changes:
-            eng._deferred.append((0, _DocDeferredBatch(eng),
-                                  range(n_changes)))
+        eng._install_parked_chunk(chunk, int(out['n_changes'][d]))
         engines[d] = eng
         fleet.metrics.docs_bulk_loaded += 1
     # clock: per (doc, actor) max seq

@@ -2791,3 +2791,119 @@ class TestSeqPoolReserve:
         assert pools.grow_events <= 2, pools.grow_events
         assert fleet_backend.materialize_docs(handles) == \
             [{'l': [7]}] * n_docs
+
+
+class TestParkDocs:
+    """park_docs demotes a live doc's host state to its canonical chunk
+    (BASELINE.md's 100k-doc host-memory plan): reads, history, saves,
+    sync, and further turbo applies must be observationally unchanged."""
+
+    def _mk_handles(self, n=3):
+        actor = ACTORS[0]
+        fb = FleetBackend(DocFleet(doc_capacity=4, key_capacity=16))
+        handles = fleet_backend.init_docs(n, fb.fleet)
+        per_doc = []
+        for d in range(n):
+            c1 = change_buf(actor, 1, 1, [
+                {'action': 'set', 'obj': '_root', 'key': 'k',
+                 'value': d, 'datatype': 'int', 'pred': []},
+                {'action': 'makeText', 'obj': '_root', 'key': 't',
+                 'pred': []}])
+            from automerge_tpu.columnar import decode_change_meta
+            h1 = decode_change_meta(c1, True)['hash']
+            c2 = change_buf(actor, 2, 3, [
+                {'action': 'set', 'obj': f'2@{actor}', 'elemId': '_head',
+                 'insert': True, 'value': 'x', 'pred': []}], deps=[h1])
+            per_doc.append([c1, c2])
+        handles, _ = fleet_backend.apply_changes_docs(handles, per_doc,
+                                                      mirror=False)
+        return fb, handles
+
+    def test_park_preserves_reads_history_saves_and_applies(self):
+        fb, handles = self._mk_handles()
+        want_reads = fleet_backend.materialize_docs(handles)
+        want_saves = [bytes(fleet_backend.save(h)) for h in handles]
+        want_changes = [[bytes(b) for b in
+                         fleet_backend.get_changes(h, [])] for h in handles]
+        heads = [h['heads'] for h in handles]
+        before = fleet_backend.host_memory_stats(handles)
+        assert fleet_backend.park_docs(handles) == 3
+        after = fleet_backend.host_memory_stats(handles)
+        assert after['change_log_bytes'] == 0
+        assert after['parked_doc_bytes'] > 0
+        assert before['change_log_bytes'] > 0
+        # device reads, saves, heads: unchanged
+        assert fleet_backend.materialize_docs(handles) == want_reads
+        assert [h['heads'] for h in handles] == heads
+        assert [bytes(fleet_backend.save(h)) for h in handles] == want_saves
+        # history rematerializes from the chunk, hash-identical
+        got = [[bytes(b) for b in fleet_backend.get_changes(h, [])]
+               for h in handles]
+        assert got == want_changes
+        # further changes land through the turbo gate on parked docs
+        actor = ACTORS[0]
+        c3 = change_buf(actor, 3, 4, [
+            {'action': 'set', 'obj': '_root', 'key': 'k', 'value': 99,
+             'datatype': 'int', 'pred': [f'1@{actor}']}],
+            deps=handles[0]['heads'])
+        handles, _ = fleet_backend.apply_changes_docs(
+            handles, [[c3], [], []], mirror=False)
+        reads = fleet_backend.materialize_docs(handles)
+        assert reads[0]['k'] == 99
+        assert reads[1:] == want_reads[1:]
+
+    def test_repark_drops_rematerialized_history(self):
+        """Review find: a history read between parks pins the decoded
+        change dicts; re-parking must drop them (and the accounting must
+        surface them while they linger)."""
+        fb, handles = self._mk_handles(1)
+        assert fleet_backend.park_docs(handles) == 1
+        fleet_backend.get_changes(handles[0], [])   # rematerializes
+        stats = fleet_backend.host_memory_stats(handles)
+        assert stats['docs_with_decoded_history'] == 1
+        assert stats['change_log_bytes'] > 0
+        assert fleet_backend.park_docs(handles) == 1
+        stats = fleet_backend.host_memory_stats(handles)
+        assert stats['docs_with_decoded_history'] == 0
+        assert stats['change_log_bytes'] == 0
+        assert handles[0]['state']._impl._doc_decoded is None
+
+    def test_park_then_sync_converges(self):
+        fb, handles = self._mk_handles(1)
+        assert fleet_backend.park_docs(handles) == 1
+        handle = handles[0]
+        peer = host_backend.init()
+        s1, s2 = am.init_sync_state(), am.init_sync_state()
+        for _ in range(12):
+            s1, msg = fleet_backend.generate_sync_message(handle, s1)
+            if msg is not None:
+                peer, s2, _ = host_backend.receive_sync_message(peer, s2,
+                                                                msg)
+            s2, msg2 = host_backend.generate_sync_message(peer, s2)
+            if msg2 is not None:
+                handle, s1, _ = fleet_backend.receive_sync_message(
+                    handle, s1, msg2)
+            if msg is None and msg2 is None:
+                break
+        assert host_backend.get_heads(peer) == \
+            fleet_backend.get_heads(handle)
+
+    def test_park_skips_queued_docs(self):
+        actor = ACTORS[0]
+        fb = FleetBackend(DocFleet(doc_capacity=2, key_capacity=8))
+        handles = fleet_backend.init_docs(1, fb.fleet)
+        from automerge_tpu.columnar import decode_change_meta
+        c1 = change_buf(actor, 1, 1, [
+            {'action': 'set', 'obj': '_root', 'key': 'a', 'value': 1,
+             'datatype': 'int', 'pred': []}])
+        h1 = decode_change_meta(c1, True)['hash']
+        c2 = change_buf(actor, 2, 2, [
+            {'action': 'set', 'obj': '_root', 'key': 'b', 'value': 2,
+             'datatype': 'int', 'pred': []}], deps=[h1])
+        h2 = decode_change_meta(c2, True)['hash']
+        c3 = change_buf(actor, 3, 3, [
+            {'action': 'set', 'obj': '_root', 'key': 'c', 'value': 3,
+             'datatype': 'int', 'pred': []}], deps=[h2])
+        handles, _ = fleet_backend.apply_changes_docs(handles, [[c1, c3]],
+                                                      mirror=False)
+        assert fleet_backend.park_docs(handles) == 0   # c3 queued
