@@ -2555,7 +2555,12 @@ def park_docs(handles):
     serving sync, and answering bulk device reads; any history read
     rematerializes the log lazily from the chunk (the same machinery
     bulk-loaded documents already exercise, ref new.js:1709-1749 — the
-    deferred document-chunk load).
+    deferred document-chunk load). A history read or a new change
+    REVIVES the host log (appending needs the change list); revived docs
+    show up in host_memory_stats (change_log_bytes,
+    docs_with_decoded_history) and re-park on the next park_docs call —
+    parking is a policy the caller applies to docs it believes are cold,
+    not a one-way compression.
 
     Soundness: the chunk is decoded once at park time —
     `decode_document` recomputes every change hash by canonical
