@@ -154,3 +154,103 @@ def drive_pairwise_sync(mesh, axis, docs, backend_module, max_rounds=None):
                               generate, receive) == 0:
             break
     return rounds
+
+
+def local_shard_ids(mesh, axis):
+    """Global positions along `axis` owned by THIS process — the shards
+    whose documents a multi-controller host holds. Mesh axes other than
+    `axis` must be absent or size 1 for the pairwise sync drivers."""
+    devs = np.asarray(mesh.devices).reshape(-1)
+    if len(devs) != mesh.shape[axis]:
+        raise ValueError(
+            f'pairwise sync needs a 1-axis mesh: {len(devs)} devices but '
+            f'axis {axis!r} spans {mesh.shape[axis]}')
+    me = jax.process_index()
+    return [int(i) for i, d in enumerate(devs) if d.process_index == me]
+
+
+def sync_round_multihost(mesh, axis, generate, receive, max_msg=1 << 16):
+    """One pairwise sync round over a MULTI-PROCESS mesh (true multi-host:
+    each controller holds only its local shards' documents, the payload
+    matrix rides the same all_to_all — ICI within a host, DCN across
+    hosts, exactly where the reference hands messages to NCCL/MPI-style
+    transports). `generate(src, dst) -> bytes | None` and
+    `receive(dst, src, payload)` are called ONLY for src/dst shards local
+    to this process. Payloads are padded to `max_msg` bytes (a fixed
+    global width keeps every controller's data shapes identical without a
+    per-round width negotiation). An over-limit payload must fail on ALL
+    controllers or the others would block in the collective, so the
+    locally-observed max rides a tiny allgather first and every process
+    raises the same error together. Returns the number of non-empty
+    payloads THIS process received."""
+    n = mesh.shape[axis]
+    mine = local_shard_ids(mesh, axis)
+    per_src = []
+    biggest = 0
+    for src in mine:
+        payloads = [generate(src, dst) or b'' if dst != src else b''
+                    for dst in range(n)]
+        biggest = max(biggest, max(map(len, payloads)))
+        per_src.append(payloads)
+    # SPMD-safe size check: every controller sees the global max and
+    # raises (or proceeds) identically
+    from jax.experimental import multihost_utils
+    global_max = int(np.max(multihost_utils.process_allgather(
+        np.int64(biggest))))
+    if global_max > max_msg:
+        raise ValueError(f'sync message {global_max}B exceeds '
+                         f'max_msg={max_msg}')
+    rows = np.zeros((len(mine), n, max_msg), dtype=np.uint8)
+    lens = np.zeros((len(mine), n), dtype=np.int32)
+    for r, payloads in enumerate(per_src):
+        rows[r], lens[r] = pack_outboxes(payloads, max_len=max_msg)
+    sh_data = NamedSharding(mesh, P(axis, None, None))
+    sh_lens = NamedSharding(mesh, P(axis, None))
+    data = jax.make_array_from_process_local_data(sh_data, rows,
+                                                  (n, n, max_msg))
+    lens_g = jax.make_array_from_process_local_data(sh_lens, lens, (n, n))
+    inboxes, in_lens = exchange_changes(mesh, axis, data, lens_g)
+    received = 0
+    lens_local = {}
+    for shard in in_lens.addressable_shards:
+        dst = shard.index[0].start or 0
+        lens_local[dst] = np.asarray(shard.data)[0]
+    for shard in inboxes.addressable_shards:
+        dst = shard.index[0].start or 0
+        for src, payload in enumerate(
+                unpack_inbox(np.asarray(shard.data)[0], lens_local[dst])):
+            if payload:
+                receive(dst, src, payload)
+                received += 1
+    return received
+
+
+def drive_pairwise_sync_multihost(mesh, axis, local_docs, backend_module,
+                                  max_rounds=None, max_msg=1 << 16):
+    """drive_pairwise_sync for a multi-controller mesh: `local_docs` maps
+    THIS process's global shard id -> backend doc. Every controller runs
+    the same round loop (the collective keeps them in step); rounds stop
+    after max_rounds (default 2n — pairwise convergence bound; a global
+    "nothing moved" vote would need another collective, so the fixed
+    bound keeps the trace identical everywhere). Mutates local_docs;
+    returns the round count."""
+    n = mesh.shape[axis]
+    states = {(i, j): backend_module.init_sync_state()
+              for i in local_docs for j in range(n) if i != j}
+
+    def generate(src, dst):
+        state, msg = backend_module.generate_sync_message(
+            local_docs[src], states[(src, dst)])
+        states[(src, dst)] = state
+        return msg
+
+    def receive(dst, src, payload):
+        doc, state, _patch = backend_module.receive_sync_message(
+            local_docs[dst], states[(dst, src)], payload)
+        local_docs[dst] = doc
+        states[(dst, src)] = state
+
+    rounds = max_rounds if max_rounds is not None else 2 * n
+    for _ in range(rounds):
+        sync_round_multihost(mesh, axis, generate, receive, max_msg=max_msg)
+    return rounds

@@ -1,0 +1,56 @@
+"""Worker for test_multihost: one controller process of a 2-process CPU
+mesh (2 local virtual devices each -> 4 global shards). Each shard edits
+its own key in a fleet-resident document, then every pair converges with
+the payload matrix riding the mesh collective (ICI within a host, DCN
+across — jax.distributed + Gloo here stands in for the cross-host wire).
+Run: python multihost_worker.py <pid> <nproc> <port>."""
+
+import json
+import os
+import sys
+
+pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ['JAX_PLATFORMS'] = 'cpu'
+os.environ['XLA_FLAGS'] = os.environ.get('XLA_FLAGS', '') + \
+    ' --xla_force_host_platform_device_count=2'
+
+import numpy as np
+import jax
+jax.config.update('jax_platforms', 'cpu')
+jax.distributed.initialize(coordinator_address=f'127.0.0.1:{port}',
+                           num_processes=nproc, process_id=pid)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import automerge_tpu as A
+from automerge_tpu import frontend as F
+from automerge_tpu.fleet import backend as fleet_backend
+from automerge_tpu.fleet.backend import DocFleet, FleetBackend
+from automerge_tpu.fleet.exchange import (local_shard_ids,
+                                          drive_pairwise_sync_multihost)
+from jax.sharding import Mesh
+
+mesh = Mesh(np.asarray(jax.devices()), ('hosts',))
+mine = local_shard_ids(mesh, 'hosts')
+n = mesh.shape['hosts']
+
+fb = FleetBackend(DocFleet(doc_capacity=8, key_capacity=32))
+local_docs = {}
+prev = A.Backend()
+A.set_default_backend(fb)
+try:
+    for s in mine:
+        actor = f'{s:02x}' * 16
+        doc = A.change(A.init(actor), {'time': 0},
+                       lambda r, s=s: r.update({f'k{s}': s}))
+        local_docs[s] = F.get_backend_state(doc, 'multihost')
+finally:
+    A.set_default_backend(prev)
+
+drive_pairwise_sync_multihost(mesh, 'hosts', local_docs, fleet_backend)
+
+reads = fleet_backend.materialize_docs([local_docs[s] for s in mine])
+heads = [fleet_backend.get_heads(local_docs[s]) for s in mine]
+print('RESULT ' + json.dumps({
+    'process': pid, 'shards': mine,
+    'reads': reads, 'heads': heads,
+}), flush=True)

@@ -1,0 +1,62 @@
+"""True multi-controller sync: two OS processes, each owning half the
+mesh's shards and their fleet-resident documents, converge through the
+all_to_all payload exchange (fleet/exchange.py sync_round_multihost).
+This is the DCN leg of SURVEY §2.12's communication backend: within a
+process the collective rides the device mesh; across processes it rides
+jax.distributed's wire — the seam where a real deployment spans hosts."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_pairwise_sync_converges():
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop('JAX_PLATFORMS', None)     # worker pins its own
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.join(HERE, 'multihost_worker.py'),
+         str(p), '2', str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for p in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=540)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f'worker {p.args[-3]} failed:\n{out}'
+    results = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith('RESULT '):
+                r = json.loads(line[len('RESULT '):])
+                results[r['process']] = r
+    assert set(results) == {0, 1}, results
+    # every shard on every host converged to the same 4-key doc and the
+    # same heads
+    want = {f'k{s}': s for s in range(4)}
+    all_heads = []
+    for r in results.values():
+        for read in r['reads']:
+            assert read == want, read
+        all_heads += r['heads']
+    assert all(h == all_heads[0] for h in all_heads), all_heads
