@@ -3057,8 +3057,9 @@ def _apply_changes_turbo(handles, per_doc_changes):
     for j, d in enumerate(fast_ne.tolist()):
         start, stop = per_doc_idx[d]
         engine = engines[d]
-        base = len(engine.changes)
-        engine.changes.extend(flat_buffers[start:stop])
+        log = engine.changes        # ONE property get (parked docs revive)
+        base = len(log)
+        log.extend(flat_buffers[start:stop])
         # One deferred-graph record for the whole run (resolved lazily per
         # change only if a graph query ever needs it)
         engine._deferred.append((base, batch_meta, range(start, stop)))
@@ -3075,9 +3076,13 @@ def _apply_changes_turbo(handles, per_doc_changes):
         g_key = key_sorted[group_starts]
         g_doc = g_key // _MA
         g_final = seqs[order[group_ends]]
-        for gi in np.flatnonzero(fast_mask[g_doc]):
-            engines[int(g_doc[gi])].clock[
-                nat_actors[int(g_key[gi]) % _MA]] = int(g_final[gi])
+        sel = np.flatnonzero(fast_mask[g_doc])
+        g_doc_l = g_doc[sel].tolist()       # one bulk int conversion per
+        g_actor_l = (g_key[sel] % _MA).tolist()   # array, not per element
+        g_final_l = g_final[sel].tolist()
+        for gi in range(len(g_doc_l)):
+            engines[g_doc_l[gi]].clock[
+                nat_actors[g_actor_l[gi]]] = g_final_l[gi]
     for engine, applied, queue in staged:
         for change in applied:
             engine.changes.append(change['buffer'])

@@ -124,16 +124,11 @@ def sync_round_sharded(mesh, axis, backends, sync_states, generate, receive):
     return moved
 
 
-def drive_pairwise_sync(mesh, axis, docs, backend_module, max_rounds=None):
-    """Converge every ordered pair of shard documents with the mesh as the
-    wire: per-pair sync states on host, one all_to_all per round, until a
-    round moves nothing (the sync_test.js driver loop, shard-to-shard).
-    `backend_module` supplies init_sync_state / generate_sync_message /
-    receive_sync_message (host backend or fleet backend — both satisfy the
-    Backend contract). Mutates `docs` in place; returns the round count."""
-    n = mesh.shape[axis]
-    sync_states = {(i, j): backend_module.init_sync_state()
-                   for i in range(n) for j in range(n) if i != j}
+def _pairwise_callbacks(docs, sync_states, backend_module):
+    """(generate, receive) closures over a docs container (list indexed by
+    shard, or dict keyed by global shard id) and per-ordered-pair sync
+    states — THE sync-state handshake, shared by the single-controller
+    and multi-controller drivers so it cannot drift between them."""
 
     def generate(src, dst):
         state, msg = backend_module.generate_sync_message(
@@ -147,6 +142,21 @@ def drive_pairwise_sync(mesh, axis, docs, backend_module, max_rounds=None):
         docs[dst] = doc
         sync_states[(dst, src)] = state
 
+    return generate, receive
+
+
+def drive_pairwise_sync(mesh, axis, docs, backend_module, max_rounds=None):
+    """Converge every ordered pair of shard documents with the mesh as the
+    wire: per-pair sync states on host, one all_to_all per round, until a
+    round moves nothing (the sync_test.js driver loop, shard-to-shard).
+    `backend_module` supplies init_sync_state / generate_sync_message /
+    receive_sync_message (host backend or fleet backend — both satisfy the
+    Backend contract). Mutates `docs` in place; returns the round count."""
+    n = mesh.shape[axis]
+    sync_states = {(i, j): backend_module.init_sync_state()
+                   for i in range(n) for j in range(n) if i != j}
+    generate, receive = _pairwise_callbacks(docs, sync_states,
+                                            backend_module)
     rounds = 0
     for _ in range(max_rounds if max_rounds is not None else 2 * n):
         rounds += 1
@@ -181,25 +191,34 @@ def sync_round_multihost(mesh, axis, generate, receive, max_msg=1 << 16):
     per-round width negotiation). An over-limit payload must fail on ALL
     controllers or the others would block in the collective, so the
     locally-observed max rides a tiny allgather first and every process
-    raises the same error together. Returns the number of non-empty
-    payloads THIS process received."""
+    raises the same error together. Returns the round's GLOBAL non-empty
+    payload count — identical on every controller, so callers can branch
+    on it without desyncing the collective; an all-empty round returns 0
+    without paying the padded all_to_all."""
     n = mesh.shape[axis]
     mine = local_shard_ids(mesh, axis)
     per_src = []
-    biggest = 0
+    biggest = sent = 0
     for src in mine:
         payloads = [generate(src, dst) or b'' if dst != src else b''
                     for dst in range(n)]
         biggest = max(biggest, max(map(len, payloads)))
+        sent += sum(1 for p in payloads if p)
         per_src.append(payloads)
-    # SPMD-safe size check: every controller sees the global max and
-    # raises (or proceeds) identically
+    # SPMD-safe agreement round: every controller sees the global max
+    # payload size (raise identically on overflow, never deadlocking
+    # peers inside the collective) and the global sent count (an
+    # all-empty round returns 0 everywhere WITHOUT paying the padded
+    # all_to_all — the lock-step convergence signal).
     from jax.experimental import multihost_utils
-    global_max = int(np.max(multihost_utils.process_allgather(
-        np.int64(biggest))))
+    agg = np.asarray(multihost_utils.process_allgather(
+        np.array([biggest, sent], dtype=np.int64))).reshape(-1, 2)
+    global_max, global_sent = int(agg[:, 0].max()), int(agg[:, 1].sum())
     if global_max > max_msg:
         raise ValueError(f'sync message {global_max}B exceeds '
                          f'max_msg={max_msg}')
+    if global_sent == 0:
+        return 0
     rows = np.zeros((len(mine), n, max_msg), dtype=np.uint8)
     lens = np.zeros((len(mine), n), dtype=np.int32)
     for r, payloads in enumerate(per_src):
@@ -210,7 +229,6 @@ def sync_round_multihost(mesh, axis, generate, receive, max_msg=1 << 16):
                                                   (n, n, max_msg))
     lens_g = jax.make_array_from_process_local_data(sh_lens, lens, (n, n))
     inboxes, in_lens = exchange_changes(mesh, axis, data, lens_g)
-    received = 0
     lens_local = {}
     for shard in in_lens.addressable_shards:
         dst = shard.index[0].start or 0
@@ -221,36 +239,30 @@ def sync_round_multihost(mesh, axis, generate, receive, max_msg=1 << 16):
                 unpack_inbox(np.asarray(shard.data)[0], lens_local[dst])):
             if payload:
                 receive(dst, src, payload)
-                received += 1
-    return received
+    # the GLOBAL count, identical on every controller: callers may branch
+    # on it (the driver's lock-step break) — a process-local count here
+    # would desync the round loops and deadlock the next collective
+    return global_sent
 
 
 def drive_pairwise_sync_multihost(mesh, axis, local_docs, backend_module,
                                   max_rounds=None, max_msg=1 << 16):
     """drive_pairwise_sync for a multi-controller mesh: `local_docs` maps
     THIS process's global shard id -> backend doc. Every controller runs
-    the same round loop (the collective keeps them in step); rounds stop
-    after max_rounds (default 2n — pairwise convergence bound; a global
-    "nothing moved" vote would need another collective, so the fixed
-    bound keeps the trace identical everywhere). Mutates local_docs;
-    returns the round count."""
+    the same round loop, and each round's agreement allgather carries the
+    global sent count, so all controllers break in lock-step as soon as a
+    round generates nothing anywhere (an empty round costs only the tiny
+    allgather, never the padded all_to_all). Mutates local_docs; returns
+    the round count."""
     n = mesh.shape[axis]
     states = {(i, j): backend_module.init_sync_state()
               for i in local_docs for j in range(n) if i != j}
-
-    def generate(src, dst):
-        state, msg = backend_module.generate_sync_message(
-            local_docs[src], states[(src, dst)])
-        states[(src, dst)] = state
-        return msg
-
-    def receive(dst, src, payload):
-        doc, state, _patch = backend_module.receive_sync_message(
-            local_docs[dst], states[(dst, src)], payload)
-        local_docs[dst] = doc
-        states[(dst, src)] = state
-
-    rounds = max_rounds if max_rounds is not None else 2 * n
-    for _ in range(rounds):
-        sync_round_multihost(mesh, axis, generate, receive, max_msg=max_msg)
+    generate, receive = _pairwise_callbacks(local_docs, states,
+                                            backend_module)
+    rounds = 0
+    for _ in range(max_rounds if max_rounds is not None else 2 * n):
+        rounds += 1
+        if sync_round_multihost(mesh, axis, generate, receive,
+                                max_msg=max_msg) == 0:
+            break
     return rounds
