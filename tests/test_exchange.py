@@ -100,3 +100,43 @@ def test_sharded_fleet_backend_sync_convergence(mesh):
     mats = materialize_docs(backends)
     want = {f'k{i}': i for i in range(N_SHARDS)}
     assert all(m == want for m in mats), mats
+
+
+def test_multihost_driver_single_controller(mesh):
+    """drive_pairwise_sync_multihost on a single-controller mesh (all
+    shards local): same convergence as drive_pairwise_sync, via the
+    multi-controller code path — process-local outbox rows, the
+    agreement allgather, the lock-step convergence break (the loop must
+    stop well before the 2n bound once a round moves nothing)."""
+    from automerge_tpu.fleet.exchange import drive_pairwise_sync_multihost
+
+    actors = [f'{i:02x}' * 16 for i in range(N_SHARDS)]
+    local_docs = {}
+    for i in range(N_SHARDS):
+        b = Backend.init()
+        b, _ = Backend.apply_changes(b, [encode_change({
+            'actor': actors[i], 'seq': 1, 'startOp': 1, 'time': 0,
+            'deps': [], 'ops': [{'action': 'set', 'obj': '_root',
+                                 'key': f'k{i}', 'value': i,
+                                 'datatype': 'int', 'pred': []}]})])
+        local_docs[i] = b
+    rounds = drive_pairwise_sync_multihost(mesh, 'peers', local_docs,
+                                           Backend)
+    assert rounds < 2 * N_SHARDS       # the convergence vote broke early
+    heads = [tuple(Backend.get_heads(local_docs[i]))
+             for i in range(N_SHARDS)]
+    assert len(set(heads)) == 1
+    assert len(heads[0]) == N_SHARDS
+
+
+def test_multihost_round_oversize_raises_before_collective(mesh):
+    """A payload over max_msg must raise during the agreement phase (every
+    controller together), not inside the padded exchange."""
+    from automerge_tpu.fleet.exchange import sync_round_multihost
+
+    def generate(src, dst):
+        return b'x' * 200
+
+    with pytest.raises(ValueError, match='exceeds max_msg'):
+        sync_round_multihost(mesh, 'peers', generate,
+                             lambda *a: None, max_msg=128)
