@@ -12,9 +12,21 @@ import automerge_tpu as am
 from automerge_tpu import backend as Backend
 from automerge_tpu.columnar import encode_change
 from automerge_tpu.fleet.exchange import (
-    drive_pairwise_sync, exchange_changes, pack_outboxes, unpack_inbox)
+    drive_pairwise_sync, drive_pairwise_sync_multihost, exchange_changes,
+    pack_outboxes, sync_round_multihost, unpack_inbox)
 
 N_SHARDS = 4
+
+
+def seed_backend(i):
+    """One host backend holding shard i's private change (key ki=i)."""
+    b = Backend.init()
+    b, _ = Backend.apply_changes(b, [encode_change({
+        'actor': f'{i:02x}' * 16, 'seq': 1, 'startOp': 1, 'time': 0,
+        'deps': [], 'ops': [{'action': 'set', 'obj': '_root',
+                             'key': f'k{i}', 'value': i,
+                             'datatype': 'int', 'pred': []}]})])
+    return b
 
 
 @pytest.fixture
@@ -48,16 +60,7 @@ def test_sharded_sync_convergence(mesh):
     """One backend per shard, each with a private change; repeated
     all_to_all-transported sync rounds must converge every shard to every
     change (the sync_test.js driver loop, with ICI as the wire)."""
-    actors = [f'{i:02x}' * 16 for i in range(N_SHARDS)]
-    backends = []
-    for i in range(N_SHARDS):
-        b = Backend.init()
-        b, _ = Backend.apply_changes(b, [encode_change({
-            'actor': actors[i], 'seq': 1, 'startOp': 1, 'time': 0,
-            'deps': [], 'ops': [{'action': 'set', 'obj': '_root',
-                                 'key': f'k{i}', 'value': i,
-                                 'datatype': 'int', 'pred': []}]})])
-        backends.append(b)
+    backends = [seed_backend(i) for i in range(N_SHARDS)]
     drive_pairwise_sync(mesh, 'peers', backends, Backend)
     heads = [tuple(Backend.get_heads(b)) for b in backends]
     assert len(set(heads)) == 1
@@ -108,18 +111,7 @@ def test_multihost_driver_single_controller(mesh):
     multi-controller code path — process-local outbox rows, the
     agreement allgather, the lock-step convergence break (the loop must
     stop well before the 2n bound once a round moves nothing)."""
-    from automerge_tpu.fleet.exchange import drive_pairwise_sync_multihost
-
-    actors = [f'{i:02x}' * 16 for i in range(N_SHARDS)]
-    local_docs = {}
-    for i in range(N_SHARDS):
-        b = Backend.init()
-        b, _ = Backend.apply_changes(b, [encode_change({
-            'actor': actors[i], 'seq': 1, 'startOp': 1, 'time': 0,
-            'deps': [], 'ops': [{'action': 'set', 'obj': '_root',
-                                 'key': f'k{i}', 'value': i,
-                                 'datatype': 'int', 'pred': []}]})])
-        local_docs[i] = b
+    local_docs = {i: seed_backend(i) for i in range(N_SHARDS)}
     rounds = drive_pairwise_sync_multihost(mesh, 'peers', local_docs,
                                            Backend)
     assert rounds < 2 * N_SHARDS       # the convergence vote broke early
@@ -132,8 +124,6 @@ def test_multihost_driver_single_controller(mesh):
 def test_multihost_round_oversize_raises_before_collective(mesh):
     """A payload over max_msg must raise during the agreement phase (every
     controller together), not inside the padded exchange."""
-    from automerge_tpu.fleet.exchange import sync_round_multihost
-
     def generate(src, dst):
         return b'x' * 200
 
