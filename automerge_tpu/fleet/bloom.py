@@ -143,6 +143,31 @@ def _probe_varsize(bits, row_bits, words, valid):
     return jnp.all(hit, axis=-1) & valid
 
 
+# Batched filters cross the host<->device link in the wire format's own
+# little-bit-order byte packing (8x less transfer than [N, bits] bool — the
+# link, tunneled or PCIe, was the dominant cost of the batched sync driver
+# on real hardware) and the packing/unpacking runs on device.
+
+@jax.jit
+def _build_varsize_packed(words, valid, row_bits, bits_init):
+    bits = _build_varsize(words, valid, row_bits, bits_init)
+    n_rows, n_bits = bits.shape
+    weights = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], dtype=jnp.uint8)
+    return jnp.sum(bits.reshape(n_rows, n_bits // 8, 8).astype(jnp.uint8)
+                   * weights, axis=-1, dtype=jnp.uint8)
+
+
+@jax.jit
+def _probe_varsize_packed(packed, row_bits, words, valid):
+    n_rows, _ = packed.shape
+    probes = _probe_indexes(words, row_bits[:, None])
+    row_idx = jnp.broadcast_to(
+        jnp.arange(n_rows, dtype=jnp.int32)[:, None, None], probes.shape)
+    byte = packed[row_idx, probes >> 3].astype(jnp.int32)
+    hit = ((byte >> (probes & 7)) & 1) == 1
+    return jnp.all(hit, axis=-1) & valid
+
+
 def _size_class(n_bits):
     """Power-of-two padding class: keeps batch memory proportional to real
     filter bytes under skewed per-peer change counts (one huge peer must not
@@ -151,40 +176,67 @@ def _size_class(n_bits):
     return 1 << max(int(n_bits) - 1, 1).bit_length()
 
 
-def build_bloom_filters_batch(hash_lists):
-    """Build one wire-format Bloom filter per hash list, batched into one
-    device dispatch per power-of-two size class despite differing entry
-    counts. Returns a list of `bytes` (b'' for empty lists), byte-identical
-    to the host BloomFilter."""
+def build_bloom_filters_batch_begin(hash_lists):
+    """Issue the device dispatches for `build_bloom_filters_batch` without
+    blocking on their results (JAX dispatch is async). Returns an opaque
+    handle for `build_bloom_filters_batch_finish`; host work interleaved
+    between begin and finish overlaps with the device build."""
     entry_counts = [len(row) for row in hash_lists]
-    out = [b''] * len(hash_lists)
     classes = {}
     for i, n in enumerate(entry_counts):
         if n > 0:
             classes.setdefault(_size_class(num_filter_bits(n)),
                                []).append(i)
+    pending = []
     for width, live in sorted(classes.items()):
         words, valid = hashes_to_words([hash_lists[i] for i in live])
         row_bits = np.array([num_filter_bits(entry_counts[i])
                              for i in live], dtype=np.uint32)
         bits = jnp.zeros((len(live), width), dtype=bool)
-        built = np.asarray(_build_varsize(
+        packed = _build_varsize_packed(
             jnp.asarray(words), jnp.asarray(valid), jnp.asarray(row_bits),
-            bits))
+            bits)
+        pending.append((live, packed))
+    return len(hash_lists), entry_counts, pending
+
+
+def build_bloom_filters_batch_finish(handle):
+    """Materialize a `build_bloom_filters_batch_begin` handle into the list
+    of wire-format filter bytes."""
+    from ..encoding import uleb_append
+    n, entry_counts, pending = handle
+    out = [b''] * n
+    for live, packed in pending:
+        arr = np.asarray(packed)
         for k, i in enumerate(live):
-            n_bits = int(row_bits[k])
-            out[i] = bloom_filter_bytes(built[k, :n_bits], entry_counts[i])
+            num_entries = entry_counts[i]
+            row = bytearray()
+            uleb_append(row, num_entries)
+            row.append(BITS_PER_ENTRY)
+            row.append(NUM_PROBES)
+            n_bytes = (num_entries * BITS_PER_ENTRY + 7) // 8
+            row += arr[k, :n_bytes].tobytes()
+            out[i] = bytes(row)
     return out
 
 
-def probe_bloom_filters_batch(filter_bytes, hash_lists):
-    """Probe each row's hashes against that row's wire-format filter, all
-    rows in one device dispatch. `filter_bytes[i]` is a serialized filter
-    (b'' = empty: contains nothing); `hash_lists[i]` the hex hashes to test.
-    Returns a list of lists of bool (True = possibly contained)."""
+def build_bloom_filters_batch(hash_lists):
+    """Build one wire-format Bloom filter per hash list, batched into one
+    device dispatch per power-of-two size class despite differing entry
+    counts. Returns a list of `bytes` (b'' for empty lists), byte-identical
+    to the host BloomFilter."""
+    return build_bloom_filters_batch_finish(
+        build_bloom_filters_batch_begin(hash_lists))
+
+
+def probe_bloom_filters_batch_begin(filter_bytes, hash_lists):
+    """Issue the device dispatches for `probe_bloom_filters_batch` without
+    blocking (filters are uploaded in their packed wire-format bytes, not
+    unpacked bools). Returns a handle for
+    `probe_bloom_filters_batch_finish`."""
     from ..encoding import Decoder
     out = [[False] * len(row) for row in hash_lists]
-    rows = []          # (orig index, bits array, n_bits)
+    rows = []          # (orig index, packed byte array, n_bits)
     for i, fb in enumerate(filter_bytes):
         if not fb or not hash_lists[i]:
             continue
@@ -204,21 +256,40 @@ def probe_bloom_filters_batch(filter_bytes, hash_lists):
             continue
         raw = decoder.read_raw_bytes(
             (num_entries * bits_per_entry + 7) // 8)
-        unpacked = np.unpackbits(np.frombuffer(raw, dtype=np.uint8),
-                                 bitorder='little')
-        rows.append((i, unpacked, 8 * len(raw)))
+        rows.append((i, np.frombuffer(raw, dtype=np.uint8), 8 * len(raw)))
     classes = {}
     for row in rows:
         classes.setdefault(_size_class(row[2]), []).append(row)
+    pending = []
     for width, group in sorted(classes.items()):
         words, valid = hashes_to_words([hash_lists[i] for i, _, _ in group])
-        bits = np.zeros((len(group), width), dtype=bool)
-        for k, (_, unpacked, n_bits) in enumerate(group):
-            bits[k, :n_bits] = unpacked[:n_bits]
+        packed = np.zeros((len(group), width // 8), dtype=np.uint8)
+        for k, (_, raw, _) in enumerate(group):
+            packed[k, :len(raw)] = raw
         row_bits = np.array([n for _, _, n in group], dtype=np.uint32)
-        hit = np.asarray(_probe_varsize(
-            jnp.asarray(bits), jnp.asarray(row_bits), jnp.asarray(words),
-            jnp.asarray(valid)))
+        hit = _probe_varsize_packed(
+            jnp.asarray(packed), jnp.asarray(row_bits), jnp.asarray(words),
+            jnp.asarray(valid))
+        pending.append((group, hit))
+    return out, hash_lists, pending
+
+
+def probe_bloom_filters_batch_finish(handle):
+    """Materialize a `probe_bloom_filters_batch_begin` handle into the
+    per-row lists of probe results."""
+    out, hash_lists, pending = handle
+    for group, hit in pending:
+        hit = np.asarray(hit)
         for k, (i, _, _) in enumerate(group):
             out[i] = [bool(h) for h in hit[k, :len(hash_lists[i])]]
     return out
+
+
+def probe_bloom_filters_batch(filter_bytes, hash_lists):
+    """Probe each row's hashes against that row's wire-format filter, all
+    rows in one device dispatch per size class. `filter_bytes[i]` is a
+    serialized filter (b'' = empty: contains nothing); `hash_lists[i]` the
+    hex hashes to test. Returns a list of lists of bool (True = possibly
+    contained)."""
+    return probe_bloom_filters_batch_finish(
+        probe_bloom_filters_batch_begin(filter_bytes, hash_lists))

@@ -12,7 +12,10 @@ filter bytes):
 - ``generate_sync_messages_docs``: every doc's Bloom build (over its
   changes since sharedHeads) lands in one ``build_bloom_filters_batch``
   dispatch, and every doc's changes-to-send scan probes the peer's filter
-  in one ``probe_bloom_filters_batch`` dispatch. Messages are
+  in one ``probe_bloom_filters_batch`` dispatch. Both dispatches are
+  issued async (begin/finish pairs) so the device build and the packed
+  filter-byte transfers overlap the host-side graph scans, and filters
+  cross the link bit-packed (see fleet/bloom.py). Messages are
   byte-identical to the host ``generate_sync_message`` outputs.
 - ``receive_sync_messages_docs``: all received changes apply through
   ``apply_changes_docs`` (one device merge dispatch on the fleet backend's
@@ -30,7 +33,10 @@ from ..backend.sync import (
     changes_to_send_prescan, decode_sync_message, encode_sync_message,
 )
 from .backend import apply_changes_docs
-from .bloom import build_bloom_filters_batch, probe_bloom_filters_batch
+from .bloom import (
+    build_bloom_filters_batch_begin, build_bloom_filters_batch_finish,
+    probe_bloom_filters_batch_begin, probe_bloom_filters_batch_finish,
+)
 
 
 def generate_sync_messages_docs(backends, sync_states):
@@ -46,18 +52,18 @@ def generate_sync_messages_docs(backends, sync_states):
     our_need = [get_missing_deps(b, s['theirHeads'] or [])
                 for b, s in zip(backends, sync_states)]
 
-    # Phase 1 — which docs attach a filter, and over which hashes
+    # Phase 1 — which docs attach a filter, and over which hashes. The
+    # build dispatch is issued here but not materialized until after the
+    # probe dispatch: the device builds (and the link moves packed filter
+    # bytes) while phase 2's host-side graph scans run.
     bloom_hash_lists = [None] * n
     for i, (backend, state) in enumerate(zip(backends, sync_states)):
         their_heads = state['theirHeads']
         if their_heads is None or all(h in their_heads for h in our_need[i]):
             bloom_hash_lists[i] = get_change_hashes(
                 backend, state['sharedHeads'])
-    built = build_bloom_filters_batch(
+    build_handle = build_bloom_filters_batch_begin(
         [row if row is not None else [] for row in bloom_hash_lists])
-    our_have = [[{'lastSync': s['sharedHeads'], 'bloom': built[i]}]
-                if bloom_hash_lists[i] is not None else []
-                for i, s in enumerate(sync_states)]
 
     # Phase 2 — full-resync resets, and the changes-to-send pre-scan
     results = [None] * n          # i -> (new_state, message or None)
@@ -91,8 +97,13 @@ def generate_sync_messages_docs(backends, sync_states):
             probe_meta.append(('probe', i, changes, first,
                                len(filter_bytes)))
 
-    hits = probe_bloom_filters_batch([r[0] for r in probe_rows],
-                                     [r[1] for r in probe_rows])
+    probe_handle = probe_bloom_filters_batch_begin(
+        [r[0] for r in probe_rows], [r[1] for r in probe_rows])
+    built = build_bloom_filters_batch_finish(build_handle)
+    our_have = [[{'lastSync': s['sharedHeads'], 'bloom': built[i]}]
+                if bloom_hash_lists[i] is not None else []
+                for i, s in enumerate(sync_states)]
+    hits = probe_bloom_filters_batch_finish(probe_handle)
 
     # Phase 3 — assemble messages exactly as the host does
     changes_to_send_by_doc = {}

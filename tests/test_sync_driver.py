@@ -99,8 +99,8 @@ class TestDifferentialEquality:
 
     def _count_dispatches(self, monkeypatch):
         calls = {'build': 0, 'probe': 0}
-        orig_build = fleet_bloom._build_varsize
-        orig_probe = fleet_bloom._probe_varsize
+        orig_build = fleet_bloom._build_varsize_packed
+        orig_probe = fleet_bloom._probe_varsize_packed
 
         def count_build(*args):
             calls['build'] += 1
@@ -109,8 +109,8 @@ class TestDifferentialEquality:
         def count_probe(*args):
             calls['probe'] += 1
             return orig_probe(*args)
-        monkeypatch.setattr(fleet_bloom, '_build_varsize', count_build)
-        monkeypatch.setattr(fleet_bloom, '_probe_varsize', count_probe)
+        monkeypatch.setattr(fleet_bloom, '_build_varsize_packed', count_build)
+        monkeypatch.setattr(fleet_bloom, '_probe_varsize_packed', count_probe)
         return calls
 
     def test_two_filter_dispatches_per_generate(self, monkeypatch):
