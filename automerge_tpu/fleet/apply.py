@@ -139,6 +139,19 @@ apply_op_batch_kills_donated = jax.jit(_apply_op_batch_kills_impl,
 apply_op_batch_donated = jax.jit(_apply_op_batch_impl, donate_argnums=(0,))
 
 
+def _zero_doc_rows_impl(state, idx):
+    """Zero the given docs' rows across every grid array — ONE fused
+    kernel, so a batched free is genuinely one device dispatch (duplicate
+    indices are fine: zeroing is idempotent, which lets callers pad idx to
+    a power of two to bound recompiles)."""
+    return FleetState(state.winners.at[idx].set(0),
+                      state.values.at[idx].set(0),
+                      state.counters.at[idx].set(0))
+
+
+zero_doc_rows_donated = jax.jit(_zero_doc_rows_impl, donate_argnums=(0,))
+
+
 def fleet_merge(state, op_batches):
     """Apply a sequence of OpBatches (e.g. one per change round)."""
     total = 0

@@ -399,22 +399,56 @@ class DocFleet:
         self.n_slots += 1
         return slot
 
+    def alloc_slots(self, n):
+        """Allocate n slots in one call (recycled slots first, in the same
+        LIFO order alloc_slot would hand them out, then fresh ones) —
+        init_docs' O(1) bookkeeping instead of n alloc_slot calls."""
+        if n <= 0:
+            return []
+        out = []
+        if self.free_slots:
+            k = min(len(self.free_slots), n)
+            out = self.free_slots[-k:][::-1]
+            del self.free_slots[-k:]
+        rest = n - len(out)
+        if rest:
+            base = self.n_slots
+            out.extend(range(base, base + rest))
+            self.n_slots = base + rest
+        return out
+
     def free_slot(self, slot):
-        self.pending = [(s, b) for (s, b) in self.pending if s != slot]
-        self.ctr_base.pop(slot, None)
-        self.grid_overflow.discard(slot)
-        self.del_fallback.discard(slot)
+        self.free_slots_batch([slot])
+
+    def free_slots_batch(self, slots):
+        """Release a batch of slots: all host-side bookkeeping in one pass
+        and the device rows zeroed in ONE dispatch per engine kind
+        (`_zero_rows`) — freeing n docs used to rewrite the whole grid n
+        times over (the per-doc `.at[slot].set(0)` chain)."""
+        if not slots:
+            return
+        if self.pending:
+            gone = set(slots)
+            self.pending = [(s, b) for (s, b) in self.pending
+                            if s not in gone]
         self._index_consolidate()
-        self._op_index.pop(slot, None)
-        self._op_index_incomplete.discard(slot)
-        self._zero_row(slot)
-        rows = self.slot_seq.pop(slot, {})
-        if rows:
-            self._zero_seq_rows(list(rows.values()))
-            for row in rows.values():
-                self.seq_rows[row] = None
-                self.seq_free.append(row)
-        self.free_slots.append(slot)
+        seq_zero = []
+        for slot in slots:
+            self.ctr_base.pop(slot, None)
+            self.grid_overflow.discard(slot)
+            self.del_fallback.discard(slot)
+            self._op_index.pop(slot, None)
+            self._op_index_incomplete.discard(slot)
+            rows = self.slot_seq.pop(slot, {})
+            if rows:
+                seq_zero.extend(rows.values())
+                for row in rows.values():
+                    self.seq_rows[row] = None
+                    self.seq_free.append(row)
+        self._zero_rows(slots)
+        if seq_zero:
+            self._zero_seq_rows(seq_zero)
+        self.free_slots.extend(slots)
 
     def clone_slot(self, src):
         self.flush()
@@ -471,23 +505,38 @@ class DocFleet:
                 rs.inexact.at[dst].set(rs.inexact[src]))
         return dst
 
-    def _zero_row(self, slot):
-        if self.state is not None and slot < self.state.winners.shape[0]:
-            st = self.state
-            self.state = FleetState(st.winners.at[slot].set(0),
-                                    st.values.at[slot].set(0),
-                                    st.counters.at[slot].set(0))
-            if self.host_winners is not None:
-                self._fold_pending_winners()
-                self.host_winners[slot] = 0
-        if self.reg_state is not None and \
-                slot < self.reg_state.reg.shape[0]:
-            from .registers import RegisterState
-            rs = self.reg_state
-            self.reg_state = RegisterState(
-                rs.reg.at[slot].set(0), rs.killed.at[slot].set(False),
-                rs.value.at[slot].set(0), rs.counter.at[slot].set(0),
-                rs.inexact.at[slot].set(False))
+    def _zero_rows(self, slots):
+        """Zero a batch of slots' device rows in ONE fused donated kernel
+        per engine kind (grid and/or registers), counted in
+        metrics.dispatches. The index vector is padded to a power of two
+        with repeats of its first slot (zeroing is idempotent) so the JIT
+        recompiles O(log batch) times, not once per batch size."""
+        arr = np.asarray(list(slots), dtype=np.int64)
+        if not len(arr):
+            return
+        import jax.numpy as jnp
+
+        def padded(sel):
+            n_pad = _pow2(len(sel))
+            return jnp.asarray(np.concatenate(
+                [sel, np.full(n_pad - len(sel), sel[0], dtype=sel.dtype)]))
+
+        if self.state is not None:
+            sel = arr[arr < self.state.winners.shape[0]]
+            if len(sel):
+                from .apply import zero_doc_rows_donated
+                self.state = zero_doc_rows_donated(self.state, padded(sel))
+                self.metrics.dispatches += 1
+                if self.host_winners is not None:
+                    self._fold_pending_winners()
+                    self.host_winners[sel] = 0
+        if self.reg_state is not None:
+            sel = arr[arr < self.reg_state.reg.shape[0]]
+            if len(sel):
+                from .registers import zero_register_rows_donated
+                self.reg_state = zero_register_rows_donated(
+                    self.reg_state, padded(sel))
+                self.metrics.dispatches += 1
 
     # -- sequence rows ---------------------------------------------------
 
@@ -2473,25 +2522,58 @@ def _gc_paused():
 
 
 def init_docs(n, fleet=None):
-    """Create n fleet documents sharing one device fleet.
+    """Create n fleet documents sharing one device fleet, with O(1)
+    (size-independent) device work.
 
     Bulk-constructs the engines via _FlatEngine._bulk_new instead of
     going through init(): the per-doc constructor chain (init -> FleetDoc
     -> _FlatEngine -> HashGraph -> alloc_slot) costs ~8us/doc in CPython,
     which at 10k+ docs is a measurable slice of the turbo seam; pausing
-    the GC across the loop saves another 4-7x (see _gc_paused)."""
+    the GC across the loop saves another 4-7x (see _gc_paused). Slot
+    numbers come from ONE alloc_slots call, and when the fleet already
+    holds device state it is pre-grown to the new slot count in one step
+    here — n fresh docs would otherwise regrow the [docs, keys] state
+    O(log n) times across their first flushes. (A fleet with no device
+    state yet keeps its lazy allocation: the first flush allocates at
+    full capacity in one step, and seq-only fleets never pay for a grid.)"""
     fleet = fleet or _default_fleet
     out = []
     append = out.append
-    alloc_slot = fleet.alloc_slot
     bulk_new = _FlatEngine._bulk_new
     with _gc_paused():
-        for _ in range(n):
+        slots = fleet.alloc_slots(n)
+        if fleet.state is not None:
+            fleet._ensure_capacity(n_docs=fleet.n_slots,
+                                   n_keys=len(fleet.keys))
+        if fleet.reg_state is not None:
+            fleet._ensure_reg_capacity(n_docs=fleet.n_slots,
+                                       n_keys=len(fleet.keys))
+        for slot in slots:
             d = FleetDoc.__new__(FleetDoc)
             d.fleet = fleet
-            d._impl = bulk_new(fleet, alloc_slot())
+            d._impl = bulk_new(fleet, slot)
             append({'state': d, 'heads': []})
     return out
+
+
+def free_docs(handles):
+    """Free n fleet documents with O(1) device dispatches: per owning
+    fleet, one batched row-zeroing per engine kind (free_slots_batch)
+    instead of the per-doc free() chain, which rewrites the whole device
+    grid once per document. Handles are frozen like free()."""
+    by_fleet = {}
+    for handle in handles:
+        state = handle.get('state')
+        if isinstance(state, FleetDoc):
+            if state.is_fleet:
+                fleet = state.fleet
+                by_fleet.setdefault(id(fleet), (fleet, []))[1].append(
+                    state._impl.slot)
+            state._impl = None
+        handle['state'] = None
+        handle['frozen'] = True
+    for fleet, slots in by_fleet.values():
+        fleet.free_slots_batch(slots)
 
 
 def host_memory_stats(handles):
@@ -3054,10 +3136,19 @@ def _apply_changes_turbo(handles, per_doc_changes):
     # bytes->hex round trip; slicing 64-char substrings is cheap
     head_hex_all = hash32[(starts_all + doc_counts - 1)[fast_ne]] \
         .tobytes().hex()
+    # Fused commit loop: the only remaining per-doc Python of the turbo
+    # path. Everything the body consumes is staged as flat arrays/lists
+    # above (per-doc head hex, max ops, buffer runs); the loop itself is
+    # straight-line attribute writes — no per-doc numpy, no per-doc hex,
+    # and the `changes` property dispatch only for parked docs (which must
+    # revive their log through it).
     for j, d in enumerate(fast_ne.tolist()):
         start, stop = per_doc_idx[d]
         engine = engines[d]
-        log = engine.changes        # ONE property get (parked docs revive)
+        if engine._doc_pending is not None:
+            log = engine.changes    # parked doc: property get revives it
+        else:
+            log = engine._changes
         base = len(log)
         log.extend(flat_buffers[start:stop])
         # One deferred-graph record for the whole run (resolved lazily per
@@ -3077,12 +3168,10 @@ def _apply_changes_turbo(handles, per_doc_changes):
         g_doc = g_key // _MA
         g_final = seqs[order[group_ends]]
         sel = np.flatnonzero(fast_mask[g_doc])
-        g_doc_l = g_doc[sel].tolist()       # one bulk int conversion per
-        g_actor_l = (g_key[sel] % _MA).tolist()   # array, not per element
-        g_final_l = g_final[sel].tolist()
-        for gi in range(len(g_doc_l)):
-            engines[g_doc_l[gi]].clock[
-                nat_actors[g_actor_l[gi]]] = g_final_l[gi]
+        for d, a, s in zip(g_doc[sel].tolist(),
+                           (g_key[sel] % _MA).tolist(),
+                           g_final[sel].tolist()):
+            engines[d].clock[nat_actors[a]] = s
     for engine, applied, queue in staged:
         for change in applied:
             engine.changes.append(change['buffer'])
@@ -3391,6 +3480,13 @@ def _apply_changes_turbo(handles, per_doc_changes):
 
     if n_kept_root:
         n_slots = fleet.n_slots
+        # Fused staging: size the device state FIRST and scatter the op
+        # columns straight into capacity-shaped arrays — the old
+        # stage-then-np.pad sequence copied every column a second time on
+        # every turbo call (part of the round-5 "turbo-commit Python"
+        # budget).
+        fleet._ensure_capacity(n_docs=n_slots, n_keys=len(fleet.keys))
+        n_cap = fleet.state.winners.shape[0]
         # Pred-scoped deletes (ref new.js:1204-1217): del rows (flags 1,
         # TOMBSTONE value — boxed values are <= -2, so -1 is del-only)
         # write no winner; their preds become kill lanes for the
@@ -3406,17 +3502,21 @@ def _apply_changes_turbo(handles, per_doc_changes):
         slot_sorted = slots[order]
         pos = np.arange(len(slot_sorted)) - \
             np.searchsorted(slot_sorted, slot_sorted, side='left')
-        shape = (n_slots, max_ops)
+        shape = (n_cap, max_ops)
         cols = {name: np.zeros(shape, dtype=np.int32)
                 for name in ('key_id', 'packed', 'value')}
-        flags = np.zeros(shape, dtype=np.int8)
+        is_set = np.zeros(shape, dtype=bool)
+        is_inc = np.zeros(shape, dtype=bool)
+        valid = np.zeros(shape, dtype=bool)
         cols['key_id'][slot_sorted, pos] = key[order]
         cols['packed'][slot_sorted, pos] = packed[order]
         cols['value'][slot_sorted, pos] = vals_root[order]
         flags_laid = np.where(del_sel, 0, flags_root)[order]
-        flags[slot_sorted, pos] = flags_laid
+        is_set[slot_sorted, pos] = flags_laid == 1
+        is_inc[slot_sorted, pos] = flags_laid == 2
+        valid[slot_sorted, pos] = flags_laid != 0
         batch = OpBatch(cols['key_id'], cols['packed'], cols['value'],
-                        flags == 1, flags == 2, flags != 0)
+                        is_set, is_inc, valid)
 
         kills = None
         kill_doc = kill_key_f = kill_packed_f = ()
@@ -3436,17 +3536,12 @@ def _apply_changes_turbo(handles, per_doc_changes):
                 rows['pred'][np.repeat(del_all, pred_counts)], actor_map,
                 on_bad_actor=lambda ds: fleet.grid_overflow.update(
                     int(s) for s in ds))
+            # laid out at capacity so _dispatch_grid skips its pad copy
             (kk_arr, kp_arr), _ = layout_doc_rows(
-                kill_doc, n_slots, (kill_key_f, kill_packed_f),
+                kill_doc, n_cap, (kill_key_f, kill_packed_f),
                 (np.int32, np.int32))
             kills = (kk_arr, kp_arr)
 
-        fleet._ensure_capacity(n_docs=n_slots, n_keys=len(fleet.keys))
-        n_cap = fleet.state.winners.shape[0]
-        if batch.key_id.shape[0] < n_cap:
-            pad = n_cap - batch.key_id.shape[0]
-            batch = OpBatch(*(np.pad(col, ((0, pad), (0, 0)))
-                              for col in batch.tree_flatten()[0]))
         fleet._dispatch_grid(batch, kills)
         # Counter-attribution check (see _note_grid_batch): advance the
         # host winner mirror with this batch's set and kill rows and

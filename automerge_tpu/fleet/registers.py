@@ -226,6 +226,20 @@ apply_register_batch_donated = jax.jit(_apply_register_batch_impl,
                                        donate_argnums=(0,))
 
 
+def _zero_register_rows_impl(state, idx):
+    """Zero the given docs' rows across every register array — ONE fused
+    kernel (idempotent under duplicate indices, so callers may pad idx)."""
+    return RegisterState(state.reg.at[idx].set(0),
+                         state.killed.at[idx].set(False),
+                         state.value.at[idx].set(0),
+                         state.counter.at[idx].set(0),
+                         state.inexact.at[idx].set(False))
+
+
+zero_register_rows_donated = jax.jit(_zero_register_rows_impl,
+                                     donate_argnums=(0,))
+
+
 @jax.jit
 def visible_registers(state):
     """(visible [N, K+1, A] bool, winner_slot [N, K+1] int32,

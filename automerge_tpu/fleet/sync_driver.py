@@ -4,10 +4,13 @@ The host protocol (``backend/sync.py``, ref backend/sync.js:234-306) builds
 one Bloom filter per peer and probes each candidate change hash one at a
 time — fine for two peers, quadratic pain for a fleet syncing with thousands.
 Here the same control flow runs over N (document, peer-state) pairs with the
-two filter-heavy steps batched into ONE device dispatch each per round (per
-power-of-two filter size class — uniform fleets get exactly one each, and a
-skewed fleet at most a handful, with batch memory proportional to real
-filter bytes):
+two filter-heavy steps batched into ONE device dispatch each per round —
+O(1) in the peer count AND in the per-peer filter-size skew (the flat
+packed layout in fleet/bloom.py gives every filter its exact wire-format
+byte span inside one concatenated vector, so differing entry counts no
+longer split the batch into per-size-class dispatches, and batch memory
+stays proportional to real filter bytes). `dispatch_count()` exposes the
+round's device-call count for bench.py and the regression tests:
 
 - ``generate_sync_messages_docs``: every doc's Bloom build (over its
   changes since sharedHeads) lands in one ``build_bloom_filters_batch``
@@ -35,8 +38,12 @@ from ..backend.sync import (
 from .backend import apply_changes_docs
 from .bloom import (
     build_bloom_filters_batch_begin, build_bloom_filters_batch_finish,
-    probe_bloom_filters_batch_begin, probe_bloom_filters_batch_finish,
+    dispatch_count, probe_bloom_filters_batch_begin,
+    probe_bloom_filters_batch_finish,
 )
+
+__all__ = ['generate_sync_messages_docs', 'receive_sync_messages_docs',
+           'dispatch_count']
 
 
 def generate_sync_messages_docs(backends, sync_states):

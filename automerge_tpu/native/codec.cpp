@@ -26,6 +26,7 @@
 #include <zlib.h>
 #if defined(__x86_64__) || defined(__i386__)
 #include <immintrin.h>
+#include <cpuid.h>
 #define AM_HAVE_X86 1
 #endif
 
@@ -180,10 +181,19 @@ static void sha256_blocks_shani(uint32_t state[8], const uint8_t *data,
 #undef AM_K4
 }
 
+// Raw cpuid instead of __builtin_cpu_supports("sha"): not every GCC in the
+// field accepts "sha" as a builtin feature name (g++ 10 rejects it at
+// compile time, taking the whole codec — and the turbo seam — down with it).
+// SHA extensions: CPUID.(EAX=7,ECX=0):EBX bit 29; SSE4.1: CPUID.1:ECX bit
+// 19; SSSE3: CPUID.1:ECX bit 9.
 static bool have_shani() {
-  static const bool v = __builtin_cpu_supports("sha") &&
-                        __builtin_cpu_supports("sse4.1") &&
-                        __builtin_cpu_supports("ssse3");
+  static const bool v = [] {
+    unsigned eax = 0, ebx = 0, ecx = 0, edx = 0;
+    if (!__get_cpuid_count(7, 0, &eax, &ebx, &ecx, &edx)) return false;
+    if (!(ebx & (1u << 29))) return false;
+    if (!__get_cpuid(1, &eax, &ebx, &ecx, &edx)) return false;
+    return (ecx & (1u << 19)) != 0 && (ecx & (1u << 9)) != 0;
+  }();
   return v;
 }
 #endif  // AM_HAVE_X86

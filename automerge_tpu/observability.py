@@ -15,6 +15,14 @@ build plan names: per-dispatch op counters and JAX profiler traces.
   TensorBoard-loadable XLA trace of everything dispatched inside the block.
 - `timed(metrics, key)`: context manager accumulating wall-clock seconds
   into a counter, for host-side phases (decode, gate, patch build).
+- `register_dispatch_source(name, fn)` / `dispatch_counts(fleets)`: one
+  roll-up of every device-dispatch counter in the system. DocFleet counts
+  its dispatches in `fleet.metrics.dispatches`, but some batched paths run
+  over HOST backends with no fleet in sight (the sync driver's Bloom
+  build/probe lives in `fleet/bloom.py` module state); those modules
+  register a monotonic counter here, so bench.py and the dispatch-count
+  regression tests can diff total device dispatches around a workload
+  without knowing which modules dispatched.
 """
 
 import contextlib
@@ -74,6 +82,30 @@ def timed(metrics, key):
     finally:
         metrics.seconds[key] = metrics.seconds.get(key, 0.0) + \
             (time.perf_counter() - start)
+
+
+# ---- device-dispatch roll-up ----------------------------------------------
+
+_dispatch_sources = {}
+
+
+def register_dispatch_source(name, fn):
+    """Register a zero-arg callable returning a module's monotonic device
+    dispatch count (e.g. fleet.bloom registers its batched build/probe
+    counter at import). Re-registering a name replaces the source."""
+    _dispatch_sources[name] = fn
+
+
+def dispatch_counts(fleets=()):
+    """Snapshot every registered module dispatch counter plus the given
+    fleets' `metrics.dispatches`, with a 'total' sum. Take one snapshot
+    before and one after a workload and subtract per key (the counters are
+    monotonic) to get dispatches attributable to that workload."""
+    out = {name: int(fn()) for name, fn in _dispatch_sources.items()}
+    for i, fleet in enumerate(fleets):
+        out[f'fleet{i}'] = int(fleet.metrics.dispatches)
+    out['total'] = sum(out.values())
+    return out
 
 
 @contextlib.contextmanager

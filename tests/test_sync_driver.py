@@ -99,8 +99,8 @@ class TestDifferentialEquality:
 
     def _count_dispatches(self, monkeypatch):
         calls = {'build': 0, 'probe': 0}
-        orig_build = fleet_bloom._build_varsize_packed
-        orig_probe = fleet_bloom._probe_varsize_packed
+        orig_build = fleet_bloom._build_flat_packed
+        orig_probe = fleet_bloom._probe_flat_packed
 
         def count_build(*args):
             calls['build'] += 1
@@ -109,8 +109,8 @@ class TestDifferentialEquality:
         def count_probe(*args):
             calls['probe'] += 1
             return orig_probe(*args)
-        monkeypatch.setattr(fleet_bloom, '_build_varsize_packed', count_build)
-        monkeypatch.setattr(fleet_bloom, '_probe_varsize_packed', count_probe)
+        monkeypatch.setattr(fleet_bloom, '_build_flat_packed', count_build)
+        monkeypatch.setattr(fleet_bloom, '_probe_flat_packed', count_probe)
         return calls
 
     def test_two_filter_dispatches_per_generate(self, monkeypatch):
@@ -143,13 +143,14 @@ class TestDifferentialEquality:
         sb, msgs2 = generate_sync_messages_docs(b_docs, sb)
         assert calls['probe'] == 1
 
-    def test_skewed_filter_sizes_bucket_by_class(self, monkeypatch):
-        # One high-churn peer must not inflate every row to its width: the
-        # batch buckets rows into power-of-two size classes (memory stays
-        # proportional to real filter bytes), one dispatch per class
+    def test_skewed_filter_sizes_one_dispatch(self, monkeypatch):
+        # One high-churn peer must neither inflate every row to its width
+        # (the flat packed layout gives each filter its exact byte span)
+        # nor split the batch into extra dispatches: skew or not, the whole
+        # build is ONE device dispatch, and every filter stays
+        # byte-identical to the host BloomFilter
         import hashlib
-        from automerge_tpu.fleet.bloom import (
-            build_bloom_filters_batch, _size_class, num_filter_bits)
+        from automerge_tpu.fleet.bloom import build_bloom_filters_batch
         from automerge_tpu.backend.sync import BloomFilter
         calls = self._count_dispatches(monkeypatch)
         hash_lists = [[hashlib.sha256(f'{i}:{j}'.encode()).hexdigest()
@@ -157,11 +158,56 @@ class TestDifferentialEquality:
         hash_lists.append([hashlib.sha256(f'big:{j}'.encode()).hexdigest()
                            for j in range(500)])
         built = build_bloom_filters_batch(hash_lists)
-        n_classes = len({_size_class(num_filter_bits(len(r)))
-                         for r in hash_lists})
-        assert calls['build'] == n_classes == 2
+        assert calls['build'] == 1
         for row, fb in zip(hash_lists, built):
             assert bytes(fb) == bytes(BloomFilter(row).bytes)
+
+    def test_skewed_probe_one_dispatch(self, monkeypatch):
+        # Probe side of the same guarantee: filters of wildly different
+        # sizes probe in ONE gather dispatch through the flat byte layout
+        import hashlib
+        from automerge_tpu.fleet.bloom import (
+            build_bloom_filters_batch, probe_bloom_filters_batch)
+        calls = self._count_dispatches(monkeypatch)
+        sizes = [1, 3, 40, 500, 7]
+        hash_lists = [[hashlib.sha256(f'{i}:{j}'.encode()).hexdigest()
+                       for j in range(n)] for i, n in enumerate(sizes)]
+        built = build_bloom_filters_batch(hash_lists)
+        calls['build'] = calls['probe'] = 0
+        hits = probe_bloom_filters_batch(built, hash_lists)
+        assert calls['probe'] == 1
+        # a filter contains everything it was built over (no false negatives)
+        assert all(all(row) for row in hits)
+        # and cross-probing mostly misses (bit-layout sanity, not just True)
+        cross = probe_bloom_filters_batch(built[1:] + built[:1], hash_lists)
+        assert not all(all(row) for row in cross)
+
+    def test_generate_round_dispatches_size_independent(self):
+        # THE O(1)-dispatch contract for sync rounds: a generate round over
+        # 4x the peers issues exactly the same number of device dispatches
+        # (2: one flat Bloom build, one flat probe), observed through the
+        # observability roll-up the bench reports from
+        from automerge_tpu.observability import dispatch_counts
+        counts = {}
+        for n in (6, 24):
+            pairs = _make_pairs(n)
+            docs = [_backend_of(a) for a, _ in pairs]
+            states = [init_sync_state() for _ in docs]
+            # prime theirHave/theirNeed so the probe phase runs too
+            states, msgs = generate_sync_messages_docs(docs, states)
+            docs_b = [_backend_of(b) for _, b in pairs]
+            states_b = [init_sync_state() for _ in docs]
+            docs_b, states_b, _ = receive_sync_messages_docs(
+                docs_b, states_b, msgs)
+            states_b, replies = generate_sync_messages_docs(docs_b, states_b)
+            docs, states, _ = receive_sync_messages_docs(docs, states,
+                                                         replies)
+            before = dispatch_counts()
+            states, msgs = generate_sync_messages_docs(docs, states)
+            after = dispatch_counts()
+            counts[n] = after['total'] - before['total']
+            assert after['bloom'] - before['bloom'] == counts[n]
+        assert counts[6] == counts[24] == 2, counts
 
     def test_empty_and_missing_messages(self):
         pairs = _make_pairs(4)
