@@ -10,8 +10,13 @@ slots in.
 """
 
 from . import backend as _default_backend
+from . import errors
 from . import frontend as Frontend
 from .columnar import encode_change, decode_change
+from .errors import (
+    AutomergeError, MalformedChange, MalformedDocument, MalformedSyncMessage,
+    InvalidChange, DanglingPred, DuplicateOpId, SyncOverflow, DocError,
+)
 from .common import uuid, set_uuid_factory
 from .frontend import (
     Text, Table, Counter, Observable, Int, Uint, Float64,

@@ -14,8 +14,18 @@ this module is the host-side protocol driver.
 from ..encoding import (Encoder, Decoder, hex_string_to_bytes,
     bytes_to_hex_string, uleb_append as _uleb)
 from ..columnar import decode_change_meta
+from ..errors import MalformedSyncMessage, as_wire_error
+from ..observability import register_health_source
 from . import get_heads, get_missing_deps, get_change_by_hash, get_changes, \
     apply_changes
+
+# Containment counter: peer Bloom filters that failed to parse/probe and
+# were treated as empty (send-everything) instead of crashing the
+# generate round. Registered as a health source so bench.py and the
+# chaos tests can see corruption being absorbed.
+_wire_stats = {'rejected_filters': 0}
+register_health_source('rejected_filters',
+                       lambda: _wire_stats['rejected_filters'])
 
 HASH_SIZE = 32
 MESSAGE_TYPE_SYNC = 0x42  # first byte of a sync message
@@ -25,6 +35,24 @@ PEER_STATE_TYPE = 0x43    # first byte of an encoded peer state
 # can change without breaking protocol compatibility (ref sync.js:29-31)
 BITS_PER_ENTRY = 10
 NUM_PROBES = 7
+
+
+def read_filter_header(decoder):
+    """THE wire-format filter-header reader (counterpart of
+    fleet/bloom.py's `_append_filter_header` writer): every site that
+    parses filter bytes — BloomFilter decode, the message-boundary
+    framing check, the batched device probe — goes through this one
+    function so the readers cannot drift. Returns (num_entries,
+    bits_per_entry, num_probes, bitmap_byte_len); rejects the
+    zero-width-probe shape (entries > 0 with bits_per_entry or
+    num_probes of 0), which would divide by zero at probe time."""
+    num_entries = decoder.read_uint32()
+    bits_per_entry = decoder.read_uint32()
+    num_probes = decoder.read_uint32()
+    if num_entries and (bits_per_entry == 0 or num_probes == 0):
+        raise ValueError('bloom filter with zero-width probes')
+    return (num_entries, bits_per_entry, num_probes,
+            (num_entries * bits_per_entry + 7) // 8)
 
 
 class BloomFilter:
@@ -49,11 +77,9 @@ class BloomFilter:
                 self.bits = bytearray()
             else:
                 decoder = Decoder(arg)
-                self.num_entries = decoder.read_uint32()
-                self.num_bits_per_entry = decoder.read_uint32()
-                self.num_probes = decoder.read_uint32()
-                self.bits = bytearray(decoder.read_raw_bytes(
-                    (self.num_entries * self.num_bits_per_entry + 7) // 8))
+                (self.num_entries, self.num_bits_per_entry,
+                 self.num_probes, n_bytes) = read_filter_header(decoder)
+                self.bits = bytearray(decoder.read_raw_bytes(n_bytes))
         else:
             raise TypeError('invalid argument')
 
@@ -153,20 +179,43 @@ def encode_sync_message(message):
     return bytes(out)
 
 
+def _validate_filter_framing(bloom):
+    """Cheap structural check of a filter's wire bytes at the decode
+    boundary: a corrupt filter stored into `theirHave` would poison every
+    LATER generate (unprobeable, or worse: probeable but all-False, which
+    makes changes_to_send permanently nonempty against a full sentHashes
+    and the peer solicit forever), so the whole message quarantines NOW,
+    where the peer's retry/reset machinery handles it like any other
+    corrupt message."""
+    if not bloom:
+        return
+    decoder = Decoder(bytes(bloom))
+    _entries, _bpe, _probes, n_bytes = read_filter_header(decoder)
+    decoder.read_raw_bytes(n_bytes)
+
+
 def decode_sync_message(data):
-    """(ref sync.js:177-201)"""
-    decoder = Decoder(data)
-    message_type = decoder.read_byte()
-    if message_type != MESSAGE_TYPE_SYNC:
-        raise ValueError(f'Unexpected message type: {message_type}')
-    message = {'heads': _decode_hashes(decoder), 'need': _decode_hashes(decoder),
-               'have': [], 'changes': []}
-    for _ in range(decoder.read_uint32()):
-        last_sync = _decode_hashes(decoder)
-        bloom = decoder.read_prefixed_bytes()
-        message['have'].append({'lastSync': last_sync, 'bloom': bloom})
-    for _ in range(decoder.read_uint32()):
-        message['changes'].append(decoder.read_prefixed_bytes())
+    """(ref sync.js:177-201). Undecodable bytes — including a structurally
+    corrupt Bloom filter inside `have` — raise `MalformedSyncMessage`
+    (a ValueError), never a bare decoder exception: one hostile message
+    must be quarantinable by type, before any of it enters sync state."""
+    try:
+        decoder = Decoder(data)
+        message_type = decoder.read_byte()
+        if message_type != MESSAGE_TYPE_SYNC:
+            raise ValueError(f'Unexpected message type: {message_type}')
+        message = {'heads': _decode_hashes(decoder),
+                   'need': _decode_hashes(decoder),
+                   'have': [], 'changes': []}
+        for _ in range(decoder.read_uint32()):
+            last_sync = _decode_hashes(decoder)
+            bloom = decoder.read_prefixed_bytes()
+            _validate_filter_framing(bloom)
+            message['have'].append({'lastSync': last_sync, 'bloom': bloom})
+        for _ in range(decoder.read_uint32()):
+            message['changes'].append(decoder.read_prefixed_bytes())
+    except Exception as exc:
+        raise as_wire_error(exc, MalformedSyncMessage, 'decode_sync_message')
     # Trailing bytes are ignored for forward compatibility
     return message
 
@@ -180,12 +229,15 @@ def encode_sync_state(sync_state):
 
 
 def decode_sync_state(data):
-    decoder = Decoder(data)
-    record_type = decoder.read_byte()
-    if record_type != PEER_STATE_TYPE:
-        raise ValueError(f'Unexpected record type: {record_type}')
-    state = init_sync_state()
-    state['sharedHeads'] = _decode_hashes(decoder)
+    try:
+        decoder = Decoder(data)
+        record_type = decoder.read_byte()
+        if record_type != PEER_STATE_TYPE:
+            raise ValueError(f'Unexpected record type: {record_type}')
+        state = init_sync_state()
+        state['sharedHeads'] = _decode_hashes(decoder)
+    except Exception as exc:
+        raise as_wire_error(exc, MalformedSyncMessage, 'decode_sync_state')
     return state
 
 
@@ -270,6 +322,23 @@ def changes_to_send_finish(backend, changes, bloom_hits, need):
     return changes_to_send
 
 
+def probe_filter_lenient(filter_bytes, hashes):
+    """Probe one peer filter's wire bytes against `hashes`, CONTAINING
+    corruption: a filter that fails to parse or probe (truncated framing,
+    zero-width bits from a flipped byte, ...) reads as all-False —
+    "peer has nothing", so every candidate change is resent. That costs
+    bandwidth, never convergence, and it keeps a peer that stored a
+    corrupt `theirHave` functional instead of crashing every subsequent
+    generate (the filter arrived inside an already-checksummed message,
+    so there is no retransmit to ask for)."""
+    try:
+        bloom = BloomFilter(bytes(filter_bytes))
+        return [bloom.contains_hash(h) for h in hashes]
+    except Exception:
+        _wire_stats['rejected_filters'] += 1
+        return [False] * len(hashes)
+
+
 def get_changes_to_send(backend, have, need):
     """Changes since lastSync whose hash misses every peer Bloom filter, plus
     transitive dependents of Bloom-negative changes, plus explicitly needed
@@ -278,9 +347,8 @@ def get_changes_to_send(backend, have, need):
     if mode == 'need-only':
         return payload
     changes, filter_bytes = payload
-    bloom_filters = [BloomFilter(fb) for fb in filter_bytes]
-    bloom_hits = [[bloom.contains_hash(c['hash']) for c in changes]
-                  for bloom in bloom_filters]
+    hashes = [c['hash'] for c in changes]
+    bloom_hits = [probe_filter_lenient(fb, hashes) for fb in filter_bytes]
     return changes_to_send_finish(backend, changes, bloom_hits, need)
 
 

@@ -15,6 +15,7 @@ import struct
 import zlib
 
 from .common import parse_op_id, lamport_key
+from .errors import MalformedChange, MalformedDocument, as_wire_error
 from .encoding import (
     Encoder, Decoder, RLEEncoder, RLEDecoder, DeltaEncoder, DeltaDecoder,
     BooleanEncoder, BooleanDecoder, hex_string_to_bytes, bytes_to_hex_string,
@@ -816,24 +817,36 @@ def decode_change_columns(buffer):
 
 
 def decode_change(buffer):
-    """Decode a binary change into its dict representation (ref columnar.js:770-776)."""
-    change = decode_change_columns(buffer)
-    change['ops'] = decode_ops(
-        decode_columns(change['columns'], change['actorIds'], CHANGE_COLUMNS), False)
+    """Decode a binary change into its dict representation (ref
+    columnar.js:770-776). Undecodable bytes — whatever the parser trips
+    over — raise `MalformedChange` (a ValueError), never a bare decoder
+    exception: callers quarantine on the type, and the wire fuzzer pins
+    the contract."""
+    try:
+        change = decode_change_columns(buffer)
+        change['ops'] = decode_ops(
+            decode_columns(change['columns'], change['actorIds'],
+                           CHANGE_COLUMNS), False)
+    except Exception as exc:
+        raise as_wire_error(exc, MalformedChange, 'decode_change')
     del change['actorIds']
     del change['columns']
     return change
 
 
 def decode_change_meta(buffer, compute_hash=False):
-    """Decode only the header fields of a change (ref columnar.js:783-793)."""
-    buffer = bytes(buffer)
-    if buffer[8] == CHUNK_TYPE_DEFLATE:
-        buffer = inflate_change(buffer)
-    header = decode_container_header(Decoder(buffer), compute_hash)
-    if header['chunkType'] != CHUNK_TYPE_CHANGE:
-        raise ValueError('Buffer chunk type is not a change')
-    meta = decode_change_header(Decoder(header['chunkData']))
+    """Decode only the header fields of a change (ref columnar.js:783-793).
+    Raises `MalformedChange` on undecodable bytes (see decode_change)."""
+    try:
+        buffer = bytes(buffer)
+        if buffer[8] == CHUNK_TYPE_DEFLATE:
+            buffer = inflate_change(buffer)
+        header = decode_container_header(Decoder(buffer), compute_hash)
+        if header['chunkType'] != CHUNK_TYPE_CHANGE:
+            raise ValueError('Buffer chunk type is not a change')
+        meta = decode_change_header(Decoder(header['chunkData']))
+    except Exception as exc:
+        raise as_wire_error(exc, MalformedChange, 'decode_change_meta')
     meta['change'] = buffer
     if compute_hash:
         meta['hash'] = header['hash']
@@ -867,14 +880,19 @@ def inflate_change(buffer):
 
 
 def split_containers(buffer):
-    """Split concatenated chunks into individual byte arrays (ref columnar.js:829-837)."""
-    decoder = Decoder(buffer)
-    chunks = []
-    start = 0
-    while not decoder.done:
-        decode_container_header(decoder, False)
-        chunks.append(decoder.buf[start:decoder.offset])
-        start = decoder.offset
+    """Split concatenated chunks into individual byte arrays (ref
+    columnar.js:829-837). Raises `MalformedChange` when the container
+    framing itself is corrupt."""
+    try:
+        decoder = Decoder(buffer)
+        chunks = []
+        start = 0
+        while not decoder.done:
+            decode_container_header(decoder, False)
+            chunks.append(decoder.buf[start:decoder.offset])
+            start = decoder.offset
+    except Exception as exc:
+        raise as_wire_error(exc, MalformedChange, 'split_containers')
     return chunks
 
 
@@ -1055,11 +1073,17 @@ def decode_document_header(buffer):
 
 def decode_document(buffer):
     """Decode a document chunk back into the original list of changes
-    (ref columnar.js:1040-1047)."""
-    header = decode_document_header(buffer)
-    changes = decode_columns(header['changesColumns'], header['actorIds'], DOCUMENT_COLUMNS)
-    ops = decode_ops(
-        decode_columns(header['opsColumns'], header['actorIds'], DOC_OPS_COLUMNS), True)
-    group_change_ops(changes, ops)
-    decode_document_changes(changes, header['heads'])
+    (ref columnar.js:1040-1047). Raises `MalformedDocument` on
+    undecodable bytes or when the recomputed heads miss the header's."""
+    try:
+        header = decode_document_header(buffer)
+        changes = decode_columns(header['changesColumns'],
+                                 header['actorIds'], DOCUMENT_COLUMNS)
+        ops = decode_ops(
+            decode_columns(header['opsColumns'], header['actorIds'],
+                           DOC_OPS_COLUMNS), True)
+        group_change_ops(changes, ops)
+        decode_document_changes(changes, header['heads'])
+    except Exception as exc:
+        raise as_wire_error(exc, MalformedDocument, 'decode_document')
     return changes

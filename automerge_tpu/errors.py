@@ -1,0 +1,127 @@
+"""Typed failure taxonomy for the batched seam and the sync wire.
+
+The reference backend reports every failure as a bare ``ValueError`` (or
+lets decoder ``IndexError``/``KeyError`` escape), which is survivable when
+one document fails one call — but the fleet engine applies N documents per
+fused dispatch and a whole shard's sync round per collective, so callers
+need to know three things a bare exception cannot tell them: WHICH
+document's input was bad, WHAT CLASS of input it was (malformed bytes vs a
+well-formed but causally-invalid change vs an oversized payload), and
+whether the failure is CONTAINED (the other N-1 documents committed) or
+batch-fatal. This module is that contract:
+
+- Wire-corruption errors (``MalformedChange``, ``MalformedDocument``,
+  ``MalformedSyncMessage``) mean the bytes themselves cannot be decoded —
+  checksum mismatch, truncation, garbage columns. Decoder entry points
+  convert whatever the parser tripped over (IndexError, struct noise,
+  UnicodeDecodeError, zlib errors) into these, so "only typed errors
+  escape a decoder" is an invariant the wire fuzzer
+  (tools/fuzz_wire.py) can enforce.
+- Validity errors (``InvalidChange``, ``DanglingPred``,
+  ``DuplicateOpId``) mean the bytes decoded fine but the change violates
+  the causal/structural rules the apply gate checks.
+- ``SyncOverflow`` means a sync payload exceeded the multihost wire's
+  hard ceiling (exchange.py) — raised identically on every controller so
+  no peer blocks inside a collective.
+
+Every class subclasses ``ValueError`` (the reference's error type), so
+existing ``except ValueError`` / ``pytest.raises(ValueError)`` call sites
+keep working; new code catches ``AutomergeError`` (or a subclass) and
+reads ``doc_index`` to scope the blast radius. ``DocError`` is the
+structured per-document rejection record the quarantining batch APIs
+(``apply_changes_docs(..., on_error='quarantine')``,
+``receive_sync_messages_docs(..., on_error='quarantine')``) return for
+rejected slots while the healthy documents commit in the same fused
+dispatch.
+"""
+
+__all__ = [
+    'AutomergeError', 'WireCorruption', 'MalformedChange',
+    'MalformedDocument', 'MalformedSyncMessage', 'InvalidChange',
+    'DanglingPred', 'DuplicateOpId', 'SyncOverflow', 'DocError',
+    'as_wire_error',
+]
+
+
+class AutomergeError(Exception):
+    """Base of every typed failure. `doc_index` scopes the error to one
+    slot of a batched call (None = not doc-scoped / unknown)."""
+
+    def __init__(self, *args, doc_index=None, **attrs):
+        super().__init__(*args)
+        self.doc_index = doc_index
+        for name, value in attrs.items():
+            setattr(self, name, value)
+
+
+class WireCorruption(AutomergeError, ValueError):
+    """Bytes off the wire (or disk) that cannot be decoded at all."""
+
+
+class MalformedChange(WireCorruption):
+    """A binary change chunk that fails to decode: bad magic/checksum,
+    truncated columns, out-of-range LEBs, invalid UTF-8."""
+
+
+class MalformedDocument(WireCorruption):
+    """A saved document chunk that fails to decode or whose recomputed
+    heads do not reproduce the header."""
+
+
+class MalformedSyncMessage(WireCorruption):
+    """A sync-protocol message that fails to decode (wrong type byte,
+    truncated hash runs, bad filter framing)."""
+
+
+class InvalidChange(AutomergeError, ValueError):
+    """A change that decoded fine but violates the apply gate's rules
+    (sequence reuse/skip, unresolvable structure)."""
+
+
+class DanglingPred(InvalidChange):
+    """A change whose pred names no existing operation — the reference
+    rejects invalid op references during the merge (new.js:1219-1220)."""
+
+
+class DuplicateOpId(InvalidChange):
+    """Two operations in one document claim the same opId."""
+
+
+class SyncOverflow(AutomergeError, ValueError):
+    """A sync payload exceeded the multihost wire's hard ceiling. Carries
+    `global_max` (largest payload anywhere this round), `max_msg` (the
+    per-sub-round wire width), `max_chunks` (how many sub-rounds the wire
+    will chunk across), and `pairs` (locally-observed offending
+    (src, dst) shard pairs — each controller sees only its own)."""
+
+
+class DocError:
+    """Structured per-document rejection record from a quarantining batch
+    call: `index` (slot in the batch), `stage` ('decode' | 'apply' |
+    'sync'), `error` (the typed exception). Healthy docs in the same call
+    carry None in the errors vector."""
+
+    __slots__ = ('index', 'stage', 'error')
+
+    def __init__(self, index, stage, error):
+        self.index = index
+        self.stage = stage
+        self.error = error
+
+    def __repr__(self):
+        return (f'DocError(index={self.index}, stage={self.stage!r}, '
+                f'error={type(self.error).__name__}: {self.error})')
+
+
+def as_wire_error(exc, err_cls, what, doc_index=None):
+    """Normalize an arbitrary decoder exception into the typed class:
+    already-typed errors pass through (gaining a doc_index if they lack
+    one), everything else wraps with the original as __cause__."""
+    if isinstance(exc, AutomergeError):
+        if doc_index is not None and exc.doc_index is None:
+            exc.doc_index = doc_index
+        return exc
+    err = err_cls(f'{what}: {type(exc).__name__}: {exc}',
+                  doc_index=doc_index)
+    err.__cause__ = exc
+    return err

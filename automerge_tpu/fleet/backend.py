@@ -41,7 +41,9 @@ import numpy as np
 
 from .. import native
 from ..backend.hash_graph import HashGraph, decode_change_buffers
-from ..observability import Metrics
+from ..errors import (AutomergeError, DanglingPred, DocError, DuplicateOpId,
+                      InvalidChange, MalformedChange, as_wire_error)
+from ..observability import Metrics, register_health_source
 from ..backend.op_set import OpSet
 from ..columnar import decode_change, OBJECT_TYPE
 from .tensor_doc import (ACTOR_BITS, CTR_LIMIT, FleetState, MAX_ACTORS,
@@ -2700,9 +2702,25 @@ def rebuild_docs(handles, fleet=None, mirror=False):
     return new_handles
 
 
-def apply_changes_docs(handles, per_doc_changes, mirror=True):
+# Fault-containment roll-up (observability.health_counts): documents
+# rejected by quarantining batch calls, and how many change buffers went
+# down with them. Module-level because quarantine also runs over host
+# backends with no fleet in sight (the sync driver's receive path).
+quarantine_stats = {'quarantined_docs': 0, 'rejected_changes': 0}
+register_health_source('quarantined_docs',
+                       lambda: quarantine_stats['quarantined_docs'])
+register_health_source('rejected_changes',
+                       lambda: quarantine_stats['rejected_changes'])
+
+
+def apply_changes_docs(handles, per_doc_changes, mirror=True,
+                       on_error='raise'):
     """Apply per-document change lists across the fleet. Returns
-    (new_handles, patches).
+    (new_handles, patches) — or (new_handles, patches, errors) with
+    on_error='quarantine', where a bad input rejects ONLY its own doc
+    (errors[i] is a DocError; healthy docs commit in the same fused
+    dispatch). on_error='raise' keeps the classic batch-fatal contract,
+    now with typed exceptions carrying `doc_index`.
 
     mirror=True (exact): per-doc causal gating and patch mirrors on host,
     then ONE batched ingest + merge dispatch for every document's ops.
@@ -2727,6 +2745,12 @@ def apply_changes_docs(handles, per_doc_changes, mirror=True):
     dangling preds there surface at the next mirror read), and a
     pred-less inc on a non-counter key surfaces at the next mirror read
     rather than at apply."""
+    if on_error == 'quarantine':
+        return _apply_changes_docs_quarantine(handles, per_doc_changes,
+                                              mirror)
+    if on_error != 'raise':
+        raise ValueError(f"on_error must be 'raise' or 'quarantine', "
+                         f"got {on_error!r}")
     if not mirror:
         with _gc_paused():
             turbo = _apply_changes_turbo(handles, per_doc_changes)
@@ -2754,6 +2778,154 @@ def apply_changes_docs(handles, per_doc_changes, mirror=True):
     if fleet is not None:
         fleet.flush()
     return out_handles, patches
+
+
+def _screen_malformed_docs(work):
+    """Per-doc screen after the batched native parse refused the whole
+    flat batch (it cannot name the offender): re-parse each doc's buffers
+    ALONE through the native parser — a doc that parses clean is healthy;
+    a doc the parser refuses gets the (slow, Python) header decode to
+    distinguish CORRUPT bytes (checksum/header damage -> quarantine,
+    returned as [(doc, MalformedChange)]) from merely turbo-INELIGIBLE
+    content (unsupported ops, document chunks — legal input that belongs
+    on the exact path, where deeper corruption is already contained
+    per-doc). The native fast path keeps the screen ~parse-speed for the
+    N-K healthy docs; only refused docs pay Python decode. Host work
+    only; no device dispatch."""
+    from ..columnar import (CHUNK_TYPE_CHANGE, CHUNK_TYPE_DEFLATE,
+                            decode_change_meta, split_containers)
+    bad = []
+
+    def classify(d):
+        """Python header decode of one refused doc: corrupt vs ineligible."""
+        try:
+            for buf in work[d]:
+                for chunk in split_containers(bytes(buf)):
+                    if chunk[8] in (CHUNK_TYPE_CHANGE, CHUNK_TYPE_DEFLATE):
+                        decode_change_meta(chunk, True)
+        except Exception as exc:
+            bad.append((d, as_wire_error(exc, MalformedChange,
+                                         'change screen', doc_index=d)))
+
+    nonempty = [d for d, changes in enumerate(work) if changes]
+    if not native.available():
+        for d in nonempty:
+            classify(d)
+        return bad
+
+    def scan(indices):
+        """Bisect to the refused docs in O(K log N) native parses —
+        parse failure is a per-buffer property, so a subset that parses
+        clean clears every doc in it."""
+        bufs = [bytes(b) for d in indices for b in work[d]]
+        if native.ingest_changes(bufs, None, with_meta=True,
+                                 with_seq=True) is not None:
+            return
+        if len(indices) == 1:
+            classify(indices[0])
+            return
+        mid = len(indices) // 2
+        scan(indices[:mid])
+        scan(indices[mid:])
+
+    scan(nonempty)
+    return bad
+
+
+def _apply_changes_docs_quarantine(handles, per_doc_changes, mirror):
+    """Fault-contained batched apply: the blast radius of a bad input is
+    ONE document. Returns (new_handles, patches, errors) with errors[i]
+    a DocError for each rejected doc (None for healthy ones).
+
+    Containment strategy: the turbo path validates the whole batch BEFORE
+    its device dispatch and raises typed, doc-scoped errors with full
+    rollback, so quarantine is a host-side retry loop — reject the
+    offender's slot, re-run the (host-only) parse+validation over the
+    survivors, and let the single fused device dispatch happen only on
+    the attempt that passes. Survivors therefore commit in exactly the
+    dispatches a clean batch of N-K docs would take (pinned by
+    tests/test_quarantine.py); each retry costs one host-side re-parse of
+    the surviving buffers, which is the right trade at K << N. When the
+    native parser refuses the whole flat batch (it cannot say which
+    buffer is corrupt), a per-doc header screen identifies the poisoned
+    docs and the batch retries without them. Workloads turbo cannot take
+    at all fall to the per-doc exact path, where isolation is free —
+    each doc's gate failure is caught and recorded individually."""
+    n = len(handles)
+    work = []
+    for d in range(n):
+        changes = per_doc_changes[d] if d < len(per_doc_changes) else []
+        work.append(list(changes) if changes else [])
+    errors = [None] * n
+
+    def reject(d, exc, stage):
+        errors[d] = DocError(d, stage, exc)
+        quarantine_stats['quarantined_docs'] += 1
+        quarantine_stats['rejected_changes'] += len(work[d])
+        work[d] = []
+
+    if not mirror:
+        screened = False
+        turbo = None
+        # Bounded: every iteration either returns/breaks or rejects >= 1
+        # doc, and only n docs exist
+        for _ in range(n + 1):
+            try:
+                with _gc_paused():
+                    turbo = _apply_changes_turbo(handles, work)
+            except AutomergeError as exc:
+                if exc.doc_index is None:
+                    raise     # not doc-scoped: genuinely batch-fatal
+                reject(exc.doc_index, exc, 'apply')
+                continue
+            if turbo is not None or screened:
+                break
+            # Native parse refused the flat batch without naming the
+            # offender: screen headers per doc, quarantine the corrupt
+            # ones, and give turbo one retry over the survivors
+            screened = True
+            bad = _screen_malformed_docs(work)
+            if not bad:
+                break             # turbo-ineligible workload, not corrupt
+            for d, exc in bad:
+                reject(d, exc, 'decode')
+        if turbo is not None:
+            out_handles, patches = turbo
+            return out_handles, patches, errors
+        for handle in handles:
+            state = handle.get('state')
+            if isinstance(state, FleetDoc) and state.is_fleet:
+                state.fleet.metrics.fallbacks += 1
+                break
+    # Exact / fallback path: the per-doc loop below is the SAME loop the
+    # non-quarantining exact path runs — device work still lands in ONE
+    # flush dispatch at the end (per-doc apply enqueues host-side), so
+    # isolation here is free, not a batching forfeit (pinned by
+    # test_exact_path_quarantine_isolates_per_doc's dispatch check).
+    out_handles, patches = [], []
+    for d, handle in enumerate(handles):
+        if work[d] and errors[d] is None:
+            try:
+                new_handle, patch = apply_changes(handle, work[d])
+            except Exception as exc:
+                # normalize so errors[d].error is ALWAYS typed — host
+                # gate ValueErrors arrive bare on this path
+                reject(d, as_wire_error(exc, InvalidChange, 'apply',
+                                        doc_index=d), 'apply')
+                new_handle, patch = handle, None
+        else:
+            new_handle, patch = handle, None
+        out_handles.append(new_handle)
+        patches.append(patch)
+    fleet = None
+    for handle in out_handles:
+        state = handle['state']
+        if isinstance(state, FleetDoc) and state.is_fleet:
+            fleet = state.fleet
+            break
+    if fleet is not None:
+        fleet.flush()
+    return out_handles, patches, errors
 
 
 class _TurboMetaBatch:
@@ -3076,8 +3248,17 @@ def _apply_changes_turbo(handles, per_doc_changes):
             applied, queue = engine._drain_queue(
                 [batch_meta.meta(i) for i in range(start, stop)],
                 lambda change: None)
-        except Exception:
+        except Exception as exc:
             restore_all()
+            # Gate errors are doc-scoped by construction (the drain loop
+            # runs one doc's changes): type them so a quarantining caller
+            # can reject slot d and retry the batch without it
+            if isinstance(exc, AutomergeError):
+                if exc.doc_index is None:
+                    exc.doc_index = d
+                raise
+            if isinstance(exc, ValueError):
+                raise InvalidChange(str(exc), doc_index=d) from exc
             raise
         staged.append((engine, applied, queue))
         for change in applied:
@@ -3091,9 +3272,12 @@ def _apply_changes_turbo(handles, per_doc_changes):
     if len(kept_packed_nat):
         kept_doc = change_doc[kept_change]
         pairs = kept_doc * (1 << 32) + kept_packed_nat
-        if len(np.unique(pairs)) != len(pairs):
+        uniq_pairs, pair_counts = np.unique(pairs, return_counts=True)
+        if len(uniq_pairs) != len(pairs):
             restore_all()
-            raise ValueError('duplicate operation ID in turbo batch')
+            bad_doc = int(uniq_pairs[pair_counts > 1][0] >> 32)
+            raise DuplicateOpId('duplicate operation ID in turbo batch',
+                                doc_index=bad_doc)
 
     # Dangling-pred validation (map-key rows): every pred must name an op
     # ROW on its key — in the slot's applied-op index (_op_index) or
@@ -3630,17 +3814,19 @@ def _validate_turbo_preds(fleet, engines, rows, keep, seq_sel, seq_make_sel,
     amap = np.array([fleet.actors.index.get(a, -1) for a in nat_actors],
                     dtype=np.int64) if nat_actors else np.zeros(1, np.int64)
 
-    def raise_dangling(p):
+    def raise_dangling(p, d):
         restore_all()
         pred = f'{p >> 8}@{nat_actors[p & (_MA - 1)]}'
-        raise ValueError(f'no matching operation for pred: {pred}')
+        raise DanglingPred(f'no matching operation for pred: {pred}',
+                           doc_index=d)
 
     key_cache = {}
     for i in np.flatnonzero(missing):
         p = int(pred_nat[i])
+        d = int(row_doc[owner[i]])
         pa = int(amap[p & (_MA - 1)])
         if pa < 0:
-            raise_dangling(p)
+            raise_dangling(p, d)
         o = int(rows['obj'][owner[i]])
         kn = int(rows['key'][owner[i]])
         fk = key_cache.get((o, kn), -2)
@@ -3653,12 +3839,12 @@ def _validate_turbo_preds(fleet, engines, rows, keep, seq_sel, seq_make_sel,
                 fk = fleet.keys.index.get((oid, ks))
             key_cache[(o, kn)] = fk
         if fk is None:
-            raise_dangling(p)
+            raise_dangling(p, d)
         pf = (p >> 8 << 8) | pa
-        slot = int(slot_arr[int(row_doc[owner[i]])])
+        slot = int(slot_arr[d])
         if not bool(fleet._index_lookup(
                 slot, np.array([(fk << 32) | pf], dtype=np.int64))[0]):
-            raise_dangling(p)
+            raise_dangling(p, d)
 
 
 def _max_pred_per_inc(pred_col, offs, counts, actor_map):

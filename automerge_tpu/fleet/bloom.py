@@ -317,22 +317,30 @@ def probe_bloom_filters_batch_begin(filter_bytes, hash_lists):
     for i, fb in enumerate(filter_bytes):
         if not fb or not hash_lists[i]:
             continue
-        decoder = Decoder(bytes(fb))
-        num_entries = decoder.read_uint32()
-        bits_per_entry = decoder.read_uint32()
-        num_probes = decoder.read_uint32()
-        if num_entries == 0:
+        try:
+            from ..backend.sync import read_filter_header
+            decoder = Decoder(bytes(fb))
+            num_entries, bits_per_entry, num_probes, n_bytes = \
+                read_filter_header(decoder)
+            if num_entries == 0:
+                continue
+            if bits_per_entry != BITS_PER_ENTRY or num_probes != NUM_PROBES:
+                # The wire format carries these so they can vary
+                # (sync.js:68-76); nonstandard peers fall back to the
+                # generic host filter rather than failing the whole batch
+                from ..backend.sync import BloomFilter
+                host = BloomFilter(bytes(fb))
+                out[i] = [host.contains_hash(h) for h in hash_lists[i]]
+                continue
+            raw = decoder.read_raw_bytes(n_bytes)
+        except Exception:
+            # Corrupt filter bytes read as all-False ("peer has nothing":
+            # resend everything) instead of aborting the other N-1 docs'
+            # probes — same containment rule as the host path's
+            # probe_filter_lenient; the shared counter records it
+            from ..backend.sync import _wire_stats
+            _wire_stats['rejected_filters'] += 1
             continue
-        if bits_per_entry != BITS_PER_ENTRY or num_probes != NUM_PROBES:
-            # The wire format carries these so they can vary (sync.js:68-76);
-            # nonstandard peers fall back to the generic host filter rather
-            # than failing the whole batch
-            from ..backend.sync import BloomFilter
-            host = BloomFilter(bytes(fb))
-            out[i] = [host.contains_hash(h) for h in hash_lists[i]]
-            continue
-        raw = decoder.read_raw_bytes(
-            (num_entries * bits_per_entry + 7) // 8)
         rows.append((i, np.frombuffer(raw, dtype=np.uint8), 8 * len(raw)))
     if not rows:
         return out, hash_lists, None, None

@@ -22,6 +22,14 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..errors import SyncOverflow
+from ..observability import register_health_source
+
+# Fault-containment roll-up: extra sub-rounds paid to move over-limit sync
+# payloads through the fixed-width wire (sync_round_multihost chunking).
+_sync_stats = {'sync_retries': 0}
+register_health_source('sync_retries', lambda: _sync_stats['sync_retries'])
+
 
 def pack_outboxes(per_dest_payloads, max_len=None):
     """per_dest_payloads: list over destination shards of bytes objects
@@ -179,7 +187,8 @@ def local_shard_ids(mesh, axis):
     return [int(i) for i, d in enumerate(devs) if d.process_index == me]
 
 
-def sync_round_multihost(mesh, axis, generate, receive, max_msg=1 << 16):
+def sync_round_multihost(mesh, axis, generate, receive, max_msg=1 << 16,
+                         max_chunks=64):
     """One pairwise sync round over a MULTI-PROCESS mesh (true multi-host:
     each controller holds only its local shards' documents, the payload
     matrix rides the same all_to_all — ICI within a host, DCN across
@@ -188,13 +197,25 @@ def sync_round_multihost(mesh, axis, generate, receive, max_msg=1 << 16):
     `receive(dst, src, payload)` are called ONLY for src/dst shards local
     to this process. Payloads are padded to `max_msg` bytes (a fixed
     global width keeps every controller's data shapes identical without a
-    per-round width negotiation). An over-limit payload must fail on ALL
-    controllers or the others would block in the collective, so the
-    locally-observed max rides a tiny allgather first and every process
-    raises the same error together. Returns the round's GLOBAL non-empty
-    payload count — identical on every controller, so callers can branch
-    on it without desyncing the collective; an all-empty round returns 0
-    without paying the padded all_to_all."""
+    per-round width negotiation).
+
+    Graceful degradation: a payload larger than `max_msg` no longer kills
+    the round — the round splits into ceil(global_max / max_msg)
+    fixed-width SUB-ROUNDS, sub-round t carrying every payload's bytes
+    [t*max_msg, (t+1)*max_msg); receivers reassemble and deliver each
+    payload once complete. Every controller derives the same sub-round
+    count from the agreement allgather's global max, so the collectives
+    stay SPMD-lock-step with no extra negotiation, and a normal-size
+    round still pays exactly one all_to_all. The extra sub-rounds land in
+    the 'sync_retries' health counter. Only a payload beyond
+    max_msg * max_chunks raises — a typed `SyncOverflow` carrying
+    (global_max, max_msg, max_chunks, locally-determinable offending
+    pairs), raised identically on every controller (the condition is a
+    function of allgathered values alone), so no peer is left blocking
+    inside the collective. Returns the round's GLOBAL non-empty payload
+    count — identical on every controller, so callers can branch on it
+    without desyncing; an all-empty round returns 0 without paying the
+    padded all_to_all."""
     n = mesh.shape[axis]
     mine = local_shard_ids(mesh, axis)
     per_src = []
@@ -206,39 +227,57 @@ def sync_round_multihost(mesh, axis, generate, receive, max_msg=1 << 16):
         sent += sum(1 for p in payloads if p)
         per_src.append(payloads)
     # SPMD-safe agreement round: every controller sees the global max
-    # payload size (raise identically on overflow, never deadlocking
-    # peers inside the collective) and the global sent count (an
-    # all-empty round returns 0 everywhere WITHOUT paying the padded
-    # all_to_all — the lock-step convergence signal).
+    # payload size (identical overflow/chunking decisions everywhere,
+    # never deadlocking peers inside the collective) and the global sent
+    # count (an all-empty round returns 0 everywhere WITHOUT paying the
+    # padded all_to_all — the lock-step convergence signal).
     from jax.experimental import multihost_utils
     agg = np.asarray(multihost_utils.process_allgather(
         np.array([biggest, sent], dtype=np.int64))).reshape(-1, 2)
     global_max, global_sent = int(agg[:, 0].max()), int(agg[:, 1].sum())
-    if global_max > max_msg:
-        raise ValueError(f'sync message {global_max}B exceeds '
-                         f'max_msg={max_msg}')
+    hard_limit = max_msg * max_chunks
+    if global_max > hard_limit:
+        pairs = [(src, dst)
+                 for src, payloads in zip(mine, per_src)
+                 for dst, p in enumerate(payloads) if len(p) > hard_limit]
+        raise SyncOverflow(
+            f'sync message {global_max}B exceeds max_msg={max_msg} x '
+            f'max_chunks={max_chunks}', global_max=global_max,
+            max_msg=max_msg, max_chunks=max_chunks, pairs=pairs)
     if global_sent == 0:
         return 0
-    rows = np.zeros((len(mine), n, max_msg), dtype=np.uint8)
-    lens = np.zeros((len(mine), n), dtype=np.int32)
-    for r, payloads in enumerate(per_src):
-        rows[r], lens[r] = pack_outboxes(payloads, max_len=max_msg)
+    n_sub = -(-global_max // max_msg) if global_max else 1
+    if n_sub > 1:
+        _sync_stats['sync_retries'] += n_sub - 1
     sh_data = NamedSharding(mesh, P(axis, None, None))
     sh_lens = NamedSharding(mesh, P(axis, None))
-    data = jax.make_array_from_process_local_data(sh_data, rows,
-                                                  (n, n, max_msg))
-    lens_g = jax.make_array_from_process_local_data(sh_lens, lens, (n, n))
-    inboxes, in_lens = exchange_changes(mesh, axis, data, lens_g)
-    lens_local = {}
-    for shard in in_lens.addressable_shards:
-        dst = shard.index[0].start or 0
-        lens_local[dst] = np.asarray(shard.data)[0]
-    for shard in inboxes.addressable_shards:
-        dst = shard.index[0].start or 0
-        for src, payload in enumerate(
-                unpack_inbox(np.asarray(shard.data)[0], lens_local[dst])):
-            if payload:
-                receive(dst, src, payload)
+    inbox_acc = {}        # (dst, src) -> bytearray of reassembled fragments
+    for t in range(n_sub):
+        lo = t * max_msg
+        rows = np.zeros((len(mine), n, max_msg), dtype=np.uint8)
+        lens = np.zeros((len(mine), n), dtype=np.int32)
+        for r, payloads in enumerate(per_src):
+            rows[r], lens[r] = pack_outboxes(
+                [p[lo:lo + max_msg] for p in payloads], max_len=max_msg)
+        data = jax.make_array_from_process_local_data(sh_data, rows,
+                                                      (n, n, max_msg))
+        lens_g = jax.make_array_from_process_local_data(sh_lens, lens,
+                                                        (n, n))
+        inboxes, in_lens = exchange_changes(mesh, axis, data, lens_g)
+        lens_local = {}
+        for shard in in_lens.addressable_shards:
+            dst = shard.index[0].start or 0
+            lens_local[dst] = np.asarray(shard.data)[0]
+        for shard in inboxes.addressable_shards:
+            dst = shard.index[0].start or 0
+            for src, fragment in enumerate(
+                    unpack_inbox(np.asarray(shard.data)[0],
+                                 lens_local[dst])):
+                if fragment:
+                    inbox_acc.setdefault((dst, src),
+                                         bytearray()).extend(fragment)
+    for (dst, src), payload in inbox_acc.items():
+        receive(dst, src, bytes(payload))
     # the GLOBAL count, identical on every controller: callers may branch
     # on it (the driver's lock-step break) — a process-local count here
     # would desync the round loops and deadlock the next collective
@@ -246,7 +285,8 @@ def sync_round_multihost(mesh, axis, generate, receive, max_msg=1 << 16):
 
 
 def drive_pairwise_sync_multihost(mesh, axis, local_docs, backend_module,
-                                  max_rounds=None, max_msg=1 << 16):
+                                  max_rounds=None, max_msg=1 << 16,
+                                  max_chunks=64):
     """drive_pairwise_sync for a multi-controller mesh: `local_docs` maps
     THIS process's global shard id -> backend doc. Every controller runs
     the same round loop, and each round's agreement allgather carries the
@@ -263,6 +303,7 @@ def drive_pairwise_sync_multihost(mesh, axis, local_docs, backend_module,
     for _ in range(max_rounds if max_rounds is not None else 2 * n):
         rounds += 1
         if sync_round_multihost(mesh, axis, generate, receive,
-                                max_msg=max_msg) == 0:
+                                max_msg=max_msg,
+                                max_chunks=max_chunks) == 0:
             break
     return rounds

@@ -35,7 +35,8 @@ from ..backend.sync import (
     _cached_meta, advance_heads, changes_to_send_finish,
     changes_to_send_prescan, decode_sync_message, encode_sync_message,
 )
-from .backend import apply_changes_docs
+from ..errors import DocError, MalformedSyncMessage, as_wire_error
+from .backend import apply_changes_docs, quarantine_stats
 from .bloom import (
     build_bloom_filters_batch_begin, build_bloom_filters_batch_finish,
     dispatch_count, probe_bloom_filters_batch_begin,
@@ -158,29 +159,63 @@ def generate_sync_messages_docs(backends, sync_states):
 
 
 def receive_sync_messages_docs(backends, sync_states, binary_messages,
-                               mirror=True):
+                               mirror=True, on_error='raise'):
     """Batched ``receive_sync_message`` over N docs. messages[i] may be None
     (no-op for that doc). All received changes apply through ONE
     apply_changes_docs call (device turbo batch with mirror=False on fleet
-    backends). Returns (new_backends, new_sync_states, patches)."""
+    backends). Returns (new_backends, new_sync_states, patches) — or, with
+    on_error='quarantine', (new_backends, new_sync_states, patches,
+    errors): an undecodable message or a poisoned change quarantines ONLY
+    its own doc (errors[i] is a DocError; that doc's backend and sync
+    state stay untouched) while the other N-1 docs commit in the same
+    fused dispatch. on_error='raise' aborts the round on the first bad
+    input (classic contract), with a typed exception carrying the doc
+    index. Messages are decoded per doc EITHER way, so the exception
+    names the offender instead of dying mid-list."""
     n = len(backends)
     if len(sync_states) != n or len(binary_messages) != n:
         raise ValueError('backends, sync_states, and messages must align')
-    decoded = [decode_sync_message(m) if m is not None else None
-               for m in binary_messages]
+    quarantine = on_error == 'quarantine'
+    if not quarantine and on_error != 'raise':
+        raise ValueError(f"on_error must be 'raise' or 'quarantine', "
+                         f"got {on_error!r}")
+    errors = [None] * n
+    decoded = [None] * n
+    for i, message_bytes in enumerate(binary_messages):
+        if message_bytes is None:
+            continue
+        try:
+            decoded[i] = decode_sync_message(message_bytes)
+        except Exception as exc:
+            err = as_wire_error(exc, MalformedSyncMessage,
+                                'receive_sync_messages_docs', doc_index=i)
+            if not quarantine:
+                raise err
+            errors[i] = DocError(i, 'decode', err)
+            quarantine_stats['quarantined_docs'] += 1
     before_heads = [get_heads(b) for b in backends]
 
     per_doc_changes = [list(d['changes']) if d else [] for d in decoded]
     if any(per_doc_changes):
-        new_backends, patches = apply_changes_docs(backends, per_doc_changes,
-                                                   mirror=mirror)
+        if quarantine:
+            new_backends, patches, apply_errors = apply_changes_docs(
+                backends, per_doc_changes, mirror=mirror,
+                on_error='quarantine')
+            for i, err in enumerate(apply_errors):
+                if err is not None and errors[i] is None:
+                    errors[i] = err
+        else:
+            new_backends, patches = apply_changes_docs(
+                backends, per_doc_changes, mirror=mirror)
     else:
         new_backends, patches = list(backends), [None] * n
 
     new_states = []
     for i, (backend, state) in enumerate(zip(new_backends, sync_states)):
         message = decoded[i]
-        if message is None:
+        if message is None or errors[i] is not None:
+            # quarantined docs keep their pre-round sync state: the peer
+            # retries from the last good handshake, nothing is half-advanced
             new_states.append(state)
             continue
         shared_heads = state['sharedHeads']
@@ -208,4 +243,6 @@ def receive_sync_messages_docs(backends, sync_states, binary_messages,
             'theirNeed': message['need'],
             'sentHashes': sent_hashes,
         })
+    if quarantine:
+        return new_backends, new_states, patches, errors
     return new_backends, new_states, patches

@@ -14,6 +14,8 @@ import sys
 
 import numpy as np
 
+from ..errors import MalformedChange
+
 _SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'codec.cpp')
 _LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          f'_codec_{sys.implementation.cache_tag}.so')
@@ -204,9 +206,9 @@ def _decode_column(fn_name, buf, signed=False):
                 return out[:n], mask[:n].astype(bool)
             cap *= 4
             if cap > 1 << 30:
-                raise ValueError('malformed boolean column')
+                raise MalformedChange('malformed boolean column')
     if count < 0:
-        raise ValueError('malformed column')
+        raise MalformedChange('malformed column')
     out = np.zeros(max(count, 1), dtype=np.int64)
     mask = np.zeros(max(count, 1), dtype=np.uint8)
     fn = lib.am_decode_rle if fn_name == 'rle' else lib.am_decode_delta
@@ -218,7 +220,7 @@ def _decode_column(fn_name, buf, signed=False):
              max(count, 1)]
     n = fn(*args)
     if n < 0:
-        raise ValueError('malformed column')
+        raise MalformedChange('malformed column')
     return out[:n], mask[:n].astype(bool)
 
 

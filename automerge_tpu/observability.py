@@ -23,6 +23,13 @@ build plan names: per-dispatch op counters and JAX profiler traces.
   register a monotonic counter here, so bench.py and the dispatch-count
   regression tests can diff total device dispatches around a workload
   without knowing which modules dispatched.
+- `register_health_source(name, fn)` / `health_counts()`: the same
+  roll-up pattern for fault-containment counters — quarantined docs,
+  rejected changes/filters, sync retries, injected wire faults, fuzz
+  corpus size. The modules that absorb bad input register monotonic
+  counters at import; bench.py reports the roll-up per run and the chaos
+  tests diff it around a workload to prove corruption was contained
+  (counter moved) rather than silently dropped or fatally propagated.
 """
 
 import contextlib
@@ -106,6 +113,25 @@ def dispatch_counts(fleets=()):
         out[f'fleet{i}'] = int(fleet.metrics.dispatches)
     out['total'] = sum(out.values())
     return out
+
+
+# ---- fault-containment health roll-up -------------------------------------
+
+_health_sources = {}
+
+
+def register_health_source(name, fn):
+    """Register a zero-arg callable returning a module's monotonic
+    fault-containment counter (quarantined docs, rejected changes, sync
+    retries, injected wire faults, ...). Re-registering a name replaces
+    the source — same contract as register_dispatch_source."""
+    _health_sources[name] = fn
+
+
+def health_counts():
+    """Snapshot every registered health counter. Counters are monotonic;
+    subtract two snapshots around a workload to attribute events to it."""
+    return {name: int(fn()) for name, fn in _health_sources.items()}
 
 
 @contextlib.contextmanager

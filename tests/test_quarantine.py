@@ -1,0 +1,261 @@
+"""Fault-isolated batched apply: a batch of N docs with K poisoned inputs
+must commit the N-K healthy docs in the SAME fused dispatch (no per-doc
+fallback for the survivors), return K structured per-doc errors, and leave
+the survivors byte-identical to a control universe that never saw the
+poison. The same contract through the sync driver's receive path."""
+
+import pytest
+
+import automerge_tpu as A
+from automerge_tpu import native, observability
+from automerge_tpu.backend.sync import encode_sync_message
+from automerge_tpu.columnar import encode_change
+from automerge_tpu.errors import (DanglingPred, DocError, DuplicateOpId,
+                                  MalformedChange, MalformedSyncMessage)
+from automerge_tpu.fleet import backend as fleet_backend
+from automerge_tpu.fleet.backend import (DocFleet, init_docs,
+                                         materialize_docs, quarantine_stats)
+from automerge_tpu.fleet.sync_driver import receive_sync_messages_docs
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason='native codec unavailable')
+
+
+def _change(actor, key, value, seq=1, start_op=None, deps=(), pred=()):
+    return encode_change({
+        'actor': actor, 'seq': seq, 'startOp': start_op or seq, 'time': 0,
+        'message': '', 'deps': list(deps),
+        'ops': [{'action': 'set', 'obj': '_root', 'key': key,
+                 'value': value, 'datatype': 'int', 'pred': list(pred)}]})
+
+
+def _flip(buf, pos=10):
+    out = bytearray(buf)
+    out[pos] ^= 0xFF
+    return bytes(out)
+
+
+def _poisoned_workload(n):
+    """n docs, one flat change each, with doc 2 corrupt (checksum-breaking
+    bit flip) and doc 4 causally invalid (dangling pred)."""
+    per_doc = [[_change(f'{i:02x}' * 16, f'k{i}', i)] for i in range(n)]
+    per_doc[2] = [_flip(per_doc[2][0])]
+    per_doc[4] = [encode_change({
+        'actor': 'ee' * 16, 'seq': 1, 'startOp': 5, 'time': 0,
+        'message': '', 'deps': [],
+        'ops': [{'action': 'set', 'obj': '_root', 'key': 'kx', 'value': 9,
+                 'datatype': 'int', 'pred': ['3@' + 'dd' * 16]}]})]
+    return per_doc
+
+
+def test_poisoned_batch_quarantines_only_offenders():
+    n = 6
+    fleet = DocFleet(doc_capacity=8, key_capacity=16)
+    handles = init_docs(n, fleet)
+    per_doc = _poisoned_workload(n)
+    stats_before = dict(quarantine_stats)
+
+    new_handles, patches, errors = fleet_backend.apply_changes_docs(
+        handles, per_doc, mirror=False, on_error='quarantine')
+
+    assert isinstance(errors[2], DocError)
+    assert isinstance(errors[2].error, MalformedChange)
+    assert errors[2].stage == 'decode'
+    assert isinstance(errors[4], DocError)
+    assert isinstance(errors[4].error, DanglingPred)
+    assert errors[4].error.doc_index == 4
+    assert [i for i, e in enumerate(errors) if e is None] == [0, 1, 3, 5]
+    assert quarantine_stats['quarantined_docs'] == \
+        stats_before['quarantined_docs'] + 2
+    assert quarantine_stats['rejected_changes'] == \
+        stats_before['rejected_changes'] + 2
+
+    mats = materialize_docs(new_handles)
+    assert mats[2] == {} and mats[4] == {}        # offenders rolled back
+    for i in (0, 1, 3, 5):
+        assert mats[i] == {f'k{i}': i}            # survivors committed
+
+
+def test_survivors_commit_in_same_fused_dispatch():
+    """Dispatch-count regression: K rejected docs must add ZERO device
+    dispatches over a clean batch of the N-K survivors — quarantine is a
+    host-side retry, never a per-doc fallback for the healthy docs."""
+    n = 6
+    fleet = DocFleet(doc_capacity=8, key_capacity=16)
+    handles = init_docs(n, fleet)
+    before = observability.dispatch_counts([fleet])
+    _, _, errors = fleet_backend.apply_changes_docs(
+        handles, _poisoned_workload(n), mirror=False, on_error='quarantine')
+    after = observability.dispatch_counts([fleet])
+    assert sum(1 for e in errors if e) == 2
+
+    control = DocFleet(doc_capacity=8, key_capacity=16)
+    chandles = init_docs(4, control)
+    clean = [[_change(f'{i:02x}' * 16, f'k{i}', i)] for i in (0, 1, 3, 5)]
+    cbefore = observability.dispatch_counts([control])
+    fleet_backend.apply_changes_docs(chandles, clean, mirror=False)
+    cafter = observability.dispatch_counts([control])
+
+    assert after['fleet0'] - before['fleet0'] == \
+        cafter['fleet0'] - cbefore['fleet0']
+    assert after['total'] - after['fleet0'] - \
+        (before['total'] - before['fleet0']) == \
+        cafter['total'] - cafter['fleet0'] - \
+        (cbefore['total'] - cbefore['fleet0'])
+
+
+def test_survivors_byte_identical_to_control_universe():
+    """No healthy doc's state may be perturbed by a quarantined neighbour:
+    survivor save bytes must equal a universe that never saw the poison."""
+    n = 6
+    fleet = DocFleet(doc_capacity=8, key_capacity=16)
+    handles = init_docs(n, fleet)
+    new_handles, _, errors = fleet_backend.apply_changes_docs(
+        handles, _poisoned_workload(n), mirror=False, on_error='quarantine')
+
+    control = DocFleet(doc_capacity=8, key_capacity=16)
+    chandles = init_docs(n, control)
+    clean = _poisoned_workload(n)
+    clean[2], clean[4] = [], []                   # the poison never existed
+    chandles, _ = fleet_backend.apply_changes_docs(chandles, clean,
+                                                   mirror=False)
+    for i in (0, 1, 3, 5):
+        assert bytes(fleet_backend.save(new_handles[i])) == \
+            bytes(fleet_backend.save(chandles[i])), f'doc {i} perturbed'
+
+
+def test_duplicate_opid_is_typed_and_scoped():
+    fleet = DocFleet(doc_capacity=4, key_capacity=16)
+    handles = init_docs(2, fleet)
+    actor = 'cc' * 16
+    good = _change('aa' * 16, 'g', 1)
+    c1 = _change(actor, 'a', 1, seq=1)
+    from automerge_tpu.columnar import decode_change
+    meta = decode_change(c1)
+    dup = encode_change({
+        'actor': actor, 'seq': 2, 'startOp': 1, 'time': 0, 'message': '',
+        'deps': [meta['hash']],
+        'ops': [{'action': 'set', 'obj': '_root', 'key': 'b', 'value': 2,
+                 'datatype': 'int', 'pred': []}]})
+    with pytest.raises(DuplicateOpId) as ei:
+        fleet_backend.apply_changes_docs(handles, [[good], [c1, dup]],
+                                         mirror=False)
+    assert ei.value.doc_index == 1
+    # quarantine mode: doc 0 commits, doc 1 rejected with the same error
+    fleet2 = DocFleet(doc_capacity=4, key_capacity=16)
+    handles2 = init_docs(2, fleet2)
+    new_handles, _, errors = fleet_backend.apply_changes_docs(
+        handles2, [[good], [c1, dup]], mirror=False, on_error='quarantine')
+    assert errors[0] is None
+    assert isinstance(errors[1].error, DuplicateOpId)
+    assert materialize_docs(new_handles) == [{'g': 1}, {}]
+
+
+def test_exact_path_quarantine_isolates_per_doc():
+    """mirror=True (exact path): per-doc isolation comes from the per-doc
+    loop; a poisoned doc must not stop later docs from applying — and the
+    device work still lands in the exact path's single flush dispatch
+    (quarantine costs the exact path no batching, clean or poisoned)."""
+    n = 4
+    fleet = DocFleet(doc_capacity=4, key_capacity=16)
+    handles = init_docs(n, fleet)
+    per_doc = [[_change(f'{i:02x}' * 16, f'k{i}', i)] for i in range(n)]
+    per_doc[1] = [_flip(per_doc[1][0])]
+    before = observability.dispatch_counts([fleet])
+    new_handles, patches, errors = fleet_backend.apply_changes_docs(
+        handles, per_doc, mirror=True, on_error='quarantine')
+    after = observability.dispatch_counts([fleet])
+    assert isinstance(errors[1].error, MalformedChange)
+    assert [i for i, e in enumerate(errors) if e is None] == [0, 2, 3]
+    assert patches[0] is not None and patches[2] is not None
+
+    control = DocFleet(doc_capacity=4, key_capacity=16)
+    chandles = init_docs(n, control)
+    clean = [[_change(f'{i:02x}' * 16, f'k{i}', i)] for i in range(n)]
+    clean[1] = []
+    cbefore = observability.dispatch_counts([control])
+    fleet_backend.apply_changes_docs(chandles, clean, mirror=True)
+    cafter = observability.dispatch_counts([control])
+    assert after['fleet0'] - before['fleet0'] == \
+        cafter['fleet0'] - cbefore['fleet0']
+
+    mats = materialize_docs(new_handles)
+    assert mats == [{'k0': 0}, {}, {'k2': 2}, {'k3': 3}]
+
+
+def test_exact_path_quarantine_errors_always_typed():
+    """The fallback path must normalize bare gate ValueErrors into typed
+    AutomergeError subclasses — on host backends too."""
+    from automerge_tpu import backend as host
+    from automerge_tpu.errors import AutomergeError, InvalidChange
+    handles = [host.init(), host.init()]
+    actor = 'ab' * 16
+    skipped_seq = _change(actor, 'k', 1, seq=3)   # seq 3 with empty clock
+    good = _change('cd' * 16, 'g', 2)
+    new_handles, _, errors = fleet_backend.apply_changes_docs(
+        handles, [[skipped_seq], [good]], mirror=True,
+        on_error='quarantine')
+    assert errors[1] is None
+    assert isinstance(errors[0].error, AutomergeError)
+    assert isinstance(errors[0].error, InvalidChange)
+    assert errors[0].error.doc_index == 0
+
+
+def test_receive_sync_messages_quarantine():
+    """An undecodable sync message (or one carrying a poisoned change)
+    rejects only its own doc: the other peers' applies share the fused
+    dispatch, the offender's sync state stays untouched."""
+    from automerge_tpu import backend as host
+    from automerge_tpu.backend import init_sync_state
+
+    n = 4
+    fleet = DocFleet(doc_capacity=4, key_capacity=16)
+    handles = init_docs(n, fleet)
+    states = [init_sync_state() for _ in range(n)]
+
+    src = A.init('aa' * 16)
+    src = A.change(src, {'time': 0}, lambda d: d.update({'x': 1}))
+    src_b = A.Frontend.get_backend_state(src, 'q')
+    good_change = bytes(A.get_all_changes(src)[0])
+    msg = encode_sync_message(
+        {'heads': host.get_heads(src_b), 'need': [], 'have': [],
+         'changes': [good_change]})
+
+    poisoned_change = _flip(good_change)
+    poison_msg = encode_sync_message(
+        {'heads': host.get_heads(src_b), 'need': [], 'have': [],
+         'changes': [poisoned_change]})
+
+    msgs = [msg, bytes([0x13]) + msg[1:], poison_msg, msg]
+    new_backends, new_states, patches, errors = receive_sync_messages_docs(
+        handles, states, msgs, mirror=False, on_error='quarantine')
+
+    assert errors[0] is None and errors[3] is None
+    assert isinstance(errors[1].error, MalformedSyncMessage)
+    assert errors[1].stage == 'decode'
+    assert isinstance(errors[2].error, MalformedChange)
+    assert new_states[1] is states[1] and new_states[2] is states[2]
+    assert new_states[0]['theirHeads'] == host.get_heads(src_b)
+    mats = materialize_docs(new_backends)
+    assert mats[0] == {'x': 1} and mats[3] == {'x': 1}
+    assert mats[1] == {} and mats[2] == {}
+
+    # raise mode names the offender
+    with pytest.raises(MalformedSyncMessage) as ei:
+        receive_sync_messages_docs(handles, states, msgs, mirror=False)
+    assert ei.value.doc_index == 1
+
+
+def test_quarantine_on_host_backends_too():
+    """The quarantining apply works over plain host backends (no fleet in
+    the batch): containment is a seam property, not a device feature."""
+    from automerge_tpu import backend as host
+    handles = [host.init() for _ in range(3)]
+    per_doc = [[_change(f'{i:02x}' * 16, f'k{i}', i)] for i in range(3)]
+    per_doc[1] = [_flip(per_doc[1][0])]
+    new_handles, patches, errors = \
+        fleet_backend.apply_changes_docs(handles, per_doc, mirror=True,
+                                         on_error='quarantine')
+    assert isinstance(errors[1].error, MalformedChange)
+    assert host.get_heads(new_handles[0]) and host.get_heads(new_handles[2])
+    assert not host.get_heads(new_handles[1])

@@ -1,0 +1,270 @@
+"""Seeded byte-level fuzzer over every wire decoder.
+
+The failure-containment contract (BASELINE.md) promises that hostile bytes
+fed to any decode entry point raise a TYPED error — `MalformedChange`,
+`MalformedDocument`, `MalformedSyncMessage` (all `WireCorruption`,
+all ValueError) — and never a bare `IndexError`/`KeyError`/
+`AssertionError`, a segfault, or a hang. This tool is the enforcement:
+build a corpus of VALID wire artifacts (changes, a saved document, a sync
+message, native column buffers, Bloom filter bytes), derive hostile
+mutants (truncate, splice, bit-flip, byte-set, prefix-garbage), and feed
+every mutant to every decoder, recording anything that escapes the typed
+envelope.
+
+Targets:
+- columnar.decode_change / decode_change_meta / split_containers
+- columnar.decode_document (and through it the loader's parked-chunk path)
+- backend.sync.decode_sync_message / decode_sync_state
+- fleet.loader.load_docs (native document parse + install, per-doc
+  fallback) — must return handles or raise typed, and NEVER poison a
+  neighbouring doc in the same batch
+- native.decode_rle_column / decode_delta_column / decode_boolean_column
+  (the C++ codec's bounds discipline; skipped when the toolchain is absent)
+- fleet.bloom.probe_bloom_filters_batch — corrupt filter bytes must
+  probe as all-False (containment), never raise
+- apply_changes_docs(on_error='quarantine') over a poisoned batch — the
+  healthy neighbour doc must commit and read back intact
+
+Dose scales like tests/test_chaos.py: FUZZ_SEEDS x FUZZ_CASES mutants per
+target (env-overridable); tests/test_fuzz_wire.py runs a small smoke dose
+in tier-1, `python tools/fuzz_wire.py` a 10x default dose standalone.
+The corpus size lands in the 'fuzz_corpus_size' health counter.
+"""
+
+import os
+import random
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import automerge_tpu as A                                    # noqa: E402
+from automerge_tpu import native                             # noqa: E402
+from automerge_tpu.backend.sync import (                     # noqa: E402
+    decode_sync_message, decode_sync_state, encode_sync_state,
+    init_sync_state)
+from automerge_tpu.columnar import (                         # noqa: E402
+    decode_change, decode_change_meta, decode_document, encode_change,
+    split_containers)
+from automerge_tpu.errors import AutomergeError  # noqa: E402
+from automerge_tpu.observability import register_health_source   # noqa: E402
+
+# Typed envelope: what a decoder may raise on hostile bytes. InvalidChange
+# (causal rules) is included because a mutant can decode into a
+# causally-nonsense change; everything else is an escape.
+ALLOWED = (AutomergeError,)
+
+_corpus_size = [0]
+register_health_source('fuzz_corpus_size', lambda: _corpus_size[0])
+
+HANG_SECONDS = 20
+
+
+class _Hang(Exception):
+    pass
+
+
+def _alarm(signum, frame):
+    raise _Hang(f'decoder exceeded {HANG_SECONDS}s')
+
+
+def build_corpus():
+    """Valid wire artifacts to mutate: binary changes (flat + nested +
+    deflated-sized), a saved document, sync messages, a sync state, raw
+    column buffers."""
+    docs = []
+    d = A.init('aa' * 16)
+    d = A.change(d, {'time': 0}, lambda r: r.update(
+        {'text': A.Text('seed text'), 'list': [1, 2, 3],
+         'nested': {'k': 'v'}, 'n': 42}))
+    d = A.change(d, {'time': 0}, lambda r: r.update(
+        {'big': 'x' * 600, 'f': 2.5, 'b': True}))
+    e = A.merge(A.init('bb' * 16), d)
+    e = A.change(e, {'time': 0}, lambda r: r.update({'other': 7}))
+    d = A.merge(d, e)
+    changes = [bytes(c) for c in A.get_all_changes(d)]
+    saved = bytes(A.save(d))
+
+    backend = A.Frontend.get_backend_state(d, 'fuzz')
+    from automerge_tpu import backend as host
+    s1 = init_sync_state()
+    _, sync_msg = host.generate_sync_message(backend, s1)
+    state_bytes = bytes(encode_sync_state(
+        {'sharedHeads': host.get_heads(backend)}))
+
+    from automerge_tpu.backend.sync import BloomFilter
+    bloom = BloomFilter([c_meta for c_meta in
+                         (host.get_heads(backend) * 4)]).bytes
+
+    corpus = {
+        'change': changes,
+        'document': [saved],
+        'sync_message': [bytes(sync_msg)],
+        'sync_state': [state_bytes],
+        'bloom': [bytes(bloom)],
+        'column': [bytes(c[12:48]) for c in changes],   # raw column-ish runs
+    }
+    _corpus_size[0] = sum(len(v) for v in corpus.values())
+    return corpus
+
+
+def mutate(rng, data):
+    """One hostile mutant of `data` (possibly multiple stacked faults)."""
+    out = bytearray(data)
+    for _ in range(rng.randrange(1, 4)):
+        roll = rng.random()
+        if roll < 0.25 and out:                       # truncate
+            del out[rng.randrange(len(out)):]
+        elif roll < 0.45 and out:                     # bit flip
+            pos = rng.randrange(len(out))
+            out[pos] ^= 1 << rng.randrange(8)
+        elif roll < 0.60 and out:                     # byte set
+            out[rng.randrange(len(out))] = rng.randrange(256)
+        elif roll < 0.75:                             # splice garbage
+            pos = rng.randrange(len(out) + 1)
+            out[pos:pos] = bytes(rng.randrange(256)
+                                 for _ in range(rng.randrange(1, 9)))
+        elif roll < 0.9 and len(out) > 2:             # cut a window
+            a = rng.randrange(len(out))
+            b = min(len(out), a + rng.randrange(1, 17))
+            del out[a:b]
+        else:                                         # duplicate a window
+            a = rng.randrange(len(out) + 1)
+            out[a:a] = out[:rng.randrange(0, 17)]
+    return bytes(out)
+
+
+def _targets():
+    """(name, callable(mutant)) pairs. Callables either succeed (a mutant
+    may decode to something valid) or raise inside ALLOWED."""
+    targets = [
+        ('decode_change', decode_change),
+        ('decode_change_meta', lambda b: decode_change_meta(b, True)),
+        ('split_containers', split_containers),
+        ('decode_document', decode_document),
+        ('decode_sync_message', decode_sync_message),
+        ('decode_sync_state', decode_sync_state),
+    ]
+    if native.available():
+        targets += [
+            ('native_rle', native.decode_rle_column),
+            ('native_delta', native.decode_delta_column),
+            ('native_boolean', native.decode_boolean_column),
+        ]
+    return targets
+
+
+def _probe_bloom_target(mutant):
+    """Corrupt filter bytes must probe lenient (all-False), never raise."""
+    from automerge_tpu.fleet.bloom import probe_bloom_filters_batch
+    hashes = ['ab' * 32, 'cd' * 32]
+    probe_bloom_filters_batch([mutant], [hashes])
+
+
+def _loader_target(corpus):
+    """One corrupt + one healthy doc through the batched loader: typed
+    containment AND the healthy neighbour must install."""
+    from automerge_tpu.fleet.backend import DocFleet, get_heads
+    from automerge_tpu.fleet.loader import load_docs
+
+    def run(mutant):
+        fleet = DocFleet(doc_capacity=4, key_capacity=64)
+        good = corpus['document'][0]
+        try:
+            handles = load_docs([mutant, good], fleet)
+        except ALLOWED:
+            return
+        assert get_heads(handles[1]), 'healthy doc failed to install'
+    return run
+
+
+def _quarantine_target(corpus):
+    """One poisoned + one healthy change batch through the quarantining
+    apply: errors stay typed, the neighbour commits."""
+    from automerge_tpu.fleet import backend as fb
+    from automerge_tpu.fleet.backend import DocFleet, init_docs
+
+    def run(mutant):
+        fleet = DocFleet(doc_capacity=4, key_capacity=64)
+        handles = init_docs(2, fleet)
+        good = corpus['change'][0]
+        new_handles, _patches, errors = fb.apply_changes_docs(
+            handles, [[mutant], [good]], mirror=False,
+            on_error='quarantine')
+        if errors[0] is not None:
+            assert isinstance(errors[0].error, ALLOWED), errors[0]
+        assert errors[1] is None, f'healthy neighbour poisoned: {errors[1]}'
+    return run
+
+
+def run_fuzz(n_seeds=None, n_cases=None, verbose=False):
+    """Returns {'cases', 'rejected', 'accepted', 'escaped': [...]} where
+    `escaped` lists (target, seed, case, exc_type, message) for anything
+    outside the typed envelope — the assertion surface for the tests."""
+    n_seeds = n_seeds if n_seeds is not None else \
+        int(os.environ.get('FUZZ_SEEDS', '5'))
+    n_cases = n_cases if n_cases is not None else \
+        int(os.environ.get('FUZZ_CASES', '40'))
+    corpus = build_corpus()
+    flat_corpus = [(kind, item) for kind, items in corpus.items()
+                   for item in items]
+    targets = _targets()
+    targets.append(('bloom_probe', _probe_bloom_target))
+    targets.append(('loader_batch', _loader_target(corpus)))
+    targets.append(('apply_quarantine', _quarantine_target(corpus)))
+
+    use_alarm = hasattr(signal, 'SIGALRM') and \
+        signal.getsignal(signal.SIGALRM) in (signal.SIG_DFL, signal.SIG_IGN,
+                                             None, _alarm)
+    if use_alarm:
+        signal.signal(signal.SIGALRM, _alarm)
+
+    stats = {'cases': 0, 'rejected': 0, 'accepted': 0, 'escaped': []}
+    heavy = {'loader_batch', 'apply_quarantine'}
+    for seed in range(n_seeds):
+        rng = random.Random(seed)
+        for case in range(n_cases):
+            _kind, base = flat_corpus[rng.randrange(len(flat_corpus))]
+            mutant = mutate(rng, base)
+            for name, fn in targets:
+                # the fleet-stack targets are ~100x the decoder cost:
+                # run them on a slice of the dose, not every mutant
+                if name in heavy and case % 10 != 0:
+                    continue
+                stats['cases'] += 1
+                if use_alarm:
+                    signal.alarm(HANG_SECONDS)
+                try:
+                    fn(mutant)
+                    stats['accepted'] += 1
+                except ALLOWED:
+                    stats['rejected'] += 1
+                except Exception as exc:    # noqa: BLE001 - the fuzz net
+                    stats['escaped'].append(
+                        (name, seed, case, type(exc).__name__, str(exc)[:200]))
+                    if verbose:
+                        print(f'ESCAPE {name} seed={seed} case={case}: '
+                              f'{type(exc).__name__}: {exc}',
+                              file=sys.stderr)
+                finally:
+                    if use_alarm:
+                        signal.alarm(0)
+    return stats
+
+
+def main():
+    n_seeds = int(os.environ.get('FUZZ_SEEDS', '20'))
+    n_cases = int(os.environ.get('FUZZ_CASES', '100'))
+    stats = run_fuzz(n_seeds, n_cases, verbose=True)
+    print(f"fuzz_wire: {stats['cases']} cases, {stats['rejected']} typed "
+          f"rejections, {stats['accepted']} clean decodes, "
+          f"{len(stats['escaped'])} escapes")
+    if stats['escaped']:
+        for row in stats['escaped'][:40]:
+            print('  ', row)
+        return 1
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
