@@ -23,6 +23,12 @@ batch-fatal. This module is that contract:
 - ``SyncOverflow`` means a sync payload exceeded the multihost wire's
   hard ceiling (exchange.py) — raised identically on every controller so
   no peer blocks inside a collective.
+- Durability-layer corruption (``MalformedJournal``, ``TornTail``,
+  ``MalformedSnapshot``) means bytes ON DISK — change-journal frames,
+  fleet snapshots, the checkpoint manifest — failed their CRC framing
+  (fleet/durability.py). They are ``WireCorruption`` too: disk is just a
+  wire with a longer flight time, and recovery gives rotted disk bytes
+  the same one-doc blast radius the sync wire gets.
 
 Every class subclasses ``ValueError`` (the reference's error type), so
 existing ``except ValueError`` / ``pytest.raises(ValueError)`` call sites
@@ -37,7 +43,8 @@ dispatch.
 
 __all__ = [
     'AutomergeError', 'WireCorruption', 'MalformedChange',
-    'MalformedDocument', 'MalformedSyncMessage', 'InvalidChange',
+    'MalformedDocument', 'MalformedSyncMessage', 'MalformedJournal',
+    'TornTail', 'MalformedSnapshot', 'InvalidChange',
     'DanglingPred', 'DuplicateOpId', 'SyncOverflow', 'DocError',
     'as_wire_error',
 ]
@@ -71,6 +78,22 @@ class MalformedDocument(WireCorruption):
 class MalformedSyncMessage(WireCorruption):
     """A sync-protocol message that fails to decode (wrong type byte,
     truncated hash runs, bad filter framing)."""
+
+
+class MalformedJournal(WireCorruption):
+    """A change-journal frame that fails its CRC framing: rotted header
+    or payload bytes, garbage between frames (fleet/durability.py)."""
+
+
+class TornTail(MalformedJournal):
+    """A journal whose final frame runs past end-of-file or whose tail
+    is garbage with no later valid frame — the signature of a crash
+    mid-write. Recovery truncates at the first bad CRC frame."""
+
+
+class MalformedSnapshot(WireCorruption):
+    """A fleet snapshot or checkpoint manifest that fails to decode:
+    bad magic, missing END terminator, rotted per-doc frames."""
 
 
 class InvalidChange(AutomergeError, ValueError):

@@ -322,6 +322,12 @@ class DocFleet:
         self.seq_len = []         # row -> host upper bound on elements
         self.seq_free = []
         self.slot_seq = {}        # slot -> {objectId: row}
+        # Optional durability hook (fleet/durability.py ChangeJournal):
+        # when attached, the mutation seams — FleetDoc.apply_changes, the
+        # turbo batch commit, free/clone — journal accepted change bytes
+        # through it, so sync rounds and batched applies are crash-durable
+        # without callers doing anything per call.
+        self.journal = None
 
     def _cap_docs(self, n_docs):
         """Doc-capacity sizing shared by the grid and register allocators:
@@ -358,6 +364,11 @@ class DocFleet:
     def dispatches(self):
         return self.metrics.dispatches
 
+    def attach_journal(self, journal):
+        """Attach (or detach, with None) a durability journal; the
+        mutation-seam hooks consult it on every accepted batch."""
+        self.journal = journal
+
     def memory_stats(self):
         """Device-state byte accounting per component: the LWW grid or
         register state, and each sequence size-class pool (observability
@@ -387,6 +398,11 @@ class DocFleet:
                           'bytes': nbytes(st.tree_flatten()[0])}
         if pools:
             out['seq_pools'] = pools
+        if self.journal is not None:
+            # durability accounting: what is buffered in RAM awaiting the
+            # next group commit, and what the OS holds but has not yet
+            # fsynced (the crash-loss window)
+            out['journal'] = self.journal.stats()
         out['total'] = out.get('lww_grid', 0) + out.get('registers', 0) + \
             sum(p['bytes'] for p in pools.values())
         out['value_table_entries'] = len(self.value_table)
@@ -2340,7 +2356,9 @@ class FleetDoc:
     valid across promotion, and so host-backed and fleet-backed documents
     interoperate (merge, sync) freely."""
 
-    __slots__ = ('fleet', '_impl')
+    # _dur_id: durable doc id assigned by an attached ChangeJournal
+    # (fleet/durability.py); set lazily, survives promotion and slot reuse
+    __slots__ = ('fleet', '_impl', '_dur_id')
 
     def __init__(self, fleet, impl=None):
         self.fleet = fleet
@@ -2390,12 +2408,26 @@ class FleetDoc:
         return ops
 
     def apply_changes(self, change_buffers, is_local=False):
+        change_buffers = list(change_buffers)
         if self.is_fleet:
             try:
-                return self._impl.apply_changes(change_buffers, is_local)
+                patch = self._impl.apply_changes(change_buffers, is_local)
+                self._journal_accepted(change_buffers)
+                return patch
             except _Unsupported:
                 self.promote()
-        return self._impl.apply_changes(change_buffers, is_local)
+        patch = self._impl.apply_changes(change_buffers, is_local)
+        self._journal_accepted(change_buffers)
+        return patch
+
+    def _journal_accepted(self, buffers):
+        """Durability seam hook: record the buffers this call accepted
+        (applied or causally queued — replay reproduces either) in the
+        fleet's attached change journal. Rejected calls raise before
+        reaching here, so the journal never holds refused bytes."""
+        journal = self.fleet.journal
+        if journal is not None and buffers:
+            journal.record_changes(self, buffers)
 
     def get_patch(self):
         return self._impl.get_patch()
@@ -2420,10 +2452,29 @@ class FleetDoc:
 
     def clone(self):
         if self.is_fleet:
-            return FleetDoc(self.fleet, self._impl.clone_engine())
-        return FleetDoc(self.fleet, self._impl.clone())
+            out = FleetDoc(self.fleet, self._impl.clone_engine())
+        else:
+            out = FleetDoc(self.fleet, self._impl.clone())
+        journal = self.fleet.journal
+        if journal is not None:
+            # the clone is a NEW durable document whose history predates
+            # its first journaled change: baseline it with one document
+            # chunk, plus its causally-held-back queue buffers — the
+            # original's queue records live under the ORIGINAL's durable
+            # id, so the clone must carry its own copies or a crash
+            # before the next checkpoint would drop them
+            bufs = [bytes(out.save())]
+            for entry in out.queue or []:
+                if isinstance(entry, dict) and \
+                        entry.get('buffer') is not None:
+                    bufs.append(bytes(entry['buffer']))
+            journal.record_changes(out, bufs)
+        return out
 
     def free(self):
+        journal = self.fleet.journal
+        if journal is not None:
+            journal.record_free(self)
         if self.is_fleet:
             self.fleet.free_slot(self._impl.slot)
         self._impl = None
@@ -2564,9 +2615,14 @@ def free_docs(handles):
     instead of the per-doc free() chain, which rewrites the whole device
     grid once per document. Handles are frozen like free()."""
     by_fleet = {}
+    journals = {}
     for handle in handles:
         state = handle.get('state')
         if isinstance(state, FleetDoc):
+            journal = state.fleet.journal
+            if journal is not None:
+                journal.record_free(state, commit=False)
+                journals[id(journal)] = journal
             if state.is_fleet:
                 fleet = state.fleet
                 by_fleet.setdefault(id(fleet), (fleet, []))[1].append(
@@ -2574,6 +2630,8 @@ def free_docs(handles):
             state._impl = None
         handle['state'] = None
         handle['frozen'] = True
+    for journal in journals.values():
+        journal.commit()          # one group commit for the whole batch
     for fleet, slots in by_fleet.values():
         fleet.free_slots_batch(slots)
 
@@ -2684,21 +2742,53 @@ def rebuild_docs(handles, fleet=None, mirror=False):
     donated dispatch leaves the old fleet's device state unrecoverable,
     but the change logs remain the source of truth, so documents replay
     into new slots. Causally-held-back queue entries re-queue too.
-    Returns new handles in input order; the old handles are frozen."""
+    Returns new handles in input order; the old handles are frozen.
+
+    Durability continuity: each rebuilt document keeps its durable id in
+    its OWN source journal's registry (ids are per-journal), so no
+    checkpoint ever snapshots the dead pre-rebuild states. When exactly
+    one source journal is involved and the target fleet is unjournaled,
+    the journal moves across (no baseline records needed — it already
+    holds these docs' full accepted-change history, which is exactly
+    what the rebuild replayed); with several source journals, or a
+    target that already carries its own, the caller must re-home the
+    managers explicitly (DurableFleet.adopt_fleet). Source fleets are
+    detached either way — they are abandoned by contract."""
     fleet = fleet or DocFleet()
-    per_doc, per_doc_queue = [], []
+    per_doc, per_doc_queue, src_states, src_journals = [], [], [], []
+    journals = {}
+    src_fleets = {}
     for handle in handles:
         state = handle['state']
         impl = state._impl if isinstance(state, FleetDoc) else state
+        journal = state.fleet.journal if isinstance(state, FleetDoc) \
+            else None
+        if journal is not None:
+            journals[id(journal)] = journal
+            src_fleets[id(state.fleet)] = state.fleet
+        src_journals.append(journal)
+        src_states.append(state)
         per_doc.append([bytes(b) for b in impl.changes])
         per_doc_queue.append([q['buffer'] for q in impl.queue
                               if isinstance(q, dict) and 'buffer' in q])
         handle['frozen'] = True
+    for src_fleet in src_fleets.values():
+        src_fleet.attach_journal(None)    # abandoned by contract
     new_handles = init_docs(len(handles), fleet)
     new_handles, _ = apply_changes_docs(new_handles, per_doc, mirror=mirror)
     if any(per_doc_queue):
         new_handles, _ = apply_changes_docs(new_handles, per_doc_queue,
                                             mirror=mirror)
+    for old, journal, new_handle in zip(src_states, src_journals,
+                                        new_handles):
+        did = getattr(old, '_dur_id', None)
+        if journal is not None and did is not None and \
+                journal.docs.get(did) is old:
+            new_state = new_handle['state']
+            new_state._dur_id = did
+            journal.docs[did] = new_state
+    if len(journals) == 1 and fleet.journal is None:
+        fleet.attach_journal(next(iter(journals.values())))
     return new_handles
 
 
@@ -2711,6 +2801,17 @@ register_health_source('quarantined_docs',
                        lambda: quarantine_stats['quarantined_docs'])
 register_health_source('rejected_changes',
                        lambda: quarantine_stats['rejected_changes'])
+
+
+def _journal_of(handles):
+    """The attached ChangeJournal of the handles' fleet, or None. Turbo
+    batches require a single shared fleet, so the first fleet doc's
+    journal is THE journal."""
+    for handle in handles:
+        state = handle.get('state') if isinstance(handle, dict) else None
+        if isinstance(state, FleetDoc) and state.is_fleet:
+            return state.fleet.journal
+    return None
 
 
 def apply_changes_docs(handles, per_doc_changes, mirror=True,
@@ -2752,8 +2853,26 @@ def apply_changes_docs(handles, per_doc_changes, mirror=True,
         raise ValueError(f"on_error must be 'raise' or 'quarantine', "
                          f"got {on_error!r}")
     if not mirror:
+        journal = _journal_of(handles)
+        if journal is not None:
+            # turbo consumes one-shot iterables into its flat batch;
+            # materialize them first so the journal hook sees the bytes.
+            # The OUTER sequence materializes before the any() scan — a
+            # generator argument would otherwise be consumed by the scan
+            # itself and turbo would see an empty batch.
+            if not isinstance(per_doc_changes, (list, tuple)):
+                per_doc_changes = list(per_doc_changes)
+            if any(not isinstance(c, (list, tuple))
+                   for c in per_doc_changes):
+                per_doc_changes = [c if isinstance(c, (list, tuple))
+                                   else list(c) for c in per_doc_changes]
         with _gc_paused():
             turbo = _apply_changes_turbo(handles, per_doc_changes)
+            if turbo is not None and journal is not None:
+                # inside the GC pause: the ~4 small objects per framed
+                # record would otherwise re-trigger the gen-0 scans the
+                # pause exists to avoid
+                journal.record_seam(turbo[0], per_doc_changes)
         if turbo is not None:
             return turbo
         for handle in handles:
@@ -2762,13 +2881,18 @@ def apply_changes_docs(handles, per_doc_changes, mirror=True,
                 state.fleet.metrics.fallbacks += 1
                 break
     out_handles, patches = [], []
-    for handle, changes in zip(handles, per_doc_changes):
-        if changes:
-            new_handle, patch = apply_changes(handle, changes)
-        else:
-            new_handle, patch = handle, None
-        out_handles.append(new_handle)
-        patches.append(patch)
+    # per-doc applies journal through FleetDoc.apply_changes; group()
+    # folds their commits into ONE write+fsync for the whole batch
+    journal = _journal_of(handles)
+    with journal.group() if journal is not None else \
+            contextlib.nullcontext():
+        for handle, changes in zip(handles, per_doc_changes):
+            if changes:
+                new_handle, patch = apply_changes(handle, changes)
+            else:
+                new_handle, patch = handle, None
+            out_handles.append(new_handle)
+            patches.append(patch)
     fleet = None
     for handle in out_handles:
         state = handle['state']
@@ -2891,6 +3015,10 @@ def _apply_changes_docs_quarantine(handles, per_doc_changes, mirror):
                 reject(d, exc, 'decode')
         if turbo is not None:
             out_handles, patches = turbo
+            journal = _journal_of(out_handles)
+            if journal is not None:
+                with _gc_paused():
+                    journal.record_seam(out_handles, work, errors)
             return out_handles, patches, errors
         for handle in handles:
             state = handle.get('state')
@@ -2903,20 +3031,25 @@ def _apply_changes_docs_quarantine(handles, per_doc_changes, mirror):
     # isolation here is free, not a batching forfeit (pinned by
     # test_exact_path_quarantine_isolates_per_doc's dispatch check).
     out_handles, patches = [], []
-    for d, handle in enumerate(handles):
-        if work[d] and errors[d] is None:
-            try:
-                new_handle, patch = apply_changes(handle, work[d])
-            except Exception as exc:
-                # normalize so errors[d].error is ALWAYS typed — host
-                # gate ValueErrors arrive bare on this path
-                reject(d, as_wire_error(exc, InvalidChange, 'apply',
-                                        doc_index=d), 'apply')
+    # per-doc applies journal through FleetDoc.apply_changes; group()
+    # folds their commits into ONE write+fsync for the whole batch
+    journal = _journal_of(handles)
+    with journal.group() if journal is not None else \
+            contextlib.nullcontext():
+        for d, handle in enumerate(handles):
+            if work[d] and errors[d] is None:
+                try:
+                    new_handle, patch = apply_changes(handle, work[d])
+                except Exception as exc:
+                    # normalize so errors[d].error is ALWAYS typed — host
+                    # gate ValueErrors arrive bare on this path
+                    reject(d, as_wire_error(exc, InvalidChange, 'apply',
+                                            doc_index=d), 'apply')
+                    new_handle, patch = handle, None
+            else:
                 new_handle, patch = handle, None
-        else:
-            new_handle, patch = handle, None
-        out_handles.append(new_handle)
-        patches.append(patch)
+            out_handles.append(new_handle)
+            patches.append(patch)
     fleet = None
     for handle in out_handles:
         state = handle['state']

@@ -194,7 +194,11 @@ def _decode_column(fn_name, buf, signed=False):
     else:
         count = len(data) * 8  # upper bound for boolean runs is large; count below
     if fn_name == 'boolean':
-        # booleans: decode with a growing buffer
+        # booleans: decode with a growing buffer. -2 = capacity too
+        # small (retry bigger), -1 = malformed — the distinction keeps a
+        # hostile run count from driving the retry loop into multi-GB
+        # allocations before the typed failure; the ceiling matches the
+        # C side's kMaxColumnValues.
         cap = max(64, len(data) * 8)
         while True:
             out = np.zeros(cap, dtype=np.int64)
@@ -204,9 +208,11 @@ def _decode_column(fn_name, buf, signed=False):
                 mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap)
             if n >= 0:
                 return out[:n], mask[:n].astype(bool)
-            cap *= 4
-            if cap > 1 << 30:
+            if n != -2:
                 raise MalformedChange('malformed boolean column')
+            cap *= 4
+            if cap > 1 << 26:
+                raise MalformedChange('boolean column too large')
     if count < 0:
         raise MalformedChange('malformed column')
     out = np.zeros(max(count, 1), dtype=np.int64)

@@ -389,15 +389,19 @@ int64_t am_decode_rle(const uint8_t *buf, uint64_t len, int is_signed,
                                 : int64_t(read_uleb(buf, len, &pos, &err));
       if (err) return -1;
       if (have_last && !last_was_nulls && last_value == value) return -1;
-      if (n + count > cap) return -1;
+      // overflow-proof form of n + count > cap: cap - n never underflows
+      // (n <= cap invariant), and a hostile count near INT64_MAX would
+      // wrap a naive signed addition past the check
+      if (count > cap - n) return -1;
       for (int64_t i = 0; i < count; i++) { out[n] = value; mask[n] = 1; n++; }
       last_value = value; have_last = 1; last_was_literal = 0; last_was_nulls = 0;
     } else if (count == 1) {
       return -1;  // repetition count of 1 is not allowed
     } else if (count < 0) {
       if (last_was_literal) return -1;  // successive literals not allowed
+      if (count == INT64_MIN) return -1;  // -count would overflow (UB)
       int64_t m = -count;
-      if (n + m > cap) return -1;
+      if (m > cap - n) return -1;
       for (int64_t i = 0; i < m; i++) {
         int64_t value = is_signed ? read_sleb(buf, len, &pos, &err)
                                   : int64_t(read_uleb(buf, len, &pos, &err));
@@ -411,7 +415,7 @@ int64_t am_decode_rle(const uint8_t *buf, uint64_t len, int is_signed,
       if (last_was_nulls) return -1;
       uint64_t m = read_uleb(buf, len, &pos, &err);
       if (err || m == 0) return -1;
-      if (n + int64_t(m) > cap) return -1;
+      if (m > uint64_t(cap - n)) return -1;  // uint64 space: no overflow
       for (uint64_t i = 0; i < m; i++) { out[n] = 0; mask[n] = 0; n++; }
       last_was_nulls = 1; last_was_literal = 0;
     }
@@ -434,6 +438,13 @@ int64_t am_decode_delta(const uint8_t *buf, uint64_t len, int64_t *out,
   return n;
 }
 
+// Returns the decoded count, -1 for malformed bytes, or -2 when the
+// output capacity is too small (callers retry with a bigger buffer; a
+// malformed column must NOT look like that, or hostile run counts send
+// the retry loop into multi-GB allocations). The capacity check
+// compares in uint64 space: a hostile LEB run count near 2^64 would
+// overflow int64 and sail past a signed `n + count > cap` check — the
+// classic heap-smash the wire fuzzer caught.
 int64_t am_decode_boolean(const uint8_t *buf, uint64_t len, int64_t *out,
                           uint8_t *mask, int64_t cap) {
   uint64_t pos = 0;
@@ -444,7 +455,7 @@ int64_t am_decode_boolean(const uint8_t *buf, uint64_t len, int64_t *out,
     uint64_t count = read_uleb(buf, len, &pos, &err);
     if (err) return -1;
     if (count == 0 && !first) return -1;  // zero-length runs not allowed
-    if (n + int64_t(count) > cap) return -1;
+    if (count > uint64_t(cap - n)) return -2;
     for (uint64_t i = 0; i < count; i++) { out[n] = value; mask[n] = 1; n++; }
     value = !value;
     first = 0;
@@ -453,6 +464,12 @@ int64_t am_decode_boolean(const uint8_t *buf, uint64_t len, int64_t *out,
 }
 
 // Counts values in an RLE/delta column without materializing them.
+// Totals are capped at kMaxColumnValues: RLE expansion is unbounded by
+// construction, so a few hostile bytes could otherwise declare 2^60
+// values and turn the caller's allocation into a multi-GB DoS (or wrap
+// the signed accumulator into a bogus non-negative count).
+static const int64_t kMaxColumnValues = int64_t(1) << 26;
+
 int64_t am_count_rle(const uint8_t *buf, uint64_t len, int is_signed) {
   uint64_t pos = 0;
   int64_t n = 0;
@@ -464,19 +481,23 @@ int64_t am_count_rle(const uint8_t *buf, uint64_t len, int is_signed) {
       if (is_signed) read_sleb(buf, len, &pos, &err);
       else read_uleb(buf, len, &pos, &err);
       if (err) return -1;
+      if (count > kMaxColumnValues - n) return -1;
       n += count;
     } else if (count == 1) {
       return -1;
     } else if (count < 0) {
+      if (count == INT64_MIN) return -1;  // -count would overflow (UB)
       for (int64_t i = 0; i < -count; i++) {
         if (is_signed) read_sleb(buf, len, &pos, &err);
         else read_uleb(buf, len, &pos, &err);
         if (err) return -1;
       }
+      if (-count > kMaxColumnValues - n) return -1;
       n += -count;
     } else {
       uint64_t m = read_uleb(buf, len, &pos, &err);
       if (err) return -1;
+      if (m > uint64_t(kMaxColumnValues - n)) return -1;
       n += int64_t(m);
     }
   }
@@ -920,12 +941,15 @@ static bool parse_change_body(IngestCtx &ctx, const uint8_t *body,
                           ? 16 : int64_t(sc.bool_v.size());
         std::vector<int64_t> &v = sc.bool_v;
         std::vector<uint8_t> &m = sc.bool_m;
-        int64_t n = -1;
-        while (n < 0 && cap < (int64_t(1) << 30)) {
+        // -2 = capacity too small (retry bigger, bounded by the column
+        // ceiling); -1 = malformed, fail immediately — a hostile run
+        // count must not drive the resize loop toward bad_alloc
+        int64_t n = -2;
+        while (n == -2 && cap <= kMaxColumnValues) {
           v.resize(size_t(cap));
           m.resize(size_t(cap));
           n = am_decode_boolean(b, blen, v.data(), m.data(), cap);
-          if (n < 0) cap *= 4;
+          if (n == -2) cap *= 4;
         }
         if (n < 0) return false;
         insert_i64.assign(v.begin(), v.begin() + n);

@@ -26,10 +26,12 @@ build plan names: per-dispatch op counters and JAX profiler traces.
 - `register_health_source(name, fn)` / `health_counts()`: the same
   roll-up pattern for fault-containment counters — quarantined docs,
   rejected changes/filters, sync retries, injected wire faults, fuzz
-  corpus size. The modules that absorb bad input register monotonic
-  counters at import; bench.py reports the roll-up per run and the chaos
-  tests diff it around a workload to prove corruption was contained
-  (counter moved) rather than silently dropped or fatally propagated.
+  corpus size, and the durability layer's checkpoint/compaction/
+  journal-fsync/replay/truncation/rot counters (fleet/durability.py).
+  The modules that absorb bad input register monotonic counters at
+  import; bench.py reports the roll-up per run and the chaos tests diff
+  it around a workload to prove corruption was contained (counter
+  moved) rather than silently dropped or fatally propagated.
 """
 
 import contextlib

@@ -1054,6 +1054,167 @@ def _sec_faults():
           f'dispatches)', file=sys.stderr)
 
 
+@section('durability')
+def _sec_durability():
+    # Crash-safe durability cost: journaled vs bare apply throughput at
+    # the 10k-doc seam (the ISSUE-3 budget is <= 15% overhead), plus
+    # recovery wall-clock vs fleet size (checkpoint + journal-suffix
+    # replay through the quarantining batch apply; includes recovery's
+    # closing re-checkpoint — the full return-to-serving cost).
+    import shutil
+    import tempfile
+    from automerge_tpu.columnar import encode_change
+    from automerge_tpu.fleet import backend as fleet_backend
+    from automerge_tpu.fleet.backend import DocFleet, init_docs
+    from automerge_tpu.fleet.durability import DurableFleet
+    n = _env('BENCH_DUR_DOCS', 10000)
+
+    def workload(count):
+        return [[encode_change({
+            'actor': f'{d % 128:04x}' * 4, 'seq': 1, 'startOp': 1,
+            'time': 0, 'message': '', 'deps': [],
+            'ops': [{'action': 'set', 'obj': '_root', 'key': 'k',
+                     'value': d, 'datatype': 'int', 'pred': []}]})]
+            for d in range(count)]
+
+    warm = DocFleet()                  # JIT warmup for the dispatch shapes
+    fleet_backend.apply_changes_docs(init_docs(n, warm), workload(n),
+                                     mirror=False)
+    del warm
+    _fence()
+
+    # PAIRED interleaved reps: single-shot rates on this box swing 4-40%
+    # with GC/page-cache state, so the overhead claim uses the median of
+    # per-rep (on - off)/off deltas — pairing cancels the drift an
+    # unpaired median-of-rates amplifies. The first pair is warmup and
+    # discarded, and the rep floor is raised above the global default:
+    # per-rep deltas here spread -20..+50% on a busy box, and even a
+    # 9-rep median of that distribution wobbles by ~10 points.
+    dur_reps = max(3 * REPS, 15)
+    root = tempfile.mkdtemp(prefix='bench-dur-')
+    try:
+        off_rates, on_rates, strict_rates = [], [], []
+        deltas, strict_deltas = [], []
+
+        def settle():
+            # flush background writeback OUTSIDE the timed regions: a
+            # previous rep's dirty journal pages otherwise steal IO/CPU
+            # from the next timed section (measured as fake overhead)
+            _fence()
+            try:
+                os.sync()
+            except (AttributeError, OSError):
+                pass
+
+        for rep in range(dur_reps + 1):
+            fleet = DocFleet()
+            handles = init_docs(n, fleet)
+            per_doc = workload(n)
+            settle()
+            start = time.perf_counter()
+            fleet_backend.apply_changes_docs(handles, per_doc, mirror=False)
+            off_s = time.perf_counter() - start
+            del fleet, handles, per_doc
+
+            # group-commit config (fsync batching, the deployable default
+            # for a batched seam: one fsync per fsync_bytes of journal)
+            mgr = DurableFleet(os.path.join(root, f'seam{rep}'),
+                               compact_bytes=1 << 40,  # no mid-run compact
+                               fsync_bytes=4 << 20)
+            handles = mgr.init_docs(n)
+            per_doc = workload(n)
+            settle()
+            start = time.perf_counter()
+            fleet_backend.apply_changes_docs(handles, per_doc,
+                                             mirror=False)
+            on_s = time.perf_counter() - start
+            mgr.close()
+            del mgr, handles, per_doc
+            if rep == 0:
+                continue
+            off_rates.append(n / off_s)
+            on_rates.append(n / on_s)
+            deltas.append(on_s - off_s)
+        # strict config: fsync on EVERY group commit (zero loss window).
+        # Benched in its own loop against the paired baseline medians —
+        # interleaving it into the A/B pairs entangles its fsyncs with
+        # the other configs' writeback on ordered-mode filesystems.
+        off_s_med = float(np.median([n / r for r in off_rates]))
+        for rep in range(max(dur_reps // 2, 3) + 1):
+            mgr = DurableFleet(os.path.join(root, f'strict{rep}'),
+                               compact_bytes=1 << 40)
+            handles = mgr.init_docs(n)
+            per_doc = workload(n)
+            settle()
+            start = time.perf_counter()
+            fleet_backend.apply_changes_docs(handles, per_doc,
+                                             mirror=False)
+            strict_s = time.perf_counter() - start
+            mgr.close()
+            del mgr, handles, per_doc
+            if rep == 0:
+                continue
+            strict_rates.append(n / strict_s)
+            strict_deltas.append(strict_s - off_s_med)
+        off_rate = float(np.median(off_rates))
+        on_rate = float(np.median(on_rates))
+        strict_rate = float(np.median(strict_rates))
+        # overhead = median ABSOLUTE per-pair delta over the median bare
+        # time: a per-rep ratio explodes whenever the off-leg of one pair
+        # stalls (this box stalls whole reps by 2-5x), while the paired
+        # difference cancels shared drift and the median kills outliers
+        off_med_s = n / off_rate
+        overhead = float(np.median(deltas)) / off_med_s * 100.0
+        strict_overhead = float(np.median(strict_deltas)) / off_med_s * 100.0
+
+        recovery = {}
+        for size in sorted({max(n // 10, 100), n}):
+            path = os.path.join(root, f'rec{size}')
+            m = DurableFleet(path, compact_bytes=1 << 40)
+            hs = m.init_docs(size)
+            hs, _p = m.apply_changes(hs, workload(size), on_error='raise')
+            m.checkpoint()
+            hs, _p = m.apply_changes(hs, [
+                [encode_change({
+                    'actor': f'{d % 128:04x}' * 4, 'seq': 2, 'startOp': 2,
+                    'time': 0, 'message': '',
+                    'deps': fleet_backend.get_heads(hs[d]),
+                    'ops': [{'action': 'set', 'obj': '_root', 'key': 'k2',
+                             'value': d, 'datatype': 'int', 'pred': []}]})]
+                for d in range(size)], on_error='raise')
+            m.close()
+            start = time.perf_counter()
+            m2, _rec, report = DurableFleet.recover(path)
+            recovery[size] = time.perf_counter() - start
+            # guard the measurement itself: recovery must have loaded the
+            # snapshot AND replayed the journal suffix (a frozen-handle
+            # bug here once timed snapshot-load only)
+            assert report.snapshot_docs == size and \
+                report.replayed_records == size and not \
+                report.quarantined, report
+            m2.close()
+            _fence()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    R.update(dur_on_rate=on_rate, dur_off_rate=off_rate,
+             dur_strict_rate=strict_rate,
+             dur_overhead_pct=overhead,
+             dur_strict_overhead_pct=strict_overhead,
+             **{f'dur_recovery_{size}_s': secs
+                for size, secs in recovery.items()})
+    rec_str = ', '.join(f'{size} docs in {secs:.2f}s '
+                        f'({size / secs:.0f} docs/s)'
+                        for size, secs in sorted(recovery.items()))
+    print(f'# durability: journal-on {on_rate:.0f} docs/s vs journal-off '
+          f'{off_rate:.0f} docs/s at the {n}-doc seam '
+          f'({overhead:+.1f}% overhead group-commit, budget 15%; '
+          f'{strict_overhead:+.1f}% with fsync-every-commit at '
+          f'{strict_rate:.0f} docs/s); recovery (snapshot load + '
+          f'quarantining replay + re-checkpoint): {rec_str}',
+          file=sys.stderr)
+
+
 @section('zipf')
 def _sec_zipf():
     # Config 5 (stretch): Zipf-skewed change rates over a large fleet
@@ -1194,6 +1355,7 @@ def _run_sanity():
              'BENCH_HOST_DOCS': '50', 'BENCH_SEAM_TEXT_DOCS': '50',
              'BENCH_TEXT_DOCS': '200', 'BENCH_BLOOM_DOCS': '1000',
              'BENCH_SYNCDRV_DOCS': '500', 'BENCH_ZIPF_DOCS': '5000',
+             'BENCH_DUR_DOCS': '1000',
              'BENCH_REG_DOCS': '500', 'BENCH_LOAD_DOCS': '200',
              'BENCH_SAVE_CHANGES': '50', 'BENCH_MIXED_DOCS': '100',
              'BENCH_REPS': '3'}

@@ -23,3 +23,10 @@ except ImportError:
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        'markers',
+        'slow: long-running (full crash/chaos matrices); tier-1 runs '
+        "-m 'not slow'")

@@ -413,6 +413,145 @@ def test_chaos_lossy_wire(wire_seed):
             f'{other[0]} fault trace diverged (messages not byte-identical?)'
 
 
+# ---------------------------------------------------------------------------
+# Durability universe: the divergent pair syncs over a LossyLink while peer
+# A journals to disk (fleet universes through the backend seam hooks, the
+# host universe through explicit journal records — same frames either way),
+# checkpoints mid-run, then CRASHES: its in-memory state is dropped and
+# rebuilt from the durability directory alone. The recovered peer resumes
+# lossy sync to quiet. All three universes (host + both device modes) must
+# converge to identical heads and byte-identical saves — a crash plus
+# recovery is invisible at the wire level.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason='native codec unavailable')
+def test_chaos_checkpoint_crash_recover(tmp_path):
+    from automerge_tpu.errors import AutomergeError
+    from automerge_tpu.fleet import durability as D
+    from automerge_tpu.fleet.durability import DurableFleet, read_state
+
+    rng = random.Random(4242)
+    edits_a = [_random_edit(rng.getrandbits(32)) for _ in range(10)]
+    edits_b = [_random_edit(rng.getrandbits(32)) for _ in range(10)]
+    # canonical divergent saves (change bytes are backend-independent —
+    # pinned by test_chaos_differential — so one build serves all
+    # universes byte-identically)
+    ha0, hb0 = _divergent_pair(host_backend, edits_a, edits_b)
+    save_a = bytes(host_backend.save(ha0))
+    save_b = bytes(host_backend.save(hb0))
+    fault_p = dict(p_drop=0.15, p_dup=0.05, p_truncate=0.1, p_flip=0.1)
+    n_pre_rounds = 6
+
+    results = []
+    for name, exact in (('host', None), ('fleet-lww', False),
+                        ('fleet-exact', True)):
+        ddir = str(tmp_path / name)
+        if exact is None:
+            impl = host_backend
+            mgr = DurableFleet(ddir)
+            ha = impl.load(save_a)
+            hb = impl.load(save_b)
+            # explicit baseline record (no seam hooks on the host path)
+            did = mgr.journal.doc_id_for(ha['state'])
+            mgr.journal.append(did, save_a)
+            mgr.journal.commit()
+            auto_journal = False
+        else:
+            impl = fleet_backend
+            fleet_a = DocFleet(doc_capacity=4, key_capacity=64,
+                               exact_device=exact)
+            fleet_b = DocFleet(doc_capacity=4, key_capacity=64,
+                               exact_device=exact)
+            mgr = DurableFleet(ddir, fleet=fleet_a)
+            # load goes through the apply seam, so the baseline chunk is
+            # journaled by the hook — no explicit plumbing
+            ha = fleet_backend.load(save_a, fleet_a)
+            hb = fleet_backend.load(save_b, fleet_b)
+            did = ha['state']._dur_id
+            auto_journal = True
+
+        # phase 1: lossy duplex rounds with a mid-run checkpoint
+        link_ab = LossyLink(seed=9000, budget=8, **fault_p)
+        link_ba = LossyLink(seed=9500, budget=8, **fault_p)
+        sa, sb = impl.init_sync_state(), impl.init_sync_state()
+        for r in range(n_pre_rounds):
+            sa, msg_ab = impl.generate_sync_message(ha, sa)
+            sb, msg_ba = impl.generate_sync_message(hb, sb)
+            for payload in link_ab.transmit(msg_ab):
+                try:
+                    hb, sb, _ = impl.receive_sync_message(hb, sb, payload)
+                except AutomergeError:
+                    pass                       # corrupt == dropped
+            for payload in link_ba.transmit(msg_ba):
+                old_heads = impl.get_heads(ha)
+                try:
+                    ha, sa, _ = impl.receive_sync_message(ha, sa, payload)
+                except AutomergeError:
+                    continue
+                if not auto_journal:
+                    new = [bytes(c)
+                           for c in impl.get_changes(ha, old_heads)]
+                    if new:
+                        mgr.journal.record_changes(ha['state'], new)
+            if r == n_pre_rounds // 2:
+                mgr.checkpoint()
+        mgr.close()
+
+        # CRASH: peer A's in-memory state is gone; rebuild from disk only
+        pre_crash_save = bytes(impl.save(ha))
+        del ha
+        mgr2 = None
+        if auto_journal:
+            mgr2, rec, report = DurableFleet.recover(ddir,
+                                                     exact_device=exact)
+            ha2 = rec[did]
+            assert report.ok, report
+        else:
+            st = read_state(ddir)
+            ha2 = impl.load(st['docs'][did]) if did in st['docs'] \
+                else impl.init()
+            suffix = [bytes(p) for k, d2, p in st['journal_records']
+                      if d2 == did and k == D.KIND_CHANGE]
+            if suffix:
+                ha2, _patch = impl.apply_changes(ha2, suffix)
+        assert bytes(impl.save(ha2)) == pre_crash_save, \
+            f'{name}: recovery lost acknowledged state'
+
+        # phase 2: resume lossy sync (fresh links + sync states — a real
+        # reconnect) until quiet
+        link2_ab = LossyLink(seed=9100, budget=6, **fault_p)
+        link2_ba = LossyLink(seed=9600, budget=6, **fault_p)
+        na, nb, _rounds, _stats = sync_until_quiet(
+            ha2, hb, impl, impl, link2_ab, link2_ba)
+        heads = impl.get_heads(na)
+        assert heads == impl.get_heads(nb), \
+            f'{name}: replicas diverged after crash-recovery sync'
+        if mgr2 is not None:
+            # the recovered peer stayed durable through phase 2: one more
+            # crash-recover round trip must reproduce the converged state
+            mgr2.close()
+            mgr3, rec3, _rep3 = DurableFleet.recover(ddir,
+                                                     exact_device=exact)
+            assert bytes(impl.save(rec3[did])) == bytes(impl.save(na)), \
+                f'{name}: post-sync recovery diverges'
+            mgr3.close()
+        results.append((name, heads, bytes(impl.save(na)),
+                        bytes(impl.save(nb)),
+                        link_ab.stats, link_ba.stats))
+
+    base = results[0]
+    for other in results[1:]:
+        assert other[1] == base[1], \
+            f'{other[0]} heads diverge from {base[0]}'
+        assert other[2] == base[2] and other[3] == base[3], \
+            f'{other[0]} save bytes diverge from {base[0]}'
+        # byte-identical messages => identical phase-1 fault traces
+        assert other[4] == base[4] and other[5] == base[5], \
+            f'{other[0]} fault trace diverged (messages not byte-identical?)'
+
+
 @pytest.mark.skipif(not native.available(),
                     reason='native codec unavailable')
 def test_chaos_lossy_wire_moves_health_counters():

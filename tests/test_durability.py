@@ -1,0 +1,542 @@
+"""Crash-safe durability: CRC journal framing, fleet checkpoints,
+torn-write/bit-rot recovery, compaction, and the seam hooks
+(fleet/durability.py + the backend.py mutation-seam journaling).
+
+The full crash-injection matrix lives in tools/crashtest.py (run
+standalone or via the slow-marked test below); tier-1 keeps a seeded
+smoke dose so the fast suite exercises recovery on every run."""
+
+import glob
+import os
+import random
+import sys
+
+import pytest
+
+import automerge_tpu as A
+from automerge_tpu import native
+from automerge_tpu.columnar import encode_change
+from automerge_tpu.errors import (AutomergeError, MalformedJournal,
+                                  MalformedSnapshot, TornTail)
+from automerge_tpu.fleet import backend as fb
+from automerge_tpu.fleet import durability as D
+from automerge_tpu.fleet.durability import (ChangeJournal, DurableFleet,
+                                            encode_frame,
+                                            parse_journal_bytes,
+                                            parse_manifest_bytes,
+                                            parse_snapshot_bytes)
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), 'tools'))
+
+
+def _change(actor, seq, deps, value, start=1, key='k'):
+    return encode_change({
+        'actor': actor, 'seq': seq, 'startOp': start, 'time': 0,
+        'message': '', 'deps': list(deps),
+        'ops': [{'action': 'set', 'obj': '_root', 'key': key,
+                 'value': value, 'datatype': 'int', 'pred': []}]})
+
+
+def _grow(mgr, handles, round_no, n=None):
+    """One linear change per doc; returns new handles."""
+    n = n if n is not None else len(handles)
+    per_doc = []
+    for i, h in enumerate(handles[:n]):
+        per_doc.append([_change(f'{i:02x}' * 16, round_no,
+                                fb.get_heads(h), round_no * 100 + i,
+                                start=round_no)])
+    per_doc += [[] for _ in handles[n:]]
+    out, _patches, errors = mgr.apply_changes(handles, per_doc)
+    assert not any(errors)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+
+def test_frame_roundtrip():
+    rng = random.Random(0)
+    frames = [(D.KIND_CHANGE, rng.randrange(1 << 31),
+               bytes(rng.randrange(256) for _ in range(rng.randrange(200))))
+              for _ in range(20)]
+    blob = b''.join(encode_frame(k, d, p) for k, d, p in frames)
+    records, info = parse_journal_bytes(blob)
+    assert records == frames
+    assert info['torn_tail_bytes'] == 0 and not info['rotted']
+    assert info['valid_end'] == len(blob)
+
+
+def test_torn_tail_truncates_at_first_bad_frame():
+    blob = b''.join(encode_frame(D.KIND_CHANGE, i, b'x' * 40)
+                    for i in range(4))
+    cut = blob[:len(blob) - 11]            # torn mid final frame
+    records, info = parse_journal_bytes(cut)
+    assert [d for _k, d, _p in records] == [0, 1, 2]
+    assert info['torn_tail_bytes'] > 0
+    assert info['valid_end'] == len(cut) - info['torn_tail_bytes']
+    with pytest.raises(TornTail):
+        parse_journal_bytes(cut, strict=True)
+
+
+def test_mid_stream_rot_attributes_one_doc_and_resyncs():
+    blob = b''.join(encode_frame(D.KIND_CHANGE, i, bytes([i]) * 30)
+                    for i in range(5))
+    # payload rot in doc 2's frame: header stays valid -> attributed
+    frame_len = len(encode_frame(D.KIND_CHANGE, 0, b'\0' * 30))
+    rot = bytearray(blob)
+    rot[2 * frame_len + 20] ^= 0x40
+    records, info = parse_journal_bytes(bytes(rot))
+    assert [d for _k, d, _p in records] == [0, 1, 3, 4]
+    assert [(d, i) for d, _at, i in info['rotted']] == [(2, 2)]
+    with pytest.raises(MalformedJournal):
+        parse_journal_bytes(bytes(rot), strict=True)
+    # header rot: attribution lost (None) but the stream resyncs
+    rot2 = bytearray(blob)
+    rot2[2 * frame_len + 3] ^= 0x01        # inside doc_id field
+    records2, info2 = parse_journal_bytes(bytes(rot2))
+    assert [d for _k, d, _p in records2] == [0, 1, 3, 4]
+    assert [d for d, _at, _i in info2['rotted']] == [None]
+
+
+def test_snapshot_and_manifest_structural_checks():
+    body = encode_frame(D.KIND_DOC, 0, b'doc0') + \
+        encode_frame(D.KIND_END, 0, D._U32.pack(1))
+    docs, queued, errors = parse_snapshot_bytes(D.SNAP_MAGIC + body)
+    assert docs == {0: b'doc0'} and not queued and not errors
+    with pytest.raises(MalformedSnapshot):
+        parse_snapshot_bytes(b'NOPE' + body)
+    with pytest.raises(MalformedSnapshot):           # missing END
+        parse_snapshot_bytes(D.SNAP_MAGIC +
+                             encode_frame(D.KIND_DOC, 0, b'doc0'))
+    with pytest.raises(MalformedSnapshot):
+        parse_manifest_bytes(b'garbage')
+
+
+# ---------------------------------------------------------------------------
+# journal group commit / accounting
+# ---------------------------------------------------------------------------
+
+
+def test_group_commit_fsync_batching(tmp_path):
+    j = ChangeJournal(str(tmp_path / 'j.log'), fsync_bytes=1 << 20)
+    j.append(0, b'a' * 100)
+    assert j.buffered_bytes > 0 and j.written_bytes == 0
+    j.commit()
+    # under the byte threshold: written but NOT yet fsynced
+    assert j.buffered_bytes == 0
+    assert j.pending_fsync_bytes > 0
+    before = D.durability_stats()['journal_fsyncs']
+    j.sync()
+    assert j.pending_fsync_bytes == 0
+    assert D.durability_stats()['journal_fsyncs'] == before + 1
+    j.close()
+
+
+def test_memory_stats_reports_journal_accounting(tmp_path):
+    mgr = DurableFleet(str(tmp_path / 'dur'), fsync_bytes=1 << 20)
+    handles = mgr.init_docs(2)
+    _grow(mgr, handles, 1)
+    stats = mgr.fleet.memory_stats()
+    assert 'journal' in stats
+    assert set(stats['journal']) >= {'buffered_bytes',
+                                     'pending_fsync_bytes',
+                                     'durable_bytes', 'records'}
+    assert stats['journal']['records'] >= 2
+    # the loss window is visible while fsyncs batch
+    assert stats['journal']['pending_fsync_bytes'] > 0
+    mgr.journal.sync()
+    assert mgr.fleet.memory_stats()['journal']['pending_fsync_bytes'] == 0
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint + recovery
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_recover_byte_identical(tmp_path):
+    path = str(tmp_path / 'dur')
+    mgr = DurableFleet(path)
+    handles = mgr.init_docs(3)
+    handles = _grow(mgr, handles, 1)
+    mgr.checkpoint()
+    handles = _grow(mgr, handles, 2)       # journal suffix past snapshot
+    pre = [bytes(fb.save(h)) for h in handles]
+    mgr.close()
+    mgr2, rec, report = DurableFleet.recover(path)
+    assert sorted(rec) == [0, 1, 2]
+    assert [bytes(fb.save(rec[i])) for i in range(3)] == pre
+    assert report.snapshot_docs == 3 and report.replayed_records == 3
+    assert report.ok
+    # recovered docs keep accepting journaled changes
+    h3 = _grow(mgr2, [rec[i] for i in range(3)], 3)
+    assert all(len(fb.get_heads(h)) == 1 for h in h3)
+    mgr2.close()
+
+
+def test_recover_refuses_fresh_dir_reuse(tmp_path):
+    path = str(tmp_path / 'dur')
+    mgr = DurableFleet(path)
+    mgr.close()
+    with pytest.raises(ValueError):
+        DurableFleet(path)
+
+
+def test_sync_seam_journals_received_changes(tmp_path):
+    """Changes arriving through the sync protocol (receive path -> the
+    apply seam) must be crash-durable without any explicit journaling."""
+    peer = A.change(A.init('aa' * 16), {'time': 0},
+                    lambda d: d.update({'x': 1, 'y': 'hello'}))
+    peer_backend = A.Frontend.get_backend_state(peer, 'sync')
+    mgr = DurableFleet(str(tmp_path / 'dur'))
+    handle = mgr.init_docs(1)[0]
+    s1, s2 = A.init_sync_state(), A.init_sync_state()
+    from automerge_tpu import backend as host_backend
+    for _ in range(8):
+        s2, msg = host_backend.generate_sync_message(peer_backend, s2)
+        if msg is not None:
+            handle, s1, _ = fb.receive_sync_message(handle, s1, msg)
+        s1, msg2 = fb.generate_sync_message(handle, s1)
+        if msg2 is not None:
+            peer_backend, s2, _ = host_backend.receive_sync_message(
+                peer_backend, s2, msg2)
+        if msg is None and msg2 is None:
+            break
+    pre = bytes(fb.save(handle))
+    mgr.close()
+    _mgr2, rec, report = DurableFleet.recover(str(tmp_path / 'dur'))
+    assert bytes(fb.save(rec[0])) == pre
+    assert report.replayed_records >= 1
+    _mgr2.close()
+
+
+def test_queued_changes_survive_checkpoint(tmp_path):
+    """A causally held-back change (missing dep) is journaled, rides the
+    snapshot's QUEUED frames across a checkpoint, and drains after
+    recovery once the dep arrives."""
+    actor = 'aa' * 16
+    c1 = _change(actor, 1, [], 1, start=1)
+    import hashlib
+    from automerge_tpu.columnar import decode_change_meta
+    h1 = decode_change_meta(c1, True)['hash']
+    c2 = _change(actor, 2, [h1], 2, start=2)
+    mgr = DurableFleet(str(tmp_path / 'dur'))
+    handle = mgr.init_docs(1)[0]
+    out, _p, errs = mgr.apply_changes([handle], [[c2]])   # dep missing
+    assert not any(errs)
+    handle = out[0]
+    assert handle['state'].queue
+    mgr.checkpoint()                       # QUEUED frame in the snapshot
+    mgr.close()
+    mgr2, rec, _report = DurableFleet.recover(str(tmp_path / 'dur'))
+    handle = rec[0]
+    assert handle['state'].queue           # still held back
+    out, _p, errs = mgr2.apply_changes([handle], [[c1]])  # dep arrives
+    assert not any(errs)
+    assert len(fb.get_heads(out[0])) == 1  # c1+c2 both applied
+    mgr2.close()
+
+
+def test_checkpoint_preserves_successor_journal_until_snapshot_durable(
+        tmp_path):
+    """A stale successor journal (the generation a fallback recovery
+    just consumed) holds real fsynced records; checkpoint() must not
+    destroy it before the snapshot superseding those records is durable
+    on disk — dying mid-snapshot would otherwise lose them."""
+    from automerge_tpu.fleet.durability import encode_frame
+    path = str(tmp_path / 'dur')
+    mgr = DurableFleet(path)
+    handles = mgr.init_docs(1)
+    _grow(mgr, handles, 1)
+    stale = os.path.join(path, 'journal-00000001.log')
+    blob = encode_frame(D.KIND_INIT, 7, b'')
+    with open(stale, 'wb') as f:
+        f.write(blob)
+
+    class _Die(Exception):
+        pass
+
+    orig = DurableFleet._fault
+    DurableFleet._fault = lambda self, point: (_ for _ in ()).throw(
+        _Die()) if point == 'snapshot-temp-written' else None
+    try:
+        with pytest.raises(_Die):
+            mgr.checkpoint()
+    finally:
+        DurableFleet._fault = orig
+    assert open(stale, 'rb').read() == blob, \
+        'successor journal destroyed before the snapshot was durable'
+    mgr.checkpoint()                 # completes: now safely superseded
+    assert open(stale, 'rb').read() != blob
+    mgr.close()
+
+
+def test_clone_queue_survives_crash(tmp_path):
+    """A clone of a doc with causally-held-back queue entries must carry
+    its own journaled copies — the original's queue records live under
+    the original's durable id."""
+    from automerge_tpu.columnar import decode_change_meta
+    actor = 'aa' * 16
+    c1 = _change(actor, 1, [], 1, start=1)
+    h1 = decode_change_meta(c1, True)['hash']
+    c2 = _change(actor, 2, [h1], 2, start=2)
+    mgr = DurableFleet(str(tmp_path / 'dur'))
+    handle = mgr.init_docs(1)[0]
+    out, _p, errs = mgr.apply_changes([handle], [[c2]])   # queues
+    assert not any(errs) and out[0]['state'].queue
+    clone = fb.clone(out[0])
+    clone_id = clone['state']._dur_id
+    mgr.close()
+    mgr2, rec, _report = DurableFleet.recover(str(tmp_path / 'dur'))
+    assert rec[clone_id]['state'].queue, 'clone queue lost across crash'
+    out, _p, errs = mgr2.apply_changes([rec[clone_id]], [[c1]])
+    assert not any(errs)
+    assert len(fb.get_heads(out[0])) == 1     # dep arrived, queue drained
+    mgr2.close()
+
+
+def test_clone_is_journaled(tmp_path):
+    mgr = DurableFleet(str(tmp_path / 'dur'))
+    handles = mgr.init_docs(1)
+    handles = _grow(mgr, handles, 1)
+    clone = fb.clone(handles[0])
+    pre = bytes(fb.save(clone))
+    mgr.close()
+    _mgr2, rec, _report = DurableFleet.recover(str(tmp_path / 'dur'))
+    assert len(rec) == 2
+    saves = sorted(bytes(fb.save(h)) for h in rec.values())
+    assert pre in saves
+    _mgr2.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite: freed / never-used slots across checkpoint + recover
+# ---------------------------------------------------------------------------
+
+
+def test_freed_and_never_used_slots_roundtrip(tmp_path):
+    """alloc -> free -> checkpoint -> recover: freed docs stay freed,
+    never-edited docs survive as empty, slot reuse does not alias."""
+    path = str(tmp_path / 'dur')
+    mgr = DurableFleet(path)
+    handles = mgr.init_docs(4)             # doc 3 never edited
+    handles = _grow(mgr, handles, 1, n=3)
+    freed_slot = handles[1]['state']._impl.slot
+    fb.free_docs([handles[1]])             # journaled FREE
+    # slot reuse: the recycled fleet slot must not alias doc 1's id
+    reused = mgr.init_docs(1)[0]
+    assert reused['state']._impl.slot == freed_slot
+    reused = _grow(mgr, [reused], 1)[0]
+    mgr.checkpoint()
+    pre = {0: bytes(fb.save(handles[0])), 2: bytes(fb.save(handles[2])),
+           4: bytes(fb.save(reused))}
+    mgr.close()
+
+    mgr2, rec, report = DurableFleet.recover(path)
+    assert sorted(rec) == [0, 2, 3, 4]     # doc 1 freed, 3 empty, 4 reused
+    assert 1 in report.freed_docs or 1 not in rec
+    for did, save in pre.items():
+        assert bytes(fb.save(rec[did])) == save, f'doc {did}'
+    assert fb.get_heads(rec[3]) == []      # never-used doc: empty, live
+    grown = _grow(mgr2, [rec[3]], 1)
+    assert len(fb.get_heads(grown[0])) == 1
+    mgr2.close()
+
+
+def test_rebuild_docs_keeps_durability(tmp_path):
+    """backend.rebuild_docs (donation-failure recovery) must carry the
+    journal + durable ids to the rebuilt fleet: post-rebuild changes
+    journal, checkpoints snapshot the REBUILT states, and ids never
+    recycle — the stale pre-rebuild states must not linger."""
+    from automerge_tpu.fleet.backend import DocFleet
+    path = str(tmp_path / 'dur')
+    mgr = DurableFleet(path)
+    handles = mgr.init_docs(2)
+    handles = _grow(mgr, handles, 1)
+    old_fleet = mgr.fleet
+    fresh = DocFleet(doc_capacity=4, key_capacity=64)
+    rebuilt = fb.rebuild_docs(handles, fresh)
+    mgr.adopt_fleet(fresh)
+    assert old_fleet.journal is None and fresh.journal is mgr.journal
+    assert [h['state']._dur_id for h in rebuilt] == [0, 1]
+    rebuilt = _grow(mgr, rebuilt, 2)       # post-rebuild change journals
+    mgr.checkpoint()                       # snapshots the REBUILT states
+    pre = [bytes(fb.save(h)) for h in rebuilt]
+    mgr.close()
+    _mgr2, rec, report = DurableFleet.recover(path)
+    assert [bytes(fb.save(rec[i])) for i in range(2)] == pre
+    _mgr2.close()
+
+
+def test_recovery_never_recycles_freed_doc_ids(tmp_path):
+    """Durable ids are monotonic forever: a doc freed after the last
+    checkpoint (id known only from journal records) must still fence
+    the id allocator across recovery."""
+    path = str(tmp_path / 'dur')
+    mgr = DurableFleet(path)
+    handles = mgr.init_docs(3)
+    handles = _grow(mgr, handles, 1)
+    fb.free_docs([handles[2]])             # top id, post-checkpoint FREE
+    mgr.close()
+    mgr2, rec, _report = DurableFleet.recover(path)
+    fresh = mgr2.init_docs(1)[0]
+    assert fresh['state']._dur_id >= 3, \
+        f"freed doc's id recycled: {fresh['state']._dur_id}"
+    mgr2.close()
+
+
+def test_free_before_any_checkpoint(tmp_path):
+    path = str(tmp_path / 'dur')
+    mgr = DurableFleet(path)
+    handles = mgr.init_docs(2)
+    handles = _grow(mgr, handles, 1)
+    fb.free_docs([handles[0]])
+    mgr.close()
+    _mgr2, rec, report = DurableFleet.recover(path)
+    assert sorted(rec) == [1]
+    assert report.freed_docs == [0]
+    _mgr2.close()
+
+
+# ---------------------------------------------------------------------------
+# containment: rot quarantines one doc, torn tails truncate
+# ---------------------------------------------------------------------------
+
+
+def _journal_path(path):
+    names = sorted(glob.glob(os.path.join(path, 'journal-*.log')))
+    assert names
+    return names[-1]
+
+
+def test_rotted_record_quarantines_exactly_one_doc(tmp_path):
+    path = str(tmp_path / 'dur')
+    mgr = DurableFleet(path)
+    handles = mgr.init_docs(3)
+    handles = _grow(mgr, handles, 1)
+    handles = _grow(mgr, handles, 2)
+    pre = [bytes(fb.save(h)) for h in handles]
+    mgr.close()
+    jp = _journal_path(path)
+    data = bytearray(open(jp, 'rb').read())
+    # rot doc 1's round-2 payload: walk frames to find it
+    off, target = 0, None
+    seen = {}
+    while off < len(data):
+        kind, did, _p, end, status = D._frame_at(bytes(data), off)
+        assert status == 'ok'
+        if kind == D.KIND_CHANGE:
+            seen[did] = seen.get(did, 0) + 1
+            if did == 1 and seen[did] == 2:
+                target = (off, end)
+        off = end
+    data[target[0] + 20] ^= 0x08
+    open(jp, 'wb').write(bytes(data))
+
+    before = D.durability_stats()
+    _mgr2, rec, report = DurableFleet.recover(path)
+    after = D.durability_stats()
+    assert sorted(report.quarantined) == [1]
+    assert isinstance(report.quarantined[1].error, AutomergeError)
+    assert after['rotted_records'] == before['rotted_records'] + 1
+    # docs 0 and 2: byte-identical; doc 1: exactly its pre-rot prefix
+    assert bytes(fb.save(rec[0])) == pre[0]
+    assert bytes(fb.save(rec[2])) == pre[2]
+    assert len(fb.get_heads(rec[1])) == 1      # round-1 survived
+    assert bytes(fb.save(rec[1])) != pre[1]
+    _mgr2.close()
+
+
+def test_torn_tail_counter_and_truncation(tmp_path):
+    path = str(tmp_path / 'dur')
+    mgr = DurableFleet(path)
+    handles = mgr.init_docs(2)
+    handles = _grow(mgr, handles, 1)
+    mgr.close()
+    jp = _journal_path(path)
+    data = open(jp, 'rb').read()
+    open(jp, 'wb').write(data[:-5])
+    before = D.durability_stats()
+    _mgr2, rec, report = DurableFleet.recover(path)
+    assert report.torn_tail_bytes > 0
+    assert D.durability_stats()['journal_truncations'] == \
+        before['journal_truncations'] + 1
+    # doc 1's final change was torn off; doc 0 intact
+    assert len(fb.get_heads(rec[0])) == 1
+    assert fb.get_heads(rec[1]) == []
+    _mgr2.close()
+
+
+def test_newest_snapshot_structural_rot_falls_back_a_generation(tmp_path):
+    path = str(tmp_path / 'dur')
+    mgr = DurableFleet(path)
+    handles = mgr.init_docs(2)
+    handles = _grow(mgr, handles, 1)
+    mgr.checkpoint()
+    handles = _grow(mgr, handles, 2)
+    mgr.checkpoint()
+    handles = _grow(mgr, handles, 3)
+    pre = [bytes(fb.save(h)) for h in handles]
+    mgr.close()
+    snaps = sorted(glob.glob(os.path.join(path, 'snapshot-*.snap')))
+    assert len(snaps) == 2                   # retain=2 generations
+    blob = bytearray(open(snaps[-1], 'rb').read())
+    blob[0] ^= 0xFF                          # kill the newest magic
+    open(snaps[-1], 'wb').write(bytes(blob))
+    _mgr2, rec, report = DurableFleet.recover(path)
+    assert report.used_fallback_manifest
+    assert [bytes(fb.save(rec[i])) for i in range(2)] == pre
+    _mgr2.close()
+
+
+# ---------------------------------------------------------------------------
+# compaction
+# ---------------------------------------------------------------------------
+
+
+def test_cost_triggered_compaction(tmp_path):
+    path = str(tmp_path / 'dur')
+    mgr = DurableFleet(path, compact_bytes=400)
+    handles = mgr.init_docs(2)
+    before = D.durability_stats()['compactions']
+    for r in range(1, 5):
+        handles = _grow(mgr, handles, r)
+    assert D.durability_stats()['compactions'] > before
+    assert mgr.replay_debt()['bytes'] < 400 + 200   # debt reset by rotation
+    pre = [bytes(fb.save(h)) for h in handles]
+    mgr.close()
+    _mgr2, rec, _report = DurableFleet.recover(path)
+    assert [bytes(fb.save(rec[i])) for i in range(2)] == pre
+    _mgr2.close()
+
+
+# ---------------------------------------------------------------------------
+# crash-injection doses (tools/crashtest.py)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason='native codec unavailable')
+def test_crashtest_smoke():
+    """Seeded smoke dose of the crash matrix in tier-1: a few kill
+    offsets, the torn final frame, journal + snapshot rot, and the
+    checkpoint-protocol crash points, on the turbo path."""
+    from crashtest import run_crashtest
+    stats = run_crashtest(n_seeds=1, n_points=2, modes=['lww'])
+    assert stats['failures'] == [], stats['failures'][:5]
+    assert stats['cases'] >= 8
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not native.available(),
+                    reason='native codec unavailable')
+def test_crashtest_full_matrix():
+    """The full matrix: every mode (turbo, host-exact mirror replay,
+    exact-device registers) x seeds x fault classes."""
+    from crashtest import run_crashtest
+    stats = run_crashtest(n_seeds=3, n_points=6,
+                          modes=['lww', 'lww-mirror', 'exact'])
+    assert stats['failures'] == [], stats['failures'][:10]

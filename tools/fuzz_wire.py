@@ -24,6 +24,10 @@ Targets:
   probe as all-False (containment), never raise
 - apply_changes_docs(on_error='quarantine') over a poisoned batch — the
   healthy neighbour doc must commit and read back intact
+- fleet.durability frame decoders: parse_journal_bytes (strict mode
+  raises only MalformedJournal/TornTail; LENIENT mode must never raise
+  at all — recovery consumes the damage report), parse_snapshot_bytes
+  and parse_manifest_bytes (typed MalformedSnapshot only)
 
 Dose scales like tests/test_chaos.py: FUZZ_SEEDS x FUZZ_CASES mutants per
 target (env-overridable); tests/test_fuzz_wire.py runs a small smoke dose
@@ -96,6 +100,27 @@ def build_corpus():
     bloom = BloomFilter([c_meta for c_meta in
                          (host.get_heads(backend) * 4)]).bytes
 
+    # durability artifacts: a CRC-framed journal, a snapshot, a manifest
+    import json
+    from automerge_tpu.fleet import durability as D
+    journal = b''.join(
+        [D.encode_frame(D.KIND_INIT, 0, b'')] +
+        [D.encode_frame(D.KIND_CHANGE, i % 3, c)
+         for i, c in enumerate(changes)] +
+        [D.encode_frame(D.KIND_FREE, 2, b'')])
+    # the columnar hot-seam format: duplicated crc'd tables + payloads
+    journal_batch = D.encode_frame(D.KIND_INIT, 0, b'') + \
+        D._encode_batch(list(range(len(changes) * 3)), changes * 3)
+    snapshot = D.SNAP_MAGIC + \
+        D.encode_frame(D.KIND_DOC, 0, saved) + \
+        D.encode_frame(D.KIND_QUEUED, 0, changes[0]) + \
+        D.encode_frame(D.KIND_END, 0, D._U32.pack(2))
+    manifest = D.MANIFEST_MAGIC + D.encode_frame(
+        D.KIND_END, 0, json.dumps(
+            {'seq': 3, 'snapshot': 'snapshot-00000003.snap',
+             'journal': 'journal-00000003.log', 'journal_offset': 0,
+             'next_doc_id': 3}).encode('utf8'))
+
     corpus = {
         'change': changes,
         'document': [saved],
@@ -103,6 +128,9 @@ def build_corpus():
         'sync_state': [state_bytes],
         'bloom': [bytes(bloom)],
         'column': [bytes(c[12:48]) for c in changes],   # raw column-ish runs
+        'journal': [journal, journal_batch],
+        'snapshot': [snapshot],
+        'manifest': [manifest],
     }
     _corpus_size[0] = sum(len(v) for v in corpus.values())
     return corpus
@@ -134,9 +162,27 @@ def mutate(rng, data):
     return bytes(out)
 
 
+def _journal_lenient_target(mutant):
+    """The LENIENT journal scan is recovery's parser: it must return a
+    (records, damage-report) pair on ANY input — a raise here, even a
+    typed one, would make one rotted disk byte fleet-fatal. Re-raise as
+    untyped so the fuzz net flags it."""
+    from automerge_tpu.fleet.durability import parse_journal_bytes
+    try:
+        records, info = parse_journal_bytes(mutant)
+    except BaseException as exc:
+        raise RuntimeError(
+            f'lenient journal scan raised {type(exc).__name__}: '
+            f'{exc}') from exc
+    assert isinstance(records, list) and 'torn_tail_bytes' in info
+
+
 def _targets():
     """(name, callable(mutant)) pairs. Callables either succeed (a mutant
     may decode to something valid) or raise inside ALLOWED."""
+    from automerge_tpu.fleet.durability import (parse_journal_bytes,
+                                                parse_manifest_bytes,
+                                                parse_snapshot_bytes)
     targets = [
         ('decode_change', decode_change),
         ('decode_change_meta', lambda b: decode_change_meta(b, True)),
@@ -144,6 +190,10 @@ def _targets():
         ('decode_document', decode_document),
         ('decode_sync_message', decode_sync_message),
         ('decode_sync_state', decode_sync_state),
+        ('journal_strict', lambda b: parse_journal_bytes(b, strict=True)),
+        ('journal_lenient', _journal_lenient_target),
+        ('snapshot_frames', parse_snapshot_bytes),
+        ('manifest', parse_manifest_bytes),
     ]
     if native.available():
         targets += [
