@@ -135,6 +135,15 @@ class DocError:
         return (f'DocError(index={self.index}, stage={self.stage!r}, '
                 f'error={type(self.error).__name__}: {self.error})')
 
+    def describe(self, durable_id=None):
+        """JSON-friendly record for forensic flight-recorder dumps: slot
+        index, stage, typed error name, truncated message, and (when the
+        caller knows it) the document's durable journal id."""
+        return {'doc': self.index, 'stage': self.stage,
+                'error': type(self.error).__name__,
+                'message': str(self.error)[:200],
+                'durable_id': durable_id}
+
 
 def as_wire_error(exc, err_cls, what, doc_index=None):
     """Normalize an arbitrary decoder exception into the typed class:

@@ -36,6 +36,8 @@ winners tensor in one dispatch.
 import contextlib
 import copy
 import gc
+import hashlib
+import time
 
 import numpy as np
 
@@ -44,6 +46,10 @@ from ..backend.hash_graph import HashGraph, decode_change_buffers
 from ..errors import (AutomergeError, DanglingPred, DocError, DuplicateOpId,
                       InvalidChange, MalformedChange, as_wire_error)
 from ..observability import Metrics, register_health_source
+from ..observability import hist as _hist
+from ..observability import recorder as _flight
+from ..observability.spans import (span as _span, span_seq as _span_seq,
+                                   spanned as _spanned)
 from ..backend.op_set import OpSet
 from ..columnar import decode_change, OBJECT_TYPE
 from .tensor_doc import (ACTOR_BITS, CTR_LIMIT, FleetState, MAX_ACTORS,
@@ -52,6 +58,7 @@ from .ingest import KeyInterner
 
 _FLAT_ACTIONS = ('set', 'del', 'inc')
 _SEQ_MAKE = ('makeText', 'makeList')
+
 
 
 class _Unsupported(Exception):
@@ -625,6 +632,7 @@ class DocFleet:
         if by_cls:
             self.seq_pools.release_rows(by_cls)
 
+    @_spanned('actor_remap')
     def _remap_seq_actors(self, perm):
         """Renumber the actor bits of packed elemIds/register opIds in every
         sequence pool after a sorted-order actor insertion, permuting the
@@ -763,6 +771,7 @@ class DocFleet:
         return (row, kind, pack_ref(op.get('elemId')), packed, value,
                 *lanes, flag)
 
+    @_spanned('dispatch_seq')
     def _dispatch_seq(self, seq_ops):
         """Place every touched row in a size-class pool with enough
         capacity (migrating rows that outgrew their class) and batch-apply
@@ -930,6 +939,7 @@ class DocFleet:
         self.doc_cap, self.key_cap = n, k - 1
         self.state = self._shard_docs(FleetState(*grown))
 
+    @_spanned('actor_remap')
     def _remap_actors(self, perm):
         """Renumber the actor bits of every packed opId on the device."""
         perm_full = np.arange(MAX_ACTORS, dtype=np.int32)
@@ -1021,6 +1031,7 @@ class DocFleet:
 
         return move, renum
 
+    @_spanned('actor_remap')
     def _remap_reg_actors(self, perm):
         """Renumber actor bits AND permute the actor-slot axis of the
         register state after a sorted-order actor insertion."""
@@ -1175,6 +1186,7 @@ class DocFleet:
         self._op_index[slot] = np.sort(
             (arr & ~np.int64(0xffffffff)) | shifted)
 
+    @_spanned('dispatch_grid')
     def _dispatch_grid(self, batch, kills=None):
         """One LWW-grid merge dispatch. With `kills` (a (kill_key,
         kill_packed) [N, Q] pair from delete preds), the kills-aware
@@ -1291,6 +1303,7 @@ class DocFleet:
             rel = min(max(rel, 1), CTR_LIMIT - 1)
         return pack_op_id(rel, actor_num)
 
+    @_spanned('fleet_flush')
     def flush(self):
         """Land all pending change buffers on the device: one batched ingest
         and one merge dispatch for the whole fleet."""
@@ -1808,16 +1821,30 @@ class _FlatEngine(HashGraph):
     def _materialize_doc(self):
         """Decode the parked document chunk into the real change log (one
         Python decode + per-change re-encode for hashes; runs at most once
-        per loaded doc, and only when history is needed)."""
+        per loaded doc, and only when history is needed). The ~700µs/doc
+        cost dominates durability-recovery replay (ROADMAP: native
+        change-list extraction), so it is attributed three ways: a
+        `doc_materialize` span, `metrics.seconds['doc_materializations']`,
+        and the `doc_materialize_s` histogram."""
         chunk = self._doc_pending
         if chunk is None:
             return
         self._doc_pending = None
         from ..columnar import decode_document, encode_change
-        self.fleet.metrics.doc_materializations += 1
-        decoded = decode_document(chunk)
-        self._changes = [encode_change(ch) for ch in decoded]
-        self._doc_decoded = decoded
+        metrics = self.fleet.metrics
+        metrics.doc_materializations += 1
+        start = time.perf_counter()
+        with _span('doc_materialize', slot=self.slot,
+                   durable_id=getattr(self, '_dur_id', None),
+                   chunk_bytes=len(chunk)):
+            decoded = decode_document(chunk)
+            self._changes = [encode_change(ch) for ch in decoded]
+            self._doc_decoded = decoded
+        elapsed = time.perf_counter() - start
+        metrics.seconds['doc_materializations'] = \
+            metrics.seconds.get('doc_materializations', 0.0) + elapsed
+        _hist.record_value('doc_materialize_s', elapsed, scale=1e9,
+                           unit='s')
 
     def _install_parked_chunk(self, chunk, n_changes):
         """THE parked form, in one place (loader bulk-load and park_docs
@@ -1854,6 +1881,7 @@ class _FlatEngine(HashGraph):
         }
         return ch['hash'], meta['deps'], meta['actor'], meta
 
+    @_spanned('mirror_rebuild')
     def _rebuild_mirror(self):
         """Replay the committed log into a fresh OpSet, bypassing the causal
         gate (the log is already in applied order, so no per-change SHA-256
@@ -2817,6 +2845,21 @@ def _journal_of(handles):
 def apply_changes_docs(handles, per_doc_changes, mirror=True,
                        on_error='raise'):
     """Apply per-document change lists across the fleet. Returns
+    (see _apply_changes_docs_impl for the full contract). When
+    observability is enabled the whole batch records an `apply_batch`
+    span and an `apply_batch_s` latency histogram sample."""
+    start = time.perf_counter()
+    with _span('apply_batch', docs=len(handles), mirror=mirror,
+               on_error=on_error):
+        out = _apply_changes_docs_impl(handles, per_doc_changes, mirror,
+                                       on_error)
+    _hist.record_value('apply_batch_s', time.perf_counter() - start,
+                       scale=1e9, unit='s')
+    return out
+
+
+def _apply_changes_docs_impl(handles, per_doc_changes, mirror, on_error):
+    """Apply per-document change lists across the fleet. Returns
     (new_handles, patches) — or (new_handles, patches, errors) with
     on_error='quarantine', where a bad input rejects ONLY its own doc
     (errors[i] is a DocError; healthy docs commit in the same fused
@@ -2986,6 +3029,19 @@ def _apply_changes_docs_quarantine(handles, per_doc_changes, mirror):
         errors[d] = DocError(d, stage, exc)
         quarantine_stats['quarantined_docs'] += 1
         quarantine_stats['rejected_changes'] += len(work[d])
+        # flight-recorder event: WHICH doc (slot + durable id), WHAT
+        # phase, WHAT typed error, plus a digest of the refused bytes so
+        # the forensic dump can be matched to a captured wire corpus
+        bufs = work[d]
+        state = handles[d].get('state') if d < n else None
+        _flight.record_event(
+            'quarantine', doc=d, stage=stage,
+            error=type(exc).__name__, message=str(exc)[:200],
+            durable_id=getattr(state, '_dur_id', None),
+            change_bytes=sum(len(b) for b in bufs),
+            digest=hashlib.sha256(
+                b''.join(bytes(b) for b in bufs)).hexdigest()[:16]
+            if bufs else None)
         work[d] = []
 
     if not mirror:
@@ -3019,6 +3075,7 @@ def _apply_changes_docs_quarantine(handles, per_doc_changes, mirror):
             if journal is not None:
                 with _gc_paused():
                     journal.record_seam(out_handles, work, errors)
+            _dump_quarantine_record(out_handles, errors)
             return out_handles, patches, errors
         for handle in handles:
             state = handle.get('state')
@@ -3058,7 +3115,23 @@ def _apply_changes_docs_quarantine(handles, per_doc_changes, mirror):
             break
     if fleet is not None:
         fleet.flush()
+    _dump_quarantine_record(out_handles, errors)
     return out_handles, patches, errors
+
+
+def _dump_quarantine_record(handles, errors):
+    """One forensic flight-recorder dump per quarantining batch that
+    actually rejected something: every DocError described with its slot,
+    stage, typed error, and durable id (when journaled), alongside the
+    surrounding event ring. "quarantined_docs moved by K" becomes K
+    named documents with context."""
+    if not any(e is not None for e in errors):
+        return
+    detail = {'errors': [
+        e.describe(durable_id=getattr(handles[i].get('state'), '_dur_id',
+                                      None) if i < len(handles) else None)
+        for i, e in enumerate(errors) if e is not None]}
+    _flight.dump_flight_record('quarantine', detail)
 
 
 class _TurboMetaBatch:
@@ -3121,7 +3194,23 @@ def _apply_changes_turbo(handles, per_doc_changes):
     (deps == current head, contiguous seqs) vectorized over the whole batch;
     docs that fit the linear-chain shape commit through the deferred hash
     graph with no per-change dict work, the rest go through the general
-    causal gate. The call is atomic: any gate error rolls back every doc."""
+    causal gate. The call is atomic: any gate error rolls back every doc.
+
+    Phase attribution: when spans are enabled the call tiles into
+    contiguous `turbo_setup` / `turbo_parse` / `turbo_gate` /
+    `turbo_commit` / `turbo_stage` / `turbo_dispatch` spans (no
+    unattributed gap between marks — the coverage contract bench.py's
+    observability section checks), with the native parse / device
+    dispatch sub-spans nested inside."""
+    ps = _span_seq()
+    ps.mark('turbo_setup', docs=len(handles))
+    try:
+        return _apply_changes_turbo_inner(handles, per_doc_changes, ps)
+    finally:
+        ps.done()
+
+
+def _apply_changes_turbo_inner(handles, per_doc_changes, ps):
     from .. import native
     from .tensor_doc import OpBatch, MAX_ACTORS as _MA
 
@@ -3173,12 +3262,14 @@ def _apply_changes_turbo(handles, per_doc_changes):
         return None
     # doc_ids=None: the zero-copy list entry (C walks the bytes objects
     # in place — no blob join, no length array; buffer i IS doc i here)
+    ps.mark('turbo_parse', changes=n_changes)
     out = native.ingest_changes(flat_buffers, None,
                                 with_meta=True, with_seq=True)
     if out is None:
         return None     # ops outside the fleet subset, or corrupt chunk
     rows, nat_keys, nat_actors, nmeta = out
     batch_meta = _TurboMetaBatch(nmeta, nat_actors, flat_buffers)
+    ps.mark('turbo_gate')
 
     # ---- Vectorized linear-chain validation over the whole batch ----
     # A doc takes the fast path iff every change deps on exactly the
@@ -3435,6 +3526,7 @@ def _apply_changes_turbo(handles, per_doc_changes):
             len(flat_buffers[i]) for i in np.flatnonzero(ready).tolist())
 
     # Phase 2 — infallible: record logs, queues, staleness
+    ps.mark('turbo_commit', ready=int(ready.sum()))
     start_op = nmeta['startOp']
     nops = nmeta['nops']
     last_op = start_op + nops - 1
@@ -3443,6 +3535,15 @@ def _apply_changes_turbo(handles, per_doc_changes):
     # old code took a numpy .max() per doc — ~27ms at 10k docs)
     starts_all = np.cumsum(doc_counts) - doc_counts
     nonempty = doc_counts > 0
+    if _hist.on() and nonempty.any():
+        # per-doc change bytes, one vectorized pass (reduceat over the
+        # contiguous per-doc runs). Recorded HERE — past every validation
+        # raise — so a quarantining caller's retry loop records each
+        # batch's survivors exactly once, on the attempt that commits.
+        _hist.histogram('doc_change_bytes', unit='B').record_many(
+            np.add.reduceat(np.fromiter(map(len, flat_buffers),
+                                        dtype=np.int64, count=n_changes),
+                            starts_all[nonempty]))
     doc_max = np.zeros(len(handles), dtype=np.int64)
     if nonempty.any():
         doc_max[nonempty] = np.maximum.reduceat(
@@ -3515,6 +3616,7 @@ def _apply_changes_turbo(handles, per_doc_changes):
     # Land any lazily-enqueued earlier changes first: the register engine
     # is order-sensitive (pred kills), and even the LWW grid's counter
     # reset bases on the pre-batch winner
+    ps.mark('turbo_stage', kept=int(keep.sum()))
     fleet.flush()
 
     # Device batch: remap the native parser's key/actor numbering into the
@@ -3788,6 +3890,7 @@ def _apply_changes_turbo(handles, per_doc_changes):
                 packed, kept_vals_all[keep_root], off_kept, preds_kept,
                 n_docs=n_cap, d_preds=fleet.d_preds,
                 force_overflow=bad_rows)
+            ps.mark('turbo_dispatch')
             fleet.reg_state, _stats = apply_register_batch_donated(
                 fleet.reg_state, fleet._shard_docs(reg_batch))
             fleet.metrics.dispatches += 1
@@ -3859,6 +3962,7 @@ def _apply_changes_turbo(handles, per_doc_changes):
                 (np.int32, np.int32))
             kills = (kk_arr, kp_arr)
 
+        ps.mark('turbo_dispatch')
         fleet._dispatch_grid(batch, kills)
         # Counter-attribution check (see _note_grid_batch): advance the
         # host winner mirror with this batch's set and kill rows and

@@ -42,6 +42,7 @@ def dispatch_count():
 
 
 from ..observability import register_dispatch_source  # noqa: E402
+from ..observability.spans import spanned as _spanned  # noqa: E402
 register_dispatch_source('bloom', dispatch_count)
 
 
@@ -249,6 +250,7 @@ def _pad_hash_axis(words, valid):
     return words, valid
 
 
+@_spanned('bloom_build')
 def build_bloom_filters_batch_begin(hash_lists):
     """Issue THE device dispatch for `build_bloom_filters_batch` without
     blocking on its result (JAX dispatch is async). Returns an opaque
@@ -276,6 +278,7 @@ def build_bloom_filters_batch_begin(hash_lists):
     return len(hash_lists), entry_counts, live, byte_off, packed
 
 
+@_spanned('bloom_build_wait')
 def build_bloom_filters_batch_finish(handle):
     """Materialize a `build_bloom_filters_batch_begin` handle into the list
     of wire-format filter bytes."""
@@ -305,6 +308,7 @@ def build_bloom_filters_batch(hash_lists):
         build_bloom_filters_batch_begin(hash_lists))
 
 
+@_spanned('bloom_probe')
 def probe_bloom_filters_batch_begin(filter_bytes, hash_lists):
     """Issue THE device dispatch for `probe_bloom_filters_batch` without
     blocking (filters are uploaded in their packed wire-format bytes, not
@@ -362,6 +366,7 @@ def probe_bloom_filters_batch_begin(filter_bytes, hash_lists):
     return out, hash_lists, rows, hit
 
 
+@_spanned('bloom_probe_wait')
 def probe_bloom_filters_batch_finish(handle):
     """Materialize a `probe_bloom_filters_batch_begin` handle into the
     per-row lists of probe results."""

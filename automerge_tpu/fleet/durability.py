@@ -55,6 +55,7 @@ import contextlib
 import json
 import os
 import struct
+import time
 import zlib
 
 import numpy as np
@@ -62,6 +63,10 @@ import numpy as np
 from ..errors import (AutomergeError, DocError, MalformedJournal,
                       MalformedSnapshot, TornTail, as_wire_error)
 from ..observability import register_health_source
+from ..observability import hist as _hist
+from ..observability import recorder as _flight
+from ..observability.spans import (span as _span, span_seq as _span_seq,
+                                   spanned as _spanned)
 
 __all__ = [
     'ChangeJournal', 'DurableFleet', 'RecoveryReport',
@@ -544,6 +549,7 @@ class ChangeJournal:
         if commit:
             self.commit()
 
+    @_spanned('journal_append')
     def record_seam(self, handles, per_doc_changes, errors=None):
         """The hot seam hook for the 10k-doc turbo batch: every ACCEPTED
         doc's buffers collected in one flattened pass and framed as a
@@ -629,14 +635,16 @@ class ChangeJournal:
         the block's exit performs the single real commit."""
         if self._group_depth > 0:
             return
-        if self._pending:
-            self._f.write(self._pending)
-            self._f.flush()
-            self.written_bytes += len(self._pending)
-            self._pending = bytearray()
-        _stats['journal_commits'] += 1
-        if self.fsync_bytes <= 0 or self.pending_fsync_bytes >= self.fsync_bytes:
-            self._fsync()
+        with _span('journal_commit', bytes=len(self._pending)):
+            if self._pending:
+                self._f.write(self._pending)
+                self._f.flush()
+                self.written_bytes += len(self._pending)
+                self._pending = bytearray()
+            _stats['journal_commits'] += 1
+            if self.fsync_bytes <= 0 or \
+                    self.pending_fsync_bytes >= self.fsync_bytes:
+                self._fsync()
 
     def sync(self):
         """Force full durability: write + fsync regardless of policy."""
@@ -650,7 +658,12 @@ class ChangeJournal:
     def _fsync(self):
         if self.durable_bytes == self.written_bytes:
             return
-        os.fsync(self._f.fileno())
+        start = time.perf_counter()
+        with _span('journal_fsync',
+                   bytes=self.written_bytes - self.durable_bytes):
+            os.fsync(self._f.fileno())
+        _hist.record_value('fsync_s', time.perf_counter() - start,
+                           scale=1e9, unit='s')
         self.durable_bytes = self.written_bytes
         _stats['journal_fsyncs'] += 1
 
@@ -955,7 +968,9 @@ class DurableFleet:
         if not force and debt['bytes'] < self.compact_bytes and \
                 debt['records'] < self.compact_records:
             return False
-        self.checkpoint()
+        with _span('compaction', debt_bytes=debt['bytes'],
+                   debt_records=debt['records']):
+            self.checkpoint()
         _stats['compactions'] += 1
         return True
 
@@ -969,6 +984,7 @@ class DurableFleet:
         _atomic_write(os.path.join(self.path, MANIFEST_NAME),
                       MANIFEST_MAGIC + encode_frame(KIND_END, 0, payload))
 
+    @_spanned('checkpoint')
     def checkpoint(self, _docs=None, _next_doc_id=None):
         """Whole-fleet snapshot + journal rotation, crash-safe at every
         step: (1) everything journaled so far is fsynced, (2) the
@@ -1082,10 +1098,28 @@ class DurableFleet:
         bytes ON DISK get the same one-doc blast radius as hostile bytes
         on the wire. Recovery ends with a fresh checkpoint, so the
         directory is compact and consistent when this returns."""
+        rs = _span_seq()
+        try:
+            return cls._recover_impl(
+                path, rs, exact_device=exact_device, mirror=mirror,
+                fsync_bytes=fsync_bytes, compact_bytes=compact_bytes,
+                compact_records=compact_records, retain=retain,
+                doc_capacity=doc_capacity, key_capacity=key_capacity)
+        finally:
+            # done() is idempotent: on success the impl already closed
+            # the last phase; on a raise this records it (with whatever
+            # phase recovery died in still attributed)
+            rs.done()
+
+    @classmethod
+    def _recover_impl(cls, path, rs, *, exact_device, mirror, fsync_bytes,
+                      compact_bytes, compact_records, retain, doc_capacity,
+                      key_capacity):
         from . import backend as fleet_backend
         from .backend import DocFleet
         from .loader import load_docs
 
+        rs.mark('recovery_read', path=str(path))
         st = read_state(path)
         report = RecoveryReport()
         report.manifest_seq = st['manifest']['seq']
@@ -1095,7 +1129,13 @@ class DurableFleet:
         report.rotted_records = len(info['rotted'])
         if report.torn_tail_bytes:
             _stats['journal_truncations'] += 1
+            _flight.record_event('recovery_truncation',
+                                 bytes=report.torn_tail_bytes,
+                                 path=str(path))
         _stats['rotted_records'] += report.rotted_records
+        for _did, _at, _rec in info['rotted']:
+            _flight.record_event('journal_rot', durable_id=_did,
+                                 at_byte=_at, record=_rec)
 
         fleet = DocFleet(doc_capacity=doc_capacity,
                          key_capacity=key_capacity,
@@ -1105,8 +1145,13 @@ class DurableFleet:
 
         def quarantine(did, stage, exc):
             report.quarantined[did] = DocError(did, stage, exc)
+            # did IS the durable id here — recovery keys everything by it
+            _flight.record_event('quarantine', doc=did, durable_id=did,
+                                 stage=stage, error=type(exc).__name__,
+                                 message=str(exc)[:200])
 
         # ---- snapshot load (bulk native parse, per-doc typed fallback)
+        rs.mark('recovery_snapshot_load', docs=len(st['docs']))
         snap_ids = sorted(st['docs'])
         report.snapshot_docs = len(snap_ids)
         payloads = [st['docs'][d] for d in snap_ids]
@@ -1156,6 +1201,7 @@ class DurableFleet:
         # ---- journal replay: batched quarantining apply, segmented at
         # FREE records; records for a quarantined doc are skipped so the
         # doc lands exactly on its last good prefix
+        rs.mark('recovery_replay', records=len(st['journal_records']))
         skip = {did for did in report.quarantined}
         pending = {}              # doc_id -> [change payloads], in order
 
@@ -1168,9 +1214,16 @@ class DurableFleet:
                     handle = fleet_backend.init(fleet)
                     handles[did] = handle
                     states[did] = handle['state']
+            start = time.perf_counter()
             out, _p, errs = fleet_backend.apply_changes_docs(
                 [handles[d] for d in ids], [pending[d] for d in ids],
                 mirror=mirror, on_error='quarantine')
+            # per-doc AVERAGE replay cost, one sample per replay batch
+            # (the batched apply cannot see true per-doc times; per-doc
+            # outliers surface through doc_materialize_s instead)
+            _hist.record_value('recovery_doc_s',
+                               (time.perf_counter() - start) / len(ids),
+                               scale=1e9, unit='s')
             for did, handle, err in zip(ids, out, errs):
                 handles[did] = handle
                 if err is not None:
@@ -1241,6 +1294,21 @@ class DurableFleet:
                 state._dur_id = did
             except AttributeError:
                 pass
+        rs.done(recovered_docs=len(handles))
+        if report.torn_tail_bytes or report.rotted_records or \
+                report.quarantined:
+            # forensic dump: recovery found damage — name every affected
+            # durable id, the stage it failed in, and the typed error,
+            # with the surrounding event ring for context
+            _flight.dump_flight_record('recovery', detail={
+                'path': str(path),
+                'manifest_seq': report.manifest_seq,
+                'used_fallback_manifest': report.used_fallback_manifest,
+                'torn_tail_bytes': report.torn_tail_bytes,
+                'rotted_records': report.rotted_records,
+                'errors': [e.describe(durable_id=did) for did, e in
+                           sorted(report.quarantined.items())],
+            })
         mgr = cls(path, fsync_bytes=fsync_bytes,
                   compact_bytes=compact_bytes,
                   compact_records=compact_records, retain=retain,

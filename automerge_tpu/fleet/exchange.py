@@ -16,6 +16,8 @@ moves bytes only — hashing/causal gating stays host-side per shard, exactly
 like the reference's split between transport and protocol.
 """
 
+import time
+
 import numpy as np
 
 import jax
@@ -24,6 +26,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..errors import SyncOverflow
 from ..observability import register_health_source
+from ..observability import hist as _hist
+from ..observability import recorder as _flight
+from ..observability.spans import span as _span
 
 # Fault-containment roll-up: extra sub-rounds paid to move over-limit sync
 # payloads through the fixed-width wire (sync_round_multihost chunking).
@@ -216,6 +221,18 @@ def sync_round_multihost(mesh, axis, generate, receive, max_msg=1 << 16,
     count — identical on every controller, so callers can branch on it
     without desyncing; an all-empty round returns 0 without paying the
     padded all_to_all."""
+    round_start = time.perf_counter() if _hist.on() else None
+    with _span('sync_round', max_msg=max_msg):
+        result = _sync_round_multihost(mesh, axis, generate, receive,
+                                       max_msg, max_chunks)
+    if round_start is not None:
+        _hist.record_value('sync_round_s', time.perf_counter() - round_start,
+                           scale=1e9, unit='s')
+    return result
+
+
+def _sync_round_multihost(mesh, axis, generate, receive, max_msg,
+                          max_chunks):
     n = mesh.shape[axis]
     mine = local_shard_ids(mesh, axis)
     per_src = []
@@ -240,6 +257,16 @@ def sync_round_multihost(mesh, axis, generate, receive, max_msg=1 << 16,
         pairs = [(src, dst)
                  for src, payloads in zip(mine, per_src)
                  for dst, p in enumerate(payloads) if len(p) > hard_limit]
+        # forensic dump before the (SPMD-identical) raise: the overflow
+        # aborts the round on every controller, so record what this one
+        # saw — sizes, limits, and its locally-observed offending pairs
+        _flight.record_event('sync_overflow', global_max=global_max,
+                             max_msg=max_msg, max_chunks=max_chunks,
+                             pairs=pairs[:16])
+        _flight.dump_flight_record('sync_overflow', detail={
+            'global_max': global_max, 'max_msg': max_msg,
+            'max_chunks': max_chunks, 'hard_limit': hard_limit,
+            'local_pairs': pairs[:64]})
         raise SyncOverflow(
             f'sync message {global_max}B exceeds max_msg={max_msg} x '
             f'max_chunks={max_chunks}', global_max=global_max,

@@ -34,6 +34,9 @@ _COL_VALRAW = 5 << 4 | COLUMN_TYPE['VALUE_RAW']
 _COL_OBJCTR = 0 << 4 | COLUMN_TYPE['INT_RLE']
 
 
+from ..observability.spans import spanned as _spanned
+
+
 def _inflate_chunk(buffer):
     if buffer[8] != CHUNK_TYPE_DEFLATE:
         return buffer
@@ -165,6 +168,7 @@ def build_kill_lanes(del_doc, del_key, del_pred_counts, praw, actor_map,
     return kill_doc, kill_key, kill_packed
 
 
+@_spanned('exact_ingest')
 def changes_to_op_batch_native(per_doc_changes, key_interner, actor_interner,
                                hazard_out=None, kills_out=None,
                                index_out=None):

@@ -32,6 +32,7 @@ import numpy as np
 from .. import native
 from ..columnar import decode_value, split_containers, CHUNK_TYPE_DOCUMENT
 from .tensor_doc import CTR_LIMIT, MAX_ACTORS
+from ..observability.spans import spanned as _spanned
 
 # Wire action numbers (ref columnar.js:51-52)
 _A_MAKE_MAP, _A_SET, _A_MAKE_LIST, _A_MAKE_TEXT = 0, 1, 2, 4
@@ -69,6 +70,7 @@ def _isin_sorted(values, sorted_arr):
     return sorted_arr[pos] == values
 
 
+@_spanned('bulk_load')
 def load_docs(buffers, fleet=None):
     """Load N saved documents into fleet-resident handles in one native
     parse + a few batched device dispatches. Returns handles in input

@@ -36,6 +36,8 @@ from ..backend.sync import (
     changes_to_send_prescan, decode_sync_message, encode_sync_message,
 )
 from ..errors import DocError, MalformedSyncMessage, as_wire_error
+from ..observability import recorder as _flight
+from ..observability.spans import span as _span, spanned as _spanned
 from .backend import apply_changes_docs, quarantine_stats
 from .bloom import (
     build_bloom_filters_batch_begin, build_bloom_filters_batch_finish,
@@ -47,6 +49,7 @@ __all__ = ['generate_sync_messages_docs', 'receive_sync_messages_docs',
            'dispatch_count']
 
 
+@_spanned('sync_generate')
 def generate_sync_messages_docs(backends, sync_states):
     """Batched ``generate_sync_message`` over N (backend, syncState) pairs.
     Returns (new_sync_states, messages) with messages[i] = bytes or None,
@@ -129,35 +132,37 @@ def generate_sync_messages_docs(backends, sync_states):
                 sync_states[i]['theirNeed'])
 
     new_states, messages = [], []
-    for i, (backend, state) in enumerate(zip(backends, sync_states)):
-        if results[i] is not None:
-            new_states.append(results[i][0])
-            messages.append(results[i][1])
-            continue
-        changes_to_send = changes_to_send_by_doc.get(i, [])
-        heads_unchanged = isinstance(state['lastSentHeads'], list) and \
-            our_heads[i] == state['lastSentHeads']
-        heads_equal = isinstance(state['theirHeads'], list) and \
-            our_heads[i] == state['theirHeads']
-        if heads_unchanged and heads_equal and not changes_to_send:
-            new_states.append(state)
-            messages.append(None)
-            continue
-        sent_hashes = state['sentHashes']
-        changes_to_send = [c for c in changes_to_send
-                           if _cached_meta(c)['hash'] not in sent_hashes]
-        message = {'heads': our_heads[i], 'have': our_have[i],
-                   'need': our_need[i], 'changes': changes_to_send}
-        if changes_to_send:
-            sent_hashes = set(sent_hashes)
-            for change in changes_to_send:
-                sent_hashes.add(_cached_meta(change)['hash'])
-        new_states.append(dict(state, lastSentHeads=our_heads[i],
-                               sentHashes=sent_hashes))
-        messages.append(encode_sync_message(message))
+    with _span('sync_encode', docs=n):
+        for i, (backend, state) in enumerate(zip(backends, sync_states)):
+            if results[i] is not None:
+                new_states.append(results[i][0])
+                messages.append(results[i][1])
+                continue
+            changes_to_send = changes_to_send_by_doc.get(i, [])
+            heads_unchanged = isinstance(state['lastSentHeads'], list) and \
+                our_heads[i] == state['lastSentHeads']
+            heads_equal = isinstance(state['theirHeads'], list) and \
+                our_heads[i] == state['theirHeads']
+            if heads_unchanged and heads_equal and not changes_to_send:
+                new_states.append(state)
+                messages.append(None)
+                continue
+            sent_hashes = state['sentHashes']
+            changes_to_send = [c for c in changes_to_send
+                               if _cached_meta(c)['hash'] not in sent_hashes]
+            message = {'heads': our_heads[i], 'have': our_have[i],
+                       'need': our_need[i], 'changes': changes_to_send}
+            if changes_to_send:
+                sent_hashes = set(sent_hashes)
+                for change in changes_to_send:
+                    sent_hashes.add(_cached_meta(change)['hash'])
+            new_states.append(dict(state, lastSentHeads=our_heads[i],
+                                   sentHashes=sent_hashes))
+            messages.append(encode_sync_message(message))
     return new_states, messages
 
 
+@_spanned('sync_receive')
 def receive_sync_messages_docs(backends, sync_states, binary_messages,
                                mirror=True, on_error='raise'):
     """Batched ``receive_sync_message`` over N docs. messages[i] may be None
@@ -181,18 +186,35 @@ def receive_sync_messages_docs(backends, sync_states, binary_messages,
                          f"got {on_error!r}")
     errors = [None] * n
     decoded = [None] * n
-    for i, message_bytes in enumerate(binary_messages):
-        if message_bytes is None:
-            continue
-        try:
-            decoded[i] = decode_sync_message(message_bytes)
-        except Exception as exc:
-            err = as_wire_error(exc, MalformedSyncMessage,
-                                'receive_sync_messages_docs', doc_index=i)
-            if not quarantine:
-                raise err
-            errors[i] = DocError(i, 'decode', err)
-            quarantine_stats['quarantined_docs'] += 1
+    with _span('sync_decode', docs=n):
+        for i, message_bytes in enumerate(binary_messages):
+            if message_bytes is None:
+                continue
+            try:
+                decoded[i] = decode_sync_message(message_bytes)
+            except Exception as exc:
+                err = as_wire_error(exc, MalformedSyncMessage,
+                                    'receive_sync_messages_docs',
+                                    doc_index=i)
+                if not quarantine:
+                    raise err
+                errors[i] = DocError(i, 'decode', err)
+                quarantine_stats['quarantined_docs'] += 1
+                state = backends[i].get('state') \
+                    if isinstance(backends[i], dict) else None
+                _flight.record_event(
+                    'quarantine', doc=i, stage='decode',
+                    error=type(err).__name__, message=str(err)[:200],
+                    durable_id=getattr(state, '_dur_id', None),
+                    change_bytes=len(message_bytes))
+    if any(e is not None for e in errors):
+        # undecodable sync messages: forensic dump now — the apply path
+        # below only dumps for ITS rejects, and never sees these docs
+        _flight.dump_flight_record('quarantine', detail={'errors': [
+            e.describe(durable_id=getattr(
+                backends[i].get('state') if isinstance(backends[i], dict)
+                else None, '_dur_id', None))
+            for i, e in enumerate(errors) if e is not None]})
     before_heads = [get_heads(b) for b in backends]
 
     per_doc_changes = [list(d['changes']) if d else [] for d in decoded]

@@ -15,6 +15,7 @@ import sys
 import numpy as np
 
 from ..errors import MalformedChange
+from ..observability.spans import span as _span
 
 _SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'codec.cpp')
 _LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -125,6 +126,11 @@ def sha256(data):
 
 def sha256_batch(buffers):
     """Hash many buffers (e.g. one change per document across a fleet)."""
+    with _span('sha256_batch', buffers=len(buffers)):
+        return _sha256_batch(buffers)
+
+
+def _sha256_batch(buffers):
     lib = _load()
     if lib is None:
         import hashlib
@@ -268,6 +274,12 @@ def ingest_changes(buffers, doc_ids, with_meta=False, with_seq=False,
     Python list's bytes objects in place — no blob join, no length
     array, no type scan (those Python-side passes cost more than the
     parse itself at fleet scale)."""
+    with _span('native_parse', buffers=len(buffers), with_meta=with_meta):
+        return _ingest_changes(buffers, doc_ids, with_meta, with_seq,
+                               blob, lens)
+
+
+def _ingest_changes(buffers, doc_ids, with_meta, with_seq, blob, lens):
     lib = _load()
     if lib is None:
         return None
@@ -506,6 +518,11 @@ def parse_documents(buffers):
     Actions are wire numbers (0 makeMap, 1 set, 2 makeList, 4 makeText,
     5 inc, 6 makeTable); del rows never appear in documents
     (columnar.js:892)."""
+    with _span('native_doc_parse', buffers=len(buffers)):
+        return _parse_documents(buffers)
+
+
+def _parse_documents(buffers):
     lib = _load()
     if lib is None:
         return None

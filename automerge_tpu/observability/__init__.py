@@ -1,0 +1,83 @@
+"""Metrics, tracing, and forensics for the fleet engine.
+
+The reference has no tracing/profiling/metrics at all (SURVEY.md §5 — its
+only observability is patchCallback/Observable/getHistory, which this
+framework also provides). A batched device engine needs more: you cannot
+see an XLA dispatch from a patchCallback, and when one document in a
+10k-doc fused batch is quarantined you need to know which one, in what
+phase, and what happened around it. Four layers, one package:
+
+- **Counters & roll-ups** (metrics.py): per-fleet monotonic `Metrics`,
+  `timed` phase seconds, `register_dispatch_source`/`dispatch_counts`
+  and `register_health_source`/`health_counts` system-wide roll-ups,
+  and the `trace` wrapper around `jax.profiler.trace`.
+- **Host-phase spans** (spans.py): `span(name, **attrs)` — near-zero
+  overhead while disabled, a bounded ring while enabled — instrumented
+  at every hot seam (native parse, SHA, turbo gate/stage/commit, device
+  dispatch, mirror rebuild, actor remap, journal append/commit/fsync,
+  checkpoint, compaction, recovery replay, Bloom build/probe, sync
+  encode/decode). `export_chrome_trace` writes Perfetto-loadable JSON
+  that lines up beside a `trace()` device capture.
+- **Latency histograms** (hist.py): fixed log2-bucket `Histogram`s with
+  p50/p95/p99 summaries and bucketwise `snapshot()`/`delta()` — batch
+  apply latency, fsync latency, sync round-trip, per-doc change bytes,
+  recovery per-doc replay time.
+- **Flight recorder** (recorder.py): an always-on bounded ring of
+  structured health events (doc ids, durable ids, typed error names,
+  change-byte digests) that dumps a JSON forensic report automatically
+  on quarantine, recovery truncation/rot, and SyncOverflow — each dump
+  also carrying the span ring's tail, so a traced run's report includes
+  the phase timeline around the fault without span churn ever evicting
+  the fault events themselves.
+
+`enable()`/`disable()` flip spans + histograms together (the switch the
+bench's <=2% overhead budget is measured across); the flight recorder's
+event ring stays on either way. `tools/obs_report.py` renders a
+phase-attribution report from an exported trace or a forensic dump.
+"""
+
+from . import hist as _hist
+from . import recorder as _recorder
+from . import spans as _spans
+from .hist import (Histogram, histogram, histogram_delta,
+                   histogram_snapshot, record_value)
+from .metrics import (Metrics, dispatch_counts, health_counts,
+                      register_dispatch_source, register_health_source,
+                      timed, trace)
+from .recorder import (configure as configure_flight_recorder, clear_events,
+                       dump_flight_record, flight_stats, last_flight_record,
+                       recent_events, record_event)
+from .spans import (clear as clear_spans, export_chrome_trace, iter_spans,
+                    span, span_count, span_seq, spanned)
+
+__all__ = [
+    'Metrics', 'timed', 'trace',
+    'register_dispatch_source', 'dispatch_counts',
+    'register_health_source', 'health_counts',
+    'span', 'span_seq', 'spanned', 'iter_spans', 'clear_spans',
+    'span_count', 'export_chrome_trace',
+    'Histogram', 'histogram', 'record_value', 'histogram_snapshot',
+    'histogram_delta',
+    'record_event', 'recent_events', 'clear_events', 'dump_flight_record',
+    'last_flight_record', 'flight_stats', 'configure_flight_recorder',
+    'enable', 'disable', 'enabled',
+]
+
+
+def enable(span_capacity=4096):
+    """Turn span recording AND histogram recording on (the observe
+    switch; off by default — the hot seams' instrumentation cost while
+    off is one flag check per seam)."""
+    _spans.enable(capacity=span_capacity)
+    _hist.enable()
+
+
+def disable():
+    """Turn spans + histograms off (rings/registries are retained for
+    inspection until the next enable()/reset)."""
+    _spans.disable()
+    _hist.disable()
+
+
+def enabled():
+    return _spans.on() or _hist.on()

@@ -1,18 +1,15 @@
-"""Metrics and profiling for the fleet engine.
-
-The reference has no tracing/profiling/metrics at all (SURVEY.md §5 — its
-only observability is patchCallback/Observable/getHistory, which this
-framework also provides). A batched device engine needs more: you cannot see
-an XLA dispatch from a patchCallback. This module provides the two tools the
-build plan names: per-dispatch op counters and JAX profiler traces.
+"""Monotonic counters and the dispatch/health roll-up registries.
 
 - `Metrics`: cheap monotonic counters every DocFleet maintains
   (`fleet.metrics`): device dispatches, ops applied on device, changes
-  ingested, bytes ingested, host fallbacks, actor renumber remaps, capacity
-  growths. `snapshot()` returns a plain dict; `delta(prev)` diffs two
-  snapshots — subtract around a workload to get per-phase counts.
+  ingested, bytes ingested, host fallbacks, actor renumber remaps,
+  capacity growths. `snapshot()` returns a plain dict; `delta(prev)`
+  diffs two snapshots — subtract around a workload to get per-phase
+  counts.
 - `trace(path)`: context manager around `jax.profiler.trace` — writes a
-  TensorBoard-loadable XLA trace of everything dispatched inside the block.
+  TensorBoard-loadable XLA trace of everything dispatched inside the
+  block (merge the host-span Chrome trace from spans.py next to it in
+  Perfetto; see BASELINE.md "Observability contract").
 - `timed(metrics, key)`: context manager accumulating wall-clock seconds
   into a counter, for host-side phases (decode, gate, patch build).
 - `register_dispatch_source(name, fn)` / `dispatch_counts(fleets)`: one
@@ -28,14 +25,20 @@ build plan names: per-dispatch op counters and JAX profiler traces.
   rejected changes/filters, sync retries, injected wire faults, fuzz
   corpus size, and the durability layer's checkpoint/compaction/
   journal-fsync/replay/truncation/rot counters (fleet/durability.py).
-  The modules that absorb bad input register monotonic counters at
-  import; bench.py reports the roll-up per run and the chaos tests diff
-  it around a workload to prove corruption was contained (counter
-  moved) rather than silently dropped or fatally propagated.
+
+The roll-up key space is shared with the synthetic keys `dispatch_counts`
+itself emits ('total', and 'fleet<N>' per passed fleet), so those names
+are RESERVED: registering a source under one would silently corrupt the
+roll-up (the module counter overwritten by — or summed into — the
+synthetic key). Both register functions reject them with ValueError.
 """
 
 import contextlib
+import re
 import time
+
+__all__ = ['Metrics', 'timed', 'trace', 'register_dispatch_source',
+           'dispatch_counts', 'register_health_source', 'health_counts']
 
 
 class Metrics:
@@ -97,11 +100,27 @@ def timed(metrics, key):
 
 _dispatch_sources = {}
 
+# 'total' and 'fleet<N>' are synthesized by dispatch_counts itself; a
+# module registering under either would corrupt the roll-up (round-7
+# satellite: the collision was silent before this guard).
+_RESERVED = re.compile(r'total|fleet\d+')
+
+
+def _check_source_name(name):
+    if not isinstance(name, str) or _RESERVED.fullmatch(name):
+        raise ValueError(
+            f'{name!r} is reserved: dispatch_counts() synthesizes '
+            f"'total' and 'fleet<N>' keys, so sources may not register "
+            f'under those names')
+
 
 def register_dispatch_source(name, fn):
     """Register a zero-arg callable returning a module's monotonic device
     dispatch count (e.g. fleet.bloom registers its batched build/probe
-    counter at import). Re-registering a name replaces the source."""
+    counter at import). Re-registering a name replaces the source.
+    Raises ValueError for the reserved roll-up keys ('total',
+    'fleet<N>')."""
+    _check_source_name(name)
     _dispatch_sources[name] = fn
 
 
@@ -126,7 +145,9 @@ def register_health_source(name, fn):
     """Register a zero-arg callable returning a module's monotonic
     fault-containment counter (quarantined docs, rejected changes, sync
     retries, injected wire faults, ...). Re-registering a name replaces
-    the source — same contract as register_dispatch_source."""
+    the source — same contract (and same reserved-name rejection) as
+    register_dispatch_source."""
+    _check_source_name(name)
     _health_sources[name] = fn
 
 

@@ -1,11 +1,23 @@
-"""Metrics counters and profiler trace helper (automerge_tpu.observability)."""
+"""Metrics counters, host-phase spans, log2 histograms, and the flight
+recorder (automerge_tpu.observability package)."""
 
 import numpy as np
+import pytest
 
+from automerge_tpu import observability
 from automerge_tpu.fleet import backend as fleet_backend
 from automerge_tpu.fleet.backend import DocFleet, FleetBackend
-from automerge_tpu.observability import Metrics, timed
+from automerge_tpu.observability import Histogram, Metrics, timed
+from automerge_tpu.observability import hist as obs_hist
+from automerge_tpu.observability import spans as obs_spans
 from tests.test_fleet_backend import change_buf, ACTORS
+
+
+@pytest.fixture(autouse=True)
+def _obs_off():
+    """Leave the module switches as the test found them (off)."""
+    yield
+    observability.disable()
 
 
 def test_metrics_counters_track_turbo_and_exact():
@@ -81,3 +93,238 @@ def test_fleet_memory_stats():
     finally:
         from automerge_tpu import backend as host_backend
         A.set_default_backend(host_backend)
+
+
+# ---------------------------------------------------------------------------
+# roll-up registries: reserved-name rejection (key-collision hazard)
+# ---------------------------------------------------------------------------
+
+
+def test_register_sources_reject_reserved_names():
+    """dispatch_counts() synthesizes 'total' and 'fleet<N>' keys; a source
+    registered under one used to silently corrupt the roll-up (the module
+    counter summed into / overwritten by the synthetic key). Both
+    registries must refuse them."""
+    from automerge_tpu.observability import (register_dispatch_source,
+                                             register_health_source)
+    for bad in ('total', 'fleet0', 'fleet7', 'fleet123'):
+        with pytest.raises(ValueError):
+            register_dispatch_source(bad, lambda: 0)
+        with pytest.raises(ValueError):
+            register_health_source(bad, lambda: 0)
+    # non-reserved names that merely CONTAIN a reserved substring are fine
+    from automerge_tpu.observability import metrics as obs_metrics
+    try:
+        register_dispatch_source('total_test_src', lambda: 0)
+        register_dispatch_source('fleet_bloom_test', lambda: 0)
+        counts = observability.dispatch_counts()
+        assert counts['total_test_src'] == 0
+        assert counts['fleet_bloom_test'] == 0
+        # and the synthetic keys stay intact alongside them
+        fleet = DocFleet(doc_capacity=2, key_capacity=2)
+        counts = observability.dispatch_counts([fleet])
+        assert counts['fleet0'] == fleet.metrics.dispatches
+        assert counts['total'] == sum(v for k, v in counts.items()
+                                      if k != 'total')
+    finally:
+        obs_metrics._dispatch_sources.pop('total_test_src', None)
+        obs_metrics._dispatch_sources.pop('fleet_bloom_test', None)
+
+
+# ---------------------------------------------------------------------------
+# histograms
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_bucket_boundaries():
+    h = Histogram('bytes', scale=1)
+    # bucket b holds scaled values in [2^(b-1), 2^b); bucket 0 holds < 1
+    assert h.bucket_of(0) == 0
+    assert h.bucket_of(1) == 1
+    assert h.bucket_of(2) == 2
+    assert h.bucket_of(3) == 2
+    assert h.bucket_of(4) == 3
+    assert h.bucket_of(1023) == 10
+    assert h.bucket_of(1024) == 11
+    assert h.bucket_bounds(3) == (4.0, 8.0)
+    # nanosecond-scaled seconds histograms
+    hs = Histogram('lat', scale=1e9)
+    assert hs.bucket_of(0.0) == 0
+    assert hs.bucket_of(1e-9) == 1
+    assert hs.bucket_of(1.0) == 30     # 1e9 ns -> bit_length 30
+    lo, hi = hs.bucket_bounds(hs.bucket_of(0.001))
+    assert lo <= 0.001 < hi
+
+
+def test_histogram_record_and_percentiles():
+    h = Histogram('lat', scale=1)
+    for v in (1, 1, 1, 1, 1, 1, 1, 1, 1, 100):
+        h.record(v)
+    s = h.summary()
+    assert s['count'] == 10 and s['sum'] == 109
+    assert s['min'] == 1 and s['max'] == 100
+    # p50 falls in bucket 1 (upper bound 2); p99 in 100's bucket (128)
+    assert s['p50'] == 2.0
+    assert s['p99'] == 128.0
+
+
+def test_histogram_record_many_matches_scalar_path():
+    a = Histogram('a', scale=1e9)
+    b = Histogram('b', scale=1e9)
+    values = [0.0, 1e-9, 5e-7, 3.2e-4, 0.01, 0.25, 1.5]
+    for v in values:
+        a.record(v)
+    b.record_many(np.asarray(values))
+    assert a.counts == b.counts
+    assert a.count == b.count
+    assert a.vmin == b.vmin and a.vmax == b.vmax
+
+
+def test_histogram_snapshot_delta():
+    h = Histogram('lat', scale=1)
+    for v in (1, 2, 4):
+        h.record(v)
+    snap = h.snapshot()
+    assert snap['count'] == 3 and snap['buckets'][1] == 1
+    for v in (64, 64, 64):
+        h.record(v)
+    d = h.delta(snap)
+    # the delta distribution is ONLY the three 64s
+    assert d['count'] == 3 and d['sum'] == 192
+    assert d['p50'] == 128.0 and d['p99'] == 128.0
+    assert sum(d['buckets']) == 3 and d['buckets'][7] == 3
+    assert 'min' not in d          # min/max are not delta-able
+
+
+def test_record_value_respects_master_switch():
+    obs_hist.reset()
+    observability.record_value('gated_metric', 1.0)
+    assert 'gated_metric' not in observability.histogram_snapshot()
+    observability.enable()
+    observability.record_value('gated_metric', 1.0)
+    observability.disable()
+    assert observability.histogram_snapshot()['gated_metric']['count'] == 1
+    obs_hist.reset()
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+
+def test_span_ring_wraparound_keeps_newest():
+    observability.enable(span_capacity=4)
+    for i in range(10):
+        with observability.span(f's{i}'):
+            pass
+    spans = observability.iter_spans()
+    assert [s['name'] for s in spans] == ['s6', 's7', 's8', 's9']
+    assert observability.span_count() == 10
+    observability.disable()
+
+
+def test_spans_balanced_under_exceptions():
+    """Every begin has an end even when the block raises; the exception
+    type is recorded on the span."""
+    observability.enable(span_capacity=16)
+    with pytest.raises(ValueError):
+        with observability.span('outer'):
+            with observability.span('inner', doc=3):
+                raise ValueError('boom')
+    spans = observability.iter_spans()
+    assert [s['name'] for s in spans] == ['inner', 'outer']
+    assert all(s['t1_ns'] >= s['t0_ns'] for s in spans)
+    assert spans[0]['error'] == 'ValueError'
+    assert spans[0]['attrs'] == {'doc': 3}
+    assert spans[1]['error'] == 'ValueError'
+    observability.disable()
+
+
+def test_span_seq_tiles_contiguously():
+    observability.enable(span_capacity=16)
+    ps = observability.span_seq()
+    ps.mark('a')
+    ps.mark('b')
+    ps.mark('c')
+    ps.done()
+    spans = observability.iter_spans()
+    assert [s['name'] for s in spans] == ['a', 'b', 'c']
+    # each phase ends exactly where the next begins: no unattributed gap
+    assert spans[0]['t1_ns'] == spans[1]['t0_ns']
+    assert spans[1]['t1_ns'] == spans[2]['t0_ns']
+    observability.disable()
+
+
+def test_span_off_is_noop_and_cheap():
+    assert not obs_spans.on()
+    before = observability.span_count()
+    with observability.span('never'):
+        pass
+    assert observability.span_count() == before
+
+
+def test_export_chrome_trace_format(tmp_path):
+    import json
+    observability.enable(span_capacity=8)
+    with observability.span('phase', docs=2):
+        pass
+    path = tmp_path / 'trace.json'
+    events = observability.export_chrome_trace(str(path))
+    assert events and events[-1]['ph'] == 'X'
+    assert events[-1]['name'] == 'phase'
+    assert events[-1]['dur'] >= 0 and 'ts' in events[-1]
+    assert events[-1]['args'] == {'docs': 2}
+    on_disk = json.loads(path.read_text())
+    assert on_disk['traceEvents'] == events
+    observability.disable()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_ring_and_dump(tmp_path):
+    import json
+    from automerge_tpu.observability import recorder
+    recorder.clear_events()
+    recorder.configure(capacity=3)
+    for i in range(5):
+        observability.record_event('probe', doc=i)
+    evs = observability.recent_events()
+    assert [e['doc'] for e in evs] == [2, 3, 4]       # bounded ring
+    report = observability.dump_flight_record(
+        'unit_test', detail={'docs': [4]},
+        path=str(tmp_path / 'dump.json'))
+    assert report['trigger'] == 'unit_test'
+    assert [e['doc'] for e in report['events']] == [2, 3, 4]
+    assert observability.last_flight_record() is report
+    on_disk = json.loads((tmp_path / 'dump.json').read_text())
+    assert on_disk['trigger'] == 'unit_test'
+    assert on_disk['detail'] == {'docs': [4]}
+    assert 'health' in on_disk
+    recorder.configure(capacity=256)
+    recorder.clear_events()
+
+
+def test_dump_carries_recent_spans_without_evicting_events():
+    """Span closes must NOT churn the small fault-event ring (a traced
+    recovery would otherwise evict the rot/quarantine events the dump
+    exists for); instead the dump reads the span ring's tail."""
+    from automerge_tpu.observability import recorder
+    recorder.clear_events()
+    recorder.configure(capacity=4)
+    observability.record_event('journal_rot', durable_id=9, at_byte=123)
+    observability.enable(span_capacity=64)
+    for i in range(32):                       # far past event capacity
+        with observability.span(f'phase{i}'):
+            pass
+    observability.disable()
+    evs = observability.recent_events()
+    assert [e['kind'] for e in evs] == ['journal_rot']   # not evicted
+    report = observability.dump_flight_record('unit_test')
+    assert report['events'][0]['kind'] == 'journal_rot'
+    assert [s['name'] for s in report['recent_spans']][-1] == 'phase31'
+    assert len(report['recent_spans']) <= 64
+    recorder.configure(capacity=256)
+    recorder.clear_events()

@@ -1,0 +1,130 @@
+"""Phase-attribution report from a host-span Chrome trace (or a flight
+recorder forensic dump).
+
+Usage:
+    python tools/obs_report.py traces/obs_host_trace.json
+    python tools/obs_report.py --flight flight-quarantine-1.json
+
+Trace mode reads the Chrome trace-event JSON that
+``observability.export_chrome_trace`` writes (a bare event list or a
+``{"traceEvents": [...]}`` wrapper — the same shapes Perfetto accepts)
+and renders, per span name: call count, total/mean/max milliseconds, and
+share of the trace's wall-clock — the per-phase merge-cost breakdown the
+ROADMAP's parse/merge-overlap work needs (cf. the differential-merge
+phase analysis in PAPERS.md "Fast Updates on Read-Optimized Databases").
+Spans nest (native_parse inside turbo_parse, dispatch_grid inside
+turbo_dispatch), so percentages legitimately sum past 100; the
+``turbo_*`` phase rows tile each batch and sum to ~the batch wall.
+
+Flight mode pretty-prints a forensic dump: trigger, per-doc errors
+(slot, durable id, stage, typed error), then the surrounding event ring.
+
+stdlib only — usable on a box with nothing else installed.
+"""
+
+import json
+import sys
+
+
+def load_events(path):
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        data = data.get('traceEvents', [])
+    return [e for e in data if e.get('ph') == 'X']
+
+
+def attribution(events):
+    """Per-name rollup: count, total/mean/max duration (µs), wall share.
+    Returns (rows sorted by total desc, wall_us)."""
+    stats = {}
+    lo, hi = None, None
+    for e in events:
+        name = e.get('name', '?')
+        dur = float(e.get('dur', 0.0))
+        ts = float(e.get('ts', 0.0))
+        ent = stats.setdefault(name, [0, 0.0, 0.0])
+        ent[0] += 1
+        ent[1] += dur
+        if dur > ent[2]:
+            ent[2] = dur
+        lo = ts if lo is None else min(lo, ts)
+        hi = ts + dur if hi is None else max(hi, ts + dur)
+    wall = (hi - lo) if events else 0.0
+    rows = [(name, n, tot, tot / n, mx,
+             (100.0 * tot / wall) if wall else 0.0)
+            for name, (n, tot, mx) in stats.items()]
+    rows.sort(key=lambda r: -r[2])
+    return rows, wall
+
+
+def render_trace(path, out=sys.stdout):
+    events = load_events(path)
+    rows, wall = attribution(events)
+    print(f'# {path}: {len(events)} spans, wall {wall / 1000.0:.2f} ms',
+          file=out)
+    print(f'{"phase":<24}{"calls":>7}{"total ms":>11}{"mean ms":>10}'
+          f'{"max ms":>10}{"% wall":>8}', file=out)
+    for name, n, tot, mean, mx, pct in rows:
+        print(f'{name:<24}{n:>7}{tot / 1000.0:>11.3f}'
+              f'{mean / 1000.0:>10.3f}{mx / 1000.0:>10.3f}{pct:>8.1f}',
+              file=out)
+    return rows
+
+
+def render_flight(path, out=sys.stdout):
+    with open(path) as f:
+        report = json.load(f)
+    print(f'# flight record: trigger={report.get("trigger")!r} '
+          f'seq={report.get("seq")}', file=out)
+    detail = report.get('detail') or {}
+    for err in detail.get('errors', []):
+        print(f'  doc {err.get("doc")} (durable id '
+              f'{err.get("durable_id")}): {err.get("error")} at stage '
+              f'{err.get("stage")!r} — {err.get("message")}', file=out)
+    for key in ('torn_tail_bytes', 'rotted_records', 'global_max'):
+        if detail.get(key):
+            print(f'  {key}: {detail[key]}', file=out)
+    events = report.get('events', [])
+    print(f'# surrounding events ({len(events)}):', file=out)
+    for ev in events:
+        kind = ev.get('kind')
+        rest = {k: v for k, v in ev.items() if k not in ('kind', 'ts_ns')}
+        print(f'  [{kind}] {rest}', file=out)
+    spans = report.get('recent_spans', [])
+    if spans:
+        print(f'# phase timeline around the fault ({len(spans)} spans):',
+              file=out)
+        for s in spans:
+            extra = f' {s["attrs"]}' if s.get('attrs') else ''
+            err = f' ERROR={s["error"]}' if s.get('error') else ''
+            print(f'  {s["name"]:<22}{s["dur_ns"] / 1e6:9.3f} ms'
+                  f'{extra}{err}', file=out)
+    health = report.get('health') or {}
+    moved = {k: v for k, v in health.items() if v}
+    if moved:
+        print(f'# health counters at dump: {moved}', file=out)
+    return report
+
+
+def main(argv):
+    if not argv or argv[0] in ('-h', '--help'):
+        print(__doc__.strip())
+        return 2
+    if argv[0] == '--flight':
+        if len(argv) < 2:
+            print('--flight needs a dump path', file=sys.stderr)
+            return 2
+        render_flight(argv[1])
+        return 0
+    render_trace(argv[0])
+    return 0
+
+
+if __name__ == '__main__':
+    try:
+        sys.exit(main(sys.argv[1:]))
+    except BrokenPipeError:      # | head
+        os_devnull = open('/dev/null', 'w')
+        sys.stdout = os_devnull
+        sys.exit(0)
