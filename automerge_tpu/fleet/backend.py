@@ -37,6 +37,8 @@ import contextlib
 import copy
 import gc
 import hashlib
+import queue
+import threading
 import time
 
 import numpy as np
@@ -2843,22 +2845,109 @@ def _journal_of(handles):
 
 
 def apply_changes_docs(handles, per_doc_changes, mirror=True,
-                       on_error='raise'):
+                       on_error='raise', _parsed=None):
     """Apply per-document change lists across the fleet. Returns
     (see _apply_changes_docs_impl for the full contract). When
     observability is enabled the whole batch records an `apply_batch`
-    span and an `apply_batch_s` latency histogram sample."""
+    span and an `apply_batch_s` latency histogram sample. `_parsed` is
+    the pipelined driver's pre-parsed native ingest result (private —
+    see apply_changes_docs_pipelined)."""
     start = time.perf_counter()
     with _span('apply_batch', docs=len(handles), mirror=mirror,
                on_error=on_error):
         out = _apply_changes_docs_impl(handles, per_doc_changes, mirror,
-                                       on_error)
+                                       on_error, _parsed)
     _hist.record_value('apply_batch_s', time.perf_counter() - start,
                        scale=1e9, unit='s')
     return out
 
 
-def _apply_changes_docs_impl(handles, per_doc_changes, mirror, on_error):
+def apply_changes_docs_pipelined(handles, per_doc_changes, sub_batches=4,
+                                 mirror=False):
+    """Pipelined turbo apply: split every document's change run into
+    `sub_batches` consecutive sub-runs and overlap the NATIVE PARSE of
+    sub-run k+1 with the host gate/commit and (async) device dispatch of
+    sub-run k. The parse runs on a background Python thread, but the
+    native codec releases the GIL across the whole batch and fans the
+    chunks over its thread pool, so the overlap is real CPU concurrency,
+    not just dispatch asynchrony — the span rig shows `parse_chunk` /
+    `native_parse` spans tiling under the previous sub-batch's
+    `turbo_commit`/`turbo_dispatch` phases (bench.py's seam section
+    measures the overlap from the exported trace).
+
+    Committed state is byte-identical to `sub_batches` sequential
+    apply_changes_docs calls over the same splits (the prefetched parse
+    is a pure function of the bytes). Only the turbo path pipelines; a
+    sub-batch that falls back to the exact path simply ignores its
+    prefetched parse. mirror=True (exact path) has no native parse to
+    overlap, so it routes to the plain call."""
+    if mirror or sub_batches <= 1:
+        return apply_changes_docs(handles, per_doc_changes, mirror=mirror)
+    work = [c if isinstance(c, (list, tuple)) else list(c)
+            for c in per_doc_changes]
+    subs = []
+    for s in range(int(sub_batches)):
+        sub = [None] * len(work)
+        any_changes = False
+        for d, changes in enumerate(work):
+            step = -(-len(changes) // int(sub_batches))   # ceil
+            run = changes[s * step:(s + 1) * step] if step else []
+            sub[d] = run
+            any_changes = any_changes or bool(run)
+        if any_changes:
+            subs.append(sub)
+    if not subs:
+        return apply_changes_docs(handles, per_doc_changes, mirror=False)
+
+    # Producer thread streams parses AHEAD of the consumer (bounded at 2
+    # in flight so a long run never accumulates every parsed sub-batch in
+    # memory): while the main thread gates/commits/dispatches sub-batch
+    # k, the producer is already parsing k+1 — and, once that lands, k+2.
+    # The native parse releases the GIL, so this is core-level overlap.
+    results = queue.Queue(maxsize=2)
+    stop = []
+
+    def producer():
+        for sub in subs:
+            if stop:
+                break
+            try:
+                flat = [b if type(b) is bytes else bytes(b)
+                        for changes in sub for b in changes]
+                parsed = (len(flat), native.ingest_changes(
+                    flat, None, with_meta=True, with_seq=True))
+            except BaseException as exc:
+                # the consumer's blocking get() must never wait on a dead
+                # producer: ship the failure and let the main thread raise
+                results.put(exc)
+                return
+            results.put(parsed)
+
+    worker = threading.Thread(target=producer, daemon=True)
+    worker.start()
+    patches = [None] * len(handles)
+    try:
+        for sub in subs:
+            parsed = results.get()
+            if isinstance(parsed, BaseException):
+                raise parsed
+            handles, patches = apply_changes_docs(handles, sub, mirror=False,
+                                                  _parsed=parsed)
+    finally:
+        # On an exception mid-pipeline the producer may be blocked on a
+        # full queue: signal it and drain so join() cannot hang.
+        stop.append(True)
+        try:
+            while True:
+                results.get_nowait()
+        except queue.Empty:
+            pass
+        worker.join()
+    return handles, patches
+
+
+def _apply_changes_docs_impl(handles, per_doc_changes, mirror, on_error,
+                             _parsed=None):
     """Apply per-document change lists across the fleet. Returns
     (new_handles, patches) — or (new_handles, patches, errors) with
     on_error='quarantine', where a bad input rejects ONLY its own doc
@@ -2910,7 +2999,7 @@ def _apply_changes_docs_impl(handles, per_doc_changes, mirror, on_error):
                 per_doc_changes = [c if isinstance(c, (list, tuple))
                                    else list(c) for c in per_doc_changes]
         with _gc_paused():
-            turbo = _apply_changes_turbo(handles, per_doc_changes)
+            turbo = _apply_changes_turbo(handles, per_doc_changes, _parsed)
             if turbo is not None and journal is not None:
                 # inside the GC pause: the ~4 small objects per framed
                 # record would otherwise re-trigger the gen-0 scans the
@@ -3184,11 +3273,19 @@ class _TurboMetaBatch:
         return self.hash_hex(i), meta['deps'], meta['actor'], meta
 
 
-def _apply_changes_turbo(handles, per_doc_changes):
+def _apply_changes_turbo(handles, per_doc_changes, parsed=None):
     """Header-decode + native-ingest batched apply. Returns None when the
     workload can't take the turbo path (no native codec, non-fleet docs,
     multi-chunk buffers, or ops outside the flat subset), in which case the
     caller falls back to the exact path.
+
+    `parsed` is an optional pre-parsed native ingest result
+    ``(n_buffers, native.ingest_changes(...) output)`` produced by a
+    pipelined caller on a background thread (the native parse releases
+    the GIL, so it genuinely overlaps the previous sub-batch's commit +
+    device dispatch). It is used only when its buffer count matches this
+    call's flat batch; the parse is a pure function of the bytes, so the
+    result is identical to parsing inline.
 
     Control flow: one native parse for every change; chain validation
     (deps == current head, contiguous seqs) vectorized over the whole batch;
@@ -3205,12 +3302,13 @@ def _apply_changes_turbo(handles, per_doc_changes):
     ps = _span_seq()
     ps.mark('turbo_setup', docs=len(handles))
     try:
-        return _apply_changes_turbo_inner(handles, per_doc_changes, ps)
+        return _apply_changes_turbo_inner(handles, per_doc_changes, ps,
+                                          parsed)
     finally:
         ps.done()
 
 
-def _apply_changes_turbo_inner(handles, per_doc_changes, ps):
+def _apply_changes_turbo_inner(handles, per_doc_changes, ps, parsed=None):
     from .. import native
     from .tensor_doc import OpBatch, MAX_ACTORS as _MA
 
@@ -3263,8 +3361,11 @@ def _apply_changes_turbo_inner(handles, per_doc_changes, ps):
     # doc_ids=None: the zero-copy list entry (C walks the bytes objects
     # in place — no blob join, no length array; buffer i IS doc i here)
     ps.mark('turbo_parse', changes=n_changes)
-    out = native.ingest_changes(flat_buffers, None,
-                                with_meta=True, with_seq=True)
+    if parsed is not None and parsed[0] == n_changes:
+        out = parsed[1]   # prefetched on a background thread (pipelined)
+    else:
+        out = native.ingest_changes(flat_buffers, None,
+                                    with_meta=True, with_seq=True)
     if out is None:
         return None     # ops outside the fleet subset, or corrupt chunk
     rows, nat_keys, nat_actors, nmeta = out

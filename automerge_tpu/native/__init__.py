@@ -5,17 +5,47 @@ have pure-Python fallbacks so the library works without a toolchain, but the
 native path is the production one (SURVEY.md section 2.9 native accounting):
 SHA-256 (single + batched across documents), raw DEFLATE, and the
 LEB128/RLE/delta/boolean column decoders emitting int64 arrays + null masks.
+
+Multi-core contract (BASELINE.md "Multi-core contract"): the batched
+change parse and batched SHA run over a persistent native thread pool
+sized by ``AUTOMERGE_TPU_NATIVE_THREADS`` (default: the machine's cores,
+capped at 16; ``set_native_threads`` overrides at runtime). Parallel
+output is byte-identical to ``AUTOMERGE_TPU_NATIVE_THREADS=1`` — same
+column bytes, hashes, interned-table order, and typed-error verdicts —
+pinned by tests/test_native_parallel.py. The GIL is released across the
+whole batch (CDLL entry points release it implicitly; the zero-copy list
+entry releases it inside C++ after gathering buffer pointers), which is
+what lets fleet.backend's pipelined turbo path overlap the parse of
+sub-batch k+1 with the device dispatch of sub-batch k.
+
+A compiled binary carries an ABI stamp (``am_abi_version``); a stale .so
+that cannot be rebuilt fails loudly at import instead of silently running
+an old single-threaded codec (see tools/build_native.sh).
 """
 
 import ctypes
 import os
 import subprocess
 import sys
+import threading
 
 import numpy as np
 
 from ..errors import MalformedChange
+from ..observability import hist as _hist
+from ..observability.metrics import register_health_source
+from ..observability.spans import on as _spans_on
+from ..observability.spans import record_span as _record_span
 from ..observability.spans import span as _span
+
+# Bumped in lockstep with codec.cpp's am_abi_version whenever the C
+# surface changes shape. A mismatch means the cached .so predates this
+# wrapper (or vice versa) and MUST NOT be used.
+_ABI_VERSION = 1
+
+
+class NativeAbiMismatch(RuntimeError):
+    """A compiled codec binary is stale and could not be rebuilt."""
 
 _SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'codec.cpp')
 _LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -50,8 +80,10 @@ def _load_pydll():
 
 
 def _build():
-    cmd = ['g++', '-O3', '-shared', '-fPIC', '-std=c++17', _SRC, '-lz',
-           '-o', _LIB_PATH]
+    # -pthread: the codec spawns a persistent worker pool (NativePool);
+    # keep in sync with tools/build_native.sh
+    cmd = ['g++', '-O3', '-shared', '-fPIC', '-std=c++17', '-pthread',
+           _SRC, '-lz', '-o', _LIB_PATH]
     # CPython headers enable the zero-copy list ingest entry
     # (am_ingest_changes_list); codec.cpp compiles without them too
     try:
@@ -64,6 +96,17 @@ def _build():
     subprocess.run(cmd, check=True, capture_output=True)
 
 
+def _abi_of(lib):
+    """The binary's ABI stamp, or -1 when the symbol predates stamping."""
+    try:
+        fn = lib.am_abi_version
+    except AttributeError:
+        return -1
+    fn.argtypes = []
+    fn.restype = ctypes.c_int64
+    return int(fn())
+
+
 def _load():
     global _lib, _load_error
     if _lib is not None or _load_error is not None:
@@ -73,6 +116,30 @@ def _load():
                 os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC):
             _build()
         lib = ctypes.CDLL(_LIB_PATH)
+        if _abi_of(lib) != _ABI_VERSION:
+            # Stale binary (mtime lied — e.g. a prebuilt .so shipped with
+            # a fresher timestamp than the source). Rebuild; if that is
+            # impossible, fail LOUDLY rather than run the old codec
+            # single-threaded with a mismatched C surface.
+            try:
+                # unlink first: the stale mapping is still dlopen'd, and
+                # glibc dedups by (dev, inode) — rebuilding in place and
+                # re-dlopening the same inode would return the OLD library
+                os.remove(_LIB_PATH)
+                _build()
+            except Exception as exc:
+                raise NativeAbiMismatch(
+                    f'native codec binary {_LIB_PATH} has ABI '
+                    f'{_abi_of(lib)}, wrapper expects {_ABI_VERSION}, and '
+                    f'rebuilding failed ({exc}); rebuild it with '
+                    f'tools/build_native.sh or delete the stale .so'
+                ) from exc
+            lib = ctypes.CDLL(_LIB_PATH)
+            if _abi_of(lib) != _ABI_VERSION:
+                raise NativeAbiMismatch(
+                    f'native codec binary {_LIB_PATH} still reports ABI '
+                    f'{_abi_of(lib)} after a rebuild (wrapper expects '
+                    f'{_ABI_VERSION}) — source/wrapper version skew')
         u8p = ctypes.POINTER(ctypes.c_uint8)
         u64p = ctypes.POINTER(ctypes.c_uint64)
         i64p = ctypes.POINTER(ctypes.c_int64)
@@ -93,11 +160,118 @@ def _load():
         lib.am_decode_boolean.restype = ctypes.c_int64
         lib.am_count_rle.argtypes = [u8p, ctypes.c_uint64, ctypes.c_int]
         lib.am_count_rle.restype = ctypes.c_int64
+        lib.am_pool_configure.argtypes = [ctypes.c_int]
+        lib.am_pool_configure.restype = ctypes.c_int64
+        lib.am_pool_threads.argtypes = []
+        lib.am_pool_threads.restype = ctypes.c_int64
+        lib.am_pool_stats.argtypes = [i64p, i64p, i64p]
+        lib.am_pool_stats.restype = ctypes.c_int64
+        lib.am_ingest_parse_stats.argtypes = [i64p, i64p, i64p, i64p,
+                                              ctypes.c_int64]
+        lib.am_ingest_parse_stats.restype = ctypes.c_int64
+        global _threads
+        _threads = int(lib.am_pool_configure(_default_threads()))
         _lib = lib
+    except NativeAbiMismatch:
+        raise                     # stale binaries fail loudly, not silently
     except Exception as exc:  # toolchain missing or compile failure
         _load_error = exc
         _lib = None
     return _lib
+
+
+_threads = 1
+
+
+def _default_threads():
+    """Pool width: AUTOMERGE_TPU_NATIVE_THREADS, else cores capped at 16
+    (the codec's slices are memory-bandwidth-bound past that)."""
+    env = os.environ.get('AUTOMERGE_TPU_NATIVE_THREADS')
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return max(1, min(os.cpu_count() or 1, 16))
+
+
+def native_threads():
+    """The configured parse-pool width (1 when the codec is unavailable)."""
+    return _threads if _load() is not None else 1
+
+
+def set_native_threads(n):
+    """Resize the native parse pool; returns the previous width. The
+    determinism contract makes this a pure performance knob — outputs are
+    byte-identical at every width."""
+    global _threads
+    lib = _load()
+    if lib is None:
+        return 1
+    prev = _threads
+    with _ingest_lock:
+        _threads = int(lib.am_pool_configure(int(n)))
+    return prev
+
+
+def pool_stats():
+    """{'threads', 'tasks', 'busy_s'} — lifetime pool occupancy counters."""
+    lib = _load()
+    if lib is None:
+        return {'threads': 1, 'tasks': 0, 'busy_s': 0.0}
+    t = ctypes.c_int64(0)
+    n = ctypes.c_int64(0)
+    b = ctypes.c_int64(0)
+    lib.am_pool_stats(ctypes.byref(t), ctypes.byref(n), ctypes.byref(b))
+    return {'threads': int(t.value), 'tasks': int(n.value),
+            'busy_s': float(b.value) / 1e9}
+
+
+register_health_source('native_pool_tasks',
+                       lambda: pool_stats()['tasks'] if _lib else 0)
+
+
+def _note_parse_stats(lib):
+    """After an ingest: inject per-slice `parse_chunk` spans (worker-tagged
+    tids — each pool lane renders as its own Perfetto track) and record the
+    parse_chunk_s / parse_pool_occupancy histograms. Only runs when the
+    observability switches are on; called under _ingest_lock so the C-side
+    stats belong to OUR parse."""
+    spans_on = _spans_on()
+    hist_on = _hist.on()
+    if not (spans_on or hist_on):
+        return
+    wall_t0 = ctypes.c_int64(0)
+    wall_t1 = ctypes.c_int64(0)
+    threads = ctypes.c_int64(1)
+    rows = np.zeros(5 * 256, dtype=np.int64)
+    n = int(lib.am_ingest_parse_stats(
+        ctypes.byref(wall_t0), ctypes.byref(wall_t1), ctypes.byref(threads),
+        rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), 256))
+    if n <= 0:
+        return
+    rows = rows[:5 * n].reshape(n, 5)
+    busy_ns = 0
+    for t0, t1, first, count, worker in rows.tolist():
+        busy_ns += t1 - t0
+        if spans_on:
+            _record_span('parse_chunk', t0, t1, tid=1_000_000 + worker,
+                         first_chunk=first, chunks=count, worker=worker)
+        if hist_on:
+            _hist.record_value('parse_chunk_s', (t1 - t0) / 1e9,
+                               scale=1e9, unit='s')
+    if hist_on:
+        wall = max(int(wall_t1.value) - int(wall_t0.value), 1)
+        occ = 100.0 * busy_ns / (wall * max(int(threads.value), 1))
+        _hist.record_value('parse_pool_occupancy', occ, scale=1,
+                           unit='%')
+
+
+# The native ingest context is single-flight (two-phase parse+fetch over
+# one global C context); concurrent callers — e.g. the pipelined turbo
+# prefetch thread racing the first sub-batch's foreground parse —
+# serialize here instead of corrupting each other's fetches.
+_ingest_lock = threading.RLock()
 
 
 def available():
@@ -273,10 +447,20 @@ def ingest_changes(buffers, doc_ids, with_meta=False, with_seq=False,
     turbo shape) and enables the zero-copy list entry: C walks the
     Python list's bytes objects in place — no blob join, no length
     array, no type scan (those Python-side passes cost more than the
-    parse itself at fleet scale)."""
-    with _span('native_parse', buffers=len(buffers), with_meta=with_meta):
-        return _ingest_changes(buffers, doc_ids, with_meta, with_seq,
-                               blob, lens)
+    parse itself at fleet scale).
+
+    The parse itself is chunk-parallel over the native thread pool with
+    the GIL released (see the module docstring's multi-core contract);
+    concurrent callers serialize on the module ingest lock."""
+    with _span('native_parse', buffers=len(buffers), with_meta=with_meta,
+               threads=_threads):
+        with _ingest_lock:
+            out = _ingest_changes(buffers, doc_ids, with_meta, with_seq,
+                                  blob, lens)
+            lib = _lib
+            if lib is not None:
+                _note_parse_stats(lib)
+            return out
 
 
 def _ingest_changes(buffers, doc_ids, with_meta, with_seq, blob, lens):

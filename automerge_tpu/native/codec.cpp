@@ -280,12 +280,21 @@ void am_sha256(const uint8_t *data, uint64_t len, uint8_t *out) {
   sha256_stream_final(s, out);
 }
 
+// Defined next to the thread pool (below): fans the batch over the pool
+// when it is worth it. Returns false when the caller should hash serially.
+static bool sha256_batch_parallel(const uint8_t *data, const uint64_t *offsets,
+                                  const uint64_t *lens, uint64_t n,
+                                  uint8_t *out);
+
 // Batched hashing: n buffers, each lens[i] bytes at data + offsets[i];
 // out receives n * 32 bytes. The per-doc hash chains of a fleet are
 // independent, so this parallelizes across documents (SURVEY.md section 7
-// hard part 5: batch across docs, not within a doc).
+// hard part 5: batch across docs, not within a doc) — long contiguous
+// runs per worker keep the SHA-NI block loop hot instead of interleaving
+// per-chunk state swaps.
 void am_sha256_batch(const uint8_t *data, const uint64_t *offsets,
                      const uint64_t *lens, uint64_t n, uint8_t *out) {
+  if (sha256_batch_parallel(data, offsets, lens, n, out)) return;
   for (uint64_t i = 0; i < n; i++) {
     am_sha256(data + offsets[i], lens[i], out + 32 * i);
   }
@@ -520,11 +529,215 @@ int64_t am_count_rle(const uint8_t *buf, uint64_t len, int is_signed) {
 // the general host engine.
 // ---------------------------------------------------------------------------
 
+#include <pthread.h>
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <ctime>
+#include <functional>
+#include <mutex>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
 namespace {
+
+// CLOCK_MONOTONIC nanoseconds — the SAME epoch CPython's
+// time.perf_counter_ns() reads on Linux, so slice timings exported to the
+// Python span ring line up with host-phase spans in one Perfetto timeline.
+static int64_t now_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return int64_t(ts.tv_sec) * 1000000000ll + ts.tv_nsec;
+}
+
+// ---------------------------------------------------------------------------
+// Persistent native thread pool (the multi-core parse engine).
+//
+// One pool per process, lazily spawned, sized by am_pool_configure (the
+// Python wrapper feeds AUTOMERGE_TPU_NATIVE_THREADS). The caller thread
+// participates as worker 0, so `threads` == concurrent lanes, not helper
+// count. run() is a blocking fork-join over an atomic task counter; jobs
+// are serialized by run_m_ (the codec's ingest contexts are single-flight
+// anyway). All sync objects live behind pointers so the pthread_atfork
+// child handler can abandon them wholesale: in a forked child the worker
+// threads do not exist and any mutex held at fork time is locked forever —
+// leaking a few kilobytes beats deadlocking the child's first parse.
+// ---------------------------------------------------------------------------
+
+constexpr int kMaxThreads = 64;
+
+class NativePool {
+ public:
+  static NativePool &inst() {
+    static NativePool *p = new NativePool();  // leaked: no exit-order races
+    return *p;
+  }
+
+  int configure(int n) {
+    if (n < 1) n = 1;
+    if (n > kMaxThreads) n = kMaxThreads;
+    std::lock_guard<std::mutex> rg(*run_m_);  // never mid-job
+    std::unique_lock<std::mutex> lk(*m_);
+    target_ = n;
+    if (int(workers_->size()) > target_ - 1) {
+      // shrink: stop everyone; they respawn lazily up to target-1
+      stop_ = true;
+      cv_->notify_all();
+      lk.unlock();
+      for (auto &t : *workers_) t.join();
+      workers_->clear();
+      lk.lock();
+      stop_ = false;
+    }
+    return target_;
+  }
+
+  int threads() {
+    std::lock_guard<std::mutex> lk(*m_);
+    return target_;
+  }
+
+  // Run fn(task, worker) for every task in [0, n_tasks); caller included
+  // as worker 0. Blocks until all tasks completed AND helpers are idle
+  // (no straggler may observe the next job's half-written state).
+  void run(int n_tasks, const std::function<void(int, int)> &fn) {
+    if (n_tasks <= 0) return;
+    std::lock_guard<std::mutex> rg(*run_m_);
+    {
+      std::unique_lock<std::mutex> lk(*m_);
+      while (int(workers_->size()) < target_ - 1) {
+        int widx = int(workers_->size()) + 1;
+        workers_->emplace_back([this, widx] { worker_main(widx); });
+      }
+      cv_done_->wait(lk, [&] { return active_ == 0; });  // flush stragglers
+      job_ = &fn;
+      n_tasks_ = n_tasks;
+      next_task_.store(0, std::memory_order_relaxed);
+      completed_.store(0, std::memory_order_relaxed);
+      gen_++;
+      cv_->notify_all();
+    }
+    work(0);
+    std::unique_lock<std::mutex> lk(*m_);
+    cv_done_->wait(lk, [&] {
+      return completed_.load(std::memory_order_acquire) >= n_tasks_ &&
+             active_ == 0;
+    });
+    job_ = nullptr;
+  }
+
+  int64_t tasks() const { return tasks_total_.load(); }
+  int64_t busy_ns() const { return busy_ns_total_.load(); }
+
+  void reset_after_fork() {
+    m_ = new std::mutex();
+    cv_ = new std::condition_variable();
+    cv_done_ = new std::condition_variable();
+    run_m_ = new std::mutex();
+    workers_ = new std::vector<std::thread>();  // old handles abandoned
+    active_ = 0;
+    stop_ = false;
+  }
+
+ private:
+  NativePool() {
+    reset_after_fork();  // initial allocation of the sync objects
+    pthread_atfork(nullptr, nullptr, [] { inst().reset_after_fork(); });
+  }
+
+  void worker_main(int widx) {
+    std::unique_lock<std::mutex> lk(*m_);
+    // seen = 0, NOT gen_: a worker spawned by run() first acquires the
+    // mutex after the spawning job's gen bump — reading gen_ here would
+    // make it sleep through that job (entering a finished job's state is
+    // safe: the exhausted task counter bounces it straight back to wait)
+    int64_t seen = 0;
+    for (;;) {
+      cv_->wait(lk, [&] { return stop_ || gen_ != seen; });
+      if (stop_) return;
+      seen = gen_;
+      active_++;
+      lk.unlock();
+      work(widx);
+      lk.lock();
+      if (--active_ == 0) cv_done_->notify_all();
+    }
+  }
+
+  void work(int widx) {
+    for (;;) {
+      int t = next_task_.fetch_add(1, std::memory_order_relaxed);
+      if (t >= n_tasks_) break;
+      int64_t t0 = now_ns();
+      (*job_)(t, widx);
+      busy_ns_total_.fetch_add(now_ns() - t0, std::memory_order_relaxed);
+      tasks_total_.fetch_add(1, std::memory_order_relaxed);
+      completed_.fetch_add(1, std::memory_order_release);
+    }
+  }
+
+  std::mutex *m_ = nullptr;
+  std::mutex *run_m_ = nullptr;
+  std::condition_variable *cv_ = nullptr;
+  std::condition_variable *cv_done_ = nullptr;
+  std::vector<std::thread> *workers_ = nullptr;
+  const std::function<void(int, int)> *job_ = nullptr;
+  int target_ = 1;
+  int n_tasks_ = 0;
+  int active_ = 0;
+  bool stop_ = false;
+  int64_t gen_ = 0;
+  std::atomic<int> next_task_{0};
+  std::atomic<int> completed_{0};
+  std::atomic<int64_t> tasks_total_{0};
+  std::atomic<int64_t> busy_ns_total_{0};
+};
+
+// Slices per job: a few per lane balances byte-size skew across chunks
+// without per-chunk dispatch overhead.
+static uint64_t slice_count(uint64_t n, int threads) {
+  uint64_t target = uint64_t(threads) * 4;
+  return n < target ? n : target;
+}
+
+}  // namespace
+
+// Disjoint per-worker output ranges make the parallel batch trivially
+// byte-identical to the serial loop. Below 64 buffers the pool wake-up
+// costs more than the hashing. (Braced extern "C" so the language
+// linkage matches the forward declaration in the first extern "C" block.)
+extern "C" {
+static bool sha256_batch_parallel(const uint8_t *data,
+                                  const uint64_t *offsets,
+                                  const uint64_t *lens, uint64_t n,
+                                  uint8_t *out) {
+  int threads = NativePool::inst().threads();
+  if (threads <= 1 || n < 64) return false;
+  uint64_t n_slices = slice_count(n, threads);
+  NativePool::inst().run(int(n_slices), [&](int t, int) {
+    uint64_t lo = n * uint64_t(t) / n_slices;
+    uint64_t hi = n * uint64_t(t + 1) / n_slices;
+    for (uint64_t i = lo; i < hi; i++)
+      am_sha256(data + offsets[i], lens[i], out + 32 * i);
+  });
+  return true;
+}
+}  // extern "C"
+
+namespace {
+
+// Per-slice parse timings of the LAST ingest call (exported to the span
+// ring / parse_chunk_s histogram by the Python wrapper).
+struct ParseStats {
+  int64_t wall_t0 = 0, wall_t1 = 0;
+  int64_t threads = 1;
+  struct Slice { int64_t t0, t1, first, count, worker; };
+  std::vector<Slice> slices;
+};
+static ParseStats g_parse_stats;
 
 struct Cursor {
   const uint8_t *buf;
@@ -1284,21 +1497,184 @@ static bool ingest_one_chunk(IngestCtx &ctx, const uint8_t *chunk,
                            with_seq, chunk + 4);
 }
 
+// Merge per-slice parse contexts into the global one, remapping every
+// slice-local interned id into the global tables. Interning each slice's
+// items IN SLICE ORDER reproduces exactly the first-occurrence order a
+// serial chunk-order parse would assign, so the merged arrays are
+// byte-identical to a single-threaded parse — same key/actor numbering,
+// same packed opIds, same hashes — no matter how many workers ran or
+// where the slice boundaries fell. Returns false when the merged actor
+// table overflows the kActorBits packing (the serial parse fails the
+// batch for the same population; both paths return -1).
+static bool merge_ingest_slices(IngestCtx &g, std::vector<IngestCtx> &slices,
+                                int with_meta, int with_seq) {
+  size_t rows = 0, preds = 0, deps = 0, msgs = 0, arena = 0;
+  for (auto &s : slices) {
+    rows += s.out_doc.size();
+    preds += s.out_pred.size();
+    deps += s.m_deps.size();
+    msgs += s.m_msg.size();
+    arena += s.val_arena.size();
+  }
+  ingest_reserve(g, rows, with_meta, with_seq);
+  g.out_pred.reserve(preds);
+  g.m_deps.reserve(deps);
+  g.m_msg.reserve(msgs);
+  g.val_arena.reserve(arena);
+  std::vector<int32_t> kmap, amap;
+  for (auto &s : slices) {
+    kmap.resize(s.keys.items.size());
+    for (size_t i = 0; i < kmap.size(); i++)
+      kmap[i] = g.keys.intern(s.keys.items[i]);
+    amap.resize(s.actors.items.size());
+    for (size_t i = 0; i < amap.size(); i++) {
+      amap[i] = g.actors.intern(s.actors.items[i]);
+      if (amap[i] >= (1 << kActorBits)) return false;
+    }
+    constexpr uint32_t kAMask = (1u << kActorBits) - 1;
+    auto remap = [&](int32_t v) -> int32_t {
+      return int32_t((uint32_t(v) & ~kAMask) |
+                     uint32_t(amap[uint32_t(v) & kAMask]));
+    };
+    g.out_doc.insert(g.out_doc.end(), s.out_doc.begin(), s.out_doc.end());
+    for (int32_t k : s.out_key) g.out_key.push_back(k < 0 ? k : kmap[k]);
+    for (int32_t p : s.out_packed) g.out_packed.push_back(remap(p));
+    g.out_val.insert(g.out_val.end(), s.out_val.begin(), s.out_val.end());
+    g.out_flags.insert(g.out_flags.end(), s.out_flags.begin(),
+                       s.out_flags.end());
+    if (with_meta) {
+      for (int32_t a : s.m_actor) g.m_actor.push_back(amap[a]);
+      g.m_seq.insert(g.m_seq.end(), s.m_seq.begin(), s.m_seq.end());
+      g.m_start_op.insert(g.m_start_op.end(), s.m_start_op.begin(),
+                          s.m_start_op.end());
+      g.m_time.insert(g.m_time.end(), s.m_time.begin(), s.m_time.end());
+      g.m_nops.insert(g.m_nops.end(), s.m_nops.begin(), s.m_nops.end());
+      g.m_hash.insert(g.m_hash.end(), s.m_hash.begin(), s.m_hash.end());
+      int64_t dep_base = int64_t(g.m_deps.size() / 32);
+      for (int64_t off : s.m_deps_off) g.m_deps_off.push_back(off + dep_base);
+      g.m_deps.insert(g.m_deps.end(), s.m_deps.begin(), s.m_deps.end());
+      int64_t msg_base = int64_t(g.m_msg.size());
+      for (int64_t off : s.m_msg_off) g.m_msg_off.push_back(off + msg_base);
+      g.m_msg.insert(g.m_msg.end(), s.m_msg.begin(), s.m_msg.end());
+      int64_t pred_base = int64_t(g.out_pred.size());
+      for (int64_t off : s.out_pred_off)
+        g.out_pred_off.push_back(off + pred_base);
+      for (int32_t p : s.out_pred) g.out_pred.push_back(remap(p));
+    }
+    if (with_seq) {
+      // 0 is the root/none sentinel in obj/ref — never an actor number
+      // (packed object/referent counters are >= 1 by parse validation)
+      for (int32_t v : s.out_obj) g.out_obj.push_back(v == 0 ? 0 : remap(v));
+      for (int32_t v : s.out_ref) g.out_ref.push_back(v == 0 ? 0 : remap(v));
+      g.out_vtype.insert(g.out_vtype.end(), s.out_vtype.begin(),
+                         s.out_vtype.end());
+      g.out_vlen.insert(g.out_vlen.end(), s.out_vlen.begin(),
+                        s.out_vlen.end());
+      g.val_arena.insert(g.val_arena.end(), s.val_arena.begin(),
+                         s.val_arena.end());
+    }
+  }
+  return true;
+}
+
+// Chunk-parallel parse: contiguous chunk slices (balanced by byte size)
+// parsed concurrently into per-slice contexts, then merged in slice order.
+static bool ingest_parallel(IngestCtx &g, const uint8_t *const *ptrs,
+                            const uint64_t *lens, const int32_t *doc_ids,
+                            uint64_t n, int with_meta, int with_seq,
+                            int threads) {
+  uint64_t n_slices = slice_count(n, threads);
+  std::vector<uint64_t> pre(n + 1, 0);
+  for (uint64_t i = 0; i < n; i++) pre[i + 1] = pre[i] + lens[i];
+  std::vector<uint64_t> bounds(n_slices + 1, 0);
+  bounds[n_slices] = n;
+  for (uint64_t s = 1; s < n_slices; s++) {
+    uint64_t want = pre[n] / n_slices * s;
+    uint64_t idx = uint64_t(
+        std::lower_bound(pre.begin(), pre.end(), want) - pre.begin());
+    uint64_t lo = bounds[s - 1] + 1, hi = n - (n_slices - s);
+    bounds[s] = idx < lo ? lo : (idx > hi ? hi : idx);
+  }
+  std::vector<IngestCtx> slices(n_slices);
+  std::vector<uint8_t> slice_ok(n_slices, 1);
+  std::vector<ParseStats::Slice> stats(n_slices);
+  std::atomic<bool> failed{false};
+  NativePool::inst().run(int(n_slices), [&](int t, int w) {
+    int64_t t0 = now_ns();
+    IngestCtx &ctx = slices[size_t(t)];
+    uint64_t lo = bounds[size_t(t)], hi = bounds[size_t(t) + 1];
+    ingest_reserve(ctx, hi - lo, with_meta, with_seq);
+    for (uint64_t i = lo; i < hi; i++) {
+      if (failed.load(std::memory_order_relaxed)) {
+        slice_ok[size_t(t)] = 0;   // sibling failed: the batch is dead
+        break;
+      }
+      if (!ingest_one_chunk(ctx, ptrs[i], lens[i],
+                            doc_ids ? doc_ids[i] : int32_t(i),
+                            with_meta, with_seq)) {
+        slice_ok[size_t(t)] = 0;
+        failed.store(true, std::memory_order_relaxed);
+        break;
+      }
+    }
+    stats[size_t(t)] = {t0, now_ns(), int64_t(lo), int64_t(hi - lo), w};
+  });
+  g_parse_stats.slices = stats;
+  for (uint8_t okf : slice_ok)
+    if (!okf) return false;
+  return merge_ingest_slices(g, slices, with_meta, with_seq);
+}
+
+// Shared entry: serial below 2 chunks or a 1-lane pool, chunk-parallel
+// otherwise. Either way the resulting context (and therefore every fetch)
+// is byte-identical; failure is all-or-nothing (-1) on both paths.
+static int64_t ingest_dispatch(const uint8_t *const *ptrs,
+                               const uint64_t *lens, const int32_t *doc_ids,
+                               uint64_t n_changes, int with_meta,
+                               int with_seq) {
+  delete g_ingest;
+  g_ingest = new IngestCtx();
+  g_parse_stats.slices.clear();
+  g_parse_stats.wall_t0 = now_ns();
+  int threads = NativePool::inst().threads();
+  bool ok;
+  if (threads <= 1 || n_changes < 2) {
+    g_parse_stats.threads = 1;
+    ingest_reserve(*g_ingest, n_changes, with_meta, with_seq);
+    ok = true;
+    int64_t t0 = now_ns();
+    for (uint64_t i = 0; i < n_changes; i++) {
+      if (!ingest_one_chunk(*g_ingest, ptrs[i], lens[i],
+                            doc_ids ? doc_ids[i] : int32_t(i),
+                            with_meta, with_seq)) {
+        ok = false;
+        break;
+      }
+    }
+    if (n_changes)
+      g_parse_stats.slices.push_back(
+          {t0, now_ns(), 0, int64_t(n_changes), 0});
+  } else {
+    g_parse_stats.threads = threads;
+    ok = ingest_parallel(*g_ingest, ptrs, lens, doc_ids, n_changes,
+                         with_meta, with_seq, threads);
+  }
+  g_parse_stats.wall_t1 = now_ns();
+  if (!ok) {
+    delete g_ingest;
+    g_ingest = nullptr;
+    return -1;
+  }
+  return int64_t(g_ingest->out_doc.size());
+}
+
 int64_t am_ingest_changes(const uint8_t *blob, const uint64_t *offsets,
                           const uint64_t *lens, const int32_t *doc_ids,
                           uint64_t n_changes, int with_meta, int with_seq) {
-  delete g_ingest;
-  g_ingest = new IngestCtx();
-  ingest_reserve(*g_ingest, n_changes, with_meta, with_seq);
-  for (uint64_t i = 0; i < n_changes; i++) {
-    if (!ingest_one_chunk(*g_ingest, blob + offsets[i], lens[i],
-                          doc_ids[i], with_meta, with_seq)) {
-      delete g_ingest;
-      g_ingest = nullptr;
-      return -1;
-    }
-  }
-  return int64_t(g_ingest->out_doc.size());
+  std::vector<const uint8_t *> ptrs(n_changes);
+  for (uint64_t i = 0; i < n_changes; i++) ptrs[i] = blob + offsets[i];
+  return ingest_dispatch(ptrs.data(), lens, doc_ids, n_changes, with_meta,
+                         with_seq);
 }
 
 #ifdef AM_HAVE_PYTHON
@@ -1306,32 +1682,75 @@ int64_t am_ingest_changes(const uint8_t *blob, const uint64_t *offsets,
 // (no join into a contiguous blob, no per-buffer length array — those
 // Python-side passes cost more than the parse itself at fleet scale).
 // Each buffer's doc id is its list index (the turbo path's shape).
-// MUST be called through ctypes.PyDLL so the GIL stays held. Returns
-// -2 for a non-list / non-bytes item (caller falls back to the blob
-// entry), -1 for malformed chunks, row count otherwise.
+// MUST be called through ctypes.PyDLL: the pointer/length gather needs
+// the GIL, after which the whole batch parse runs with the GIL RELEASED
+// (Py_BEGIN_ALLOW_THREADS) so pool workers — and the caller's other
+// Python threads — get real cores. The borrowed buffer pointers stay
+// valid because the caller holds the list (and its bytes) alive across
+// the call. Returns -2 for a non-list / non-bytes item (caller falls
+// back to the blob entry), -1 for malformed chunks, row count otherwise.
 int64_t am_ingest_changes_list(PyObject *buffers, int with_meta,
                                int with_seq) {
   if (!PyList_Check(buffers)) return -2;
   Py_ssize_t n = PyList_GET_SIZE(buffers);
-  delete g_ingest;
-  g_ingest = new IngestCtx();
-  ingest_reserve(*g_ingest, uint64_t(n), with_meta, with_seq);
+  std::vector<const uint8_t *> ptrs;
+  std::vector<uint64_t> lens;
+  ptrs.reserve(size_t(n));
+  lens.reserve(size_t(n));
   for (Py_ssize_t i = 0; i < n; i++) {
     PyObject *it = PyList_GET_ITEM(buffers, i);
-    if (!PyBytes_Check(it)) {
-      delete g_ingest; g_ingest = nullptr; return -2;
-    }
-    if (!ingest_one_chunk(
-            *g_ingest,
-            reinterpret_cast<const uint8_t *>(PyBytes_AS_STRING(it)),
-            uint64_t(PyBytes_GET_SIZE(it)), int32_t(i),
-            with_meta, with_seq)) {
-      delete g_ingest; g_ingest = nullptr; return -1;
-    }
+    if (!PyBytes_Check(it)) return -2;
+    ptrs.push_back(reinterpret_cast<const uint8_t *>(PyBytes_AS_STRING(it)));
+    lens.push_back(uint64_t(PyBytes_GET_SIZE(it)));
   }
-  return int64_t(g_ingest->out_doc.size());
+  int64_t rc;
+  Py_BEGIN_ALLOW_THREADS
+  rc = ingest_dispatch(ptrs.data(), lens.data(), nullptr, uint64_t(n),
+                       with_meta, with_seq);
+  Py_END_ALLOW_THREADS
+  return rc;
 }
 #endif  // AM_HAVE_PYTHON
+
+// ---- pool / parse instrumentation exports ---------------------------------
+
+// Monotone ABI stamp, bumped on any C-surface change. The Python wrapper
+// refuses to run against a binary whose stamp mismatches (a stale .so
+// would otherwise silently run the old single-threaded codec).
+int64_t am_abi_version() { return 1; }
+
+int64_t am_pool_configure(int n) { return NativePool::inst().configure(n); }
+
+int64_t am_pool_threads() { return NativePool::inst().threads(); }
+
+int64_t am_pool_stats(int64_t *threads, int64_t *tasks, int64_t *busy_ns) {
+  *threads = NativePool::inst().threads();
+  *tasks = NativePool::inst().tasks();
+  *busy_ns = NativePool::inst().busy_ns();
+  return 0;
+}
+
+// Per-slice timings of the LAST am_ingest_changes[_list] call, in
+// CLOCK_MONOTONIC ns (same epoch as time.perf_counter_ns on Linux).
+// rows receives up to cap records of 5 int64s: t0, t1, first_chunk,
+// n_chunks, worker. Returns rows written.
+int64_t am_ingest_parse_stats(int64_t *wall_t0, int64_t *wall_t1,
+                              int64_t *threads, int64_t *rows, int64_t cap) {
+  *wall_t0 = g_parse_stats.wall_t0;
+  *wall_t1 = g_parse_stats.wall_t1;
+  *threads = g_parse_stats.threads;
+  int64_t n = int64_t(g_parse_stats.slices.size());
+  if (n > cap) n = cap;
+  for (int64_t i = 0; i < n; i++) {
+    const ParseStats::Slice &s = g_parse_stats.slices[size_t(i)];
+    rows[5 * i] = s.t0;
+    rows[5 * i + 1] = s.t1;
+    rows[5 * i + 2] = s.first;
+    rows[5 * i + 3] = s.count;
+    rows[5 * i + 4] = s.worker;
+  }
+  return n;
+}
 
 // Copy results out after am_ingest_changes. key_blob receives the interned
 // keys as length-prefixed (uleb) strings; returns bytes written or -1 if cap

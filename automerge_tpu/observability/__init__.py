@@ -48,14 +48,14 @@ from .recorder import (configure as configure_flight_recorder, clear_events,
                        dump_flight_record, flight_stats, last_flight_record,
                        recent_events, record_event)
 from .spans import (clear as clear_spans, export_chrome_trace, iter_spans,
-                    span, span_count, span_seq, spanned)
+                    record_span, span, span_count, span_seq, spanned)
 
 __all__ = [
     'Metrics', 'timed', 'trace',
     'register_dispatch_source', 'dispatch_counts',
     'register_health_source', 'health_counts',
     'span', 'span_seq', 'spanned', 'iter_spans', 'clear_spans',
-    'span_count', 'export_chrome_trace',
+    'span_count', 'export_chrome_trace', 'record_span',
     'Histogram', 'histogram', 'record_value', 'histogram_snapshot',
     'histogram_delta',
     'record_event', 'recent_events', 'clear_events', 'dump_flight_record',

@@ -33,7 +33,8 @@ import threading
 import time
 
 __all__ = ['enable', 'disable', 'on', 'span', 'span_seq', 'spanned',
-           'clear', 'iter_spans', 'export_chrome_trace', 'Span']
+           'clear', 'iter_spans', 'export_chrome_trace', 'Span',
+           'record_span']
 
 _on = False                 # the master switch; module-global for one-load checks
 _ring = []                  # preallocated record slots (None until written)
@@ -76,15 +77,28 @@ def clear():
         _total = 0
 
 
-def _record(name, t0, t1, attrs, error):
+def _record(name, t0, t1, attrs, error, tid=None):
     global _idx, _total
-    rec = (name, t0, t1, threading.get_ident(), attrs, error)
+    rec = (name, t0, t1,
+           threading.get_ident() if tid is None else tid, attrs, error)
     with _lock:
         if not _cap:
             return
         _ring[_idx] = rec
         _idx = (_idx + 1) % _cap
         _total += 1
+
+
+def record_span(name, t0_ns, t1_ns, tid=None, **attrs):
+    """Inject an externally-timed span into the ring. For phases measured
+    outside Python — the native codec's pool workers time their parse
+    slices against CLOCK_MONOTONIC, the same epoch ``perf_counter_ns``
+    reads on Linux, so injected slices line up with host-phase spans in
+    one Perfetto timeline. ``tid`` (default: calling thread) lets each
+    worker render as its own track."""
+    if not _on:
+        return
+    _record(name, t0_ns, t1_ns, attrs or None, None, tid=tid)
 
 
 class Span:

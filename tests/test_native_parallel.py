@@ -1,0 +1,350 @@
+"""Multi-core native codec: the determinism, pipelining, and thread-safety
+contract (BASELINE.md "Multi-core contract").
+
+Parallel parse output must be byte-identical to
+``AUTOMERGE_TPU_NATIVE_THREADS=1`` at EVERY pool width — same column
+arrays, hashes, interned key/actor table order, pred/value arenas, and
+the same all-or-nothing verdicts over hostile bytes (fuzz-corpus mutants
+replayed through the threaded path). The pipelined turbo driver must
+commit state byte-identical to the plain call, with the span rig showing
+the prefetched parse genuinely overlapping the previous sub-batch."""
+
+import os
+import random
+import sys
+
+import numpy as np
+import pytest
+
+from automerge_tpu import native, observability
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', 'tools'))
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason='native toolchain unavailable')
+
+POOL_WIDTHS = (1, 2, 4, 8)
+
+
+@pytest.fixture(autouse=True)
+def _restore_threads():
+    prev = native.native_threads()
+    yield
+    native.set_native_threads(prev)
+
+
+def _chain(n_changes, n_keys=40, seed=0):
+    """A linear change chain (two alternating actors) of flat int sets."""
+    from automerge_tpu.columnar import decode_change_meta, encode_change
+    rng = random.Random(seed)
+    actors = ['aa' * 16, 'bb' * 16]
+    changes, heads, seqs = [], [], [0, 0]
+    for c in range(n_changes):
+        a = c % 2
+        seqs[a] += 1
+        buf = encode_change({
+            'actor': actors[a], 'seq': seqs[a], 'startOp': c + 1,
+            'time': 0, 'message': f'm{c}' if c % 5 == 0 else '',
+            'deps': heads,
+            'ops': [{'action': 'set', 'obj': '_root',
+                     'key': f'k{rng.randrange(n_keys)}',
+                     'value': rng.randrange(1, 1 << 20),
+                     'datatype': 'int', 'pred': []}]})
+        heads = [decode_change_meta(buf, True)['hash']]
+        changes.append(buf)
+    return changes
+
+
+def _rich_changes():
+    """Changes exercising the full with_seq surface: text/list/nested
+    maps, strings, floats, bools, counters — boxed values, seq ops,
+    makes, preds, multi-actor merges."""
+    import automerge_tpu as A
+    d = A.init('aa' * 16)
+    d = A.change(d, {'time': 0}, lambda r: r.update(
+        {'text': A.Text('parallel parse'), 'list': [1, 2, 3],
+         'nested': {'k': 'v', 'n': 7}, 'count': A.Counter(3)}))
+    d = A.change(d, {'time': 0}, lambda r: r.update(
+        {'big': 'x' * 300, 'f': 2.5, 'b': True, 'neg': -12}))
+    e = A.merge(A.init('bb' * 16), d)
+    e = A.change(e, {'time': 0}, lambda r: r['list'].append(99))
+    d = A.merge(d, e)
+    d = A.change(d, {'time': 0}, lambda r: r['count'].increment(2))
+    return [bytes(c) for c in A.get_all_changes(d)]
+
+
+def _snapshot(out):
+    """Every array/list/blob of an ingest result, normalized to bytes."""
+    if out is None:
+        return None
+    rows, keys, actors = out[0], out[1], out[2]
+    snap = {k: (v.tobytes() if hasattr(v, 'tobytes') else bytes(v))
+            for k, v in rows.items()}
+    snap['_keys'] = tuple(keys)
+    snap['_actors'] = tuple(actors)
+    if len(out) > 3:
+        for k, v in out[3].items():
+            snap['meta_' + k] = v.tobytes() if hasattr(v, 'tobytes') else v
+    return snap
+
+
+def _assert_same_snapshot(a, b, label):
+    if a is None or b is None:
+        assert a is None and b is None, f'{label}: verdict differs'
+        return
+    assert a.keys() == b.keys(), f'{label}: column sets differ'
+    for k in a:
+        assert a[k] == b[k], f'{label}: column {k!r} differs'
+
+
+class TestParallelDeterminism:
+    def test_flat_chain_byte_identical_at_every_width(self):
+        bufs = _chain(400) * 25          # 10k buffers, doc i = buffer i
+        native.set_native_threads(1)
+        ref = _snapshot(native.ingest_changes(
+            bufs, None, with_meta=True, with_seq=True))
+        assert ref is not None
+        for width in POOL_WIDTHS[1:]:
+            native.set_native_threads(width)
+            got = _snapshot(native.ingest_changes(
+                bufs, None, with_meta=True, with_seq=True))
+            _assert_same_snapshot(ref, got, f'width {width}')
+
+    def test_rich_ops_byte_identical_at_every_width(self):
+        # boxed values / seq ops / preds / multi-actor tables stress the
+        # merge's id remapping (keys, actors, packed opIds, pred arenas)
+        bufs = _rich_changes() * 200
+        native.set_native_threads(1)
+        ref = _snapshot(native.ingest_changes(
+            bufs, None, with_meta=True, with_seq=True))
+        assert ref is not None
+        for width in POOL_WIDTHS[1:]:
+            native.set_native_threads(width)
+            got = _snapshot(native.ingest_changes(
+                bufs, None, with_meta=True, with_seq=True))
+            _assert_same_snapshot(ref, got, f'width {width}')
+
+    def test_blob_entry_matches_list_entry(self):
+        # the CDLL blob path (explicit doc_ids) and the zero-copy PyDLL
+        # list path must agree at every width
+        bufs = _chain(64) * 4
+        native.set_native_threads(4)
+        via_list = _snapshot(native.ingest_changes(
+            bufs, None, with_meta=True, with_seq=True))
+        via_blob = _snapshot(native.ingest_changes(
+            bufs, list(range(len(bufs))), with_meta=True, with_seq=True))
+        _assert_same_snapshot(via_list, via_blob, 'list vs blob')
+
+    def test_fuzz_corpus_hostile_bytes_same_verdict(self):
+        """Mutants of real wire changes replayed through the threaded
+        path: the (all-or-nothing) parse verdict AND, when accepted, the
+        full output must match the single-threaded parse — a worker
+        thread failing a poisoned chunk while siblings succeed must not
+        change what the caller observes."""
+        from fuzz_wire import build_corpus, mutate
+        corpus = build_corpus()
+        good = corpus['change']
+        rng = random.Random(1234)
+        # case 0 is unmutated (verdict: accepted) so the sweep provably
+        # exercises both verdicts even when every mutant breaks the parse
+        cases = [[bytes(b) for b in good] * 2]
+        for _ in range(60):
+            base = good[rng.randrange(len(good))]
+            cases.append([bytes(b) for b in good] +
+                         [mutate(rng, base)] +
+                         [bytes(b) for b in good])
+        verdicts = []
+        for ci, bufs in enumerate(cases):
+            native.set_native_threads(1)
+            ref = _snapshot(native.ingest_changes(
+                bufs, None, with_meta=True, with_seq=True))
+            verdicts.append(ref is not None)
+            for width in (4, 8):
+                native.set_native_threads(width)
+                got = _snapshot(native.ingest_changes(
+                    bufs, None, with_meta=True, with_seq=True))
+                _assert_same_snapshot(ref, got, f'case {ci} width {width}')
+        # the corpus must exercise BOTH verdicts or the test proves nothing
+        assert any(verdicts) and not all(verdicts)
+
+    def test_sha256_batch_parallel_identical(self):
+        import hashlib
+        bufs = [os.urandom(i % 513 + 1) for i in range(500)]
+        expect = [hashlib.sha256(b).digest() for b in bufs]
+        for width in POOL_WIDTHS:
+            native.set_native_threads(width)
+            assert native.sha256_batch(bufs) == expect, f'width {width}'
+
+
+class TestPoolPlumbing:
+    def test_abi_stamp_matches(self):
+        assert native._abi_of(native._load()) == native._ABI_VERSION
+
+    def test_set_native_threads_roundtrip(self):
+        prev = native.set_native_threads(3)
+        assert native.native_threads() == 3
+        native.set_native_threads(prev)
+
+    def test_pool_tasks_counter_moves(self):
+        native.set_native_threads(4)
+        before = native.pool_stats()['tasks']
+        native.ingest_changes(_chain(200), None, with_meta=True,
+                              with_seq=True)
+        stats = native.pool_stats()
+        assert stats['tasks'] > before
+        assert stats['busy_s'] > 0.0
+        assert observability.health_counts()['native_pool_tasks'] == \
+            stats['tasks']
+
+    def test_parse_chunk_spans_and_histograms(self):
+        """Per-slice parse spans + parse_chunk_s / parse_pool_occupancy
+        histograms land when observability is on (the obs_report pool
+        view's feed)."""
+        native.set_native_threads(4)
+        observability.enable()
+        try:
+            observability.clear_spans()
+            native.ingest_changes(_chain(300), None, with_meta=True,
+                                  with_seq=True)
+            spans = observability.iter_spans()
+            chunk = [s for s in spans if s['name'] == 'parse_chunk']
+            assert chunk, 'no parse_chunk spans recorded'
+            assert all(s['attrs']['chunks'] > 0 for s in chunk)
+            parent = [s for s in spans if s['name'] == 'native_parse']
+            assert parent and parent[-1]['attrs']['threads'] == 4
+            # slices tile inside the parent parse interval
+            lo = min(s['t0_ns'] for s in chunk)
+            hi = max(s['t1_ns'] for s in chunk)
+            assert lo >= parent[-1]['t0_ns'] - 1_000_000
+            assert hi <= parent[-1]['t1_ns'] + 1_000_000
+            hists = observability.histogram_snapshot()
+            assert hists['parse_chunk_s']['count'] >= len(chunk)
+            assert hists['parse_pool_occupancy']['count'] >= 1
+        finally:
+            observability.disable()
+
+
+class TestPipelinedApply:
+    def _workload(self, n_docs, n_changes):
+        chain = _chain(n_changes, n_keys=16, seed=5)
+        return [list(chain) for _ in range(n_docs)]
+
+    def test_pipelined_commits_byte_identical_state(self):
+        from automerge_tpu.fleet.backend import (
+            DocFleet, apply_changes_docs, apply_changes_docs_pipelined,
+            init_docs, materialize_docs, save)
+        per_doc = self._workload(60, 9)
+        plain = DocFleet()
+        ph = init_docs(60, plain)
+        ph, _ = apply_changes_docs(ph, per_doc, mirror=False)
+        for subs in (2, 3, 4):
+            fleet = DocFleet()
+            handles = init_docs(60, fleet)
+            handles, _ = apply_changes_docs_pipelined(
+                handles, per_doc, sub_batches=subs)
+            assert materialize_docs(handles) == materialize_docs(ph)
+            for i in (0, 31, 59):
+                assert bytes(save(handles[i])) == bytes(save(ph[i])), \
+                    f'doc {i} save bytes differ at sub_batches={subs}'
+
+    def test_pipelined_single_dispatch_per_sub_batch(self):
+        from automerge_tpu.fleet.backend import (
+            DocFleet, apply_changes_docs_pipelined, init_docs)
+        fleet = DocFleet()
+        handles = init_docs(40, fleet)
+        # warm the dispatch shape so the counted run is steady-state
+        apply_changes_docs_pipelined(handles, self._workload(40, 4),
+                                     sub_batches=2)
+        fleet2 = DocFleet()
+        handles2 = init_docs(40, fleet2)
+        d0 = fleet2.metrics.dispatches
+        apply_changes_docs_pipelined(handles2, self._workload(40, 4),
+                                     sub_batches=2)
+        assert fleet2.metrics.dispatches - d0 == 2   # one per sub-batch
+
+    def test_pipelined_producer_failure_propagates(self, monkeypatch):
+        """A producer-thread parse failure must raise in the caller, not
+        hang the consumer's queue.get() forever."""
+        from automerge_tpu.fleet import backend as fleet_backend
+        from automerge_tpu.fleet.backend import (
+            DocFleet, apply_changes_docs_pipelined, init_docs)
+
+        def boom(*a, **k):
+            raise RuntimeError('producer parse died')
+
+        monkeypatch.setattr(fleet_backend.native, 'ingest_changes', boom)
+        fleet = DocFleet()
+        handles = init_docs(8, fleet)
+        with pytest.raises(RuntimeError, match='producer parse died'):
+            apply_changes_docs_pipelined(handles, self._workload(8, 4),
+                                         sub_batches=2)
+
+    def test_pipelined_parse_overlaps_previous_sub_batch(self):
+        """The span rig must show the producer thread's parse running
+        concurrently with the previous sub-batch's apply phases — the
+        overlap the Perfetto trace renders as parallel tracks. Retries a
+        few times before failing: genuine overlap is a scheduling fact,
+        not a logical invariant, and a loaded CI box can starve one
+        attempt."""
+        from automerge_tpu.fleet.backend import (
+            DocFleet, apply_changes_docs_pipelined, init_docs)
+        per_doc = self._workload(800, 8)
+        main_tid = None
+        for attempt in range(3):
+            fleet = DocFleet()
+            handles = init_docs(800, fleet)
+            observability.enable()
+            observability.clear_spans()
+            try:
+                apply_changes_docs_pipelined(handles, per_doc,
+                                             sub_batches=2)
+                spans = observability.iter_spans()
+            finally:
+                observability.disable()
+            applies = [s for s in spans if s['name'] == 'apply_batch']
+            assert len(applies) == 2
+            main_tid = applies[0]['tid']
+            parses = [s for s in spans if s['name'] == 'native_parse'
+                      and s['tid'] != main_tid]
+            assert parses, 'parse never ran on the producer thread'
+            overlap = 0
+            for p in parses:
+                for a in applies:
+                    overlap += max(0, min(p['t1_ns'], a['t1_ns']) -
+                                   max(p['t0_ns'], a['t0_ns']))
+            # the main thread must never stall on a foreground parse
+            # (structural: every sub-batch consumes a prefetched result)
+            stalls = [s['dur_ns'] for s in spans
+                      if s['name'] == 'turbo_parse']
+            assert max(stalls) < 50_000_000, 'foreground parse stall'
+            if overlap > 0:
+                return
+        pytest.fail('no parse/apply overlap in 3 attempts')
+
+
+class TestMultiThreadedErrorPath:
+    def test_count_bomb_stays_typed_at_every_width(self):
+        """The -1/-2 malformed-vs-capacity split (PR 3's count-bomb fix)
+        must hold when the poisoned column fails on a worker thread: the
+        batch verdict is a clean refusal (None), never a crash or a
+        multi-GB allocation, at every pool width."""
+        def leb(v):
+            out = bytearray()
+            while True:
+                b = v & 0x7F
+                v >>= 7
+                if v:
+                    out.append(b | 0x80)
+                else:
+                    out.append(b)
+                    return bytes(out)
+
+        good = _chain(32)
+        # a boolean column declaring ~2^62 values inside an otherwise
+        # plausible chunk: the native parse must refuse it typed
+        bomb = good[3][:20] + leb((1 << 62) + 7) + good[3][20:]
+        bufs = good + [bomb] + good
+        for width in POOL_WIDTHS:
+            native.set_native_threads(width)
+            assert native.ingest_changes(bufs, None, with_meta=True,
+                                         with_seq=True) is None

@@ -124,6 +124,54 @@ def test_survivors_byte_identical_to_control_universe():
             bytes(fleet_backend.save(chandles[i])), f'doc {i} perturbed'
 
 
+def test_quarantine_verdicts_identical_across_pool_widths():
+    """Thread-safety of the native error path: a poisoned chunk failing
+    on a WORKER thread while sibling slices succeed must produce exactly
+    the single-threaded outcome — same quarantined docs, same typed
+    errors, same survivor states. Includes a count-bomb boolean column
+    (the PR 3 -1/-2 malformed-vs-capacity split) so the refusal path, not
+    just the checksum path, crosses threads."""
+    def leb(v):
+        out = bytearray()
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                return bytes(out)
+
+    n = 8
+    def workload():
+        per_doc = _poisoned_workload(n)
+        bomb_src = per_doc[6][0]
+        per_doc[6] = [bomb_src[:20] + leb((1 << 62) + 3) + bomb_src[20:]]
+        return per_doc
+
+    def run(width):
+        prev = native.set_native_threads(width)
+        try:
+            fleet = DocFleet(doc_capacity=8, key_capacity=16)
+            handles = init_docs(n, fleet)
+            new_handles, _, errors = fleet_backend.apply_changes_docs(
+                handles, workload(), mirror=False, on_error='quarantine')
+            mats = materialize_docs(new_handles)
+            kinds = [type(e.error).__name__ if e else None for e in errors]
+            stages = [e.stage if e else None for e in errors]
+            return kinds, stages, mats
+        finally:
+            native.set_native_threads(prev)
+
+    ref = run(1)
+    assert ref[0][2] == 'MalformedChange'
+    assert ref[0][4] == 'DanglingPred'
+    assert ref[0][6] == 'MalformedChange'      # count bomb: typed refusal
+    for width in (2, 4, 8):
+        got = run(width)
+        assert got == ref, f'quarantine outcome diverged at width {width}'
+
+
 def test_duplicate_opid_is_typed_and_scoped():
     fleet = DocFleet(doc_capacity=4, key_capacity=16)
     handles = init_docs(2, fleet)

@@ -1,0 +1,45 @@
+#!/bin/sh
+# Build the native codec (automerge_tpu/native/codec.cpp) into the cached
+# shared object the ctypes wrapper loads. The wrapper normally compiles on
+# demand (automerge_tpu/native/__init__.py:_build) — this script is the
+# same recipe for CI images, cross-builds, and for recovering from a
+# stale-.so NativeAbiMismatch failure at import.
+#
+# Flags that matter:
+#   -pthread   the codec runs a persistent worker pool (NativePool); a
+#              build without it deadlocks or crashes on first parallel
+#              parse instead of failing cleanly
+#   -I<python> optional: CPython headers enable the zero-copy list entry
+#              (am_ingest_changes_list); the codec builds without them
+#
+# The binary carries an ABI stamp (am_abi_version, checked against
+# native.__init__._ABI_VERSION at import): a stale .so fails LOUDLY
+# instead of silently running an old single-threaded codec. After editing
+# codec.cpp's C surface, bump BOTH stamps.
+set -eu
+
+here="$(cd "$(dirname "$0")/.." && pwd)"
+src="$here/automerge_tpu/native/codec.cpp"
+python_bin="${PYTHON:-python3}"
+
+cache_tag="$("$python_bin" -c 'import sys; print(sys.implementation.cache_tag)')"
+out="$here/automerge_tpu/native/_codec_${cache_tag}.so"
+
+inc="$("$python_bin" -c 'import sysconfig; print(sysconfig.get_paths().get("include") or "")')"
+inc_flag=""
+if [ -n "$inc" ] && [ -e "$inc/Python.h" ]; then
+    inc_flag="-I$inc"
+fi
+
+# shellcheck disable=SC2086  # inc_flag is intentionally word-split
+g++ -O3 -shared -fPIC -std=c++17 -pthread $inc_flag "$src" -lz -o "$out"
+
+"$python_bin" - <<EOF
+import sys
+sys.path.insert(0, "$here")
+from automerge_tpu import native
+assert native.available(), 'built but failed to load'
+assert native._abi_of(native._load()) == native._ABI_VERSION, 'ABI stamp skew'
+print('built', "$out", 'ABI', native._ABI_VERSION,
+      'threads', native.native_threads())
+EOF

@@ -34,10 +34,30 @@ def load_events(path):
     return [e for e in data if e.get('ph') == 'X']
 
 
+def _union(intervals):
+    """Total µs covered by the union of (lo, hi) intervals."""
+    total = 0.0
+    cur_lo = cur_hi = None
+    for lo, hi in sorted(intervals):
+        if cur_hi is None or lo > cur_hi:
+            if cur_hi is not None:
+                total += cur_hi - cur_lo
+            cur_lo, cur_hi = lo, hi
+        else:
+            cur_hi = max(cur_hi, hi)
+    if cur_hi is not None:
+        total += cur_hi - cur_lo
+    return total
+
+
 def attribution(events):
-    """Per-name rollup: count, total/mean/max duration (µs), wall share.
-    Returns (rows sorted by total desc, wall_us)."""
+    """Per-name rollup: count, cpu (summed durations), wall (union of the
+    name's intervals — with the multi-core parse, spans of one name run
+    CONCURRENTLY on pool workers, so cpu > wall measures parallelism),
+    mean/max duration (µs), wall share. Returns (rows sorted by cpu desc,
+    wall_us)."""
     stats = {}
+    ivs = {}
     lo, hi = None, None
     for e in events:
         name = e.get('name', '?')
@@ -48,11 +68,14 @@ def attribution(events):
         ent[1] += dur
         if dur > ent[2]:
             ent[2] = dur
+        ivs.setdefault(name, []).append((ts, ts + dur))
         lo = ts if lo is None else min(lo, ts)
         hi = ts + dur if hi is None else max(hi, ts + dur)
     wall = (hi - lo) if events else 0.0
-    rows = [(name, n, tot, tot / n, mx,
-             (100.0 * tot / wall) if wall else 0.0)
+    # % wall from the UNION, not the cpu sum: concurrent same-name spans
+    # (pool workers) would otherwise print shares past 100%
+    rows = [(name, n, tot, _union(ivs[name]), tot / n, mx,
+             (100.0 * _union(ivs[name]) / wall) if wall else 0.0)
             for name, (n, tot, mx) in stats.items()]
     rows.sort(key=lambda r: -r[2])
     return rows, wall
@@ -63,12 +86,32 @@ def render_trace(path, out=sys.stdout):
     rows, wall = attribution(events)
     print(f'# {path}: {len(events)} spans, wall {wall / 1000.0:.2f} ms',
           file=out)
-    print(f'{"phase":<24}{"calls":>7}{"total ms":>11}{"mean ms":>10}'
-          f'{"max ms":>10}{"% wall":>8}', file=out)
-    for name, n, tot, mean, mx, pct in rows:
-        print(f'{name:<24}{n:>7}{tot / 1000.0:>11.3f}'
+    print(f'{"phase":<24}{"calls":>7}{"cpu ms":>10}{"wall ms":>10}'
+          f'{"par":>6}{"mean ms":>10}{"max ms":>10}{"% wall":>8}', file=out)
+    for name, n, tot, wall_n, mean, mx, pct in rows:
+        par = tot / wall_n if wall_n else 1.0
+        print(f'{name:<24}{n:>7}{tot / 1000.0:>10.3f}'
+              f'{wall_n / 1000.0:>10.3f}{par:>6.2f}'
               f'{mean / 1000.0:>10.3f}{mx / 1000.0:>10.3f}{pct:>8.1f}',
               file=out)
+    # Pool view: per-slice parse spans carry worker/chunk attrs; cpu/wall
+    # over them is the measured pool parallelism, and occupancy relates
+    # that to the configured lane count when the spans recorded it.
+    chunk = [e for e in events if e.get('name') == 'parse_chunk']
+    if chunk:
+        cpu = sum(float(e.get('dur', 0.0)) for e in chunk)
+        wall_c = _union([(float(e['ts']), float(e['ts']) + float(e['dur']))
+                         for e in chunk])
+        workers = {(e.get('args') or {}).get('worker') for e in chunk}
+        lanes = [e for e in events if e.get('name') == 'native_parse']
+        threads = max(((e.get('args') or {}).get('threads') or 0)
+                      for e in lanes) if lanes else len(workers)
+        occ = (100.0 * cpu / (wall_c * threads)) if wall_c and threads \
+            else 0.0
+        print(f'# parse pool: {len(chunk)} slices over {len(workers)} '
+              f'workers, cpu {cpu / 1000.0:.3f} ms / wall '
+              f'{wall_c / 1000.0:.3f} ms = {cpu / wall_c if wall_c else 1:.2f}x '
+              f'parallel, occupancy {occ:.0f}% of {threads} lanes', file=out)
     return rows
 
 
