@@ -29,6 +29,16 @@ batch-fatal. This module is that contract:
   (fleet/durability.py). They are ``WireCorruption`` too: disk is just a
   wire with a longer flight time, and recovery gives rotted disk bytes
   the same one-doc blast radius the sync wire gets.
+- Load-shedding rejections (``Overloaded``, ``TenantThrottled``,
+  ``DeadlineExceeded``, ``RetriesExhausted``, ``SyncStalled``) mean the
+  INPUT was fine but the system declined the work: global or per-tenant
+  admission control refused it, its deadline passed before the fused
+  dispatch, or its retry/reconnect budget ran dry (service/ and
+  fleet/faults.py). They join the taxonomy so shedding is never an
+  untyped escape — a client can always distinguish "your bytes are bad"
+  from "come back later" (``retry_after``) from "too late". A shed
+  request is all-or-nothing: these errors are only ever raised BEFORE
+  the request's batch commits, never after a partial apply.
 
 Every class subclasses ``ValueError`` (the reference's error type), so
 existing ``except ValueError`` / ``pytest.raises(ValueError)`` call sites
@@ -46,6 +56,8 @@ __all__ = [
     'MalformedDocument', 'MalformedSyncMessage', 'MalformedJournal',
     'TornTail', 'MalformedSnapshot', 'InvalidChange',
     'DanglingPred', 'DuplicateOpId', 'SyncOverflow', 'DocError',
+    'Overloaded', 'TenantThrottled', 'DeadlineExceeded',
+    'RetriesExhausted', 'SyncStalled',
     'as_wire_error',
 ]
 
@@ -116,6 +128,40 @@ class SyncOverflow(AutomergeError, ValueError):
     per-sub-round wire width), `max_chunks` (how many sub-rounds the wire
     will chunk across), and `pairs` (locally-observed offending
     (src, dst) shard pairs — each controller sees only its own)."""
+
+
+class Overloaded(AutomergeError, ValueError):
+    """The service's global admission ceiling (queued + in-flight work)
+    is full, or a brownout stage shed this request class. Carries
+    `retry_after` (seconds the client should wait, None = unknown) and,
+    for brownout sheds, `shed=True` + `stage`."""
+
+
+class TenantThrottled(Overloaded):
+    """THIS tenant exhausted its token bucket or bounded queue — other
+    tenants are unaffected (per-tenant isolation is the point). Carries
+    `tenant` and `retry_after`."""
+
+
+class DeadlineExceeded(AutomergeError, ValueError):
+    """The request's deadline passed before its batch's fused dispatch.
+    All-or-nothing: raised only while the request is still entirely
+    unapplied — a deadline NEVER fires after a partial commit. Carries
+    `deadline` (the absolute clock value) and `late_by` (seconds)."""
+
+
+class RetriesExhausted(AutomergeError, ValueError):
+    """A transient fault persisted past the bounded jittered-backoff
+    schedule or the per-tenant retry budget — retrying further would
+    amplify the outage. Carries `attempts` and (when tenant-scoped)
+    `tenant`; `__cause__` is the last underlying typed failure."""
+
+
+class SyncStalled(RetriesExhausted):
+    """The two-peer sync handshake kept traffic flowing but made no head
+    progress through the whole reconnect-with-backoff schedule
+    (fleet/faults.py sync_until_quiet) — a protocol bug or a dead wire,
+    not bad luck. Carries `rounds` and `resets`."""
 
 
 class DocError:

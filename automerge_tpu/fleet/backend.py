@@ -2845,13 +2845,20 @@ def _journal_of(handles):
 
 
 def apply_changes_docs(handles, per_doc_changes, mirror=True,
-                       on_error='raise', _parsed=None):
+                       on_error='raise', deadline=None, _parsed=None):
     """Apply per-document change lists across the fleet. Returns
     (see _apply_changes_docs_impl for the full contract). When
     observability is enabled the whole batch records an `apply_batch`
-    span and an `apply_batch_s` latency histogram sample. `_parsed` is
-    the pipelined driver's pre-parsed native ingest result (private —
-    see apply_changes_docs_pipelined)."""
+    span and an `apply_batch_s` latency histogram sample. `deadline` (a
+    service.deadline.Deadline) is checked HERE, before any parse or
+    mutation: an expired deadline raises typed DeadlineExceeded with the
+    batch entirely unapplied — the all-or-nothing half of the service's
+    deadline contract (work that expires DURING the batch still commits;
+    late useful work beats a torn doc). `_parsed` is the pipelined
+    driver's pre-parsed native ingest result (private — see
+    apply_changes_docs_pipelined)."""
+    if deadline is not None:
+        deadline.check(what='apply_changes_docs')
     start = time.perf_counter()
     with _span('apply_batch', docs=len(handles), mirror=mirror,
                on_error=on_error):
@@ -3059,6 +3066,19 @@ def _screen_malformed_docs(work):
                 for chunk in split_containers(bytes(buf)):
                     if chunk[8] in (CHUNK_TYPE_CHANGE, CHUNK_TYPE_DEFLATE):
                         decode_change_meta(chunk, True)
+                    elif hashlib.sha256(bytes(chunk[8:])).digest()[:4] != \
+                            bytes(chunk[4:8]):
+                        # an unknown container TYPE is legal to skip
+                        # (forward compatibility) — but only when its
+                        # checksum validates; a well-framed chunk whose
+                        # checksum fails is corruption wearing an
+                        # unknown-type byte (e.g. a bit flip IN the type
+                        # byte) and must quarantine typed, not slide
+                        # through as "nothing to apply" (found by the
+                        # ISSUE-7 chaos client)
+                        raise MalformedChange(
+                            'container checksum mismatch on unknown '
+                            f'chunk type {chunk[8]}', doc_index=d)
         except Exception as exc:
             bad.append((d, as_wire_error(exc, MalformedChange,
                                          'change screen', doc_index=d)))

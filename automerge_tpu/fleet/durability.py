@@ -56,6 +56,7 @@ import json
 import os
 import struct
 import time
+import weakref
 import zlib
 
 import numpy as np
@@ -74,6 +75,7 @@ __all__ = [
     'KIND_INIT',
     'encode_frame', 'parse_journal_bytes', 'parse_snapshot_bytes',
     'parse_manifest_bytes', 'read_state', 'durability_stats',
+    'pending_fsync_bytes_total', 'set_fsync_alert_threshold',
 ]
 
 # ---------------------------------------------------------------------------
@@ -438,9 +440,45 @@ _stats = {
     'journal_truncations': 0,    # torn tails truncated at recovery
     'rotted_records': 0,         # mid-stream CRC failures contained
     'recovered_docs': 0,         # documents recovered from disk
+    'fsync_window_alerts': 0,    # loss-window threshold crossings
 }
 for _key in _stats:
     register_health_source(_key, lambda k=_key: _stats[k])
+
+# The durability LOSS WINDOW as a first-class health signal: the sum of
+# written-but-not-fsynced bytes across every open journal. The brownout
+# ladder WIDENS this window deliberately (stage 1 raises fsync_bytes);
+# registering it here is what lets operators — and the overload tests —
+# watch the window move instead of trusting the policy. Crossing the
+# alert threshold is edge-triggered per journal into the
+# 'fsync_window_alerts' counter + a flight-recorder event.
+
+_open_journals = weakref.WeakSet()
+# The alert only fires while pending < fsync_bytes (a commit at or past
+# fsync_bytes fsyncs instead, closing the window), so the threshold must
+# sit BELOW the widest fsync batching in use or it is unreachable: 1 MB
+# default, under the brownout stage-1 widen ceiling (4 MB).
+_fsync_alert_bytes = int(os.environ.get(
+    'AUTOMERGE_TPU_FSYNC_ALERT_BYTES', 1 << 20))
+
+
+def set_fsync_alert_threshold(n_bytes):
+    """Configure the loss-window alert threshold (bytes; <= 0 disables).
+    Returns the previous value."""
+    global _fsync_alert_bytes
+    prev = _fsync_alert_bytes
+    _fsync_alert_bytes = int(n_bytes)
+    return prev
+
+
+def pending_fsync_bytes_total():
+    """Sum of every open journal's pending_fsync_bytes — the bytes a
+    crash right now would lose (on top of unwritten buffers)."""
+    return sum(j.pending_fsync_bytes for j in _open_journals
+               if not j.closed)
+
+
+register_health_source('pending_fsync_bytes', pending_fsync_bytes_total)
 
 
 def durability_stats():
@@ -504,6 +542,8 @@ class ChangeJournal:
         self.durable_bytes = size       # bytes known fsynced
         self.records = 0                # records appended this generation
         self.closed = False
+        self._window_alerted = False    # edge trigger for the loss alert
+        _open_journals.add(self)
 
     # -- doc identity ---------------------------------------------------
 
@@ -645,6 +685,8 @@ class ChangeJournal:
             if self.fsync_bytes <= 0 or \
                     self.pending_fsync_bytes >= self.fsync_bytes:
                 self._fsync()
+            else:
+                self._check_loss_window()
 
     def sync(self):
         """Force full durability: write + fsync regardless of policy."""
@@ -666,6 +708,23 @@ class ChangeJournal:
                            scale=1e9, unit='s')
         self.durable_bytes = self.written_bytes
         _stats['journal_fsyncs'] += 1
+        self._window_alerted = False    # window closed; re-arm the alert
+
+    def _check_loss_window(self):
+        """Edge-triggered loss-window alert: the first commit that
+        leaves pending_fsync_bytes above the configured threshold bumps
+        'fsync_window_alerts' and lands a flight event; the alert
+        re-arms when an fsync closes the window."""
+        if _fsync_alert_bytes <= 0 or self._window_alerted:
+            return
+        pending = self.pending_fsync_bytes
+        if pending >= _fsync_alert_bytes:
+            self._window_alerted = True
+            _stats['fsync_window_alerts'] += 1
+            _flight.record_event('fsync_window_alert', path=self.path,
+                                 pending_bytes=pending,
+                                 threshold=_fsync_alert_bytes,
+                                 fsync_bytes=self.fsync_bytes)
 
     def close(self):
         if not self.closed:

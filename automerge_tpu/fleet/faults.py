@@ -33,15 +33,20 @@ counter (observability.health_counts)."""
 
 import random
 
-from ..errors import AutomergeError
+from ..errors import AutomergeError, SyncStalled
 from ..observability import register_health_source
+# the light policy module only — service/__init__ loads its core lazily,
+# so this import cannot cycle back into fleet/
+from ..service.backoff import Backoff
 
 __all__ = ['LossyLink', 'sync_until_quiet']
 
 _FAULT_KINDS = ('dropped', 'duplicated', 'reordered', 'truncated', 'flipped')
 
-_fault_totals = {'injected': 0}
+_fault_totals = {'injected': 0, 'stall_resets': 0}
 register_health_source('wire_faults', lambda: _fault_totals['injected'])
+register_health_source('sync_stall_resets',
+                       lambda: _fault_totals['stall_resets'])
 
 
 class LossyLink:
@@ -148,7 +153,8 @@ def _deliver(receiver, payloads, quarantined):
 
 
 def sync_until_quiet(doc_a, doc_b, backend_a, backend_b, link_ab=None,
-                     link_ba=None, max_rounds=256, stall_reset=8):
+                     link_ba=None, max_rounds=256, stall_reset=8,
+                     backoff=None):
     """Drive the two-peer sync handshake (the sync_test.js loop) over lossy
     links until both directions go quiet, corruption quarantining as drops.
     `backend_*` follow the Backend contract (generate_sync_message /
@@ -167,14 +173,30 @@ def sync_until_quiet(doc_a, doc_b, backend_a, backend_b, link_ab=None,
     drops everything, the worst case). Convergence under loss therefore
     means: protocol + reconnect policy, which is the deployable unit.
 
+    Reconnects follow a bounded JITTERED BACKOFF (`backoff`, a
+    service.backoff.Backoff in ROUND units — the same schedule object the
+    service retry path uses): reset k+1 requires `stall_reset` plus the
+    schedule's (growing, jittered) delay in stalled rounds, so a fleet of
+    drivers sharing a flapping wire cannot re-handshake in lockstep.
+    Once the schedule is exhausted — or `max_rounds` elapse — the driver
+    gives up with a TYPED ``SyncStalled`` (carrying `rounds`, `resets`,
+    and the link stats in `detail`): with a fault budget that means a
+    real protocol bug, not bad luck.
+
     Returns (doc_a, doc_b, rounds, stats) with stats carrying
     'quarantined' (corrupt messages contained at the receiver) and
-    'resets' (stall recoveries). Raises if max_rounds elapse without
-    quiet — with a fault budget that means a real protocol bug, not bad
-    luck."""
+    'resets' (stall recoveries)."""
+    if backoff is None:
+        # round units: first re-reset after ~stall_reset extra rounds,
+        # growing 2x (capped at 8x) — generous retries so bounded-budget
+        # fault traces always converge before the typed give-up
+        backoff = Backoff(base=stall_reset, factor=2.0,
+                          cap=8.0 * stall_reset, retries=16, jitter=0.5,
+                          seed=0)
     quarantined = [0]
     resets = 0
     stalled = 0
+    reset_wait = stall_reset      # rounds of stall before the next reset
     last_heads = None
     box = {'a': doc_a, 'b': doc_b,
            'sa': backend_a.init_sync_state(),
@@ -222,14 +244,28 @@ def sync_until_quiet(doc_a, doc_b, backend_a, backend_b, link_ab=None,
 
         heads = (tuple(backend_a.get_heads(box['a'])),
                  tuple(backend_b.get_heads(box['b'])))
-        stalled = stalled + 1 if heads == last_heads else 0
+        if heads == last_heads:
+            stalled += 1
+        else:
+            stalled = 0
         last_heads = heads
-        if stalled >= stall_reset:
+        if stalled >= reset_wait:
+            if backoff.exhausted(resets):
+                raise SyncStalled(
+                    f'sync stalled: no head progress through {resets} '
+                    f'reconnects over {rounds} rounds', rounds=rounds,
+                    resets=resets,
+                    detail={'ab': link_ab.stats if link_ab else None,
+                            'ba': link_ba.stats if link_ba else None})
             box['sa'] = backend_a.init_sync_state()
             box['sb'] = backend_b.init_sync_state()
+            # next reset waits longer, jittered — no lockstep re-handshake
+            reset_wait = max(1, round(stall_reset + backoff.delay(resets)))
             resets += 1
+            _fault_totals['stall_resets'] += 1
             stalled = 0
-    raise AssertionError(
-        f'sync not quiet after {max_rounds} rounds '
-        f'(ab={link_ab.stats if link_ab else None}, '
-        f'ba={link_ba.stats if link_ba else None})')
+    raise SyncStalled(
+        f'sync not quiet after {max_rounds} rounds ({resets} reconnects)',
+        rounds=max_rounds, resets=resets,
+        detail={'ab': link_ab.stats if link_ab else None,
+                'ba': link_ba.stats if link_ba else None})

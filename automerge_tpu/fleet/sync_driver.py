@@ -50,14 +50,18 @@ __all__ = ['generate_sync_messages_docs', 'receive_sync_messages_docs',
 
 
 @_spanned('sync_generate')
-def generate_sync_messages_docs(backends, sync_states):
+def generate_sync_messages_docs(backends, sync_states, deadline=None):
     """Batched ``generate_sync_message`` over N (backend, syncState) pairs.
     Returns (new_sync_states, messages) with messages[i] = bytes or None,
     byte-identical to the host function applied per doc. All Bloom builds
-    share one device dispatch; all peer-filter probes share another."""
+    share one device dispatch; all peer-filter probes share another.
+    `deadline` is checked before the build dispatch is issued (generation
+    mutates no document state, so the check is purely a latency bound)."""
     n = len(backends)
     if len(sync_states) != n:
         raise ValueError('backends and sync_states must align')
+    if deadline is not None:
+        deadline.check(what='generate_sync_messages_docs')
 
     our_heads = [get_heads(b) for b in backends]
     our_need = [get_missing_deps(b, s['theirHeads'] or [])
@@ -164,7 +168,8 @@ def generate_sync_messages_docs(backends, sync_states):
 
 @_spanned('sync_receive')
 def receive_sync_messages_docs(backends, sync_states, binary_messages,
-                               mirror=True, on_error='raise'):
+                               mirror=True, on_error='raise',
+                               deadline=None):
     """Batched ``receive_sync_message`` over N docs. messages[i] may be None
     (no-op for that doc). All received changes apply through ONE
     apply_changes_docs call (device turbo batch with mirror=False on fleet
@@ -176,10 +181,17 @@ def receive_sync_messages_docs(backends, sync_states, binary_messages,
     fused dispatch. on_error='raise' aborts the round on the first bad
     input (classic contract), with a typed exception carrying the doc
     index. Messages are decoded per doc EITHER way, so the exception
-    names the offender instead of dying mid-list."""
+    names the offender instead of dying mid-list.
+
+    `deadline` is checked at entry and again AFTER the (host-side,
+    non-mutating) decode, immediately before the fused apply dispatch —
+    a deadline that fires leaves every doc and sync state untouched
+    (typed DeadlineExceeded, all-or-nothing)."""
     n = len(backends)
     if len(sync_states) != n or len(binary_messages) != n:
         raise ValueError('backends, sync_states, and messages must align')
+    if deadline is not None:
+        deadline.check(what='receive_sync_messages_docs')
     quarantine = on_error == 'quarantine'
     if not quarantine and on_error != 'raise':
         raise ValueError(f"on_error must be 'raise' or 'quarantine', "
@@ -219,16 +231,20 @@ def receive_sync_messages_docs(backends, sync_states, binary_messages,
 
     per_doc_changes = [list(d['changes']) if d else [] for d in decoded]
     if any(per_doc_changes):
+        # the decode above was pure host-side reading; this is the last
+        # point before the fused dispatch mutates anything (apply checks
+        # the deadline again at its own entry)
         if quarantine:
             new_backends, patches, apply_errors = apply_changes_docs(
                 backends, per_doc_changes, mirror=mirror,
-                on_error='quarantine')
+                on_error='quarantine', deadline=deadline)
             for i, err in enumerate(apply_errors):
                 if err is not None and errors[i] is None:
                     errors[i] = err
         else:
             new_backends, patches = apply_changes_docs(
-                backends, per_doc_changes, mirror=mirror)
+                backends, per_doc_changes, mirror=mirror,
+                deadline=deadline)
     else:
         new_backends, patches = list(backends), [None] * n
 

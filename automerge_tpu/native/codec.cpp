@@ -1463,11 +1463,19 @@ static bool ingest_one_chunk(IngestCtx &ctx, const uint8_t *chunk,
                              uint64_t chunk_len, int32_t doc_id,
                              int with_meta, int with_seq) {
   if (chunk_len < 12) return false;
+  // The checksum covers type+length+body but NOT the magic bytes, so
+  // they must be checked explicitly: without this, a buffer whose magic
+  // is corrupt parses "clean", its ops land on the device, and the raw
+  // garbage bytes enter the change log where save()'s host decode later
+  // explodes — silent acceptance instead of a typed quarantine (found
+  // by the ISSUE-7 chaos client, pinned by
+  // tests/test_service.py::test_corrupt_magic_is_quarantined_not_stored).
+  if (memcmp(chunk, "\x85\x6f\x4a\x83", 4) != 0) return false;
   const uint8_t *body;
   uint64_t body_len;
   std::vector<uint8_t> inflated;
   Cursor hc{chunk, chunk_len};
-  hc.skip(8);  // magic + checksum
+  hc.skip(8);  // magic (verified above) + checksum (verified per body)
   uint8_t chunk_type = *hc.bytes(1);
   uint64_t blen = hc.uleb();
   const uint8_t *bptr = hc.bytes(blen);

@@ -37,26 +37,57 @@ __all__ = ['configure', 'record_event', 'recent_events', 'clear_events',
 _events = collections.deque(maxlen=256)
 _dump_dir = os.environ.get('AUTOMERGE_TPU_FLIGHT_DIR') or None
 _dump_spans = 64             # newest spans included per forensic dump
+# Disk-write rate limit: a quarantine STORM (thousands of poisoned docs
+# in one incident) must not amplify into disk exhaustion — at most
+# _dump_limit dump FILES land per _dump_window_s sliding window; excess
+# dumps are still assembled in memory (last_flight_record keeps working)
+# but the file write is suppressed and counted in 'dumps_suppressed'.
+_dump_limit = int(os.environ.get('AUTOMERGE_TPU_FLIGHT_DUMP_LIMIT', 16))
+_dump_window_s = float(os.environ.get('AUTOMERGE_TPU_FLIGHT_DUMP_WINDOW',
+                                      60.0))
+_dump_times = collections.deque()
 _last = None
-_stats = {'flight_events': 0, 'flight_dumps': 0}
+_stats = {'flight_events': 0, 'flight_dumps': 0, 'dumps_suppressed': 0}
 register_health_source('flight_events', lambda: _stats['flight_events'])
 register_health_source('flight_dumps', lambda: _stats['flight_dumps'])
+register_health_source('dumps_suppressed',
+                       lambda: _stats['dumps_suppressed'])
 
 _UNSET = object()
 
 
-def configure(capacity=None, dump_dir=_UNSET, dump_spans=None):
+def configure(capacity=None, dump_dir=_UNSET, dump_spans=None,
+              dump_limit=None, dump_window_s=None):
     """Adjust the recorder: ring capacity (the newest events are kept up
     to the new bound; call clear_events() for a fresh ring),
-    forensic-dump directory (None = keep dumps in memory only), and how
-    many of the newest spans each dump includes."""
-    global _events, _dump_dir, _dump_spans
+    forensic-dump directory (None = keep dumps in memory only), how
+    many of the newest spans each dump includes, and the disk-write
+    rate limit (`dump_limit` files per `dump_window_s` sliding window;
+    limit <= 0 disables the cap)."""
+    global _events, _dump_dir, _dump_spans, _dump_limit, _dump_window_s
     if capacity is not None:
         _events = collections.deque(_events, maxlen=int(capacity))
     if dump_dir is not _UNSET:
         _dump_dir = dump_dir
     if dump_spans is not None:
         _dump_spans = int(dump_spans)
+    if dump_limit is not None:
+        _dump_limit = int(dump_limit)
+    if dump_window_s is not None:
+        _dump_window_s = float(dump_window_s)
+
+
+def _dump_write_allowed(now):
+    """Sliding-window admission for dump FILE writes (the report itself
+    always assembles). True = write, with the slot recorded."""
+    if _dump_limit <= 0:
+        return True
+    while _dump_times and now - _dump_times[0] > _dump_window_s:
+        _dump_times.popleft()
+    if len(_dump_times) >= _dump_limit:
+        return False
+    _dump_times.append(now)
+    return True
 
 
 def record_event(kind, **fields):
@@ -83,14 +114,20 @@ def dump_flight_record(trigger, detail=None, path=None):
     """Assemble (and possibly write) the forensic report around `trigger`.
     Returns the report dict; it is also retained for
     ``last_flight_record()``. ``path`` overrides the configured dump
-    directory for this one dump."""
+    directory for this one dump — and bypasses the rate limit (an
+    explicit path is an operator asking, not a storm amplifying). Disk
+    writes to the CONFIGURED directory are rate-limited (see
+    ``configure``): a suppressed dump still assembles in memory, gains
+    ``'suppressed': True``, and bumps the 'dumps_suppressed' health
+    counter."""
     global _last
     from . import hist
     _stats['flight_dumps'] += 1
+    now = time.time()
     report = {
         'trigger': trigger,
         'seq': _stats['flight_dumps'],
-        'ts': time.time(),
+        'ts': now,
         'detail': detail,
         'events': list(_events),
         'recent_spans': _spans.iter_spans()[-_dump_spans:],
@@ -101,9 +138,13 @@ def dump_flight_record(trigger, detail=None, path=None):
     _last = report
     out_path = path
     if out_path is None and _dump_dir is not None:
-        os.makedirs(_dump_dir, exist_ok=True)
-        out_path = os.path.join(
-            _dump_dir, f'flight-{trigger}-{report["seq"]}.json')
+        if _dump_write_allowed(now):
+            os.makedirs(_dump_dir, exist_ok=True)
+            out_path = os.path.join(
+                _dump_dir, f'flight-{trigger}-{report["seq"]}.json')
+        else:
+            _stats['dumps_suppressed'] += 1
+            report['suppressed'] = True
     if out_path is not None:
         with open(out_path, 'w') as f:
             json.dump(report, f, indent=1, default=repr)

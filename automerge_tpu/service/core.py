@@ -1,0 +1,703 @@
+"""The in-process multi-tenant serving core.
+
+This is the ROADMAP's "in-process driver first" step toward the async
+document service: ``DocService`` multiplexes tenant SESSIONS (each bound
+to one document in a shared ``DocFleet``) onto the batched seams —
+every tick's admitted apply work lands in ONE fused
+``apply_changes_docs`` dispatch and every tick's sync work in one
+batched receive + one batched generate round — while staying correct
+and fair under overload:
+
+- **Admission** (service/admission.py): typed ``Overloaded`` /
+  ``TenantThrottled`` BEFORE any work is queued; round-robin drain so
+  one tenant's flood cannot age another tenant's queue.
+- **Deadlines** (service/deadline.py): checked when a request is pulled
+  into a batch and re-checked by the seam immediately before the fused
+  dispatch — a request fails ``DeadlineExceeded`` fully-unapplied or
+  commits fully, never half-applied.
+- **Retries** (service/backoff.py): a request carrying a ``payload_fn``
+  (the transport re-draw — what a client retransmit delivers) is
+  retried on wire-corruption faults with jittered backoff under a
+  per-tenant retry budget; the budget dry or the schedule exhausted is
+  a typed ``RetriesExhausted``, not another retry. Sync sessions that
+  stall (traffic, no head progress — a dropped message poisoned
+  ``sentHashes`` upstream) reconnect with fresh sync state on the same
+  backoff curve and budget.
+- **Brownout** (service/brownout.py): sustained admission pressure
+  climbs the widen-fsync → defer-compaction → shed-background-sync
+  ladder, every transition in the health counters and flight recorder.
+
+The core is deliberately tick-driven and synchronous (``pump()`` runs
+one batch round; the engine below is single-threaded by contract);
+``AsyncDocService`` is the asyncio facade that turns tickets into
+awaitables and pumps from an event-loop task. All time flows through an
+injected monotonic clock so tests and the loadgen drive it explicitly.
+"""
+
+import asyncio
+import time
+
+from ..errors import (DeadlineExceeded, Overloaded, RetriesExhausted,
+                      WireCorruption)
+from ..fleet import backend as fleet_backend
+from ..fleet.sync_driver import (generate_sync_messages_docs,
+                                 receive_sync_messages_docs)
+from ..observability import hist as _hist
+from ..observability import recorder as _flight
+from ..observability.metrics import register_health_source
+from ..observability.spans import span as _span
+from .admission import AdmissionController
+from .backoff import Backoff, RetryBudget
+from .brownout import BrownoutController
+from .deadline import Deadline
+
+__all__ = ['DocService', 'AsyncDocService', 'Session', 'Ticket',
+           'service_stats']
+
+_stats = {
+    'service_requests': 0,         # submitted (admitted) requests
+    'service_completed': 0,        # tickets resolved ok
+    'service_failed': 0,           # tickets resolved with a typed error
+    'deadline_exceeded': 0,        # requests dropped at their deadline
+    'service_retries': 0,          # transient-fault retries scheduled
+    'retry_budget_exhausted': 0,   # typed RetriesExhausted resolutions
+    'sync_reconnects': 0,          # stalled sessions reset with backoff
+}
+for _key in _stats:
+    register_health_source(_key, lambda k=_key: _stats[k])
+
+
+def service_stats():
+    return dict(_stats)
+
+
+class Ticket:
+    """One request's completion handle. ``status`` moves pending -> 'ok'
+    (``result`` holds the reply, e.g. sync response bytes) or 'error'
+    (``error`` holds the TYPED exception — shedding is never untyped).
+    ``latency`` is submit-to-resolution seconds on the service clock."""
+
+    __slots__ = ('kind', 'tenant', 'session_id', 'status', 'result',
+                 'error', 'submitted_at', 'finished_at', '_future')
+
+    def __init__(self, kind, tenant, session_id, submitted_at):
+        self.kind = kind
+        self.tenant = tenant
+        self.session_id = session_id
+        self.status = 'pending'
+        self.result = None
+        self.error = None
+        self.submitted_at = submitted_at
+        self.finished_at = None
+        self._future = None
+
+    @property
+    def done(self):
+        return self.status != 'pending'
+
+    @property
+    def latency(self):
+        if self.finished_at is None:
+            return None
+        return self.finished_at - self.submitted_at
+
+    def _finish(self, now, result=None, error=None):
+        if self.done:
+            return
+        self.finished_at = now
+        if error is not None:
+            self.status = 'error'
+            self.error = error
+            _stats['service_failed'] += 1
+        else:
+            self.status = 'ok'
+            self.result = result
+            _stats['service_completed'] += 1
+        if self._future is not None and not self._future.done():
+            self._future.set_result(self)
+
+    def __repr__(self):
+        return (f'Ticket({self.kind}, tenant={self.tenant!r}, '
+                f'status={self.status!r})')
+
+
+class _Request:
+    __slots__ = ('kind', 'session', 'payload', 'payload_fn', 'deadline',
+                 'priority', 'ticket', 'attempts', 'not_before', 'reset')
+
+    def __init__(self, kind, session, payload, payload_fn, deadline,
+                 priority, ticket, reset=False):
+        self.kind = kind
+        self.session = session
+        self.payload = payload
+        self.payload_fn = payload_fn
+        self.deadline = deadline
+        self.priority = priority
+        self.ticket = ticket
+        self.attempts = 0
+        self.not_before = 0.0
+        self.reset = reset
+
+    def draw_payload(self):
+        """This attempt's bytes: the transport re-draw when the client
+        models retransmission, else the fixed payload."""
+        if self.payload_fn is not None:
+            return self.payload_fn()
+        return self.payload
+
+
+class Session:
+    """One tenant session bound to one fleet document plus the
+    service-side sync state for that client."""
+
+    __slots__ = ('id', 'tenant', 'handle', 'sync_state', 'closed',
+                 '_last_heads', '_stall_rounds', '_reconnect_attempts')
+
+    def __init__(self, sid, tenant, handle):
+        self.id = sid
+        self.tenant = tenant
+        self.handle = handle
+        self.sync_state = _init_sync_state()
+        self.closed = False
+        self._last_heads = None
+        self._stall_rounds = 0
+        self._reconnect_attempts = 0
+
+
+def _init_sync_state():
+    from ..backend.sync import init_sync_state
+    return init_sync_state()
+
+
+class DocService:
+    """See the module docstring. Construct over a fresh fleet, an
+    existing ``DocFleet``, or a ``DurableFleet`` (whose journal the
+    brownout ladder then manages)."""
+
+    def __init__(self, fleet=None, durable=None, *,
+                 tenant_rate=200.0, tenant_burst=50.0, tenant_queue=64,
+                 max_queued=10_000, batch_limit=4096,
+                 default_timeout=None,
+                 backoff=None, retry_rate=20.0, retry_burst=40.0,
+                 stall_rounds=8,
+                 brownout=None, clock=time.monotonic):
+        from ..fleet.backend import DocFleet
+        self.durable = durable
+        if durable is not None:
+            fleet = durable.fleet
+        self.fleet = fleet if fleet is not None else DocFleet()
+        self.clock = clock
+        self.admission = AdmissionController(
+            rate=tenant_rate, burst=tenant_burst, queue_limit=tenant_queue,
+            max_queued=max_queued)
+        self.batch_limit = int(batch_limit)
+        self.default_timeout = default_timeout
+        self.backoff = backoff if backoff is not None else Backoff()
+        self._retry_budgets = {}       # tenant -> RetryBudget
+        self._retry_rate = float(retry_rate)
+        self._retry_burst = float(retry_burst)
+        self.stall_rounds = int(stall_rounds)
+        self.brownout = brownout if brownout is not None \
+            else BrownoutController()
+        self._attached_journal = None
+        self._attach_brownout_journal()
+        self.sessions = {}
+        self._next_sid = 0
+        self._delayed = []             # backoff-parked retries
+        self.ticks = 0
+        self._adm_counts = (0, 0, 0)   # admission deltas across ticks
+
+    # -- wiring ---------------------------------------------------------
+
+    def _attach_brownout_journal(self):
+        journal = self.durable.journal if self.durable is not None else \
+            self.fleet.journal
+        if journal is not self._attached_journal:
+            self.brownout.attach_journal(journal)
+            self._attached_journal = journal
+
+    def _retry_budget(self, tenant):
+        b = self._retry_budgets.get(tenant)
+        if b is None:
+            b = self._retry_budgets[tenant] = RetryBudget(
+                rate=self._retry_rate, burst=self._retry_burst)
+        return b
+
+    # -- sessions -------------------------------------------------------
+
+    def open_sessions(self, tenants):
+        """Open one session per entry of `tenants` (a list of tenant
+        names) with O(1) device work for the whole batch (init_docs)."""
+        handles = self.durable.init_docs(len(tenants)) \
+            if self.durable is not None \
+            else fleet_backend.init_docs(len(tenants), self.fleet)
+        out = []
+        for tenant, handle in zip(tenants, handles):
+            sid = self._next_sid
+            self._next_sid += 1
+            session = Session(sid, tenant, handle)
+            self.sessions[sid] = session
+            out.append(session)
+        return out
+
+    def open_session(self, tenant):
+        return self.open_sessions([tenant])[0]
+
+    def close_session(self, session):
+        """Disconnect: free the doc; still-queued requests resolve typed
+        ('session closed') when their turn comes."""
+        if session.closed:
+            return
+        session.closed = True
+        fleet_backend.free_docs([session.handle])
+        self.sessions.pop(session.id, None)
+
+    # -- submission ------------------------------------------------------
+
+    def submit(self, session, kind, payload=None, *, payload_fn=None,
+               deadline=None, timeout=None, priority=1, reset=False):
+        """Admit one request. Raises typed ``Overloaded`` /
+        ``TenantThrottled`` at the edge; returns a ``Ticket`` otherwise.
+        `kind` is 'apply' (payload: list of change bytes for the
+        session's doc) or 'sync' (payload: the client's sync message
+        bytes, or None to solicit a server message). `payload_fn`
+        replaces a fixed payload with a per-attempt transport draw,
+        which is what makes wire faults retryable. `timeout` seconds
+        mint a deadline on the service clock; an explicit `deadline`
+        wins. `reset=True` on a sync request marks a CLIENT RECONNECT:
+        the service discards its side of the handshake state before
+        processing — without this, a server whose `sentHashes` already
+        cover everything goes silent at a freshly-reconnected (state
+        lost) client and the handshake livelocks."""
+        if kind not in ('apply', 'sync'):
+            raise ValueError(f"kind must be 'apply' or 'sync', got "
+                             f'{kind!r}')
+        if session.closed:
+            raise Overloaded('session closed', retry_after=None,
+                             shed=False, stage=None)
+        now = self.clock()
+        if deadline is None:
+            t = timeout if timeout is not None else self.default_timeout
+            if t is not None:
+                deadline = Deadline(now + t, clock=self.clock)
+        ticket = Ticket(kind, session.tenant, session.id, now)
+        request = _Request(kind, session, payload, payload_fn, deadline,
+                           priority, ticket, reset=reset)
+        self.admission.admit(session.tenant, request, now)
+        _stats['service_requests'] += 1
+        return ticket
+
+    # -- the tick --------------------------------------------------------
+
+    def pump(self, now=None):
+        """One service tick: drain a fair batch, drop expired deadlines,
+        run the fused apply + sync rounds, schedule retries, feed the
+        brownout ladder. Returns the tick's stats dict."""
+        now = self.clock() if now is None else now
+        self.ticks += 1
+        start = time.perf_counter()
+        with _span('service_tick', tick=self.ticks):
+            stats = self._pump_inner(now)
+        _hist.record_value('service_tick_s', time.perf_counter() - start,
+                           scale=1e9, unit='s')
+        return stats
+
+    def _pump_inner(self, now):
+        stats = {'completed': 0, 'failed': 0, 'deadline_dropped': 0,
+                 'retried': 0, 'shed': 0}
+        # pressure inputs: backlog BEFORE the drain (after it the queue
+        # is empty whenever batch_limit covers the tick — an idle-looking
+        # queue under heavy typed rejection), plus the rejected fraction
+        # at the admission edge since the LAST tick (rejections happen at
+        # submit time, between pumps)
+        queue_pressure = self.admission.pressure()
+        adm = self.admission.stats
+        counts = (adm['admitted'], adm['rejected_overloaded'],
+                  adm['rejected_throttled'])
+        prev_counts = getattr(self, '_adm_counts', counts)
+        self._adm_counts = counts
+        admitted = counts[0] - prev_counts[0]
+        rejected = (counts[1] - prev_counts[1]) + \
+            (counts[2] - prev_counts[2])
+        batch = self._ripe_retries(now)
+        batch += self.admission.drain(self.batch_limit - len(batch))
+
+        applies, syncs = [], []
+        shed_floor = self.brownout.shed_below()
+        for request in batch:
+            ticket = request.ticket
+            if request.session.closed:
+                ticket._finish(now, error=Overloaded(
+                    'session closed', retry_after=None, shed=False,
+                    stage=None))
+                stats['failed'] += 1
+                continue
+            if request.deadline is not None and \
+                    request.deadline.remaining(now) < 0:
+                late = -request.deadline.remaining(now)
+                ticket._finish(now, error=DeadlineExceeded(
+                    f'{request.kind}: deadline exceeded by '
+                    f'{late * 1e3:.2f}ms before dispatch',
+                    deadline=request.deadline.at, late_by=late))
+                _stats['deadline_exceeded'] += 1
+                stats['deadline_dropped'] += 1
+                continue
+            if request.kind == 'sync' and shed_floor is not None and \
+                    request.priority < shed_floor:
+                self.brownout.count_shed()
+                ticket._finish(now, error=Overloaded(
+                    f'sync round shed at brownout stage '
+                    f'{self.brownout.stage}', retry_after=0.1, shed=True,
+                    stage=self.brownout.stage))
+                stats['shed'] += 1
+                continue
+            (applies if request.kind == 'apply' else syncs).append(request)
+
+        if applies:
+            self._run_applies(applies, now, stats)
+        if syncs:
+            self._run_syncs(syncs, now, stats)
+
+        # background durability work: compaction runs cost-based unless
+        # the ladder deferred it; journal rotation re-attaches
+        if self.durable is not None:
+            if not self.brownout.defer_compaction:
+                self.durable.maybe_compact()
+            self._attach_brownout_journal()
+        reject_pressure = rejected / (admitted + rejected) \
+            if (admitted + rejected) >= 8 else 0.0
+        self.brownout.observe(max(queue_pressure, reject_pressure))
+        stats['stage'] = self.brownout.stage
+        stats['queued'] = self.admission.queued + len(self._delayed)
+        return stats
+
+    def _ripe_retries(self, now):
+        if not self._delayed:
+            return []
+        ripe = [r for r in self._delayed if r.not_before <= now]
+        if ripe:
+            self._delayed = [r for r in self._delayed
+                             if r.not_before > now]
+        return ripe
+
+    def _min_deadline(self, requests):
+        deadlines = [r.deadline for r in requests if r.deadline is not None]
+        if not deadlines:
+            return None
+        return min(deadlines, key=lambda d: d.at)
+
+    def _seam_deadline_abort(self, requests, now, stats):
+        """The seam refused the whole batch pre-dispatch (typed
+        DeadlineExceeded): nothing committed. Resolve the requests that
+        are actually late; requeue the rest at the front, unserved."""
+        requeue = {}
+        for request in requests:
+            if request.deadline is not None and \
+                    request.deadline.remaining(now) < 0:
+                late = -request.deadline.remaining(now)
+                request.ticket._finish(now, error=DeadlineExceeded(
+                    f'{request.kind}: deadline exceeded by '
+                    f'{late * 1e3:.2f}ms before dispatch',
+                    deadline=request.deadline.at, late_by=late))
+                _stats['deadline_exceeded'] += 1
+                stats['deadline_dropped'] += 1
+            else:
+                requeue.setdefault(request.session.tenant, []).append(
+                    request)
+        for tenant, requests_ in requeue.items():
+            self.admission.requeue_front(tenant, requests_)
+
+    def _fail_or_retry(self, request, error, now, stats):
+        """A typed per-doc failure: retry when it is plausibly transient
+        (the request carries a transport re-draw and the fault class is
+        wire corruption) within backoff + budget; resolve typed
+        otherwise. Never an untyped escape."""
+        transient = request.payload_fn is not None and \
+            isinstance(error, WireCorruption)
+        if transient and not self.backoff.exhausted(request.attempts) and \
+                self._retry_budget(request.session.tenant).spend(now):
+            delay = self.backoff.delay(request.attempts)
+            request.attempts += 1
+            request.not_before = now + delay
+            self._delayed.append(request)
+            _stats['service_retries'] += 1
+            stats['retried'] += 1
+            return
+        if transient:
+            _stats['retry_budget_exhausted'] += 1
+            _flight.record_event('retry_exhausted',
+                                 tenant=request.session.tenant,
+                                 request_kind=request.kind,
+                                 attempts=request.attempts,
+                                 error=type(error).__name__)
+            exhausted = RetriesExhausted(
+                f'{request.kind}: transient fault persisted through '
+                f'{request.attempts} retries',
+                attempts=request.attempts, tenant=request.session.tenant)
+            exhausted.__cause__ = error
+            error = exhausted
+        request.ticket._finish(now, error=error)
+        stats['failed'] += 1
+
+    # -- the apply round -------------------------------------------------
+
+    def _run_applies(self, requests, now, stats):
+        """All apply requests of the tick in ONE fused quarantining
+        dispatch. Requests for the same session concatenate in drain
+        order; a quarantined doc fails (or retries) every request that
+        contributed to it — none of its changes committed."""
+        by_session = {}
+        for request in requests:
+            by_session.setdefault(request.session.id, []).append(request)
+        sessions = []
+        per_doc = []
+        doc_requests = []
+        bad = []                    # (request, typed error) pre-dispatch
+        for sid, requests_ in by_session.items():
+            session = requests_[0].session
+            changes = []
+            kept = []
+            for request in requests_:
+                try:
+                    payload = request.draw_payload()
+                except Exception as exc:       # a payload_fn that died
+                    bad.append((request, Overloaded(
+                        f'transport draw failed: {exc!r}',
+                        retry_after=None, shed=False, stage=None)))
+                    continue
+                if payload is None:            # chaos disconnect mid-draw
+                    bad.append((request, Overloaded(
+                        'transport delivered nothing', retry_after=0.01,
+                        shed=False, stage=None)))
+                    continue
+                changes.extend(bytes(b) for b in payload)
+                kept.append(request)
+            if kept:
+                sessions.append(session)
+                per_doc.append(changes)
+                doc_requests.append(kept)
+        for request, error in bad:
+            self._fail_or_retry(request, error, now, stats)
+        if not sessions:
+            return
+        kept_requests = [r for kept in doc_requests for r in kept]
+        try:
+            new_handles, _patches, errors = fleet_backend.apply_changes_docs(
+                [s.handle for s in sessions], per_doc, mirror=False,
+                on_error='quarantine',
+                deadline=self._min_deadline(kept_requests))
+        except DeadlineExceeded:
+            self._seam_deadline_abort(kept_requests, now, stats)
+            return
+        for session, handle, err, requests_ in zip(
+                sessions, new_handles, errors, doc_requests):
+            # the quarantine seam returns a VALID handle for every slot
+            # (rejected docs roll back, they don't freeze) — adopt it
+            # either way; only the tickets differ
+            session.handle = handle
+            if err is None:
+                for request in requests_:
+                    request.ticket._finish(now, result=len(request.payload)
+                                           if request.payload is not None
+                                           else None)
+                    stats['completed'] += 1
+            else:
+                # the doc's whole tick-batch was rejected: nothing from
+                # these requests committed (all-or-nothing holds)
+                for request in requests_:
+                    self._fail_or_retry(request, err.error, now, stats)
+
+    # -- the sync round ----------------------------------------------------
+
+    def _run_syncs(self, requests, now, stats):
+        """All sync requests of the tick in one batched receive round +
+        one batched generate round. Each request's result is the
+        service's reply message (or None when the handshake is quiet)."""
+        sessions = []
+        incoming = []
+        live = []
+        seen = set()
+        deferred = {}
+        for request in requests:
+            if request.session.id in seen:
+                # a sync round is a handshake step: one per session per
+                # tick (the batched seam needs distinct docs); extras
+                # run next tick, order preserved
+                deferred.setdefault(request.session.tenant, []).append(
+                    request)
+                continue
+            try:
+                payload = request.draw_payload()
+            except Exception as exc:
+                self._fail_or_retry(request, Overloaded(
+                    f'transport draw failed: {exc!r}', retry_after=None,
+                    shed=False, stage=None), now, stats)
+                continue
+            if request.reset:
+                # client reconnect: both ends handshake fresh (delivery
+                # is idempotent; only optimization state is discarded)
+                request.session.sync_state = _init_sync_state()
+                request.session._stall_rounds = 0
+            seen.add(request.session.id)
+            sessions.append(request.session)
+            incoming.append(bytes(payload) if payload is not None else None)
+            live.append(request)
+        for tenant, requests_ in deferred.items():
+            self.admission.requeue_front(tenant, requests_)
+        if not live:
+            return
+        # Reconnect rounds emulate the SIMULTANEOUS handshake: the reply
+        # is generated from the fresh state BEFORE the client's message
+        # lands. Receiving first would let the receive shortcut set
+        # lastSentHeads without sending (the alternating-turn trap
+        # documented in fleet/faults.py) and the reconnected client
+        # would solicit a silent server forever.
+        pre_replies = {}
+        reset_sessions = [s for s, r in zip(sessions, live) if r.reset]
+        if reset_sessions:
+            states, messages = generate_sync_messages_docs(
+                [s.handle for s in reset_sessions],
+                [s.sync_state for s in reset_sessions])
+            for session, state, message in zip(reset_sessions, states,
+                                               messages):
+                session.sync_state = state
+                pre_replies[session.id] = message
+        try:
+            handles, states, _patches, errors = receive_sync_messages_docs(
+                [s.handle for s in sessions],
+                [s.sync_state for s in sessions], incoming,
+                mirror=False, on_error='quarantine',
+                deadline=self._min_deadline(live))
+        except DeadlineExceeded:
+            self._seam_deadline_abort(live, now, stats)
+            return
+        ok_sessions = []
+        ok_requests = []
+        for session, handle, state, err, request in zip(
+                sessions, handles, states, errors, live):
+            session.handle = handle     # valid for rejected slots too
+            if err is not None:
+                # corrupt client message: the doc CONTENT and sync state
+                # are untouched (containment) — transient by nature
+                self._fail_or_retry(request, err.error, now, stats)
+                continue
+            session.sync_state = state
+            if request.reset:
+                # reply = the pre-receive handshake generated above
+                request.ticket._finish(now,
+                                       result=pre_replies.get(session.id))
+                stats['completed'] += 1
+                continue
+            ok_sessions.append(session)
+            ok_requests.append(request)
+        if not ok_sessions:
+            return
+        self._detect_stalls(ok_sessions, now)
+        new_states, replies = generate_sync_messages_docs(
+            [s.handle for s in ok_sessions],
+            [s.sync_state for s in ok_sessions])
+        for session, state, reply, request in zip(
+                ok_sessions, new_states, replies, ok_requests):
+            session.sync_state = state
+            request.ticket._finish(now, result=reply)
+            stats['completed'] += 1
+
+    def _detect_stalls(self, sessions, now):
+        """Reconnect-on-stall with jittered backoff + the tenant retry
+        budget: a session whose handshake keeps exchanging traffic
+        without head movement resets its service-side sync state (change
+        delivery is idempotent; only optimization state is lost). The
+        stall threshold grows along the backoff curve per reset, and a
+        dry retry budget SKIPS the reset (it retries when tokens refill)
+        instead of hammering."""
+        from ..backend import get_heads
+        for session in sessions:
+            heads = tuple(get_heads(session.handle))
+            their = session.sync_state.get('theirHeads')
+            # a stall is SPLIT BRAIN THAT PERSISTS: the peer's advertised
+            # heads differ from ours and ours are not moving. A quiet
+            # converged handshake (equal heads) is not a stall, however
+            # long it idles — resetting there would livelock.
+            split = their is not None and sorted(their) != sorted(heads)
+            if split and heads == session._last_heads:
+                session._stall_rounds += 1
+            else:
+                session._stall_rounds = 0
+                if not split:
+                    session._reconnect_attempts = 0
+            session._last_heads = heads
+            threshold = self.stall_rounds * (1 + session._reconnect_attempts)
+            if session._stall_rounds < threshold:
+                continue
+            if not self._retry_budget(session.tenant).spend(now):
+                continue
+            session.sync_state = _init_sync_state()
+            session._stall_rounds = 0
+            session._reconnect_attempts += 1
+            _stats['sync_reconnects'] += 1
+            _flight.record_event('sync_reconnect', session=session.id,
+                                 tenant=session.tenant,
+                                 attempt=session._reconnect_attempts)
+
+    # -- drain helpers ----------------------------------------------------
+
+    def idle(self):
+        return self.admission.queued == 0 and not self._delayed
+
+    def run_until_idle(self, max_ticks=10_000, advance=None):
+        """Pump until no work is queued or parked. `advance` (seconds per
+        tick) steps an injected fake clock via pump(now=...) so parked
+        retries ripen without wall-clock sleeps."""
+        now = self.clock()
+        for _ in range(max_ticks):
+            if self.idle():
+                return True
+            self.pump(now=now)
+            if advance is not None:
+                now += advance
+        return self.idle()
+
+
+class AsyncDocService:
+    """asyncio facade: ``await submit(...)`` resolves when the pump loop
+    (one ``run()`` task per service) serves the request. Admission
+    rejections raise typed immediately; resolved-with-error tickets
+    raise their typed error from ``await``."""
+
+    def __init__(self, service, idle_sleep=0.001):
+        self.service = service
+        self.idle_sleep = idle_sleep
+        self._stop = False
+
+    async def submit(self, session, kind, payload=None, **kwargs):
+        ticket = self.service.submit(session, kind, payload, **kwargs)
+        ticket._future = asyncio.get_running_loop().create_future()
+        await ticket._future
+        if ticket.status == 'error':
+            raise ticket.error
+        return ticket
+
+    async def run(self):
+        """The pump task: tick while work is queued, sleep until the
+        earliest parked retry ripens when backoff parking is the only
+        pending work (pumping through a parked delay would busy-spin a
+        core on no-op ticks), yield while idle."""
+        while not self._stop:
+            service = self.service
+            if service.admission.queued:
+                service.pump()
+                await asyncio.sleep(0)
+            elif service._delayed:
+                wait = min(r.not_before for r in service._delayed) - \
+                    service.clock()
+                if wait <= 0:
+                    service.pump()
+                    await asyncio.sleep(0)
+                else:
+                    await asyncio.sleep(min(wait, max(self.idle_sleep,
+                                                      0.001)))
+            else:
+                await asyncio.sleep(self.idle_sleep)
+
+    def stop(self):
+        self._stop = True

@@ -862,7 +862,8 @@ SECTIONS = {}
 # within 2x (the BENCH_SANITY contract; VERDICT round-5 weak #7)
 SANITY_KEYS = {'seam': 'seam_rate', 'registers': 'reg_rate',
                'mixed': 'mixed_rate', 'seam_dense': 'seam_dense_rate',
-               'observability': 'obs_off_rate'}
+               'observability': 'obs_off_rate',
+               'service': 'service_clean_rps'}
 
 
 def section(name):
@@ -1491,6 +1492,45 @@ def _sec_observability():
           f'apply_batch_s p50 {apply_p50}', file=sys.stderr)
 
 
+@section('service')
+def _sec_service():
+    # Multi-tenant serving core (ISSUE-7): the three standing loadgen
+    # legs — clean, chaos client, 2x overload — at 10k concurrent
+    # sessions, reporting p99 request latency and sustained rounds/s per
+    # leg. Acceptance lives in the report itself: every rejection typed
+    # (untyped_escapes == 0), every edit doc byte-identical to the
+    # unloaded control, every drained sync session converged, brownout
+    # transitions visible under overload.
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), 'tools'))
+    from loadgen import run_standard_legs
+    sessions = _env('BENCH_SERVICE_SESSIONS', 10000)
+    requests = _env('BENCH_SERVICE_REQUESTS', max(20000, sessions * 2))
+    tenants = _env('BENCH_SERVICE_TENANTS', 256)
+    legs = run_standard_legs(sessions=sessions, tenants=tenants,
+                             requests=requests, seed=0)
+    for leg in legs:
+        name = leg['leg']
+        conv = leg['convergence'] or {}
+        R[f'service_{name}_p99_ms'] = leg['p99_ms']
+        R[f'service_{name}_rps'] = leg['requests_per_s']
+        R[f'service_{name}_rounds_per_s'] = leg['rounds_per_s']
+        ok = leg['untyped_escapes'] == 0 and \
+            conv.get('edit_mismatches', 0) == 0 and \
+            conv.get('sync_converged') == conv.get('sync_drained')
+        R[f'service_{name}_ok'] = int(ok)
+        print(f"# service {name}: {leg['completed_ok']}/{leg['submitted']}"
+              f" ok at {sessions} sessions/{tenants} tenants, p99 "
+              f"{leg['p99_ms']}ms, {leg['rounds_per_s']} rounds/s, "
+              f"{leg['requests_per_s']} req/s, rejections "
+              f"{ {k: v for k, v in leg['rejections'].items()} }, "
+              f"brownout transitions {leg['brownout_transitions']}, "
+              f"convergence {conv}, {'OK' if ok else 'FAIL'}",
+              file=sys.stderr)
+    R['service_legs_all_ok'] = int(all(
+        R[f"service_{leg['leg']}_ok"] for leg in legs))
+
+
 @section('zipf')
 def _sec_zipf():
     # Config 5 (stretch): Zipf-skewed change rates over a large fleet
@@ -1634,6 +1674,9 @@ def _run_sanity():
              'BENCH_DUR_DOCS': '1000', 'BENCH_OBS_DOCS': '1000',
              'BENCH_REG_DOCS': '500', 'BENCH_LOAD_DOCS': '200',
              'BENCH_SAVE_CHANGES': '50', 'BENCH_MIXED_DOCS': '100',
+             'BENCH_SERVICE_SESSIONS': '500',
+             'BENCH_SERVICE_REQUESTS': '3000',
+             'BENCH_SERVICE_TENANTS': '32',
              'BENCH_REPS': '3'}
     for k, v in small.items():
         os.environ.setdefault(k, v)

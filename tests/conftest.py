@@ -30,3 +30,37 @@ def pytest_configure(config):
         'markers',
         'slow: long-running (full crash/chaos matrices); tier-1 runs '
         "-m 'not slow'")
+
+
+# ---------------------------------------------------------------------------
+# slow-marker audit bookkeeping (ISSUE-7 satellite): accumulate wall time
+# per test FAMILY (a parametrized function is one family) across the
+# session, and record which families carry the `slow` marker. The audit
+# test itself lives in tests/test_slow_audit.py and is reordered to run
+# LAST, so it sees the whole session's totals — an unmarked family that
+# grows past its budget fails tier-1 loudly instead of silently pushing
+# the suite toward its 870s timeout.
+# ---------------------------------------------------------------------------
+
+FAMILY_DURATIONS = {}      # nodeid-without-parametrization -> seconds
+SLOW_FAMILIES = set()      # families carrying the `slow` marker
+
+
+def _family(nodeid):
+    return nodeid.split('[', 1)[0]
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.get_closest_marker('slow'):
+            SLOW_FAMILIES.add(_family(item.nodeid))
+    # the audit must observe every other test: push its module to the end
+    items.sort(key=lambda item: item.module.__name__ == 'test_slow_audit'
+               if hasattr(item, 'module') else False)
+
+
+def pytest_runtest_logreport(report):
+    if report.when in ('setup', 'call', 'teardown'):
+        fam = _family(report.nodeid)
+        FAMILY_DURATIONS[fam] = FAMILY_DURATIONS.get(fam, 0.0) + \
+            (report.duration or 0.0)
