@@ -1087,3 +1087,148 @@ def decode_document(buffer):
     except Exception as exc:
         raise as_wire_error(exc, MalformedDocument, 'decode_document')
     return changes
+
+
+def _native_column_decode(buf, delta):
+    """One change-meta column via the native decoders; None = no codec
+    (caller falls back to the Python decoders). Decode failures re-raise
+    typed as MalformedDocument — the view's containment contract."""
+    from . import native
+    if not native.available():
+        return None
+    try:
+        if delta:
+            values, valid = native.decode_delta_column(buf)
+        else:
+            values, valid = native.decode_rle_column(buf, signed=False)
+    except Exception as exc:
+        raise as_wire_error(exc, MalformedDocument, 'DocChunkView column')
+    return values.tolist(), valid.tolist()
+
+
+class DocChunkView:
+    """Compute-on-compressed reads over a document chunk (the LSM-OPD
+    idea applied to the parked main store): heads, actor table, change
+    count, per-actor clock, and maxOp are answered straight from the
+    chunk's HEADER and change-metadata columns — the op columns (the
+    bulk of the chunk, and the expensive part of `decode_document`) are
+    never inflated, decoded, or re-encoded.
+
+    Used by the delta+main storage engine (fleet/storage.py) to serve
+    causal-state reads and sync-membership probes for parked documents
+    without materializing them, and by `park_docs` as the header-derived
+    change count. Raises `MalformedDocument` on undecodable bytes."""
+
+    __slots__ = ('heads', 'actor_ids', '_cols', '_n_changes', '_clock',
+                 '_max_op')
+
+    # change-metadata column ids ((spec << 4) | type)
+    _ACTOR, _SEQ, _MAXOP = 0x01, 0x03, 0x13
+
+    def __init__(self, chunk, check=True):
+        try:
+            self._parse(bytes(chunk), check)
+        except Exception as exc:
+            raise as_wire_error(exc, MalformedDocument, 'DocChunkView')
+        self._n_changes = None
+        self._clock = None
+        self._max_op = None
+
+    def _parse(self, chunk, check):
+        decoder = Decoder(chunk)
+        header = decode_container_header(decoder, check)
+        if header['chunkType'] != CHUNK_TYPE_DOCUMENT:
+            raise ValueError(f"Unexpected chunk type: {header['chunkType']}")
+        body = Decoder(header['chunkData'])
+        self.actor_ids = [body.read_hex_string()
+                          for _ in range(body.read_uint53())]
+        num_heads = body.read_uint53()
+        self.heads = [bytes_to_hex_string(body.read_raw_bytes(32))
+                      for _ in range(num_heads)]
+        changes_info = decode_column_info(body)
+        ops_info = decode_column_info(body)
+        # slice ONLY the change-metadata columns this view serves;
+        # everything after (all op columns) stays untouched bytes
+        cols = {}
+        for col in changes_info:
+            buf = body.read_raw_bytes(col['bufferLen'])
+            cid = col['columnId']
+            if (cid & ~COLUMN_TYPE_DEFLATE) in (self._ACTOR, self._SEQ,
+                                                self._MAXOP):
+                if cid & COLUMN_TYPE_DEFLATE:
+                    buf = _inflate_raw(buf)
+                    cid &= ~COLUMN_TYPE_DEFLATE
+                cols[cid] = bytes(buf)
+        self._cols = cols
+
+    def _decode(self, cid, delta):
+        """(values, valid) for one change-meta column; native decoders
+        when available, the Python codecs otherwise."""
+        buf = self._cols.get(cid, b'')
+        out = _native_column_decode(buf, delta)
+        if out is not None:
+            return out
+        dec = DeltaDecoder(buf) if delta else RLEDecoder('uint', buf)
+        values, valid = [], []
+        while not dec.done:
+            v = dec.read_value()
+            values.append(0 if v is None else v)
+            valid.append(v is not None)
+        return values, valid
+
+    @property
+    def n_changes(self):
+        """Number of changes in the chunk, from the seq column's row
+        count alone (no per-change decode)."""
+        if self._n_changes is None:
+            values, _valid = self._decode(self._SEQ, delta=True)
+            self._n_changes = len(values)
+        return self._n_changes
+
+    @property
+    def clock(self):
+        """{actor_id: max seq} straight from the actor/seq columns."""
+        if self._clock is None:
+            actors, a_ok = self._decode(self._ACTOR, delta=False)
+            seqs, s_ok = self._decode(self._SEQ, delta=True)
+            if len(actors) != len(seqs):
+                raise MalformedDocument(
+                    'DocChunkView: actor/seq column length mismatch')
+            clock = {}
+            for a, av, s, sv in zip(actors, a_ok, seqs, s_ok):
+                if not av or not sv:
+                    raise MalformedDocument(
+                        'DocChunkView: null actor/seq row')
+                a = int(a)
+                if a >= len(self.actor_ids) or a < 0:
+                    raise MalformedDocument(f'DocChunkView: no actor {a}')
+                hexa = self.actor_ids[a]
+                s = int(s)
+                if clock.get(hexa, 0) < s:
+                    clock[hexa] = s
+            self._clock = clock
+        return dict(self._clock)
+
+    @property
+    def max_op(self):
+        if self._max_op is None:
+            values, valid = self._decode(self._MAXOP, delta=True)
+            self._max_op = max((int(v) for v, ok in zip(values, valid)
+                                if ok), default=0)
+        return self._max_op
+
+    def contains_head(self, hash_hex):
+        """Sync-membership probe: is `hash_hex` one of this document's
+        heads? (Exact interior-history membership needs materialized
+        hashes; the heads answer is what the sync driver's have-check
+        consumes for parked docs.)"""
+        return hash_hex in self.heads
+
+    def covers_heads(self, their_heads):
+        """True when every hash in `their_heads` is one of this chunk's
+        heads — the parked-doc form of the reference's
+        all-deps-already-known fast path: a peer whose heads are a
+        subset of ours (and vice versa for equality) needs no revive to
+        answer 'in sync'."""
+        heads = set(self.heads)
+        return all(h in heads for h in their_heads)

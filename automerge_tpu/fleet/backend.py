@@ -1753,10 +1753,14 @@ class _FlatEngine(HashGraph):
     (new.js:1887-1912)."""
 
     # 'changes' is inherited as a HashGraph slot but shadowed by the
-    # property below; storage lives in _changes (see the property note)
+    # property below; storage lives in _changes (see the property note).
+    # _doc_hashes/_doc_maxops carry the native extractor's per-change
+    # hashes/maxOps after a native materialize (in place of the decoded
+    # dicts the Python path keeps in _doc_decoded); _parked_n is the
+    # parked chunk's change count while appends accumulate in the tail.
     __slots__ = ('fleet', 'slot', 'mirror', 'binary_doc', 'seq_objects',
                  'map_objects', 'stale', '_doc_pending', '_doc_decoded',
-                 '_changes')
+                 '_changes', '_doc_hashes', '_doc_maxops', '_parked_n')
 
     def __init__(self, fleet, slot):
         super().__init__()
@@ -1821,30 +1825,52 @@ class _FlatEngine(HashGraph):
         self._changes = value
 
     def _materialize_doc(self):
-        """Decode the parked document chunk into the real change log (one
-        Python decode + per-change re-encode for hashes; runs at most once
-        per loaded doc, and only when history is needed). The ~700µs/doc
-        cost dominates durability-recovery replay (ROADMAP: native
-        change-list extraction), so it is attributed three ways: a
-        `doc_materialize` span, `metrics.seconds['doc_materializations']`,
-        and the `doc_materialize_s` histogram."""
+        """Expand the parked document chunk into the real change log
+        prefix (runs at most once per parked generation, and only when
+        history is genuinely read). The native extractor (codec.cpp
+        am_extract_changes) splits the chunk into canonical per-change
+        buffers + hashes directly — byte-identical to the Python
+        decode_document + encode_change round trip it replaces, ~5-10x
+        faster (the delta+main materialize kernel); docs outside the
+        native subset fall back to the Python path transparently. Changes
+        appended while parked (the delta tail — see apply_changes_docs'
+        commit loop) stay in _changes and the extracted prefix splices in
+        front of them. Attributed three ways: a `doc_materialize` span,
+        `metrics.seconds['doc_materializations']`, and the
+        `doc_materialize_s` histogram."""
         chunk = self._doc_pending
         if chunk is None:
             return
         self._doc_pending = None
-        from ..columnar import decode_document, encode_change
         metrics = self.fleet.metrics
         metrics.doc_materializations += 1
         start = time.perf_counter()
+        tail = self._changes
+        used_native = False
         with _span('doc_materialize', slot=self.slot,
                    durable_id=getattr(self, '_dur_id', None),
                    chunk_bytes=len(chunk)):
-            decoded = decode_document(chunk)
-            self._changes = [encode_change(ch) for ch in decoded]
-            self._doc_decoded = decoded
+            extracted = native.extract_changes([chunk]) \
+                if native.available() else None
+            if extracted is not None and extracted[0] is not None:
+                buffers, hashes, max_ops = extracted[0]
+                self._changes = buffers + tail
+                self._doc_decoded = None
+                self._doc_hashes = hashes
+                self._doc_maxops = max_ops
+                used_native = True
+            else:
+                from ..columnar import decode_document, encode_change
+                decoded = decode_document(chunk)
+                self._changes = [encode_change(ch) for ch in decoded] + tail
+                self._doc_decoded = decoded
         elapsed = time.perf_counter() - start
         metrics.seconds['doc_materializations'] = \
             metrics.seconds.get('doc_materializations', 0.0) + elapsed
+        if used_native:
+            metrics.seconds['doc_materializations_native'] = \
+                metrics.seconds.get('doc_materializations_native', 0.0) + \
+                elapsed
         _hist.record_value('doc_materialize_s', elapsed, scale=1e9,
                            unit='s')
 
@@ -1859,6 +1885,9 @@ class _FlatEngine(HashGraph):
         self._changes = []
         self._doc_pending = chunk
         self._doc_decoded = None
+        self._doc_hashes = None
+        self._doc_maxops = None
+        self._parked_n = n_changes
         self.binary_doc = chunk
         self.changes_meta = []
         self.change_index_by_hash = {}
@@ -1872,8 +1901,26 @@ class _FlatEngine(HashGraph):
 
     def _doc_resolve(self, i):
         """(hash, deps, actor, meta) for _ensure_graph over a bulk-loaded
-        document's i-th change."""
+        document's i-th change. After a NATIVE materialize the decoded
+        dicts don't exist; the hash/maxOp come from the extractor's
+        arrays and the rest from a header-only decode of the canonical
+        change buffer (cheap: no op columns are touched)."""
         self._materialize_doc()
+        if self._doc_decoded is None:
+            # header + raw column slicing only — no op decode (and
+            # extraBytes, which the header-only decode_change_meta
+            # doesn't reach, survives into changes_meta)
+            from ..columnar import decode_change_columns
+            m = decode_change_columns(self._changes[i])
+            meta = {
+                'actor': m['actor'], 'seq': m['seq'],
+                'maxOp': self._doc_maxops[i],
+                'time': m.get('time', 0),
+                'message': m.get('message') or '',
+                'deps': list(m['deps']),
+                'extraBytes': m.get('extraBytes'),
+            }
+            return self._doc_hashes[i], meta['deps'], meta['actor'], meta
         ch = self._doc_decoded[i]
         meta = {
             'actor': ch['actor'], 'seq': ch['seq'],
@@ -2685,8 +2732,9 @@ def host_memory_stats(handles):
         fleet = impl.fleet
         if impl._doc_pending is not None:
             parked_bytes += len(impl._doc_pending)
-        else:
-            log_bytes += sum(len(b) for b in impl._changes)
+        # a parked doc's _changes holds its delta TAIL (changes accepted
+        # since parking); both forms count — they are both host RAM
+        log_bytes += sum(len(b) for b in impl._changes)
         for q in impl.queue:
             buf = q.get('buffer') if isinstance(q, dict) else None
             if buf is not None:
@@ -2734,16 +2782,21 @@ def park_docs(handles):
     parking is a policy the caller applies to docs it believes are cold,
     not a one-way compression.
 
-    Soundness: the chunk is decoded once at park time —
-    `decode_document` recomputes every change hash by canonical
-    re-encoding and raises unless the heads reproduce exactly
-    (columnar.py decode_document_changes), so a doc whose history cannot
-    round-trip (e.g. foreign non-canonically-encoded changes) is left
-    live rather than parked. Docs with queued changes or parked already
-    are skipped. Returns the number of docs parked."""
-    from ..columnar import decode_document
+    Soundness: the chunk is round-trip-validated once at park time — the
+    native extractor reconstructs every change canonically and verifies
+    the re-encoded hash frontier against the header heads (codec.cpp
+    am_extract_changes; Python `decode_document` does the identical check
+    when the native codec is absent or bails) — so a doc whose history
+    cannot round-trip (e.g. foreign non-canonically-encoded changes) is
+    left live rather than parked. The change COUNT comes from the same
+    extraction instead of a full Python decode (the old
+    decode-every-change-just-to-record-n cost). Docs with queued changes
+    are skipped; an already-parked doc re-parks only when it has accrued
+    a delta tail (changes accepted while parked), folding the tail into
+    a fresh chunk. Returns the number of docs parked."""
     parked = 0
     flushed = set()
+    cands = []                   # (impl, chunk) pending batch validation
     for handle in handles:
         state = handle.get('state')
         if not isinstance(state, FleetDoc) or not state.is_fleet:
@@ -2753,17 +2806,49 @@ def park_docs(handles):
         if id(fleet) not in flushed:
             fleet.flush()
             flushed.add(id(fleet))
-        if impl.queue or impl._doc_pending is not None or \
-                not impl.changes:
+        if impl.queue or not impl._changes:
+            # held-back queue entries can't be represented in a chunk;
+            # no tail means either an empty doc or already parked clean
             continue
-        chunk = bytes(impl.save())
-        try:
-            n = len(decode_document(chunk))
-        except Exception:
+        cands.append((impl, bytes(impl.save())))
+    # ONE batched validation for the whole park call: the native
+    # extractor fans the chunks over its thread pool instead of paying a
+    # per-doc FFI round trip
+    counts = _validate_doc_chunks([chunk for _impl, chunk in cands])
+    for (impl, chunk), n in zip(cands, counts):
+        if n is None:
             continue          # cannot round-trip: stays live
         impl._install_parked_chunk(chunk, n)
         parked += 1
     return parked
+
+
+def _validate_doc_chunks(chunks):
+    """Batched round-trip validation: per chunk, its change count or
+    None when the history cannot be reproduced from it (the park-time
+    soundness gate). Native extraction validates by construction (heads
+    verified against re-encoded hashes) over the thread pool; docs it
+    bails on get the identical check from the Python decode."""
+    if not chunks:
+        return []
+    native_out = native.extract_changes(chunks) if native.available() \
+        else None
+    out = [None] * len(chunks)
+    from ..columnar import decode_document
+    for i, chunk in enumerate(chunks):
+        if native_out is not None and native_out[i] is not None:
+            out[i] = len(native_out[i][0])
+        else:
+            try:
+                out[i] = len(decode_document(chunk))
+            except Exception:
+                out[i] = None
+    return out
+
+
+def _validate_doc_chunk(chunk):
+    """Single-chunk form of _validate_doc_chunks."""
+    return _validate_doc_chunks([chunk])[0]
 
 
 def rebuild_docs(handles, fleet=None, mirror=False):
@@ -3685,10 +3770,16 @@ def _apply_changes_turbo_inner(handles, per_doc_changes, ps, parsed=None):
         start, stop = per_doc_idx[d]
         engine = engines[d]
         if engine._doc_pending is not None:
-            log = engine.changes    # parked doc: property get revives it
+            # Parked doc: the accepted buffers append to the DELTA TAIL
+            # (_changes) while the compressed chunk stays parked — the
+            # delta+main write path. Log indexes account for the parked
+            # prefix; the prefix only materializes when history is
+            # genuinely read (recovery replay at 10k docs never does).
+            log = engine._changes
+            base = engine._parked_n + len(log)
         else:
             log = engine._changes
-        base = len(log)
+            base = len(log)
         log.extend(flat_buffers[start:stop])
         # One deferred-graph record for the whole run (resolved lazily per
         # change only if a graph query ever needs it)

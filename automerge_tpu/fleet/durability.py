@@ -72,7 +72,7 @@ from ..observability.spans import (span as _span, span_seq as _span_seq,
 __all__ = [
     'ChangeJournal', 'DurableFleet', 'RecoveryReport',
     'KIND_CHANGE', 'KIND_FREE', 'KIND_DOC', 'KIND_QUEUED', 'KIND_END',
-    'KIND_INIT',
+    'KIND_INIT', 'KIND_SMETA',
     'encode_frame', 'parse_journal_bytes', 'parse_snapshot_bytes',
     'parse_manifest_bytes', 'read_state', 'durability_stats',
     'pending_fsync_bytes_total', 'set_fsync_alert_threshold',
@@ -103,11 +103,23 @@ _U32 = struct.Struct('<I')
 FRAME_OVERHEAD = _MHEAD.size + 4 + 4   # prefix + hcrc + pcrc
 
 KIND_CHANGE = 1      # journal: raw change (or document-chunk) bytes
-KIND_FREE = 2        # journal: document freed (empty payload)
+KIND_FREE = 2        # journal: document freed (empty payload); in a
+#                      SNAPSHOT SEGMENT: tombstone — the doc was freed
+#                      since the previous segment and must not resurrect
 KIND_DOC = 3         # snapshot: document save() bytes
 KIND_QUEUED = 4      # snapshot: causally held-back queue buffer
 KIND_END = 5         # snapshot/manifest: terminator
 KIND_INIT = 6        # journal: document created, no changes yet
+KIND_SMETA = 8       # snapshot: segment metadata (JSON: base flag, seq)
+#                      — absent in pre-segment snapshots, which read as
+#                      base (full) snapshots. Written with the sentinel
+#                      doc id below (never a real durable id, which are
+#                      assigned monotonically from 0), so payload rot in
+#                      the SMETA frame reads as STRUCTURAL damage
+#                      instead of quarantining document 0 — a segment
+#                      whose base-ness cannot be trusted must not be
+#                      stitched at all.
+SMETA_DOC_ID = 0xfffffffe
 # Columnar batch frame — the hot-seam format (ChangeJournal.record_seam):
 # ONE outer frame whose doc_id field carries the record count and whose
 # payload is two independently-CRC'd copies of a (doc_id, length,
@@ -370,13 +382,16 @@ def parse_journal_bytes(data, offset=0, strict=False):
 
 
 def parse_snapshot_bytes(data):
-    """Decode a snapshot body. Returns (docs, queued, errors): docs is
-    {doc_id: save_bytes}, queued {doc_id: [buffers]}, errors
+    """Decode a snapshot (base or incremental segment) body. Returns
+    (docs, queued, errors, meta): docs is {doc_id: save_bytes | None}
+    (None = KIND_FREE tombstone — the doc was freed since the previous
+    segment), queued {doc_id: [buffers]}, errors
     [(doc_id | None, MalformedSnapshot)] for rotted per-doc frames (one
     rotted frame quarantines ONE doc — the rest of the snapshot still
-    loads). Raises MalformedSnapshot only for STRUCTURAL damage: bad
-    file magic, or a missing/corrupt END terminator (the snapshot cannot
-    be proven complete)."""
+    loads), meta the segment's KIND_SMETA JSON ({'base': True} for
+    pre-segment snapshots without one). Raises MalformedSnapshot only
+    for STRUCTURAL damage: bad file magic, or a missing/corrupt END
+    terminator (the snapshot cannot be proven complete)."""
     data = bytes(data)
     if data[:len(SNAP_MAGIC)] != SNAP_MAGIC:
         raise MalformedSnapshot('snapshot: bad magic')
@@ -393,18 +408,37 @@ def parse_snapshot_bytes(data):
         raise MalformedSnapshot(
             f'snapshot: END declares {declared} records, found '
             f'{len(body)} intact + {len(info["rotted"])} rotted')
-    errors = [(doc_id, MalformedSnapshot(
-        f'snapshot: rotted frame at byte {at}'
-        + (f' (doc {doc_id})' if doc_id is not None else ''),
-        doc_index=doc_id)) for doc_id, at, _idx in info['rotted']]
+    errors = []
+    for doc_id, at, _idx in info['rotted']:
+        if doc_id == SMETA_DOC_ID:
+            # rotted segment metadata: the segment's identity (base vs
+            # incremental) is unknowable — structural damage
+            raise MalformedSnapshot(
+                f'snapshot: rotted segment metadata at byte {at}')
+        errors.append((doc_id, MalformedSnapshot(
+            f'snapshot: rotted frame at byte {at}'
+            + (f' (doc {doc_id})' if doc_id is not None else ''),
+            doc_index=doc_id)))
     docs, queued = {}, {}
+    meta = {'base': True}
     for kind, doc_id, payload in body:
         if kind == KIND_DOC:
             docs[doc_id] = bytes(payload)
         elif kind == KIND_QUEUED:
             queued.setdefault(doc_id, []).append(bytes(payload))
+        elif kind == KIND_FREE:
+            docs[doc_id] = None
+            queued.pop(doc_id, None)
+        elif kind == KIND_SMETA:
+            try:
+                meta = json.loads(bytes(payload).decode('utf8'))
+            except Exception as exc:
+                raise as_wire_error(exc, MalformedSnapshot,
+                                    'snapshot segment meta')
+            if not isinstance(meta, dict):
+                raise MalformedSnapshot('snapshot: bad segment meta')
         # unknown kinds: forward-compatible skip
-    return docs, queued, errors
+    return docs, queued, errors, meta
 
 
 def parse_manifest_bytes(data):
@@ -441,6 +475,10 @@ _stats = {
     'rotted_records': 0,         # mid-stream CRC failures contained
     'recovered_docs': 0,         # documents recovered from disk
     'fsync_window_alerts': 0,    # loss-window threshold crossings
+    'segments': 0,               # incremental (per-doc) compaction segments
+    'segment_docs': 0,           # doc frames written by incremental
+    #                              compaction — the O(churn) signal: after
+    #                              touching K of N docs this grows by K
 }
 for _key in _stats:
     register_health_source(_key, lambda k=_key: _stats[k])
@@ -541,6 +579,12 @@ class ChangeJournal:
         self.written_bytes = size       # bytes handed to the OS
         self.durable_bytes = size       # bytes known fsynced
         self.records = 0                # records appended this generation
+        # Churn tracking for incremental compaction: every doc id that
+        # journaled a record this generation (dirty), and the subset
+        # freed. Compaction re-persists EXACTLY the dirty set — work
+        # proportional to churn, not fleet size (SynchroStore).
+        self.dirty = set()
+        self.freed = set()
         self.closed = False
         self._window_alerted = False    # edge trigger for the loss alert
         _open_journals.add(self)
@@ -578,6 +622,7 @@ class ChangeJournal:
     def append(self, doc_id, payload, kind=KIND_CHANGE):
         self._pending += encode_frame(kind, doc_id, bytes(payload))
         self.records += 1
+        self.dirty.add(doc_id)
         _stats['journal_records'] += 1
 
     def record_changes(self, state, buffers, commit=True):
@@ -638,6 +683,7 @@ class ChangeJournal:
         else:
             self._pending += _encode_batch(dids, bufs)
         self.records += n_rec
+        self.dirty.update(dids)
         _stats['journal_records'] += n_rec
         self.commit()
 
@@ -648,6 +694,7 @@ class ChangeJournal:
         if did is None or self.docs.get(did) is not state:
             return
         self.append(did, b'', kind=KIND_FREE)
+        self.freed.add(did)
         self.docs.pop(did, None)
         if commit:
             self.commit()
@@ -794,10 +841,56 @@ def _journal_name(seq):
     return f'journal-{seq:08d}.log'
 
 
+def _stitch_segments(path, names):
+    """Load + stitch a snapshot-segment chain (oldest -> newest). Raises
+    MalformedSnapshot / OSError through — callers decide fallback
+    policy."""
+    results = []
+    for name in names:
+        with open(os.path.join(path, name), 'rb') as f:
+            results.append(parse_snapshot_bytes(f.read()))
+    return _stitch_parsed(results)
+
+
+def _stitch_parsed(seg_results):
+    """Stitch already-parsed segments (oldest -> newest): a later
+    KIND_DOC supersedes earlier copies (and replaces the doc's queued
+    list), a KIND_FREE tombstone erases the doc. Per-doc rot errors from
+    an OLDER segment are dropped when a newer segment supersedes the doc
+    (the newest persisted copy is what matters)."""
+    docs, queued = {}, {}
+    errors_by_doc = {}
+    unattributed = []
+    for seg_docs, seg_queued, seg_errors, _meta in seg_results:
+        for did, payload in seg_docs.items():
+            if payload is None:
+                docs.pop(did, None)
+                queued.pop(did, None)
+                errors_by_doc.pop(did, None)
+            else:
+                docs[did] = payload
+                queued[did] = seg_queued.get(did, [])
+                if not queued[did]:
+                    queued.pop(did, None)
+                errors_by_doc.pop(did, None)
+        for did, err in seg_errors:
+            if did is None:
+                unattributed.append((None, err))
+            else:
+                errors_by_doc[did] = err
+                # the newest copy of this doc is rot: an older stitched
+                # copy (if any) becomes the doc's last good prefix
+    errors = unattributed + [(did, err)
+                             for did, err in sorted(errors_by_doc.items())]
+    return docs, queued, errors
+
+
 def read_state(path):
     """Low-level recovery inputs from a durability directory, backend
     agnostic (the chaos harness rebuilds host-backend peers from this).
-    Returns a dict with 'manifest', 'docs' {doc_id: save_bytes},
+    Returns a dict with 'manifest', 'docs' {doc_id: save_bytes} (the
+    STITCHED view over the manifest's segment chain — base snapshot plus
+    incremental per-doc compaction segments, tombstones applied),
     'queued' {doc_id: [buffers]}, 'snapshot_errors'
     [(doc_id | None, MalformedSnapshot)], 'journal_records'
     [(kind, doc_id, payload)], 'journal_info' (parse_journal_bytes
@@ -806,6 +899,7 @@ def read_state(path):
     damaged ones do (an unrecoverable directory)."""
     manifest = None
     fallback = False
+    stitched = None
     mpath = os.path.join(path, MANIFEST_NAME)
     if os.path.exists(mpath):
         try:
@@ -813,38 +907,61 @@ def read_state(path):
                 manifest = parse_manifest_bytes(f.read())
         except (MalformedSnapshot, OSError):
             manifest = None
-    snap_bytes = None
-    snap_result = None
-    if manifest is not None and manifest.get('snapshot'):
-        sp = os.path.join(path, manifest['snapshot'])
+    if manifest is not None:
+        chain = manifest.get('chain')
+        if chain is None:           # pre-segment manifest
+            chain = [manifest['snapshot']] if manifest.get('snapshot') \
+                else []
+        manifest['chain'] = chain
         try:
-            with open(sp, 'rb') as f:
-                snap_bytes = f.read()
-            snap_result = parse_snapshot_bytes(snap_bytes)
+            stitched = _stitch_segments(path, chain)
         except (MalformedSnapshot, OSError):
-            snap_result = None
+            stitched = None
             manifest = None           # fall back to the directory scan
+    journal_start = None
     if manifest is None:
         # manifest missing or pointing at damage: scan for the newest
-        # structurally-valid snapshot generation on disk
+        # structurally-valid BASE snapshot on disk, then stitch every
+        # structurally-valid newer segment on top of it (invalid ones
+        # are skipped — their docs fall back to older copies)
         fallback = True
         found_damaged = False
-        snaps = sorted((f for f in os.listdir(path)
-                        if f.startswith('snapshot-') and f.endswith('.snap')),
-                       reverse=True)
-        for name in snaps:
+        snaps = []
+        for name in os.listdir(path):
+            if name.startswith('snapshot-') and name.endswith('.snap'):
+                try:
+                    snaps.append(
+                        (int(name[len('snapshot-'):-len('.snap')]), name))
+                except ValueError:
+                    continue
+        parsed = {}
+        base_seq = None
+        for fseq, name in sorted(snaps, reverse=True):
             try:
                 with open(os.path.join(path, name), 'rb') as f:
-                    snap_bytes = f.read()
-                snap_result = parse_snapshot_bytes(snap_bytes)
+                    parsed[fseq] = (name, parse_snapshot_bytes(f.read()))
             except (MalformedSnapshot, OSError):
                 found_damaged = True
                 continue
-            seq = int(name[len('snapshot-'):-len('.snap')])
-            manifest = {'seq': seq, 'snapshot': name,
-                        'journal': _journal_name(seq), 'journal_offset': 0}
-            break
-        if manifest is None:
+            if parsed[fseq][1][3].get('base', True):
+                base_seq = fseq
+                break
+        if base_seq is not None:
+            valid = sorted(s for s in parsed if s >= base_seq)
+            chain = [parsed[s][0] for s in valid]
+            # stitch from the results the scan ALREADY parsed — no
+            # second read (and no unguarded I/O escaping the fallback)
+            stitched = _stitch_parsed([parsed[s][1] for s in valid])
+            manifest = {'seq': valid[-1], 'snapshot': chain[-1],
+                        'chain': chain,
+                        'journal': _journal_name(valid[-1]),
+                        'journal_offset': 0}
+            # older journals may survive retention: replay everything on
+            # disk from the base generation up (idempotent over segment
+            # content — the hash graph dedupes, FREE follows its doc's
+            # changes within a journal, ids never recycle)
+            journal_start = base_seq
+        else:
             if found_damaged:
                 raise MalformedSnapshot(
                     'no valid manifest or snapshot in durability dir '
@@ -855,25 +972,42 @@ def read_state(path):
                                and f.endswith('.log')), reverse=True)
             seq = int(journals[0][len('journal-'):-len('.log')]) \
                 if journals else 0
-            manifest = {'seq': seq, 'snapshot': None,
+            manifest = {'seq': seq, 'snapshot': None, 'chain': [],
                         'journal': _journal_name(seq), 'journal_offset': 0}
-    docs, queued, snap_errors = snap_result if snap_result is not None \
+            journal_start = 0
+    docs, queued, snap_errors = stitched if stitched is not None \
         else ({}, {}, [])
-    # Journal CHAIN replay: start at the chosen generation and keep
-    # consuming newer journal files while they exist. Normally there is
-    # exactly one; a crash mid-checkpoint leaves an empty successor, and
-    # a fallback onto an OLDER retained snapshot (newest snapshot
-    # structurally rotted) finds the full chain of retained journals —
-    # so a single rotted snapshot frame never costs the suffix.
+    # Journal CHAIN replay: walk journal files upward from the chosen
+    # generation (fallback mode: from the base generation, skipping
+    # retention gaps). Normally there is exactly one; a crash
+    # mid-checkpoint leaves an empty successor, and a fallback onto an
+    # OLDER retained generation finds the retained journals — so a
+    # single rotted segment never costs the suffix.
     journal_records, journal_info = [], {
         'torn_tail_bytes': 0, 'rotted': [], 'valid_end': 0,
         'scanned_bytes': 0}
     seq = int(manifest['seq'])
-    s = seq
-    while True:
+    if journal_start is not None:
+        jseqs = []
+        for name in os.listdir(path):
+            if name.startswith('journal-') and name.endswith('.log'):
+                try:
+                    js = int(name[len('journal-'):-len('.log')])
+                except ValueError:
+                    continue
+                if js >= journal_start:
+                    jseqs.append(js)
+        jseqs.sort()
+    else:
+        jseqs = []
+        s = seq
+        while os.path.exists(os.path.join(path, _journal_name(s))):
+            jseqs.append(s)
+            s += 1
+    for s in jseqs:
         jp = os.path.join(path, _journal_name(s))
         if not os.path.exists(jp):
-            break
+            continue
         with open(jp, 'rb') as f:
             jbytes = f.read()
         recs, inf = parse_journal_bytes(
@@ -887,7 +1021,6 @@ def read_state(path):
                                    for did, at, idx in inf['rotted']]
         journal_info['valid_end'] = inf['valid_end']
         journal_info['scanned_bytes'] += inf['scanned_bytes']
-        s += 1
     return {
         'manifest': manifest,
         'docs': docs,
@@ -896,6 +1029,7 @@ def read_state(path):
         'journal_records': journal_records,
         'journal_info': journal_info,
         'used_fallback_manifest': fallback,
+        'max_journal_seq': jseqs[-1] if jseqs else seq,
     }
 
 
@@ -918,8 +1052,8 @@ class DurableFleet:
 
     def __init__(self, path, fleet=None, *, exact_device=False,
                  fsync_bytes=0, compact_bytes=16 << 20,
-                 compact_records=100_000, retain=2, doc_capacity=64,
-                 key_capacity=64, _recovered=None):
+                 compact_records=100_000, retain=2, max_chain=8,
+                 doc_capacity=64, key_capacity=64, _recovered=None):
         from .backend import DocFleet
         self.path = path
         os.makedirs(path, exist_ok=True)
@@ -931,11 +1065,60 @@ class DurableFleet:
         # snapshot falls back to the previous generation and replays the
         # retained journal chain instead of failing fleet-wide
         self.retain = max(int(retain), 1)
+        # incremental segments allowed on top of the base snapshot before
+        # compaction escalates to a full checkpoint (bounds recovery's
+        # stitch work and the chain's disk amplification)
+        self.max_chain = max(int(max_chain), 1)
         if _recovered is not None:
-            # internal: recovery built the fleet + registry already
-            self.fleet, self.seq, docs, next_doc_id = _recovered
-            self.journal = None
-            self.checkpoint(_docs=docs, _next_doc_id=next_doc_id)
+            # internal: recovery built the fleet + registry already; the
+            # closing persist RE-JOURNALS what replay applied instead of
+            # re-snapshotting the whole fleet — recovery work stays
+            # proportional to the replayed suffix, not fleet size
+            (self.fleet, last_seq, docs, next_doc_id, chain,
+             rejournal) = _recovered
+            self.chain = list(chain)
+            new_seq = int(last_seq) + 1
+            self.seq = new_seq
+            self.journal = ChangeJournal(
+                os.path.join(path, _journal_name(new_seq)),
+                fsync_bytes=fsync_bytes, docs=docs,
+                next_doc_id=next_doc_id)
+            # re-frame the replayed records; runs of CHANGE records use
+            # the columnar batch frame (one crc32 per record, the hot
+            # seam format) so the closing persist stays cheap at scale
+            pend_d, pend_b = [], []
+
+            def _flush_changes():
+                if not pend_b:
+                    return
+                if len(pend_b) < _BATCH_MIN:
+                    for did, buf in zip(pend_d, pend_b):
+                        self.journal._pending += encode_frame(
+                            KIND_CHANGE, did, buf)
+                else:
+                    self.journal._pending += _encode_batch(pend_d, pend_b)
+                self.journal.records += len(pend_b)
+                self.journal.dirty.update(pend_d)
+                _stats['journal_records'] += len(pend_b)
+                pend_d.clear()
+                pend_b.clear()
+
+            for kind, did, payload in rejournal:
+                if kind == KIND_CHANGE:
+                    pend_d.append(did)
+                    pend_b.append(bytes(payload))
+                    continue
+                _flush_changes()
+                self.journal.append(did, payload, kind=kind)
+                if kind == KIND_FREE:
+                    self.journal.freed.add(did)
+            _flush_changes()
+            self.journal.sync()
+            self._fault('journal-rotated')
+            self._write_manifest()
+            self._fault('manifest-flipped')
+            self._retention_sweep(new_seq)
+            self.fleet.attach_journal(self.journal)
             return
         if os.path.exists(os.path.join(path, MANIFEST_NAME)) or \
                 any(f.startswith(('snapshot-', 'journal-'))
@@ -947,9 +1130,10 @@ class DurableFleet:
             doc_capacity=doc_capacity, key_capacity=key_capacity,
             exact_device=exact_device)
         self.seq = 0
+        self.chain = []
         self.journal = ChangeJournal(
             os.path.join(path, _journal_name(0)), fsync_bytes=fsync_bytes)
-        self._write_manifest(snapshot=None)
+        self._write_manifest()
         self.fleet.attach_journal(self.journal)
 
     # -- document lifecycle --------------------------------------------
@@ -1021,64 +1205,60 @@ class DurableFleet:
                 'records': j.records}
 
     def maybe_compact(self, force=False):
-        """Checkpoint once replay debt crosses the byte/record threshold
-        (the LSM-style cost trigger). Returns True if it compacted."""
+        """Compact once replay debt crosses the byte/record threshold
+        (the LSM-style cost trigger). Compaction is INCREMENTAL: only
+        documents with journaled records this generation re-persist (a
+        per-doc segment, SynchroStore-style) — touching K of N docs does
+        O(K) work; the chain escalates to a full checkpoint after
+        `max_chain` segments. Returns True if it compacted."""
         debt = self.replay_debt()
         if not force and debt['bytes'] < self.compact_bytes and \
                 debt['records'] < self.compact_records:
             return False
         with _span('compaction', debt_bytes=debt['bytes'],
                    debt_records=debt['records']):
-            self.checkpoint()
-        _stats['compactions'] += 1
-        return True
+            did_work = self.compact()
+        if did_work:
+            _stats['compactions'] += 1
+        return did_work
 
     # -- checkpointing --------------------------------------------------
 
-    def _write_manifest(self, snapshot):
-        meta = {'seq': self.seq, 'snapshot': snapshot,
+    def _write_manifest(self):
+        meta = {'seq': self.seq,
+                'snapshot': self.chain[-1] if self.chain else None,
+                'chain': list(self.chain),
                 'journal': _journal_name(self.seq), 'journal_offset': 0,
                 'next_doc_id': self.journal.next_doc_id}
         payload = json.dumps(meta, sort_keys=True).encode('utf8')
         _atomic_write(os.path.join(self.path, MANIFEST_NAME),
                       MANIFEST_MAGIC + encode_frame(KIND_END, 0, payload))
 
-    @_spanned('checkpoint')
-    def checkpoint(self, _docs=None, _next_doc_id=None):
-        """Whole-fleet snapshot + journal rotation, crash-safe at every
-        step: (1) everything journaled so far is fsynced, (2) the
-        snapshot lands via temp + fsync + atomic rename, (3) a fresh
-        journal generation is created, (4) the manifest atomically
-        flips to the new pair, (5) only then is the old generation
-        deleted — a crash anywhere leaves the manifest pointing at a
-        complete (snapshot, journal) pair."""
-        old_seq = self.seq
-        if self.journal is not None:
-            self.journal.sync()
-            docs = self.journal.docs
-            next_doc_id = self.journal.next_doc_id
-        else:                                   # recovery's first one
-            docs = _docs
-            next_doc_id = _next_doc_id
-        # drop freed/dead documents from the registry (their FREE records
-        # die with the rotated journal)
-        live = {did: state for did, state in docs.items()
-                if getattr(state, '_impl', True) is not None}
-        new_seq = old_seq + 1
+    def _write_segment(self, new_seq, doc_items, tombstones, base):
+        """Write one snapshot file (base or incremental segment) via
+        temp + fsync + atomic rename. Returns (name, docs_written)."""
         snap_name = _snap_name(new_seq)
         tmp = os.path.join(self.path, snap_name + '.tmp')
-        n_frames = 0
+        n_frames = 1
+        n_docs = 0
         with open(tmp, 'wb') as f:
             f.write(SNAP_MAGIC)
-            for did, state in sorted(live.items()):
+            f.write(encode_frame(KIND_SMETA, SMETA_DOC_ID, json.dumps(
+                {'base': bool(base), 'seq': new_seq},
+                sort_keys=True).encode('utf8')))
+            for did, state in doc_items:
                 f.write(encode_frame(KIND_DOC, did, bytes(state.save())))
                 n_frames += 1
+                n_docs += 1
                 for entry in getattr(state, 'queue', []) or []:
                     buf = entry.get('buffer') if isinstance(entry, dict) \
                         else None
                     if buf is not None:
                         f.write(encode_frame(KIND_QUEUED, did, bytes(buf)))
                         n_frames += 1
+            for did in sorted(tombstones):
+                f.write(encode_frame(KIND_FREE, did, b''))
+                n_frames += 1
             f.write(encode_frame(KIND_END, 0, _U32.pack(n_frames)))
             f.flush()
             os.fsync(f.fileno())
@@ -1086,6 +1266,11 @@ class DurableFleet:
         os.replace(tmp, os.path.join(self.path, snap_name))
         _fsync_dir(self.path)
         self._fault('snapshot-renamed')
+        return snap_name, n_docs
+
+    def _rotate_and_flip(self, new_seq, live, next_doc_id):
+        """Steps 3-5 of the checkpoint protocol: fresh journal
+        generation, manifest flip, retention sweep."""
         # A stale successor journal (crash mid-checkpoint, or the
         # generation a fallback recovery just consumed) is removed only
         # NOW — after the snapshot that supersedes its records is
@@ -1103,18 +1288,24 @@ class DurableFleet:
             self.journal.close()
         self.seq = new_seq
         self.journal = ChangeJournal(
-            os.path.join(self.path, _journal_name(new_seq)),
-            fsync_bytes=self.fsync_bytes, docs=live,
+            new_path, fsync_bytes=self.fsync_bytes, docs=live,
             next_doc_id=next_doc_id)
         self.fleet.attach_journal(self.journal)
         self._fault('journal-rotated')
-        self._write_manifest(snapshot=snap_name)
+        self._write_manifest()
         self._fault('manifest-flipped')
-        # retention: keep the newest `retain` generations, delete the rest
+        self._retention_sweep(new_seq)
+
+    def _retention_sweep(self, new_seq):
+        """Keep the newest `retain` generations plus every snapshot the
+        live chain still references; delete the rest."""
+        protected = set(self.chain)
         for name in os.listdir(self.path):
             for prefix, suffix in (('snapshot-', '.snap'),
                                    ('journal-', '.log')):
                 if name.startswith(prefix) and name.endswith(suffix):
+                    if name in protected:
+                        continue
                     try:
                         fseq = int(name[len(prefix):-len(suffix)])
                     except ValueError:
@@ -1124,7 +1315,73 @@ class DurableFleet:
                             os.remove(os.path.join(self.path, name))
                         except OSError:
                             pass
+
+    @_spanned('checkpoint')
+    def checkpoint(self):
+        """Whole-fleet BASE snapshot + journal rotation, crash-safe at
+        every step: (1) everything journaled so far is fsynced, (2) the
+        snapshot lands via temp + fsync + atomic rename, (3) a fresh
+        journal generation is created, (4) the manifest atomically
+        flips to the new pair, (5) only then is the old generation
+        deleted — a crash anywhere leaves the manifest pointing at a
+        complete (snapshot chain, journal) pair. The segment chain
+        resets to this snapshot."""
+        self.journal.sync()
+        docs = self.journal.docs
+        next_doc_id = self.journal.next_doc_id
+        # drop freed/dead documents from the registry (their FREE records
+        # die with the rotated journal)
+        live = {did: state for did, state in docs.items()
+                if getattr(state, '_impl', True) is not None}
+        new_seq = self.seq + 1
+        snap_name, _n = self._write_segment(new_seq, sorted(live.items()),
+                                            (), base=True)
+        self.chain = [snap_name]
+        self._rotate_and_flip(new_seq, live, next_doc_id)
         _stats['checkpoints'] += 1
+
+    @_spanned('compact_segment')
+    def compact(self):
+        """Incremental per-doc compaction: persist ONLY the documents
+        that journaled records this generation (plus tombstones for the
+        freed) as one segment appended to the chain, then rotate the
+        journal — replay debt resets to zero at O(churn) cost. The
+        chain escalates to a full checkpoint past `max_chain` segments
+        (bounding stitch work and disk amplification). Returns True when
+        anything was persisted (incl. the escalated full checkpoint),
+        False when zero churn made it a no-op. Recovery stitches the
+        chain; byte-identical to a full-checkpoint recovery."""
+        if not self.chain or len(self.chain) >= self.max_chain:
+            # no base yet (a fleet that never checkpointed): segments
+            # without a base are invisible to the manifest-rot fallback
+            # scan, and retention would eventually delete the journals
+            # holding their records — the first compaction MUST cut the
+            # base snapshot
+            self.checkpoint()
+            return True
+        self.journal.sync()
+        docs = self.journal.docs
+        next_doc_id = self.journal.next_doc_id
+        dirty = set(self.journal.dirty)
+        freed = set(self.journal.freed)
+        live = {did: state for did, state in docs.items()
+                if getattr(state, '_impl', True) is not None}
+        # dirty docs that died without surviving to the registry (freed,
+        # or detached by rebuild/promotion) tombstone — they must not
+        # resurrect from an older segment copy
+        tombstones = freed | {did for did in dirty if did not in live}
+        doc_items = sorted((did, live[did]) for did in dirty
+                           if did in live)
+        if not doc_items and not tombstones:
+            return False                 # nothing journaled: no-op
+        new_seq = self.seq + 1
+        snap_name, n_docs = self._write_segment(new_seq, doc_items,
+                                                tombstones, base=False)
+        self.chain = self.chain + [snap_name]
+        self._rotate_and_flip(new_seq, live, next_doc_id)
+        _stats['segments'] += 1
+        _stats['segment_docs'] += n_docs
+        return True
 
     def _fault(self, point):
         """Crash-point hook: a no-op in production; tools/crashtest.py
@@ -1146,8 +1403,8 @@ class DurableFleet:
     @classmethod
     def recover(cls, path, *, exact_device=False, mirror=False,
                 fsync_bytes=0, compact_bytes=16 << 20,
-                compact_records=100_000, retain=2, doc_capacity=64,
-                key_capacity=64):
+                compact_records=100_000, retain=2, max_chain=8,
+                doc_capacity=64, key_capacity=64):
         """Rebuild a durable fleet from disk. Returns (manager, handles,
         report): handles is {doc_id: backend handle} for every recovered
         live document. Torn journal tails truncate at the first bad CRC
@@ -1163,7 +1420,8 @@ class DurableFleet:
                 path, rs, exact_device=exact_device, mirror=mirror,
                 fsync_bytes=fsync_bytes, compact_bytes=compact_bytes,
                 compact_records=compact_records, retain=retain,
-                doc_capacity=doc_capacity, key_capacity=key_capacity)
+                max_chain=max_chain, doc_capacity=doc_capacity,
+                key_capacity=key_capacity)
         finally:
             # done() is idempotent: on success the impl already closed
             # the last phase; on a raise this records it (with whatever
@@ -1172,8 +1430,8 @@ class DurableFleet:
 
     @classmethod
     def _recover_impl(cls, path, rs, *, exact_device, mirror, fsync_bytes,
-                      compact_bytes, compact_records, retain, doc_capacity,
-                      key_capacity):
+                      compact_bytes, compact_records, retain, max_chain,
+                      doc_capacity, key_capacity):
         from . import backend as fleet_backend
         from .backend import DocFleet
         from .loader import load_docs
@@ -1259,10 +1517,15 @@ class DurableFleet:
 
         # ---- journal replay: batched quarantining apply, segmented at
         # FREE records; records for a quarantined doc are skipped so the
-        # doc lands exactly on its last good prefix
+        # doc lands exactly on its last good prefix. Every record that
+        # APPLIES is collected into `rejournal` — recovery's closing
+        # persist re-frames them into the fresh journal generation
+        # instead of re-snapshotting the whole fleet (O(replayed), not
+        # O(fleet))
         rs.mark('recovery_replay', records=len(st['journal_records']))
         skip = {did for did in report.quarantined}
         pending = {}              # doc_id -> [change payloads], in order
+        rejournal = []            # (kind, did, payload) for the new gen
 
         def flush():
             if not pending:
@@ -1289,6 +1552,9 @@ class DurableFleet:
                     skip.add(did)
                     if did not in report.quarantined:
                         quarantine(did, 'replay', err.error)
+                else:
+                    rejournal.extend((KIND_CHANGE, did, payload)
+                                     for payload in pending[did])
             pending.clear()
 
         # attribute mid-stream rot: the victim keeps every record BEFORE
@@ -1315,6 +1581,7 @@ class DurableFleet:
                     handle = fleet_backend.init(fleet)
                     handles[did] = handle
                     states[did] = handle['state']
+                rejournal.append((KIND_INIT, did, b''))
             elif kind == KIND_FREE:
                 flush()
                 handle = handles.pop(did, None)
@@ -1322,6 +1589,7 @@ class DurableFleet:
                 if handle is not None:
                     fleet_backend.free_docs([handle])
                 report.freed_docs.append(did)
+                rejournal.append((KIND_FREE, did, b''))
         flush()
         # a quarantined doc still recovers — to its last good prefix
         # (possibly empty), never silently vanishing from the fleet
@@ -1371,6 +1639,14 @@ class DurableFleet:
         mgr = cls(path, fsync_bytes=fsync_bytes,
                   compact_bytes=compact_bytes,
                   compact_records=compact_records, retain=retain,
-                  _recovered=(fleet, st['manifest']['seq'],
-                              dict(states), next_doc_id))
+                  max_chain=max_chain,
+                  _recovered=(fleet, st['max_journal_seq'],
+                              dict(states), next_doc_id,
+                              st['manifest']['chain'], rejournal))
+        if not report.ok:
+            # damage found: the chain still holds the rotted frames, so
+            # a clean recovery would re-report them forever — heal with
+            # one full checkpoint (damage is rare; the O(churn) fast
+            # path stays for clean recoveries)
+            mgr.checkpoint()
         return mgr, {did: handles[did] for did in sorted(handles)}, report

@@ -30,7 +30,8 @@ rows install as links (round 4).
 import numpy as np
 
 from .. import native
-from ..columnar import decode_value, split_containers, CHUNK_TYPE_DOCUMENT
+from ..columnar import (decode_value, split_containers,
+                        CHUNK_TYPE_DOCUMENT, MAGIC_BYTES as _MAGIC)
 from .tensor_doc import CTR_LIMIT, MAX_ACTORS
 from ..observability.spans import spanned as _spanned
 
@@ -85,8 +86,18 @@ def load_docs(buffers, fleet=None):
     chunks = [None] * n_in
     if native.available():
         for i, buf in enumerate(buffers):
+            buf = bytes(buf)
+            # fast single-container probe: magic + document type byte —
+            # the native parser re-verifies framing, checksum, and
+            # trailing bytes, so a false positive only round-trips
+            # through its per-doc ok=0 fallback. The full Python
+            # container walk runs only for multi-chunk/odd inputs.
+            if len(buf) > 11 and buf[:4] == _MAGIC and \
+                    buf[8] == CHUNK_TYPE_DOCUMENT:
+                chunks[i] = buf
+                continue
             try:
-                parts = split_containers(bytes(buf))
+                parts = split_containers(buf)
             except Exception:
                 parts = []
             if len(parts) == 1 and parts[0][8] == CHUNK_TYPE_DOCUMENT:
@@ -244,40 +255,59 @@ def _install_parsed(fleet, out, native_idx, chunks, handles, fleet_backend):
     good_docs = np.flatnonzero(~bad)
     slot_of = np.full(len(ok), -1, dtype=np.int64)
     engines = {}
-    # one batched allocation for the whole load (init_docs' bookkeeping)
+    # one batched allocation for the whole load (init_docs' bookkeeping);
+    # engines come from the allocation-only bulk constructor and the GC
+    # stays paused across the loop — the per-doc constructor chain +
+    # gen-0 scans were a measurable slice of recovery's snapshot load
+    # at 10k docs (same reasoning as init_docs)
     slots = fleet.alloc_slots(len(good_docs))
-    for d, slot in zip(good_docs, slots):
-        d = int(d)
-        eng = _FlatEngine(fleet, slot)
-        slot_of[d] = eng.slot
-        # The loaded ops feed the applied-op index below
-        # (_install_map_cells), so the turbo dangling-pred check stays
-        # armed for bulk-loaded slots — the reference detects invalid op
-        # references during the merge regardless of how the doc arrived
-        # (new.js:1219-1220; closes round-5 VERDICT weak #6).
-        a0, a1 = int(out['actor_off'][d]), int(out['actor_off'][d + 1])
-        eng.actor_ids = [fleet.actors.actors[int(amap[g])]
-                         for g in out['doc_actors'][a0:a1]]
-        h0, h1 = int(out['heads_off'][d]), int(out['heads_off'][d + 1])
-        eng.heads = sorted(out['heads'][h].tobytes().hex()
-                           for h in range(h0, h1))
-        eng.max_op = int(out['max_op'][d])
-        chunk = bytes(chunks[native_idx[d]])
-        eng._install_parked_chunk(chunk, int(out['n_changes'][d]))
-        engines[d] = eng
-        fleet.metrics.docs_bulk_loaded += 1
-    # clock: per (doc, actor) max seq
-    c_doc = out['c_doc'].astype(np.int64)
-    c_actor = amap[out['c_actor']] if len(out['c_actor']) else \
-        np.zeros(0, dtype=np.int64)
-    c_seq = out['c_seq']
-    for j in range(len(c_doc)):
-        d = int(c_doc[j])
-        if d in engines:
-            hexa = fleet.actors.actors[int(c_actor[j])]
-            eng = engines[d]
-            if eng.clock.get(hexa, 0) < int(c_seq[j]):
-                eng.clock[hexa] = int(c_seq[j])
+    bulk_new = _FlatEngine._bulk_new
+    fleet_actors = fleet.actors.actors
+    heads_off = out['heads_off']
+    actor_off = out['actor_off']
+    doc_actors = out['doc_actors']
+    max_op_arr = out['max_op']
+    n_changes_arr = out['n_changes']
+    heads_hex = out['heads'].tobytes().hex() if len(out['heads']) else ''
+    from .backend import _gc_paused
+    with _gc_paused():
+        for d, slot in zip(good_docs.tolist(), slots):
+            eng = bulk_new(fleet, slot)
+            slot_of[d] = slot
+            # The loaded ops feed the applied-op index below
+            # (_install_map_cells), so the turbo dangling-pred check stays
+            # armed for bulk-loaded slots — the reference detects invalid
+            # op references during the merge regardless of how the doc
+            # arrived (new.js:1219-1220; closes round-5 VERDICT weak #6).
+            a0, a1 = int(actor_off[d]), int(actor_off[d + 1])
+            if a1 - a0 == 1:                 # the common single-actor doc
+                eng.actor_ids = [fleet_actors[int(amap[doc_actors[a0]])]]
+            else:
+                eng.actor_ids = [fleet_actors[int(amap[g])]
+                                 for g in doc_actors[a0:a1]]
+            h0, h1 = int(heads_off[d]), int(heads_off[d + 1])
+            if h1 - h0 == 1:                 # the common single-head doc
+                eng.heads = [heads_hex[64 * h0:64 * h1]]
+            else:
+                eng.heads = sorted(heads_hex[64 * h:64 * (h + 1)]
+                                   for h in range(h0, h1))
+            eng.max_op = int(max_op_arr[d])
+            chunk = bytes(chunks[native_idx[d]])
+            eng._install_parked_chunk(chunk, int(n_changes_arr[d]))
+            engines[d] = eng
+        # clock: per (doc, actor) max seq
+        c_doc = out['c_doc'].astype(np.int64)
+        c_actor = amap[out['c_actor']] if len(out['c_actor']) else \
+            np.zeros(0, dtype=np.int64)
+        c_seq = out['c_seq']
+        for d, a, s in zip(c_doc.tolist(), c_actor.tolist(),
+                           c_seq.tolist()):
+            eng = engines.get(d)
+            if eng is not None:
+                hexa = fleet_actors[a]
+                if eng.clock.get(hexa, 0) < s:
+                    eng.clock[hexa] = s
+    fleet.metrics.docs_bulk_loaded += len(engines)
     # object registries
     for j in np.flatnonzero(make_mask):
         d = int(doc[j])

@@ -1,0 +1,360 @@
+"""Delta+main storage engine: park a million cold documents per host.
+
+The fleet's in-memory footprint has two very different tenants. LIVE
+documents (the write-optimized **delta**) need device rows, causal state,
+host change logs, and journal hooks. COLD documents need none of that:
+their entire identity is one compressed document chunk plus a few dozen
+bytes of causal state — and every read those docs actually get (heads,
+clock, maxOp, change count, "are we in sync?") is answerable straight
+from the chunk header and metadata columns (LSM-OPD: compute on
+compressed data; `columnar.DocChunkView`).
+
+This module is the read-optimized **main** for those cold documents:
+
+- ``MainStore`` — a columnar arena of parked chunks. Per-doc causal
+  state lives in fleet-level arrays (heads in one byte arena + offset
+  arrays, clocks as flat (actor, seq) runs against an interned actor
+  table, maxOp/n_changes as int64 lanes), NOT per-doc Python objects —
+  the ~3.3 KB/doc of engine/handle/dict overhead a fleet-resident parked
+  doc costs (BASELINE.md host-memory accounting) collapses to the chunk
+  bytes plus ~100-200 B/doc of arrays. One host comfortably holds 1M
+  parked docs (tests/test_storage.py, slow-marked, asserts the ceiling).
+- ``StorageEngine`` — the policy layer binding a live ``DocFleet`` to a
+  ``MainStore``: ``park`` demotes cold fleet docs (canonical chunk via
+  ``save()``, round-trip-validated by the native extractor, device slots
+  freed), ``revive`` promotes them back through the bulk loader (one
+  native parse + batched dispatches, history stays parked-lazy on the
+  revived engine), and the causal-state reads route to the columnar
+  arrays without touching chunk bytes at all.
+
+Durability composition: parking a journaled doc frees it from the
+journal's registry (the standard FREE record) — its bytes now live in
+the main store; reviving through a ``DurableFleet``'s ``load_docs``
+re-journals the chunk as the doc's baseline. The incremental per-doc
+compaction that keeps checkpoint cost proportional to churn lives in
+fleet/durability.py; this module is the RAM-resident tier.
+"""
+
+import numpy as np
+
+from ..columnar import DocChunkView
+from ..errors import MalformedDocument
+from ..observability.spans import span as _span
+
+__all__ = ['MainStore', 'StorageEngine']
+
+
+class _I64:
+    """Growable int64 lane (amortized-doubling numpy array)."""
+
+    __slots__ = ('data', 'n')
+
+    def __init__(self, dtype=np.int64):
+        self.data = np.zeros(16, dtype=dtype)
+        self.n = 0
+
+    def append(self, value):
+        if self.n == len(self.data):
+            grown = np.zeros(len(self.data) * 2, dtype=self.data.dtype)
+            grown[:self.n] = self.data
+            self.data = grown
+        self.data[self.n] = value
+        self.n += 1
+
+    def extend(self, values):
+        need = self.n + len(values)
+        if need > len(self.data):
+            cap = len(self.data)
+            while cap < need:
+                cap *= 2
+            grown = np.zeros(cap, dtype=self.data.dtype)
+            grown[:self.n] = self.data
+            self.data = grown
+        self.data[self.n:need] = values
+        self.n = need
+
+    @property
+    def nbytes(self):
+        return int(self.data.nbytes)
+
+
+class MainStore:
+    """Columnar store of parked compressed document chunks.
+
+    Row ids are dense ints assigned by ``add`` and never recycled until
+    ``vacuum`` (discarded rows leave arena garbage that vacuum reclaims;
+    ``dead_fraction`` exposes the trigger signal). All causal reads are
+    O(row) array lookups — no chunk bytes are touched."""
+
+    def __init__(self):
+        self._chunks = []               # row -> bytes | None (discarded)
+        self._chunk_bytes = 0
+        self._heads_arena = bytearray()  # 32 B per head, concatenated
+        self._heads_off = _I64()
+        self._heads_n = _I64(np.int32)
+        self._clock_actor = _I64(np.int32)   # interned actor index
+        self._clock_seq = _I64()
+        self._clock_off = _I64()
+        self._clock_n = _I64(np.int32)
+        self._max_op = _I64()
+        self._n_changes = _I64()
+        self.actors = []                # interned actor hex strings
+        self._actor_index = {}
+        self._live = 0
+        self._dead_head_bytes = 0
+        self._dead_clock_rows = 0
+
+    def __len__(self):
+        return self._live
+
+    def _intern_actor(self, hexa):
+        idx = self._actor_index.get(hexa)
+        if idx is None:
+            idx = len(self.actors)
+            self.actors.append(hexa)
+            self._actor_index[hexa] = idx
+        return idx
+
+    def add(self, chunk, heads, clock, max_op, n_changes):
+        """Store one parked doc; returns its row id. `heads` are hex
+        strings, `clock` {actor_hex: seq}."""
+        row = len(self._chunks)
+        chunk = bytes(chunk)
+        self._chunks.append(chunk)
+        self._chunk_bytes += len(chunk)
+        self._heads_off.append(len(self._heads_arena))
+        self._heads_n.append(len(heads))
+        for h in sorted(heads):
+            self._heads_arena += bytes.fromhex(h)
+        self._clock_off.append(self._clock_actor.n)
+        self._clock_n.append(len(clock))
+        for hexa in sorted(clock):
+            self._clock_actor.append(self._intern_actor(hexa))
+            self._clock_seq.append(int(clock[hexa]))
+        self._max_op.append(int(max_op))
+        self._n_changes.append(int(n_changes))
+        self._live += 1
+        return row
+
+    def add_chunk(self, chunk, check=True):
+        """Store a chunk deriving its causal row compute-on-compressed
+        (DocChunkView: header heads + change-meta columns only). Raises
+        MalformedDocument on undecodable bytes."""
+        view = DocChunkView(chunk, check=check)
+        return self.add(chunk, view.heads, view.clock, view.max_op,
+                        view.n_changes)
+
+    def _check(self, row):
+        if not (0 <= row < len(self._chunks)) or self._chunks[row] is None:
+            raise KeyError(f'no parked doc at row {row}')
+
+    def chunk(self, row):
+        self._check(row)
+        return self._chunks[row]
+
+    def heads(self, row):
+        self._check(row)
+        off = int(self._heads_off.data[row])
+        n = int(self._heads_n.data[row])
+        return [self._heads_arena[off + 32 * i:off + 32 * (i + 1)].hex()
+                for i in range(n)]
+
+    def clock(self, row):
+        self._check(row)
+        off = int(self._clock_off.data[row])
+        n = int(self._clock_n.data[row])
+        return {self.actors[int(self._clock_actor.data[off + i])]:
+                int(self._clock_seq.data[off + i]) for i in range(n)}
+
+    def max_op(self, row):
+        self._check(row)
+        return int(self._max_op.data[row])
+
+    def n_changes(self, row):
+        self._check(row)
+        return int(self._n_changes.data[row])
+
+    def contains_head(self, row, hash_hex):
+        """Sync-membership probe against the columnar heads arena —
+        no chunk decode, no Python per-head strings on the hot path."""
+        self._check(row)
+        off = int(self._heads_off.data[row])
+        n = int(self._heads_n.data[row])
+        needle = bytes.fromhex(hash_hex)
+        arena = self._heads_arena
+        return any(arena[off + 32 * i:off + 32 * (i + 1)] == needle
+                   for i in range(n))
+
+    def covers_heads(self, row, their_heads):
+        """True when every hash in `their_heads` is one of row's heads —
+        the parked-doc 'already in sync' fast path."""
+        return all(self.contains_head(row, h) for h in their_heads)
+
+    def discard(self, row):
+        self._check(row)
+        chunk = self._chunks[row]
+        self._chunks[row] = None
+        self._chunk_bytes -= len(chunk)
+        self._dead_head_bytes += 32 * int(self._heads_n.data[row])
+        self._dead_clock_rows += int(self._clock_n.data[row])
+        self._live -= 1
+        return chunk
+
+    @property
+    def dead_fraction(self):
+        total = len(self._chunks)
+        return (total - self._live) / total if total else 0.0
+
+    def vacuum(self):
+        """Compact arenas and row lanes, dropping discarded rows.
+        Returns {old_row: new_row} so callers can remap their ids."""
+        remap = {}
+        fresh = MainStore()
+        fresh.actors = self.actors
+        fresh._actor_index = self._actor_index
+        for row, chunk in enumerate(self._chunks):
+            if chunk is None:
+                continue
+            remap[row] = fresh.add(chunk, self.heads(row), self.clock(row),
+                                   self.max_op(row), self.n_changes(row))
+        for name in ('_chunks', '_chunk_bytes', '_heads_arena', '_heads_off',
+                     '_heads_n', '_clock_actor', '_clock_seq', '_clock_off',
+                     '_clock_n', '_max_op', '_n_changes', '_live',
+                     '_dead_head_bytes', '_dead_clock_rows'):
+            setattr(self, name, getattr(fresh, name))
+        return remap
+
+    def memory_stats(self):
+        """Byte accounting: chunk payload vs per-doc overhead (the
+        columnar causal state + row lanes + list slots). The acceptance
+        signal is overhead_per_doc — what the HOST pays per parked doc
+        on top of its compressed bytes."""
+        lanes = (self._heads_off.nbytes + self._heads_n.nbytes +
+                 self._clock_off.nbytes + self._clock_n.nbytes +
+                 self._max_op.nbytes + self._n_changes.nbytes)
+        arenas = (len(self._heads_arena) + self._clock_actor.nbytes +
+                  self._clock_seq.nbytes)
+        # list slot (8 B pointer) + bytes-object header (~33 B) per chunk
+        obj_overhead = 8 * len(self._chunks) + 33 * self._live
+        overhead = lanes + arenas + obj_overhead
+        return {
+            'n_docs': self._live,
+            'chunk_bytes': self._chunk_bytes,
+            'causal_arena_bytes': arenas,
+            'lane_bytes': lanes,
+            'overhead_bytes': overhead,
+            'overhead_per_doc': overhead / self._live if self._live else 0.0,
+            'total_bytes': self._chunk_bytes + overhead,
+            'dead_fraction': self.dead_fraction,
+            'n_actors': len(self.actors),
+        }
+
+
+class StorageEngine:
+    """Delta (live DocFleet) + main (MainStore) with park/revive policy
+    and compute-on-compressed reads for the parked tier."""
+
+    def __init__(self, fleet=None):
+        from .backend import DocFleet
+        self.fleet = fleet if fleet is not None else DocFleet()
+        self.main = MainStore()
+
+    # -- demotion -------------------------------------------------------
+
+    def park(self, handles):
+        """Demote fleet documents into the main store: canonical chunk
+        (round-trip-validated — a doc whose history cannot reproduce
+        from its chunk stays live), causal state into the columnar
+        arrays, device slots freed in one batched call. Returns a list
+        aligned with `handles`: the doc's main-store id, or None where
+        the doc was skipped (queued changes, non-fleet, failed
+        validation). Skipped handles stay live and usable."""
+        from . import backend as fleet_backend
+        from .backend import FleetDoc, _validate_doc_chunks
+
+        ids = [None] * len(handles)
+        to_free = []
+        ready = []          # (input index, handle, state, chunk, n)
+        pending = []        # (input index, handle, state, chunk) to batch
+        with _span('storage_park', docs=len(handles)):
+            for i, handle in enumerate(handles):
+                state = handle.get('state')
+                if handle.get('frozen') or not isinstance(state, FleetDoc) \
+                        or not state.is_fleet:
+                    continue
+                impl = state._impl
+                if impl.queue:
+                    continue
+                if impl._doc_pending is not None and not impl._changes:
+                    # already parked in-fleet with no delta tail: the
+                    # chunk is the validated canonical form
+                    ready.append((i, handle, state, impl._doc_pending,
+                                  impl._parked_n))
+                else:
+                    pending.append((i, handle, state, bytes(state.save())))
+            # ONE batched validation (native pool fan-out) for every doc
+            # that needs it
+            counts = _validate_doc_chunks([c for _i, _h, _s, c in pending])
+            for (i, handle, state, chunk), n in zip(pending, counts):
+                if n is not None:
+                    ready.append((i, handle, state, chunk, n))
+            for i, handle, state, chunk, n in ready:
+                ids[i] = self.main.add(chunk, state.heads, state.clock,
+                                       state.max_op, n)
+                to_free.append(handle)
+            if to_free:
+                fleet_backend.free_docs(to_free)
+        return ids
+
+    def ingest_chunks(self, chunks, check=True):
+        """Admit saved document chunks straight into the main store —
+        no fleet slot, no engine, no decode of op columns: causal state
+        comes from the chunk itself (DocChunkView). This is the 1M-doc
+        bulk-park path. Returns main-store ids. Raises MalformedDocument
+        for undecodable bytes (the batch up to that point is kept)."""
+        with _span('storage_ingest', docs=len(chunks)):
+            return [self.main.add_chunk(c, check=check) for c in chunks]
+
+    # -- promotion ------------------------------------------------------
+
+    def revive(self, ids, durable=None):
+        """Promote parked docs back into the live fleet through the bulk
+        loader (one native parse + batched dispatches; history stays
+        lazily parked on the revived engines). `durable` is an optional
+        DurableFleet manager — revived docs journal their chunk as a
+        baseline through its load_docs. Returns backend handles in id
+        order; the rows are discarded from the main store."""
+        chunks = [self.main.chunk(r) for r in ids]
+        with _span('storage_revive', docs=len(ids)):
+            if durable is not None:
+                handles = durable.load_docs(chunks)
+            else:
+                from .loader import load_docs
+                handles = load_docs(chunks, self.fleet)
+            for r in ids:
+                self.main.discard(r)
+        return handles
+
+    # -- compute-on-compressed reads -----------------------------------
+
+    def heads(self, row):
+        return self.main.heads(row)
+
+    def clock(self, row):
+        return self.main.clock(row)
+
+    def max_op(self, row):
+        return self.main.max_op(row)
+
+    def n_changes(self, row):
+        return self.main.n_changes(row)
+
+    def needs_sync(self, row, their_heads):
+        """Parked-doc sync gate: False when the peer's heads equal ours
+        (nothing to exchange — the doc can stay parked); True otherwise
+        (revive before running a real sync round)."""
+        ours = set(self.main.heads(row))
+        return set(their_heads) != ours
+
+    def memory_stats(self):
+        return self.main.memory_stats()

@@ -1725,7 +1725,7 @@ int64_t am_ingest_changes_list(PyObject *buffers, int with_meta,
 // Monotone ABI stamp, bumped on any C-surface change. The Python wrapper
 // refuses to run against a binary whose stamp mismatches (a stale .so
 // would otherwise silently run the old single-threaded codec).
-int64_t am_abi_version() { return 1; }
+int64_t am_abi_version() { return 2; }
 
 int64_t am_pool_configure(int n) { return NativePool::inst().configure(n); }
 
@@ -3508,6 +3508,949 @@ int64_t am_build_fetch(uint8_t *out, uint64_t cap) {
   delete g_build;
   g_build = nullptr;
   return n;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Native change-list extraction: document chunk -> canonical per-change
+// chunks + SHA-256 hashes (the inverse of am_build_document; ref
+// columnar.js:1040-1047 decodeDocument). This is the delta+main engine's
+// materialize kernel: a parked document revives its change log without the
+// Python decode_document + encode_change round trip (~700us/doc ->
+// ~100-150us/doc), and recovery / bulk load feed change buffers straight
+// from parked chunks.
+//
+// Parity contract: when extraction SUCCEEDS its output is byte-identical
+// to Python's decode_document + encode_change — both normalize the same
+// way (value tags for non-set/inc actions collapse to NULL, zero-counter
+// children collapse to null, preds/deps sort canonically) and both verify
+// that the re-encoded hash frontier reproduces the header's heads. Every
+// change is an ancestor of some head, so ANY byte divergence cascades into
+// the heads check; extraction bails (caller falls back to Python, which
+// reproduces the exact typed verdict) on anything it cannot prove it
+// normalizes identically: unknown columns, unknown value types with
+// ambiguous round-trips, non-minimal LEB payloads, invalid UTF-8, link
+// ops, del rows in the ops table, null change-meta fields Python raises
+// on. Per-doc extraction is independent, so the pool fan-out is
+// byte-identical at every width by construction.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Strict UTF-8 validation matching CPython's decoder (encoding.py
+// read_prefixed_string): rejects overlong forms, surrogates, > U+10FFFF.
+// Python re-encodes decoded strings verbatim only for valid input; invalid
+// input raises typed — so the extractor bails to keep verdicts identical.
+static bool validate_utf8(const uint8_t *p, uint64_t n) {
+  uint64_t i = 0;
+  while (i < n) {
+    uint8_t b = p[i];
+    uint32_t cp;
+    uint64_t need;
+    if (b < 0x80) { cp = b; need = 1; }
+    else if ((b >> 5) == 6) { cp = b & 0x1f; need = 2; }
+    else if ((b >> 4) == 14) { cp = b & 0x0f; need = 3; }
+    else if ((b >> 3) == 30) { cp = b & 0x07; need = 4; }
+    else return false;
+    if (i + need > n) return false;
+    for (uint64_t k = 1; k < need; k++) {
+      if ((p[i + k] >> 6) != 2) return false;
+      cp = (cp << 6) | (p[i + k] & 0x3f);
+    }
+    static const uint32_t min_cp[5] = {0, 0, 0x80, 0x800, 0x10000};
+    if (cp < min_cp[need]) return false;
+    if (cp >= 0xd800 && cp <= 0xdfff) return false;
+    if (cp > 0x10ffff) return false;
+    i += need;
+  }
+  return true;
+}
+
+// RLE utf8 column -> per-row interned string ids (-1 = null), strict utf8,
+// count-bombs capped. (decode_keystr is the no-null-validation variant the
+// doc parser uses; messages and extraction keys need the strict one.)
+static bool decode_strcol_strict(const uint8_t *buf, uint64_t len,
+                                 Interner &pool, std::vector<int32_t> &out) {
+  Cursor c{buf, len};
+  while (c.pos < c.len && !c.fail) {
+    int64_t count = c.sleb();
+    if (c.fail) return false;
+    if (count > 1) {
+      if (count > kMaxColumnValues - int64_t(out.size())) return false;
+      uint64_t slen = c.uleb();
+      const uint8_t *p = c.bytes(slen);
+      if (c.fail || !validate_utf8(p, slen)) return false;
+      int32_t id = pool.intern(std::string((const char *)p, slen));
+      for (int64_t i = 0; i < count; i++) out.push_back(id);
+    } else if (count == 1) {
+      return false;              // non-canonical lone run
+    } else if (count < 0) {
+      if (-count > kMaxColumnValues - int64_t(out.size())) return false;
+      for (int64_t i = 0; i < -count; i++) {
+        uint64_t slen = c.uleb();
+        const uint8_t *p = c.bytes(slen);
+        if (c.fail || !validate_utf8(p, slen)) return false;
+        out.push_back(pool.intern(std::string((const char *)p, slen)));
+      }
+    } else {
+      uint64_t nulls = c.uleb();
+      if (c.fail || nulls > uint64_t(kMaxColumnValues - int64_t(out.size())))
+        return false;
+      for (uint64_t i = 0; i < nulls; i++) out.push_back(-1);
+    }
+  }
+  return !c.fail;
+}
+
+struct XOp {
+  int64_t ctr = 0;
+  int32_t actor = -1;             // local doc-actor index
+  int64_t obj_ctr = 0;
+  int32_t obj_actor = -1;         // -1 = root
+  int8_t key_kind = 0;            // 0 = map key, 1 = _head, 2 = elemId
+  int32_t key_str = -1;           // interned map key
+  int64_t ek_ctr = 0;
+  int32_t ek_actor = -1;
+  uint8_t insert = 0;
+  uint8_t action = 0;
+  uint32_t vtag = 0;              // normalized valLen tag (len<<4 | type)
+  uint64_t voff = 0;              // into the per-doc value arena
+  int64_t chld_ctr = 0;
+  int32_t chld_actor = -1;        // -1 = none
+  std::vector<std::pair<int64_t, int32_t>> pred;   // (ctr, local actor)
+};
+
+struct XChange {
+  int32_t actor = -1;             // local doc-actor index
+  int64_t seq = 0, max_op = 0, time = 0;
+  int32_t msg = -1;               // interned message id (-1 = null)
+  std::vector<int64_t> deps_idx;  // indexes into the doc's change list
+  const uint8_t *extra = nullptr;
+  uint64_t extra_len = 0;
+  std::vector<int32_t> ops;       // indexes into the op pool, sorted by ctr
+  uint8_t hash[32];
+};
+
+struct DocExtract {
+  uint8_t ok = 0;
+  std::vector<uint8_t> blob;      // concatenated canonical change chunks
+  std::vector<int64_t> lens;      // per-change chunk byte length
+  std::vector<uint8_t> hashes;    // 32 bytes per change
+  std::vector<int64_t> max_ops;   // per-change maxOp
+};
+
+// Encode one reconstructed change as its canonical chunk (encode_change,
+// ref columnar.js:710-739), appending to doc.blob. Returns false on shapes
+// Python's encoder would reject.
+constexpr int64_t kMaxSafeInt = (int64_t(1) << 53) - 1;
+
+static bool encode_extracted_change(
+    XChange &ch, const std::vector<XOp> &pool,
+    const std::vector<std::string> &actors, const Interner &keys,
+    const Interner &msgs, const std::vector<uint8_t> &vals,
+    const std::vector<XChange> &changes, DocExtract &doc) {
+  // per-change actor table: change actor first, others hex-sorted
+  std::vector<int32_t> tbl_of(actors.size(), -1);
+  std::vector<int32_t> referenced;
+  auto touch = [&](int32_t a) {
+    if (a >= 0 && tbl_of[size_t(a)] < 0) {
+      tbl_of[size_t(a)] = 0;        // mark; numbered below
+      referenced.push_back(a);
+    }
+  };
+  touch(ch.actor);
+  for (int32_t oi : ch.ops) {
+    const XOp &op = pool[size_t(oi)];
+    touch(op.obj_actor);
+    if (op.key_kind == 2) touch(op.ek_actor);
+    if (op.chld_actor >= 0 && op.chld_ctr != 0) touch(op.chld_actor);
+    for (auto &p : op.pred) touch(p.second);
+  }
+  std::vector<int32_t> others;
+  for (int32_t a : referenced)
+    if (a != ch.actor) others.push_back(a);
+  std::sort(others.begin(), others.end(), [&](int32_t x, int32_t y) {
+    return actors[size_t(x)] < actors[size_t(y)];
+  });
+  tbl_of[size_t(ch.actor)] = 0;
+  for (size_t i = 0; i < others.size(); i++)
+    tbl_of[size_t(others[i])] = int32_t(i + 1);
+
+  // ---- op columns (CHANGE_COLUMNS; ids ascending) ----
+  RleEnc obj_a(RleEnc::UINT), obj_c(RleEnc::UINT), key_a(RleEnc::UINT),
+      key_s(RleEnc::UTF8), act(RleEnc::UINT), vlen(RleEnc::UINT),
+      chld_a(RleEnc::UINT), pred_n(RleEnc::UINT), pred_a(RleEnc::UINT);
+  DeltaEnc key_c, chld_c, pred_c;
+  BoolEnc ins;
+  ByteBuf vraw;
+  for (int32_t oi : ch.ops) {
+    const XOp &op = pool[size_t(oi)];
+    if (op.obj_actor < 0) {
+      obj_a.null_();
+      obj_c.null_();
+    } else {
+      obj_a.value(tbl_of[size_t(op.obj_actor)]);
+      obj_c.value(op.obj_ctr);
+    }
+    if (op.key_kind == 0) {
+      // empty map keys fail Python's falsy key check — stay identical
+      if (op.key_str < 0 || keys.items[size_t(op.key_str)].empty())
+        return false;
+      key_a.null_();
+      key_c.null_();
+      key_s.str(keys.items[size_t(op.key_str)]);
+    } else if (op.key_kind == 1) {
+      if (!op.insert) return false;   // _head on a non-insert: Python raises
+      key_a.null_();
+      key_c.value(0);
+      key_s.null_();
+    } else {
+      if (op.ek_actor < 0 || op.ek_ctr <= 0) return false;
+      key_a.value(tbl_of[size_t(op.ek_actor)]);
+      key_c.value(op.ek_ctr);
+      key_s.null_();
+    }
+    ins.value(bool(op.insert));
+    act.value(op.action);
+    // value: set/inc keep their (normalized) tag + raw bytes; all other
+    // actions encode NULL (encode_value_to_columns' action gate)
+    if ((op.action == 1 || op.action == 5) && op.vtag != 0) {
+      uint32_t ln = op.vtag >> 4;
+      uint8_t vt = uint8_t(op.vtag & 0xf);
+      if (vt == 1 || vt == 2) {
+        vlen.value(int64_t(vt));      // FALSE/TRUE carry no payload
+      } else {
+        vlen.value(int64_t(op.vtag));
+        if (ln) vraw.raw(vals.data() + op.voff, ln);
+      }
+    } else {
+      vlen.value(0);                  // NULL
+    }
+    if (op.chld_actor >= 0 && op.chld_ctr != 0) {
+      chld_a.value(tbl_of[size_t(op.chld_actor)]);
+      chld_c.value(op.chld_ctr);
+    } else {
+      chld_a.null_();
+      chld_c.null_();
+    }
+    // preds sorted by (ctr, actor hex) — ParsedOpId.sort_key
+    std::vector<std::pair<int64_t, int32_t>> pred = op.pred;
+    std::sort(pred.begin(), pred.end(),
+              [&](const std::pair<int64_t, int32_t> &x,
+                  const std::pair<int64_t, int32_t> &y) {
+                if (x.first != y.first) return x.first < y.first;
+                return actors[size_t(x.second)] < actors[size_t(y.second)];
+              });
+    for (size_t i = 1; i < pred.size(); i++)
+      if (pred[i - 1].first == pred[i].first &&
+          pred[i - 1].second == pred[i].second)
+        return false;                 // duplicate pred: decode would raise
+    pred_n.value(int64_t(pred.size()));
+    for (auto &p : pred) {
+      pred_a.value(tbl_of[size_t(p.second)]);
+      pred_c.value(p.first);
+    }
+  }
+  for (RleEnc *e : {&obj_a, &obj_c, &key_a, &key_s, &act, &vlen, &chld_a,
+                    &pred_n, &pred_a})
+    e->finish();
+  for (DeltaEnc *e : {&key_c, &chld_c, &pred_c}) e->finish();
+  ins.finish();
+
+  // ---- body (encode_change layout) ----
+  ByteBuf body;
+  {
+    // deps: resolved hashes, sorted bytewise (== hex sort)
+    std::vector<const uint8_t *> deps;
+    for (int64_t di : ch.deps_idx) deps.push_back(changes[size_t(di)].hash);
+    std::sort(deps.begin(), deps.end(),
+              [](const uint8_t *a, const uint8_t *b) {
+                return memcmp(a, b, 32) < 0;
+              });
+    body.uleb(deps.size());
+    for (const uint8_t *d : deps) body.raw(d, 32);
+  }
+  const std::string &ahex = actors[size_t(ch.actor)];
+  auto hex_bytes = [&](const std::string &h) {
+    body.uleb(h.size() / 2);
+    for (size_t i = 0; i + 1 < h.size(); i += 2) {
+      auto nib = [](char c) -> uint8_t {
+        return c <= '9' ? uint8_t(c - '0') : uint8_t(c - 'a' + 10);
+      };
+      body.u8(uint8_t(nib(h[i]) << 4 | nib(h[i + 1])));
+    }
+  };
+  hex_bytes(ahex);
+  // Python's append_uint53/append_int53 bound every header field
+  if (ch.seq <= 0 || ch.seq > kMaxSafeInt) return false;
+  body.uleb(uint64_t(ch.seq));
+  int64_t start_op = ch.max_op - int64_t(ch.ops.size()) + 1;
+  if (start_op < 0 || start_op > kMaxSafeInt) return false;
+  body.uleb(uint64_t(start_op));
+  if (ch.time < -kMaxSafeInt || ch.time > kMaxSafeInt) return false;
+  body.sleb(ch.time);
+  if (ch.msg < 0) {
+    body.uleb(0);
+  } else {
+    const std::string &m = msgs.items[size_t(ch.msg)];
+    body.uleb(m.size());
+    body.raw((const uint8_t *)m.data(), m.size());
+  }
+  body.uleb(others.size());
+  for (int32_t a : others) hex_bytes(actors[size_t(a)]);
+  using Col = std::pair<uint32_t, std::vector<uint8_t> *>;
+  std::vector<Col> cols = {
+      {kColObjActor, &obj_a.out.b}, {kColObjCtr, &obj_c.out.b},
+      {kColKeyActor, &key_a.out.b}, {kColKeyCtr, &key_c.rle.out.b},
+      {kColKeyStr, &key_s.out.b},   {kColInsert, &ins.out.b},
+      {kColAction, &act.out.b},     {kColValLen, &vlen.out.b},
+      {kColValRaw, &vraw.b},        {kColChldActor, &chld_a.out.b},
+      {kColChldCtr, &chld_c.rle.out.b}, {kColPredNum, &pred_n.out.b},
+      {kColPredActor, &pred_a.out.b},   {kColPredCtr, &pred_c.rle.out.b}};
+  std::sort(cols.begin(), cols.end(),
+            [](const Col &a, const Col &b) { return a.first < b.first; });
+  uint64_t n_cols = 0;
+  for (auto &c : cols)
+    if (!c.second->empty()) n_cols++;
+  body.uleb(n_cols);
+  for (auto &c : cols) {
+    if (c.second->empty()) continue;
+    body.uleb(c.first);
+    body.uleb(c.second->size());
+  }
+  for (auto &c : cols)
+    if (!c.second->empty()) body.raw(c.second->data(), c.second->size());
+  if (ch.extra_len) body.raw(ch.extra, ch.extra_len);
+
+  // ---- container + hash (+ canonical DEFLATE past 256 bytes) ----
+  ByteBuf framed;
+  framed.u8(1);
+  framed.uleb(body.b.size());
+  framed.raw(body.b.data(), body.b.size());
+  uint8_t digest[32];
+  {
+    Sha256Stream s;
+    sha256_stream_init(s);
+    sha256_stream_update(s, framed.b.data(), framed.b.size());
+    sha256_stream_final(s, digest);
+  }
+  const uint8_t magic[4] = {0x85, 0x6f, 0x4a, 0x83};
+  size_t chunk_start = doc.blob.size();
+  if (8 + framed.b.size() >= 256) {
+    // deflate_change: magic + checksum of the UNCOMPRESSED form, type 2,
+    // LEB compressed length, raw-DEFLATE body (level 6, matching Python)
+    z_stream zs;
+    memset(&zs, 0, sizeof(zs));
+    if (deflateInit2(&zs, 6, Z_DEFLATED, -15, 8, Z_DEFAULT_STRATEGY) != Z_OK)
+      return false;
+    std::vector<uint8_t> comp(deflateBound(&zs, uInt(body.b.size())));
+    zs.next_in = body.b.data();
+    zs.avail_in = uInt(body.b.size());
+    zs.next_out = comp.data();
+    zs.avail_out = uInt(comp.size());
+    if (deflate(&zs, Z_FINISH) != Z_STREAM_END) {
+      deflateEnd(&zs);
+      return false;
+    }
+    comp.resize(comp.size() - zs.avail_out);
+    deflateEnd(&zs);
+    doc.blob.insert(doc.blob.end(), magic, magic + 4);
+    doc.blob.insert(doc.blob.end(), digest, digest + 4);
+    ByteBuf dh;
+    dh.u8(2);
+    dh.uleb(comp.size());
+    doc.blob.insert(doc.blob.end(), dh.b.begin(), dh.b.end());
+    doc.blob.insert(doc.blob.end(), comp.begin(), comp.end());
+  } else {
+    doc.blob.insert(doc.blob.end(), magic, magic + 4);
+    doc.blob.insert(doc.blob.end(), digest, digest + 4);
+    doc.blob.insert(doc.blob.end(), framed.b.begin(), framed.b.end());
+  }
+  doc.lens.push_back(int64_t(doc.blob.size() - chunk_start));
+  doc.hashes.insert(doc.hashes.end(), digest, digest + 32);
+  doc.max_ops.push_back(ch.max_op);
+  memcpy(ch.hash, digest, 32);
+  return true;
+}
+
+// Extract one document chunk into per-change canonical chunks; returns
+// false (doc.ok stays 0, partial output discarded by the caller using a
+// fresh DocExtract) when the doc needs the Python path.
+static bool extract_document_body(const uint8_t *chunk, uint64_t chunk_len,
+                                  DocExtract &doc) {
+  Cursor c{chunk, chunk_len};
+  const uint8_t *magic = c.bytes(4);
+  if (c.fail || memcmp(magic, "\x85\x6f\x4a\x83", 4) != 0) return false;
+  const uint8_t *checksum = c.bytes(4);
+  uint64_t hash_start = c.pos;
+  if (c.fail || c.pos >= chunk_len) return false;
+  uint8_t chunk_type = chunk[c.pos];
+  c.skip(1);
+  uint64_t body_len = c.uleb();
+  if (c.fail || chunk_type != 0) return false;
+  const uint8_t *body = c.bytes(body_len);
+  if (c.fail || c.pos != chunk_len) return false;
+  {
+    uint8_t digest[32];
+    Sha256Stream s;
+    sha256_stream_init(s);
+    sha256_stream_update(s, chunk + hash_start, c.pos - hash_start);
+    sha256_stream_final(s, digest);
+    if (memcmp(digest, checksum, 4) != 0) return false;
+  }
+
+  Cursor b{body, body_len};
+  uint64_t n_actors = b.uleb();
+  if (b.fail || n_actors > (1u << 20)) return false;
+  std::vector<std::string> actors;
+  for (uint64_t i = 0; i < n_actors; i++) {
+    uint64_t alen = b.uleb();
+    const uint8_t *raw = b.bytes(alen);
+    if (b.fail) return false;
+    actors.push_back(to_hex(raw, alen));
+  }
+  uint64_t n_heads = b.uleb();
+  if (b.fail || n_heads > (1u << 20)) return false;
+  std::vector<const uint8_t *> heads;
+  for (uint64_t i = 0; i < n_heads; i++) {
+    const uint8_t *h = b.bytes(32);
+    if (b.fail) return false;
+    heads.push_back(h);
+  }
+  auto read_col_info = [&](std::vector<DocColumn> &cols) -> bool {
+    uint64_t n = b.uleb();
+    if (b.fail || n > 4096) return false;
+    uint32_t last_id = 0;
+    bool first = true;
+    for (uint64_t i = 0; i < n; i++) {
+      DocColumn col;
+      col.id = uint32_t(b.uleb());
+      col.len = b.uleb();
+      if (b.fail) return false;
+      uint32_t bare = col.id & ~uint32_t(kDeflateBit);
+      if (!first && bare <= (last_id & ~uint32_t(kDeflateBit))) return false;
+      last_id = col.id;
+      first = false;
+      cols.push_back(col);
+    }
+    return true;
+  };
+  std::vector<DocColumn> ccols, ocols;
+  if (!read_col_info(ccols) || !read_col_info(ocols)) return false;
+  for (auto *cols : {&ccols, &ocols}) {
+    for (auto &col : *cols) {
+      col.buf = b.bytes(col.len);
+      if (b.fail) return false;
+      if (col.id & kDeflateBit) {
+        if (!inflate_vec(col.buf, col.len, col.inflated)) return false;
+        col.id &= ~uint32_t(kDeflateBit);
+        col.buf = col.inflated.data();
+        col.len = col.inflated.size();
+      }
+    }
+  }
+  // optional headsIndexes + doc-level extraBytes (both ignored by the
+  // Python decode path too)
+  if (b.pos < b.len) {
+    for (uint64_t i = 0; i < n_heads; i++) b.uleb();
+    if (b.fail) return false;
+  }
+
+  auto find = [](std::vector<DocColumn> &cols, uint32_t id) -> DocColumn * {
+    for (auto &col : cols) if (col.id == id) return &col;
+    return nullptr;
+  };
+
+  // ---- change metadata columns ----
+  for (auto &col : ccols) {
+    switch (col.id) {
+      case kDocActor: case kDocSeq: case kDocMaxOp: case kDocTime:
+      case kDocMessage: case kDocDepsNum: case kDocDepsIndex:
+      case kDocExtraLen: case kDocExtraRaw:
+        break;
+      default:
+        return false;           // unknown change-meta column: Python path
+    }
+  }
+  auto dec = [&](std::vector<DocColumn> &cols, uint32_t id, bool sgn,
+                 bool delta, std::vector<int64_t> &v,
+                 std::vector<uint8_t> &m) {
+    DocColumn *col = find(cols, id);
+    if (!col) { v.clear(); m.clear(); return true; }
+    return decode_i64_col(col->buf, col->len, sgn, delta, v, m);
+  };
+  std::vector<int64_t> cm_actor, cm_seq, cm_maxop, cm_time, cm_depsn,
+      cm_depsi, cm_extral;
+  std::vector<uint8_t> cm_actor_m, cm_seq_m, cm_maxop_m, cm_time_m,
+      cm_depsn_m, cm_depsi_m, cm_extral_m;
+  if (!dec(ccols, kDocActor, false, false, cm_actor, cm_actor_m) ||
+      !dec(ccols, kDocSeq, false, true, cm_seq, cm_seq_m) ||
+      !dec(ccols, kDocMaxOp, false, true, cm_maxop, cm_maxop_m) ||
+      !dec(ccols, kDocTime, false, true, cm_time, cm_time_m) ||
+      !dec(ccols, kDocDepsNum, false, false, cm_depsn, cm_depsn_m) ||
+      !dec(ccols, kDocDepsIndex, false, true, cm_depsi, cm_depsi_m) ||
+      !dec(ccols, kDocExtraLen, false, false, cm_extral, cm_extral_m))
+    return false;
+  size_t n_changes = cm_actor.size();
+  if (cm_seq.size() != n_changes || cm_maxop.size() != n_changes)
+    return false;
+  Interner msgs;
+  std::vector<int32_t> cm_msg;
+  {
+    DocColumn *col = find(ccols, kDocMessage);
+    if (col) {
+      if (!decode_strcol_strict(col->buf, col->len, msgs, cm_msg))
+        return false;
+      if (cm_msg.size() != n_changes) return false;
+    } else {
+      cm_msg.assign(n_changes, -1);
+    }
+  }
+  auto padn = [&](std::vector<int64_t> &v, std::vector<uint8_t> &m,
+                  size_t n) {
+    if (v.empty()) { v.assign(n, 0); m.assign(n, 0); }
+    return v.size() == n;
+  };
+  if (!padn(cm_time, cm_time_m, n_changes) ||
+      !padn(cm_depsn, cm_depsn_m, n_changes) ||
+      !padn(cm_extral, cm_extral_m, n_changes))
+    return false;
+  uint64_t deps_total = 0;
+  for (size_t i = 0; i < n_changes; i++)
+    deps_total += cm_depsn_m[i] ? uint64_t(cm_depsn[i]) : 0;
+  if (cm_depsi.size() != deps_total) return false;
+  DocColumn *xraw = find(ccols, kDocExtraRaw);
+  const uint8_t *extra_buf = xraw ? xraw->buf : nullptr;
+  uint64_t extra_len_total = xraw ? xraw->len : 0;
+
+  std::vector<XChange> changes(n_changes);
+  {
+    uint64_t dpos = 0, xpos = 0;
+    for (size_t i = 0; i < n_changes; i++) {
+      XChange &ch = changes[i];
+      // null actor/seq/maxOp/time -> Python raises in re-encode: bail
+      if (!cm_actor_m[i] || !cm_seq_m[i] || !cm_maxop_m[i] || !cm_time_m[i])
+        return false;
+      if (cm_actor[i] < 0 || uint64_t(cm_actor[i]) >= actors.size())
+        return false;
+      ch.actor = int32_t(cm_actor[i]);
+      ch.seq = cm_seq[i];
+      ch.max_op = cm_maxop[i];
+      ch.time = cm_time[i];
+      ch.msg = cm_msg[i];
+      uint64_t nd = cm_depsn_m[i] ? uint64_t(cm_depsn[i]) : 0;
+      for (uint64_t k = 0; k < nd; k++, dpos++) {
+        if (!cm_depsi_m[dpos]) return false;
+        int64_t di = cm_depsi[dpos];
+        if (di < 0 || uint64_t(di) >= i) return false;  // forward dep: bail
+        ch.deps_idx.push_back(di);
+      }
+      // extraLen must be a BYTES tag (decode_document_changes' check)
+      if (!cm_extral_m[i]) return false;
+      uint64_t tag = uint64_t(cm_extral[i]);
+      if ((tag & 0xf) != 7) return false;
+      uint64_t xlen = tag >> 4;
+      if (xpos + xlen > extra_len_total) return false;
+      ch.extra = extra_buf + xpos;
+      ch.extra_len = xlen;
+      xpos += xlen;
+    }
+    if (dpos != deps_total || xpos != extra_len_total) return false;
+  }
+
+  // ---- ops columns ----
+  for (auto &col : ocols) {
+    switch (col.id) {
+      case kColObjActor: case kColObjCtr: case kColKeyActor: case kColKeyCtr:
+      case kColKeyStr: case kColIdActor: case kColIdCtr: case kColInsert:
+      case kColAction: case kColValLen: case kColValRaw:
+      case kColChldActor: case kColChldCtr:
+      case kColSuccNum: case kColSuccActor: case kColSuccCtr:
+        break;
+      default:
+        return false;           // unknown ops column: Python path
+    }
+  }
+  std::vector<int64_t> obj_a, obj_c, key_a, key_c, id_a, id_c, act_v, vlen_v,
+      chld_a, chld_c, succ_n, succ_a, succ_c;
+  std::vector<uint8_t> obj_am, obj_cm, key_am, key_cm, id_am, id_cm, act_m,
+      vlen_m, chld_am, chld_cm, succ_nm, succ_am, succ_cm;
+  if (!dec(ocols, kColObjActor, false, false, obj_a, obj_am) ||
+      !dec(ocols, kColObjCtr, false, false, obj_c, obj_cm) ||
+      !dec(ocols, kColKeyActor, false, false, key_a, key_am) ||
+      !dec(ocols, kColKeyCtr, false, true, key_c, key_cm) ||
+      !dec(ocols, kColIdActor, false, false, id_a, id_am) ||
+      !dec(ocols, kColIdCtr, false, true, id_c, id_cm) ||
+      !dec(ocols, kColAction, false, false, act_v, act_m) ||
+      !dec(ocols, kColValLen, false, false, vlen_v, vlen_m) ||
+      !dec(ocols, kColChldActor, false, false, chld_a, chld_am) ||
+      !dec(ocols, kColChldCtr, false, true, chld_c, chld_cm) ||
+      !dec(ocols, kColSuccNum, false, false, succ_n, succ_nm) ||
+      !dec(ocols, kColSuccActor, false, false, succ_a, succ_am) ||
+      !dec(ocols, kColSuccCtr, false, true, succ_c, succ_cm))
+    return false;
+  size_t n_ops = id_c.size();
+  if (id_a.size() != n_ops || act_v.size() != n_ops) return false;
+  std::vector<int64_t> ins_v(n_ops);
+  std::vector<uint8_t> ins_m(n_ops);
+  {
+    DocColumn *col = find(ocols, kColInsert);
+    if (col) {
+      if (am_decode_boolean(col->buf, col->len, ins_v.data(), ins_m.data(),
+                            int64_t(n_ops)) != int64_t(n_ops))
+        return false;
+    } else if (n_ops) {
+      return false;
+    }
+  }
+  Interner keys;
+  std::vector<int32_t> key_str;
+  {
+    DocColumn *col = find(ocols, kColKeyStr);
+    if (col) {
+      if (!decode_strcol_strict(col->buf, col->len, keys, key_str))
+        return false;
+      if (key_str.size() != n_ops) return false;
+    } else {
+      key_str.assign(n_ops, -1);
+    }
+  }
+  if (!padn(obj_a, obj_am, n_ops) || !padn(obj_c, obj_cm, n_ops) ||
+      !padn(key_a, key_am, n_ops) || !padn(key_c, key_cm, n_ops) ||
+      !padn(vlen_v, vlen_m, n_ops) || !padn(chld_a, chld_am, n_ops) ||
+      !padn(chld_c, chld_cm, n_ops) || !padn(succ_n, succ_nm, n_ops))
+    return false;
+  uint64_t succ_total = 0;
+  for (size_t i = 0; i < n_ops; i++)
+    succ_total += succ_nm[i] ? uint64_t(succ_n[i]) : 0;
+  if (succ_a.size() != succ_total || succ_c.size() != succ_total)
+    return false;
+  DocColumn *vraw_col = find(ocols, kColValRaw);
+  const uint8_t *raw_buf = vraw_col ? vraw_col->buf : nullptr;
+  uint64_t raw_len = vraw_col ? vraw_col->len : 0;
+
+  // ---- reconstruct ops; redistribute into changes (group_change_ops) ----
+  // changes_by_actor: Python enforces seq == count+1 in column order and
+  // maxOp monotonic per actor
+  std::unordered_map<int32_t, std::vector<int32_t>> by_actor;
+  for (size_t i = 0; i < n_changes; i++) {
+    auto &list = by_actor[changes[i].actor];
+    if (changes[i].seq != int64_t(list.size()) + 1) return false;
+    if (!list.empty() &&
+        changes[size_t(list.back())].max_op > changes[i].max_op)
+      return false;
+    list.push_back(int32_t(i));
+  }
+
+  std::vector<uint8_t> vals;          // raw value bytes arena
+  std::vector<XOp> pool;
+  pool.reserve(n_ops);
+  // (ctr << 20 | actor) -> pool index; actors bounded above by 2^20
+  std::unordered_map<int64_t, int32_t> by_id;
+  auto idkey = [](int64_t ctr, int32_t actor) -> int64_t {
+    return (ctr << 20) | int64_t(uint32_t(actor));
+  };
+  if (actors.size() > (1u << 20)) return false;
+  uint64_t raw_pos = 0, succ_pos = 0;
+  for (size_t i = 0; i < n_ops; i++) {
+    if (!id_am[i] || !id_cm[i] || !act_m[i]) return false;
+    int64_t action = act_v[i];
+    // del rows never appear in documents; link (7) and unknown numeric
+    // actions take the Python path
+    if (action < 0 || action > 6 || action == 3) return false;
+    if (uint64_t(id_a[i]) >= actors.size()) return false;
+    if (id_c[i] <= 0 || id_c[i] >= (int64_t(1) << 40)) return false;
+    XOp op;
+    op.ctr = id_c[i];
+    op.actor = int32_t(id_a[i]);
+    op.action = uint8_t(action);
+    op.insert = uint8_t(ins_m[i] ? ins_v[i] : 0);
+    if (obj_am[i] != obj_cm[i]) return false;
+    if (obj_am[i]) {
+      if (uint64_t(obj_a[i]) >= actors.size()) return false;
+      op.obj_actor = int32_t(obj_a[i]);
+      op.obj_ctr = obj_c[i];
+    }
+    if (key_str[i] >= 0) {
+      if (key_am[i] || key_cm[i]) return false;
+      op.key_kind = 0;
+      op.key_str = key_str[i];
+    } else if (key_cm[i] && key_c[i] == 0 && !key_am[i]) {
+      op.key_kind = 1;
+    } else if (key_cm[i] && key_am[i]) {
+      if (uint64_t(key_a[i]) >= actors.size()) return false;
+      op.key_kind = 2;
+      op.ek_ctr = key_c[i];
+      op.ek_actor = int32_t(key_a[i]);
+    } else {
+      return false;
+    }
+    if (chld_am[i] != chld_cm[i]) return false;
+    if (chld_am[i]) {
+      if (uint64_t(chld_a[i]) >= actors.size()) return false;
+      op.chld_actor = int32_t(chld_a[i]);
+      op.chld_ctr = chld_c[i];
+    }
+    // value: normalize exactly as Python's decode+re-encode round trip
+    if (vlen_m[i]) {
+      uint64_t tag = uint64_t(vlen_v[i]);
+      uint8_t vt = uint8_t(tag & 0xf);
+      uint32_t ln = uint32_t(tag >> 4);
+      if (raw_pos + ln > raw_len) return false;
+      const uint8_t *vp = raw_buf + raw_pos;
+      if (ln == 0 && (vt == 0 || vt == 1 || vt == 2)) {
+        op.vtag = vt;                 // NULL / FALSE / TRUE, no payload
+      } else if (vt == 0 || vt == 1 || vt == 2) {
+        // a NULL/FALSE/TRUE tag with payload bytes decodes to a raw-bytes
+        // value in Python (decode_value's fallthrough) and re-encodes as
+        // BYTES — normalize the same way
+        op.vtag = (ln << 4) | 7u;
+        op.voff = vals.size();
+        vals.insert(vals.end(), vp, vp + ln);
+      } else if (vt == 3 || vt == 4 || vt == 8 || vt == 9) {
+        // minimal-LEB + int53-range check: Python's read/append round
+        // trip must reproduce the bytes or raise
+        uint64_t p = 0;
+        int err = 0;
+        int64_t v;
+        if (vt == 3) {
+          uint64_t uv = read_uleb(vp, ln, &p, &err);
+          if (uv > uint64_t(kMaxSafeInt)) return false;
+          v = int64_t(uv);
+        } else {
+          v = read_sleb(vp, ln, &p, &err);
+          if (v < -kMaxSafeInt || v > kMaxSafeInt) return false;
+        }
+        if (err || p != ln) return false;
+        // reject non-minimal encodings (Python would shrink them)
+        if (ln > 1) {
+          uint8_t last = vp[ln - 1];
+          if (vt == 3 && last == 0) return false;
+          if (vt != 3) {
+            uint8_t prev_top = vp[ln - 2] & 0x40;
+            if ((last == 0x00 && !prev_top) || (last == 0x7f && prev_top))
+              return false;
+          }
+        }
+        (void)v;
+        op.vtag = uint32_t(tag);
+        op.voff = vals.size();
+        vals.insert(vals.end(), vp, vp + ln);
+      } else if (vt == 5) {
+        if (ln != 8) return false;    // Python: invalid float length
+        op.vtag = uint32_t(tag);
+        op.voff = vals.size();
+        vals.insert(vals.end(), vp, vp + ln);
+      } else if (vt == 6) {
+        if (!validate_utf8(vp, ln)) return false;
+        op.vtag = uint32_t(tag);
+        op.voff = vals.size();
+        vals.insert(vals.end(), vp, vp + ln);
+      } else {
+        // BYTES (7) and unknown tags 10-15 round-trip verbatim
+        op.vtag = uint32_t(tag);
+        op.voff = vals.size();
+        vals.insert(vals.end(), vp, vp + ln);
+      }
+      raw_pos += ln;
+    }
+    int32_t pool_idx;
+    auto it = by_id.find(idkey(op.ctr, op.actor));
+    if (it != by_id.end()) {
+      XOp &ph = pool[size_t(it->second)];
+      // only a synthesized del placeholder (action 3; real del rows bail
+      // above) may be superseded — a second real op with the same id is
+      // a duplicate the Python path would also reject downstream
+      if (ph.action != 3) return false;
+      // placeholder created by an earlier succ ref: adopt its preds
+      op.pred = std::move(ph.pred);
+      ph = op;
+      pool_idx = it->second;
+    } else {
+      pool.push_back(std::move(op));
+      pool_idx = int32_t(pool.size() - 1);
+      by_id.emplace(idkey(pool[size_t(pool_idx)].ctr,
+                          pool[size_t(pool_idx)].actor),
+                    pool_idx);
+    }
+    // succ entries: strictly ascending by (ctr, actor hex)
+    uint64_t ns = succ_nm[i] ? uint64_t(succ_n[i]) : 0;
+    int64_t prev_ctr = -1;
+    int32_t prev_actor = -1;
+    for (uint64_t k = 0; k < ns; k++, succ_pos++) {
+      if (!succ_am[succ_pos] || !succ_cm[succ_pos]) return false;
+      if (uint64_t(succ_a[succ_pos]) >= actors.size()) return false;
+      int64_t sc = succ_c[succ_pos];
+      int32_t sa = int32_t(succ_a[succ_pos]);
+      if (prev_ctr >= 0) {
+        if (sc < prev_ctr ||
+            (sc == prev_ctr &&
+             actors[size_t(sa)] <= actors[size_t(prev_actor)]))
+          return false;               // Python: ids not ascending
+      }
+      prev_ctr = sc;
+      prev_actor = sa;
+      if (sc <= 0 || sc >= (int64_t(1) << 40)) return false;
+      auto sit = by_id.find(idkey(sc, sa));
+      int32_t succ_idx;
+      if (sit == by_id.end()) {
+        // synthesize a del op (group_change_ops, columnar.js:876-943)
+        const XOp &self = pool[size_t(pool_idx)];
+        XOp del;
+        del.ctr = sc;
+        del.actor = sa;
+        del.action = 3;
+        del.obj_ctr = self.obj_ctr;
+        del.obj_actor = self.obj_actor;
+        if (self.key_kind == 0) {
+          del.key_kind = 0;
+          del.key_str = self.key_str;
+        } else {
+          del.key_kind = 2;
+          if (self.insert) {
+            del.ek_ctr = self.ctr;
+            del.ek_actor = self.actor;
+          } else if (self.key_kind == 2) {
+            del.ek_ctr = self.ek_ctr;
+            del.ek_actor = self.ek_actor;
+          } else {
+            return false;   // _head referent on a non-insert op
+          }
+        }
+        pool.push_back(std::move(del));
+        succ_idx = int32_t(pool.size() - 1);
+        by_id.emplace(idkey(sc, sa), succ_idx);
+      } else {
+        succ_idx = sit->second;
+      }
+      pool[size_t(succ_idx)].pred.emplace_back(
+          pool[size_t(pool_idx)].ctr, pool[size_t(pool_idx)].actor);
+    }
+  }
+  if (raw_pos != raw_len || succ_pos != succ_total) return false;
+
+  // assign every op (incl. synthesized dels) to its change by binary
+  // search over the actor's maxOp sequence
+  for (size_t pi = 0; pi < pool.size(); pi++) {
+    const XOp &op = pool[pi];
+    auto ait = by_actor.find(op.actor);
+    if (ait == by_actor.end()) return false;
+    std::vector<int32_t> &list = ait->second;
+    size_t lo = 0, hi = list.size();
+    while (lo < hi) {
+      size_t mid = (lo + hi) / 2;
+      if (changes[size_t(list[mid])].max_op < op.ctr) lo = mid + 1;
+      else hi = mid;
+    }
+    if (lo >= list.size()) return false;   // opId outside allowed range
+    changes[size_t(list[lo])].ops.push_back(int32_t(pi));
+  }
+  for (XChange &ch : changes) {
+    std::sort(ch.ops.begin(), ch.ops.end(), [&](int32_t x, int32_t y) {
+      return pool[size_t(x)].ctr < pool[size_t(y)].ctr;
+    });
+    int64_t start_op = ch.max_op - int64_t(ch.ops.size()) + 1;
+    for (size_t k = 0; k < ch.ops.size(); k++)
+      if (pool[size_t(ch.ops[k])].ctr != start_op + int64_t(k))
+        return false;                 // non-contiguous opIds in a change
+  }
+
+  // ---- encode canonically, in document order; verify heads ----
+  std::vector<uint8_t> is_head(n_changes, 1);
+  for (size_t i = 0; i < n_changes; i++) {
+    for (int64_t di : changes[i].deps_idx) is_head[size_t(di)] = 0;
+    if (!encode_extracted_change(changes[i], pool, actors, keys, msgs, vals,
+                                 changes, doc))
+      return false;
+  }
+  std::vector<std::string> got_heads, want_heads;
+  for (size_t i = 0; i < n_changes; i++)
+    if (is_head[i])
+      got_heads.emplace_back((const char *)changes[i].hash, 32);
+  for (const uint8_t *h : heads)
+    want_heads.emplace_back((const char *)h, 32);
+  std::sort(got_heads.begin(), got_heads.end());
+  std::sort(want_heads.begin(), want_heads.end());
+  if (got_heads != want_heads) return false;
+  doc.ok = 1;
+  return true;
+}
+
+static std::vector<DocExtract> *g_extract = nullptr;
+
+}  // namespace
+
+extern "C" {
+
+// Extract a batch of document chunks into canonical per-change chunks +
+// hashes. Returns the total change count across extracted docs, or -1 on
+// allocation-level failure. Per-doc failures set ok=0 (caller falls back
+// per doc). Docs are independent, so the batch fans over the native pool
+// with byte-identical output at every width.
+int64_t am_extract_changes(const uint8_t *blob, const uint64_t *offsets,
+                           const uint64_t *lens, uint64_t n_docs) {
+  delete g_extract;
+  g_extract = new std::vector<DocExtract>(n_docs);
+  std::vector<DocExtract> &docs = *g_extract;
+  int threads = NativePool::inst().threads();
+  auto one = [&](int t, int) {
+    DocExtract &d = docs[size_t(t)];
+    if (!extract_document_body(blob + offsets[t], lens[t], d)) {
+      DocExtract fresh;
+      d = std::move(fresh);           // discard partial output
+    }
+  };
+  if (threads > 1 && n_docs >= 2) {
+    NativePool::inst().run(int(n_docs), one);
+  } else {
+    for (uint64_t i = 0; i < n_docs; i++) one(int(i), 0);
+  }
+  int64_t total = 0;
+  for (auto &d : docs) total += int64_t(d.lens.size());
+  return total;
+}
+
+// Sizes for fetch-buffer allocation. Returns 0, or -1 with no context.
+int64_t am_extract_sizes(int64_t *total_changes, int64_t *blob_bytes) {
+  if (!g_extract) return -1;
+  int64_t tc = 0, tb = 0;
+  for (auto &d : *g_extract) {
+    tc += int64_t(d.lens.size());
+    tb += int64_t(d.blob.size());
+  }
+  *total_changes = tc;
+  *blob_bytes = tb;
+  return 0;
+}
+
+// Copy out: ok [n_docs], d_off [n_docs+1] (per-doc first change index),
+// c_off [C+1] (per-change byte offsets into blob), blob, hashes [32*C],
+// max_ops [C]. Returns C and frees the context.
+int64_t am_extract_fetch(uint8_t *ok, int64_t *d_off, int64_t *c_off,
+                         uint8_t *blob, uint8_t *hashes, int64_t *max_ops) {
+  if (!g_extract) return -1;
+  std::vector<DocExtract> &docs = *g_extract;
+  int64_t ci = 0, bpos = 0;
+  for (size_t d = 0; d < docs.size(); d++) {
+    ok[d] = docs[d].ok;
+    d_off[d] = ci;
+    for (size_t k = 0; k < docs[d].lens.size(); k++) {
+      c_off[ci] = bpos;
+      max_ops[ci] = docs[d].max_ops[k];
+      bpos += docs[d].lens[k];
+      ci++;
+    }
+    memcpy(blob + (bpos - int64_t(docs[d].blob.size())),
+           docs[d].blob.data(), docs[d].blob.size());
+    memcpy(hashes + 32 * (ci - int64_t(docs[d].lens.size())),
+           docs[d].hashes.data(), docs[d].hashes.size());
+  }
+  d_off[docs.size()] = ci;
+  c_off[ci] = bpos;
+  delete g_extract;
+  g_extract = nullptr;
+  return ci;
 }
 
 }  // extern "C"

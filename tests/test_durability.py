@@ -104,8 +104,9 @@ def test_mid_stream_rot_attributes_one_doc_and_resyncs():
 def test_snapshot_and_manifest_structural_checks():
     body = encode_frame(D.KIND_DOC, 0, b'doc0') + \
         encode_frame(D.KIND_END, 0, D._U32.pack(1))
-    docs, queued, errors = parse_snapshot_bytes(D.SNAP_MAGIC + body)
+    docs, queued, errors, meta = parse_snapshot_bytes(D.SNAP_MAGIC + body)
     assert docs == {0: b'doc0'} and not queued and not errors
+    assert meta.get('base', True)    # no SMETA frame reads as a base
     with pytest.raises(MalformedSnapshot):
         parse_snapshot_bytes(b'NOPE' + body)
     with pytest.raises(MalformedSnapshot):           # missing END
@@ -513,6 +514,158 @@ def test_cost_triggered_compaction(tmp_path):
     _mgr2.close()
 
 
+def test_incremental_compaction_work_tracks_churn(tmp_path):
+    """The O(K) pin: after touching K of N docs, a forced compaction
+    writes EXACTLY K doc frames (counter-based — `segment_docs` grows by
+    K, not N), and recovery through the segment chain is byte-identical
+    to the live fleet."""
+    n, k = 40, 3
+    path = str(tmp_path / 'dur')
+    mgr = DurableFleet(path, compact_bytes=1 << 40,
+                       compact_records=1 << 40)
+    handles = mgr.init_docs(n)
+    handles = _grow(mgr, handles, 1)
+    mgr.checkpoint()                       # base snapshot, chain reset
+    # touch exactly K docs
+    per_doc = [[] for _ in range(n)]
+    for i in range(k):
+        per_doc[i] = [_change(f'{i:02x}' * 16, 2, fb.get_heads(handles[i]),
+                              999 + i, start=2)]
+    handles, _p, errs = mgr.apply_changes(handles, per_doc)
+    assert not any(errs)
+    before = D.durability_stats()
+    assert mgr.maybe_compact(force=True)
+    after = D.durability_stats()
+    assert after['segments'] == before['segments'] + 1
+    assert after['segment_docs'] == before['segment_docs'] + k
+    assert len(mgr.chain) == 2             # base + one segment
+    # idle compaction is a no-op (zero churn -> zero work), and a forced
+    # maybe_compact reports it honestly (no phantom 'compactions' count)
+    assert mgr.compact() is False
+    c0 = D.durability_stats()['compactions']
+    assert mgr.maybe_compact(force=True) is False
+    assert D.durability_stats()['compactions'] == c0
+    pre = [bytes(fb.save(h)) for h in handles]
+    mgr.close()
+    mgr2, rec, report = DurableFleet.recover(path)
+    assert report.ok
+    assert [bytes(fb.save(rec[i])) for i in range(n)] == pre
+    mgr2.close()
+
+
+@pytest.mark.parametrize('exact_device,mirror', [(False, False),
+                                                 (False, True),
+                                                 (True, False)])
+def test_segment_chain_recovery_byte_identical(tmp_path, exact_device,
+                                               mirror):
+    """Per-doc generations stitch back byte-identically across host +
+    both device modes (the acceptance matrix), including a freed doc's
+    tombstone (no resurrection from an older segment copy)."""
+    path = str(tmp_path / f'dur-{exact_device}-{mirror}')
+    mgr = DurableFleet(path, exact_device=exact_device)
+    handles = mgr.init_docs(6)
+    handles = _grow(mgr, handles, 1)
+    mgr.checkpoint()
+    seqs = [1] * len(handles)              # per-doc seq from round 1
+    for r in (2, 3, 4):
+        # each round touches a sliding window of docs, then compacts —
+        # every doc's newest copy ends up in a DIFFERENT segment
+        per_doc = [[] for _ in handles]
+        for i in range(r - 2, r + 1):
+            seqs[i] += 1
+            per_doc[i] = [_change(f'{i:02x}' * 16, seqs[i],
+                                  fb.get_heads(handles[i]), r * 10 + i,
+                                  start=seqs[i])]
+        handles, _p, errs = mgr.apply_changes(handles, per_doc,
+                                              mirror=mirror)
+        assert not any(errs)
+        assert mgr.maybe_compact(force=True)
+    fb.free_docs([handles[5]])
+    assert mgr.maybe_compact(force=True)   # tombstone segment
+    assert len(mgr.chain) >= 4
+    pre = {i: bytes(fb.save(handles[i])) for i in range(5)}
+    mgr.close()
+    mgr2, rec, report = DurableFleet.recover(path, exact_device=exact_device,
+                                             mirror=mirror)
+    assert report.ok
+    assert sorted(rec) == sorted(pre)      # doc 5 did NOT resurrect
+    for i, want in pre.items():
+        assert bytes(fb.save(rec[i])) == want
+    mgr2.close()
+
+
+def test_first_compaction_without_checkpoint_cuts_a_base(tmp_path):
+    """Review find: a fleet that only ever compacts (the service path —
+    nothing calls checkpoint() directly) must still get a BASE snapshot,
+    or the manifest-rot fallback scan has no chain start and retention
+    eventually strands records in deleted journals."""
+    path = str(tmp_path / 'dur')
+    mgr = DurableFleet(path)
+    handles = mgr.init_docs(3)
+    handles = _grow(mgr, handles, 1)
+    assert mgr.chain == []
+    assert mgr.maybe_compact(force=True) is True
+    assert len(mgr.chain) == 1                  # escalated to a base
+    handles = _grow(mgr, handles, 2)
+    assert mgr.maybe_compact(force=True) is True
+    assert len(mgr.chain) == 2                  # now segments may follow
+    pre = [bytes(fb.save(h)) for h in handles]
+    mgr.close()
+    # manifest rot -> fallback scan must find the base and stitch
+    mpath = os.path.join(path, 'MANIFEST')
+    data = bytearray(open(mpath, 'rb').read())
+    data[8] ^= 0xff
+    open(mpath, 'wb').write(bytes(data))
+    mgr2, rec, report = DurableFleet.recover(path)
+    assert report.used_fallback_manifest
+    assert [bytes(fb.save(rec[i])) for i in range(3)] == pre
+    mgr2.close()
+
+
+def test_chain_escalates_to_full_checkpoint(tmp_path):
+    path = str(tmp_path / 'dur')
+    mgr = DurableFleet(path, max_chain=3)
+    handles = mgr.init_docs(2)
+    for r in range(1, 8):
+        handles = _grow(mgr, handles, r)
+        mgr.maybe_compact(force=True)
+        assert len(mgr.chain) <= 3
+    pre = [bytes(fb.save(h)) for h in handles]
+    mgr.close()
+    mgr2, rec, report = DurableFleet.recover(path)
+    assert report.ok
+    assert [bytes(fb.save(rec[i])) for i in range(2)] == pre
+    mgr2.close()
+
+
+def test_recovery_rejournals_instead_of_resnapshotting(tmp_path):
+    """Recovery's closing persist is O(replayed), not O(fleet): a clean
+    recovery with a journal suffix writes NO new snapshot (the chain is
+    reused; the replayed records land in the fresh journal generation),
+    and an immediate second recovery reproduces the same states."""
+    path = str(tmp_path / 'dur')
+    mgr = DurableFleet(path)
+    handles = mgr.init_docs(8)
+    handles = _grow(mgr, handles, 1)
+    mgr.checkpoint()
+    handles = _grow(mgr, handles, 2)       # journal suffix over snapshot
+    pre = [bytes(fb.save(h)) for h in handles]
+    mgr.close()
+    snaps_before = set(glob.glob(os.path.join(path, 'snapshot-*.snap')))
+    ckpt_count = D.durability_stats()['checkpoints']
+    mgr2, rec, report = DurableFleet.recover(path)
+    assert report.ok and report.replayed_records == 8
+    assert D.durability_stats()['checkpoints'] == ckpt_count
+    assert set(glob.glob(os.path.join(path, 'snapshot-*.snap'))) == \
+        snaps_before
+    assert [bytes(fb.save(rec[i])) for i in range(8)] == pre
+    mgr2.close()
+    mgr3, rec3, report3 = DurableFleet.recover(path)
+    assert report3.ok
+    assert [bytes(fb.save(rec3[i])) for i in range(8)] == pre
+    mgr3.close()
+
+
 # ---------------------------------------------------------------------------
 # crash-injection doses (tools/crashtest.py)
 # ---------------------------------------------------------------------------
@@ -522,8 +675,11 @@ def test_cost_triggered_compaction(tmp_path):
                     reason='native codec unavailable')
 def test_crashtest_smoke():
     """Seeded smoke dose of the crash matrix in tier-1: a few kill
-    offsets, the torn final frame, journal + snapshot rot, and the
-    checkpoint-protocol crash points, on the turbo path."""
+    offsets, the torn final frame, journal + snapshot rot, the
+    checkpoint-protocol crash points, AND the incremental-compaction
+    legs (segment-chain recovery, truncation over a chain, newest-
+    segment rot falling back a generation, compaction-protocol crash
+    points), on the turbo path."""
     from crashtest import run_crashtest
     stats = run_crashtest(n_seeds=1, n_points=2, modes=['lww'])
     assert stats['failures'] == [], stats['failures'][:5]

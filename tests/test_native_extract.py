@@ -1,0 +1,304 @@
+"""Native change-list extraction (codec.cpp am_extract_changes): parity
+with the Python decode_document + encode_change round trip, byte-identical
+output at every pool width, typed containment, and the materialize seam
+that consumes it (_FlatEngine._materialize_doc).
+
+The parity contract is the delta+main engine's soundness core: a parked
+document chunk must expand to EXACTLY the change buffers (and hashes) the
+Python path produces, or the extractor must bail so the Python path runs
+instead — never a third behavior.
+"""
+
+import os
+import random
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import automerge_tpu as A                                        # noqa: E402
+from automerge_tpu import native                                 # noqa: E402
+from automerge_tpu.columnar import (                             # noqa: E402
+    decode_document, encode_change, DocChunkView,
+    decode_document_header)
+from automerge_tpu.errors import MalformedDocument               # noqa: E402
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason='native codec unavailable')
+
+
+@pytest.fixture(autouse=True)
+def _restore_threads():
+    prev = native.native_threads()
+    yield
+    native.set_native_threads(prev)
+
+
+def _flat_doc():
+    d = A.init('aa' * 16)
+    d = A.change(d, {'time': 0}, lambda r: r.update(
+        {'k1': 1, 'k2': 'v', 'k3': True}))
+    d = A.change(d, {'time': 3}, lambda r: r.update({'k1': 2}))
+    return bytes(A.save(d))
+
+
+def _rich_doc():
+    d = A.init('aa' * 16)
+    d = A.change(d, {'time': 0, 'message': 'first'}, lambda r: r.update(
+        {'text': A.Text('hello'), 'list': [1, 'two', 3.5, None, True],
+         'nested': {'deep': {'er': 'x'}}, 'c': A.Counter(10),
+         'ts': 7, 'big': 'x' * 700}))
+    d = A.change(d, {'time': 1}, lambda r: r['c'].increment(5))
+    e = A.merge(A.init('bb' * 16), d)
+    e = A.change(e, {'time': 2, 'message': 'peer'},
+                 lambda r: r.update({'peer': -42}))
+    d = A.merge(d, e)
+
+    def edit(r):
+        del r['ts']
+        del r['list'][1]
+        r['list'][0] = 99
+        r['text'].insert_at(0, 'H')
+        del r['nested']['deep']
+    d = A.change(d, {'time': 4}, edit)
+    return bytes(A.save(d))
+
+
+def _merge_heavy_doc():
+    """Multi-actor concurrent edits: several heads through history,
+    deps fan-in, conflicts."""
+    a = A.init('aa' * 16)
+    a = A.change(a, {'time': 0}, lambda r: r.update({'k': 'a', 'n': 1}))
+    b = A.merge(A.init('bb' * 16), a)
+    c = A.merge(A.init('cc' * 16), a)
+    a = A.change(a, {'time': 0}, lambda r: r.update({'k': 'a2'}))
+    b = A.change(b, {'time': 0}, lambda r: r.update({'k': 'b2', 'x': 2}))
+    c = A.change(c, {'time': 0}, lambda r: r.update({'y': [1, 2]}))
+    a = A.merge(A.merge(a, b), c)
+    a = A.change(a, {'time': 9}, lambda r: r.update({'done': True}))
+    return bytes(A.save(a))
+
+
+def _empty_doc():
+    return bytes(A.save(A.init('dd' * 16)))
+
+
+def _python_extract(chunk):
+    decoded = decode_document(chunk)
+    return ([bytes(encode_change(ch)) for ch in decoded],
+            [ch['hash'] for ch in decoded],
+            [ch['startOp'] + len(ch['ops']) - 1 for ch in decoded])
+
+
+ALL_DOCS = [_flat_doc, _rich_doc, _merge_heavy_doc, _empty_doc]
+
+
+class TestParity:
+    @pytest.mark.parametrize('build', ALL_DOCS)
+    def test_byte_identical_to_python(self, build):
+        chunk = build()
+        out = native.extract_changes([chunk])
+        assert out is not None and out[0] is not None, \
+            'extractor bailed on a canonical doc'
+        bufs, hashes, max_ops = out[0]
+        py_bufs, py_hashes, py_max_ops = _python_extract(chunk)
+        assert bufs == py_bufs
+        assert hashes == py_hashes
+        assert max_ops == py_max_ops
+
+    def test_batched_multi_doc(self):
+        chunks = [b() for b in ALL_DOCS]
+        out = native.extract_changes(chunks)
+        for chunk, res in zip(chunks, out):
+            assert res is not None
+            assert res[0] == _python_extract(chunk)[0]
+
+    def test_identical_across_pool_widths(self):
+        chunks = [b() for b in ALL_DOCS] * 3
+        native.set_native_threads(1)
+        want = native.extract_changes(chunks)
+        for width in (2, 4, 8):
+            native.set_native_threads(width)
+            assert native.extract_changes(chunks) == want
+
+    def test_unknown_columns_fall_back(self):
+        """A doc carrying forward-compat unknown columns extracts only
+        through the Python path (which preserves them)."""
+        from automerge_tpu.backend import op_set
+        ops = op_set.OpSet()
+        buf = encode_change({
+            'actor': 'aa' * 16, 'seq': 1, 'startOp': 1, 'time': 0,
+            'message': '', 'deps': [],
+            'ops': [{'action': 'set', 'obj': '_root', 'key': 'k',
+                     'value': 1, 'datatype': 'int', 'pred': [],
+                     'unknownCols': {0x92: 5}}]})
+        ops.apply_changes([buf])
+        chunk = bytes(ops.save())
+        out = native.extract_changes([chunk])
+        assert out[0] is None                       # native bails...
+        py_bufs, _h, _m = _python_extract(chunk)    # ...Python round-trips
+        assert py_bufs == [bytes(buf)]
+
+
+class TestContainment:
+    def _mutants(self, n=120):
+        rng = random.Random(7)
+        base = _rich_doc()
+        out = []
+        for _ in range(n):
+            m = bytearray(base)
+            for _k in range(rng.randrange(1, 3)):
+                roll = rng.random()
+                if roll < 0.3 and m:
+                    del m[rng.randrange(len(m)):]
+                elif roll < 0.7 and m:
+                    m[rng.randrange(len(m))] ^= 1 << rng.randrange(8)
+                else:
+                    pos = rng.randrange(len(m) + 1)
+                    m[pos:pos] = bytes(rng.randrange(256)
+                                       for _ in range(rng.randrange(1, 6)))
+            out.append(bytes(m))
+        return out
+
+    def test_hostile_chunks_never_escape(self):
+        """Wrapper never raises on hostile bytes; whenever it accepts,
+        Python accepts with identical output (the heads check is the
+        arbiter)."""
+        for m in self._mutants():
+            out = native.extract_changes([m])
+            if out is None or out[0] is None:
+                continue
+            bufs, hashes, _ = out[0]
+            py_bufs, py_hashes, _ = _python_extract(m)  # must NOT raise
+            assert bufs == py_bufs and hashes == py_hashes
+
+    def test_verdicts_identical_across_pool_widths(self):
+        """Satellite pin: hostile document chunks get the SAME per-doc
+        verdict (ok/bail) and the same bytes at widths 1/2/4/8."""
+        mutants = self._mutants(60) + [b() for b in ALL_DOCS]
+        native.set_native_threads(1)
+        want = native.extract_changes(mutants)
+        for width in (2, 4, 8):
+            native.set_native_threads(width)
+            assert native.extract_changes(mutants) == want
+
+    def test_materialize_seam_raises_typed_on_hostile_chunk(self):
+        """The _materialize_doc consumer: a parked hostile chunk
+        surfaces as MalformedDocument (via the Python fallback path),
+        never an untyped error."""
+        from automerge_tpu.fleet.backend import DocFleet, _FlatEngine
+        fleet = DocFleet()
+        eng = _FlatEngine(fleet, fleet.alloc_slot())
+        bad = bytearray(_flat_doc())
+        bad[-3] ^= 0x40
+        eng._install_parked_chunk(bytes(bad), 2)
+        with pytest.raises(MalformedDocument):
+            _ = eng.changes
+
+
+class TestMaterializeSeam:
+    def test_materialize_uses_native_and_matches_python(self):
+        """_materialize_doc through the native extractor produces the
+        same change log + graph as the Python path."""
+        from automerge_tpu.fleet.backend import DocFleet, _FlatEngine
+        chunk = _rich_doc()
+        py_bufs, py_hashes, _ = _python_extract(chunk)
+
+        fleet = DocFleet()
+        eng = _FlatEngine(fleet, fleet.alloc_slot())
+        eng._install_parked_chunk(chunk, len(py_bufs))
+        logs = eng.changes
+        assert [bytes(b) for b in logs] == py_bufs
+        assert eng._doc_decoded is None          # native path: no dicts
+        # graph resolution (hash + meta) from the extractor's arrays
+        eng._ensure_graph()
+        assert sorted(eng.change_index_by_hash) == sorted(py_hashes)
+        metas = eng.changes_meta
+        decoded = decode_document(chunk)
+        for meta, ch in zip(metas, decoded):
+            assert meta['actor'] == ch['actor']
+            assert meta['seq'] == ch['seq']
+            assert meta['maxOp'] == ch['startOp'] + len(ch['ops']) - 1
+            assert meta['deps'] == sorted(ch['deps'])
+            assert meta['message'] == (ch.get('message') or '')
+
+    def test_view_matches_header(self):
+        """DocChunkView answers header-derived reads without decoding
+        ops columns."""
+        for build in ALL_DOCS:
+            chunk = build()
+            view = DocChunkView(chunk)
+            hdr = decode_document_header(chunk)
+            decoded = decode_document(chunk)
+            assert sorted(view.heads) == sorted(hdr['heads'])
+            assert view.actor_ids == hdr['actorIds']
+            assert view.n_changes == len(decoded)
+            clock = {}
+            max_op = 0
+            for ch in decoded:
+                clock[ch['actor']] = max(clock.get(ch['actor'], 0),
+                                         ch['seq'])
+                max_op = max(max_op, ch['startOp'] + len(ch['ops']) - 1)
+            assert view.clock == clock
+            assert view.max_op == max_op
+            for h in hdr['heads']:
+                assert view.contains_head(h)
+            assert view.covers_heads(hdr['heads'])
+            assert not view.contains_head('ee' * 32)
+
+
+class TestParityEdgeCases:
+    """Shapes the frontend rarely produces but the format allows."""
+
+    def _parity(self, chunk):
+        out = native.extract_changes([chunk])
+        assert out[0] is not None, 'extractor bailed on a canonical doc'
+        py_bufs, py_hashes, py_max_ops = _python_extract(chunk)
+        assert out[0][0] == py_bufs
+        assert out[0][1] == py_hashes
+        assert out[0][2] == py_max_ops
+
+    def test_two_head_document(self):
+        from automerge_tpu.backend import op_set
+        ops = op_set.OpSet()
+        ops.apply_changes([encode_change({
+            'actor': a * 16, 'seq': 1, 'startOp': 1, 'time': 0,
+            'message': '', 'deps': [],
+            'ops': [{'action': 'set', 'obj': '_root', 'key': 'a',
+                     'value': i, 'datatype': 'int', 'pred': []}]})
+            for i, a in enumerate(('aa', 'bb'))])
+        assert len(ops.heads) == 2
+        self._parity(bytes(ops.save()))
+
+    def test_change_level_extra_bytes(self):
+        from automerge_tpu.backend import op_set
+        ops = op_set.OpSet()
+        ops.apply_changes([encode_change({
+            'actor': 'cc' * 16, 'seq': 1, 'startOp': 1, 'time': 0,
+            'message': 'm', 'deps': [], 'extraBytes': b'\x01\x02xtra',
+            'ops': [{'action': 'set', 'obj': '_root', 'key': 'k',
+                     'value': 'v', 'pred': []}]})])
+        self._parity(bytes(ops.save()))
+
+    def test_preds_bytes_and_wire_datatypes(self):
+        from automerge_tpu.backend import op_set
+        from automerge_tpu.columnar import decode_change_meta
+        ops = op_set.OpSet()
+        b1 = encode_change({
+            'actor': 'dd' * 16, 'seq': 1, 'startOp': 1, 'time': 5,
+            'message': '', 'deps': [],
+            'ops': [{'action': 'set', 'obj': '_root', 'key': 'k',
+                     'value': 3, 'datatype': 'uint', 'pred': []},
+                    {'action': 'set', 'obj': '_root', 'key': 'ts',
+                     'value': 123456, 'datatype': 'timestamp',
+                     'pred': []}]})
+        ops.apply_changes([b1])
+        h = decode_change_meta(b1, True)['hash']
+        ops.apply_changes([encode_change({
+            'actor': 'dd' * 16, 'seq': 2, 'startOp': 3, 'time': 5,
+            'message': '', 'deps': [h],
+            'ops': [{'action': 'set', 'obj': '_root', 'key': 'k',
+                     'value': b'\x00\xff',
+                     'pred': [f'1@{"dd" * 16}']}]})])
+        self._parity(bytes(ops.save()))

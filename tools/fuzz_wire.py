@@ -89,6 +89,20 @@ def build_corpus():
     changes = [bytes(c) for c in A.get_all_changes(d)]
     saved = bytes(A.save(d))
 
+    # a second document exercising the extractor's full column surface:
+    # counters + incs (succ-synthesized attribution), deletes (del
+    # resynthesis from succ), floats/strings-in-lists, deflated columns
+    d2 = A.init('cc' * 16)
+    d2 = A.change(d2, {'time': 1}, lambda r: r.update(
+        {'c': A.Counter(1), 'l': [1, 'two', 3.0], 'pad': 'z' * 700}))
+    d2 = A.change(d2, {'time': 2}, lambda r: r['c'].increment(4))
+
+    def drop(r):
+        del r['l'][1]
+        del r['pad']
+    d2 = A.change(d2, {'time': 0}, drop)
+    saved2 = bytes(A.save(d2))
+
     backend = A.Frontend.get_backend_state(d, 'fuzz')
     from automerge_tpu import backend as host
     s1 = init_sync_state()
@@ -123,7 +137,7 @@ def build_corpus():
 
     corpus = {
         'change': changes,
-        'document': [saved],
+        'document': [saved, saved2],
         'sync_message': [bytes(sync_msg)],
         'sync_state': [state_bytes],
         'bloom': [bytes(bloom)],
@@ -200,8 +214,35 @@ def _targets():
             ('native_rle', native.decode_rle_column),
             ('native_delta', native.decode_delta_column),
             ('native_boolean', native.decode_boolean_column),
+            ('native_extract', _extract_target),
         ]
     return targets
+
+
+def _extract_target(mutant):
+    """The native change-list extractor (delta+main materialize kernel)
+    against hostile document chunks. The wrapper NEVER raises — it
+    returns per-doc None for anything outside its provable subset — and
+    whenever it claims success its output must be byte-identical to the
+    Python decode_document + encode_change round trip (which must then
+    also succeed): a mutant the extractor accepts but Python rejects
+    (or renders differently) is a containment hole, re-raised untyped
+    so the fuzz net flags it."""
+    out = native.extract_changes([mutant])
+    if out is None or out[0] is None:
+        return
+    chunks, hashes, _max_ops = out[0]
+    try:
+        decoded = decode_document(mutant)
+        py = [bytes(encode_change(ch)) for ch in decoded]
+        py_hashes = [ch['hash'] for ch in decoded]
+    except BaseException as exc:
+        raise RuntimeError(
+            f'extractor accepted a doc Python rejects: '
+            f'{type(exc).__name__}: {exc}') from exc
+    if chunks != py or hashes != py_hashes:
+        raise RuntimeError('extractor output diverges from Python '
+                           'decode+re-encode on an accepted doc')
 
 
 def _probe_bloom_target(mutant):
