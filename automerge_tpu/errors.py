@@ -39,6 +39,14 @@ batch-fatal. This module is that contract:
   from "come back later" (``retry_after``) from "too late". A shed
   request is all-or-nothing: these errors are only ever raised BEFORE
   the request's batch commits, never after a partial apply.
+- Query-engine rejections (``InvalidCursor``, ``UnknownHeads``) scope
+  the time-travel/subscription surface (automerge_tpu/query/):
+  ``InvalidCursor`` is wire corruption at the subscription-cursor
+  decode boundary (hostile cursor bytes fail typed, like every other
+  decoder); ``UnknownHeads`` means the cursor/frontier DECODED fine but
+  names hashes outside the document's causal history — a stale, bogus,
+  or cross-document cursor. A subscriber presenting one is resynced or
+  rejected typed; it is never sent a wrong patch.
 
 Every class subclasses ``ValueError`` (the reference's error type), so
 existing ``except ValueError`` / ``pytest.raises(ValueError)`` call sites
@@ -58,6 +66,7 @@ __all__ = [
     'DanglingPred', 'DuplicateOpId', 'SyncOverflow', 'DocError',
     'Overloaded', 'TenantThrottled', 'DeadlineExceeded',
     'RetriesExhausted', 'SyncStalled',
+    'InvalidCursor', 'UnknownHeads',
     'as_wire_error',
 ]
 
@@ -162,6 +171,20 @@ class SyncStalled(RetriesExhausted):
     progress through the whole reconnect-with-backoff schedule
     (fleet/faults.py sync_until_quiet) — a protocol bug or a dead wire,
     not bad luck. Carries `rounds` and `resets`."""
+
+
+class InvalidCursor(WireCorruption):
+    """Subscription-cursor bytes that cannot be decoded: bad magic,
+    truncated hash runs, count bombs, trailing garbage
+    (automerge_tpu/query/subscriptions.py decode_cursor)."""
+
+
+class UnknownHeads(AutomergeError, ValueError):
+    """A time-travel frontier or subscription cursor that decoded fine
+    but names change hashes outside the document's history (stale after
+    a history the server never had, bogus, or aimed at the wrong doc).
+    Carries `missing` (the unknown hex hashes). The query engine answers
+    with a typed rejection or a full resync — never a wrong patch."""
 
 
 class DocError:

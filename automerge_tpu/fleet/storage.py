@@ -39,9 +39,17 @@ import numpy as np
 
 from ..columnar import DocChunkView
 from ..errors import MalformedDocument
+from ..observability.metrics import register_health_source
 from ..observability.spans import span as _span
 
 __all__ = ['MainStore', 'StorageEngine']
+
+_stats = {
+    'storage_auto_vacuums': 0,   # dead_fraction-policy vacuums triggered
+    'storage_parked_syncs_skipped': 0,   # sync rounds served parked
+}
+for _key in _stats:
+    register_health_source(_key, lambda k=_key: _stats[k])
 
 
 class _I64:
@@ -252,12 +260,81 @@ class MainStore:
 
 class StorageEngine:
     """Delta (live DocFleet) + main (MainStore) with park/revive policy
-    and compute-on-compressed reads for the parked tier."""
+    and compute-on-compressed reads for the parked tier.
 
-    def __init__(self, fleet=None):
+    Doc ids handed out by ``park``/``ingest_chunks`` are STABLE: an
+    id→row indirection lets the engine vacuum the main store underneath
+    its callers (``vacuum_dead_fraction`` policy — after discard churn
+    pushes ``MainStore.dead_fraction`` past the threshold, the arenas
+    compact automatically, counted in the ``storage_auto_vacuums``
+    health counter) without invalidating anything a caller holds. Pass
+    ``vacuum_dead_fraction=None`` to disable the policy and vacuum by
+    hand via ``self.main``."""
+
+    # don't churn tiny stores: below this row count a vacuum saves noise
+    VACUUM_MIN_ROWS = 8
+
+    def __init__(self, fleet=None, vacuum_dead_fraction=0.5):
         from .backend import DocFleet
         self.fleet = fleet if fleet is not None else DocFleet()
         self.main = MainStore()
+        self.vacuum_dead_fraction = vacuum_dead_fraction
+        self.vacuums = 0
+        self._row_of = {}            # stable doc id -> main-store row
+        self._next_id = 0
+
+    def adopt_main(self, other):
+        """MOVE another engine's main store and its stable-id space here
+        (e.g. rebinding parked docs to a durable fleet's engine): ids the
+        other engine handed out stay valid on THIS engine, and the donor
+        resets to empty. Ownership transfers whole — two engines sharing
+        one store would race their id maps the first time either
+        auto-vacuums (the vacuum rebinds the map it knows about and
+        strands the other's rows) — and only into an EMPTY engine: the
+        adopter's own id space would otherwise silently alias the
+        donor's."""
+        if self._row_of or len(self.main._chunks):
+            raise ValueError('adopt_main requires an empty adopter: this '
+                             'engine already holds parked docs whose ids '
+                             'would alias the adopted ones')
+        self.main = other.main
+        self._row_of = dict(other._row_of)
+        self._next_id = other._next_id
+        other.main = MainStore()
+        other._row_of = {}
+        other._next_id = 0
+
+    def _admit(self, row):
+        doc_id = self._next_id
+        self._next_id += 1
+        self._row_of[doc_id] = row
+        return doc_id
+
+    def _row(self, doc_id):
+        row = self._row_of.get(doc_id)
+        if row is None:
+            raise KeyError(f'no parked doc {doc_id}')
+        return row
+
+    def _discard(self, doc_ids):
+        for doc_id in doc_ids:
+            self.main.discard(self._row_of.pop(doc_id))
+        self._maybe_vacuum()
+
+    def _maybe_vacuum(self):
+        threshold = self.vacuum_dead_fraction
+        if threshold is None:
+            return False
+        if len(self.main._chunks) < self.VACUUM_MIN_ROWS or \
+                self.main.dead_fraction < threshold:
+            return False
+        with _span('storage_vacuum', docs=len(self.main)):
+            remap = self.main.vacuum()
+        self._row_of = {doc_id: remap[row]
+                        for doc_id, row in self._row_of.items()}
+        self.vacuums += 1
+        _stats['storage_auto_vacuums'] += 1
+        return True
 
     # -- demotion -------------------------------------------------------
 
@@ -299,8 +376,8 @@ class StorageEngine:
                 if n is not None:
                     ready.append((i, handle, state, chunk, n))
             for i, handle, state, chunk, n in ready:
-                ids[i] = self.main.add(chunk, state.heads, state.clock,
-                                       state.max_op, n)
+                ids[i] = self._admit(self.main.add(
+                    chunk, state.heads, state.clock, state.max_op, n))
                 to_free.append(handle)
             if to_free:
                 fleet_backend.free_docs(to_free)
@@ -313,7 +390,8 @@ class StorageEngine:
         bulk-park path. Returns main-store ids. Raises MalformedDocument
         for undecodable bytes (the batch up to that point is kept)."""
         with _span('storage_ingest', docs=len(chunks)):
-            return [self.main.add_chunk(c, check=check) for c in chunks]
+            return [self._admit(self.main.add_chunk(c, check=check))
+                    for c in chunks]
 
     # -- promotion ------------------------------------------------------
 
@@ -323,37 +401,65 @@ class StorageEngine:
         lazily parked on the revived engines). `durable` is an optional
         DurableFleet manager — revived docs journal their chunk as a
         baseline through its load_docs. Returns backend handles in id
-        order; the rows are discarded from the main store."""
-        chunks = [self.main.chunk(r) for r in ids]
+        order; the docs leave the main store (auto-vacuum may compact
+        the arenas afterwards — ids held for OTHER docs stay valid)."""
+        chunks = [self.main.chunk(self._row(i)) for i in ids]
         with _span('storage_revive', docs=len(ids)):
             if durable is not None:
                 handles = durable.load_docs(chunks)
             else:
                 from .loader import load_docs
                 handles = load_docs(chunks, self.fleet)
-            for r in ids:
-                self.main.discard(r)
+            self._discard(ids)
         return handles
+
+    def discard(self, ids):
+        """Drop parked docs outright (no revive); returns their chunks.
+        Auto-vacuum policy applies."""
+        chunks = [self.main.chunk(self._row(i)) for i in ids]
+        self._discard(ids)
+        return chunks
+
+    def repark(self, handles, ids):
+        """Return just-revived docs to the store under their ORIGINAL
+        ids — the abort path of a round that revived docs and then
+        raised before serving them (mixed sync deadline/decode aborts):
+        the caller's ids must stay valid because the caller never sees
+        the handles. Freshly revived docs re-park through the
+        already-parked fast path (chunk verbatim, no re-validation)."""
+        got = self.park(handles)
+        for orig, new in zip(ids, got):
+            if new is not None and new != orig:
+                self._row_of[orig] = self._row_of.pop(new)
 
     # -- compute-on-compressed reads -----------------------------------
 
-    def heads(self, row):
-        return self.main.heads(row)
+    def chunk(self, doc_id):
+        return self.main.chunk(self._row(doc_id))
 
-    def clock(self, row):
-        return self.main.clock(row)
+    def heads(self, doc_id):
+        return self.main.heads(self._row(doc_id))
 
-    def max_op(self, row):
-        return self.main.max_op(row)
+    def clock(self, doc_id):
+        return self.main.clock(self._row(doc_id))
 
-    def n_changes(self, row):
-        return self.main.n_changes(row)
+    def max_op(self, doc_id):
+        return self.main.max_op(self._row(doc_id))
 
-    def needs_sync(self, row, their_heads):
+    def n_changes(self, doc_id):
+        return self.main.n_changes(self._row(doc_id))
+
+    def contains_head(self, doc_id, hash_hex):
+        return self.main.contains_head(self._row(doc_id), hash_hex)
+
+    def covers_heads(self, doc_id, their_heads):
+        return self.main.covers_heads(self._row(doc_id), their_heads)
+
+    def needs_sync(self, doc_id, their_heads):
         """Parked-doc sync gate: False when the peer's heads equal ours
         (nothing to exchange — the doc can stay parked); True otherwise
         (revive before running a real sync round)."""
-        ours = set(self.main.heads(row))
+        ours = set(self.main.heads(self._row(doc_id)))
         return set(their_heads) != ours
 
     def memory_stats(self):

@@ -1,0 +1,280 @@
+"""Incremental patch subscriptions: push only the diff since a cursor.
+
+A subscriber follows a document without running a full sync peer: it
+holds a CURSOR (the heads frontier of the last state it folded) and, per
+tick, receives the changes PAST that frontier — exactly the incremental
+recomputation of a view over a growing op graph that "Formal Foundations
+of Continuous Graph Processing" frames (PAPERS.md). Folding the pushed
+buffers onto the subscriber's shadow copy reproduces the server document
+at the pushed heads byte-identically (the chaos-universe audit pins it).
+
+``SubscriptionHub`` is the fan-out engine:
+
+- Documents register under caller-chosen keys; sources can be live fleet
+  handles OR parked ``(store, id)`` rows — a doc parking or reviving
+  mid-subscription just rebinds its source (``update_source``), cursors
+  survive because history (and its hashes) survives.
+- Per tick, subscribers group into (doc, cursor-frontier) EQUIVALENCE
+  CLASSES: one diff is computed per class and shared by every member, so
+  10k subscribers at k distinct cursors over one doc cost k selection
+  walks — and ZERO device dispatches (the diff is pure hash-graph work;
+  the dispatch-count tests pin it).
+- Cursor hygiene is typed, never wrong: a cursor naming hashes outside
+  the doc's history (bogus, or stale past a server that never had them)
+  triggers a full RESYNC event (changes from the empty frontier) tagged
+  with the typed ``UnknownHeads`` — plus a forensic flight-recorder dump
+  — while replayed-but-valid cursors simply get the (idempotent) diff
+  from their older frontier again.
+
+``encode_cursor``/``decode_cursor`` are the wire form of a cursor (what
+a client presents over the service boundary); hostile bytes fail with
+typed ``InvalidCursor`` (``WireCorruption``) — tools/fuzz_wire.py holds
+the decode boundary to the zero-untyped-escapes contract.
+"""
+
+import time
+
+from ..encoding import Decoder, Encoder
+from ..errors import InvalidCursor, UnknownHeads, as_wire_error
+from ..observability import hist as _hist
+from ..observability import recorder as _flight
+from ..observability.spans import span as _span
+from .history import history_of, select_descendants
+
+__all__ = ['SubscriptionHub', 'Subscription', 'encode_cursor',
+           'decode_cursor', 'diff_since']
+
+CURSOR_MAGIC = 0x51          # 'Q': a query-engine cursor frame
+_MAX_CURSOR_HEADS = 4096     # count-bomb ceiling (a real frontier is tiny)
+
+
+def encode_cursor(heads):
+    """Wire form of a cursor: magic byte + uint53 count + 32-byte hashes
+    (sorted, deduped). The inverse of ``decode_cursor``."""
+    heads = sorted(dict.fromkeys(str(h) for h in heads))
+    out = Encoder()
+    out.append_byte(CURSOR_MAGIC)
+    out.append_uint53(len(heads))
+    for h in heads:
+        raw = bytes.fromhex(h)
+        if len(raw) != 32:
+            raise ValueError(f'cursor head is not a 32-byte hash: {h!r}')
+        out.append_raw_bytes(raw)
+    return out.buffer
+
+
+def decode_cursor(data):
+    """Decode cursor bytes to a sorted list of hex head hashes. Hostile
+    bytes (bad magic, count bombs, truncation, trailing garbage) raise
+    typed ``InvalidCursor`` — never a bare decoder exception."""
+    try:
+        decoder = Decoder(bytes(data))
+        if decoder.read_byte() != CURSOR_MAGIC:
+            raise ValueError('cursor does not begin with magic byte 0x51')
+        count = decoder.read_uint53()
+        if count > _MAX_CURSOR_HEADS:
+            raise ValueError(f'cursor head count {count} exceeds '
+                             f'{_MAX_CURSOR_HEADS}')
+        heads = [decoder.read_raw_bytes(32).hex() for _ in range(count)]
+        if not decoder.done:
+            raise ValueError('cursor has trailing data')
+        if heads != sorted(dict.fromkeys(heads)):
+            raise ValueError('cursor heads are not sorted and unique')
+        # canonical-form discipline, enforced as decode∘encode identity:
+        # a frame that decodes but would not re-encode to the same bytes
+        # (e.g. a non-minimal LEB count) must be rejected, or equivalent
+        # cursors would split subscriber equivalence classes
+        if bytes(encode_cursor(heads)) != bytes(data):
+            raise ValueError('cursor frame is not in canonical form')
+    except Exception as exc:
+        raise as_wire_error(exc, InvalidCursor, 'decode_cursor')
+    return heads
+
+
+def diff_since(source, cursor, what='diff_since'):
+    """(changes, heads): the change buffers past the `cursor` frontier
+    and the source's current heads — the patch that takes a shadow copy
+    from the cursor state to the current state. Typed ``UnknownHeads``
+    when the cursor names history the source does not have.
+
+    The quiet case (cursor already at the heads) is answered from the
+    causal state alone: a parked doc's chunk is never extracted, a live
+    doc's graph never materialized — at-frontier subscribers are the
+    steady state, so their tick cost is a heads comparison."""
+    cursor = sorted(str(h) for h in cursor)
+    if isinstance(source, tuple):
+        heads = sorted(source[0].heads(source[1]))
+    elif not isinstance(source, (bytes, bytearray)):
+        state = source.get('state') if isinstance(source, dict) else source
+        heads = sorted(state.heads)
+    else:
+        heads = None
+    if heads is not None and cursor == heads:
+        return [], heads
+    history = history_of(source)
+    if heads is None:
+        heads = sorted(history.heads)
+        if cursor == heads:
+            return [], heads
+    start = time.perf_counter()
+    changes = select_descendants(history, cursor, what=what)
+    _hist.record_value('subscription_diff_s',
+                       time.perf_counter() - start, scale=1e9, unit='s')
+    return [bytes(c) for c in changes], heads
+
+
+class Subscription:
+    """One subscriber's hub-side state. ``cursor`` auto-advances to the
+    pushed heads on every patch/resync event (delivery is assumed; a
+    client that lost a push re-subscribes — or presents its own cursor
+    via ``resubscribe`` — and gets the idempotent diff again)."""
+
+    __slots__ = ('id', 'key', 'cursor', 'priority', 'closed')
+
+    def __init__(self, sid, key, cursor, priority):
+        self.id = sid
+        self.key = key
+        self.cursor = list(cursor)
+        self.priority = priority
+        self.closed = False
+
+    def __repr__(self):
+        return (f'Subscription({self.id}, key={self.key!r}, '
+                f'cursor={len(self.cursor)} heads)')
+
+
+class SubscriptionHub:
+    """See the module docstring. Single-threaded by contract, like the
+    service core it plugs into."""
+
+    def __init__(self):
+        self._sources = {}           # key -> query source
+        self._subs = {}              # sub id -> Subscription
+        self._next_sid = 0
+        self.stats = {
+            'ticks': 0, 'pushes': 0, 'resyncs': 0, 'quiet': 0,
+            'diffs_computed': 0, 'diffs_reused': 0,
+        }
+
+    # -- documents -----------------------------------------------------
+
+    def register(self, key, source):
+        """Bind `key` to a query source (live handle, parked (store, id)
+        pair, or raw chunk bytes). Re-registering rebinds."""
+        self._sources[key] = source
+
+    update_source = register
+
+    def unregister(self, key):
+        """Drop the doc; its subscribers resolve closed on next tick."""
+        self._sources.pop(key, None)
+
+    def keys(self):
+        return list(self._sources)
+
+    # -- subscribers ---------------------------------------------------
+
+    def subscribe(self, key, cursor=None, priority=0):
+        """Attach a subscriber to `key` at `cursor` (None/[] = from the
+        empty document: the first tick pushes the full state)."""
+        if key not in self._sources:
+            raise KeyError(f'no document registered under {key!r}')
+        sid = self._next_sid
+        self._next_sid += 1
+        sub = Subscription(sid, key, cursor or [], priority)
+        self._subs[sid] = sub
+        return sub
+
+    def resubscribe(self, sub, cursor):
+        """Reset a subscriber's cursor (the client-driven recovery path:
+        present the frontier of the state you actually hold)."""
+        sub.cursor = list(cursor)
+
+    def unsubscribe(self, sub):
+        sub.closed = True
+        self._subs.pop(sub.id, None)
+
+    def __len__(self):
+        return len(self._subs)
+
+    # -- the tick ------------------------------------------------------
+
+    def tick(self):
+        """One fan-out round. Returns {sub_id: event} for every
+        subscriber owed something this tick; quiet subscribers (cursor
+        already at the doc's heads) are omitted. Events:
+
+        - ``{'kind': 'patch', 'changes': [...], 'heads': [...]}`` —
+          fold the buffers onto the shadow copy; it now equals the
+          server doc at ``heads``.
+        - ``{'kind': 'resync', 'changes': [...], 'heads': [...],
+          'error': 'UnknownHeads'}`` — the cursor was invalid; the
+          changes rebuild the doc from scratch (fold onto an EMPTY
+          shadow).
+        - ``{'kind': 'closed'}`` — the doc was unregistered.
+
+        One diff per (doc, cursor-frontier) equivalence class; class
+        members past the first are served from the memo (the
+        ``diffs_reused`` counter / reuse ratio in bench)."""
+        from . import _stats
+
+        self.stats['ticks'] += 1
+        events = {}
+        memo = {}                  # (key, cursor tuple) -> event | None
+        invalid = []
+        with _span('subscription_tick', subscribers=len(self._subs)):
+            for sub in list(self._subs.values()):
+                source = self._sources.get(sub.key)
+                if source is None:
+                    events[sub.id] = {'kind': 'closed'}
+                    self._subs.pop(sub.id, None)
+                    continue
+                ckey = (sub.key, tuple(sorted(sub.cursor)))
+                if ckey in memo:
+                    # membership, not get(): a QUIET class memoizes None,
+                    # and its members must share that answer instead of
+                    # recomputing (one diff — or one heads compare — per
+                    # class, even at 10k at-frontier subscribers)
+                    event = memo[ckey]
+                    if event is not None:
+                        self.stats['diffs_reused'] += 1
+                        _stats['subscription_diff_reuse'] += 1
+                else:
+                    event = self._class_diff(source, sub, invalid)
+                    memo[ckey] = event
+                    if event is not None:
+                        self.stats['diffs_computed'] += 1
+                if event is None:
+                    self.stats['quiet'] += 1
+                    continue
+                events[sub.id] = event
+                sub.cursor = list(event['heads'])
+                self.stats['pushes'] += 1
+                _stats['subscription_pushes'] += 1
+        if invalid:
+            _flight.dump_flight_record('query', detail={
+                'invalid_cursors': invalid})
+        return events
+
+    def _class_diff(self, source, sub, invalid):
+        """The diff event for one (doc, cursor) class; None = quiet."""
+        from . import _stats
+        try:
+            changes, heads = diff_since(source, sub.cursor,
+                                        what='subscription_tick')
+        except UnknownHeads as exc:
+            # bogus/stale cursor: typed, resync from scratch — never a
+            # wrong patch
+            self.stats['resyncs'] += 1
+            _stats['subscription_resyncs'] += 1
+            _stats['unknown_heads'] += 1
+            invalid.append({'subscriber': sub.id, 'key': repr(sub.key),
+                            'error': type(exc).__name__,
+                            'message': str(exc)[:200]})
+            changes, heads = diff_since(source, [],
+                                        what='subscription_resync')
+            return {'kind': 'resync', 'changes': changes, 'heads': heads,
+                    'error': type(exc).__name__}
+        if not changes and sorted(sub.cursor) == heads:
+            return None
+        return {'kind': 'patch', 'changes': changes, 'heads': heads}

@@ -26,6 +26,12 @@ and fair under overload:
 - **Brownout** (service/brownout.py): sustained admission pressure
   climbs the widen-fsync → defer-compaction → shed-background-sync
   ladder, every transition in the health counters and flight recorder.
+- **Queries** (automerge_tpu/query/): the 'materialize_at' kind serves
+  time-travel reads (all of a tick's reads in ONE fused replay
+  dispatch), the 'subscribe' kind incremental patch pulls (one diff per
+  (doc, cursor) equivalence class, zero device work). Subscription
+  pushes default to sub-priority — the first citizens of the brownout
+  shed stage.
 
 The core is deliberately tick-driven and synchronous (``pump()`` runs
 one batch round; the engine below is single-threaded by contract);
@@ -148,10 +154,14 @@ class _Request:
 
 class Session:
     """One tenant session bound to one fleet document plus the
-    service-side sync state for that client."""
+    service-side sync state for that client. ``sub_cursor`` is the
+    session's subscription cursor: the heads frontier of the last patch
+    the service pushed to it ('subscribe' requests with no explicit
+    cursor continue from here)."""
 
     __slots__ = ('id', 'tenant', 'handle', 'sync_state', 'closed',
-                 '_last_heads', '_stall_rounds', '_reconnect_attempts')
+                 'sub_cursor', '_last_heads', '_stall_rounds',
+                 '_reconnect_attempts')
 
     def __init__(self, sid, tenant, handle):
         self.id = sid
@@ -159,6 +169,7 @@ class Session:
         self.handle = handle
         self.sync_state = _init_sync_state()
         self.closed = False
+        self.sub_cursor = []
         self._last_heads = None
         self._stall_rounds = 0
         self._reconnect_attempts = 0
@@ -255,23 +266,34 @@ class DocService:
     # -- submission ------------------------------------------------------
 
     def submit(self, session, kind, payload=None, *, payload_fn=None,
-               deadline=None, timeout=None, priority=1, reset=False):
+               deadline=None, timeout=None, priority=None, reset=False):
         """Admit one request. Raises typed ``Overloaded`` /
         ``TenantThrottled`` at the edge; returns a ``Ticket`` otherwise.
         `kind` is 'apply' (payload: list of change bytes for the
-        session's doc) or 'sync' (payload: the client's sync message
-        bytes, or None to solicit a server message). `payload_fn`
-        replaces a fixed payload with a per-attempt transport draw,
-        which is what makes wire faults retryable. `timeout` seconds
-        mint a deadline on the service clock; an explicit `deadline`
-        wins. `reset=True` on a sync request marks a CLIENT RECONNECT:
-        the service discards its side of the handshake state before
-        processing — without this, a server whose `sentHashes` already
-        cover everything goes silent at a freshly-reconnected (state
-        lost) client and the handshake livelocks."""
-        if kind not in ('apply', 'sync'):
-            raise ValueError(f"kind must be 'apply' or 'sync', got "
+        session's doc), 'sync' (payload: the client's sync message
+        bytes, or None to solicit a server message), 'materialize_at'
+        (payload: a heads frontier — hex hash list or encoded cursor
+        bytes; the result is the saved document chunk at that historical
+        frontier), or 'subscribe' (payload: the client's cursor —
+        encoded bytes, a heads list, or None to continue from the
+        session's auto-advancing cursor; the result is a patch event
+        carrying the changes since the cursor). `payload_fn` replaces a
+        fixed payload with a per-attempt transport draw, which is what
+        makes wire faults retryable. `timeout` seconds mint a deadline
+        on the service clock; an explicit `deadline` wins. `priority`
+        defaults to 1 — except 'subscribe', which defaults to 0:
+        subscription pushes are the first work the brownout ladder's
+        shed stage drops. `reset=True` on a sync request marks a CLIENT
+        RECONNECT: the service discards its side of the handshake state
+        before processing — without this, a server whose `sentHashes`
+        already cover everything goes silent at a freshly-reconnected
+        (state lost) client and the handshake livelocks."""
+        if kind not in ('apply', 'sync', 'materialize_at', 'subscribe'):
+            raise ValueError(f"kind must be 'apply', 'sync', "
+                             f"'materialize_at', or 'subscribe', got "
                              f'{kind!r}')
+        if priority is None:
+            priority = 0 if kind == 'subscribe' else 1
         if session.closed:
             raise Overloaded('session closed', retry_after=None,
                              shed=False, stage=None)
@@ -322,7 +344,9 @@ class DocService:
         batch = self._ripe_retries(now)
         batch += self.admission.drain(self.batch_limit - len(batch))
 
-        applies, syncs = [], []
+        applies, syncs, queries, subs = [], [], [], []
+        buckets = {'apply': applies, 'sync': syncs,
+                   'materialize_at': queries, 'subscribe': subs}
         shed_floor = self.brownout.shed_below()
         for request in batch:
             ticket = request.ticket
@@ -342,19 +366,27 @@ class DocService:
                 _stats['deadline_exceeded'] += 1
                 stats['deadline_dropped'] += 1
                 continue
-            if request.kind == 'sync' and shed_floor is not None and \
+            if request.kind in ('sync', 'subscribe') and \
+                    shed_floor is not None and \
                     request.priority < shed_floor:
+                # subscription pushes default to sub-priority, so they
+                # are the FIRST work this stage drops (staleness, never
+                # wrongness: the cursor doesn't advance on a shed)
                 self.brownout.count_shed()
                 ticket._finish(now, error=Overloaded(
-                    f'sync round shed at brownout stage '
+                    f'{request.kind} shed at brownout stage '
                     f'{self.brownout.stage}', retry_after=0.1, shed=True,
                     stage=self.brownout.stage))
                 stats['shed'] += 1
                 continue
-            (applies if request.kind == 'apply' else syncs).append(request)
+            buckets[request.kind].append(request)
 
         if applies:
             self._run_applies(applies, now, stats)
+        if queries:
+            self._run_queries(queries, now, stats)
+        if subs:
+            self._run_subscriptions(subs, now, stats)
         if syncs:
             self._run_syncs(syncs, now, stats)
 
@@ -506,6 +538,126 @@ class DocService:
                 # these requests committed (all-or-nothing holds)
                 for request in requests_:
                     self._fail_or_retry(request, err.error, now, stats)
+
+    # -- the query round ---------------------------------------------------
+
+    def _cursor_of(self, request, now, stats):
+        """Resolve a request's frontier payload: encoded cursor bytes
+        (typed InvalidCursor on hostile input — the fuzzed decode
+        boundary), a heads list, or None (the session's auto-advancing
+        subscription cursor). Returns None after resolving the ticket
+        on failure."""
+        from ..errors import InvalidCursor
+        from ..query import _stats as _query_stats
+        from ..query.subscriptions import decode_cursor
+        try:
+            payload = request.draw_payload()
+        except Exception as exc:
+            self._fail_or_retry(request, Overloaded(
+                f'transport draw failed: {exc!r}', retry_after=None,
+                shed=False, stage=None), now, stats)
+            return None
+        if payload is None:
+            return list(request.session.sub_cursor)
+        if isinstance(payload, (bytes, bytearray)):
+            try:
+                return decode_cursor(payload)
+            except InvalidCursor as exc:
+                _query_stats['invalid_cursors'] += 1
+                _flight.record_event('invalid_cursor',
+                                     tenant=request.session.tenant,
+                                     session=request.session.id,
+                                     request_kind=request.kind,
+                                     error=type(exc).__name__)
+                self._fail_or_retry(request, exc, now, stats)
+                return None
+        return [str(h) for h in payload]
+
+    def _run_queries(self, requests, now, stats):
+        """All time-travel reads of the tick in ONE fused replay
+        dispatch (query.materialize_at_docs): each request's result is
+        the saved document chunk at its requested frontier. A frontier
+        outside the doc's history fails typed (UnknownHeads) without
+        costing the others their batch."""
+        from ..query import materialize_at_docs
+
+        live = []
+        frontiers = []
+        for request in requests:
+            cursor = self._cursor_of(request, now, stats)
+            if cursor is None:
+                continue
+            live.append(request)
+            frontiers.append(cursor)
+        if not live:
+            return
+        try:
+            handles, errors = materialize_at_docs(
+                [r.session.handle for r in live], frontiers,
+                fleet=self.fleet, deadline=self._min_deadline(live),
+                on_error='quarantine')
+        except DeadlineExceeded:
+            self._seam_deadline_abort(live, now, stats)
+            return
+        to_free = []
+        for request, handle, err in zip(live, handles, errors):
+            if err is not None:
+                self._fail_or_retry(request, err.error, now, stats)
+                continue
+            request.ticket._finish(
+                now, result=bytes(handle['state'].save()))
+            stats['completed'] += 1
+            to_free.append(handle)
+        if to_free:
+            fleet_backend.free_docs(to_free)
+
+    def _run_subscriptions(self, requests, now, stats):
+        """All subscription pulls of the tick: one diff per
+        (session-doc, cursor-frontier) equivalence class — pure
+        hash-graph work, zero device dispatches — shared by every
+        subscriber in the class. Bogus/stale cursors get a typed full
+        RESYNC event; the cursor only ever advances to heads the pushed
+        changes actually reach (never a wrong patch)."""
+        from ..errors import UnknownHeads
+        from ..query import _stats as _query_stats
+        from ..query.subscriptions import diff_since
+
+        memo = {}
+        with _span('subscription_tick', subscribers=len(requests)):
+            for request in requests:
+                session = request.session
+                cursor = self._cursor_of(request, now, stats)
+                if cursor is None:
+                    continue
+                ckey = (session.id, tuple(sorted(cursor)))
+                event = memo.get(ckey)
+                if event is None:
+                    try:
+                        changes, heads = diff_since(
+                            session.handle, cursor,
+                            what='service_subscribe')
+                        event = {'kind': 'patch', 'changes': changes,
+                                 'heads': heads}
+                    except UnknownHeads as exc:
+                        _query_stats['subscription_resyncs'] += 1
+                        _query_stats['unknown_heads'] += 1
+                        _flight.record_event(
+                            'invalid_cursor', tenant=session.tenant,
+                            session=session.id,
+                            error=type(exc).__name__,
+                            message=str(exc)[:200])
+                        changes, heads = diff_since(
+                            session.handle, [], what='service_resync')
+                        event = {'kind': 'resync', 'changes': changes,
+                                 'heads': heads,
+                                 'error': type(exc).__name__}
+                    memo[ckey] = event
+                else:
+                    _query_stats['subscription_diff_reuse'] += 1
+                _query_stats['subscription_pushes'] += 1
+                session.sub_cursor = list(event['heads'])
+                request.ticket._finish(now, result=event)
+                stats['completed'] += 1
 
     # -- the sync round ----------------------------------------------------
 

@@ -867,7 +867,8 @@ SANITY_KEYS = {'seam': 'seam_rate', 'registers': 'reg_rate',
                # recovery rate, not materialize-us: the latter is NaN on
                # hosts without the native codec, which the sanity ratio
                # would turn into an unconditional FAIL
-               'storage': 'storage_recovery_docs_per_s'}
+               'storage': 'storage_recovery_docs_per_s',
+               'query': 'query_materialize_docs_per_s'}
 
 
 def section(name):
@@ -1688,6 +1689,131 @@ def _sec_service():
         R[f"service_{leg['leg']}_ok"] for leg in legs))
 
 
+@section('query')
+def _sec_query():
+    # Query engine (ISSUE-9): (a) batched time-travel reads — N docs
+    # materialized at historical frontiers through ONE fused replay
+    # dispatch (query.materialize_at_docs), reported as docs/s with the
+    # dispatch count pinned; (b) the subscription tick at fleet scale —
+    # S subscribers over D docs grouped into (doc, cursor) equivalence
+    # classes, reporting tick p99, the per-tick device dispatch count
+    # (must be 0: pure hash-graph work), and the one-diff-per-class
+    # reuse ratio.
+    from automerge_tpu.columnar import decode_change_meta, encode_change
+    from automerge_tpu.fleet import backend as fleet_backend
+    from automerge_tpu.fleet.backend import DocFleet, init_docs
+    from automerge_tpu.query import SubscriptionHub, materialize_at_docs
+
+    n_docs = _env('BENCH_QUERY_DOCS', 1000)
+    n_subs = _env('BENCH_QUERY_SUBS', 10000)
+    n_changes = 6
+
+    fleet = DocFleet()
+    handles = init_docs(n_docs, fleet)
+    frontiers = [[] for _ in range(n_docs)]   # current heads per doc
+    mid_frontier = [None] * n_docs            # heads at the halfway point
+    for c in range(n_changes):
+        per_doc = []
+        for d in range(n_docs):
+            buf = encode_change({
+                'actor': f'{d % 128:04x}' * 4, 'seq': c + 1,
+                'startOp': c + 1, 'time': 0, 'message': '',
+                'deps': frontiers[d],
+                'ops': [{'action': 'set', 'obj': '_root', 'key': f'k{c}',
+                         'value': d * 100 + c, 'datatype': 'int',
+                         'pred': []}]})
+            frontiers[d] = [decode_change_meta(buf, True)['hash']]
+            if c == n_changes // 2:
+                mid_frontier[d] = list(frontiers[d])
+            per_doc.append([buf])
+        handles, _ = fleet_backend.apply_changes_docs(handles, per_doc,
+                                                      mirror=False)
+    _fence()
+
+    # ---- (a) batched materialize-at ----
+    mat_times = []
+    dispatches = None
+    for rep in range(max(REPS, 3) + 1):
+        before = fleet.metrics.dispatches
+        start = time.perf_counter()
+        outs = materialize_at_docs(handles, mid_frontier, fleet=fleet)
+        mat_s = time.perf_counter() - start
+        dispatches = fleet.metrics.dispatches - before
+        fleet_backend.free_docs(outs)
+        if rep == 0:
+            continue
+        mat_times.append(mat_s)
+    mat_s = float(np.median(mat_times))
+    mat_rate = n_docs / mat_s
+
+    # ---- (b) the subscription tick at fan-out scale ----
+    # subscribers spread over the docs at 3 cursor classes per doc
+    # (empty / mid / at-head), so the expected reuse ratio at S >> 3D is
+    # ~1 - 3D/S
+    hub = SubscriptionHub()
+    for d in range(n_docs):
+        hub.register(d, handles[d])
+    classes = [[], None, 'head']
+    for s in range(n_subs):
+        d = s % n_docs
+        cls = classes[(s // n_docs) % 3]
+        cursor = mid_frontier[d] if cls is None else \
+            (frontiers[d] if cls == 'head' else [])
+        hub.subscribe(d, cursor=cursor)
+    tick_times = []
+    tick_dispatches = 0
+    reuse_ratio = 0.0
+    n_ticks = max(REPS, 5)
+    for rep in range(n_ticks + 1):
+        # advance every doc one change so each tick has real diffs
+        per_doc = []
+        for d in range(n_docs):
+            buf = encode_change({
+                'actor': f'{d % 128:04x}' * 4, 'seq': n_changes + rep + 1,
+                'startOp': n_changes + rep + 1, 'time': 0, 'message': '',
+                'deps': frontiers[d],
+                'ops': [{'action': 'set', 'obj': '_root', 'key': 'hot',
+                         'value': rep, 'datatype': 'int', 'pred': []}]})
+            frontiers[d] = [decode_change_meta(buf, True)['hash']]
+            per_doc.append([buf])
+        handles, _ = fleet_backend.apply_changes_docs(handles, per_doc,
+                                                      mirror=False)
+        for d in range(n_docs):
+            hub.update_source(d, handles[d])
+        computed0 = hub.stats['diffs_computed']
+        reused0 = hub.stats['diffs_reused']
+        before = fleet.metrics.dispatches
+        start = time.perf_counter()
+        events = hub.tick()
+        tick_s = time.perf_counter() - start
+        tick_dispatches = fleet.metrics.dispatches - before
+        assert len(events) == n_subs
+        if rep == 0:
+            continue
+        computed = hub.stats['diffs_computed'] - computed0
+        reused = hub.stats['diffs_reused'] - reused0
+        reuse_ratio = reused / max(computed + reused, 1)
+        tick_times.append(tick_s)
+    tick_p99_ms = float(np.percentile(tick_times, 99)) * 1e3
+    tick_p50_ms = float(np.median(tick_times)) * 1e3
+    del hub, handles, fleet
+    _fence()
+
+    R.update(query_materialize_docs_per_s=mat_rate,
+             query_materialize_dispatches=dispatches,
+             query_tick_subs=n_subs,
+             query_tick_p50_ms=tick_p50_ms,
+             query_tick_p99_ms=tick_p99_ms,
+             query_tick_dispatches=tick_dispatches,
+             query_diff_reuse_ratio=reuse_ratio)
+    print(f'# query: batched materialize-at {mat_rate:.0f} docs/s '
+          f'({n_docs} docs/batch, {dispatches} dispatches/batch); '
+          f'{n_subs}-subscriber tick over {n_docs} docs p50 '
+          f'{tick_p50_ms:.1f}ms / p99 {tick_p99_ms:.1f}ms, '
+          f'{tick_dispatches} device dispatches/tick, diff reuse '
+          f'{reuse_ratio:.3f}', file=sys.stderr)
+
+
 @section('zipf')
 def _sec_zipf():
     # Config 5 (stretch): Zipf-skewed change rates over a large fleet
@@ -1834,6 +1960,8 @@ def _run_sanity():
              'BENCH_SERVICE_SESSIONS': '500',
              'BENCH_SERVICE_REQUESTS': '3000',
              'BENCH_SERVICE_TENANTS': '32',
+             'BENCH_QUERY_DOCS': '200',
+             'BENCH_QUERY_SUBS': '1000',
              'BENCH_REPS': '3'}
     for k, v in small.items():
         os.environ.setdefault(k, v)

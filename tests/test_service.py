@@ -525,3 +525,140 @@ def test_pending_fsync_bytes_counter_and_alert(tmp_path):
         j.close()
     finally:
         dur.set_fsync_alert_threshold(prev)
+
+
+# ---------------------------------------------------------------------------
+# query request kinds (round 13): time-travel reads + subscriptions
+# ---------------------------------------------------------------------------
+
+def _chained_change(actor, seq, deps, key, val):
+    return encode_change({
+        'actor': actor, 'seq': seq, 'startOp': seq, 'time': 0,
+        'message': '', 'deps': list(deps),
+        'ops': [{'action': 'set', 'obj': '_root', 'key': key,
+                 'value': val, 'datatype': 'int', 'pred': []}]})
+
+
+def _edited_session(svc, tenant='t0', rounds=3):
+    """A session whose doc holds `rounds` chained changes; returns
+    (session, frontiers) with frontiers[k] = heads after k changes."""
+    from automerge_tpu.columnar import decode_change_meta
+    session = svc.open_session(tenant)
+    frontier, frontiers = [], [[]]
+    for r in range(rounds):
+        buf = _chained_change('ee' * 16, r + 1, frontier, f'k{r}', r)
+        frontier = [decode_change_meta(buf, True)['hash']]
+        frontiers.append(list(frontier))
+        t = svc.submit(session, 'apply', [buf])
+        svc.pump()
+        assert t.status == 'ok', t.error
+    return session, frontiers
+
+
+def test_materialize_at_kind_returns_historical_chunk():
+    svc = make_service()
+    session, frontiers = _edited_session(svc)
+    t = svc.submit(session, 'materialize_at', frontiers[2])
+    svc.pump()
+    assert t.status == 'ok', t.error
+    doc = host_backend.load(t.result)
+    assert host_backend.get_heads(doc) == sorted(frontiers[2])
+    # the ephemeral read doc was freed: session doc still live, fleet
+    # slot count unchanged after the read batch
+    assert session.handle['state'].is_fleet
+
+
+def test_materialize_at_unknown_heads_typed_contained():
+    from automerge_tpu.errors import UnknownHeads
+    svc = make_service()
+    session, frontiers = _edited_session(svc)
+    bad = svc.submit(session, 'materialize_at', ['ee' * 32])
+    good = svc.submit(session, 'materialize_at', frontiers[1])
+    svc.pump()
+    assert bad.status == 'error'
+    assert isinstance(bad.error, UnknownHeads)
+    assert good.status == 'ok'     # the bad frontier cost only its slot
+
+
+def test_subscribe_kind_incremental_and_wire_cursor():
+    from automerge_tpu.query import encode_cursor
+    svc = make_service()
+    session, frontiers = _edited_session(svc)
+    # first pull: full state from the session's empty cursor
+    t1 = svc.submit(session, 'subscribe')
+    svc.pump()
+    assert t1.status == 'ok'
+    assert t1.result['kind'] == 'patch'
+    assert len(t1.result['changes']) == 3
+    shadow = host_backend.init()
+    shadow, _ = host_backend.apply_changes(shadow, t1.result['changes'])
+    assert bytes(host_backend.save(shadow)) == \
+        bytes(session.handle['state'].save())
+    # cursor advanced server-side: next pull is an empty patch
+    t2 = svc.submit(session, 'subscribe')
+    svc.pump()
+    assert t2.result['changes'] == []
+    # an explicit wire cursor replays from its frontier (idempotent)
+    t3 = svc.submit(session, 'subscribe', encode_cursor(frontiers[1]))
+    svc.pump()
+    assert len(t3.result['changes']) == 2
+
+
+def test_subscribe_hostile_cursor_fails_typed():
+    from automerge_tpu.errors import InvalidCursor
+    svc = make_service()
+    session, _ = _edited_session(svc, rounds=1)
+    t = svc.submit(session, 'subscribe', b'\x00garbage')
+    svc.pump()
+    assert t.status == 'error'
+    assert isinstance(t.error, InvalidCursor)
+
+
+def test_subscribe_bogus_cursor_resyncs_typed():
+    from automerge_tpu.query import encode_cursor
+    svc = make_service()
+    session, _ = _edited_session(svc)
+    t = svc.submit(session, 'subscribe', encode_cursor(['99' * 32]))
+    svc.pump()
+    assert t.status == 'ok'
+    assert t.result['kind'] == 'resync'
+    shadow = host_backend.init()
+    shadow, _ = host_backend.apply_changes(shadow, t.result['changes'])
+    assert bytes(host_backend.save(shadow)) == \
+        bytes(session.handle['state'].save())
+
+
+def test_subscription_push_is_first_shed():
+    """Subscription pushes default to sub-priority: at brownout stage 3
+    they shed (typed, cursor unmoved) while default-priority sync and
+    apply keep flowing."""
+    from automerge_tpu.errors import Overloaded
+    svc = make_service()
+    session, _ = _edited_session(svc, rounds=1)
+    svc.brownout.stage = 3
+    sub = svc.submit(session, 'subscribe')
+    app = svc.submit(session, 'apply',
+                     [_chained_change('dd' * 16, 1, [], 'x', 1)])
+    sync = svc.submit(session, 'sync', None)
+    svc.pump()
+    assert sub.status == 'error'
+    assert isinstance(sub.error, Overloaded)
+    assert sub.error.shed is True
+    assert session.sub_cursor == []       # a shed never advances it
+    assert app.status == 'ok'
+    assert sync.status == 'ok'
+    # explicit priority keeps a subscription alive through the shed
+    kept = svc.submit(session, 'subscribe', priority=2)
+    svc.pump()
+    assert kept.status == 'ok'
+
+
+def test_subscription_tick_diff_reuse_across_requests():
+    from automerge_tpu.query import query_stats
+    svc = make_service()
+    session, _ = _edited_session(svc)
+    before = query_stats()['subscription_diff_reuse']
+    tickets = [svc.submit(session, 'subscribe', []) for _ in range(6)]
+    svc.pump()
+    assert all(t.status == 'ok' for t in tickets)
+    assert query_stats()['subscription_diff_reuse'] - before == 5

@@ -165,7 +165,7 @@ class TestStorageEngine:
         ids = eng.park(handles)
         mgr = DurableFleet(str(tmp_path / 'dur'))
         eng2 = StorageEngine(mgr.fleet)
-        eng2.main = eng.main
+        eng2.adopt_main(eng)
         back = eng2.revive(ids, durable=mgr)
         assert [bytes(h['state'].save()) for h in back] == saves
         mgr.close()
@@ -215,3 +215,84 @@ def test_million_parked_docs_resident(tmp_path):
     # must stay under 1 GiB of RSS growth — an in-fleet 3.3 KB/doc
     # residency would need >3.3 GiB
     assert grew_kib < 1 << 20, f'RSS grew {grew_kib} KiB'
+
+
+class TestAutoVacuum:
+    """dead_fraction-policy vacuum (round-13 satellite): discard churn
+    past the threshold compacts the arenas automatically, behind a
+    stable-id indirection so callers' ids survive."""
+
+    def _engine(self, n, threshold=0.5):
+        fleet = DocFleet()
+        eng = StorageEngine(fleet, vacuum_dead_fraction=threshold)
+        handles = _workload(fleet, n)
+        saves = [bytes(h['state'].save()) for h in handles]
+        ids = eng.ingest_chunks(saves)
+        return eng, ids, saves
+
+    def test_discard_churn_triggers_vacuum(self):
+        from automerge_tpu.observability import health_counts
+        eng, ids, saves = self._engine(12)
+        before = health_counts()['storage_auto_vacuums']
+        eng.discard(ids[:7])
+        assert eng.vacuums == 1
+        assert health_counts()['storage_auto_vacuums'] == before + 1
+        assert eng.main.dead_fraction == 0.0
+        # surviving ids stay valid across the row remap
+        for i, save in zip(ids[7:], saves[7:]):
+            assert bytes(eng.chunk(i)) == save
+
+    def test_below_threshold_no_vacuum(self):
+        eng, ids, _ = self._engine(12)
+        eng.discard(ids[:3])
+        assert eng.vacuums == 0
+        assert eng.main.dead_fraction > 0
+
+    def test_policy_disabled(self):
+        eng, ids, _ = self._engine(12, threshold=None)
+        eng.discard(ids[:10])
+        assert eng.vacuums == 0
+        assert eng.main.dead_fraction > 0.8   # caller vacuums by hand
+
+    def test_revive_churn_triggers_and_reads_survive(self):
+        eng, ids, saves = self._engine(16)
+        live = [(sorted(eng.heads(i)), eng.max_op(i)) for i in ids]
+        back = eng.revive(ids[:12])
+        assert [bytes(h['state'].save()) for h in back] == saves[:12]
+        assert eng.vacuums >= 1
+        for i, (heads, max_op) in zip(ids[12:], live[12:]):
+            assert eng.heads(i) == heads
+            assert eng.max_op(i) == max_op
+        # a revived (discarded) id is gone, typed
+        with pytest.raises(KeyError):
+            eng.heads(ids[0])
+
+    def test_small_stores_never_churn(self):
+        eng, ids, _ = self._engine(4)
+        eng.discard(ids[:3])
+        assert eng.vacuums == 0               # below VACUUM_MIN_ROWS
+
+    def test_adopt_main_moves_ownership(self):
+        # regression: adoption MOVES the store — the donor resets, so a
+        # later auto-vacuum on either side cannot strand the other's ids
+        eng, ids, saves = self._engine(16)
+        other = StorageEngine(DocFleet())
+        other.adopt_main(eng)
+        assert len(eng.main) == 0 and len(eng._row_of) == 0
+        # churn the adopter past the threshold: its ids survive its own
+        # vacuum, and the donor is unaffected
+        other.discard(ids[:12])
+        assert other.vacuums >= 1
+        for i, save in zip(ids[12:], saves[12:]):
+            assert bytes(other.chunk(i)) == save
+        with pytest.raises(KeyError):
+            eng.heads(ids[15])
+
+    def test_adopt_main_requires_empty_adopter(self):
+        eng, ids, _ = self._engine(8)
+        other = StorageEngine(DocFleet())
+        other.ingest_chunks([bytes(eng.chunk(ids[0]))])
+        with pytest.raises(ValueError):
+            other.adopt_main(eng)
+        # donor untouched by the refused adoption
+        assert len(eng.main) == 8

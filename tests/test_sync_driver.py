@@ -218,3 +218,165 @@ class TestDifferentialEquality:
         assert out_docs == docs
         assert out_states == states
         assert patches == [None] * len(pairs)
+
+
+class TestParkedGate:
+    """The StorageEngine.needs_sync parked gate (round-13 satellite): a
+    sync round over a mixed live/parked population revives ONLY the docs
+    a peer actually needs; quiet converged handshakes are answered
+    compute-on-compressed with the doc still parked."""
+
+    def _converged_population(self, n=6):
+        """n (fleet doc, host peer) pairs driven to sync quiescence, plus
+        the sync states of both sides."""
+        from automerge_tpu.columnar import encode_change, decode_change_meta
+        from automerge_tpu.fleet import backend as fleet_backend
+        from automerge_tpu.fleet.backend import DocFleet, init_docs
+
+        fleet = DocFleet()
+        docs = init_docs(n, fleet)
+        heads = [[] for _ in range(n)]
+        for r in range(3):
+            per_doc = []
+            for d in range(n):
+                buf = encode_change({
+                    'actor': f'{d:04x}' * 4, 'seq': r + 1,
+                    'startOp': r + 1, 'time': 0, 'message': '',
+                    'deps': heads[d],
+                    'ops': [{'action': 'set', 'obj': '_root',
+                             'key': f'k{r}', 'value': d * 10 + r,
+                             'datatype': 'int', 'pred': []}]})
+                heads[d] = [decode_change_meta(buf, True)['hash']]
+                per_doc.append([buf])
+            docs, _ = fleet_backend.apply_changes_docs(docs, per_doc,
+                                                       mirror=False)
+        peers = [Backend.init() for _ in range(n)]
+        ls = [init_sync_state() for _ in range(n)]
+        ps = [init_sync_state() for _ in range(n)]
+        for _ in range(10):
+            traffic = False
+            ls, msgs = generate_sync_messages_docs(docs, ls)
+            for i, m in enumerate(msgs):
+                if m is not None:
+                    traffic = True
+                    peers[i], ps[i], _ = Backend.receive_sync_message(
+                        peers[i], ps[i], m)
+            replies = []
+            for i in range(n):
+                ps[i], back = generate_sync_message(peers[i], ps[i])
+                replies.append(back)
+                if back is not None:
+                    traffic = True
+            docs, ls, _ = receive_sync_messages_docs(docs, ls, replies)
+            if not traffic:
+                break
+        for i in range(n):
+            assert Backend.get_heads(peers[i]) == \
+                sorted(docs[i]['state'].heads)
+        return fleet, docs, peers, ls, ps
+
+    def test_quiet_parked_docs_stay_parked(self):
+        from automerge_tpu.fleet.storage import StorageEngine
+        from automerge_tpu.fleet.sync_driver import (
+            generate_sync_messages_mixed, receive_sync_messages_mixed)
+        from automerge_tpu.observability import health_counts
+
+        fleet, docs, peers, ls, ps = self._converged_population()
+        eng = StorageEngine(fleet)
+        ids = eng.park(docs)
+        assert all(i is not None for i in ids)
+        before = health_counts()['storage_parked_syncs_skipped']
+        out_docs, out_ls, msgs = generate_sync_messages_mixed(eng, ids, ls)
+        assert msgs == [None] * len(ids)
+        assert out_docs == ids               # nothing revived
+        assert len(eng.main) == len(ids)
+        assert out_ls == ls
+        assert health_counts()['storage_parked_syncs_skipped'] > before
+        # a quiet peer message (no changes, heads == ours) is absorbed
+        # parked too
+        ps2, peer_msgs = zip(*[generate_sync_message(p, dict(
+            s, lastSentHeads=None)) for p, s in zip(peers, ps)])
+        out_docs, out_ls, _patches = receive_sync_messages_mixed(
+            eng, ids, out_ls, list(peer_msgs))
+        assert out_docs == ids
+        assert len(eng.main) == len(ids)
+        for i, state in enumerate(out_ls):
+            assert sorted(state['theirHeads']) == eng.heads(ids[i])
+
+    def test_divergent_peer_revives_only_its_doc(self):
+        from automerge_tpu.fleet.storage import StorageEngine
+        from automerge_tpu.fleet.sync_driver import (
+            generate_sync_messages_mixed, receive_sync_messages_mixed)
+
+        fleet, docs, peers, ls, ps = self._converged_population()
+        n = len(docs)
+        eng = StorageEngine(fleet)
+        ids = eng.park(docs)
+        # peer 2 edits: its doc (and only its doc) must revive
+        from automerge_tpu.columnar import encode_change
+        edit = encode_change({
+            'actor': 'dd' * 16, 'seq': 1, 'startOp': 100, 'time': 0,
+            'message': '', 'deps': Backend.get_heads(peers[2]),
+            'ops': [{'action': 'set', 'obj': '_root', 'key': 'new',
+                     'value': 1, 'datatype': 'int', 'pred': []}]})
+        peers[2], _ = Backend.apply_changes(peers[2], [edit])
+        mixed = list(ids)
+        for _ in range(10):
+            traffic = False
+            replies = []
+            for i in range(n):
+                ps[i], back = generate_sync_message(peers[i], ps[i])
+                replies.append(back)
+                traffic = traffic or back is not None
+            mixed, ls, _ = receive_sync_messages_mixed(eng, mixed, ls,
+                                                       replies)
+            mixed, ls, msgs = generate_sync_messages_mixed(eng, mixed, ls)
+            for i, m in enumerate(msgs):
+                if m is not None:
+                    traffic = True
+                    peers[i], ps[i], _ = Backend.receive_sync_message(
+                        peers[i], ps[i], m)
+            if not traffic:
+                break
+        # only doc 2 left the main store
+        assert [isinstance(x, int) for x in mixed] == \
+            [i != 2 for i in range(n)]
+        assert len(eng.main) == n - 1
+        assert sorted(mixed[2]['state'].heads) == \
+            Backend.get_heads(peers[2])
+
+    def test_deadline_abort_leaves_storage_whole(self):
+        """All-or-nothing over the parked gate: a deadline firing at
+        entry touches nothing, and one firing mid-round (after the gate
+        already revived) re-parks the revived docs under their ORIGINAL
+        ids — the caller's handles never dangle."""
+        from automerge_tpu.errors import DeadlineExceeded
+        from automerge_tpu.fleet.storage import StorageEngine
+        from automerge_tpu.fleet.sync_driver import (
+            generate_sync_messages_mixed)
+        from automerge_tpu.service.deadline import Deadline
+
+        fleet, docs, peers, ls, ps = self._converged_population(3)
+        eng = StorageEngine(fleet)
+        ids = eng.park(docs)
+        heads_before = [eng.heads(i) for i in ids]
+        # make the round NOT quiet so the gate wants to revive
+        fresh = [dict(s, theirHeads=None) for s in ls]
+        # expired at entry: nothing revived, nothing discarded
+        past = Deadline(-1.0, clock=lambda: 0.0)
+        with pytest.raises(DeadlineExceeded):
+            generate_sync_messages_mixed(eng, ids, fresh, deadline=past)
+        assert len(eng.main) == len(ids)
+        # expires BETWEEN the entry check and the sub-round's own check:
+        # the revived docs must re-park under their original ids
+        ticks = [0.0]
+
+        def clock():
+            ticks[0] += 1.0
+            return ticks[0]
+        mid = Deadline(1.5, clock=clock)      # 1st check ok, 2nd late
+        with pytest.raises(DeadlineExceeded):
+            generate_sync_messages_mixed(eng, ids, fresh, deadline=mid)
+        assert len(eng.main) == len(ids)
+        for i, heads in zip(ids, heads_before):
+            assert eng.heads(i) == heads

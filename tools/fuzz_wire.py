@@ -28,6 +28,9 @@ Targets:
   raises only MalformedJournal/TornTail; LENIENT mode must never raise
   at all — recovery consumes the damage report), parse_snapshot_bytes
   and parse_manifest_bytes (typed MalformedSnapshot only)
+- query.decode_cursor — the subscription-cursor decode boundary: a
+  hostile cursor fails typed InvalidCursor, and one that DECODES must
+  round-trip (re-encode to the same bytes: canonical-form discipline)
 
 Dose scales like tests/test_chaos.py: FUZZ_SEEDS x FUZZ_CASES mutants per
 target (env-overridable); tests/test_fuzz_wire.py runs a small smoke dose
@@ -135,6 +138,13 @@ def build_corpus():
              'journal': 'journal-00000003.log', 'journal_offset': 0,
              'next_doc_id': 3}).encode('utf8'))
 
+    # subscription cursors: empty, single-head, and multi-head frontiers
+    from automerge_tpu.query import encode_cursor
+    cursors = [encode_cursor([]),
+               encode_cursor(host.get_heads(backend)),
+               encode_cursor(host.get_heads(backend) +
+                             ['ab' * 32, 'cd' * 32])]
+
     corpus = {
         'change': changes,
         'document': [saved, saved2],
@@ -145,6 +155,7 @@ def build_corpus():
         'journal': [journal, journal_batch],
         'snapshot': [snapshot],
         'manifest': [manifest],
+        'cursor': cursors,
     }
     _corpus_size[0] = sum(len(v) for v in corpus.values())
     return corpus
@@ -198,6 +209,7 @@ def _targets():
                                                 parse_manifest_bytes,
                                                 parse_snapshot_bytes)
     targets = [
+        ('decode_cursor', _cursor_target),
         ('decode_change', decode_change),
         ('decode_change_meta', lambda b: decode_change_meta(b, True)),
         ('split_containers', split_containers),
@@ -243,6 +255,17 @@ def _extract_target(mutant):
     if chunks != py or hashes != py_hashes:
         raise RuntimeError('extractor output diverges from Python '
                            'decode+re-encode on an accepted doc')
+
+
+def _cursor_target(mutant):
+    """The subscription-cursor decode boundary (query engine): hostile
+    bytes raise typed InvalidCursor only, and any mutant that decodes
+    must re-encode to the same bytes — decode_cursor accepting a
+    non-canonical frame would split subscriber equivalence classes."""
+    from automerge_tpu.query import decode_cursor, encode_cursor
+    heads = decode_cursor(mutant)
+    if bytes(encode_cursor(heads)) != bytes(mutant):
+        raise RuntimeError('decode_cursor accepted a non-canonical frame')
 
 
 def _probe_bloom_target(mutant):
