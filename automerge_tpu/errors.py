@@ -73,7 +73,16 @@ __all__ = [
 
 class AutomergeError(Exception):
     """Base of every typed failure. `doc_index` scopes the error to one
-    slot of a batched call (None = not doc-scoped / unknown)."""
+    slot of a batched call (None = not doc-scoped / unknown).
+
+    `budget` is the SLO error-budget class the failure burns (None =
+    burns no availability budget): the shedding classes each carry
+    their own so the telemetry plane (observability/slo.py) can hold
+    TenantThrottled, Overloaded, and DeadlineExceeded against DIFFERENT
+    objectives — a tenant flooding itself dry must not spend the budget
+    that pages when the service starts shedding everyone."""
+
+    budget = None
 
     def __init__(self, *args, doc_index=None, **attrs):
         super().__init__(*args)
@@ -145,11 +154,15 @@ class Overloaded(AutomergeError, ValueError):
     `retry_after` (seconds the client should wait, None = unknown) and,
     for brownout sheds, `shed=True` + `stage`."""
 
+    budget = 'overloaded'
+
 
 class TenantThrottled(Overloaded):
     """THIS tenant exhausted its token bucket or bounded queue — other
     tenants are unaffected (per-tenant isolation is the point). Carries
     `tenant` and `retry_after`."""
+
+    budget = 'throttled'
 
 
 class DeadlineExceeded(AutomergeError, ValueError):
@@ -157,6 +170,8 @@ class DeadlineExceeded(AutomergeError, ValueError):
     All-or-nothing: raised only while the request is still entirely
     unapplied — a deadline NEVER fires after a partial commit. Carries
     `deadline` (the absolute clock value) and `late_by` (seconds)."""
+
+    budget = 'deadline'
 
 
 class RetriesExhausted(AutomergeError, ValueError):

@@ -37,7 +37,8 @@ from ..backend.sync import (
 )
 from ..errors import DocError, MalformedSyncMessage, as_wire_error
 from ..observability import recorder as _flight
-from ..observability.spans import span as _span, spanned as _spanned
+from ..observability import tracecontext as _trace
+from ..observability.spans import span as _span
 from .backend import apply_changes_docs, quarantine_stats
 from .bloom import (
     build_bloom_filters_batch_begin, build_bloom_filters_batch_finish,
@@ -50,20 +51,37 @@ __all__ = ['generate_sync_messages_docs', 'receive_sync_messages_docs',
            'dispatch_count']
 
 
-@_spanned('sync_generate')
-def generate_sync_messages_docs(backends, sync_states, deadline=None):
+def generate_sync_messages_docs(backends, sync_states, deadline=None,
+                                trace_ctx=None):
     """Batched ``generate_sync_message`` over N (backend, syncState) pairs.
     Returns (new_sync_states, messages) with messages[i] = bytes or None,
     byte-identical to the host function applied per doc. All Bloom builds
     share one device dispatch; all peer-filter probes share another.
     `deadline` is checked before the build dispatch is issued (generation
-    mutates no document state, so the check is purely a latency bound)."""
+    mutates no document state, so the check is purely a latency bound).
+
+    `trace_ctx` OPTS the round into cross-peer trace stitching: every
+    produced message is prepended with the trace envelope
+    (observability/tracecontext.py), so the receiving peer's spans join
+    this trace. Without it the wire bytes are untouched (the
+    byte-identity contract above holds) — an AMBIENT context
+    (``tracecontext.use``) only decorates this round's spans with the
+    trace id, it never changes the wire."""
     n = len(backends)
     if len(sync_states) != n:
         raise ValueError('backends and sync_states must align')
     if deadline is not None:
         deadline.check(what='generate_sync_messages_docs')
+    with _span('sync_generate', docs=n,
+               **_trace.trace_attr(trace_ctx)):
+        new_states, messages = _generate_inner(backends, sync_states, n)
+    if trace_ctx is not None:
+        messages = [m if m is None else _trace.wrap(m, trace_ctx)
+                    for m in messages]
+    return new_states, messages
 
+
+def _generate_inner(backends, sync_states, n):
     our_heads = [get_heads(b) for b in backends]
     our_need = [get_missing_deps(b, s['theirHeads'] or [])
                 for b, s in zip(backends, sync_states)]
@@ -167,7 +185,6 @@ def generate_sync_messages_docs(backends, sync_states, deadline=None):
     return new_states, messages
 
 
-@_spanned('sync_receive')
 def receive_sync_messages_docs(backends, sync_states, binary_messages,
                                mirror=True, on_error='raise',
                                deadline=None, _decoded=None):
@@ -187,12 +204,48 @@ def receive_sync_messages_docs(backends, sync_states, binary_messages,
     `deadline` is checked at entry and again AFTER the (host-side,
     non-mutating) decode, immediately before the fused apply dispatch —
     a deadline that fires leaves every doc and sync state untouched
-    (typed DeadlineExceeded, all-or-nothing)."""
+    (typed DeadlineExceeded, all-or-nothing).
+
+    Messages carrying the trace ENVELOPE (a tracing peer generated with
+    ``trace_ctx``) are transparently stripped before decode, and the
+    round's spans adopt the first stripped trace id — the receive side
+    of cross-peer trace stitching. Plain messages pass through the
+    (one-byte) probe untouched."""
     n = len(backends)
     if len(sync_states) != n or len(binary_messages) != n:
         raise ValueError('backends, sync_states, and messages must align')
     if deadline is not None:
         deadline.check(what='receive_sync_messages_docs')
+    wire_ctx, binary_messages = _strip_trace_envelopes(binary_messages)
+    with _span('sync_receive', docs=n,
+               **_trace.trace_attr(wire_ctx)):
+        return _receive_inner(backends, sync_states, binary_messages,
+                              mirror, on_error, deadline, _decoded, n)
+
+
+def _strip_trace_envelopes(binary_messages):
+    """(first stripped TraceContext or None, messages with every trace
+    envelope removed). The input list is untouched (copied on first
+    strip); plain messages cost a one-byte probe. Every receive entry
+    point — batched AND mixed — must strip before any decode, or an
+    enveloped message from a tracing peer reads as hostile bytes."""
+    wire_ctx = None
+    stripped = None
+    for i, message_bytes in enumerate(binary_messages):
+        if message_bytes is not None and len(message_bytes) and \
+                message_bytes[0] == _trace.TRACE_MAGIC:
+            ctx, payload = _trace.unwrap(bytes(message_bytes))
+            if ctx is not None:
+                if stripped is None:
+                    stripped = list(binary_messages)
+                stripped[i] = payload
+                if wire_ctx is None:
+                    wire_ctx = ctx
+    return wire_ctx, (binary_messages if stripped is None else stripped)
+
+
+def _receive_inner(backends, sync_states, binary_messages, mirror,
+                   on_error, deadline, _decoded, n):
     quarantine = on_error == 'quarantine'
     if not quarantine and on_error != 'raise':
         raise ValueError(f"on_error must be 'raise' or 'quarantine', "
@@ -398,12 +451,17 @@ def receive_sync_messages_mixed(storage, docs, sync_states,
     if deadline is not None:
         # before the gate revives anything (see generate_..._mixed)
         deadline.check(what='receive_sync_messages_mixed')
+    # strip trace envelopes BEFORE the parked gate's decode — an
+    # enveloped message from a tracing peer would otherwise read as
+    # hostile bytes and quarantine a perfectly valid sync
+    _wire_ctx, binary_messages = _strip_trace_envelopes(binary_messages)
     quarantine = on_error == 'quarantine'
     docs_out = list(docs)
     fast = {}                   # i -> decoded message served parked
     pre_decoded = [None] * n    # parked-gate decodes, reused by the
     revive = []                 # live path (no double message parse)
-    with _span('sync_parked_gate', docs=n):
+    with _span('sync_parked_gate', docs=n,
+               **_trace.trace_attr(_wire_ctx)):
         for i, doc in enumerate(docs):
             if not isinstance(doc, int) or binary_messages[i] is None:
                 continue
@@ -436,12 +494,17 @@ def receive_sync_messages_mixed(storage, docs, sync_states,
     errors = [None] * n
     if live:
         try:
-            out = receive_sync_messages_docs(
-                [docs_out[i] for i in live],
-                [sync_states[i] for i in live],
-                [binary_messages[i] for i in live], mirror=mirror,
-                on_error=on_error, deadline=deadline,
-                _decoded=[pre_decoded[i] for i in live])
+            # the messages were already stripped above, so the inner
+            # receive's own probe finds no envelope — hand it the wire
+            # context as AMBIENT instead (trace_attr falls back to it),
+            # so the round's spans still adopt the peer's trace id
+            with _trace.use(_wire_ctx or _trace.current()):
+                out = receive_sync_messages_docs(
+                    [docs_out[i] for i in live],
+                    [sync_states[i] for i in live],
+                    [binary_messages[i] for i in live], mirror=mirror,
+                    on_error=on_error, deadline=deadline,
+                    _decoded=[pre_decoded[i] for i in live])
         except Exception:
             # round aborted after the gate revived docs (deadline at the
             # apply seam, or a raise-mode decode failure — both fire

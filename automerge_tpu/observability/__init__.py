@@ -30,36 +30,62 @@ phase, and what happened around it. Four layers, one package:
   the phase timeline around the fault without span churn ever evicting
   the fault events themselves.
 
+And the tenant telemetry plane on top (ISSUE-10):
+
+- **SLO accounting** (slo.py): per-(tenant, kind) SLIs — latency,
+  availability split by rejection class, subscription freshness — as
+  rolling deltas over the histograms/counters above, with multi-window
+  burn-rate alerting (hysteretic, edge-triggered, flight-recorded).
+- **Exposition** (export.py): Prometheus text format over every
+  counter, histogram, and SLO gauge, served by the stdlib-only
+  `MetricsExporter` (`AUTOMERGE_TPU_METRICS_PORT`; unset = fully off)
+  or written atomically to a snapshot file.
+- **Trace stitching** (tracecontext.py): `TraceContext` minted per
+  service request, span `links` on the fused batches, and an opt-in
+  wire envelope so two peers' sync span trees share one trace id —
+  merged by `tools/obs_report.py --stitch`.
+
 `enable()`/`disable()` flip spans + histograms together (the switch the
 bench's <=2% overhead budget is measured across); the flight recorder's
-event ring stays on either way. `tools/obs_report.py` renders a
-phase-attribution report from an exported trace or a forensic dump.
+event ring and the SLO accounting stay on either way (the latter has
+its own switch: `DocService(slo=False)`). `tools/obs_report.py` renders
+a phase-attribution report from an exported trace or a forensic dump.
 """
 
 from . import hist as _hist
 from . import recorder as _recorder
 from . import spans as _spans
+from .export import (MetricsExporter, maybe_start_exporter,
+                     render_prometheus)
 from .hist import (Histogram, histogram, histogram_delta,
                    histogram_snapshot, record_value)
-from .metrics import (Metrics, dispatch_counts, health_counts,
+from .metrics import (Metrics, counts_delta, dispatch_counts,
+                      dispatch_delta, health_counts, health_delta,
                       register_dispatch_source, register_health_source,
                       timed, trace)
 from .recorder import (configure as configure_flight_recorder, clear_events,
                        dump_flight_record, flight_stats, last_flight_record,
                        recent_events, record_event)
+from .slo import SloPolicy, SloRegistry, outcome_class, slo_stats
 from .spans import (clear as clear_spans, export_chrome_trace, iter_spans,
-                    record_span, span, span_count, span_seq, spanned)
+                    record_span, span, span_count, span_seq, spanned,
+                    spans_dropped)
+from .tracecontext import TraceContext
 
 __all__ = [
     'Metrics', 'timed', 'trace',
     'register_dispatch_source', 'dispatch_counts',
     'register_health_source', 'health_counts',
+    'counts_delta', 'health_delta', 'dispatch_delta',
     'span', 'span_seq', 'spanned', 'iter_spans', 'clear_spans',
-    'span_count', 'export_chrome_trace', 'record_span',
+    'span_count', 'export_chrome_trace', 'record_span', 'spans_dropped',
     'Histogram', 'histogram', 'record_value', 'histogram_snapshot',
     'histogram_delta',
     'record_event', 'recent_events', 'clear_events', 'dump_flight_record',
     'last_flight_record', 'flight_stats', 'configure_flight_recorder',
+    'SloPolicy', 'SloRegistry', 'outcome_class', 'slo_stats',
+    'MetricsExporter', 'maybe_start_exporter', 'render_prometheus',
+    'TraceContext',
     'enable', 'disable', 'enabled',
 ]
 

@@ -1,0 +1,294 @@
+"""Prometheus exposition: every counter, histogram, and SLO gauge on a
+machine-scrapable surface.
+
+``render_prometheus()`` renders text-format 0.0.4 exposition from an
+ATOMIC snapshot — every value is copied into plain data first, and a
+histogram's ``_count`` is derived from the very bucket vector the
+``_bucket`` lines are printed from, so a scrape racing a service tick
+can never show cumulative buckets that disagree with their own count
+(the classic torn-read artifact of rendering live state field by
+field). What lands on the page:
+
+- the health-counter roll-up (``automerge_tpu_health_total``) and the
+  device-dispatch roll-up (``automerge_tpu_dispatch_total``),
+- every registered histogram as cumulative buckets + sum + count
+  (log2 bucket upper bounds as ``le`` labels, trailing empty buckets
+  collapsed into ``+Inf``),
+- the span ring's truncation state (``automerge_tpu_spans_dropped``),
+- and, when an ``SloRegistry`` is passed: per-(tenant, kind) request
+  outcome counters, per-pair committed-latency histograms, burn-rate /
+  alert gauges per SLO and window, and worst cursor-lag gauges.
+
+``MetricsExporter`` is the stdlib-only serving thread: an HTTP server
+on ``127.0.0.1:<port>`` answering ``GET /metrics`` (port 0 binds an
+ephemeral port — the test mode), plus ``write_snapshot()`` for
+scrape-less environments: the same exposition rendered to a temp file
+and atomically renamed into place, so a sidecar tailing the file never
+reads a half-written page. ``maybe_start_exporter()`` is the
+env-driven entry: ``AUTOMERGE_TPU_METRICS_PORT`` unset means fully
+disabled — no server, no thread, nothing started.
+"""
+
+import os
+import threading
+
+from . import hist as _hist
+from . import spans as _spans
+from .metrics import dispatch_counts, health_counts
+
+__all__ = ['render_prometheus', 'snapshot_all', 'MetricsExporter',
+           'maybe_start_exporter', 'METRICS_PORT_ENV',
+           'METRICS_SNAPSHOT_ENV']
+
+METRICS_PORT_ENV = 'AUTOMERGE_TPU_METRICS_PORT'
+METRICS_SNAPSHOT_ENV = 'AUTOMERGE_TPU_METRICS_SNAPSHOT'
+_PREFIX = 'automerge_tpu'
+
+
+def _sanitize(name):
+    """A Prometheus-legal metric-name fragment."""
+    out = []
+    for ch in str(name):
+        out.append(ch if (ch.isascii() and (ch.isalnum() or ch == '_'))
+                   else '_')
+    frag = ''.join(out)
+    return frag if not frag[:1].isdigit() else '_' + frag
+
+
+def _label(value):
+    """A Prometheus-escaped label VALUE (quotes/backslashes/newlines)."""
+    return str(value).replace('\\', '\\\\').replace('"', '\\"') \
+        .replace('\n', '\\n')
+
+
+def _fmt(value):
+    if isinstance(value, float):
+        return repr(value)
+    return str(value)
+
+
+def _hist_snapshot(h):
+    """Torn-read-proof plain snapshot of one histogram: the bucket
+    vector is copied ONCE and count derived from that same copy, so
+    the rendered cumulative buckets always sum to the rendered count
+    even while another thread is recording."""
+    counts = list(h.counts)
+    return {'counts': counts, 'count': sum(counts),
+            'sum': float(h.total), 'scale': h.scale}
+
+
+def snapshot_all(slo=None, fleets=()):
+    """Every exposed value as plain data — the atomic snapshot both the
+    text renderer and the snapshot-file mode serialize from."""
+    snap = {
+        'health': health_counts(),
+        'dispatch': dispatch_counts(fleets),
+        'spans_dropped': _spans.spans_dropped(),
+        'histograms': {name: _hist_snapshot(h)
+                       for name, h in list(_hist._registry.items())},
+    }
+    if slo is not None:
+        snap['slo_tallies'] = slo.tallies()
+        snap['slo_gauges'] = slo.gauges()
+        snap['slo_lag'] = slo.lag_gauges()
+        snap['slo_hists'] = {key: _hist_snapshot(h)
+                             for key, h in slo.histograms().items()}
+    return snap
+
+
+def _render_hist_lines(lines, metric, snap, labels=''):
+    counts = snap['counts']
+    scale = snap['scale']
+    cum = 0
+    last = max((b for b, c in enumerate(counts) if c), default=-1)
+    sep = ',' if labels else ''
+    for b in range(last + 1):
+        cum += counts[b]
+        le = (1 << b) / scale
+        lines.append(f'{metric}_bucket{{{labels}{sep}le="{_fmt(le)}"}} '
+                     f'{cum}')
+    lines.append(f'{metric}_bucket{{{labels}{sep}le="+Inf"}} '
+                 f'{snap["count"]}')
+    lines.append(f'{metric}_sum{{{labels}}} {_fmt(snap["sum"])}'
+                 if labels else f'{metric}_sum {_fmt(snap["sum"])}')
+    lines.append(f'{metric}_count{{{labels}}} {snap["count"]}'
+                 if labels else f'{metric}_count {snap["count"]}')
+
+
+def render_prometheus(slo=None, fleets=()):
+    """The full text-format 0.0.4 exposition page (one trailing
+    newline), rendered from ``snapshot_all``."""
+    snap = snapshot_all(slo=slo, fleets=fleets)
+    lines = []
+
+    lines.append(f'# TYPE {_PREFIX}_health_total counter')
+    for name, value in sorted(snap['health'].items()):
+        lines.append(f'{_PREFIX}_health_total'
+                     f'{{counter="{_label(name)}"}} {value}')
+    lines.append(f'# TYPE {_PREFIX}_dispatch_total counter')
+    for name, value in sorted(snap['dispatch'].items()):
+        lines.append(f'{_PREFIX}_dispatch_total'
+                     f'{{source="{_label(name)}"}} {value}')
+    lines.append(f'# TYPE {_PREFIX}_spans_dropped gauge')
+    lines.append(f'{_PREFIX}_spans_dropped {snap["spans_dropped"]}')
+
+    for name, hsnap in sorted(snap['histograms'].items()):
+        metric = f'{_PREFIX}_{_sanitize(name)}'
+        lines.append(f'# TYPE {metric} histogram')
+        _render_hist_lines(lines, metric, hsnap)
+
+    if 'slo_tallies' in snap:
+        lines.append(f'# TYPE {_PREFIX}_slo_requests_total counter')
+        for (tenant, kind), tally in sorted(snap['slo_tallies'].items()):
+            for cls, value in sorted(tally.items()):
+                lines.append(
+                    f'{_PREFIX}_slo_requests_total'
+                    f'{{tenant="{_label(tenant)}",kind="{_label(kind)}",'
+                    f'outcome="{_label(cls)}"}} {value}')
+        lines.append(f'# TYPE {_PREFIX}_slo_burn_rate gauge')
+        lines.append(f'# TYPE {_PREFIX}_slo_alert_active gauge')
+        burn, alert = [], []
+        for (tenant, kind, sli), gauge in sorted(
+                snap['slo_gauges'].items()):
+            labels = (f'tenant="{_label(tenant)}",kind="{_label(kind)}",'
+                      f'sli="{_label(sli)}"')
+            for window in ('fast', 'slow'):
+                if f'{window}_burn' in gauge:
+                    burn.append(f'{_PREFIX}_slo_burn_rate{{{labels},'
+                                f'window="{window}"}} '
+                                f'{_fmt(gauge[f"{window}_burn"])}')
+                if f'alert_{window}' in gauge:
+                    alert.append(f'{_PREFIX}_slo_alert_active{{{labels},'
+                                 f'window="{window}"}} '
+                                 f'{gauge[f"alert_{window}"]}')
+        lines.extend(burn)
+        lines.extend(alert)
+        if snap['slo_lag']:
+            lines.append(f'# TYPE {_PREFIX}_slo_cursor_lag_ticks_max '
+                         f'gauge')
+            for (tenant, kind), lag in sorted(snap['slo_lag'].items()):
+                lines.append(
+                    f'{_PREFIX}_slo_cursor_lag_ticks_max'
+                    f'{{tenant="{_label(tenant)}",kind="{_label(kind)}"}}'
+                    f' {lag}')
+        if snap['slo_hists']:
+            metric = f'{_PREFIX}_slo_request_latency_seconds'
+            lines.append(f'# TYPE {metric} histogram')
+            for (tenant, kind), hsnap in sorted(snap['slo_hists'].items()):
+                labels = (f'tenant="{_label(tenant)}",'
+                          f'kind="{_label(kind)}"')
+                _render_hist_lines(lines, metric, hsnap, labels=labels)
+
+    return '\n'.join(lines) + '\n'
+
+
+class MetricsExporter:
+    """The serving thread (see the module docstring). ``start()`` binds
+    and serves; ``stop()`` shuts the server down and joins the thread.
+    With ``port=None`` no server is created — the instance is then a
+    snapshot-file writer only."""
+
+    def __init__(self, port=0, host='127.0.0.1', slo=None, fleets=(),
+                 snapshot_path=None):
+        self._port_arg = port
+        self.host = host
+        self.slo = slo
+        self.fleets = tuple(fleets)
+        self.snapshot_path = snapshot_path
+        self.port = None
+        self._server = None
+        self._thread = None
+
+    def render(self):
+        return render_prometheus(slo=self.slo, fleets=self.fleets)
+
+    # -- HTTP mode ------------------------------------------------------
+
+    def start(self):
+        """Bind (port 0 = ephemeral; ``self.port`` is then the real
+        one) and serve /metrics from a daemon thread. No-op when
+        ``port=None`` (snapshot-only mode) or already started."""
+        if self._port_arg is None or self._server is not None:
+            return self
+        import http.server
+
+        exporter = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.split('?', 1)[0] not in ('/metrics', '/'):
+                    self.send_error(404)
+                    return
+                body = exporter.render().encode()
+                self.send_response(200)
+                self.send_header('Content-Type',
+                                 'text/plain; version=0.0.4; '
+                                 'charset=utf-8')
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):    # scrapes are not stderr news
+                pass
+
+        self._server = http.server.ThreadingHTTPServer(
+            (self.host, int(self._port_arg)), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name='metrics-exporter',
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        """Shut the server down, close the socket, join the thread."""
+        if self._server is None:
+            return
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+        self._server = None
+        self._thread = None
+        self.port = None
+
+    @property
+    def running(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    # -- snapshot-file mode ---------------------------------------------
+
+    def write_snapshot(self, path=None):
+        """Render the exposition to ``path`` (default: the configured
+        ``snapshot_path``) atomically: temp file + rename, so a reader
+        never sees a torn page. Returns the path written."""
+        path = path if path is not None else self.snapshot_path
+        if path is None:
+            raise ValueError('no snapshot path configured')
+        body = self.render()
+        tmp = f'{path}.tmp.{os.getpid()}'
+        with open(tmp, 'w') as f:
+            f.write(body)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+
+
+def maybe_start_exporter(slo=None, fleets=()):
+    """The env-driven entry point: ``AUTOMERGE_TPU_METRICS_PORT`` set
+    starts (and returns) a serving ``MetricsExporter`` on that port
+    (0 = ephemeral); ``AUTOMERGE_TPU_METRICS_SNAPSHOT`` set (with no
+    port) returns a snapshot-only exporter bound to that file path;
+    NEITHER set returns None with zero threads started — telemetry
+    export is strictly opt-in."""
+    port = os.environ.get(METRICS_PORT_ENV)
+    snapshot = os.environ.get(METRICS_SNAPSHOT_ENV)
+    if port is not None and port != '':
+        exporter = MetricsExporter(port=int(port), slo=slo, fleets=fleets,
+                                   snapshot_path=snapshot or None)
+        return exporter.start()
+    if snapshot:
+        return MetricsExporter(port=None, slo=slo, fleets=fleets,
+                               snapshot_path=snapshot)
+    return None

@@ -105,13 +105,18 @@ class Histogram:
         return lo, hi
 
     def record(self, value):
-        self.counts[self.bucket_of(value)] += 1
+        """Returns the bucket index the value landed in, so a caller
+        that also classifies by bucket (the SLO latency SLI) pays
+        bucket_of once."""
+        b = self.bucket_of(value)
+        self.counts[b] += 1
         self.count += 1
         self.total += value
         if self.vmin is None or value < self.vmin:
             self.vmin = value
         if self.vmax is None or value > self.vmax:
             self.vmax = value
+        return b
 
     def record_many(self, values):
         """Vectorized record over an array-like of raw values — one

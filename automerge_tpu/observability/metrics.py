@@ -38,7 +38,8 @@ import re
 import time
 
 __all__ = ['Metrics', 'timed', 'trace', 'register_dispatch_source',
-           'dispatch_counts', 'register_health_source', 'health_counts']
+           'dispatch_counts', 'register_health_source', 'health_counts',
+           'counts_delta', 'health_delta', 'dispatch_delta']
 
 
 class Metrics:
@@ -155,6 +156,40 @@ def health_counts():
     """Snapshot every registered health counter. Counters are monotonic;
     subtract two snapshots around a workload to attribute events to it."""
     return {name: int(fn()) for name, fn in _health_sources.items()}
+
+
+# ---- snapshot/delta over counter roll-ups ---------------------------------
+#
+# The counter twin of Histogram.snapshot()/delta(): the roll-ups return
+# plain monotonic dicts, and every consumer used to subtract them by hand
+# (bench.py's faults section, obs_report dump comparisons, now the SLO
+# windows every tick). One shared subtraction keeps the semantics in one
+# place: keys are unioned, a key missing from either side reads 0.
+
+def counts_delta(now, prev):
+    """Per-key difference of two counter snapshots (``now - prev``).
+    Keys are unioned; a key absent from one side counts as 0 there, so
+    a counter that appeared (or a source registered) between the two
+    snapshots still contributes its full movement."""
+    out = {}
+    for k, v in now.items():
+        out[k] = v - prev.get(k, 0)
+    for k, v in prev.items():
+        if k not in now:
+            out[k] = -v
+    return out
+
+
+def health_delta(prev):
+    """Health counters accumulated since ``prev`` (an earlier
+    health_counts() snapshot)."""
+    return counts_delta(health_counts(), prev)
+
+
+def dispatch_delta(prev, fleets=()):
+    """Device dispatches accumulated since ``prev`` (an earlier
+    dispatch_counts() snapshot over the same fleets)."""
+    return counts_delta(dispatch_counts(fleets), prev)
 
 
 @contextlib.contextmanager

@@ -32,16 +32,25 @@ import json
 import threading
 import time
 
+from .metrics import register_health_source
+
 __all__ = ['enable', 'disable', 'on', 'span', 'span_seq', 'spanned',
            'clear', 'iter_spans', 'export_chrome_trace', 'Span',
-           'record_span']
+           'record_span', 'spans_dropped']
 
 _on = False                 # the master switch; module-global for one-load checks
 _ring = []                  # preallocated record slots (None until written)
 _cap = 0
 _idx = 0                    # next write position
 _total = 0                  # lifetime spans recorded (wraparound-aware)
+_dropped_lifetime = 0       # spans evicted by wraparound, never reset
 _lock = threading.Lock()    # guards ring writes only; reads copy under it
+
+# a wrapped ring silently truncating a trace is the no-silent-caps rule's
+# textbook violation: the health counter makes the loss countable, and
+# export_chrome_trace emits a synthetic marker event so the Perfetto view
+# itself discloses that older spans fell off
+register_health_source('spans_dropped', lambda: _dropped_lifetime)
 
 
 def on():
@@ -78,12 +87,14 @@ def clear():
 
 
 def _record(name, t0, t1, attrs, error, tid=None):
-    global _idx, _total
+    global _idx, _total, _dropped_lifetime
     rec = (name, t0, t1,
            threading.get_ident() if tid is None else tid, attrs, error)
     with _lock:
         if not _cap:
             return
+        if _ring[_idx] is not None:
+            _dropped_lifetime += 1
         _ring[_idx] = rec
         _idx = (_idx + 1) % _cap
         _total += 1
@@ -249,6 +260,14 @@ def span_count():
     return _total
 
 
+def spans_dropped():
+    """Spans evicted from the CURRENT ring by wraparound — the count of
+    older spans an export of this ring is missing (0 = the ring holds
+    the full trace). The 'spans_dropped' health counter is the lifetime
+    total across enable()/clear() cycles."""
+    return max(0, _total - _cap) if _cap else 0
+
+
 def export_chrome_trace(path=None, pid=1):
     """The recorded spans as Chrome trace-event 'X' (complete) events —
     the JSON Perfetto / chrome://tracing load. Timestamps are the raw
@@ -267,6 +286,18 @@ def export_chrome_trace(path=None, pid=1):
         if args:
             ev['args'] = args
         events.append(ev)
+    dropped = spans_dropped()
+    if dropped and events:
+        # truncation disclosure (no-silent-caps): a wrapped ring means
+        # this trace is a TAIL, not the run — say so inside the trace
+        # itself, as an instant event at the surviving window's start
+        events.insert(0, {
+            'ph': 'I', 'name': 'spans_dropped', 'pid': pid, 'tid': 0,
+            's': 'g', 'ts': events[0]['ts'],
+            'args': {'dropped': dropped,
+                     'note': 'span ring wrapped; this trace is the '
+                             f'newest window only ({dropped} older '
+                             'spans lost)'}})
     if path is not None:
         with open(path, 'w') as f:
             json.dump({'traceEvents': events,

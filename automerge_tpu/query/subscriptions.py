@@ -127,9 +127,13 @@ class Subscription:
     """One subscriber's hub-side state. ``cursor`` auto-advances to the
     pushed heads on every patch/resync event (delivery is assumed; a
     client that lost a push re-subscribes — or presents its own cursor
-    via ``resubscribe`` — and gets the idempotent diff again)."""
+    via ``resubscribe`` — and gets the idempotent diff again).
+    ``fresh_tick`` is the hub tick at which the cursor last matched the
+    document heads (the freshness SLI's anchor: a push's cursor lag is
+    the ticks elapsed since then)."""
 
-    __slots__ = ('id', 'key', 'cursor', 'priority', 'closed')
+    __slots__ = ('id', 'key', 'cursor', 'priority', 'closed',
+                 'fresh_tick')
 
     def __init__(self, sid, key, cursor, priority):
         self.id = sid
@@ -137,6 +141,7 @@ class Subscription:
         self.cursor = list(cursor)
         self.priority = priority
         self.closed = False
+        self.fresh_tick = None
 
     def __repr__(self):
         return (f'Subscription({self.id}, key={self.key!r}, '
@@ -151,10 +156,20 @@ class SubscriptionHub:
         self._sources = {}           # key -> query source
         self._subs = {}              # sub id -> Subscription
         self._next_sid = 0
+        self._slo = None             # (SloRegistry, tenant_of) when bound
         self.stats = {
             'ticks': 0, 'pushes': 0, 'resyncs': 0, 'quiet': 0,
-            'diffs_computed': 0, 'diffs_reused': 0,
+            'diffs_computed': 0, 'diffs_reused': 0, 'lag_max': 0,
         }
+
+    def bind_slo(self, registry, tenant_of=str):
+        """Feed the freshness SLI: every served push reports its cursor
+        lag (ticks since the subscriber was last at the heads) to
+        ``registry.record_freshness`` under ``tenant_of(key)`` — the
+        hub already walks each subscriber per tick, so the accounting
+        rides the walk instead of adding a rescan. ``registry=None``
+        unbinds."""
+        self._slo = None if registry is None else (registry, tenant_of)
 
     # -- documents -----------------------------------------------------
 
@@ -244,13 +259,25 @@ class SubscriptionHub:
                     memo[ckey] = event
                     if event is not None:
                         self.stats['diffs_computed'] += 1
+                tick_no = self.stats['ticks']
                 if event is None:
                     self.stats['quiet'] += 1
+                    sub.fresh_tick = tick_no   # at the heads right now
                     continue
                 events[sub.id] = event
                 sub.cursor = list(event['heads'])
                 self.stats['pushes'] += 1
                 _stats['subscription_pushes'] += 1
+                # freshness: this push catches the cursor up — its lag
+                # is the ticks since the subscriber was last at-frontier
+                lag = 0 if sub.fresh_tick is None \
+                    else tick_no - sub.fresh_tick
+                sub.fresh_tick = tick_no
+                if lag > self.stats['lag_max']:
+                    self.stats['lag_max'] = lag
+                if self._slo is not None:
+                    registry, tenant_of = self._slo
+                    registry.record_freshness(tenant_of(sub.key), lag)
         if invalid:
             _flight.dump_flight_record('query', detail={
                 'invalid_cursors': invalid})

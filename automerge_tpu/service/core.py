@@ -32,6 +32,15 @@ and fair under overload:
   (doc, cursor) equivalence class, zero device work). Subscription
   pushes default to sub-priority — the first citizens of the brownout
   shed stage.
+- **SLO telemetry** (observability/slo.py): every resolution — and
+  every typed admission-edge rejection — lands in the per-(tenant,
+  kind) SLI accounting; one registry evaluation per tick drives the
+  multi-window burn-rate alerts. ``slo=False`` is the telemetry-off
+  build the <=2% overhead budget is measured against. Requests carry a
+  ``TraceContext`` (observability/tracecontext.py): minted at submit
+  while spans are recording, recorded as span LINKS on the fused batch
+  spans, adopted from (and echoed into) the wire envelope on enveloped
+  sync exchanges.
 
 The core is deliberately tick-driven and synchronous (``pump()`` runs
 one batch round; the engine below is single-threaded by contract);
@@ -43,15 +52,17 @@ injected monotonic clock so tests and the loadgen drive it explicitly.
 import asyncio
 import time
 
-from ..errors import (DeadlineExceeded, Overloaded, RetriesExhausted,
-                      WireCorruption)
+from ..errors import (AutomergeError, DeadlineExceeded, Overloaded,
+                      RetriesExhausted, WireCorruption)
 from ..fleet import backend as fleet_backend
 from ..fleet.sync_driver import (generate_sync_messages_docs,
                                  receive_sync_messages_docs)
 from ..observability import hist as _hist
 from ..observability import recorder as _flight
+from ..observability import tracecontext as _trace
 from ..observability.metrics import register_health_source
-from ..observability.spans import span as _span
+from ..observability.slo import SloRegistry
+from ..observability.spans import on as _spans_on, span as _span
 from .admission import AdmissionController
 from .backoff import Backoff, RetryBudget
 from .brownout import BrownoutController
@@ -81,10 +92,15 @@ class Ticket:
     """One request's completion handle. ``status`` moves pending -> 'ok'
     (``result`` holds the reply, e.g. sync response bytes) or 'error'
     (``error`` holds the TYPED exception — shedding is never untyped).
-    ``latency`` is submit-to-resolution seconds on the service clock."""
+    ``latency`` is submit-to-resolution seconds on the service clock.
+    ``trace`` is the request's ``TraceContext`` (minted at submit while
+    spans are recording — the span-link audience — or adopted from the
+    client's wire envelope on an enveloped sync request); None when
+    nobody is tracing."""
 
     __slots__ = ('kind', 'tenant', 'session_id', 'status', 'result',
-                 'error', 'submitted_at', 'finished_at', '_future')
+                 'error', 'submitted_at', 'finished_at', '_future',
+                 'trace', '_slo')
 
     def __init__(self, kind, tenant, session_id, submitted_at):
         self.kind = kind
@@ -96,6 +112,8 @@ class Ticket:
         self.submitted_at = submitted_at
         self.finished_at = None
         self._future = None
+        self.trace = None
+        self._slo = None
 
     @property
     def done(self):
@@ -119,6 +137,13 @@ class Ticket:
             self.status = 'ok'
             self.result = result
             _stats['service_completed'] += 1
+        latency = self.finished_at - self.submitted_at
+        _hist.record_value('service_request_s', latency, scale=1e9,
+                           unit='s')
+        if self._slo is not None:
+            self._slo.record(self.tenant, self.kind, latency, error,
+                             trace=None if self.trace is None
+                             else self.trace.trace_id)
         if self._future is not None and not self._future.done():
             self._future.set_result(self)
 
@@ -129,7 +154,8 @@ class Ticket:
 
 class _Request:
     __slots__ = ('kind', 'session', 'payload', 'payload_fn', 'deadline',
-                 'priority', 'ticket', 'attempts', 'not_before', 'reset')
+                 'priority', 'ticket', 'attempts', 'not_before', 'reset',
+                 'enveloped')
 
     def __init__(self, kind, session, payload, payload_fn, deadline,
                  priority, ticket, reset=False):
@@ -143,6 +169,7 @@ class _Request:
         self.attempts = 0
         self.not_before = 0.0
         self.reset = reset
+        self.enveloped = False     # sync payload arrived trace-wrapped
 
     def draw_payload(self):
         """This attempt's bytes: the transport re-draw when the client
@@ -161,7 +188,8 @@ class Session:
 
     __slots__ = ('id', 'tenant', 'handle', 'sync_state', 'closed',
                  'sub_cursor', '_last_heads', '_stall_rounds',
-                 '_reconnect_attempts')
+                 '_reconnect_attempts', '_sub_served_tick',
+                 '_heads_moved_tick')
 
     def __init__(self, sid, tenant, handle):
         self.id = sid
@@ -173,6 +201,8 @@ class Session:
         self._last_heads = None
         self._stall_rounds = 0
         self._reconnect_attempts = 0
+        self._sub_served_tick = None   # tick of the last subscribe serve
+        self._heads_moved_tick = None  # first heads movement since then
 
 
 def _init_sync_state():
@@ -191,7 +221,7 @@ class DocService:
                  default_timeout=None,
                  backoff=None, retry_rate=20.0, retry_burst=40.0,
                  stall_rounds=8,
-                 brownout=None, clock=time.monotonic):
+                 brownout=None, slo=None, clock=time.monotonic):
         from ..fleet.backend import DocFleet
         self.durable = durable
         if durable is not None:
@@ -210,6 +240,14 @@ class DocService:
         self.stall_rounds = int(stall_rounds)
         self.brownout = brownout if brownout is not None \
             else BrownoutController()
+        # `slo`: None = a default SloRegistry (per-tenant SLI accounting
+        # on, DEFAULT_POLICIES objectives), an SloRegistry = use that
+        # (custom objectives), False = accounting fully off (the
+        # telemetry-off leg the <=2% overhead budget is measured
+        # against). Trace contexts are minted iff accounting is on or
+        # spans are recording.
+        self.slo = None if slo is False else \
+            (slo if slo is not None else SloRegistry())
         self._attached_journal = None
         self._attach_brownout_journal()
         self.sessions = {}
@@ -295,19 +333,45 @@ class DocService:
         if priority is None:
             priority = 0 if kind == 'subscribe' else 1
         if session.closed:
-            raise Overloaded('session closed', retry_after=None,
-                             shed=False, stage=None)
+            # the client's own fault (it kept a dead handle), so it
+            # burns the per-tenant 'throttled' budget, NOT the
+            # 'overloaded' budget that pages when the SERVICE sheds
+            raise self._slo_reject(session.tenant, kind, Overloaded(
+                'session closed', retry_after=None, shed=False,
+                stage=None, budget='throttled'))
         now = self.clock()
         if deadline is None:
             t = timeout if timeout is not None else self.default_timeout
             if t is not None:
                 deadline = Deadline(now + t, clock=self.clock)
         ticket = Ticket(kind, session.tenant, session.id, now)
+        ticket._slo = self.slo
+        if self.slo is not None or _spans_on():
+            # minting is lazy about its audience: mint while SLO
+            # accounting is on (the forensic dumps carry the id, so an
+            # alert's offending requests stitch into a trace) or while
+            # spans record (the span-link audience); an enveloped sync
+            # request brings its OWN context, adopted in the sync round
+            ticket.trace = _trace.mint()
         request = _Request(kind, session, payload, payload_fn, deadline,
                            priority, ticket, reset=reset)
-        self.admission.admit(session.tenant, request, now)
+        try:
+            self.admission.admit(session.tenant, request, now)
+        except AutomergeError as exc:
+            # edge rejections never mint a ticket, but they burn a
+            # tenant's availability budget all the same — account them
+            # before the typed raise leaves the building
+            raise self._slo_reject(session.tenant, kind, exc)
         _stats['service_requests'] += 1
         return ticket
+
+    def _slo_reject(self, tenant, kind, exc):
+        """Account a typed admission-edge rejection (latency 0: the
+        request never entered the system) and hand the error back for
+        raising."""
+        if self.slo is not None:
+            self.slo.record(tenant, kind, 0.0, exc)
+        return exc
 
     # -- the tick --------------------------------------------------------
 
@@ -322,6 +386,10 @@ class DocService:
             stats = self._pump_inner(now)
         _hist.record_value('service_tick_s', time.perf_counter() - start,
                            scale=1e9, unit='s')
+        if self.slo is not None:
+            # one evaluation round per service tick: the SLO windows are
+            # tick-denominated, like the brownout ladder's hysteresis
+            self.slo.tick(now)
         return stats
 
     def _pump_inner(self, now):
@@ -351,9 +419,11 @@ class DocService:
         for request in batch:
             ticket = request.ticket
             if request.session.closed:
+                # client's fault (disconnect left requests queued):
+                # throttled budget, same as the submit-edge twin above
                 ticket._finish(now, error=Overloaded(
                     'session closed', retry_after=None, shed=False,
-                    stage=None))
+                    stage=None, budget='throttled'))
                 stats['failed'] += 1
                 continue
             if request.deadline is not None and \
@@ -493,14 +563,17 @@ class DocService:
                 try:
                     payload = request.draw_payload()
                 except Exception as exc:       # a payload_fn that died
+                    # client-fault, like 'session closed': throttled
+                    # budget, not the paging overloaded one
                     bad.append((request, Overloaded(
                         f'transport draw failed: {exc!r}',
-                        retry_after=None, shed=False, stage=None)))
+                        retry_after=None, shed=False, stage=None,
+                        budget='throttled')))
                     continue
                 if payload is None:            # chaos disconnect mid-draw
                     bad.append((request, Overloaded(
                         'transport delivered nothing', retry_after=0.01,
-                        shed=False, stage=None)))
+                        shed=False, stage=None, budget='throttled')))
                     continue
                 changes.extend(bytes(b) for b in payload)
                 kept.append(request)
@@ -513,14 +586,26 @@ class DocService:
         if not sessions:
             return
         kept_requests = [r for kept in doc_requests for r in kept]
-        try:
-            new_handles, _patches, errors = fleet_backend.apply_changes_docs(
-                [s.handle for s in sessions], per_doc, mirror=False,
-                on_error='quarantine',
-                deadline=self._min_deadline(kept_requests))
-        except DeadlineExceeded:
-            self._seam_deadline_abort(kept_requests, now, stats)
-            return
+        # one fused dispatch serves N admitted requests: the batch span
+        # records every member's trace id as a span LINK, so a stitched
+        # trace can attribute the shared dispatch to each request tree
+        # (links are built only while spans record — the off-path cost
+        # is one flag check)
+        batch_span = _span('service_apply_batch', docs=len(sessions))
+        if _spans_on():
+            batch_span.set(links=[r.ticket.trace.trace_id
+                                  for r in kept_requests
+                                  if r.ticket.trace is not None])
+        with batch_span:
+            try:
+                new_handles, _patches, errors = \
+                    fleet_backend.apply_changes_docs(
+                        [s.handle for s in sessions], per_doc,
+                        mirror=False, on_error='quarantine',
+                        deadline=self._min_deadline(kept_requests))
+            except DeadlineExceeded:
+                self._seam_deadline_abort(kept_requests, now, stats)
+                return
         for session, handle, err, requests_ in zip(
                 sessions, new_handles, errors, doc_requests):
             # the quarantine seam returns a VALID handle for every slot
@@ -528,6 +613,11 @@ class DocService:
             # either way; only the tickets differ
             session.handle = handle
             if err is None:
+                if session._heads_moved_tick is None:
+                    # the freshness SLI's anchor: the first commit that
+                    # moves this doc's heads past the last subscription
+                    # serve starts the staleness clock
+                    session._heads_moved_tick = self.ticks
                 for request in requests_:
                     request.ticket._finish(now, result=len(request.payload)
                                            if request.payload is not None
@@ -555,7 +645,7 @@ class DocService:
         except Exception as exc:
             self._fail_or_retry(request, Overloaded(
                 f'transport draw failed: {exc!r}', retry_after=None,
-                shed=False, stage=None), now, stats)
+                shed=False, stage=None, budget='throttled'), now, stats)
             return None
         if payload is None:
             return list(request.session.sub_cursor)
@@ -656,6 +746,26 @@ class DocService:
                     _query_stats['subscription_diff_reuse'] += 1
                 _query_stats['subscription_pushes'] += 1
                 session.sub_cursor = list(event['heads'])
+                if self.slo is not None:
+                    # cursor lag in service ticks: how long this pull's
+                    # changes sat waiting — anchored at the tick the
+                    # doc's heads FIRST moved past the last serve, not
+                    # at the last serve itself (a slow poller whose
+                    # changes landed one tick ago reads lag 1, not its
+                    # whole poll gap). An empty patch means the cursor
+                    # was AT the heads: lag 0, the steady state.
+                    lag = 0
+                    if event['changes']:
+                        moved = session._heads_moved_tick
+                        if moved is not None:
+                            lag = self.ticks - moved
+                        elif session._sub_served_tick is not None:
+                            # heads moved via an unstamped path: the
+                            # poll gap is the honest upper bound
+                            lag = self.ticks - session._sub_served_tick
+                    self.slo.record_freshness(session.tenant, lag)
+                session._sub_served_tick = self.ticks
+                session._heads_moved_tick = None
                 request.ticket._finish(now, result=event)
                 stats['completed'] += 1
 
@@ -683,8 +793,22 @@ class DocService:
             except Exception as exc:
                 self._fail_or_retry(request, Overloaded(
                     f'transport draw failed: {exc!r}', retry_after=None,
-                    shed=False, stage=None), now, stats)
+                    shed=False, stage=None, budget='throttled'), now,
+                    stats)
                 continue
+            if payload is not None:
+                # a tracing client prepends the trace envelope to its
+                # sync bytes: adopt ITS trace id for this request (the
+                # client owns the trace) and remember to wrap the reply
+                ctx, payload = _trace.unwrap(payload)
+                # probed PER ATTEMPT: enveloped follows what THIS
+                # attempt's bytes carried, so a corrupt payload that
+                # happened to start with the magic (stripped here, then
+                # rejected by the decoder) cannot latch a plain client
+                # into enveloped replies after its clean retry
+                request.enveloped = ctx is not None
+                if ctx is not None:
+                    request.ticket.trace = ctx
             if request.reset:
                 # client reconnect: both ends handshake fresh (delivery
                 # is idempotent; only optimization state is discarded)
@@ -714,19 +838,25 @@ class DocService:
                                                messages):
                 session.sync_state = state
                 pre_replies[session.id] = message
-        try:
-            handles, states, _patches, errors = receive_sync_messages_docs(
-                [s.handle for s in sessions],
-                [s.sync_state for s in sessions], incoming,
-                mirror=False, on_error='quarantine',
-                deadline=self._min_deadline(live))
-        except DeadlineExceeded:
-            self._seam_deadline_abort(live, now, stats)
-            return
+        batch_span = _span('service_sync_batch', docs=len(sessions))
+        if _spans_on():
+            batch_span.set(links=[r.ticket.trace.trace_id for r in live
+                                  if r.ticket.trace is not None])
+        with batch_span:
+            try:
+                handles, states, _patches, errors = \
+                    receive_sync_messages_docs(
+                        [s.handle for s in sessions],
+                        [s.sync_state for s in sessions], incoming,
+                        mirror=False, on_error='quarantine',
+                        deadline=self._min_deadline(live))
+            except DeadlineExceeded:
+                self._seam_deadline_abort(live, now, stats)
+                return
         ok_sessions = []
         ok_requests = []
-        for session, handle, state, err, request in zip(
-                sessions, handles, states, errors, live):
+        for session, handle, state, err, request, message in zip(
+                sessions, handles, states, errors, live, incoming):
             session.handle = handle     # valid for rejected slots too
             if err is not None:
                 # corrupt client message: the doc CONTENT and sync state
@@ -734,10 +864,15 @@ class DocService:
                 self._fail_or_retry(request, err.error, now, stats)
                 continue
             session.sync_state = state
+            if message is not None and session._heads_moved_tick is None:
+                # a received sync message may have applied changes: start
+                # the freshness clock (conservative — a quiet handshake
+                # stamps too, costing at most a one-serve overestimate)
+                session._heads_moved_tick = self.ticks
             if request.reset:
                 # reply = the pre-receive handshake generated above
-                request.ticket._finish(now,
-                                       result=pre_replies.get(session.id))
+                request.ticket._finish(now, result=self._wrap_reply(
+                    request, pre_replies.get(session.id)))
                 stats['completed'] += 1
                 continue
             ok_sessions.append(session)
@@ -751,8 +886,19 @@ class DocService:
         for session, state, reply, request in zip(
                 ok_sessions, new_states, replies, ok_requests):
             session.sync_state = state
-            request.ticket._finish(now, result=reply)
+            request.ticket._finish(now,
+                                   result=self._wrap_reply(request, reply))
             stats['completed'] += 1
+
+    def _wrap_reply(self, request, reply):
+        """Trace-envelope a sync reply IFF the request arrived enveloped
+        (the client opted in; plain clients always get plain bytes) —
+        stamped with the service's own span id so the two sides of the
+        exchange are distinct nodes of one trace."""
+        if reply is None or not request.enveloped or \
+                request.ticket.trace is None:
+            return reply
+        return _trace.wrap(reply, request.ticket.trace.child())
 
     def _detect_stalls(self, sessions, now):
         """Reconnect-on-stall with jittered backoff + the tenant retry

@@ -223,6 +223,42 @@ def test_span_ring_wraparound_keeps_newest():
     observability.disable()
 
 
+def test_wrapped_ring_discloses_truncation():
+    """No-silent-caps: a wrapped span ring must disclose the loss — in
+    the spans_dropped() count, the health counter, and a synthetic
+    marker event inside the Chrome-trace export itself."""
+    from automerge_tpu.observability import health_counts
+    h0 = health_counts()
+    observability.enable(span_capacity=4)
+    for i in range(10):
+        with observability.span(f's{i}'):
+            pass
+    assert observability.spans_dropped() == 6
+    assert observability.health_delta(h0)['spans_dropped'] == 6
+    events = observability.export_chrome_trace()
+    marker = [e for e in events if e['ph'] == 'I' and
+              e['name'] == 'spans_dropped']
+    assert len(marker) == 1
+    assert marker[0]['args']['dropped'] == 6
+    assert marker[0]['ts'] == events[1]['ts']   # at the window's start
+    # an unwrapped ring emits NO marker
+    observability.enable(span_capacity=16)
+    with observability.span('only'):
+        pass
+    assert observability.spans_dropped() == 0
+    assert not [e for e in observability.export_chrome_trace()
+                if e['ph'] == 'I']
+    observability.disable()
+
+
+def test_counts_delta_unions_keys():
+    from automerge_tpu.observability import counts_delta
+    assert counts_delta({'a': 5, 'b': 2}, {'a': 3}) == {'a': 2, 'b': 2}
+    # a source present only in the baseline still reports its movement
+    assert counts_delta({}, {'gone': 4}) == {'gone': -4}
+    assert counts_delta({}, {}) == {}
+
+
 def test_spans_balanced_under_exceptions():
     """Every begin has an end even when the block raises; the exception
     type is recorded on the span."""

@@ -48,6 +48,14 @@ def assert_leg_ok(report):
     assert conv['sync_converged'] == conv['sync_drained'], report
     for key in report['rejections']:
         assert not key.startswith('UNTYPED'), report
+    # the SLO audit (ISSUE-10): the registry's per-tenant outcome
+    # tallies match the client-observed typed outcomes EXACTLY — a
+    # double count or a missed reject under the chaos/quarantine storm
+    # fails the leg
+    audit = report['slo_audit']
+    assert audit is not None and 'mismatches' in audit, report
+    assert audit['mismatches'] == [], audit
+    assert audit['pairs_checked'] > 0, audit
 
 
 def test_service_chaos_smoke():

@@ -43,7 +43,11 @@ GRANDFATHER_BUDGETS = {
     'test_service_chaos_identical_across_device_modes': 15.0,
     'tests/test_sequence.py::TestLongDocSharding::'
     'test_sharded_matches_local': 15.0,
-    'tests/test_chaos.py::test_chaos_checkpoint_crash_recover': 12.0,
+    # measured 3.9s isolated / ~4.8s in-suite on the reference box, but
+    # observed at 22.2s under full-suite contention on this box (round
+    # 14; family wall time UNCHANGED vs the prior tree, so contention,
+    # not a regression) — budgeted off the contended worst case
+    'tests/test_chaos.py::test_chaos_checkpoint_crash_recover': 30.0,
     'tests/test_multihost.py::'
     'test_two_process_pairwise_sync_converges': 12.0,
     'tests/test_fleet_backend.py::TestSequenceSeam::'

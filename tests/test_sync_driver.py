@@ -303,6 +303,44 @@ class TestParkedGate:
         for i, state in enumerate(out_ls):
             assert sorted(state['theirHeads']) == eng.heads(ids[i])
 
+    def test_enveloped_messages_pass_the_parked_gate(self):
+        """A trace-enveloped sync message from a tracing peer must be
+        stripped BEFORE the parked gate's decode — unstripped, the
+        0x54 magic read as hostile bytes and a valid quiet message was
+        quarantined (regression: the strip lived only in the batched
+        receive entry point)."""
+        from automerge_tpu.fleet.storage import StorageEngine
+        from automerge_tpu.fleet.sync_driver import (
+            receive_sync_messages_mixed)
+        from automerge_tpu.observability import tracecontext as tc
+
+        import automerge_tpu.observability as obs
+
+        fleet, docs, peers, ls, ps = self._converged_population()
+        eng = StorageEngine(fleet)
+        ids = eng.park(docs)
+        ps2, peer_msgs = zip(*[generate_sync_message(p, dict(
+            s, lastSentHeads=None)) for p, s in zip(peers, ps)])
+        ctxs = [tc.mint() for _ in peer_msgs]
+        wrapped = [tc.wrap(m, c) for m, c in zip(peer_msgs, ctxs)]
+        obs.enable()
+        obs.clear_spans()
+        try:
+            # on_error='raise': an unstripped envelope raises typed here
+            out_docs, out_ls, _patches = receive_sync_messages_mixed(
+                eng, ids, ls, wrapped)
+            spans = {s['name']: s for s in obs.iter_spans()}
+        finally:
+            obs.disable()
+        assert out_docs == ids               # quiet: still parked
+        for i, state in enumerate(out_ls):
+            assert sorted(state['theirHeads']) == eng.heads(ids[i])
+        # the mixed entry point ADOPTS the stripped envelope's trace id
+        # (first one wins), not just tolerates it — stitching works for
+        # parked populations too
+        assert spans['sync_parked_gate']['attrs']['trace'] == \
+            ctxs[0].trace_id
+
     def test_divergent_peer_revives_only_its_doc(self):
         from automerge_tpu.fleet.storage import StorageEngine
         from automerge_tpu.fleet.sync_driver import (

@@ -1,0 +1,282 @@
+"""Cross-peer trace stitching (ISSUE-10): TraceContext, the wire
+envelope, span links on the fused service batches, and the two-peer
+stitch through tools/obs_report.py.
+
+The stitching contracts pinned here: enveloping is strictly opt-in
+(trace_ctx=None produces byte-identical wire traffic, and the receive
+side's strip is transparent — same states, same replies), a service
+sync reply is enveloped IFF the request arrived enveloped, and a
+two-peer exchange exported from both sides stitches into ONE Perfetto
+trace whose sync spans share the request's trace id."""
+
+import json
+import os
+import sys
+
+import pytest
+
+import automerge_tpu as A
+from automerge_tpu import backend as host_backend, native
+from automerge_tpu import observability as obs
+from automerge_tpu.columnar import encode_change
+from automerge_tpu.observability import tracecontext as tc
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), 'tools'))
+
+import obs_report                                 # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _obs_off():
+    yield
+    obs.disable()
+
+
+def change_bytes(actor, seq, deps=(), val=1):
+    return encode_change({
+        'actor': actor, 'seq': seq, 'startOp': seq, 'time': 0,
+        'message': '', 'deps': list(deps),
+        'ops': [{'action': 'set', 'obj': '_root', 'key': 'k',
+                 'value': val, 'datatype': 'int', 'pred': []}]})
+
+
+def host_doc(actor, n_changes=0):
+    doc = A.frontend.get_backend_state(A.init(actor), f'tc-{actor}')
+    deps = []
+    for s in range(1, n_changes + 1):
+        doc, _ = host_backend.apply_changes(
+            doc, [change_bytes(actor, s, deps, val=s)])
+        deps = host_backend.get_heads(doc)
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# the context + envelope primitives
+# ---------------------------------------------------------------------------
+
+
+def test_mint_unique_and_child_shares_trace():
+    a, b = tc.mint(), tc.mint()
+    assert a.trace_id != b.trace_id
+    assert len(a.trace_id) == 16 and len(a.span_id) == 16
+    child = a.child()
+    assert child.trace_id == a.trace_id
+    assert child.span_id != a.span_id
+
+
+def test_wrap_unwrap_roundtrip_and_passthrough():
+    ctx = tc.mint()
+    wrapped = tc.wrap(b'payload', ctx)
+    assert wrapped[0] == tc.TRACE_MAGIC
+    got, payload = tc.unwrap(wrapped)
+    assert payload == b'payload' and got == ctx
+    # passthrough: plain bytes, short bytes, None
+    assert tc.unwrap(b'plain') == (None, b'plain')
+    assert tc.unwrap(b'\x54ab') == (None, b'\x54ab')
+    assert tc.unwrap(None) == (None, None)
+    # wrap with no ctx is the identity
+    assert tc.wrap(b'x', None) == b'x'
+
+
+def test_envelope_magic_disjoint_from_wire_frames():
+    from automerge_tpu.backend.sync import MESSAGE_TYPE_SYNC
+    from automerge_tpu.query.subscriptions import CURSOR_MAGIC
+    assert tc.TRACE_MAGIC not in (MESSAGE_TYPE_SYNC, CURSOR_MAGIC)
+
+
+def test_use_nests_and_restores():
+    assert tc.current() is None
+    a, b = tc.mint(), tc.mint()
+    with tc.use(a):
+        assert tc.current() is a
+        assert tc.trace_attr() == {'trace': a.trace_id}
+        with tc.use(b):
+            assert tc.current() is b
+        assert tc.current() is a
+    assert tc.current() is None
+    assert tc.trace_attr() == {}
+
+
+# ---------------------------------------------------------------------------
+# the sync driver: opt-in envelope, transparent strip
+# ---------------------------------------------------------------------------
+
+
+def test_generate_envelope_opt_in_and_strip_transparent():
+    from automerge_tpu.fleet.sync_driver import (
+        generate_sync_messages_docs, receive_sync_messages_docs)
+    a = host_doc('aa' * 16, 3)
+    sa = host_backend.init_sync_state()
+    (s_plain,), (plain,) = generate_sync_messages_docs([a], [sa])
+    ctx = tc.mint()
+    (s_traced,), (traced,) = generate_sync_messages_docs(
+        [a], [sa], trace_ctx=ctx)
+    # the envelope is a pure prefix: stripping it restores the exact
+    # plain-wire bytes (byte-identity holds under tracing)
+    got_ctx, stripped = tc.unwrap(traced)
+    assert got_ctx.trace_id == ctx.trace_id
+    assert bytes(stripped) == bytes(plain)
+    # receive strips transparently: same states either way
+    b1 = host_doc('bb' * 16)
+    b2 = host_doc('bb' * 16)
+    _, (st1,), _ = receive_sync_messages_docs(
+        [b1], [host_backend.init_sync_state()], [plain])
+    _, (st2,), _ = receive_sync_messages_docs(
+        [b2], [host_backend.init_sync_state()], [traced])
+    assert st1 == st2
+
+
+def test_sync_spans_carry_trace_attr():
+    from automerge_tpu.fleet.sync_driver import (
+        generate_sync_messages_docs, receive_sync_messages_docs)
+    a = host_doc('aa' * 16, 2)
+    b = host_doc('bb' * 16)
+    obs.enable()
+    obs.clear_spans()
+    ctx = tc.mint()
+    with tc.use(ctx):
+        _, (msg,) = generate_sync_messages_docs(
+            [a], [host_backend.init_sync_state()], trace_ctx=ctx)
+    receive_sync_messages_docs([b], [host_backend.init_sync_state()],
+                               [msg])
+    spans = {s['name']: s for s in obs.iter_spans()}
+    assert spans['sync_generate']['attrs']['trace'] == ctx.trace_id
+    # the receive side adopted the STRIPPED envelope's id — same trace
+    assert spans['sync_receive']['attrs']['trace'] == ctx.trace_id
+
+
+# ---------------------------------------------------------------------------
+# the service: minting, reply enveloping, batch links
+# ---------------------------------------------------------------------------
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason='native codec unavailable')
+
+
+@needs_native
+def test_service_reply_enveloped_iff_request_was():
+    from automerge_tpu.fleet.backend import DocFleet
+    from automerge_tpu.service import DocService
+    svc = DocService(fleet=DocFleet(doc_capacity=8, key_capacity=64),
+                     tenant_rate=10_000.0, tenant_burst=1000.0)
+    plain_s, traced_s = svc.open_sessions(['p', 't'])
+
+    client = host_doc('cc' * 16, 2)
+    state, msg = host_backend.generate_sync_message(
+        client, host_backend.init_sync_state())
+    ctx = tc.mint()
+    t_plain = svc.submit(plain_s, 'sync', msg)
+    t_traced = svc.submit(traced_s, 'sync', tc.wrap(msg, ctx))
+    svc.pump()
+    assert t_plain.status == 'ok' and t_traced.status == 'ok'
+    # plain request: plain reply
+    assert t_plain.result is None or t_plain.result[0] != tc.TRACE_MAGIC
+    # enveloped request: the ticket adopts the client's trace id and the
+    # reply comes back enveloped under the same trace
+    assert t_traced.trace.trace_id == ctx.trace_id
+    assert t_traced.result is not None
+    reply_ctx, reply = tc.unwrap(t_traced.result)
+    assert reply_ctx is not None
+    assert reply_ctx.trace_id == ctx.trace_id
+    assert reply_ctx.span_id != ctx.span_id    # the service's own node
+    # the stripped reply is a decodable sync message
+    host_backend.receive_sync_message(client, state, reply)
+
+
+@needs_native
+def test_service_batch_spans_link_member_traces():
+    from automerge_tpu.fleet.backend import DocFleet
+    from automerge_tpu.service import DocService
+    svc = DocService(fleet=DocFleet(doc_capacity=8, key_capacity=64),
+                     tenant_rate=10_000.0, tenant_burst=1000.0)
+    s1, s2 = svc.open_sessions(['a', 'b'])
+    obs.enable()
+    obs.clear_spans()
+    t1 = svc.submit(s1, 'apply', [change_bytes('aa' * 16, 1)])
+    t2 = svc.submit(s2, 'apply', [change_bytes('bb' * 16, 1)])
+    svc.pump()
+    obs.disable()
+    assert t1.status == 'ok' and t2.status == 'ok'
+    assert t1.trace is not None and t2.trace is not None
+    batch = [s for s in obs.iter_spans()
+             if s['name'] == 'service_apply_batch']
+    assert len(batch) == 1
+    links = batch[0]['attrs']['links']
+    assert set(links) == {t1.trace.trace_id, t2.trace.trace_id}
+
+
+# ---------------------------------------------------------------------------
+# the acceptance: two peers, one stitched Perfetto trace
+# ---------------------------------------------------------------------------
+
+
+def test_two_peer_exchange_stitches_to_one_trace(tmp_path):
+    from automerge_tpu.fleet.sync_driver import (
+        generate_sync_messages_docs, receive_sync_messages_docs)
+    a = host_doc('aa' * 16, 3)
+    b = host_doc('bb' * 16)
+    sa = host_backend.init_sync_state()
+    sb = host_backend.init_sync_state()
+
+    obs.enable()
+    obs.clear_spans()
+    ctx = tc.mint()
+    # peer A generates under the trace (envelope on the wire)...
+    with tc.use(ctx):
+        (sa,), (msg,) = generate_sync_messages_docs([a], [sa],
+                                                    trace_ctx=ctx)
+    peer_a = tmp_path / 'peer_a.json'
+    obs.export_chrome_trace(str(peer_a))
+    obs.clear_spans()
+    # ...peer B receives it (the "other process": its own span ring) and
+    # answers, continuing the SAME trace from the stripped envelope
+    (b,), (sb,), _ = receive_sync_messages_docs([b], [sb], [msg])
+    reply_ctx, _payload = tc.unwrap(msg)
+    with tc.use(reply_ctx):
+        generate_sync_messages_docs([b], [sb],
+                                    trace_ctx=reply_ctx.child())
+    peer_b = tmp_path / 'peer_b.json'
+    obs.export_chrome_trace(str(peer_b))
+    obs.disable()
+
+    out = tmp_path / 'stitched.json'
+    shared = obs_report.render_stitch([str(peer_a), str(peer_b)],
+                                      str(out))
+    # ONE trace id spans both peers' exports
+    assert ctx.trace_id in shared
+    stitched = json.loads(out.read_text())['traceEvents']
+    by_pid = {}
+    for event in stitched:
+        if event.get('ph') != 'X':
+            continue
+        ids = obs_report._event_trace_ids(event)
+        if ctx.trace_id in ids:
+            by_pid.setdefault(event['pid'], []).append(event['name'])
+    # both peers contribute sync spans to the request's trace
+    assert set(by_pid) == {1, 2}
+    assert 'sync_generate' in by_pid[1]
+    assert 'sync_receive' in by_pid[2]
+    # process metadata names the inputs
+    names = [e['args']['name'] for e in stitched
+             if e.get('ph') == 'M']
+    assert names == ['peer_a.json', 'peer_b.json']
+
+
+def test_stitch_accepts_flight_dumps(tmp_path):
+    from automerge_tpu.observability import recorder as obs_recorder
+    obs.enable()
+    obs.clear_spans()
+    ctx = tc.mint()
+    with obs.span('work', trace=ctx.trace_id):
+        pass
+    dump = obs_recorder.dump_flight_record(
+        'unit', path=str(tmp_path / 'flight.json'))
+    assert dump['recent_spans']
+    trace = tmp_path / 'trace.json'
+    obs.export_chrome_trace(str(trace))
+    obs.disable()
+    shared = obs_report.render_stitch(
+        [str(tmp_path / 'flight.json'), str(trace)],
+        str(tmp_path / 'out.json'))
+    assert ctx.trace_id in shared

@@ -24,9 +24,14 @@ bucketed BY TYPE (an untyped escape anywhere fails the run), brownout
 ladder transitions, and a convergence audit: every edit session's doc
 must be byte-identical to an unloaded control fleet fed exactly the
 committed requests, and every sync session's client replica must reach
-head-equality with its service doc after a drain. Used by
+head-equality with its service doc after a drain. Every leg also runs
+the SLO AUDIT (ISSUE-10): the service SloRegistry's per-tenant outcome
+tallies must match the client-observed typed outcomes EXACTLY, so a
+double-count or missed-reject in the accounting plane fails the leg —
+and ``latency_step=(tick, extra_s)`` injects a synthetic mid-leg
+latency regression for timing the burn-rate alert's detection. Used by
 tests/test_service_chaos.py (small doses) and bench.py's ``service``
-section (10k sessions).
+and ``slo`` sections (10k sessions).
 
 Standalone:  python tools/loadgen.py            # default three legs
              LOADGEN_SESSIONS=10000 LOADGEN_REQUESTS=40000 \
@@ -48,6 +53,7 @@ from automerge_tpu.columnar import encode_change              # noqa: E402
 from automerge_tpu.errors import AutomergeError                # noqa: E402
 from automerge_tpu.fleet import backend as fleet_backend      # noqa: E402
 from automerge_tpu.fleet.backend import DocFleet              # noqa: E402
+from automerge_tpu.observability.slo import outcome_class     # noqa: E402
 from automerge_tpu.service import DocService                  # noqa: E402
 
 __all__ = ['ZipfSampler', 'ChaosClient', 'run_leg', 'run_standard_legs']
@@ -223,14 +229,30 @@ def run_leg(name, *, sessions=1000, tenants=64, zipf_s=1.2,
             chaos=False, overload=False, seed=0, exact_device=False,
             durable_dir=None, fleet=None, deadline_s=None,
             service_kwargs=None, max_ticks=200_000, convergence=True,
-            tick_dt=None, collect_saves=False):
+            tick_dt=None, collect_saves=False, latency_step=None):
     """One leg. Returns the report dict (see module docstring).
 
     `tick_dt` switches the service onto a FAKE clock advanced by that
     many seconds per pump — the whole leg becomes a deterministic
     function of its seed (the cross-device-mode byte-identity tests run
     the same script twice and diff the saves). `collect_saves` adds
-    `session_saves` ({session_id: (actor, save_hex)}) to the report."""
+    `session_saves` ({session_id: (actor, save_hex)}) to the report.
+
+    `latency_step=(tick, extra_s)` injects a SYNTHETIC latency
+    regression mid-leg (requires `tick_dt`): from that tick until the
+    leg's arrivals end, every pump advances the fake clock by an extra
+    `extra_s`, so every in-flight request's measured latency jumps by
+    it — the controlled fault the SLO fast-window burn alert must catch
+    (the bench `slo` section and the acceptance test time its
+    detection). The report then carries `slo_step_tick` and
+    `slo_alerts`.
+
+    Every leg whose service keeps the default SLO accounting ends with
+    the SLO AUDIT: the registry's per-tenant outcome tallies must match
+    the client-side typed-outcome counts EXACTLY (`slo_audit` in the
+    report; tools and main() fail on any mismatch) — the double-count /
+    missed-reject detector for the accounting plane under quarantine
+    storms."""
     rng = random.Random(seed)
     zipf = ZipfSampler(tenants, zipf_s)
     chaos_client = ChaosClient(seed + 1) if chaos else None
@@ -260,12 +282,19 @@ def run_leg(name, *, sessions=1000, tenants=64, zipf_s=1.2,
         arrivals_per_tick *= 2
     if service_kwargs:
         kwargs.update(service_kwargs)
+    if latency_step is not None and tick_dt is None:
+        raise ValueError('latency_step needs the tick_dt fake clock')
     _clk = [0.0]
     if tick_dt is not None:
         kwargs.setdefault('clock', lambda: _clk[0])
     service = DocService(fleet=fleet, durable=durable, **kwargs)
+    _inject = [False]              # latency_step currently applying
 
     def pump():
+        if _inject[0]:
+            # the injected regression: age every in-flight request by
+            # extra_s before the tick serves it
+            _clk[0] += latency_step[1]
         service.pump()
         if tick_dt is not None:
             _clk[0] += tick_dt
@@ -290,6 +319,10 @@ def run_leg(name, *, sessions=1000, tenants=64, zipf_s=1.2,
         by_tenant.setdefault(tenant_of_session[i], []).append(client)
 
     counts = {'ok': 0}
+    # the client-side half of the SLO audit: every typed outcome this
+    # client observes, tallied (tenant, budget class) — the registry's
+    # server-side tallies must match these EXACTLY
+    client_tally = {}
     latencies = []
     untyped = 0
     submitted = 0
@@ -297,8 +330,13 @@ def run_leg(name, *, sessions=1000, tenants=64, zipf_s=1.2,
     disconnected = 0
     replayed = 0
 
+    def tally(tenant, error):
+        key = (tenant, outcome_class(error))
+        client_tally[key] = client_tally.get(key, 0) + 1
+
     def note(ticket):
         nonlocal untyped
+        tally(ticket.tenant, ticket.error)
         if ticket.status == 'ok':
             counts['ok'] += 1
             if ticket.latency is not None:
@@ -322,6 +360,7 @@ def run_leg(name, *, sessions=1000, tenants=64, zipf_s=1.2,
         except AutomergeError as exc:
             key = type(exc).__name__
             counts[key] = counts.get(key, 0) + 1
+            tally(client.session.tenant, exc)
             return None
         except Exception as exc:       # would be an untyped escape
             counts[f'UNTYPED:{type(exc).__name__}'] = \
@@ -336,6 +375,10 @@ def run_leg(name, *, sessions=1000, tenants=64, zipf_s=1.2,
     while (submitted < requests or not service.idle()) and \
             ticks < max_ticks:
         ticks += 1
+        if latency_step is not None:
+            # the regression applies only while arrivals keep coming
+            # (mid-leg): the drain after the loop must converge clean
+            _inject[0] = ticks >= latency_step[0] and submitted < requests
         # -- arrivals (open loop: these do not wait for completions)
         n_arrive = min(arrivals_per_tick, requests - submitted)
         for _ in range(max(0, n_arrive)):
@@ -411,6 +454,34 @@ def run_leg(name, *, sessions=1000, tenants=64, zipf_s=1.2,
             if isinstance(client, _EditSession):
                 client.harvest()
     elapsed = time.perf_counter() - start
+    _inject[0] = False
+
+    # -- SLO audit: the registry's per-tenant outcome tallies vs the
+    #    client-observed typed outcomes. Exact equality or the
+    #    accounting plane double-counted / missed a reject somewhere in
+    #    the retry/quarantine/disconnect machinery.
+    slo_audit = None
+    if service.slo is not None:
+        pending = sum(1 for t, _ in tickets if not t.done)
+        if pending:
+            slo_audit = {'skipped': f'{pending} tickets still pending '
+                                    f'at max_ticks'}
+        else:
+            server_tally = {}
+            for (tenant, _kind), outcomes in service.slo.tallies().items():
+                for cls, n in outcomes.items():
+                    key = (tenant, cls)
+                    server_tally[key] = server_tally.get(key, 0) + n
+            mismatches = []
+            for key in sorted(set(server_tally) | set(client_tally)):
+                want = client_tally.get(key, 0)
+                got = server_tally.get(key, 0)
+                if want != got:
+                    mismatches.append({'tenant': key[0], 'outcome': key[1],
+                                       'client': want, 'registry': got})
+            slo_audit = {'pairs_checked': len(set(server_tally) |
+                                              set(client_tally)),
+                         'mismatches': mismatches}
 
     # -- drain: finish the sync handshakes fault-free so convergence is
     #    assertable (the wire is quiet, the service keeps admitting)
@@ -520,7 +591,16 @@ def run_leg(name, *, sessions=1000, tenants=64, zipf_s=1.2,
             'sync_drained': drained,
             'sync_converged': converged_sync,
         } if convergence else None,
+        'slo_audit': slo_audit,
     }
+    if service.slo is not None:
+        report['slo_alerts'] = [
+            {'tick': t, 'tenant': tenant, 'kind': kind, 'sli': sli,
+             'window': window, 'edge': edge, 'burn': burn}
+            for t, tenant, kind, sli, window, edge, burn in
+            service.slo.alert_log]
+        if latency_step is not None:
+            report['slo_step_tick'] = latency_step[0]
     if collect_saves:
         report['session_saves'] = {
             c.session.id: (c.actor,
@@ -558,9 +638,16 @@ def main():
     for leg in run_standard_legs(sessions=sessions, tenants=tenants,
                                  requests=requests, seed=seed):
         print(json.dumps(leg))
+        audit = leg.get('slo_audit')
+        # a SKIPPED audit (tickets still pending at max_ticks) fails the
+        # leg like a mismatch would — same contract the test harness's
+        # assert_leg_ok enforces; silently passing it would mask a hung
+        # or backlogged leg
         ok = leg['untyped_escapes'] == 0 and (
             leg['convergence'] is None or
-            leg['convergence']['edit_mismatches'] == 0)
+            leg['convergence']['edit_mismatches'] == 0) and (
+            audit is None or ('mismatches' in audit
+                              and not audit['mismatches']))
         print(f"# {leg['leg']}: {leg['completed_ok']}/{leg['submitted']} "
               f"ok, p99 {leg['p99_ms']}ms, {leg['rounds_per_s']} rounds/s, "
               f"stage {leg['brownout_stage_final']}, "

@@ -1,9 +1,12 @@
 """Phase-attribution report from a host-span Chrome trace (or a flight
-recorder forensic dump).
+recorder forensic dump), and the cross-peer trace stitcher.
 
 Usage:
     python tools/obs_report.py traces/obs_host_trace.json
     python tools/obs_report.py --flight flight-quarantine-1.json
+    python tools/obs_report.py --flight after.json before.json
+    python tools/obs_report.py --stitch peer_a.json peer_b.json \\
+                               [-o stitched_trace.json]
 
 Trace mode reads the Chrome trace-event JSON that
 ``observability.export_chrome_trace`` writes (a bare event list or a
@@ -18,20 +21,60 @@ turbo_dispatch), so percentages legitimately sum past 100; the
 
 Flight mode pretty-prints a forensic dump: trigger, per-doc errors
 (slot, durable id, stage, typed error), then the surrounding event ring.
+With a second (baseline) dump, the health counters print as the DELTA
+between the two dumps — the counter twin of the histogram delta, so two
+forensic snapshots bracket an incident the way two bench snapshots
+bracket a workload.
 
-stdlib only — usable on a box with nothing else installed.
+Stitch mode merges span exports from MULTIPLE peers — Chrome traces
+(export_chrome_trace) or flight dumps (their ``recent_spans``) — into
+ONE Perfetto-loadable trace: each input renders as its own named
+process, each file's clock is rebased to its own start (perf_counter
+epochs do not align across processes), and spans that share a
+``trace``/``links`` id are reported so a request minted on one peer can
+be followed into the other peer's generate/receive span tree.
+
+stdlib only — usable on a box with nothing else installed (the counter
+delta helper is loaded straight from
+automerge_tpu/observability/metrics.py by file path, which keeps one
+implementation without importing the package).
 """
 
+import importlib.util
 import json
+import os
 import sys
 
 
-def load_events(path):
+def _metrics_mod():
+    """observability/metrics.py loaded by path (stdlib importlib only):
+    the shared counts_delta without pulling the package import chain."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), 'automerge_tpu', 'observability',
+        'metrics.py')
+    spec = importlib.util.spec_from_file_location('_obs_metrics', path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def load_events(path, phases=('X',)):
     with open(path) as f:
         data = json.load(f)
     if isinstance(data, dict):
-        data = data.get('traceEvents', [])
-    return [e for e in data if e.get('ph') == 'X']
+        if 'traceEvents' in data:
+            data = data.get('traceEvents', [])
+        elif 'recent_spans' in data:
+            # a flight dump: its span tail in iter_spans() shape
+            data = [{'ph': 'X', 'name': s['name'],
+                     'ts': s['t0_ns'] / 1000.0,
+                     'dur': s['dur_ns'] / 1000.0,
+                     'tid': s.get('tid', 0) % 1_000_000,
+                     'args': s.get('attrs') or {}}
+                    for s in data['recent_spans']]
+        else:
+            data = []
+    return [e for e in data if e.get('ph') in phases]
 
 
 def _union(intervals):
@@ -115,7 +158,76 @@ def render_trace(path, out=sys.stdout):
     return rows
 
 
-def render_flight(path, out=sys.stdout):
+def _event_trace_ids(event):
+    """Trace ids an event references: its own ``trace`` attr plus any
+    batch-span ``links`` (the fused-dispatch -> member-request edges)."""
+    args = event.get('args') or {}
+    ids = set()
+    if args.get('trace'):
+        ids.add(args['trace'])
+    for link in args.get('links') or ():
+        ids.add(link)
+    return ids
+
+
+def stitch(paths, out_path=None):
+    """Merge multiple peers' span exports into one Perfetto trace (see
+    the module docstring). Returns (events, shared_trace_ids) where
+    shared ids appear in MORE than one input — the stitched requests."""
+    events = []
+    ids_by_file = []
+    for pid, path in enumerate(paths, start=1):
+        file_events = load_events(path, phases=('X', 'I'))
+        t0 = min((float(e.get('ts', 0.0)) for e in file_events),
+                 default=0.0)
+        ids = set()
+        events.append({'ph': 'M', 'name': 'process_name', 'pid': pid,
+                       'tid': 0, 'args': {'name': os.path.basename(path)}})
+        for e in file_events:
+            e = dict(e)
+            e['pid'] = pid
+            # each process's perf_counter epoch is private: rebase every
+            # file to its own start so the peers render side by side
+            # (cross-host clocks cannot be aligned; the trace ids are
+            # the correlation, not the timestamps)
+            e['ts'] = float(e.get('ts', 0.0)) - t0
+            e.setdefault('tid', 0)
+            events.append(e)
+            ids |= _event_trace_ids(e)
+        ids_by_file.append(ids)
+    shared = set()
+    for i, ids in enumerate(ids_by_file):
+        for other in ids_by_file[i + 1:]:
+            shared |= ids & other
+    if out_path is not None:
+        with open(out_path, 'w') as f:
+            json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'},
+                      f)
+    return events, shared
+
+
+def render_stitch(paths, out_path, out=sys.stdout):
+    events, shared = stitch(paths, out_path)
+    spans = [e for e in events if e.get('ph') == 'X']
+    print(f'# stitched {len(paths)} peers: {len(spans)} spans'
+          f'{" -> " + out_path if out_path else ""}', file=out)
+    by_trace = {}
+    for e in spans:
+        for tid in _event_trace_ids(e) & shared:
+            by_trace.setdefault(tid, []).append(e)
+    for trace_id in sorted(shared):
+        rows = by_trace.get(trace_id, [])
+        peers = sorted({e['pid'] for e in rows})
+        names = sorted({e.get('name', '?') for e in rows})
+        print(f'# trace {trace_id}: {len(rows)} spans across peers '
+              f'{peers} ({", ".join(names)})', file=out)
+    if not shared:
+        print('# no trace ids shared across inputs (were the messages '
+              'enveloped? generate with trace_ctx=...)', file=out)
+    return shared
+
+
+def render_flight(path, baseline=None, out=sys.stdout):
     with open(path) as f:
         report = json.load(f)
     print(f'# flight record: trigger={report.get("trigger")!r} '
@@ -144,9 +256,18 @@ def render_flight(path, out=sys.stdout):
             print(f'  {s["name"]:<22}{s["dur_ns"] / 1e6:9.3f} ms'
                   f'{extra}{err}', file=out)
     health = report.get('health') or {}
-    moved = {k: v for k, v in health.items() if v}
-    if moved:
-        print(f'# health counters at dump: {moved}', file=out)
+    if baseline is not None:
+        with open(baseline) as f:
+            base_health = json.load(f).get('health') or {}
+        moved = {k: v for k, v in _metrics_mod().counts_delta(
+            health, base_health).items() if v}
+        if moved:
+            print(f'# health counters moved since {baseline}: {moved}',
+                  file=out)
+    else:
+        moved = {k: v for k, v in health.items() if v}
+        if moved:
+            print(f'# health counters at dump: {moved}', file=out)
     return report
 
 
@@ -158,7 +279,25 @@ def main(argv):
         if len(argv) < 2:
             print('--flight needs a dump path', file=sys.stderr)
             return 2
-        render_flight(argv[1])
+        render_flight(argv[1], baseline=argv[2] if len(argv) > 2 else None)
+        return 0
+    if argv[0] == '--stitch':
+        paths = []
+        out_path = 'stitched_trace.json'
+        rest = argv[1:]
+        while rest:
+            arg = rest.pop(0)
+            if arg == '-o':
+                if not rest:
+                    print('-o needs a path', file=sys.stderr)
+                    return 2
+                out_path = rest.pop(0)
+            else:
+                paths.append(arg)
+        if len(paths) < 2:
+            print('--stitch needs at least two exports', file=sys.stderr)
+            return 2
+        render_stitch(paths, out_path)
         return 0
     render_trace(argv[0])
     return 0
