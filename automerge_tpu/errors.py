@@ -30,7 +30,8 @@ batch-fatal. This module is that contract:
   wire with a longer flight time, and recovery gives rotted disk bytes
   the same one-doc blast radius the sync wire gets.
 - Load-shedding rejections (``Overloaded``, ``TenantThrottled``,
-  ``DeadlineExceeded``, ``RetriesExhausted``, ``SyncStalled``) mean the
+  ``DeadlineExceeded``, ``RetriesExhausted``, ``SyncStalled``,
+  ``ShardUnavailable``) mean the
   INPUT was fine but the system declined the work: global or per-tenant
   admission control refused it, its deadline passed before the fused
   dispatch, or its retry/reconnect budget ran dry (service/ and
@@ -65,7 +66,8 @@ __all__ = [
     'TornTail', 'MalformedSnapshot', 'InvalidChange',
     'DanglingPred', 'DuplicateOpId', 'SyncOverflow', 'DocError',
     'Overloaded', 'TenantThrottled', 'DeadlineExceeded',
-    'RetriesExhausted', 'SyncStalled',
+    'RetriesExhausted', 'SyncStalled', 'SessionClosed',
+    'ShardUnavailable',
     'InvalidCursor', 'UnknownHeads',
     'as_wire_error',
 ]
@@ -163,6 +165,29 @@ class TenantThrottled(Overloaded):
     `tenant` and `retry_after`."""
 
     budget = 'throttled'
+
+
+class SessionClosed(Overloaded):
+    """The request's session was closed before it could be served (the
+    client disconnected, or kept a dead handle after a failover or
+    migration moved its tenant). Burns the 'throttled' budget — the
+    CLIENT's fault, not the service shedding. A dedicated type so the
+    shard router can recognize 'this session moved out from under a
+    queued request' structurally and retry on the new home, instead of
+    matching message text."""
+
+    budget = 'throttled'
+
+
+class ShardUnavailable(Overloaded):
+    """The tenant's home shard is dead or unreachable (crashed, lease
+    expired, or not yet failed over) — the request never reached a
+    serving shard. Carries `shard` (the unavailable shard id, when
+    known), `tenant`, and `retry_after`: the router's failover machinery
+    re-homes the tenant within the lease window, so a budgeted jittered
+    retry normally lands on the replica. Burns the 'overloaded'
+    availability budget — a dead shard is the SERVICE's fault, never
+    the tenant's."""
 
 
 class DeadlineExceeded(AutomergeError, ValueError):

@@ -54,17 +54,85 @@ class LossyLink:
     of payloads the receiver actually sees for this send (possibly empty,
     possibly two, possibly corrupted); `flush()` releases any message still
     held back by an in-flight reorder. Stats count per fault class plus
-    sent/delivered totals."""
+    sent/delivered totals.
+
+    Beyond the five PER-MESSAGE fault kinds, the link models two
+    STATEFUL faults — a peer going dark for K ticks and then healing —
+    because a dead peer is a different failure class from per-message
+    loss: every message in the window vanishes (no lucky deliveries for
+    a retry to ride), and chaos tests of failover need exactly that
+    shape. ``tick()`` is the link's clock (drivers call it once per
+    sync round); ``partition(k)`` darkens the wire for k ticks (the
+    peer is fine, the network is not); ``crash(k)`` darkens it AND
+    drops any reorder-held in-flight message (the peer process died —
+    everything in its buffers died with it; the caller models the rest
+    of a crash by resetting the peer's sync state). ``p_partition``
+    draws partitions randomly at transmit time, each lasting
+    ``partition_ticks``. One dark WINDOW counts as one injected fault
+    (one budget token) in its own stats bucket; the messages it
+    swallows are consequences, tallied under ``dark_dropped``."""
 
     def __init__(self, seed=0, p_drop=0.0, p_dup=0.0, p_reorder=0.0,
-                 p_truncate=0.0, p_flip=0.0, budget=None):
+                 p_truncate=0.0, p_flip=0.0, budget=None,
+                 p_partition=0.0, partition_ticks=8):
         self.rng = random.Random(seed)
         self.p = {'dropped': p_drop, 'duplicated': p_dup,
                   'reordered': p_reorder, 'truncated': p_truncate,
                   'flipped': p_flip}
         self.budget = budget          # None = unbounded fault injection
-        self.stats = dict.fromkeys(_FAULT_KINDS + ('sent', 'delivered'), 0)
+        self.p_partition = float(p_partition)
+        self.partition_ticks = int(partition_ticks)
+        self.stats = dict.fromkeys(
+            _FAULT_KINDS + ('partitioned', 'crashed', 'dark_dropped',
+                            'sent', 'delivered'), 0)
         self._held = None             # message delayed by a reorder fault
+        self._ticks = 0               # the link clock (tick())
+        self._dark_until = 0          # ticks < this = peer dark
+
+    # -- stateful faults ------------------------------------------------
+
+    @property
+    def dark(self):
+        """True while a partition/crash window is open."""
+        return self._ticks < self._dark_until
+
+    def tick(self):
+        """Advance the link clock one round; dark windows heal when the
+        clock reaches their end."""
+        self._ticks += 1
+
+    def _spend_budget(self):
+        if self.budget is not None:
+            if self.budget <= 0:
+                return False
+            self.budget -= 1
+        return True
+
+    def _darken(self, ticks, kind):
+        if not self._spend_budget():
+            return False
+        self._dark_until = max(self._dark_until, self._ticks + int(ticks))
+        self.stats[kind] += 1
+        _fault_totals['injected'] += 1
+        return True
+
+    def partition(self, ticks=None):
+        """Open (or extend) a partition: the wire is dark for `ticks`
+        link ticks, then heals. Returns False when the fault budget is
+        dry (no window opened)."""
+        return self._darken(ticks if ticks is not None
+                            else self.partition_ticks, 'partitioned')
+
+    def crash(self, ticks=None):
+        """The peer process dies for `ticks` link ticks: dark wire AND
+        any reorder-held in-flight message is lost with the process.
+        The caller completes the crash model by resetting the peer's
+        sync state when it 'restarts'."""
+        ok = self._darken(ticks if ticks is not None
+                          else self.partition_ticks, 'crashed')
+        if ok:
+            self._held = None
+        return ok
 
     def _draw_fault(self):
         """Pick at most one fault class for this message. The PRNG draw
@@ -76,10 +144,8 @@ class LossyLink:
         for kind in _FAULT_KINDS:
             acc += self.p[kind]
             if roll < acc:
-                if self.budget is not None:
-                    if self.budget <= 0:
-                        return None
-                    self.budget -= 1
+                if not self._spend_budget():
+                    return None
                 self.stats[kind] += 1
                 _fault_totals['injected'] += 1
                 return kind
@@ -101,6 +167,17 @@ class LossyLink:
         """Send one message (None = nothing to send this tick). Returns
         the payloads delivered to the receiver, in arrival order."""
         deliveries = []
+        if payload is not None and self.p_partition > 0.0 and \
+                not self.dark and self.rng.random() < self.p_partition:
+            # a randomly-drawn dark window (the PRNG draw happens only
+            # on real sends, so seeded traces stay send-aligned)
+            self.partition()
+        if payload is not None and self.dark:
+            # the peer is dark: the whole send vanishes — no dup, no
+            # corruption, no reorder hold, just silence
+            self.stats['sent'] += 1
+            self.stats['dark_dropped'] += 1
+            return []
         if payload is not None:
             payload = bytes(payload)
             self.stats['sent'] += 1
@@ -228,6 +305,12 @@ def sync_until_quiet(doc_a, doc_b, backend_a, backend_b, link_ab=None,
             ([msg_ba] if msg_ba is not None else [])
         _deliver(recv_b, out_ab, quarantined)
         _deliver(recv_a, out_ba, quarantined)
+        # the round IS the link clock: stateful dark windows
+        # (partition/crash) heal after their K rounds
+        if link_ab is not None:
+            link_ab.tick()
+        if link_ba is not None:
+            link_ba.tick()
 
         if msg_ab is None and msg_ba is None:
             # quiet — but drain any reorder-held messages first: a held

@@ -38,10 +38,11 @@ from .metrics import dispatch_counts, health_counts
 
 __all__ = ['render_prometheus', 'snapshot_all', 'MetricsExporter',
            'maybe_start_exporter', 'METRICS_PORT_ENV',
-           'METRICS_SNAPSHOT_ENV']
+           'METRICS_SNAPSHOT_ENV', 'SHARD_ENV']
 
 METRICS_PORT_ENV = 'AUTOMERGE_TPU_METRICS_PORT'
 METRICS_SNAPSHOT_ENV = 'AUTOMERGE_TPU_METRICS_SNAPSHOT'
+SHARD_ENV = 'AUTOMERGE_TPU_SHARD'
 _PREFIX = 'automerge_tpu'
 
 
@@ -96,6 +97,12 @@ def snapshot_all(slo=None, fleets=()):
     return snap
 
 
+def _labelset(*parts):
+    """'{a,b}' from the non-empty label fragments, '' when none."""
+    joined = ','.join(p for p in parts if p)
+    return f'{{{joined}}}' if joined else ''
+
+
 def _render_hist_lines(lines, metric, snap, labels=''):
     counts = snap['counts']
     scale = snap['scale']
@@ -115,36 +122,43 @@ def _render_hist_lines(lines, metric, snap, labels=''):
                  if labels else f'{metric}_count {snap["count"]}')
 
 
-def render_prometheus(slo=None, fleets=()):
+def render_prometheus(slo=None, fleets=(), shard=None):
     """The full text-format 0.0.4 exposition page (one trailing
-    newline), rendered from ``snapshot_all``."""
+    newline), rendered from ``snapshot_all``. ``shard`` stamps a
+    ``shard="<id>"`` label on EVERY sample line — the process-level
+    identity a multi-shard deployment scrapes by (one exporter per
+    shard process; the in-process ``ShardRouter`` testbed renders one
+    page per shard the same way), so per-shard dashboards and the
+    failover runbooks can select a single failure domain."""
     snap = snapshot_all(slo=slo, fleets=fleets)
+    sl = f'shard="{_label(shard)}"' if shard is not None else ''
     lines = []
 
     lines.append(f'# TYPE {_PREFIX}_health_total counter')
     for name, value in sorted(snap['health'].items()):
-        lines.append(f'{_PREFIX}_health_total'
-                     f'{{counter="{_label(name)}"}} {value}')
+        ls = _labelset(sl, 'counter="%s"' % _label(name))
+        lines.append(f'{_PREFIX}_health_total{ls} {value}')
     lines.append(f'# TYPE {_PREFIX}_dispatch_total counter')
     for name, value in sorted(snap['dispatch'].items()):
-        lines.append(f'{_PREFIX}_dispatch_total'
-                     f'{{source="{_label(name)}"}} {value}')
+        ls = _labelset(sl, 'source="%s"' % _label(name))
+        lines.append(f'{_PREFIX}_dispatch_total{ls} {value}')
     lines.append(f'# TYPE {_PREFIX}_spans_dropped gauge')
-    lines.append(f'{_PREFIX}_spans_dropped {snap["spans_dropped"]}')
+    lines.append(f'{_PREFIX}_spans_dropped{_labelset(sl)} '
+                 f'{snap["spans_dropped"]}')
 
     for name, hsnap in sorted(snap['histograms'].items()):
         metric = f'{_PREFIX}_{_sanitize(name)}'
         lines.append(f'# TYPE {metric} histogram')
-        _render_hist_lines(lines, metric, hsnap)
+        _render_hist_lines(lines, metric, hsnap, labels=sl)
 
     if 'slo_tallies' in snap:
         lines.append(f'# TYPE {_PREFIX}_slo_requests_total counter')
         for (tenant, kind), tally in sorted(snap['slo_tallies'].items()):
             for cls, value in sorted(tally.items()):
-                lines.append(
-                    f'{_PREFIX}_slo_requests_total'
-                    f'{{tenant="{_label(tenant)}",kind="{_label(kind)}",'
-                    f'outcome="{_label(cls)}"}} {value}')
+                ls = _labelset(sl, (f'tenant="{_label(tenant)}",'
+                                    f'kind="{_label(kind)}",'
+                                    f'outcome="{_label(cls)}"'))
+                lines.append(f'{_PREFIX}_slo_requests_total{ls} {value}')
         lines.append(f'# TYPE {_PREFIX}_slo_burn_rate gauge')
         lines.append(f'# TYPE {_PREFIX}_slo_alert_active gauge')
         burn, alert = [], []
@@ -153,13 +167,12 @@ def render_prometheus(slo=None, fleets=()):
             labels = (f'tenant="{_label(tenant)}",kind="{_label(kind)}",'
                       f'sli="{_label(sli)}"')
             for window in ('fast', 'slow'):
+                ls = _labelset(sl, f'{labels},window="{window}"')
                 if f'{window}_burn' in gauge:
-                    burn.append(f'{_PREFIX}_slo_burn_rate{{{labels},'
-                                f'window="{window}"}} '
+                    burn.append(f'{_PREFIX}_slo_burn_rate{ls} '
                                 f'{_fmt(gauge[f"{window}_burn"])}')
                 if f'alert_{window}' in gauge:
-                    alert.append(f'{_PREFIX}_slo_alert_active{{{labels},'
-                                 f'window="{window}"}} '
+                    alert.append(f'{_PREFIX}_slo_alert_active{ls} '
                                  f'{gauge[f"alert_{window}"]}')
         lines.extend(burn)
         lines.extend(alert)
@@ -167,17 +180,19 @@ def render_prometheus(slo=None, fleets=()):
             lines.append(f'# TYPE {_PREFIX}_slo_cursor_lag_ticks_max '
                          f'gauge')
             for (tenant, kind), lag in sorted(snap['slo_lag'].items()):
-                lines.append(
-                    f'{_PREFIX}_slo_cursor_lag_ticks_max'
-                    f'{{tenant="{_label(tenant)}",kind="{_label(kind)}"}}'
-                    f' {lag}')
+                ls = _labelset(sl, (f'tenant="{_label(tenant)}",'
+                                    f'kind="{_label(kind)}"'))
+                lines.append(f'{_PREFIX}_slo_cursor_lag_ticks_max{ls}'
+                             f' {lag}')
         if snap['slo_hists']:
             metric = f'{_PREFIX}_slo_request_latency_seconds'
             lines.append(f'# TYPE {metric} histogram')
             for (tenant, kind), hsnap in sorted(snap['slo_hists'].items()):
                 labels = (f'tenant="{_label(tenant)}",'
                           f'kind="{_label(kind)}"')
-                _render_hist_lines(lines, metric, hsnap, labels=labels)
+                _render_hist_lines(lines, metric, hsnap,
+                                   labels=','.join(
+                                       p for p in (sl, labels) if p))
 
     return '\n'.join(lines) + '\n'
 
@@ -189,18 +204,20 @@ class MetricsExporter:
     snapshot-file writer only."""
 
     def __init__(self, port=0, host='127.0.0.1', slo=None, fleets=(),
-                 snapshot_path=None):
+                 snapshot_path=None, shard=None):
         self._port_arg = port
         self.host = host
         self.slo = slo
         self.fleets = tuple(fleets)
         self.snapshot_path = snapshot_path
+        self.shard = shard
         self.port = None
         self._server = None
         self._thread = None
 
     def render(self):
-        return render_prometheus(slo=self.slo, fleets=self.fleets)
+        return render_prometheus(slo=self.slo, fleets=self.fleets,
+                                 shard=self.shard)
 
     # -- HTTP mode ------------------------------------------------------
 
@@ -275,20 +292,25 @@ class MetricsExporter:
         return path
 
 
-def maybe_start_exporter(slo=None, fleets=()):
+def maybe_start_exporter(slo=None, fleets=(), shard=None):
     """The env-driven entry point: ``AUTOMERGE_TPU_METRICS_PORT`` set
     starts (and returns) a serving ``MetricsExporter`` on that port
     (0 = ephemeral); ``AUTOMERGE_TPU_METRICS_SNAPSHOT`` set (with no
     port) returns a snapshot-only exporter bound to that file path;
     NEITHER set returns None with zero threads started — telemetry
-    export is strictly opt-in."""
+    export is strictly opt-in. ``AUTOMERGE_TPU_SHARD`` (or the `shard`
+    arg, which wins) stamps the shard identity label on every sample —
+    how a shard process names its failure domain to the scraper."""
     port = os.environ.get(METRICS_PORT_ENV)
     snapshot = os.environ.get(METRICS_SNAPSHOT_ENV)
+    if shard is None:
+        shard = os.environ.get(SHARD_ENV) or None
     if port is not None and port != '':
         exporter = MetricsExporter(port=int(port), slo=slo, fleets=fleets,
-                                   snapshot_path=snapshot or None)
+                                   snapshot_path=snapshot or None,
+                                   shard=shard)
         return exporter.start()
     if snapshot:
         return MetricsExporter(port=None, slo=slo, fleets=fleets,
-                               snapshot_path=snapshot)
+                               snapshot_path=snapshot, shard=shard)
     return None

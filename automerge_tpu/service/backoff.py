@@ -22,7 +22,7 @@ system is jittered, capped, and budgeted:
 
 import random
 
-__all__ = ['Backoff', 'RetryBudget']
+__all__ = ['Backoff', 'RetryBudget', 'RetryBudgetPool']
 
 
 class Backoff:
@@ -89,3 +89,21 @@ class RetryBudget:
     def available(self, now):
         self._refill(now)
         return self.tokens
+
+
+class RetryBudgetPool:
+    """Lazy per-tenant ``RetryBudget`` map with one shared rate/burst
+    config — the memoization both ``DocService`` and ``ShardRouter``
+    need, kept in ONE place so budget semantics can't diverge."""
+
+    def __init__(self, rate, burst):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._budgets = {}
+
+    def get(self, tenant):
+        b = self._budgets.get(tenant)
+        if b is None:
+            b = self._budgets[tenant] = RetryBudget(rate=self.rate,
+                                                    burst=self.burst)
+        return b

@@ -53,7 +53,7 @@ import asyncio
 import time
 
 from ..errors import (AutomergeError, DeadlineExceeded, Overloaded,
-                      RetriesExhausted, WireCorruption)
+                      RetriesExhausted, SessionClosed, WireCorruption)
 from ..fleet import backend as fleet_backend
 from ..fleet.sync_driver import (generate_sync_messages_docs,
                                  receive_sync_messages_docs)
@@ -64,7 +64,7 @@ from ..observability.metrics import register_health_source
 from ..observability.slo import SloRegistry
 from ..observability.spans import on as _spans_on, span as _span
 from .admission import AdmissionController
-from .backoff import Backoff, RetryBudget
+from .backoff import Backoff, RetryBudgetPool
 from .brownout import BrownoutController
 from .deadline import Deadline
 
@@ -234,9 +234,7 @@ class DocService:
         self.batch_limit = int(batch_limit)
         self.default_timeout = default_timeout
         self.backoff = backoff if backoff is not None else Backoff()
-        self._retry_budgets = {}       # tenant -> RetryBudget
-        self._retry_rate = float(retry_rate)
-        self._retry_burst = float(retry_burst)
+        self._retry_budgets = RetryBudgetPool(retry_rate, retry_burst)
         self.stall_rounds = int(stall_rounds)
         self.brownout = brownout if brownout is not None \
             else BrownoutController()
@@ -266,11 +264,7 @@ class DocService:
             self._attached_journal = journal
 
     def _retry_budget(self, tenant):
-        b = self._retry_budgets.get(tenant)
-        if b is None:
-            b = self._retry_budgets[tenant] = RetryBudget(
-                rate=self._retry_rate, burst=self._retry_burst)
-        return b
+        return self._retry_budgets.get(tenant)
 
     # -- sessions -------------------------------------------------------
 
@@ -299,6 +293,35 @@ class DocService:
             return
         session.closed = True
         fleet_backend.free_docs([session.handle])
+        self.sessions.pop(session.id, None)
+
+    def adopt_session(self, tenant, handle):
+        """Bind a fresh session to an EXISTING doc of this service's
+        fleet — the shard failover/migration promotion path: the doc
+        already lives here (a warm replica kept current by inter-shard
+        replication, or a migrant revived from a transferred chunk) and
+        gains a serving session without any init dispatch. The session
+        starts from scratch on everything BUT the doc: fresh per-peer
+        sync state (the re-homed client reconnects with ``reset=True``
+        — both ends handshake fresh; delivery is idempotent) and an
+        empty subscription cursor (the router re-registers the standing
+        cursor it tracked, and a cursor naming heads this doc never saw
+        resolves as a TYPED resync, never a silently stale patch)."""
+        sid = self._next_sid
+        self._next_sid += 1
+        session = Session(sid, tenant, handle)
+        self.sessions[sid] = session
+        return session
+
+    def release_session(self, session):
+        """Unbind a session WITHOUT freeing its doc — the migration
+        donor path: the doc's bytes were just parked/transferred (its
+        slot is already free), so ``close_session``'s free would
+        double-free. Still-queued requests resolve typed ('session
+        closed') when their turn comes, exactly like a disconnect."""
+        if session.closed:
+            return
+        session.closed = True
         self.sessions.pop(session.id, None)
 
     # -- submission ------------------------------------------------------
@@ -336,9 +359,9 @@ class DocService:
             # the client's own fault (it kept a dead handle), so it
             # burns the per-tenant 'throttled' budget, NOT the
             # 'overloaded' budget that pages when the SERVICE sheds
-            raise self._slo_reject(session.tenant, kind, Overloaded(
+            raise self._slo_reject(session.tenant, kind, SessionClosed(
                 'session closed', retry_after=None, shed=False,
-                stage=None, budget='throttled'))
+                stage=None))
         now = self.clock()
         if deadline is None:
             t = timeout if timeout is not None else self.default_timeout
@@ -421,9 +444,9 @@ class DocService:
             if request.session.closed:
                 # client's fault (disconnect left requests queued):
                 # throttled budget, same as the submit-edge twin above
-                ticket._finish(now, error=Overloaded(
+                ticket._finish(now, error=SessionClosed(
                     'session closed', retry_after=None, shed=False,
-                    stage=None, budget='throttled'))
+                    stage=None))
                 stats['failed'] += 1
                 continue
             if request.deadline is not None and \

@@ -1,0 +1,953 @@
+"""Crash-fault-tolerant shard scale-out: router, replication, failover.
+
+The single ``DocService`` tick loop is the architectural ceiling for
+"millions of users" (ROADMAP): one thread, one fleet, one fused dispatch
+stream. This module is the horizontal answer — N ``Shard``s (each its
+own ``DocFleet`` + ``DocService`` + ``StorageEngine``; thread-per-shard
+today, but every cross-shard interaction goes through bytes-on-a-link
+or chunk transfer, so a shard could be a process without an API change)
+fronted by a ``ShardRouter`` that owns:
+
+- **Placement**: a consistent-hash ring over tenants (shard/ring.py).
+  Every tenant has a HOME shard (its serving session) and a REPLICA
+  shard (a warm doc kept current by inter-shard replication).
+- **Replication**: the EXISTING sync wire protocol
+  (fleet/sync_driver.py), batched per shard pair per tick — one fused
+  generate and one fused receive per shard per round — over
+  ``LossyLink``-wrappable links, so chaos tests drive the REAL
+  replication path through drops, dup, corruption, partitions, and
+  crashes. Corrupt replication messages quarantine per doc (never
+  poison a fleet); stalled pair handshakes (loss-poisoned
+  ``sentHashes``) reset like the service's reconnect rule.
+- **Acknowledged-write durability**: an 'apply' is acked to the client
+  only once its changes are on BOTH the home doc and the replica doc
+  (checked by change hash as replication lands). Acked => survives any
+  single shard crash. With no replica available (single-shard, or a
+  double failure window) the router degrades to single-copy acks —
+  visible in ``shard_stats()['shard_degraded_acks']``, never silent.
+- **Failure detection + failover**: shards heartbeat by pumping; a
+  shard whose lease (``lease_ticks``) expires is declared dead. Its
+  tenants re-home onto their replica shard (the warm doc is PROMOTED
+  to a serving session via ``DocService.adopt_session``), a new
+  replica is placed on the next live ring shard, and in-flight
+  requests against the dead shard come back typed
+  (``ShardUnavailable``) or ride the router's budgeted jittered
+  retries (service/backoff.py) onto the new home. Re-homed sessions
+  get a FRESH per-peer sync state — the client's next sync runs the
+  ``reset=True`` reconnect rule — and their standing subscription
+  cursor is re-registered on the new session; a cursor naming heads
+  the replica never received resolves as a TYPED resync event, never a
+  silently stale patch.
+- **Planned rebalance**: ``rebalance()`` migrates tenants back to
+  their ring-preferred home (after a revive, or scale-out) through the
+  storage engine's chunk-transfer primitive — ``StorageEngine.park``
+  on the donor, ``ingest_chunks`` + ``revive`` on the receiver — with
+  brownout-style degraded serving while in flight: reads
+  (sync/materialize_at/subscribe) keep flowing from the donor, writes
+  get typed pushback with ``retry_after`` (the router parks and
+  retries them onto the new home), never hard unavailability.
+
+The router is tick-driven and deterministic: ``pump()`` runs every live
+shard's service tick, advances the link clocks, checks leases, runs one
+replication round, steps migrations, and settles router-level tickets —
+all on an injected clock, so the kill-and-recover chaos harness
+(tools/loadgen.py ``run_shard_leg``) replays byte-identically from its
+seed. ``tools/loadgen.py`` proves the two contract properties: ZERO
+acknowledged-write loss across kills, and post-quiet byte-identical
+convergence between every tenant's home and replica docs.
+"""
+
+import time
+
+from ..backend import get_change_by_hash, get_heads
+from ..backend.sync import init_sync_state
+from ..columnar import decode_change_meta
+from ..errors import (AutomergeError, Overloaded, SessionClosed,
+                      ShardUnavailable, WireCorruption)
+from ..fleet import backend as fleet_backend
+from ..fleet.backend import DocFleet
+from ..fleet.storage import StorageEngine
+from ..fleet.sync_driver import (generate_sync_messages_docs,
+                                 receive_sync_messages_docs)
+from ..observability import recorder as _flight
+from ..observability.metrics import register_health_source
+from ..observability.spans import span as _span
+from ..service import DocService
+from ..service.backoff import Backoff, RetryBudgetPool
+from .ring import HashRing
+
+__all__ = ['Shard', 'ShardRouter', 'RouterTicket', 'shard_stats']
+
+_stats = {
+    'shard_kills': 0,              # Shard.kill() crashes injected
+    'shard_revives': 0,            # Shard.revive() restarts
+    'shard_failovers': 0,          # lease expiries acted on
+    'shard_rehomed_sessions': 0,   # tenants promoted onto their replica
+    'shard_rebalances': 0,         # planned migrations started
+    'shard_migrations': 0,         # chunk-transfer migrations completed
+    'shard_unavailable': 0,        # typed ShardUnavailable routing events
+    'shard_retries': 0,            # router-level backoff retries parked
+    'shard_repl_rounds': 0,        # replication rounds run
+    'shard_repl_resets': 0,        # stalled pair handshakes reset
+    'shard_repl_quarantined': 0,   # corrupt replication messages contained
+    'shard_degraded_acks': 0,      # applies acked with no replica copy
+}
+for _key in _stats:
+    register_health_source(_key, lambda k=_key: _stats[k])
+
+
+def shard_stats():
+    return dict(_stats)
+
+
+class Shard:
+    """One failure domain: its own fleet, service, and storage engine.
+    ``pump`` is the heartbeat — a crashed shard (``kill()``) simply
+    stops pumping, and the router notices only through the missed
+    lease, exactly like a dead process. ``revive()`` restarts the
+    shard EMPTY (crash-fault semantics: its memory died with it; state
+    re-enters via replication catch-up or a planned migration)."""
+
+    def __init__(self, shard_id, *, exact_device=False,
+                 clock=time.monotonic, service_kwargs=None):
+        self.id = shard_id
+        self._exact = exact_device
+        self._clock = clock
+        self._service_kwargs = dict(service_kwargs or {})
+        self.alive = True
+        self.last_beat = 0
+        self._build()
+
+    def _build(self):
+        self.fleet = DocFleet(exact_device=self._exact)
+        kwargs = dict(slo=False)
+        kwargs.update(self._service_kwargs)
+        self.service = DocService(fleet=self.fleet, clock=self._clock,
+                                  **kwargs)
+        self.storage = StorageEngine(fleet=self.fleet)
+
+    def pump(self, tick, now=None):
+        """One service tick + heartbeat. A dead shard does nothing —
+        whatever its queues held is unreachable until revive."""
+        if not self.alive:
+            return None
+        with _span('shard_tick', shard=self.id):
+            stats = self.service.pump(now=now)
+        self.last_beat = tick
+        return stats
+
+    def kill(self):
+        """Crash the shard: it stops pumping (and so heart-beating).
+        Nothing is cleaned up — a crash doesn't flush queues."""
+        if not self.alive:
+            return
+        self.alive = False
+        _stats['shard_kills'] += 1
+        _flight.record_event('shard_kill', shard=self.id)
+
+    def revive(self):
+        """Restart the crashed shard with EMPTY state (its memory died
+        with the process). The router must re-admit it before it serves
+        (``ShardRouter.revive_shard`` does both)."""
+        if self.alive:
+            return
+        self._build()
+        self.alive = True
+        _stats['shard_revives'] += 1
+        _flight.record_event('shard_revive', shard=self.id)
+
+
+class RouterTicket:
+    """A router-level request handle: resolves 'ok' or 'error' (typed —
+    shedding, unavailability, and failover gaps are never untyped).
+    For 'apply' requests, 'ok' means the REPLICATION CONTRACT is met:
+    the changes are on the home doc AND the replica doc (or the router
+    is running replica-less, counted in ``shard_degraded_acks``)."""
+
+    __slots__ = ('kind', 'tenant', 'status', 'result', 'error',
+                 'submitted_tick', 'finished_tick', 'attempts', 'shard')
+
+    def __init__(self, kind, tenant, tick):
+        self.kind = kind
+        self.tenant = tenant
+        self.status = 'pending'
+        self.result = None
+        self.error = None
+        self.submitted_tick = tick
+        self.finished_tick = None
+        self.attempts = 0
+        self.shard = None
+
+    @property
+    def done(self):
+        return self.status != 'pending'
+
+    def _finish(self, tick, result=None, error=None, shard=None):
+        if self.done:
+            return
+        self.finished_tick = tick
+        self.shard = shard
+        if error is not None:
+            self.status = 'error'
+            self.error = error
+        else:
+            self.status = 'ok'
+            self.result = result
+
+    def __repr__(self):
+        return (f'RouterTicket({self.kind}, tenant={self.tenant!r}, '
+                f'status={self.status!r})')
+
+
+class _RReq:
+    __slots__ = ('kind', 'tenant', 'payload', 'payload_fn', 'timeout',
+                 'priority', 'ticket', 'attempts', 'not_before', 'state',
+                 'sub', 'hashes', 'home_at_submit', 'result_cache')
+
+    def __init__(self, kind, tenant, payload, payload_fn, timeout,
+                 priority, ticket):
+        self.kind = kind
+        self.tenant = tenant
+        self.payload = payload
+        self.payload_fn = payload_fn
+        self.timeout = timeout
+        self.priority = priority
+        self.ticket = ticket
+        self.attempts = 0
+        self.not_before = 0.0
+        self.state = 'new'        # parked | submitted | await_replica
+        self.sub = None
+        self.hashes = None
+        self.home_at_submit = None
+        self.result_cache = None
+
+
+class _Tenant:
+    """The router's record of one tenant: where it lives, its warm
+    replica, the replication handshake state for the pair, and the
+    standing-subscription cursor the router re-registers on re-home."""
+
+    __slots__ = ('name', 'home', 'replica_on', 'session',
+                 'replica_handle', 'state_home', 'state_rep',
+                 'inbox_home', 'inbox_rep', 'cursor', 'needs_reset',
+                 'read_only', 'stall', 'last_pair_heads', 'quiet',
+                 'migrating', 'placed')
+
+    def __init__(self, name):
+        self.name = name
+        self.home = None
+        self.replica_on = None
+        self.session = None
+        self.replica_handle = None
+        self.state_home = init_sync_state()
+        self.state_rep = init_sync_state()
+        self.inbox_home = []
+        self.inbox_rep = []
+        self.cursor = []            # last subscription heads served
+        self.needs_reset = False    # next client sync runs reset=True
+        self.read_only = False      # in-migration: writes pushed back
+        self.stall = 0
+        self.last_pair_heads = None
+        self.quiet = True
+        self.migrating = None       # {'phase': ..., 'to': shard_id}
+        self.placed = False         # ever had a home session (a
+                                    # never-placed tenant can be placed
+                                    # fresh on revive without data loss;
+                                    # a double-failure one cannot)
+
+    def _reset_pair(self):
+        self.state_home = init_sync_state()
+        self.state_rep = init_sync_state()
+        self.inbox_home = []
+        self.inbox_rep = []
+        self.stall = 0
+        self.last_pair_heads = None
+        self.quiet = False
+
+
+class ShardRouter:
+    """See the module docstring. ``submit`` never raises for transient
+    conditions — routing gaps (dead shard, migration read-only window,
+    admission pushback) park the request under the budgeted jittered
+    backoff and the ticket resolves typed if the budget runs dry."""
+
+    def __init__(self, n_shards=None, shard_ids=None, *,
+                 exact_device=False, clock=None, lease_ticks=3,
+                 vnodes=64, link_factory=None, backoff=None,
+                 retry_rate=50.0, retry_burst=100.0,
+                 repl_stall_rounds=8, service_kwargs=None,
+                 pump_threads=None, repl_every=1):
+        if shard_ids is None:
+            shard_ids = [f'shard{i}' for i in range(n_shards or 1)]
+        self.clock = clock if clock is not None else time.monotonic
+        self.shards = {sid: Shard(sid, exact_device=exact_device,
+                                  clock=self.clock,
+                                  service_kwargs=service_kwargs)
+                       for sid in shard_ids}
+        self.ring = HashRing(shard_ids, vnodes=vnodes)
+        self.alive = set(shard_ids)    # the ROUTER's lease-driven view
+        self.lease_ticks = int(lease_ticks)
+        self.link_factory = link_factory
+        self._links = {}               # (src, dst) -> LossyLink or None
+        self.backoff = backoff if backoff is not None else \
+            Backoff(base=0.05, factor=1.5, cap=1.0, retries=12, seed=7)
+        self._retry_budgets = RetryBudgetPool(retry_rate, retry_burst)
+        self.repl_stall_rounds = int(repl_stall_rounds)
+        # group-commit cadence: a replication round every `repl_every`
+        # ticks. >1 amortizes the fused sync-protocol cost over more
+        # committed changes per round (higher aggregate throughput, ack
+        # latency up by <= repl_every ticks). The ACK CONTRACT is
+        # cadence-independent: an apply resolves only once its hashes
+        # are on both copies, however long replication takes.
+        self.repl_every = max(1, int(repl_every))
+        self.ticks = 0
+        self._tenants = {}
+        self._pending = []
+        self.failovers = []            # [{'tick', 'shard', 'moved'}]
+        # thread-per-shard pump: shard ticks are independent (each shard
+        # owns its fleet/service; every cross-shard phase — links,
+        # leases, replication, migration, settlement — runs serially
+        # after the barrier), so pumping them concurrently changes no
+        # DOC/TICKET state outcome, only wall time. None/1 = serial.
+        # Caveat: module-global telemetry counters are unsynchronized
+        # dict increments, so concurrent pumps can undercount them —
+        # best-effort health numbers only; nothing the ack contract or
+        # the chaos audits read rides them (shard services run with
+        # slo=False, and the audits check hashes/bytes, not counters).
+        self._pool = None
+        if pump_threads is not None and int(pump_threads) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=int(pump_threads),
+                thread_name_prefix='shard-pump')
+
+    # -- wiring ---------------------------------------------------------
+
+    def close(self):
+        """Release the pump thread pool (no-op when serial)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    def _link(self, src, dst):
+        key = (src, dst)
+        if key not in self._links:
+            self._links[key] = None if self.link_factory is None \
+                else self.link_factory(src, dst)
+        return self._links[key]
+
+    def _transmit(self, src, dst, message):
+        link = self._link(src, dst)
+        if link is None:
+            return [message] if message is not None else []
+        return link.transmit(message)
+
+    def tenant_record(self, name):
+        """The router's internal record (tests and the chaos harness
+        read home/replica placement and doc handles through this)."""
+        return self._tenants[name]
+
+    def tenants_on(self, shard_id):
+        return [r.name for r in self._tenants.values()
+                if r.home == shard_id]
+
+    # -- membership -----------------------------------------------------
+
+    def kill_shard(self, shard_id):
+        """Crash a shard (chaos entry). The router does NOT learn of it
+        here — detection happens through the missed lease, like a real
+        dead process."""
+        self.shards[shard_id].kill()
+
+    def revive_shard(self, shard_id):
+        """Restart a crashed shard empty and re-admit it to the serving
+        set (fresh lease). Existing tenants stay on their failover home
+        until ``rebalance()`` migrates them back."""
+        shard = self.shards[shard_id]
+        if not shard.alive and shard_id in self.alive:
+            # revived before the lease noticed the death: the crash
+            # still destroyed its memory, so the failover must run NOW
+            # or tenants would keep sessions into the dead incarnation
+            # while the router routes at a rebuilt-empty service
+            self._failover(shard_id)
+        shard.revive()
+        shard.last_beat = self.ticks
+        self.alive.add(shard_id)
+        # returned capacity heals replica-less tenants NOW: a failover
+        # that found no spare shard left them on degraded single-copy
+        # acks, and nothing else re-places a replica for a tenant whose
+        # HOME never moves — without this, every later apply would keep
+        # acking single-copy forever despite a live spare shard
+        for rec in self._tenants.values():
+            if rec.home is None and not rec.placed:
+                # opened during the outage: place it fresh now (it
+                # never held data, so nothing can be lost)
+                self._place(rec)
+            elif rec.home in self.alive and rec.session is not None and \
+                    (rec.replica_on not in self.alive or
+                     rec.replica_handle is None):
+                self._ensure_replica(rec)
+
+    # -- tenants --------------------------------------------------------
+
+    def open_tenant(self, name):
+        """Place a tenant: home session on its ring-primary shard, warm
+        replica doc on the next live ring shard. Idempotent. During a
+        FULL outage the tenant is recorded unplaced (home None) rather
+        than raising — its requests park/resolve typed through the
+        normal unavailable path, and the next ``revive_shard`` places
+        it (fresh and empty, so no data can be lost by the wait)."""
+        rec = self._tenants.get(name)
+        if rec is not None:
+            return rec
+        rec = _Tenant(name)
+        self._tenants[name] = rec
+        self._place(rec)
+        return rec
+
+    def _place(self, rec):
+        home = self.ring.primary(rec.name, alive=self.alive)
+        if home is None:
+            return False
+        rec.home = home
+        rec.session = self.shards[home].service.open_session(rec.name)
+        rec.placed = True
+        self._ensure_replica(rec)
+        return True
+
+    def _ensure_replica(self, rec):
+        """(Re)place the tenant's warm replica on the first live ring
+        shard after its home; fresh pair handshake. No-op when the
+        placement is already correct. With fewer than two live shards
+        the tenant runs replica-less (degraded single-copy acks)."""
+        want = None
+        for sid in self.ring.preference(rec.name, alive=self.alive):
+            if sid != rec.home:
+                want = sid
+                break
+        if want == rec.replica_on and rec.replica_handle is not None:
+            return
+        old_on, old_handle = rec.replica_on, rec.replica_handle
+        if old_handle is not None and old_on in self.alive and \
+                self.shards[old_on].alive:
+            fleet_backend.free_docs([old_handle])
+        rec.replica_on = want
+        rec.replica_handle = None
+        if want is not None:
+            rec.replica_handle = fleet_backend.init_docs(
+                1, self.shards[want].fleet)[0]
+        rec._reset_pair()
+
+    # -- submission -----------------------------------------------------
+
+    def submit(self, tenant, kind, payload=None, *, payload_fn=None,
+               timeout=None, priority=None):
+        """Route one request to the tenant's home shard. Returns a
+        ``RouterTicket``; resolution (including every failure) is typed.
+        `payload_fn` is the client transport draw — the router draws it
+        ONCE PER ATTEMPT (the same bytes reach home and, via
+        replication, the replica), and wire-corruption verdicts retry
+        through the router's backoff with a fresh draw."""
+        rec = self._tenants.get(tenant)
+        if rec is None:
+            rec = self.open_tenant(tenant)
+        ticket = RouterTicket(kind, tenant, self.ticks)
+        req = _RReq(kind, tenant, payload, payload_fn, timeout, priority,
+                    ticket)
+        self._dispatch(req, self.clock())
+        if not ticket.done:
+            self._pending.append(req)
+        return ticket
+
+    def _unavailable(self, message, *, shard, tenant):
+        """Mint a typed ``ShardUnavailable`` and count it — EVERY mint
+        site goes through here so ``shard_unavailable`` matches the
+        tickets that actually saw the error."""
+        _stats['shard_unavailable'] += 1
+        return ShardUnavailable(message, shard=shard, tenant=tenant,
+                                retry_after=None)
+
+    def _fail_or_retry(self, req, error, now, transient=True):
+        """Park the request under backoff + per-tenant budget, or
+        resolve it with the (typed) error."""
+        if transient and not self.backoff.exhausted(req.attempts) and \
+                self._retry_budgets.get(req.tenant).spend(now):
+            delay = self.backoff.delay(req.attempts)
+            req.attempts += 1
+            req.ticket.attempts = req.attempts
+            req.not_before = now + delay
+            req.state = 'parked'
+            req.sub = None
+            _stats['shard_retries'] += 1
+            return
+        req.ticket._finish(self.ticks, error=error,
+                           shard=self._tenants[req.tenant].home)
+
+    def _dispatch(self, req, now):
+        rec = self._tenants[req.tenant]
+        if rec.home is None or rec.home not in self.alive:
+            self._fail_or_retry(req, self._unavailable(
+                f'tenant {req.tenant!r} home shard unavailable',
+                shard=rec.home, tenant=req.tenant), now)
+            return
+        if rec.read_only and req.kind == 'apply':
+            # brownout-style degraded serving while the tenant migrates:
+            # reads keep flowing, writes get typed pushback and ride the
+            # router's backoff onto the new home
+            self._fail_or_retry(req, Overloaded(
+                f'tenant {req.tenant!r} migrating: reads only',
+                retry_after=0.05, shed=False, stage='migration'), now)
+            return
+        if req.payload_fn is not None:
+            try:
+                payload = req.payload_fn()
+            except Exception as exc:
+                req.ticket._finish(self.ticks, error=Overloaded(
+                    f'transport draw failed: {exc!r}', retry_after=None,
+                    shed=False, stage=None, budget='throttled'),
+                    shard=rec.home)
+                return
+            if payload is None:
+                req.ticket._finish(self.ticks, error=Overloaded(
+                    'transport delivered nothing', retry_after=0.01,
+                    shed=False, stage=None, budget='throttled'),
+                    shard=rec.home)
+                return
+        else:
+            payload = req.payload
+        hashes = None
+        if req.kind == 'apply':
+            # the ack contract needs the change hashes BEFORE anything
+            # is enqueued: bytes that don't even decode can never meet
+            # it, so they resolve typed here (and, on a payload_fn
+            # transport, retry with a fresh draw) instead of raising
+            # out of submit()/pump() with a doomed request queued
+            try:
+                hashes = [decode_change_meta(bytes(b), True)['hash']
+                          for b in payload]
+            except AutomergeError as exc:
+                self._fail_or_retry(req, exc, now,
+                                    transient=req.payload_fn is not None)
+                return
+        reset = req.kind == 'sync' and rec.needs_reset
+        try:
+            sub = self.shards[rec.home].service.submit(
+                rec.session, req.kind, payload, timeout=req.timeout,
+                priority=req.priority, reset=reset)
+        except AutomergeError as exc:
+            self._fail_or_retry(req, exc, now)
+            return
+        if reset:
+            rec.needs_reset = False
+        req.sub = sub
+        req.state = 'submitted'
+        req.home_at_submit = rec.home
+        if req.kind == 'apply':
+            req.hashes = hashes
+            req.result_cache = len(payload)
+
+    # -- the tick -------------------------------------------------------
+
+    def pump(self, now=None):
+        """One cluster tick (see the module docstring for the phases).
+        Deterministic given the injected clock and link seeds."""
+        self.ticks += 1
+        now = self.clock() if now is None else now
+        with _span('shard_router_tick', tick=self.ticks,
+                   shards=len(self.alive)):
+            if self._pool is not None:
+                futures = [self._pool.submit(self.shards[sid].pump,
+                                             self.ticks, now)
+                           for sid in self.ring.shard_ids()]
+                for f in futures:
+                    f.result()
+            else:
+                for sid in self.ring.shard_ids():
+                    self.shards[sid].pump(self.ticks, now)
+            for link in self._links.values():
+                if link is not None:
+                    link.tick()
+            for sid in list(self.alive):
+                if self.ticks - self.shards[sid].last_beat > \
+                        self.lease_ticks:
+                    self._failover(sid)
+            if self.ticks % self.repl_every == 0:
+                self._replicate()
+            self._advance_migrations()
+            self._harvest(now)
+
+    # -- failover -------------------------------------------------------
+
+    def _failover(self, dead):
+        """The lease expired: re-home the dead shard's tenants onto
+        their replicas, re-place replicas that lived there, cancel
+        migrations touching it."""
+        self.alive.discard(dead)
+        _stats['shard_failovers'] += 1
+        _flight.record_event('shard_failover', shard=dead,
+                             tick=self.ticks)
+        moved = []
+        for rec in self._tenants.values():
+            if rec.migrating is not None and \
+                    (rec.home == dead or rec.migrating['to'] == dead):
+                rec.migrating = None
+                rec.read_only = False
+            if rec.home == dead:
+                new_home = rec.replica_on \
+                    if rec.replica_on in self.alive else None
+                if new_home is None:
+                    # both copies gone (double failure): unavailable,
+                    # typed, until an operator re-homes it
+                    rec.home = None
+                    rec.session = None
+                    rec.replica_on = None
+                    rec.replica_handle = None
+                    continue
+                shard = self.shards[new_home]
+                rec.session = shard.service.adopt_session(
+                    rec.name, rec.replica_handle)
+                # the standing subscription survives the re-home: the
+                # promoted session continues from the cursor the router
+                # tracked; heads the replica never received resolve as
+                # a TYPED resync event, never a silently stale patch
+                rec.session.sub_cursor = list(rec.cursor)
+                rec.home = new_home
+                rec.replica_on = None
+                rec.replica_handle = None
+                rec.needs_reset = True
+                _stats['shard_rehomed_sessions'] += 1
+                self._ensure_replica(rec)
+                moved.append(rec.name)
+            elif rec.replica_on == dead:
+                rec.replica_on = None
+                rec.replica_handle = None
+                self._ensure_replica(rec)
+        self.failovers.append({'tick': self.ticks, 'shard': dead,
+                               'moved': moved})
+
+    # -- replication ----------------------------------------------------
+
+    def _repl_active(self):
+        # PHYSICAL liveness gates the data plane: a killed shard's
+        # memory cannot accept or produce bytes even while the router's
+        # lease-driven view (self.alive) hasn't noticed the death yet —
+        # during that window the pair is simply dark (an apply's
+        # replica wait keeps waiting; failover re-places the replica
+        # and the wait settles through the NEW copy). The router-view
+        # checks stay: they cover failed-over placement holes.
+        return [rec for rec in self._tenants.values()
+                if rec.home in self.alive and rec.session is not None
+                and rec.replica_on in self.alive
+                and rec.replica_handle is not None
+                and self.shards[rec.home].alive
+                and self.shards[rec.replica_on].alive]
+
+    def _replicate(self):
+        """One replication round: per live shard, ONE fused generate and
+        ONE fused receive for each side of its tenant pairs, messages
+        crossing the (possibly lossy) inter-shard links.
+
+        Converged-QUIET pairs whose heads have not moved since their
+        last round are skipped entirely — two ``get_heads`` reads
+        instead of riding the fused generate — so the steady-state cost
+        of a round is O(dirty pairs), not O(tenants). A skipped pair
+        wakes the moment either side's heads move: the home moves on a
+        committed apply, and replica heads can only move through a
+        round it participated in, so no wake-up can be missed."""
+        everyone = self._repl_active()
+        active = []
+        for rec in everyone:
+            pair = (tuple(get_heads(rec.session.handle)),
+                    tuple(get_heads(rec.replica_handle)))
+            if rec.quiet and pair == rec.last_pair_heads and \
+                    not rec.inbox_home and not rec.inbox_rep:
+                continue
+            active.append(rec)
+        if not active:
+            return
+        _stats['shard_repl_rounds'] += 1
+        sent = {}
+        with _span('shard_replication', pairs=len(active)):
+            # generate, home side, grouped per home shard
+            for side in ('home', 'rep'):
+                groups = {}
+                for rec in active:
+                    key = rec.home if side == 'home' else rec.replica_on
+                    groups.setdefault(key, []).append(rec)
+                for recs in groups.values():
+                    if side == 'home':
+                        handles = [r.session.handle for r in recs]
+                        states = [r.state_home for r in recs]
+                    else:
+                        handles = [r.replica_handle for r in recs]
+                        states = [r.state_rep for r in recs]
+                    new_states, msgs = generate_sync_messages_docs(
+                        handles, states)
+                    for r, st, m in zip(recs, new_states, msgs):
+                        if side == 'home':
+                            r.state_home = st
+                            if m is not None:
+                                r.inbox_rep.extend(self._transmit(
+                                    r.home, r.replica_on, m))
+                        else:
+                            r.state_rep = st
+                            if m is not None:
+                                r.inbox_home.extend(self._transmit(
+                                    r.replica_on, r.home, m))
+                        if m is not None:
+                            sent[id(r)] = True
+            # receive, both sides, one inbox message per pair per round
+            for side in ('home', 'rep'):
+                groups = {}
+                for rec in active:
+                    inbox = rec.inbox_home if side == 'home' \
+                        else rec.inbox_rep
+                    if inbox:
+                        key = rec.home if side == 'home' \
+                            else rec.replica_on
+                        groups.setdefault(key, []).append(rec)
+                for recs in groups.values():
+                    if side == 'home':
+                        handles = [r.session.handle for r in recs]
+                        states = [r.state_home for r in recs]
+                        msgs = [r.inbox_home.pop(0) for r in recs]
+                    else:
+                        handles = [r.replica_handle for r in recs]
+                        states = [r.state_rep for r in recs]
+                        msgs = [r.inbox_rep.pop(0) for r in recs]
+                    out_handles, out_states, _patches, errors = \
+                        receive_sync_messages_docs(
+                            handles, states, msgs, mirror=False,
+                            on_error='quarantine')
+                    for r, handle, st, err in zip(recs, out_handles,
+                                                  out_states, errors):
+                        if side == 'home':
+                            r.session.handle = handle
+                            r.state_home = st
+                        else:
+                            r.replica_handle = handle
+                            r.state_rep = st
+                        if err is not None:
+                            # corrupt wire bytes: contained to this doc,
+                            # equivalent to a drop — the handshake
+                            # re-sends through its own machinery
+                            _stats['shard_repl_quarantined'] += 1
+                        sent[id(r)] = True
+        # stall detection: TRAFFIC without head movement is the
+        # loss-poisoned handshake (split heads = poisoned sentHashes;
+        # equal heads = one side soliciting a peer whose "you're in
+        # sync" reply was dropped — it stays silent forever while the
+        # solicitor never learns). Both livelocks keep messages flowing
+        # with frozen heads, and a genuinely converged-quiet pair
+        # exchanges NO messages, so resetting on stalled traffic can
+        # never disturb a quiet pair (the sync_until_quiet rule;
+        # idempotent delivery makes the reset always safe).
+        for rec in active:
+            pair = (tuple(get_heads(rec.session.handle)),
+                    tuple(get_heads(rec.replica_handle)))
+            split = sorted(pair[0]) != sorted(pair[1])
+            rec.quiet = not split and not sent.get(id(rec)) and \
+                not rec.inbox_home and not rec.inbox_rep
+            if pair == rec.last_pair_heads and sent.get(id(rec)):
+                rec.stall += 1
+            else:
+                rec.stall = 0
+            rec.last_pair_heads = pair
+            if rec.stall >= self.repl_stall_rounds:
+                rec._reset_pair()
+                _stats['shard_repl_resets'] += 1
+
+    def replication_quiet(self):
+        """True when every replicated pair converged and went quiet in
+        the last round (the post-quiet audit precondition)."""
+        return all(rec.quiet for rec in self._repl_active())
+
+    # -- planned rebalance ---------------------------------------------
+
+    def rebalance(self):
+        """Start migrating every tenant whose live ring-primary differs
+        from its current home (post-revive healing, scale-out). Returns
+        how many migrations were started; they advance across the next
+        few ``pump`` ticks (read-only window -> chunk transfer ->
+        cutover)."""
+        started = 0
+        for rec in self._tenants.values():
+            if rec.migrating is not None or rec.home is None:
+                continue
+            want = self.ring.primary(rec.name, alive=self.alive)
+            if want is not None and want != rec.home:
+                rec.migrating = {'phase': 'readonly', 'to': want}
+                _stats['shard_rebalances'] += 1
+                started += 1
+        return started
+
+    def migrating(self):
+        return [rec.name for rec in self._tenants.values()
+                if rec.migrating is not None]
+
+    def _advance_migrations(self):
+        for rec in self._tenants.values():
+            mig = rec.migrating
+            if mig is None:
+                continue
+            if mig['to'] not in self.alive or rec.home not in self.alive:
+                rec.migrating = None
+                rec.read_only = False
+                continue
+            if mig['phase'] == 'readonly':
+                rec.read_only = True
+                busy = any(r.tenant == rec.name and r.kind == 'apply'
+                           and r.state == 'submitted' and not r.sub.done
+                           for r in self._pending)
+                if not busy:
+                    # transfer NEXT tick: the read-only window is a real
+                    # window, not a same-tick flicker
+                    mig['phase'] = 'transfer'
+                continue
+            # transfer: park on the donor -> chunk -> ingest + revive on
+            # the receiver -> cutover
+            donor = self.shards[rec.home]
+            receiver = self.shards[mig['to']]
+            with _span('shard_migrate', tenant=rec.name,
+                       src=rec.home, dst=mig['to']):
+                ids = donor.storage.park([rec.session.handle])
+                if ids[0] is None:
+                    continue            # queued changes — retry next tick
+                chunk = donor.storage.discard([ids[0]])[0]
+                rid = receiver.storage.ingest_chunks([chunk])[0]
+                handle = receiver.storage.revive([rid])[0]
+                donor.service.release_session(rec.session)
+                rec.session = receiver.service.adopt_session(rec.name,
+                                                             handle)
+            rec.session.sub_cursor = list(rec.cursor)
+            rec.home = mig['to']
+            rec.needs_reset = True
+            rec.read_only = False
+            rec.migrating = None
+            rec._reset_pair()
+            self._ensure_replica(rec)
+            _stats['shard_migrations'] += 1
+            _flight.record_event('shard_migration', tenant=rec.name,
+                                 dst=rec.home, tick=self.ticks)
+
+    # -- settlement -----------------------------------------------------
+
+    def _hashes_on(self, handle, hashes):
+        return all(get_change_by_hash(handle, h) is not None
+                   for h in hashes)
+
+    def _resolve_ok(self, req, rec):
+        result = req.sub.result if req.sub is not None and \
+            req.sub.status == 'ok' else req.result_cache
+        if req.kind == 'apply':
+            result = req.result_cache
+        elif req.kind == 'subscribe' and isinstance(result, dict):
+            rec.cursor = list(result.get('heads', rec.cursor))
+        req.ticket._finish(self.ticks, result=result, shard=rec.home)
+
+    def _harvest(self, now):
+        still = []
+        for req in self._pending:
+            if req.ticket.done:
+                continue
+            rec = self._tenants[req.tenant]
+            if req.state == 'parked':
+                if req.not_before <= now:
+                    self._dispatch(req, now)
+            elif req.state == 'submitted':
+                if req.sub.done:
+                    self._settle_sub(req, rec, now)
+                elif rec.home != req.home_at_submit or \
+                        req.home_at_submit not in self.alive:
+                    # orphaned in a dead/abandoned shard's queues
+                    self._settle_orphan(req, rec, now)
+            elif req.state == 'await_replica':
+                self._settle_replica_wait(req, rec, now)
+            if not req.ticket.done:
+                still.append(req)
+        self._pending = still
+
+    def _settle_sub(self, req, rec, now):
+        sub = req.sub
+        if sub.status == 'ok':
+            if req.kind == 'apply' and rec.replica_handle is not None:
+                req.state = 'await_replica'
+                self._settle_replica_wait(req, rec, now)
+                return
+            if req.kind == 'apply':
+                _stats['shard_degraded_acks'] += 1
+            self._resolve_ok(req, rec)
+            return
+        err = sub.error
+        if rec.home != req.home_at_submit and \
+                isinstance(err, SessionClosed):
+            # the session moved (failover/migration) while this request
+            # sat queued: not the client's fault — retry on the new home
+            self._fail_or_retry(req, self._unavailable(
+                f'tenant {req.tenant!r} re-homed mid-flight',
+                shard=req.home_at_submit, tenant=req.tenant), now)
+            return
+        if req.payload_fn is not None and isinstance(err, WireCorruption):
+            # transient transport fault: re-draw and retry, budgeted
+            self._fail_or_retry(req, err, now)
+            return
+        req.ticket._finish(self.ticks, error=err, shard=rec.home)
+
+    def _settle_orphan(self, req, rec, now):
+        if req.kind == 'apply' and req.hashes and rec.home in self.alive \
+                and rec.session is not None and \
+                self._hashes_on(rec.session.handle, req.hashes):
+            # the write survived onto the promoted replica before the
+            # crash: the ack contract is already met (or about to be,
+            # via the new replica) — settle through the replica wait
+            req.state = 'await_replica'
+            self._settle_replica_wait(req, rec, now)
+            return
+        self._fail_or_retry(req, self._unavailable(
+            f'shard {req.home_at_submit!r} lost mid-flight',
+            shard=req.home_at_submit, tenant=req.tenant), now)
+
+    def _settle_replica_wait(self, req, rec, now):
+        if rec.home is None or rec.home not in self.alive or \
+                rec.session is None:
+            self._fail_or_retry(req, self._unavailable(
+                f'tenant {req.tenant!r} home shard unavailable',
+                shard=rec.home, tenant=req.tenant), now)
+            return
+        if not self._hashes_on(rec.session.handle, req.hashes):
+            # the only copy died before replicating: NOT acked — the
+            # retry replays the same changes (idempotent by hash)
+            self._fail_or_retry(req, self._unavailable(
+                'committed copy lost before replication',
+                shard=req.home_at_submit, tenant=req.tenant), now)
+            return
+        if rec.replica_handle is None:
+            _stats['shard_degraded_acks'] += 1
+            self._resolve_ok(req, rec)
+            return
+        if self.shards[rec.replica_on].alive and \
+                self._hashes_on(rec.replica_handle, req.hashes):
+            self._resolve_ok(req, rec)
+        # else: keep waiting — replication lands it (a physically dead
+        # replica's memory doesn't count even if the hashes reached it
+        # before the crash killed them; failover re-places the replica
+        # and this wait settles through the new copy)
+
+    # -- drain helpers --------------------------------------------------
+
+    def idle(self):
+        return not self._pending and all(
+            self.shards[sid].service.idle() for sid in self.alive)
+
+    def run_until_quiet(self, max_ticks=10_000, advance=None):
+        """Pump until no router/shard work is pending AND replication is
+        quiet. `advance` steps an injected fake clock per tick."""
+        now = self.clock()
+        for _ in range(max_ticks):
+            if self.idle() and self.replication_quiet() and \
+                    not self.migrating():
+                return True
+            self.pump(now=now)
+            if advance is not None:
+                now += advance
+        return self.idle() and self.replication_quiet()

@@ -872,7 +872,10 @@ SANITY_KEYS = {'seam': 'seam_rate', 'registers': 'reg_rate',
                # render throughput, not the overhead percentage: the
                # paired delta is a noise-sensitive difference that can
                # legitimately cross zero run to run
-               'slo': 'slo_render_series_per_s'}
+               'slo': 'slo_render_series_per_s',
+               # the paced aggregate rate: cadence-bound, so stable
+               # across run order by construction
+               'shards': 'shards_rps_4'}
 
 
 def section(name):
@@ -1843,6 +1846,80 @@ def _sec_slo():
           f'(budget <= 10)', file=sys.stderr)
 
 
+@section('shards')
+def _sec_shards():
+    # Shard scale-out (ISSUE-11), two numbers:
+    # (a) aggregate acked req/s on the CLEAN leg at 1/2/4 shards. The
+    #     serving tick is a CADENCE (tick_dt bounds batching latency),
+    #     so the legs run wall-paced: per-shard capacity is the modeled
+    #     per-core device budget (batch_limit applies per fused tick),
+    #     aggregate throughput = capacity x shards IF each tick's work
+    #     fits the cadence on this box — overruns are counted and
+    #     reported (ticks_slipped), never silently absorbed. Pumps run
+    #     thread-per-shard; replication group-commits every 4 ticks
+    #     (the ack contract — changes on home AND replica before the
+    #     ticket resolves — is cadence-independent).
+    # (b) failover MTTR: an UNPACED kill-one-of-4 chaos leg (lossy
+    #     replication links), reporting ticks from the kill to the
+    #     first acked request served by a re-homed tenant, plus the
+    #     zero-acked-loss / byte-identical-convergence audits.
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), 'tools'))
+    from loadgen import run_shard_leg
+    tenants = _env('BENCH_SHARD_TENANTS', 96)
+    requests = _env('BENCH_SHARD_REQUESTS', 1200)
+    kill_requests = _env('BENCH_SHARD_KILL_REQUESTS', 400)
+
+    # warm the JIT paths on a throwaway cluster so the 1-shard leg
+    # doesn't pay compilation inside its paced window
+    run_shard_leg('warmup', n_shards=2, tenants=8, requests=100,
+                  arrivals_per_tick=8,
+                  service_kwargs={'batch_limit': 8}, seed=0)
+    _fence()
+
+    sweep = {}
+    slips = {}
+    for n in (1, 2, 4):
+        leg = run_shard_leg(
+            f'clean_{n}', n_shards=n, tenants=tenants,
+            requests=requests, arrivals_per_tick=max(8, tenants // 2),
+            seed=0, tick_dt=0.03, subscribe_fraction=0.1,
+            sync_fraction=0.05, service_kwargs={'batch_limit': 8},
+            pump_threads=2, repl_every=4, pace=True)
+        sweep[str(n)] = leg['requests_per_s']
+        slips[str(n)] = leg['ticks_slipped']
+        R[f'shards_rps_{n}'] = leg['requests_per_s']
+        R[f'shards_clean_{n}_ok'] = int(leg['ok'])
+        _fence()
+    monotonic = sweep['1'] < sweep['2'] < sweep['4']
+    R['shards_scaling_monotonic'] = int(monotonic)
+
+    kill = run_shard_leg(
+        'kill_one_of_four', n_shards=4, tenants=max(8, tenants // 8),
+        requests=kill_requests, arrivals_per_tick=8, chaos=True,
+        seed=5, kills=((12, 1, 40),), mttr_bound=12)
+    mttr = kill['mttr_ticks'][0] if kill['mttr_ticks'] else None
+    R['shards_failover_mttr_ticks'] = mttr
+    R['shards_kill_leg_ok'] = int(kill['ok'])
+    R['shards_kill_acked_lost'] = kill['final_audit']['acked_lost']
+    R['shards_kill_replica_mismatches'] = \
+        kill['final_audit']['replica_mismatches']
+    _fence()
+
+    scaled = ', '.join(
+        f'{n}S {r:.0f} req/s ({r / sweep["1"]:.2f}x, '
+        f'{slips[n]} slipped)' for n, r in sweep.items())
+    print(f'# shards clean paced sweep ({tenants} tenants, '
+          f'batch_limit 8/tick/shard, tick 30ms, repl_every 4): '
+          f'{scaled}, monotonic {"OK" if monotonic else "FAIL"}',
+          file=sys.stderr)
+    print(f'# shards kill-one-of-four: MTTR {mttr} ticks (lease '
+          f'{kill["lease_ticks"]}), acked lost '
+          f'{kill["final_audit"]["acked_lost"]}, replica mismatches '
+          f'{kill["final_audit"]["replica_mismatches"]}, '
+          f'{"OK" if kill["ok"] else "FAIL"}', file=sys.stderr)
+
+
 @section('query')
 def _sec_query():
     # Query engine (ISSUE-9): (a) batched time-travel reads — N docs
@@ -2121,6 +2198,12 @@ def _run_sanity():
              'BENCH_SLO_SERIES_TENANTS': '60',
              'BENCH_QUERY_DOCS': '200',
              'BENCH_QUERY_SUBS': '1000',
+             # tenants stay at the default: the paced sweep needs the
+             # closed-loop writer pool to SATURATE per-shard capacity
+             # (tenants >> shards x batch x ack-latency) or the legs go
+             # latency-bound and the scaling curve flattens
+             'BENCH_SHARD_REQUESTS': '600',
+             'BENCH_SHARD_KILL_REQUESTS': '240',
              'BENCH_REPS': '3'}
     for k, v in small.items():
         os.environ.setdefault(k, v)

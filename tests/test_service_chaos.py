@@ -32,7 +32,8 @@ from automerge_tpu import native
 sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), 'tools'))
 
-from loadgen import run_leg, run_standard_legs   # noqa: E402
+from loadgen import (run_leg, run_shard_leg,     # noqa: E402
+                     run_standard_legs)
 
 pytestmark = pytest.mark.skipif(not native.available(),
                                 reason='native codec unavailable')
@@ -89,6 +90,64 @@ def test_service_chaos_identical_across_device_modes():
         assert report['session_saves'], 'empty save map'
     assert saves[False] == saves[True], \
         'device modes diverged under the identical chaos script'
+
+
+def assert_shard_leg_ok(report):
+    assert report['untyped_escapes'] == 0, report
+    assert report['drained'], report
+    for audit in report['audits']:
+        # ZERO acknowledged-write loss and byte-identical home/replica
+        # convergence at EVERY settle point, not just the end
+        assert audit['acked_lost'] == 0, audit
+        assert audit['replica_mismatches'] == 0, audit
+        assert audit['replica_pairs'] > 0, audit
+    assert report['ok'], report
+
+
+def test_shard_kill_one_of_four_smoke():
+    """The acceptance leg (ISSUE-11): kill one of 4 shards mid-workload
+    under chaos links — zero acked-write loss, the dead shard's tenants
+    served by their replicas within the lease window, post-quiet
+    byte-identical convergence across the surviving shards."""
+    report = run_shard_leg('kill_one_of_four', n_shards=4, tenants=12,
+                           requests=240, arrivals_per_tick=8,
+                           chaos=True, seed=5, kills=((12, 1, 40),),
+                           mttr_bound=12)
+    assert_shard_leg_ok(report)
+    assert report['failovers'] == 1
+    assert report['mttr_ticks'][0] is not None
+    assert report['mttr_ticks'][0] <= report['lease_ticks'] + 9
+    assert report['completed_ok'] > 0
+
+
+def test_shard_kill_revive_cycles_same_shard():
+    """The satellite: kill and revive ONE shard 3x mid-workload (with a
+    rebalance pulling its tenants home each revive), asserting the
+    byte-identical convergence audit after every round."""
+    report = run_shard_leg(
+        'kill_revive_3x', n_shards=3, tenants=9, requests=270,
+        arrivals_per_tick=6, chaos=True, seed=7,
+        kills=((10, 0, 30), (60, 0, 80), (110, 0, 130)))
+    assert_shard_leg_ok(report)
+    assert report['kills'] == 3
+    # three settle audits (one per revive) plus the final one, each
+    # byte-identical — checked in assert_shard_leg_ok
+    assert len(report['audits']) == 4
+    assert report['shard_health_delta'].get('shard_revives', 0) == 3
+
+
+@pytest.mark.slow
+def test_shard_kill_matrix_full():
+    """Scaled kill schedule: two different victims plus a repeat kill,
+    both device modes."""
+    for mode in (False, True):
+        report = run_shard_leg(
+            'kill_matrix', n_shards=4, tenants=32, requests=1600,
+            arrivals_per_tick=16, chaos=True, seed=19,
+            exact_device=mode,
+            kills=((20, 1, 60), (120, 3, 160), (220, 1, 260)))
+        assert_shard_leg_ok(report)
+        assert report['failovers'] == 3
 
 
 @pytest.mark.slow

@@ -49,14 +49,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import automerge_tpu as A                                     # noqa: E402
 from automerge_tpu import backend as host_backend             # noqa: E402
-from automerge_tpu.columnar import encode_change              # noqa: E402
+from automerge_tpu.backend import get_change_by_hash          # noqa: E402
+from automerge_tpu.columnar import (encode_change,            # noqa: E402
+                                    decode_change_meta)
 from automerge_tpu.errors import AutomergeError                # noqa: E402
 from automerge_tpu.fleet import backend as fleet_backend      # noqa: E402
 from automerge_tpu.fleet.backend import DocFleet              # noqa: E402
+from automerge_tpu.fleet.faults import LossyLink              # noqa: E402
 from automerge_tpu.observability.slo import outcome_class     # noqa: E402
-from automerge_tpu.service import DocService                  # noqa: E402
+from automerge_tpu.service import Backoff, DocService         # noqa: E402
+from automerge_tpu.shard import ShardRouter, shard_stats      # noqa: E402
 
-__all__ = ['ZipfSampler', 'ChaosClient', 'run_leg', 'run_standard_legs']
+__all__ = ['ZipfSampler', 'ChaosClient', 'run_leg', 'run_standard_legs',
+           'run_shard_leg']
 
 
 class ZipfSampler:
@@ -611,6 +616,293 @@ def run_leg(name, *, sessions=1000, tenants=64, zipf_s=1.2,
     return report
 
 
+class _ShardWriter:
+    """One tenant's write stream in shard mode: seq-consecutive changes
+    from one actor, at most one apply in flight (seq ordering survives
+    router-level retries), failed payloads RETRANSMITTED byte-identical
+    (a re-minted seq with fresh content would collide with a copy the
+    crash actually preserved — idempotent-by-hash replay is the safe
+    retry)."""
+
+    __slots__ = ('name', 'actor', 'seq', 'acked', 'inflight', 'stash')
+
+    def __init__(self, name, actor):
+        self.name = name
+        self.actor = actor
+        self.seq = 0
+        self.acked = []          # payloads whose router tickets acked
+        self.inflight = None     # (ticket, payload)
+        self.stash = None        # failed payload awaiting retransmit
+
+    def next_payload(self, rng):
+        if self.stash is not None:
+            payload, self.stash = self.stash, None
+            return payload
+        self.seq += 1
+        return [encode_change({
+            'actor': self.actor, 'seq': self.seq, 'startOp': self.seq,
+            'time': 0, 'message': '', 'deps': [],
+            'ops': [{'action': 'set', 'obj': '_root',
+                     'key': f'k{rng.randrange(8)}',
+                     'value': rng.randrange(10_000), 'datatype': 'int',
+                     'pred': []}]})]
+
+
+def run_shard_leg(name, *, n_shards=4, tenants=16, requests=800,
+                  arrivals_per_tick=8, kills=(), chaos=False, seed=0,
+                  lease_ticks=3, tick_dt=0.02, subscribe_fraction=0.2,
+                  sync_fraction=0.1, rebalance_after_revive=True,
+                  audit_rounds=True, exact_device=False,
+                  link_budget=48, max_ticks=60_000, mttr_bound=None,
+                  service_kwargs=None, pump_threads=None, repl_every=1,
+                  pace=False):
+    """The kill-and-recover chaos leg for the shard cluster (ISSUE-11).
+
+    Drives an open-loop workload (applies + subscription pulls + sync
+    solicits) through a ``ShardRouter`` while crashing and reviving
+    shards on a schedule: ``kills`` is a sequence of
+    ``(kill_tick, shard_index, revive_tick)``. With ``chaos=True`` the
+    inter-shard replication links are budgeted ``LossyLink``s
+    (drop/dup/reorder/truncate/flip), so replication itself rides a
+    hostile wire; the budget runs dry before the drain, which is what
+    makes the post-quiet audit assertable.
+
+    The two contract audits (run after each revive round when
+    ``audit_rounds``, and always at the end):
+
+    - ZERO ACKNOWLEDGED-WRITE LOSS: every change of every acked apply
+      is present (by hash) on the tenant's CURRENT home doc — across
+      every kill, failover, and rebalance in the schedule.
+    - BYTE-IDENTICAL CONVERGENCE: after replication goes quiet, every
+      tenant's home doc and replica doc save() to identical bytes.
+
+    Plus the standing properties: zero untyped escapes (every failed
+    ticket carries an AutomergeError), and failover MTTR — ticks from
+    each kill to the first acked request served by a re-homed tenant's
+    replica — reported per kill (``mttr_bound`` asserts a ceiling)."""
+    rng = random.Random(seed)
+    clk = [0.0]
+    link_seed = [seed * 7919 + 13]
+
+    def link_factory(src, dst):
+        if not chaos:
+            return None
+        link_seed[0] += 1
+        return LossyLink(seed=link_seed[0], p_drop=0.05, p_dup=0.02,
+                         p_reorder=0.02, p_truncate=0.02, p_flip=0.02,
+                         budget=link_budget)
+
+    router = ShardRouter(
+        n_shards=n_shards, clock=lambda: clk[0],
+        lease_ticks=lease_ticks, link_factory=link_factory,
+        exact_device=exact_device, service_kwargs=service_kwargs,
+        pump_threads=pump_threads, repl_every=repl_every,
+        backoff=Backoff(base=tick_dt, factor=1.5, cap=tick_dt * 16,
+                        retries=16, jitter=0.5, seed=seed + 3))
+    shard_ids = router.ring.shard_ids()
+    tenant_names = [f'tenant{t}' for t in range(tenants)]
+    writers = {}
+    for i, t in enumerate(tenant_names):
+        router.open_tenant(t)
+        writers[t] = _ShardWriter(t, f'{i % 192:08x}' + 'cd' * 12)
+
+    counts = {'ok': 0}
+    untyped = 0
+    submitted = 0
+    aux = []                    # subscribe/sync tickets in flight
+    audits = []
+    mttrs = []                  # one record per kill
+    kill_list = sorted(kills)
+    revive_pending = []         # (revive_tick, shard_id)
+    base_health = shard_stats()
+
+    def pump():
+        router.pump(now=clk[0])
+        clk[0] += tick_dt
+
+    def note_error(err):
+        nonlocal untyped
+        key = type(err).__name__
+        counts[key] = counts.get(key, 0) + 1
+        if not isinstance(err, AutomergeError):
+            untyped += 1
+
+    def harvest():
+        for t, w in writers.items():
+            if w.inflight is None:
+                continue
+            ticket, payload = w.inflight
+            if not ticket.done:
+                continue
+            w.inflight = None
+            if ticket.status == 'ok':
+                counts['ok'] += 1
+                w.acked.append(payload)
+                for m in mttrs:
+                    if m['mttr_ticks'] is None and t in m['tenants'] and \
+                            router.tenant_record(t).home != m['shard']:
+                        m['mttr_ticks'] = router.ticks - m['kill_tick']
+            else:
+                note_error(ticket.error)
+                w.stash = payload        # retransmit the SAME bytes
+        still = []
+        for ticket in aux:
+            if not ticket.done:
+                still.append(ticket)
+                continue
+            if ticket.status == 'ok':
+                counts['ok'] += 1
+            else:
+                note_error(ticket.error)
+        aux[:] = still
+
+    def writers_idle():
+        return all(w.inflight is None for w in writers.values())
+
+    def drain_quiet(budget=1200):
+        for _ in range(budget):
+            if router.idle() and router.replication_quiet() and \
+                    not router.migrating() and writers_idle() and not aux:
+                return True
+            pump()
+            harvest()
+        return False
+
+    def audit(tag):
+        checked = lost = pairs = mismatched = homeless = 0
+        for t, w in writers.items():
+            rec = router.tenant_record(t)
+            if rec.home is None or rec.session is None:
+                homeless += 1
+                continue
+            for payload in w.acked:
+                for b in payload:
+                    checked += 1
+                    h = decode_change_meta(bytes(b), True)['hash']
+                    if get_change_by_hash(rec.session.handle, h) is None:
+                        lost += 1
+            if rec.replica_handle is not None:
+                pairs += 1
+                home_bytes = bytes(host_backend.save(rec.session.handle))
+                rep_bytes = bytes(host_backend.save(rec.replica_handle))
+                if home_bytes != rep_bytes:
+                    mismatched += 1
+        record = {'tag': tag, 'tick': router.ticks,
+                  'acked_changes_checked': checked, 'acked_lost': lost,
+                  'replica_pairs': pairs,
+                  'replica_mismatches': mismatched,
+                  'homeless_tenants': homeless}
+        audits.append(record)
+        return record
+
+    start = time.perf_counter()
+    slipped = 0
+    while submitted < requests or not writers_idle() or aux or \
+            kill_list or revive_pending:
+        if router.ticks >= max_ticks:
+            break
+        if pace:
+            # the serving tick is a CADENCE (tick_dt bounds batching
+            # latency): sleep to the tick boundary, and when the tick's
+            # work overran it, count the slip — a box whose per-tick
+            # work does not fit the cadence shows it here instead of
+            # silently reporting free-run throughput
+            deadline = start + router.ticks * tick_dt
+            wait = deadline - time.perf_counter()
+            if wait > 0:
+                time.sleep(wait)
+            else:
+                slipped += 1
+        while kill_list and router.ticks >= kill_list[0][0]:
+            ktick, sidx, rtick = kill_list.pop(0)
+            sid = shard_ids[sidx]
+            doomed = set(router.tenants_on(sid))
+            router.kill_shard(sid)
+            mttrs.append({'shard': sid, 'kill_tick': router.ticks,
+                          'tenants': doomed, 'mttr_ticks': None})
+            revive_pending.append((rtick, sid))
+        for rtick, sid in list(revive_pending):
+            if router.ticks >= rtick:
+                revive_pending.remove((rtick, sid))
+                router.revive_shard(sid)
+                if rebalance_after_revive:
+                    router.rebalance()
+                if audit_rounds:
+                    # one recovery round settles: arrivals pause, the
+                    # cluster drains to quiet, both audits run, then
+                    # the workload resumes against the healed topology
+                    drain_quiet()
+                    audit(f'post-revive-{sid}')
+        n_arrive = min(arrivals_per_tick, requests - submitted)
+        for _ in range(max(0, n_arrive)):
+            t = tenant_names[rng.randrange(tenants)]
+            w = writers[t]
+            roll = rng.random()
+            if roll < subscribe_fraction:
+                aux.append(router.submit(t, 'subscribe'))
+                submitted += 1
+            elif roll < subscribe_fraction + sync_fraction:
+                aux.append(router.submit(t, 'sync', None))
+                submitted += 1
+            else:
+                if w.inflight is not None:
+                    continue             # writer busy: seq order first
+                payload = w.next_payload(rng)
+                ticket = router.submit(t, 'apply', payload)
+                w.inflight = (ticket, payload)
+                submitted += 1
+        pump()
+        harvest()
+    drained = drain_quiet(budget=2400)
+    elapsed = time.perf_counter() - start   # serving window: audits are
+    final = audit('final')                  # verification, not serving
+
+    health = shard_stats()
+    link_stats = {}
+    for (src, dst), link in router._links.items():
+        if link is not None:
+            link_stats[f'{src}->{dst}'] = dict(link.stats)
+    report = {
+        'leg': name,
+        'shards': n_shards,
+        'tenants': tenants,
+        'requests_offered': requests,
+        'submitted': submitted,
+        'completed_ok': counts['ok'],
+        'rejections': {k: v for k, v in sorted(counts.items())
+                       if k != 'ok'},
+        'untyped_escapes': untyped,
+        'elapsed_s': round(elapsed, 3),
+        'ticks': router.ticks,
+        'requests_per_s': round(counts['ok'] / elapsed, 1)
+        if elapsed else None,
+        'lease_ticks': lease_ticks,
+        'paced': bool(pace),
+        'ticks_slipped': slipped if pace else None,
+        'kills': len(mttrs),
+        'failovers': len(router.failovers),
+        'mttr_ticks': [m['mttr_ticks'] for m in mttrs],
+        'drained': drained,
+        'audits': audits,
+        'final_audit': final,
+        'shard_health_delta': {k: health[k] - base_health.get(k, 0)
+                               for k in health
+                               if health[k] != base_health.get(k, 0)},
+        'link_stats': link_stats,
+    }
+    ok = (untyped == 0 and final['acked_lost'] == 0 and
+          final['replica_mismatches'] == 0 and
+          all(a['acked_lost'] == 0 and a['replica_mismatches'] == 0
+              for a in audits) and drained)
+    if mttr_bound is not None:
+        ok = ok and all(m['mttr_ticks'] is not None and
+                        m['mttr_ticks'] <= mttr_bound
+                        for m in mttrs if m['tenants'])
+    report['ok'] = ok
+    router.close()
+    return report
+
+
 def run_standard_legs(sessions=1000, tenants=64, requests=10_000,
                       seed=0, exact_device=False, sync_fraction=0.25):
     """The three standing legs: clean, chaos, 2x overload."""
@@ -635,6 +927,30 @@ def main():
     tenants = int(os.environ.get('LOADGEN_TENANTS', 64))
     requests = int(os.environ.get('LOADGEN_REQUESTS', 10_000))
     seed = int(os.environ.get('LOADGEN_SEED', 0))
+    n_shards = int(os.environ.get('LOADGEN_SHARDS', 0))
+    if n_shards:
+        # multi-shard mode: a clean leg plus a kill-one-shard chaos leg
+        # (kill at 1/3 of the arrival window, revive at 2/3)
+        arrivals = 8
+        window = max(1, requests // arrivals)
+        legs = [
+            run_shard_leg('shard_clean', n_shards=n_shards,
+                          tenants=tenants, requests=requests, seed=seed),
+            run_shard_leg('shard_kill', n_shards=n_shards,
+                          tenants=tenants, requests=requests,
+                          chaos=True, seed=seed + 1,
+                          kills=((window // 3, 0, 2 * window // 3),)),
+        ]
+        for leg in legs:
+            print(json.dumps(leg))
+            print(f"# {leg['leg']}: {leg['completed_ok']}/"
+                  f"{leg['submitted']} ok, {leg['failovers']} failovers, "
+                  f"mttr {leg['mttr_ticks']} ticks, audit "
+                  f"{leg['final_audit']}, "
+                  f"{'OK' if leg['ok'] else 'FAIL'}", file=sys.stderr)
+            if not leg['ok']:
+                sys.exit(1)
+        return
     for leg in run_standard_legs(sessions=sessions, tenants=tenants,
                                  requests=requests, seed=seed):
         print(json.dumps(leg))

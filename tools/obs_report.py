@@ -7,6 +7,7 @@ Usage:
     python tools/obs_report.py --flight after.json before.json
     python tools/obs_report.py --stitch peer_a.json peer_b.json \\
                                [-o stitched_trace.json]
+    python tools/obs_report.py --stitch shard0=a.json shard1=b.json
 
 Trace mode reads the Chrome trace-event JSON that
 ``observability.export_chrome_trace`` writes (a bare event list or a
@@ -32,7 +33,11 @@ ONE Perfetto-loadable trace: each input renders as its own named
 process, each file's clock is rebased to its own start (perf_counter
 epochs do not align across processes), and spans that share a
 ``trace``/``links`` id are reported so a request minted on one peer can
-be followed into the other peer's generate/receive span tree.
+be followed into the other peer's generate/receive span tree. Inputs
+may be ``shard0=path.json`` to label each process track with its shard
+id, and any input whose span ring wrapped (a restarted shard exports a
+partial window) has its truncation DISCLOSED in the report — trace ids
+stay continuous across the gap, so a failover still stitches.
 
 stdlib only — usable on a box with nothing else installed (the counter
 delta helper is loaded straight from
@@ -170,19 +175,47 @@ def _event_trace_ids(event):
     return ids
 
 
+def _split_labeled(arg):
+    """A stitch input may be ``shardname=path`` (the shard label a
+    multi-shard deployment names its exports by) or a bare path (the
+    basename then labels the process). Only treat ``lhs=`` as a label
+    when the whole arg isn't itself an existing file (paths may contain
+    '=')."""
+    if '=' in arg and not os.path.exists(arg):
+        label, _, path = arg.partition('=')
+        if label and path:
+            return label, path
+    return None, arg
+
+
 def stitch(paths, out_path=None):
     """Merge multiple peers' span exports into one Perfetto trace (see
-    the module docstring). Returns (events, shared_trace_ids) where
-    shared ids appear in MORE than one input — the stitched requests."""
+    the module docstring). Each input may be ``shard=path`` to label its
+    process track. Returns (events, shared_trace_ids, truncated) where
+    shared ids appear in MORE than one input — the stitched requests —
+    and truncated maps labels whose span ring wrapped (a restarted or
+    long-lived shard) to their dropped-span counts: the window loss is
+    DISCLOSED, and trace ids still correlate across the gap (they ride
+    the surviving spans, not the ring indices)."""
     events = []
     ids_by_file = []
-    for pid, path in enumerate(paths, start=1):
+    truncated = {}
+    seen_labels = set()
+    for pid, arg in enumerate(paths, start=1):
+        label, path = _split_labeled(arg)
+        if label is None:
+            label = os.path.basename(path)
+        if label in seen_labels:
+            # two unlabeled inputs sharing a basename must not merge
+            # their process tracks or truncation disclosures
+            label = f'{label}#{pid}'
+        seen_labels.add(label)
         file_events = load_events(path, phases=('X', 'I'))
         t0 = min((float(e.get('ts', 0.0)) for e in file_events),
                  default=0.0)
         ids = set()
         events.append({'ph': 'M', 'name': 'process_name', 'pid': pid,
-                       'tid': 0, 'args': {'name': os.path.basename(path)}})
+                       'tid': 0, 'args': {'name': label}})
         for e in file_events:
             e = dict(e)
             e['pid'] = pid
@@ -192,6 +225,11 @@ def stitch(paths, out_path=None):
             # the correlation, not the timestamps)
             e['ts'] = float(e.get('ts', 0.0)) - t0
             e.setdefault('tid', 0)
+            if e.get('ph') == 'I' and e.get('name') == 'spans_dropped':
+                # the export's in-band truncation marker: this ring
+                # wrapped (or was restarted) and older spans are gone
+                truncated[label] = truncated.get(label, 0) + \
+                    int((e.get('args') or {}).get('dropped', 0))
             events.append(e)
             ids |= _event_trace_ids(e)
         ids_by_file.append(ids)
@@ -203,14 +241,18 @@ def stitch(paths, out_path=None):
         with open(out_path, 'w') as f:
             json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'},
                       f)
-    return events, shared
+    return events, shared, truncated
 
 
 def render_stitch(paths, out_path, out=sys.stdout):
-    events, shared = stitch(paths, out_path)
+    events, shared, truncated = stitch(paths, out_path)
     spans = [e for e in events if e.get('ph') == 'X']
     print(f'# stitched {len(paths)} peers: {len(spans)} spans'
           f'{" -> " + out_path if out_path else ""}', file=out)
+    for label, dropped in sorted(truncated.items()):
+        print(f'# shard {label}: span ring truncated ({dropped} older '
+              f'spans dropped) — window is partial; trace ids remain '
+              f'continuous across the gap', file=out)
     by_trace = {}
     for e in spans:
         for tid in _event_trace_ids(e) & shared:
