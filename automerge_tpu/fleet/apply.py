@@ -66,6 +66,51 @@ def _apply_op_batch_impl(state, ops):
 apply_op_batch = jax.jit(_apply_op_batch_impl)
 
 
+def _apply_op_batch_noinc_impl(state, ops):
+    """Set-only batches (no inc lanes — the caller checks host-side):
+    skips the counter machinery entirely. The counter grid passes
+    through UNTOUCHED — with donation that is a buffer alias, so the
+    dispatch saves the winners==old compare (2 grid reads), the
+    counter where() rewrite, and the inc scatter: ~3 whole-grid memory
+    passes on a path whose cost IS memory traffic.
+
+    SOUNDNESS GATE (the caller's, not this kernel's): with all-False
+    is_inc the general kernel still RESETS the accumulator of any key
+    whose winner changed — so skipping the counter machinery is only
+    byte-identical while the counter grid is all-zero. DocFleet tracks
+    that with `_counters_touched`: the first batch carrying an inc lane
+    (or a bulk load installing counter cells) pins the fleet to the
+    general kernel for good. Pinned against the general kernel by
+    test_noinc_kernel_matches_general."""
+    n_docs, n_slots = state.winners.shape
+    doc_idx = jnp.arange(n_docs, dtype=jnp.int32)[:, None]
+    doc_idx = jnp.broadcast_to(doc_idx, ops.key_id.shape)
+    scratch = n_slots - 1
+    set_mask = ops.is_set & ops.valid
+    set_key = jnp.where(set_mask, ops.key_id, scratch)
+    winners = state.winners.at[doc_idx, set_key].max(
+        jnp.where(set_mask, ops.packed, 0))
+    won = set_mask & (ops.packed == winners[doc_idx, ops.key_id])
+    win_key = jnp.where(won, ops.key_id, scratch)
+    values = state.values.at[doc_idx, win_key].set(
+        jnp.where(won, ops.value, 0))
+    stats = jnp.sum(ops.valid, dtype=jnp.int32)
+    return FleetState(winners, values, state.counters), stats
+
+
+apply_op_batch_noinc_donated = jax.jit(_apply_op_batch_noinc_impl,
+                                       donate_argnums=(0,))
+
+
+def _apply_op_batch_noinc_fresh_impl(ops, n_docs, n_keys):
+    return _apply_op_batch_noinc_impl(
+        FleetState.empty(n_docs, n_keys, xp=jnp), ops)
+
+
+apply_op_batch_noinc_fresh = jax.jit(_apply_op_batch_noinc_fresh_impl,
+                                     static_argnums=(1, 2))
+
+
 def _apply_op_batch_kills_impl(state, ops, kill_key, kill_packed):
     """Apply one OpBatch plus delete "kill lanes" with the reference's
     pred-scoped delete semantics (ref backend/new.js:1204-1217: a delete
@@ -137,6 +182,35 @@ apply_op_batch_kills_donated = jax.jit(_apply_op_batch_kills_impl,
 # fresh fleet (or promote to the host engine) from their logs; device
 # state is always a derived cache.
 apply_op_batch_donated = jax.jit(_apply_op_batch_impl, donate_argnums=(0,))
+
+
+def _apply_op_batch_fresh_impl(ops, n_docs, n_keys):
+    """First dispatch of a FRESH fleet: the zero state is created inside
+    the jit, so XLA fuses the fill with the scatter instead of running a
+    separate whole-grid memset dispatch first — a fresh 10k-doc x 1k-key
+    grid otherwise pays a ~120 MB zero-fill (measured 60-85 ms host-side
+    on the bench box) before its first merge. Shapes are static args:
+    one compile per capacity step, same as the growth path."""
+    return _apply_op_batch_impl(FleetState.empty(n_docs, n_keys, xp=jnp),
+                                ops)
+
+
+apply_op_batch_fresh = jax.jit(_apply_op_batch_fresh_impl,
+                               static_argnums=(1, 2))
+
+
+def _apply_op_batch_kills_fresh_impl(ops, kill_key, kill_packed, n_docs,
+                                     n_keys):
+    """Kills-aware variant of the fused fresh-state dispatch (kills
+    against an all-zero grid cannot hit, but the lane masking of
+    same-batch sets must still run)."""
+    return _apply_op_batch_kills_impl(
+        FleetState.empty(n_docs, n_keys, xp=jnp), ops, kill_key,
+        kill_packed)
+
+
+apply_op_batch_kills_fresh = jax.jit(_apply_op_batch_kills_fresh_impl,
+                                     static_argnums=(3, 4))
 
 
 def _zero_doc_rows_impl(state, idx):

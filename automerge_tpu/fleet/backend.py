@@ -61,6 +61,11 @@ from .ingest import KeyInterner
 _FLAT_ACTIONS = ('set', 'del', 'inc')
 _SEQ_MAKE = ('makeText', 'makeList')
 
+# Turbo commits park their log appends as lazily-folded _SeamSegs; past
+# this many outstanding records the fleet folds everything (bounds the
+# rowmap overhead on write-only workloads that never read history).
+_SEAM_FOLD_LIMIT = 64
+
 
 
 class _Unsupported(Exception):
@@ -318,6 +323,27 @@ class DocFleet:
         self.free_slots = []
         self.pending = []         # (slot, [change buffers])
         self.pending_actors = set()
+        # Struct-of-arrays doc state (heads/clock/max_op/stale/...): the
+        # turbo commit scatters whole batches into these columns; the
+        # engines' attributes are property views onto their slot row.
+        self.doc_cols = _DocCols(doc_capacity)
+        # slot -> live engine — lets the seam-cap fold reach pending
+        # docs without a handle. A PLAIN dict (a WeakValueDictionary
+        # measured 40x slower per store, ~40 ms per 10k-doc init):
+        # entries are popped by every slot-free path (free_docs /
+        # free_slot / promote), so an engine outlives its handles only
+        # until its slot is freed or reused — and an abandoned FLEET
+        # takes the whole registry down with it.
+        self._engines = {}
+        # Lazily-folded turbo-commit log segments (see _SeamSegs) and
+        # the clock-actor registry backing the _DocCols clock lanes
+        self._pend_seams = []
+        self._ck_reg = {}         # actor hex -> clock-actor id
+        self._ck_names = []       # clock-actor id -> actor hex
+        # False until any inc lane (or bulk-loaded counter cell) lands:
+        # while False, set-only batches take the specialized no-inc
+        # merge kernel (apply.py) that skips the counter grid passes
+        self._counters_touched = False
         self.metrics = Metrics()  # per-dispatch counters (observability.py)
         # Sequence-object fleet: one device row per (doc slot, objectId).
         # Text/list CRDT state lives in pow2 size-class pools of SeqStates
@@ -421,9 +447,12 @@ class DocFleet:
 
     def alloc_slot(self):
         if self.free_slots:
-            return self.free_slots.pop()
-        slot = self.n_slots
-        self.n_slots += 1
+            slot = self.free_slots.pop()
+        else:
+            slot = self.n_slots
+            self.n_slots += 1
+        self.doc_cols.ensure(self.n_slots)
+        self.doc_cols.reset_rows([slot])
         return slot
 
     def alloc_slots(self, n):
@@ -442,6 +471,8 @@ class DocFleet:
             base = self.n_slots
             out.extend(range(base, base + rest))
             self.n_slots = base + rest
+        self.doc_cols.ensure(self.n_slots)
+        self.doc_cols.reset_rows(out)
         return out
 
     def free_slot(self, slot):
@@ -458,8 +489,27 @@ class DocFleet:
             gone = set(slots)
             self.pending = [(s, b) for (s, b) in self.pending
                             if s not in gone]
+        if self._pend_seams:
+            # un-folded turbo appends die with the doc: a recycled slot
+            # must never fold a previous tenant's segments
+            for seg in self._pend_seams:
+                for slot in slots:
+                    seg.rowmap.pop(slot, None)
+            self._pend_seams = [s for s in self._pend_seams if s.rowmap]
         self._index_consolidate()
         seq_zero = []
+        for slot in slots:
+            eng = self._engines.pop(slot, None)
+            if eng is not None:
+                # sever the dead engine from the shared columns: every
+                # freeing path nulls its handle's _impl, so nothing
+                # legitimate touches it again — but a leaked raw
+                # reference must fail LOUDLY (a non-integer slot makes
+                # every column index raise) rather than alias the
+                # slot's next tenant. slot=None would be WORSE than
+                # stale: numpy None-indexing broadcasts, so a setter
+                # would overwrite whole columns.
+                eng.slot = 'freed'
         for slot in slots:
             self.ctr_base.pop(slot, None)
             self.grid_overflow.discard(slot)
@@ -476,6 +526,20 @@ class DocFleet:
         if seq_zero:
             self._zero_seq_rows(seq_zero)
         self.free_slots.extend(slots)
+
+    def _fold_all_pending(self):
+        """Fold every doc's pending turbo-commit segments into the real
+        logs — the amortized eager path bounding seam-record memory on
+        write-heavy workloads that never read history (the hot path
+        stays O(1); this runs once per _SEAM_FOLD_LIMIT commits)."""
+        for seg in list(self._pend_seams):
+            for slot in list(seg.rowmap):
+                eng = self._engines.get(slot)
+                if eng is None:
+                    seg.rowmap.pop(slot, None)
+                else:
+                    eng._fold_pending()
+        self._pend_seams = [s for s in self._pend_seams if s.rowmap]
 
     def clone_slot(self, src):
         self.flush()
@@ -908,18 +972,41 @@ class DocFleet:
             self.pending.append((slot, list(buffers)))
             self.pending_actors.update(actors)
 
+    def _grid_cap(self):
+        """Doc capacity of the grid state — materialized or (fresh
+        fleet, allocation deferred into the first dispatch) recorded."""
+        return self.state.winners.shape[0] if self.state is not None \
+            else self.doc_cap
+
+    def _materialize_grid(self, n_docs, n_keys):
+        """Eagerly materialize the grid state at capacity — for callers
+        that write `self.state` IN PLACE (the bulk loader's direct
+        installs) rather than through a dispatch, where the deferred
+        fresh-fleet allocation (see _ensure_capacity/_dispatch_grid)
+        would leave state None."""
+        self._ensure_capacity(n_docs=n_docs, n_keys=n_keys)
+        if self.state is None:
+            import jax.numpy as jnp
+            self.state = self._shard_docs(
+                FleetState.empty(self.doc_cap, self.key_cap, xp=jnp))
+
     def _ensure_capacity(self, n_docs, n_keys):
         need_docs = self._cap_docs(n_docs)
         need_keys = _pow2(max(n_keys + 1, self.key_cap))
         if self.state is None:
-            import jax.numpy as jnp
             self.doc_cap, self.key_cap = need_docs, need_keys
-            # Allocate on device: host-side zeros would ship the whole grid
-            # over the transfer link for no reason
-            self.state = self._shard_docs(
-                FleetState.empty(need_docs, need_keys, xp=jnp))
             self.host_winners = np.zeros((need_docs, need_keys + 1),
                                          dtype=np.int32)
+            if self.mesh is not None:
+                # sharded fleets keep the eager device allocation (the
+                # fused fresh-dispatch path would need out_shardings)
+                import jax.numpy as jnp
+                self.state = self._shard_docs(
+                    FleetState.empty(need_docs, need_keys, xp=jnp))
+            # else: the first _dispatch_grid builds the zero state INSIDE
+            # its jit (apply.apply_op_batch_fresh) — the fill fuses with
+            # the first scatter instead of being its own ~whole-grid
+            # memset dispatch
             return
         old_n, old_k = self.state.winners.shape
         if need_docs <= old_n and need_keys + 1 <= old_k:
@@ -1196,20 +1283,47 @@ class DocFleet:
         (apply.apply_op_batch_kills — ref new.js:1204-1217); without, the
         plain scatter kernel. The batch must already be padded to the
         state's doc capacity; kills are padded here."""
-        from .apply import apply_op_batch_donated, apply_op_batch_kills_donated
+        from .apply import (apply_op_batch_donated, apply_op_batch_fresh,
+                            apply_op_batch_kills_donated,
+                            apply_op_batch_kills_fresh,
+                            apply_op_batch_noinc_donated,
+                            apply_op_batch_noinc_fresh)
+        fresh = self.state is None      # deferred fresh-fleet allocation
+        has_inc = bool(batch.is_inc.any())
+        if has_inc:
+            self._counters_touched = True
         if kills is None:
-            self.state, _stats = apply_op_batch_donated(
-                self.state, self._shard_docs(batch))
+            if not has_inc and not self._counters_touched:
+                # set-only batch on a counter-free grid: the specialized
+                # kernel skips ~3 whole-grid memory passes (see apply.py)
+                if fresh:
+                    self.state, _stats = apply_op_batch_noinc_fresh(
+                        batch, self.doc_cap, self.key_cap)
+                else:
+                    self.state, _stats = apply_op_batch_noinc_donated(
+                        self.state, self._shard_docs(batch))
+            elif fresh:
+                self.state, _stats = apply_op_batch_fresh(
+                    batch, self.doc_cap, self.key_cap)
+            else:
+                self.state, _stats = apply_op_batch_donated(
+                    self.state, self._shard_docs(batch))
         else:
             kill_key, kill_packed = kills
-            n_cap = self.state.winners.shape[0]
+            n_cap = self._grid_cap()
             if kill_key.shape[0] < n_cap:
                 pad = n_cap - kill_key.shape[0]
                 kill_key = np.pad(kill_key, ((0, pad), (0, 0)))
                 kill_packed = np.pad(kill_packed, ((0, pad), (0, 0)))
-            self.state, _stats = apply_op_batch_kills_donated(
-                self.state, self._shard_docs(batch),
-                self._shard_docs(kill_key), self._shard_docs(kill_packed))
+            if fresh:
+                self.state, _stats = apply_op_batch_kills_fresh(
+                    batch, kill_key, kill_packed, self.doc_cap,
+                    self.key_cap)
+            else:
+                self.state, _stats = apply_op_batch_kills_donated(
+                    self.state, self._shard_docs(batch),
+                    self._shard_docs(kill_key),
+                    self._shard_docs(kill_packed))
         self.metrics.dispatches += 1
 
     def _note_grid_batch(self, set_doc, set_key, set_packed,
@@ -1353,8 +1467,8 @@ class DocFleet:
             self._flush_mixed(per_doc, n_docs)
             return
         self._ensure_capacity(n_docs=n_docs, n_keys=len(self.keys))
-        if batch.key_id.shape[0] < self.state.winners.shape[0]:
-            pad = self.state.winners.shape[0] - batch.key_id.shape[0]
+        if batch.key_id.shape[0] < self._grid_cap():
+            pad = self._grid_cap() - batch.key_id.shape[0]
             batch = type(batch)(*(np.pad(col, ((0, pad), (0, 0)))
                                   for col in batch.tree_flatten()[0]))
         if index_rows:
@@ -1480,7 +1594,7 @@ class DocFleet:
                 counts[r[0]] += 1
             width = max(int(counts.max()), 1)
             self._ensure_capacity(n_docs=n_docs, n_keys=len(self.keys))
-            n_cap = self.state.winners.shape[0]
+            n_cap = self._grid_cap()
             shape = (n_cap, width)
             cols = {name: np.zeros(shape, dtype=np.int32)
                     for name in ('key_id', 'packed', 'value')}
@@ -1739,6 +1853,114 @@ class DocFleet:
                  if conflicts} for doc in docs[:self.n_slots]]
 
 
+class _DocCols:
+    """Struct-of-arrays doc state for every fleet engine, indexed by slot.
+
+    The turbo commit's per-doc Python loop (heads / max_op / stale /
+    binary_doc writes, clock advance, log bookkeeping) is replaced by
+    vectorized scatters into these columns; `_FlatEngine` exposes the
+    same attributes as properties reading its row, so every slow path
+    keeps its exact semantics against ONE source of truth. Grows pow2
+    with the fleet's slot count; recycled slots are reset at allocation
+    time in one vectorized pass (`reset_rows`).
+
+    Head frontier: ``head_n`` is the head count when the frontier is
+    columnar-representable (0 = empty, 1 = ``head32`` holds the raw
+    hash, ``head_hex``/``head_obj`` memoize the hex string / list) and
+    -1 when the authoritative list lives in ``head_obj`` (multi-head
+    docs — the gate falls back to the host hex compare for those).
+
+    Clock: up to ``CLOCK_LANES`` (actor, seq) lanes per doc
+    (``ck_actor`` holds ids into the fleet's clock-actor registry,
+    -1 = unused), ``ck_n`` the lane count — or -1 when the
+    authoritative dict lives in ``ck_obj`` (actor populations past the
+    lane width). The gate's per-(doc, actor) base lookup and the
+    commit's clock advance are vectorized over the lanes; dict-mode
+    docs take the counted fallback loop.
+
+    Change log: per-doc buffer lists stay on the engines (``_log``),
+    but turbo commits append LAZILY — each batch parks one
+    `_SeamSegs` record on the fleet and bumps ``pend_n``; an engine
+    folds its pending segments into ``_log``/``_defer`` only when
+    something actually reads its history (`_fold_pending`). ``pend_doc``
+    / ``parked_n`` mirror ``_doc_pending`` / ``_parked_n`` so the
+    commit computes parked-prefix bases without touching engines."""
+
+    CLOCK_LANES = 4
+
+    __slots__ = ('cap', 'maxop', 'stale', 'bindoc', 'head_n', 'head32',
+                 'head_hex', 'head_obj', 'ck_n', 'ck_actor', 'ck_seq',
+                 'ck_obj', 'pend_doc', 'parked_n', 'pend_n')
+
+    def __init__(self, cap=64):
+        self._alloc(max(int(cap), 1))
+
+    def _alloc(self, cap):
+        L = self.CLOCK_LANES
+        self.cap = cap
+        self.maxop = np.zeros(cap, dtype=np.int64)
+        self.stale = np.zeros(cap, dtype=bool)
+        self.bindoc = np.full(cap, None, dtype=object)
+        self.head_n = np.zeros(cap, dtype=np.int32)
+        self.head32 = np.zeros((cap, 32), dtype=np.uint8)
+        self.head_hex = np.full(cap, None, dtype=object)
+        self.head_obj = np.full(cap, None, dtype=object)
+        self.ck_n = np.zeros(cap, dtype=np.int32)
+        self.ck_actor = np.full((cap, L), -1, dtype=np.int32)
+        self.ck_seq = np.zeros((cap, L), dtype=np.int64)
+        self.ck_obj = np.full(cap, None, dtype=object)
+        self.pend_doc = np.full(cap, None, dtype=object)
+        self.parked_n = np.zeros(cap, dtype=np.int64)
+        self.pend_n = np.zeros(cap, dtype=np.int64)
+
+    def ensure(self, n):
+        """Grow (pow2) so rows [0, n) are addressable."""
+        if n <= self.cap:
+            return
+        old = {name: getattr(self, name) for name in self.__slots__
+               if name != 'cap'}
+        k = self.cap
+        self._alloc(_pow2(n))
+        for name, arr in old.items():
+            getattr(self, name)[:k] = arr
+
+    def reset_rows(self, rows):
+        """Vectorized per-row defaults (fresh-engine state) — the single
+        choke point recycled slots pass through at allocation."""
+        if not len(rows):
+            return
+        rows = np.asarray(rows, dtype=np.int64)
+        self.maxop[rows] = 0
+        self.stale[rows] = False
+        self.bindoc[rows] = None
+        self.head_n[rows] = 0
+        self.head_hex[rows] = None
+        self.head_obj[rows] = None
+        self.ck_n[rows] = 0
+        self.ck_actor[rows] = -1
+        self.ck_seq[rows] = 0
+        self.ck_obj[rows] = None
+        self.pend_doc[rows] = None
+        self.parked_n[rows] = 0
+        self.pend_n[rows] = 0
+
+
+class _SeamSegs:
+    """One turbo commit's lazily-folded log/deferred-graph appends: the
+    flat buffer list + parse metadata, and per-slot (start, stop, base)
+    segments. `_FlatEngine._fold_pending` pops its slot's segment and
+    splices `buffers[start:stop]` into the log (and one deferred-graph
+    record at `base`) — until then the commit cost for the log is one
+    dict build for the whole batch."""
+
+    __slots__ = ('buffers', 'meta', 'rowmap')
+
+    def __init__(self, buffers, meta, rowmap):
+        self.buffers = buffers
+        self.meta = meta
+        self.rowmap = rowmap
+
+
 class _FlatEngine(HashGraph):
     """Host-side mirror + patch generator for one fleet document.
 
@@ -1754,18 +1976,27 @@ class _FlatEngine(HashGraph):
 
     # 'changes' is inherited as a HashGraph slot but shadowed by the
     # property below; storage lives in _changes (see the property note).
+    # The hot doc-state fields (heads/clock/max_op/stale/binary_doc/
+    # _doc_pending/_parked_n) live in the fleet's _DocCols columns —
+    # shadowed here as properties reading this engine's slot row — so
+    # the turbo commit updates a whole batch of docs with vectorized
+    # scatters instead of per-engine attribute writes.
     # _doc_hashes/_doc_maxops carry the native extractor's per-change
     # hashes/maxOps after a native materialize (in place of the decoded
-    # dicts the Python path keeps in _doc_decoded); _parked_n is the
-    # parked chunk's change count while appends accumulate in the tail.
-    __slots__ = ('fleet', 'slot', 'mirror', 'binary_doc', 'seq_objects',
-                 'map_objects', 'stale', '_doc_pending', '_doc_decoded',
-                 '_changes', '_doc_hashes', '_doc_maxops', '_parked_n')
+    # dicts the Python path keeps in _doc_decoded).
+    __slots__ = ('fleet', 'slot', 'mirror', 'seq_objects', 'map_objects',
+                 '_doc_decoded', '_log', '_defer', '_doc_hashes',
+                 '_doc_maxops')
 
     def __init__(self, fleet, slot):
-        super().__init__()
+        # fleet/slot FIRST: every col-backed property setter below (and
+        # in HashGraph.__init__) resolves through them
         self.fleet = fleet
         self.slot = slot
+        fleet._engines[slot] = self
+        self._log = []
+        self._defer = []
+        super().__init__()
         self.mirror = None        # OpSet, built lazily on first exact use
         self.binary_doc = None
         self.seq_objects = {}     # objectId -> 'text' | 'list'
@@ -1785,31 +2016,219 @@ class _FlatEngine(HashGraph):
         attribute sets as __init__, skipping the constructor call chain
         (measurable at 10k+ docs). MUST stay equivalent to
         __init__/HashGraph.__init__ — test_bulk_init_matches_constructor
-        pins the attribute-set equivalence."""
+        pins the attribute-set equivalence. Column-backed fields
+        (heads/clock/max_op/stale/binary_doc/_doc_pending) are NOT set
+        here: the caller's alloc_slots already reset their rows in one
+        vectorized pass (`_DocCols.reset_rows`)."""
         e = cls.__new__(cls)
-        # HashGraph.__init__ body
-        e.max_op = 0
+        e.fleet = fleet
+        e.slot = slot
+        fleet._engines[slot] = e
+        # HashGraph.__init__ body (column-backed fields via reset_rows)
         e.actor_ids = []
-        e.heads = []
-        e.clock = {}
         e.queue = []
-        e.changes = []
+        e._log = []
         e.changes_meta = []
         e.change_index_by_hash = {}
         e.dependencies_by_hash = {}
         e.dependents_by_hash = {}
         e.hashes_by_actor = {}
-        e._deferred = []
+        e._defer = []
         # _FlatEngine.__init__ body
-        e.fleet = fleet
-        e.slot = slot
         e.mirror = None
-        e.binary_doc = None
         e.seq_objects = {}
         e.map_objects = {}
-        e.stale = False
-        e._doc_pending = None
         return e
+
+    # -- column-backed doc state (struct-of-arrays; see _DocCols) -------
+
+    @property
+    def max_op(self):
+        return int(self.fleet.doc_cols.maxop[self.slot])
+
+    @max_op.setter
+    def max_op(self, v):
+        self.fleet.doc_cols.maxop[self.slot] = v
+
+    @property
+    def stale(self):
+        return bool(self.fleet.doc_cols.stale[self.slot])
+
+    @stale.setter
+    def stale(self, v):
+        self.fleet.doc_cols.stale[self.slot] = v
+
+    @property
+    def binary_doc(self):
+        return self.fleet.doc_cols.bindoc[self.slot]
+
+    @binary_doc.setter
+    def binary_doc(self, v):
+        self.fleet.doc_cols.bindoc[self.slot] = v
+
+    @property
+    def _doc_pending(self):
+        return self.fleet.doc_cols.pend_doc[self.slot]
+
+    @_doc_pending.setter
+    def _doc_pending(self, v):
+        self.fleet.doc_cols.pend_doc[self.slot] = v
+
+    @property
+    def _parked_n(self):
+        return int(self.fleet.doc_cols.parked_n[self.slot])
+
+    @_parked_n.setter
+    def _parked_n(self, v):
+        self.fleet.doc_cols.parked_n[self.slot] = v
+
+    @property
+    def heads(self):
+        """The head frontier as the usual sorted-hex list. Materialized
+        lazily from the binary column (memoized per generation); treat
+        the returned list as read-only — replace it via assignment, as
+        every existing writer does."""
+        cols = self.fleet.doc_cols
+        r = self.slot
+        n = cols.head_n[r]
+        if n == -1:
+            return cols.head_obj[r]
+        memo = cols.head_obj[r]
+        if memo is None:
+            if n == 0:
+                memo = []
+            else:
+                hx = cols.head_hex[r]
+                if hx is None:
+                    hx = cols.head32[r].tobytes().hex()
+                    cols.head_hex[r] = hx
+                memo = [hx]
+            cols.head_obj[r] = memo
+        return memo
+
+    @heads.setter
+    def heads(self, v):
+        cols = self.fleet.doc_cols
+        r = self.slot
+        if type(v) is not list:
+            v = list(v)
+        if len(v) == 1 and len(v[0]) == 64:
+            try:
+                cols.head32[r] = np.frombuffer(bytes.fromhex(v[0]),
+                                               dtype=np.uint8)
+            except ValueError:
+                cols.head_n[r] = -1       # not a hex hash: attr-mode
+                cols.head_obj[r] = v
+                return
+            cols.head_n[r] = 1
+            cols.head_hex[r] = v[0]
+            cols.head_obj[r] = v
+        elif not v:
+            cols.head_n[r] = 0
+            cols.head_obj[r] = v
+        else:
+            cols.head_n[r] = -1           # multi-head: attr-mode
+            cols.head_obj[r] = v
+
+    @property
+    def clock(self):
+        """The vector clock as a dict. Lane-mode rows materialize a
+        FRESH dict per read — mutate via whole-dict assignment (the
+        pattern every writer uses), never in place."""
+        cols = self.fleet.doc_cols
+        r = self.slot
+        n = cols.ck_n[r]
+        if n == -1:
+            return cols.ck_obj[r]
+        if n == 0:
+            return {}
+        names = self.fleet._ck_names
+        ck_actor = cols.ck_actor
+        ck_seq = cols.ck_seq
+        return {names[ck_actor[r, l]]: int(ck_seq[r, l]) for l in range(n)}
+
+    @clock.setter
+    def clock(self, d):
+        cols = self.fleet.doc_cols
+        r = self.slot
+        n = len(d)
+        if 0 < n <= cols.CLOCK_LANES:
+            reg = self.fleet._ck_reg
+            names = self.fleet._ck_names
+            for l, (a, s) in enumerate(d.items()):
+                aid = reg.get(a)
+                if aid is None:
+                    aid = len(names)
+                    reg[a] = aid
+                    names.append(a)
+                cols.ck_actor[r, l] = aid
+                cols.ck_seq[r, l] = s
+            # clear the tail lanes: the gate/commit lane scans read all
+            # CLOCK_LANES, so a SHRINKING assignment (e.g. restore_all
+            # rolling back a failed drain) must not leave a stale lane
+            # that would hand the gate a phantom seq base
+            cols.ck_actor[r, n:] = -1
+            cols.ck_n[r] = n
+            cols.ck_obj[r] = None
+        elif n == 0:
+            cols.ck_actor[r, :] = -1
+            cols.ck_n[r] = 0
+            cols.ck_obj[r] = None
+        else:
+            cols.ck_n[r] = -1
+            cols.ck_obj[r] = d
+
+    # -- lazily-folded change log (see _SeamSegs) -----------------------
+
+    def _fold_pending(self):
+        """Splice this doc's pending turbo-commit segments into the real
+        log + deferred-graph records (commit order preserved). Runs only
+        when something genuinely reads or extends history — the hot
+        write path never pays it."""
+        fleet = self.fleet
+        r = self.slot
+        if not fleet.doc_cols.pend_n[r]:
+            return
+        log = self._log
+        defer = self._defer
+        compact = False
+        for seg in fleet._pend_seams:
+            ent = seg.rowmap.pop(r, None)
+            if ent is None:
+                continue
+            start, stop, base = ent
+            log.extend(seg.buffers[start:stop])
+            defer.append((base, seg.meta, range(start, stop)))
+            if not seg.rowmap:
+                compact = True
+        fleet.doc_cols.pend_n[r] = 0
+        if compact:
+            fleet._pend_seams = [s for s in fleet._pend_seams if s.rowmap]
+
+    @property
+    def _changes(self):
+        if self.fleet.doc_cols.pend_n[self.slot]:
+            self._fold_pending()
+        return self._log
+
+    @_changes.setter
+    def _changes(self, value):
+        # fold-then-replace: an overwrite must never silently drop
+        # pending accepted appends (every real caller reads first, so
+        # the fold is a no-op there; this is belt-and-braces)
+        if self.fleet.doc_cols.pend_n[self.slot]:
+            self._fold_pending()
+        self._log = value
+
+    @property
+    def _deferred(self):
+        if self.fleet.doc_cols.pend_n[self.slot]:
+            self._fold_pending()
+        return self._defer
+
+    @_deferred.setter
+    def _deferred(self, value):
+        self._defer = value
 
     # The change log is a property so a bulk-loaded document's history can
     # stay unmaterialized until something genuinely reads or extends it
@@ -3477,71 +3896,69 @@ def _apply_changes_turbo_inner(handles, per_doc_changes, ps, parsed=None):
     batch_meta = _TurboMetaBatch(nmeta, nat_actors, flat_buffers)
     ps.mark('turbo_gate')
 
-    # ---- Vectorized linear-chain validation over the whole batch ----
+    # ---- Batched linear-chain validation: ONE native call ----
     # A doc takes the fast path iff every change deps on exactly the
     # previous change (or the doc's current head for the first) and seqs
     # are contiguous per actor. Everything else gets the general gate.
+    # The chain-link memcmps, deps-count checks, heads compare against
+    # the columnar head32 rows, and per-(doc, actor) seq-run grouping
+    # all run in codec.cpp's am_turbo_gate with the GIL released —
+    # replacing the per-doc hex/dict probes AND the numpy argsort pass.
     doc_of = change_doc
-    actor_id = nmeta['actor'].astype(np.int64)
     seqs = nmeta['seq']
-    deps_off = nmeta['deps_off']
-    deps_count = np.diff(deps_off)
     hash32 = nmeta['hash32']
-    deps_view = np.frombuffer(nmeta['deps_blob'], dtype=np.uint8)
-    deps_view = deps_view.reshape(-1, 32) if deps_view.size else \
-        np.zeros((0, 32), dtype=np.uint8)
-
-    ok = np.ones(n_changes, dtype=bool)
-    prev_same = np.zeros(n_changes, dtype=bool)
-    prev_same[1:] = doc_of[1:] == doc_of[:-1]
-    dep0 = np.zeros((n_changes, 32), dtype=np.uint8)
-    has_dep = deps_count >= 1
-    dep0[has_dep] = deps_view[deps_off[:-1][has_dep]]
-    link = np.zeros(n_changes, dtype=bool)
-    if n_changes > 1:
-        link[1:] = (dep0[1:] == hash32[:-1]).all(axis=1)
-    ok &= ~prev_same | ((deps_count == 1) & link)
-
-    # Contiguous seqs per (doc, actor): rank within the group + clock base.
-    # Docs with an empty clock (fresh documents — the bulk-ingest common
-    # case) have base 0 for every actor, so the per-group dict walk runs
-    # only over docs that already hold state.
-    key = doc_of * _MA + actor_id
-    order = np.argsort(key, kind='stable')
-    key_sorted = key[order]
-    rank = np.arange(n_changes) - \
-        np.searchsorted(key_sorted, key_sorted, side='left')
-    base_sorted = np.zeros(n_changes, dtype=np.int64)
-    group_starts = np.flatnonzero(np.r_[True, key_sorted[1:] != key_sorted[:-1]])
-    clocked = np.fromiter((len(e.clock) != 0 for e in engines),
-                          dtype=bool, count=len(engines))
-    if clocked.any():
-        g_stop_all = np.r_[group_starts[1:], n_changes]
-        for gi in np.flatnonzero(clocked[key_sorted[group_starts] // _MA]):
-            start = group_starts[gi]
-            k = int(key_sorted[start])
-            actor_hex = nat_actors[k % _MA]
-            base_sorted[start:g_stop_all[gi]] = \
-                engines[k // _MA].clock.get(actor_hex, 0)
-    ok_seq = np.empty(n_changes, dtype=bool)
-    ok_seq[order] = seqs[order] == base_sorted + rank + 1
-    ok &= ok_seq
-
-    # First change of each doc must dep on the doc's current heads. Fresh
-    # docs (empty heads — the bulk common case) need deps_count == 0, which
-    # vectorizes; docs holding state get the per-doc hex compare.
-    first_idx = np.flatnonzero(~prev_same)
-    n_heads = np.fromiter((len(e.heads) for e in engines),
-                          dtype=np.int64, count=len(engines))
-    first_docs = doc_of[first_idx]
-    ok[first_idx] &= deps_count[first_idx] == n_heads[first_docs]
-    for i in first_idx[n_heads[first_docs] != 0]:
-        heads = engines[int(doc_of[i])].heads
-        if ok[i] and batch_meta.deps_hex(i) != heads:
-            ok[i] = False
-
-    fast_mask = np.ones(len(engines), dtype=bool)
-    fast_mask[doc_of[~ok]] = False
+    cols = fleet.doc_cols
+    erows = np.fromiter((e.slot for e in engines), dtype=np.int64,
+                        count=len(engines))
+    if len(np.unique(erows)) != len(erows):
+        # the same doc twice in one batch: the scatter commit would
+        # collapse its two runs; the exact path applies them in order
+        return None
+    starts_all = np.cumsum(doc_counts) - doc_counts
+    doc_off = np.concatenate([starts_all, [n_changes]])
+    head_n_d = cols.head_n[erows]
+    gate = native.turbo_gate(doc_off, nmeta['actor'], seqs, hash32,
+                             nmeta['deps_off'], nmeta['deps_blob'],
+                             cols.head32[erows], head_n_d)
+    if gate is None:
+        return None
+    doc_ok, hostcheck, g_doc, g_actor, g_first, g_last = gate
+    # Docs whose head frontier is not columnar-representable (multi-head)
+    # get the host hex compare for JUST their first change — rare.
+    for d in np.flatnonzero(hostcheck).tolist():
+        if doc_ok[d] and doc_counts[d]:
+            i = int(starts_all[d])
+            heads = engines[d].heads
+            if int(nmeta['deps_off'][i + 1] - nmeta['deps_off'][i]) != \
+                    len(heads) or batch_meta.deps_hex(i) != heads:
+                doc_ok[d] = False
+    # Seq bases: each (doc, actor) run's first seq must extend the doc's
+    # clock. Lane-mode rows check vectorized against the clock columns;
+    # dict-mode rows (actor populations past the lane width) probe their
+    # dicts per group.
+    if len(g_doc):
+        g_rows = erows[g_doc]
+        ck_n_g = cols.ck_n[g_rows]
+        reg = fleet._ck_reg
+        reg_ids = np.fromiter((reg.get(a, -1) for a in nat_actors),
+                              dtype=np.int64, count=len(nat_actors)) \
+            if nat_actors else np.zeros(1, dtype=np.int64)
+        g_reg = reg_ids[g_actor]
+        base = np.zeros(len(g_doc), dtype=np.int64)
+        known = g_reg >= 0
+        if known.any():
+            for l in range(cols.CLOCK_LANES):
+                m = known & (cols.ck_actor[g_rows, l] == g_reg)
+                if m.any():
+                    base[m] = cols.ck_seq[g_rows[m], l]
+        dmode = np.flatnonzero(ck_n_g == -1)
+        for gi in dmode.tolist():
+            base[gi] = engines[int(g_doc[gi])].clock.get(
+                nat_actors[int(g_actor[gi])], 0)
+        bad = g_first != base + 1
+        if bad.any():
+            doc_ok[g_doc[bad]] = False
+    fast_mask = doc_ok
 
     flags_all = rows['flags']
     seq_sel = (flags_all >= 3) & (flags_all <= 6)
@@ -3657,7 +4074,7 @@ def _apply_changes_turbo_inner(handles, per_doc_changes, ps, parsed=None):
     # _drain_queue mutates clock/heads, so engines carry backups and any
     # failure restores all of them: the whole turbo call is atomic (the
     # exact path gets per-doc atomicity from fleet.pending instead).
-    ready = np.zeros(n_changes, dtype=bool)
+    ready = fast_mask[doc_of]    # fancy-indexed: a fresh, writable array
     staged = []                  # general-path: (engine, applied, queue)
     backups = []                 # (engine, clock, heads, queue)
 
@@ -3665,13 +4082,9 @@ def _apply_changes_turbo_inner(handles, per_doc_changes, ps, parsed=None):
         for engine, clock, heads, queue in backups:
             engine.clock, engine.heads, engine.queue = clock, heads, queue
 
-    for d, engine in enumerate(engines):
+    for d in np.flatnonzero(~fast_mask & (doc_counts > 0)).tolist():
+        engine = engines[d]
         start, stop = per_doc_idx[d]
-        if start == stop:
-            continue
-        if fast_mask[d]:
-            ready[start:stop] = True
-            continue
         backups.append((engine, dict(engine.clock), list(engine.heads),
                         list(engine.queue)))
         try:
@@ -3723,13 +4136,14 @@ def _apply_changes_turbo_inner(handles, per_doc_changes, ps, parsed=None):
                           restore_all)
 
     # Count only causally-applied changes: queued ones are re-counted when
-    # the exact path drains and flushes them later
+    # the exact path drains and flushes them later. Byte counts come from
+    # the parser's buf_len meta column — no Python len() pass.
+    buf_len = nmeta['buf_len']
     fleet.metrics.changes_ingested += int(ready.sum())
     if ready.all():
-        fleet.metrics.bytes_ingested += sum(map(len, flat_buffers))
+        fleet.metrics.bytes_ingested += int(buf_len.sum())
     else:
-        fleet.metrics.bytes_ingested += sum(
-            len(flat_buffers[i]) for i in np.flatnonzero(ready).tolist())
+        fleet.metrics.bytes_ingested += int(buf_len[ready].sum())
 
     # Phase 2 — infallible: record logs, queues, staleness
     ps.mark('turbo_commit', ready=int(ready.sum()))
@@ -3739,7 +4153,6 @@ def _apply_changes_turbo_inner(handles, per_doc_changes, ps, parsed=None):
     # Per-doc max of last_op in one reduceat over the batch (a linear
     # chain does not guarantee the LAST change has the max op id, so the
     # old code took a numpy .max() per doc — ~27ms at 10k docs)
-    starts_all = np.cumsum(doc_counts) - doc_counts
     nonempty = doc_counts > 0
     if _hist.on() and nonempty.any():
         # per-doc change bytes, one vectorized pass (reduceat over the
@@ -3747,62 +4160,124 @@ def _apply_changes_turbo_inner(handles, per_doc_changes, ps, parsed=None):
         # raise — so a quarantining caller's retry loop records each
         # batch's survivors exactly once, on the attempt that commits.
         _hist.histogram('doc_change_bytes', unit='B').record_many(
-            np.add.reduceat(np.fromiter(map(len, flat_buffers),
-                                        dtype=np.int64, count=n_changes),
-                            starts_all[nonempty]))
+            np.add.reduceat(buf_len, starts_all[nonempty]))
     doc_max = np.zeros(len(handles), dtype=np.int64)
     if nonempty.any():
         doc_max[nonempty] = np.maximum.reduceat(
             last_op, starts_all[nonempty])
-    doc_max_l = doc_max.tolist()
     fast_ne = np.flatnonzero(fast_mask & nonempty)
-    # One .hex() over every fast doc's head hash instead of a per-doc
-    # bytes->hex round trip; slicing 64-char substrings is cheap
-    head_hex_all = hash32[(starts_all + doc_counts - 1)[fast_ne]] \
-        .tobytes().hex()
-    # Fused commit loop: the only remaining per-doc Python of the turbo
-    # path. Everything the body consumes is staged as flat arrays/lists
-    # above (per-doc head hex, max ops, buffer runs); the loop itself is
-    # straight-line attribute writes — no per-doc numpy, no per-doc hex,
-    # and the `changes` property dispatch only for parked docs (which must
-    # revive their log through it).
-    for j, d in enumerate(fast_ne.tolist()):
-        start, stop = per_doc_idx[d]
-        engine = engines[d]
-        if engine._doc_pending is not None:
-            # Parked doc: the accepted buffers append to the DELTA TAIL
-            # (_changes) while the compressed chunk stays parked — the
-            # delta+main write path. Log indexes account for the parked
-            # prefix; the prefix only materializes when history is
-            # genuinely read (recovery replay at 10k docs never does).
-            log = engine._changes
-            base = engine._parked_n + len(log)
-        else:
-            log = engine._changes
-            base = len(log)
-        log.extend(flat_buffers[start:stop])
-        # One deferred-graph record for the whole run (resolved lazily per
-        # change only if a graph query ever needs it)
-        engine._deferred.append((base, batch_meta, range(start, stop)))
-        engine.heads = [head_hex_all[64 * j:64 * (j + 1)]]
-        if doc_max_l[d] > engine.max_op:
-            engine.max_op = doc_max_l[d]
-        engine.stale = True
-        engine.binary_doc = None
-    # Clock advance, one write per (doc, actor) group: the sorted grouping
-    # from the seq validation gives each group's final seq directly (stable
-    # sort keeps buffer order, and fast-path seqs are contiguous)
-    if len(group_starts):
-        group_ends = np.r_[group_starts[1:], n_changes] - 1
-        g_key = key_sorted[group_starts]
-        g_doc = g_key // _MA
-        g_final = seqs[order[group_ends]]
-        sel = np.flatnonzero(fast_mask[g_doc])
-        for d, a, s in zip(g_doc[sel].tolist(),
-                           (g_key[sel] % _MA).tolist(),
-                           g_final[sel].tolist()):
-            engines[d].clock[nat_actors[a]] = s
+    # ---- Columnar commit: the whole fast-doc batch lands as vectorized
+    # scatters into the _DocCols struct-of-arrays — no per-doc Python.
+    # Head frontier: binary rows straight from the parser's hash lanes,
+    # hex strings decoded in ONE numpy pass (S64 -> U64 view of the
+    # single .hex() string), per-doc lists built by one comprehension.
+    frows = erows[fast_ne]
+    last_idx = (starts_all + doc_counts - 1)[fast_ne]
+    head_hex_all = hash32[last_idx].tobytes().hex()
+    cols.head32[frows] = hash32[last_idx]
+    cols.head_n[frows] = 1
+    hex_strs = np.frombuffer(head_hex_all.encode('ascii'),
+                             dtype='S64').astype('U64').tolist()
+    cols.head_hex[frows] = hex_strs
+    head_lists = np.empty(len(fast_ne), dtype=object)
+    head_lists[:] = [[s] for s in hex_strs]
+    cols.head_obj[frows] = head_lists
+    cols.maxop[frows] = np.maximum(cols.maxop[frows], doc_max[fast_ne])
+    cols.stale[frows] = True
+    cols.bindoc[frows] = None
+    # Log append, lazily: one _SeamSegs record for the whole batch; each
+    # doc's (start, stop, base) segment folds into its real log only when
+    # something reads history. Parked docs' bases account for the parked
+    # prefix (the delta+main write path) — all from columns, no engine
+    # attribute reads.
+    log_lens = np.fromiter((len(e._log) for e in engines),
+                           dtype=np.int64, count=len(engines))
+    bases = log_lens[fast_ne] + cols.pend_n[frows]
+    if cols.parked_n[frows].any():
+        # only fleets that actually hold parked docs pay the object-
+        # column scan for the parked-prefix bases
+        parked = np.array([chunk is not None
+                           for chunk in cols.pend_doc[frows]], dtype=bool)
+        bases += np.where(parked, cols.parked_n[frows], 0)
+    starts_f = starts_all[fast_ne]
+    stops_f = starts_f + doc_counts[fast_ne]
+    seg = _SeamSegs(flat_buffers, batch_meta,
+                    dict(zip(frows.tolist(),
+                             zip(starts_f.tolist(), stops_f.tolist(),
+                                 bases.tolist()))))
+    cols.pend_n[frows] += doc_counts[fast_ne]
+    fleet._pend_seams.append(seg)
+    if len(fleet._pend_seams) > _SEAM_FOLD_LIMIT:
+        fleet._fold_all_pending()
+    # Clock advance: the gate kernel's per-(doc, actor) groups scatter
+    # their final seqs into the clock lanes. Rows already in dict mode,
+    # or overflowing the lane width this batch, take the counted
+    # fallback loop below (the regression guard pins it at zero for
+    # fast-path workloads).
+    fallback_docs = set()
+    if len(g_doc):
+        gsel = np.flatnonzero(fast_mask[g_doc])
+        if len(gsel):
+            s_rows = g_rows[gsel]
+            s_reg = g_reg[gsel]
+            s_last = g_last[gsel]
+            dict_mode = cols.ck_n[s_rows] == -1
+            lanes = np.full(len(gsel), -1, dtype=np.int64)
+            for l in range(cols.CLOCK_LANES):
+                lanes = np.where((cols.ck_actor[s_rows, l] == s_reg) &
+                                 (s_reg >= 0), l, lanes)
+            new = (lanes < 0) & ~dict_mode
+            if new.any():
+                # intern actors the clock registry hasn't seen
+                for a in np.unique(np.asarray(g_actor)[gsel][new]).tolist():
+                    hexa = nat_actors[a]
+                    if hexa not in fleet._ck_reg:
+                        fleet._ck_reg[hexa] = len(fleet._ck_names)
+                        fleet._ck_names.append(hexa)
+                reg_ids = np.fromiter(
+                    (fleet._ck_reg.get(a, -1) for a in nat_actors),
+                    dtype=np.int64, count=len(nat_actors))
+                s_reg = reg_ids[np.asarray(g_actor)[gsel]]
+                # per-row rank among this batch's new actors (groups of
+                # one doc are contiguous in kernel order)
+                ni = np.flatnonzero(new)
+                rw = s_rows[ni]
+                run_first = np.r_[True, rw[1:] != rw[:-1]]
+                rank = np.arange(len(ni)) - \
+                    np.repeat(np.flatnonzero(run_first),
+                              np.diff(np.r_[np.flatnonzero(run_first),
+                                            len(ni)]))
+                lanes[ni] = cols.ck_n[rw] + rank
+            over = lanes >= cols.CLOCK_LANES
+            good = ~dict_mode & ~over
+            if good.any():
+                gi = np.flatnonzero(good)
+                cols.ck_actor[s_rows[gi], lanes[gi]] = s_reg[gi]
+                cols.ck_seq[s_rows[gi], lanes[gi]] = s_last[gi]
+                newly = good & new
+                if newly.any():
+                    np.add.at(cols.ck_n, s_rows[newly], 1)
+            if (dict_mode | over).any():
+                fallback_docs.update(
+                    np.asarray(g_doc)[gsel[dict_mode | over]].tolist())
+    if fallback_docs:
+        # Dict-mode / lane-overflow docs: per-doc dict merge — correct
+        # for any actor population, counted so the guard can pin the
+        # fast path at zero iterations.
+        fleet.metrics.turbo_commit_fallback_docs += len(fallback_docs)
+        gd = np.asarray(g_doc)
+        ga = np.asarray(g_actor)
+        for d in fallback_docs:
+            engine = engines[d]
+            clock = dict(engine.clock)
+            for gi in np.flatnonzero(gd == d).tolist():
+                clock[nat_actors[int(ga[gi])]] = int(g_last[gi])
+            engine.clock = clock
     for engine, applied, queue in staged:
+        # Slow/staged docs: the exact per-doc tail loop (counted — this
+        # is the fallback path the columnar commit replaces for fast
+        # docs).
+        fleet.metrics.turbo_commit_fallback_docs += 1
         for change in applied:
             engine.changes.append(change['buffer'])
             engine._defer_record(change)
@@ -3816,11 +4291,17 @@ def _apply_changes_turbo_inner(handles, per_doc_changes, ps, parsed=None):
             # mirror so the exact path re-decodes them before draining
             engine.stale = True
 
-    out_handles = []
     for handle in handles:
         handle['frozen'] = True
-        out_handles.append({'state': handle['state'],
-                            'heads': handle['state'].heads})
+    # Fast docs' head lists come straight from the commit scatter (the
+    # same list objects the columns memoize) — no per-doc property-chain
+    # reads; only slow/empty docs consult their engines.
+    heads_out = np.empty(len(handles), dtype=object)
+    heads_out[fast_ne] = head_lists
+    for d in np.flatnonzero(~(fast_mask & nonempty)).tolist():
+        heads_out[d] = engines[d].heads
+    out_handles = [{'state': handle['state'], 'heads': h}
+                   for handle, h in zip(handles, heads_out.tolist())]
     result = out_handles, [None] * len(handles)
     if not keep.any():
         return result            # everything queued: no device work
@@ -3833,7 +4314,7 @@ def _apply_changes_turbo_inner(handles, per_doc_changes, ps, parsed=None):
 
     # Device batch: remap the native parser's key/actor numbering into the
     # fleet tables (interning only keys that actually land on the device)
-    applied_actor_ids = np.unique(actor_id[ready])
+    applied_actor_ids = np.unique(nmeta['actor'][ready])
     perm = fleet.actors.insert_many([nat_actors[int(a)]
                                      for a in applied_actor_ids])
     if perm is not None:
@@ -4118,7 +4599,7 @@ def _apply_changes_turbo_inner(handles, per_doc_changes, ps, parsed=None):
         # every turbo call (part of the round-5 "turbo-commit Python"
         # budget).
         fleet._ensure_capacity(n_docs=n_slots, n_keys=len(fleet.keys))
-        n_cap = fleet.state.winners.shape[0]
+        n_cap = fleet._grid_cap()
         # Pred-scoped deletes (ref new.js:1204-1217): del rows (flags 1,
         # TOMBSTONE value — boxed values are <= -2, so -1 is del-only)
         # write no winner; their preds become kill lanes for the
@@ -4128,27 +4609,31 @@ def _apply_changes_turbo_inner(handles, per_doc_changes, ps, parsed=None):
         vals_root = kept_vals_all[keep_root]
         flags_root = kept_flags_all[keep_root]
         del_sel = (flags_root == 1) & (vals_root == TOMBSTONE)
-        counts = np.bincount(slots, minlength=n_slots)
-        max_ops = max(int(counts.max()) if counts.size else 0, 1)
-        order = np.argsort(slots, kind='stable')
-        slot_sorted = slots[order]
-        pos = np.arange(len(slot_sorted)) - \
-            np.searchsorted(slot_sorted, slot_sorted, side='left')
+        # Lane layout without the old argsort pass: kept root rows are
+        # already doc-contiguous (the parser emits rows in change order,
+        # changes in doc order), so each row's lane is its rank within
+        # its doc run — run boundaries + one repeat, no permutation.
+        n_root = len(slots)
+        run_starts = np.r_[0, np.flatnonzero(doc_arr[1:] != doc_arr[:-1])
+                           + 1] if n_root else np.zeros(0, dtype=np.int64)
+        run_lens = np.diff(np.r_[run_starts, n_root])
+        pos = np.arange(n_root) - np.repeat(run_starts, run_lens)
+        max_ops = max(int(run_lens.max()) if n_root else 0, 1)
         shape = (n_cap, max_ops)
-        cols = {name: np.zeros(shape, dtype=np.int32)
-                for name in ('key_id', 'packed', 'value')}
+        grid_cols = {name: np.zeros(shape, dtype=np.int32)
+                     for name in ('key_id', 'packed', 'value')}
         is_set = np.zeros(shape, dtype=bool)
         is_inc = np.zeros(shape, dtype=bool)
         valid = np.zeros(shape, dtype=bool)
-        cols['key_id'][slot_sorted, pos] = key[order]
-        cols['packed'][slot_sorted, pos] = packed[order]
-        cols['value'][slot_sorted, pos] = vals_root[order]
-        flags_laid = np.where(del_sel, 0, flags_root)[order]
-        is_set[slot_sorted, pos] = flags_laid == 1
-        is_inc[slot_sorted, pos] = flags_laid == 2
-        valid[slot_sorted, pos] = flags_laid != 0
-        batch = OpBatch(cols['key_id'], cols['packed'], cols['value'],
-                        is_set, is_inc, valid)
+        grid_cols['key_id'][slots, pos] = key
+        grid_cols['packed'][slots, pos] = packed
+        grid_cols['value'][slots, pos] = vals_root
+        flags_laid = np.where(del_sel, 0, flags_root)
+        is_set[slots, pos] = flags_laid == 1
+        is_inc[slots, pos] = flags_laid == 2
+        valid[slots, pos] = flags_laid != 0
+        batch = OpBatch(grid_cols['key_id'], grid_cols['packed'],
+                        grid_cols['value'], is_set, is_inc, valid)
 
         kills = None
         kill_doc = kill_key_f = kill_packed_f = ()

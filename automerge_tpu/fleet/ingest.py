@@ -387,24 +387,45 @@ def changes_to_decoded_ops(per_doc_changes):
 def intern_composite_keys(obj, key_nat, nat_keys, nat_actors, key_interner):
     """Intern fleet key ids for rows that may live on nested objects:
     obj == 0 rows intern their bare key string, others the composite
-    (objectId, key) tuple — one intern per unique (obj, key) pair.
-    Shared by the turbo path and the register ingest."""
+    (objectId, key) tuple. Shared by the turbo path and the register
+    ingest.
+
+    Root rows ride a LUT over the parser's OWN key table (nat_keys is
+    already dictionary-encoded, so one intern per distinct string and a
+    single gather maps every row) — the previous np.unique over all
+    row pairs cost a whole-batch sort to rediscover a dedup the parser
+    had already done. Only nested-object rows (composite keys the
+    parser cannot see) still pay a per-unique-pair walk."""
     n = len(obj)
     out = np.zeros(n, dtype=np.int32)
     if not n:
         return out
-    pairs = obj.astype(np.int64) * (1 << 32) + key_nat.astype(np.int64)
+    # intern ONLY keys some root row actually references (one boolean
+    # scatter — still no sort): nested-only key strings must not
+    # bare-intern, or a nested-heavy workload would inflate the fleet
+    # key table (and with it the [docs, keys] device grid) with ids no
+    # root row ever uses
+    root = obj == 0
+    used = np.zeros(max(len(nat_keys), 1), dtype=bool)
+    used[key_nat[root] if not root.all() else key_nat] = True
+    lut = np.full(max(len(nat_keys), 1), -1, dtype=np.int32)
+    for ki in np.flatnonzero(used).tolist():
+        lut[ki] = key_interner.intern(nat_keys[ki])
+    if root.all():
+        return lut[key_nat]
+    out[root] = lut[key_nat[root]]
+    nest = np.flatnonzero(~root)
+    pairs = obj[nest].astype(np.int64) * (1 << 32) + \
+        key_nat[nest].astype(np.int64)
     uniq, inv = np.unique(pairs, return_inverse=True)
     u_ids = np.empty(len(uniq), dtype=np.int32)
     for ui, pv in enumerate(uniq):
         o = int(pv >> 32)
         ks = nat_keys[int(pv & 0xffffffff)]
-        if o == 0:
-            u_ids[ui] = key_interner.intern(ks)
-        else:
-            oid = f'{o >> 8}@{nat_actors[o & 0xff]}'
-            u_ids[ui] = key_interner.intern((oid, ks))
-    return u_ids[inv]
+        oid = f'{o >> 8}@{nat_actors[o & 0xff]}'
+        u_ids[ui] = key_interner.intern((oid, ks))
+    out[nest] = u_ids[inv]
+    return out
 
 
 def changes_to_op_rows(per_doc_changes, key_interner, actor_interner,

@@ -295,18 +295,23 @@ def _install_parsed(fleet, out, native_idx, chunks, handles, fleet_backend):
             chunk = bytes(chunks[native_idx[d]])
             eng._install_parked_chunk(chunk, int(n_changes_arr[d]))
             engines[d] = eng
-        # clock: per (doc, actor) max seq
+        # clock: per (doc, actor) max seq, accumulated per doc and
+        # assigned WHOLE (engine.clock is a columnar-backed property:
+        # in-place writes on the materialized dict would be lost)
         c_doc = out['c_doc'].astype(np.int64)
         c_actor = amap[out['c_actor']] if len(out['c_actor']) else \
             np.zeros(0, dtype=np.int64)
         c_seq = out['c_seq']
+        clocks = {}
         for d, a, s in zip(c_doc.tolist(), c_actor.tolist(),
                            c_seq.tolist()):
-            eng = engines.get(d)
-            if eng is not None:
+            if d in engines:
+                clock = clocks.setdefault(d, {})
                 hexa = fleet_actors[a]
-                if eng.clock.get(hexa, 0) < s:
-                    eng.clock[hexa] = s
+                if clock.get(hexa, 0) < s:
+                    clock[hexa] = s
+        for d, clock in clocks.items():
+            engines[d].clock = clock
     fleet.metrics.docs_bulk_loaded += len(engines)
     # object registries
     for j in np.flatnonzero(make_mask):
@@ -356,8 +361,10 @@ def _ensure_caps(fleet, n_docs):
         fleet._ensure_reg_capacity(n_docs=max(n_docs, fleet.n_slots),
                                    n_keys=len(fleet.keys))
     else:
-        fleet._ensure_capacity(n_docs=max(n_docs, fleet.n_slots),
-                               n_keys=len(fleet.keys))
+        # materialize (not just size): the loader writes fleet.state in
+        # place below, so the deferred fresh-fleet allocation must land
+        fleet._materialize_grid(n_docs=max(n_docs, fleet.n_slots),
+                                n_keys=len(fleet.keys))
 
 
 def _decode_cell_value(fleet, out, j, vtype_j, val_int_j, exact):
@@ -471,6 +478,10 @@ def _install_map_cells(fleet, out, sel, index_sel, doc, slot_of, okey,
             st.values.at[idx].set(jnp.asarray(values[w].astype(np.int32))),
             st.counters.at[idx].set(
                 jnp.asarray(counters[w].astype(np.int32))))
+        if (counters[w] != 0).any():
+            # loaded accumulators pin the fleet to the general merge
+            # kernel (see DocFleet._counters_touched)
+            fleet._counters_touched = True
         if fleet.host_winners is not None:
             # Seed the host winner mirror (counter-attribution checks for
             # later incs run against these loaded winners)

@@ -41,7 +41,7 @@ from ..observability.spans import span as _span
 # Bumped in lockstep with codec.cpp's am_abi_version whenever the C
 # surface changes shape. A mismatch means the cached .so predates this
 # wrapper (or vice versa) and MUST NOT be used.
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 
 
 class NativeAbiMismatch(RuntimeError):
@@ -640,6 +640,7 @@ def _fetch_ingest_meta(lib, n_changes):
     hash32 = np.zeros(32 * n, dtype=np.uint8)
     deps_off = np.zeros(n + 1, dtype=np.int64)
     msg_off = np.zeros(n + 1, dtype=np.int64)
+    buf_len = np.zeros(n, dtype=np.int64)
     deps_bytes = i64(0)
     msg_bytes = i64(0)
     lib.am_ingest_meta_sizes.argtypes = [i64p, i64p]
@@ -651,7 +652,7 @@ def _fetch_ingest_meta(lib, n_changes):
     msg_blob = np.zeros(max(int(msg_bytes.value), 1), dtype=np.uint8)
     lib.am_ingest_meta_fetch.argtypes = [
         i32p, i64p, i64p, i64p, i64p, u8p, i64p, u8p, ctypes.c_uint64,
-        i64p, u8p, ctypes.c_uint64]
+        i64p, u8p, ctypes.c_uint64, i64p]
     lib.am_ingest_meta_fetch.restype = i64
     got = lib.am_ingest_meta_fetch(
         actor.ctypes.data_as(i32p), seq.ctypes.data_as(i64p),
@@ -659,7 +660,8 @@ def _fetch_ingest_meta(lib, n_changes):
         nops.ctypes.data_as(i64p), hash32.ctypes.data_as(u8p),
         deps_off.ctypes.data_as(i64p), deps_blob.ctypes.data_as(u8p),
         deps_blob.size, msg_off.ctypes.data_as(i64p),
-        msg_blob.ctypes.data_as(u8p), msg_blob.size)
+        msg_blob.ctypes.data_as(u8p), msg_blob.size,
+        buf_len.ctypes.data_as(i64p))
     if got != n_changes:
         return None
     # Raw arrays/blobs only — hex strings and per-change dicts are built
@@ -672,7 +674,77 @@ def _fetch_ingest_meta(lib, n_changes):
         'deps_blob': deps_blob[:32 * int(deps_off[n_changes])].tobytes(),
         'msg_off': msg_off[:n_changes + 1],
         'msg_blob': msg_blob[:int(msg_off[n_changes])].tobytes(),
+        'buf_len': buf_len[:n_changes],
     }
+
+
+def turbo_gate(doc_off, actor, seq, hash32, deps_off, deps_blob,
+               head32, head_n):
+    """Batched linear-chain causal gate (codec.cpp am_turbo_gate): the
+    whole batch's deps-present / heads-match / seq-contiguity checks in
+    one native call over the extractor's hash lanes, GIL released.
+
+    Inputs are the am_ingest_changes meta arrays plus the fleet's
+    columnar per-doc head state (head32 rows gathered for this batch's
+    docs; head_n outside {0, 1} routes that doc's first-change deps
+    check back to the host). Returns None when the codec is
+    unavailable, else ``(doc_ok, doc_hostcheck, g_doc, g_actor,
+    g_first, g_last)`` — per-doc verdict bools plus the per-(doc,
+    actor) seq-run group records whose ``g_first`` the caller checks
+    against its clock columns (and whose ``g_last`` it scatters back
+    as the clock advance)."""
+    lib = _load()
+    if lib is None:
+        return None
+    i64 = ctypes.c_int64
+    i64p = ctypes.POINTER(i64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    if not hasattr(lib, '_turbo_gate_ready'):
+        lib.am_turbo_gate.argtypes = [
+            i64p, i32p, i64p, u8p, i64p, u8p, u8p, i32p,
+            i64, i64, i64,
+            u8p, u8p, i32p, i32p, i64p, i64p]
+        lib.am_turbo_gate.restype = i64
+        lib._turbo_gate_ready = True
+    n_docs = len(doc_off) - 1
+    n_changes = len(actor)
+    doc_off = np.ascontiguousarray(doc_off, dtype=np.int64)
+    actor = np.ascontiguousarray(actor, dtype=np.int32)
+    seq = np.ascontiguousarray(seq, dtype=np.int64)
+    hash32 = np.ascontiguousarray(hash32, dtype=np.uint8)
+    deps_off = np.ascontiguousarray(deps_off, dtype=np.int64)
+    deps_arr = np.frombuffer(deps_blob, dtype=np.uint8) \
+        if isinstance(deps_blob, (bytes, bytearray)) else \
+        np.ascontiguousarray(deps_blob, dtype=np.uint8)
+    if deps_arr.size == 0:
+        deps_arr = np.zeros(1, dtype=np.uint8)
+    head32 = np.ascontiguousarray(head32, dtype=np.uint8)
+    head_n = np.ascontiguousarray(head_n, dtype=np.int32)
+    # the actor column's ids are dense interned indexes; the scratch
+    # tables size to the max id + 1
+    n_actors = int(actor.max()) + 1 if n_changes else 1
+    doc_ok = np.zeros(max(n_docs, 1), dtype=np.uint8)
+    hostcheck = np.zeros(max(n_docs, 1), dtype=np.uint8)
+    cap = max(n_changes, 1)
+    g_doc = np.zeros(cap, dtype=np.int32)
+    g_actor = np.zeros(cap, dtype=np.int32)
+    g_first = np.zeros(cap, dtype=np.int64)
+    g_last = np.zeros(cap, dtype=np.int64)
+    n_groups = lib.am_turbo_gate(
+        doc_off.ctypes.data_as(i64p), actor.ctypes.data_as(i32p),
+        seq.ctypes.data_as(i64p), hash32.ctypes.data_as(u8p),
+        deps_off.ctypes.data_as(i64p), deps_arr.ctypes.data_as(u8p),
+        head32.ctypes.data_as(u8p), head_n.ctypes.data_as(i32p),
+        n_docs, n_changes, n_actors,
+        doc_ok.ctypes.data_as(u8p), hostcheck.ctypes.data_as(u8p),
+        g_doc.ctypes.data_as(i32p), g_actor.ctypes.data_as(i32p),
+        g_first.ctypes.data_as(i64p), g_last.ctypes.data_as(i64p))
+    if n_groups < 0:
+        return None
+    k = int(n_groups)
+    return (doc_ok[:n_docs].astype(bool), hostcheck[:n_docs].astype(bool),
+            g_doc[:k], g_actor[:k], g_first[:k], g_last[:k])
 
 
 def parse_documents(buffers):

@@ -851,6 +851,7 @@ struct IngestCtx {
   std::vector<uint8_t> m_deps;      // 32 bytes per dep, concatenated
   std::vector<int64_t> m_msg_off;   // per change, byte offset into m_msg
   std::vector<uint8_t> m_msg;       // UTF-8 message bytes, concatenated
+  std::vector<int64_t> m_buf_len;   // per change, wire buffer byte length
   // Per-op pred lists (with_meta only): out_pred_off[i] indexes the first
   // pred of op row i in out_pred; packed as (ctr << kActorBits) | actor
   // with GLOBAL actor numbers (the per-change actor table is interned)
@@ -1673,6 +1674,14 @@ static int64_t ingest_dispatch(const uint8_t *const *ptrs,
     g_ingest = nullptr;
     return -1;
   }
+  if (with_meta) {
+    // Per-change wire byte lengths: a buffer is exactly one change here
+    // (multi-chunk buffers are refused by ingest_one_chunk), so the
+    // caller's bytes accounting never needs a Python-side len() pass.
+    g_ingest->m_buf_len.reserve(n_changes);
+    for (uint64_t i = 0; i < n_changes; i++)
+      g_ingest->m_buf_len.push_back(int64_t(lens[i]));
+  }
   return int64_t(g_ingest->out_doc.size());
 }
 
@@ -1725,7 +1734,7 @@ int64_t am_ingest_changes_list(PyObject *buffers, int with_meta,
 // Monotone ABI stamp, bumped on any C-surface change. The Python wrapper
 // refuses to run against a binary whose stamp mismatches (a stale .so
 // would otherwise silently run the old single-threaded codec).
-int64_t am_abi_version() { return 2; }
+int64_t am_abi_version() { return 3; }
 
 int64_t am_pool_configure(int n) { return NativePool::inst().configure(n); }
 
@@ -1844,12 +1853,13 @@ int64_t am_ingest_meta_fetch(int32_t *actor, int64_t *seq, int64_t *start_op,
                              int64_t *time, int64_t *nops, uint8_t *hash32,
                              int64_t *deps_off, uint8_t *deps_blob,
                              uint64_t deps_cap, int64_t *msg_off,
-                             uint8_t *msg_blob, uint64_t msg_cap) {
+                             uint8_t *msg_blob, uint64_t msg_cap,
+                             int64_t *buf_len) {
   if (!g_ingest) return -1;
   IngestCtx &ctx = *g_ingest;
   size_t n = ctx.m_seq.size();
   if (ctx.m_actor.size() != n || ctx.m_nops.size() != n ||
-      ctx.m_hash.size() != 32 * n)
+      ctx.m_hash.size() != 32 * n || ctx.m_buf_len.size() != n)
     return -1;
   if (ctx.m_deps.size() > deps_cap || ctx.m_msg.size() > msg_cap) return -1;
   memcpy(actor, ctx.m_actor.data(), n * 4);
@@ -1864,7 +1874,96 @@ int64_t am_ingest_meta_fetch(int32_t *actor, int64_t *seq, int64_t *start_op,
   memcpy(msg_off, ctx.m_msg_off.data(), n * 8);
   msg_off[n] = int64_t(ctx.m_msg.size());
   memcpy(msg_blob, ctx.m_msg.data(), ctx.m_msg.size());
+  memcpy(buf_len, ctx.m_buf_len.data(), n * 8);
   return int64_t(n);
+}
+
+// ---- batched turbo gate ---------------------------------------------------
+//
+// The linear-chain causal gate over a whole parsed batch in ONE call,
+// replacing the Python side's per-doc hex/dict probes and the numpy
+// chain-validation pass (argsort + per-row 32-byte compares). Operates
+// directly on the extractor's hash lanes (hash32 / deps_blob are the
+// am_ingest_meta_fetch outputs) plus the fleet's columnar per-doc head
+// state. Called through ctypes CDLL, so the GIL is released for the
+// whole scan.
+//
+// Per change i of doc d (changes are doc-contiguous, doc_off gives the
+// per-doc ranges):
+//   - non-first changes must dep on EXACTLY the previous change's hash
+//     (deps_count == 1 + 32-byte memcmp against hash32[i-1]);
+//   - the doc's first change must dep on the doc's current head
+//     frontier: head_n[d] == 0 -> deps_count == 0; head_n[d] == 1 ->
+//     deps_count == 1 + memcmp against head32[d]. Docs whose frontier
+//     is not columnar-representable (head_n outside {0, 1}) are flagged
+//     in doc_hostcheck and the caller re-checks JUST their first-change
+//     deps on the host (the rare multi-head case);
+//   - per-(doc, actor) seq runs must be contiguous. The first seq of
+//     each run is emitted as a group record (g_doc/g_actor/g_first/
+//     g_last, capacity n_changes) so the caller can verify the bases
+//     against its clock columns vectorized — and scatter g_last back as
+//     the clock advance without re-deriving groups.
+//
+// Any violation clears doc_ok[d] (doc granularity is all the turbo path
+// needs: one bad change sends the whole doc to the general gate).
+// Returns the group count, or -1 on out-of-range actor ids.
+int64_t am_turbo_gate(const int64_t *doc_off, const int32_t *actor,
+                      const int64_t *seq, const uint8_t *hash32,
+                      const int64_t *deps_off, const uint8_t *deps_blob,
+                      const uint8_t *head32, const int32_t *head_n,
+                      int64_t n_docs, int64_t n_changes, int64_t n_actors,
+                      uint8_t *doc_ok, uint8_t *doc_hostcheck,
+                      int32_t *g_doc, int32_t *g_actor, int64_t *g_first,
+                      int64_t *g_last) {
+  if (n_docs < 0 || n_changes < 0 || n_actors < 0) return -1;
+  // per-actor scratch, epoch-tagged per doc: O(1) reset per document
+  std::vector<int32_t> a_epoch(size_t(n_actors), -1);
+  std::vector<int64_t> a_last(size_t(n_actors), 0);
+  std::vector<int64_t> a_group(size_t(n_actors), 0);
+  int64_t n_groups = 0;
+  for (int64_t d = 0; d < n_docs; d++) {
+    int64_t lo = doc_off[d], hi = doc_off[d + 1];
+    uint8_t ok = 1;
+    doc_hostcheck[d] = 0;
+    if (lo > hi || lo < 0 || hi > n_changes) return -1;
+    for (int64_t i = lo; i < hi && ok; i++) {
+      int64_t dc = deps_off[i + 1] - deps_off[i];
+      if (i == lo) {
+        int32_t hn = head_n[d];
+        if (hn == 0) {
+          if (dc != 0) ok = 0;
+        } else if (hn == 1) {
+          if (dc != 1 ||
+              memcmp(deps_blob + deps_off[i] * 32, head32 + d * 32, 32) != 0)
+            ok = 0;
+        } else {
+          doc_hostcheck[d] = 1;  // caller compares against the attr heads
+        }
+      } else {
+        if (dc != 1 ||
+            memcmp(deps_blob + deps_off[i] * 32, hash32 + (i - 1) * 32,
+                   32) != 0)
+          ok = 0;
+      }
+      int32_t a = actor[i];
+      if (a < 0 || a >= n_actors) return -1;
+      if (a_epoch[size_t(a)] != int32_t(d)) {
+        a_epoch[size_t(a)] = int32_t(d);
+        a_group[size_t(a)] = n_groups;
+        g_doc[n_groups] = int32_t(d);
+        g_actor[n_groups] = a;
+        g_first[n_groups] = seq[i];
+        g_last[n_groups] = seq[i];
+        n_groups++;
+      } else {
+        if (seq[i] != a_last[size_t(a)] + 1) ok = 0;
+        g_last[a_group[size_t(a)]] = seq[i];
+      }
+      a_last[size_t(a)] = seq[i];
+    }
+    doc_ok[d] = ok;
+  }
+  return n_groups;
 }
 
 // Copy sequence-op columns captured by am_ingest_changes(with_seq=1).

@@ -78,9 +78,11 @@ def _hist_snapshot(h):
             'sum': float(h.total), 'scale': h.scale}
 
 
-def snapshot_all(slo=None, fleets=()):
+def snapshot_all(slo=None, fleets=(), router=None):
     """Every exposed value as plain data — the atomic snapshot both the
-    text renderer and the snapshot-file mode serialize from."""
+    text renderer and the snapshot-file mode serialize from. ``router``
+    (an in-process ShardRouter) adds per-shard tick-overrun telemetry:
+    each shard's slipped-tick counter and last pump seconds."""
     snap = {
         'health': health_counts(),
         'dispatch': dispatch_counts(fleets),
@@ -88,6 +90,11 @@ def snapshot_all(slo=None, fleets=()):
         'histograms': {name: _hist_snapshot(h)
                        for name, h in list(_hist._registry.items())},
     }
+    if router is not None:
+        snap['shard_slips'] = {sid: s.ticks_slipped
+                               for sid, s in router.shards.items()}
+        snap['shard_pump_s'] = {sid: s.last_pump_s
+                                for sid, s in router.shards.items()}
     if slo is not None:
         snap['slo_tallies'] = slo.tallies()
         snap['slo_gauges'] = slo.gauges()
@@ -122,7 +129,7 @@ def _render_hist_lines(lines, metric, snap, labels=''):
                  if labels else f'{metric}_count {snap["count"]}')
 
 
-def render_prometheus(slo=None, fleets=(), shard=None):
+def render_prometheus(slo=None, fleets=(), shard=None, router=None):
     """The full text-format 0.0.4 exposition page (one trailing
     newline), rendered from ``snapshot_all``. ``shard`` stamps a
     ``shard="<id>"`` label on EVERY sample line — the process-level
@@ -130,7 +137,7 @@ def render_prometheus(slo=None, fleets=(), shard=None):
     shard process; the in-process ``ShardRouter`` testbed renders one
     page per shard the same way), so per-shard dashboards and the
     failover runbooks can select a single failure domain."""
-    snap = snapshot_all(slo=slo, fleets=fleets)
+    snap = snapshot_all(slo=slo, fleets=fleets, router=router)
     sl = f'shard="{_label(shard)}"' if shard is not None else ''
     lines = []
 
@@ -145,6 +152,24 @@ def render_prometheus(slo=None, fleets=(), shard=None):
     lines.append(f'# TYPE {_PREFIX}_spans_dropped gauge')
     lines.append(f'{_PREFIX}_spans_dropped{_labelset(sl)} '
                  f'{snap["spans_dropped"]}')
+    if 'shard_slips' in snap:
+        # per-shard tick-overrun telemetry (ISSUE-12 satellite): the
+        # loadgen's aggregate ticks_slipped, attributed per failure
+        # domain — which shard's tick work does not fit the cadence.
+        # The `shard` label here is the FAILURE DOMAIN the counter
+        # describes (the in-process router testbed exposes all of its
+        # shards from one page); a process-level `shard=` identity
+        # label composes alongside it as `proc_shard`.
+        psl = f'proc_{sl}' if sl else ''
+        lines.append(f'# TYPE {_PREFIX}_shard_ticks_slipped_total '
+                     f'counter')
+        for sid, n in sorted(snap['shard_slips'].items()):
+            ls = _labelset(psl, f'shard="{_label(sid)}"')
+            lines.append(f'{_PREFIX}_shard_ticks_slipped_total{ls} {n}')
+        lines.append(f'# TYPE {_PREFIX}_shard_pump_seconds gauge')
+        for sid, v in sorted(snap['shard_pump_s'].items()):
+            ls = _labelset(psl, f'shard="{_label(sid)}"')
+            lines.append(f'{_PREFIX}_shard_pump_seconds{ls} {_fmt(v)}')
 
     for name, hsnap in sorted(snap['histograms'].items()):
         metric = f'{_PREFIX}_{_sanitize(name)}'
@@ -204,20 +229,21 @@ class MetricsExporter:
     snapshot-file writer only."""
 
     def __init__(self, port=0, host='127.0.0.1', slo=None, fleets=(),
-                 snapshot_path=None, shard=None):
+                 snapshot_path=None, shard=None, router=None):
         self._port_arg = port
         self.host = host
         self.slo = slo
         self.fleets = tuple(fleets)
         self.snapshot_path = snapshot_path
         self.shard = shard
+        self.router = router
         self.port = None
         self._server = None
         self._thread = None
 
     def render(self):
         return render_prometheus(slo=self.slo, fleets=self.fleets,
-                                 shard=self.shard)
+                                 shard=self.shard, router=self.router)
 
     # -- HTTP mode ------------------------------------------------------
 
@@ -292,7 +318,7 @@ class MetricsExporter:
         return path
 
 
-def maybe_start_exporter(slo=None, fleets=(), shard=None):
+def maybe_start_exporter(slo=None, fleets=(), shard=None, router=None):
     """The env-driven entry point: ``AUTOMERGE_TPU_METRICS_PORT`` set
     starts (and returns) a serving ``MetricsExporter`` on that port
     (0 = ephemeral); ``AUTOMERGE_TPU_METRICS_SNAPSHOT`` set (with no
@@ -308,9 +334,10 @@ def maybe_start_exporter(slo=None, fleets=(), shard=None):
     if port is not None and port != '':
         exporter = MetricsExporter(port=int(port), slo=slo, fleets=fleets,
                                    snapshot_path=snapshot or None,
-                                   shard=shard)
+                                   shard=shard, router=router)
         return exporter.start()
     if snapshot:
         return MetricsExporter(port=None, slo=slo, fleets=fleets,
-                               snapshot_path=snapshot, shard=shard)
+                               snapshot_path=snapshot, shard=shard,
+                               router=router)
     return None

@@ -60,6 +60,10 @@ class Metrics:
         'graph_builds',          # deferred hash-graph materializations
         'docs_bulk_loaded',      # documents installed by the native loader
         'doc_materializations',  # bulk-loaded docs whose history was read
+        'turbo_commit_fallback_docs',  # per-doc commit-loop iterations
+                                 # (staged/slow docs only; the columnar
+                                 # fast path contributes ZERO — pinned
+                                 # by the commit-phase regression guard)
     )
 
     def __init__(self):

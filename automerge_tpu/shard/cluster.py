@@ -91,6 +91,8 @@ _stats = {
     'shard_repl_resets': 0,        # stalled pair handshakes reset
     'shard_repl_quarantined': 0,   # corrupt replication messages contained
     'shard_degraded_acks': 0,      # applies acked with no replica copy
+    'shard_ticks_slipped': 0,      # shard pumps that overran tick_budget_s
+    'shard_scrub_mismatches': 0,   # anti-entropy frontier divergences found
 }
 for _key in _stats:
     register_health_source(_key, lambda k=_key: _stats[k])
@@ -116,6 +118,14 @@ class Shard:
         self._service_kwargs = dict(service_kwargs or {})
         self.alive = True
         self.last_beat = 0
+        # tick-overrun telemetry: pumps whose wall time exceeded the
+        # router's tick_budget_s (None = free-running, never counted),
+        # plus the last pump's duration for dashboards. A box whose
+        # per-shard tick work does not fit the serving cadence shows it
+        # HERE, per failure domain, instead of only in the loadgen's
+        # aggregate pacing loop.
+        self.ticks_slipped = 0
+        self.last_pump_s = 0.0
         self._build()
 
     def _build(self):
@@ -126,13 +136,20 @@ class Shard:
                                   **kwargs)
         self.storage = StorageEngine(fleet=self.fleet)
 
-    def pump(self, tick, now=None):
+    def pump(self, tick, now=None, budget_s=None):
         """One service tick + heartbeat. A dead shard does nothing —
-        whatever its queues held is unreachable until revive."""
+        whatever its queues held is unreachable until revive. With a
+        `budget_s` cadence, a pump that overruns it counts a slipped
+        tick (per shard here, globally in `shard_ticks_slipped`)."""
         if not self.alive:
             return None
+        start = time.perf_counter()
         with _span('shard_tick', shard=self.id):
             stats = self.service.pump(now=now)
+        self.last_pump_s = time.perf_counter() - start
+        if budget_s is not None and self.last_pump_s > budget_s:
+            self.ticks_slipped += 1
+            _stats['shard_ticks_slipped'] += 1
         self.last_beat = tick
         return stats
 
@@ -276,7 +293,8 @@ class ShardRouter:
                  vnodes=64, link_factory=None, backoff=None,
                  retry_rate=50.0, retry_burst=100.0,
                  repl_stall_rounds=8, service_kwargs=None,
-                 pump_threads=None, repl_every=1):
+                 pump_threads=None, repl_every=1, tick_budget_s=None,
+                 scrub_every=25):
         if shard_ids is None:
             shard_ids = [f'shard{i}' for i in range(n_shards or 1)]
         self.clock = clock if clock is not None else time.monotonic
@@ -300,6 +318,19 @@ class ShardRouter:
         # cadence-independent: an apply resolves only once its hashes
         # are on both copies, however long replication takes.
         self.repl_every = max(1, int(repl_every))
+        # serving cadence for tick-overrun telemetry: when set, every
+        # shard pump that overruns it counts a per-shard slipped tick
+        # (Shard.ticks_slipped; Prometheus exposition with shard labels
+        # via observability.export.render_prometheus(router=...))
+        self.tick_budget_s = tick_budget_s
+        # anti-entropy head-frontier scrub cadence (ticks; 0/None = off):
+        # a cheap per-replica-pair heads compare that catches SILENT
+        # home/replica divergence — a pair that believes itself
+        # converged-quiet while the frontiers disagree — earlier than
+        # the next write would. Found pairs emit a typed
+        # shard_frontier_mismatch event and reset their handshake.
+        self.scrub_every = int(scrub_every or 0)
+        self.scrub_mismatches = []     # [{'tick', 'tenant', ...}]
         self.ticks = 0
         self._tenants = {}
         self._pending = []
@@ -555,15 +586,17 @@ class ShardRouter:
         now = self.clock() if now is None else now
         with _span('shard_router_tick', tick=self.ticks,
                    shards=len(self.alive)):
+            budget = self.tick_budget_s
             if self._pool is not None:
                 futures = [self._pool.submit(self.shards[sid].pump,
-                                             self.ticks, now)
+                                             self.ticks, now, budget)
                            for sid in self.ring.shard_ids()]
                 for f in futures:
                     f.result()
             else:
                 for sid in self.ring.shard_ids():
-                    self.shards[sid].pump(self.ticks, now)
+                    self.shards[sid].pump(self.ticks, now,
+                                          budget_s=budget)
             for link in self._links.values():
                 if link is not None:
                     link.tick()
@@ -573,6 +606,8 @@ class ShardRouter:
                     self._failover(sid)
             if self.ticks % self.repl_every == 0:
                 self._replicate()
+            if self.scrub_every and self.ticks % self.scrub_every == 0:
+                self.scrub_frontiers()
             self._advance_migrations()
             self._harvest(now)
 
@@ -756,6 +791,46 @@ class ShardRouter:
             if rec.stall >= self.repl_stall_rounds:
                 rec._reset_pair()
                 _stats['shard_repl_resets'] += 1
+
+    def scrub_frontiers(self):
+        """Anti-entropy head-frontier scrub (ROADMAP shard leftover):
+        per replica pair, compare the home and replica head frontiers.
+        A pair that is merely LAGGING (replication in flight, inboxes
+        non-empty, quiet=False) is left alone — the rounds converge it.
+        A pair that believes itself converged-QUIET while the frontiers
+        DISAGREE is silent divergence (state damaged out-of-band — e.g.
+        a quarantined replication message whose re-send never landed, or
+        replica memory rot): the replication skip rule would never wake
+        it until the tenant's next write. Each such pair emits a typed
+        ``shard_frontier_mismatch`` flight event, counts in
+        ``shard_scrub_mismatches``, and has its handshake reset with
+        quiet cleared — the next replication round re-converges it from
+        a fresh sync state. Cost: two get_heads reads per pair (no
+        message traffic, no doc decode). Returns mismatches found."""
+        found = 0
+        for rec in self._repl_active():
+            if not rec.quiet or rec.last_pair_heads is None:
+                continue             # converging normally: rounds own it
+            home = sorted(get_heads(rec.session.handle))
+            rep = sorted(get_heads(rec.replica_handle))
+            if home == rep:
+                continue
+            if home != sorted(rec.last_pair_heads[0]):
+                # the HOME frontier moved since the round that declared
+                # quiet: a normal write raced the scrub — the next
+                # replication round owns that; flagging it would turn
+                # every write into a false divergence event
+                continue
+            found += 1
+            _stats['shard_scrub_mismatches'] += 1
+            record = {'tick': self.ticks, 'tenant': rec.name,
+                      'home': rec.home, 'replica': rec.replica_on,
+                      'home_heads': len(home), 'replica_heads': len(rep)}
+            self.scrub_mismatches.append(record)
+            _flight.record_event('shard_frontier_mismatch', **record)
+            rec.quiet = False
+            rec._reset_pair()
+        return found
 
     def replication_quiet(self):
         """True when every replicated pair converged and went quiet in

@@ -1054,6 +1054,86 @@ def _sec_seam():
           file=sys.stderr)
 
 
+@section('seam_commit')
+def _sec_seam_commit():
+    # Host commit-phase breakdown (ISSUE-12 "melt the serial floor"):
+    # ONE steady-state seam batch under the span rig, tiled into the
+    # turbo phase spans (setup/parse/gate/commit/stage/dispatch — they
+    # tile the batch interval with no unattributed gap), reported as ms
+    # per phase. The COMMIT phase is the columnar scatter (struct-of-
+    # arrays doc state + lazily-folded log segments) and the GATE phase
+    # is the native am_turbo_gate call — the two serial-floor terms this
+    # round melts; the per-doc fallback counter proves the fast path ran
+    # with ZERO per-doc commit-loop iterations, and the dispatch count
+    # pins the O(1)-dispatch contract alongside the phase widths.
+    from automerge_tpu import observability as obs
+    from automerge_tpu.columnar import encode_change, decode_change_meta
+    from automerge_tpu.fleet.backend import (
+        DocFleet, init_docs, apply_changes_docs)
+    import jax
+    n_keys = _env('BENCH_KEYS', 1000)
+    n_docs = _env('BENCH_SEAM_DOCS', 10000)
+    rng = np.random.default_rng(11)
+    actors = ['aa' * 16, 'bb' * 16]
+    changes, heads = [], []
+    seqs = [0, 0]
+    for c in range(20):
+        a = c % 2
+        seqs[a] += 1
+        buf = encode_change({
+            'actor': actors[a], 'seq': seqs[a], 'startOp': c + 1,
+            'time': 0, 'message': '', 'deps': heads,
+            'ops': [{'action': 'set', 'obj': '_root',
+                     'key': f'k{int(rng.integers(0, n_keys))}',
+                     'value': int(rng.integers(1, 1 << 20)),
+                     'datatype': 'int', 'pred': []}]})
+        heads = [decode_change_meta(buf, True)['hash']]
+        changes.append(buf)
+    per_doc = [list(changes) for _ in range(n_docs)]
+    # warmup universe: steady-state phase widths, not XLA compiles
+    warm = DocFleet(doc_capacity=n_docs, key_capacity=n_keys + 1)
+    apply_changes_docs(init_docs(n_docs, warm), per_doc, mirror=False)
+    jax.block_until_ready(warm.state.winners)
+    del warm
+    _fence()
+    fleet = DocFleet(doc_capacity=n_docs, key_capacity=n_keys + 1)
+    handles = init_docs(n_docs, fleet)
+    d0 = fleet.metrics.dispatches
+    f0 = fleet.metrics.turbo_commit_fallback_docs
+    obs.enable()
+    obs.clear_spans()
+    t0 = time.perf_counter()
+    apply_changes_docs(handles, per_doc, mirror=False)
+    jax.block_until_ready(fleet.state.winners)
+    wall_ms = (time.perf_counter() - t0) * 1000.0
+    obs.disable()
+    phases = {}
+    for s in obs.iter_spans():
+        if s['name'].startswith('turbo_'):
+            key = s['name'][len('turbo_'):]
+            phases[key] = phases.get(key, 0.0) + s['dur_ns'] / 1e6
+    dispatches = fleet.metrics.dispatches - d0
+    fallback_docs = fleet.metrics.turbo_commit_fallback_docs - f0
+    commit_ms = phases.get('commit', 0.0)
+    rate = n_docs * 20 / (wall_ms / 1000.0)
+    R.update(seam_commit_rate=rate,
+             seam_commit_phase_ms={k: round(v, 2)
+                                   for k, v in sorted(phases.items())},
+             seam_commit_wall_ms=round(wall_ms, 1),
+             seam_commit_ms=round(commit_ms, 2),
+             seam_commit_dispatches=dispatches,
+             seam_commit_fallback_docs=fallback_docs)
+    breakdown = ', '.join(f'{k} {v:.1f}' for k, v in
+                          sorted(phases.items(),
+                                 key=lambda kv: -kv[1]))
+    print(f'# seam_commit phase breakdown ({n_docs} docs x 20 changes, '
+          f'one traced steady-state batch, {wall_ms:.0f} ms wall): '
+          f'{breakdown} ms; commit phase {commit_ms:.1f} ms, '
+          f'{dispatches} device dispatch(es), '
+          f'{fallback_docs} per-doc commit-loop fallback iterations '
+          f'(columnar fast path = 0)', file=sys.stderr)
+
+
 @section('seam_threads')
 def _sec_seam_threads():
     # Thread-scaling sweep: the single-shot seam at a 1/2/4-lane native

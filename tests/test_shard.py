@@ -541,6 +541,106 @@ class TestMigration:
         assert get_change_by_hash(rec.session.handle, h) is not None
 
 
+class TestTickTelemetry:
+    def test_tick_budget_counts_slips_per_shard(self):
+        """A router given a serving cadence attributes overrunning
+        pumps PER SHARD (ISSUE-12 satellite) and the Prometheus page
+        renders the labeled counters; a free-running router (no
+        budget) never counts."""
+        clk = [0.0]
+        router = _router(2, clk, tick_budget_s=0.0)   # everything slips
+        router.open_tenant('t0')
+        ticket = _settle(router, clk, router.submit(
+            't0', 'apply', [_change('aa' * 16, 1)]))
+        assert ticket.status == 'ok', ticket.error
+        slips = {sid: s.ticks_slipped for sid, s in router.shards.items()}
+        assert all(n > 0 for n in slips.values())
+        assert sum(slips.values()) <= shard_stats()['shard_ticks_slipped']
+        page = render_prometheus(router=router)
+        for sid in router.shards:
+            assert (f'automerge_tpu_shard_ticks_slipped_total'
+                    f'{{shard="{sid}"}}') in page
+            assert f'automerge_tpu_shard_pump_seconds{{shard="{sid}"}}' \
+                in page
+        free = _router(2, [0.0])
+        free.open_tenant('t0')
+        free.pump(now=0.0)
+        assert all(s.ticks_slipped == 0 for s in free.shards.values())
+
+    def test_obs_report_metrics_mode_surfaces_slips(self, tmp_path):
+        from automerge_tpu.observability.export import MetricsExporter
+        clk = [0.0]
+        router = _router(2, clk, tick_budget_s=0.0)
+        router.open_tenant('t0')
+        _settle(router, clk, router.submit('t0', 'apply',
+                                           [_change('aa' * 16, 1)]))
+        snap = tmp_path / 'metrics.prom'
+        MetricsExporter(port=None, router=router,
+                        snapshot_path=str(snap)).write_snapshot()
+        import obs_report
+        out = io.StringIO()
+        obs_report.render_metrics(str(snap), out=out)
+        text = out.getvalue()
+        assert 'per-shard slipped ticks' in text
+        assert 'shard_ticks_slipped_total{shard="shard0"}' in text
+
+
+class TestAntiEntropyScrub:
+    def test_scrub_flags_silent_divergence_and_heals(self):
+        """A replica whose state rotted OUT OF BAND (stand-in: the
+        handle swapped for an empty doc) while the pair believes itself
+        converged-quiet: the scrub flags it with a typed mismatch event
+        and resets the handshake, and the next rounds re-converge the
+        pair byte-identically — earlier than the tenant's next write
+        would have surfaced it."""
+        from automerge_tpu.fleet import backend as fleet_backend
+        base = shard_stats()['shard_scrub_mismatches']
+        clk = [0.0]
+        router = _router(2, clk, scrub_every=5)
+        router.open_tenant('t0')
+        rec = router.tenant_record('t0')
+        ticket = _settle(router, clk, router.submit(
+            't0', 'apply', [_change('aa' * 16, 1)]))
+        assert ticket.status == 'ok', ticket.error
+        assert router.run_until_quiet(200, advance=0.02)
+        assert rec.quiet
+        # memory-rot stand-in: replica doc replaced by an empty one,
+        # with the pair's bookkeeping still claiming convergence
+        rec.replica_handle = fleet_backend.init(
+            router.shards[rec.replica_on].fleet)
+        rec.last_pair_heads = (rec.last_pair_heads[0], ())
+        rec.quiet = True
+        found = router.scrub_frontiers()
+        assert found == 1
+        assert shard_stats()['shard_scrub_mismatches'] == base + 1
+        assert router.scrub_mismatches[-1]['tenant'] == 't0'
+        assert not rec.quiet
+        assert router.run_until_quiet(400, advance=0.02)
+        assert bytes(host_backend.save(rec.session.handle)) == \
+            bytes(host_backend.save(rec.replica_handle))
+
+    def test_scrub_skips_lagging_and_racing_pairs(self):
+        """Normal replication lag (quiet=False) and a home write that
+        raced the scrub must NOT flag — divergence events mean damage,
+        not traffic."""
+        clk = [0.0]
+        router = _router(2, clk, scrub_every=0)
+        router.open_tenant('t0')
+        rec = router.tenant_record('t0')
+        ticket = _settle(router, clk, router.submit(
+            't0', 'apply', [_change('aa' * 16, 1)]))
+        assert ticket.status == 'ok', ticket.error
+        assert router.run_until_quiet(200, advance=0.02)
+        before = shard_stats()['shard_scrub_mismatches']
+        # a home-side write the rounds have not replicated yet: heads
+        # differ, home frontier moved -> the scrub must stay silent
+        rec.session.handle = host_backend.apply_changes(
+            rec.session.handle, [_change('aa' * 16, 2)])[0]
+        assert router.scrub_frontiers() == 0
+        assert shard_stats()['shard_scrub_mismatches'] == before
+        assert router.run_until_quiet(200, advance=0.02)
+
+
 class TestLinkFaults:
     def test_partition_darkens_then_heals(self):
         link = LossyLink(seed=0)

@@ -697,6 +697,10 @@ def run_shard_leg(name, *, n_shards=4, tenants=16, requests=800,
         lease_ticks=lease_ticks, link_factory=link_factory,
         exact_device=exact_device, service_kwargs=service_kwargs,
         pump_threads=pump_threads, repl_every=repl_every,
+        # paced legs declare the cadence to the router too, so slipped
+        # ticks are attributed PER SHARD (Shard.ticks_slipped -> the
+        # labeled Prometheus counter), not just counted in this loop
+        tick_budget_s=tick_dt if pace else None,
         backoff=Backoff(base=tick_dt, factor=1.5, cap=tick_dt * 16,
                         retries=16, jitter=0.5, seed=seed + 3))
     shard_ids = router.ring.shard_ids()
@@ -879,6 +883,9 @@ def run_shard_leg(name, *, n_shards=4, tenants=16, requests=800,
         'lease_ticks': lease_ticks,
         'paced': bool(pace),
         'ticks_slipped': slipped if pace else None,
+        'ticks_slipped_per_shard': {sid: router.shards[sid].ticks_slipped
+                                    for sid in shard_ids} if pace else None,
+        'scrub_mismatches': len(router.scrub_mismatches),
         'kills': len(mttrs),
         'failovers': len(router.failovers),
         'mttr_ticks': [m['mttr_ticks'] for m in mttrs],

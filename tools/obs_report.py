@@ -8,6 +8,13 @@ Usage:
     python tools/obs_report.py --stitch peer_a.json peer_b.json \\
                                [-o stitched_trace.json]
     python tools/obs_report.py --stitch shard0=a.json shard1=b.json
+    python tools/obs_report.py --metrics metrics_snapshot.prom
+
+Metrics mode reads a Prometheus exposition page (a MetricsExporter
+``write_snapshot`` file or a curl'd /metrics body) and surfaces the
+shard-labeled operational counters — per-shard slipped ticks (the
+tick-overrun telemetry: which failure domain's pump does not fit the
+serving cadence) and pump seconds — plus any non-zero health counters.
 
 Trace mode reads the Chrome trace-event JSON that
 ``observability.export_chrome_trace`` writes (a bare event list or a
@@ -313,10 +320,55 @@ def render_flight(path, baseline=None, out=sys.stdout):
     return report
 
 
+def render_metrics(path, out=sys.stdout):
+    """Pretty-print a Prometheus exposition page (a MetricsExporter
+    ``write_snapshot`` file, or anything curl'd from /metrics): the
+    shard-labeled operational counters first — per-shard slipped ticks
+    (tick-overrun telemetry) and pump seconds — then the health-counter
+    roll-up, so a shard deployment's cadence health reads at a glance
+    without a Prometheus server in the loop."""
+    with open(path) as f:
+        lines = [ln for ln in f.read().splitlines()
+                 if ln and not ln.startswith('#')]
+    slips, pumps, health = [], [], []
+    for ln in lines:
+        name = ln.split('{', 1)[0].split(' ', 1)[0]
+        if name.endswith('shard_ticks_slipped_total'):
+            slips.append(ln)
+        elif name.endswith('shard_pump_seconds'):
+            pumps.append(ln)
+        elif name.endswith('health_total'):
+            health.append(ln)
+    if slips:
+        print('# per-shard slipped ticks (pump overran the serving '
+              'cadence):', file=out)
+        for ln in slips:
+            print(f'  {ln}', file=out)
+    if pumps:
+        print('# per-shard last pump seconds:', file=out)
+        for ln in pumps:
+            print(f'  {ln}', file=out)
+    moved = [ln for ln in health if not ln.rstrip().endswith(' 0')]
+    if moved:
+        print('# health counters (non-zero):', file=out)
+        for ln in moved:
+            print(f'  {ln}', file=out)
+    if not (slips or pumps or moved):
+        print('# no shard telemetry or non-zero health counters in '
+              f'{path}', file=out)
+    return 0
+
+
 def main(argv):
     if not argv or argv[0] in ('-h', '--help'):
         print(__doc__.strip())
         return 2
+    if argv[0] == '--metrics':
+        if len(argv) < 2:
+            print('--metrics needs an exposition-file path',
+                  file=sys.stderr)
+            return 2
+        return render_metrics(argv[1])
     if argv[0] == '--flight':
         if len(argv) < 2:
             print('--flight needs a dump path', file=sys.stderr)
