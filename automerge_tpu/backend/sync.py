@@ -16,6 +16,7 @@ from ..encoding import (Encoder, Decoder, hex_string_to_bytes,
 from ..columnar import decode_change_meta
 from ..errors import MalformedSyncMessage, as_wire_error
 from ..observability import register_health_source
+from ..observability.metrics import Counters
 from . import get_heads, get_missing_deps, get_change_by_hash, get_changes, \
     apply_changes
 
@@ -23,7 +24,7 @@ from . import get_heads, get_missing_deps, get_change_by_hash, get_changes, \
 # were treated as empty (send-everything) instead of crashing the
 # generate round. Registered as a health source so bench.py and the
 # chaos tests can see corruption being absorbed.
-_wire_stats = {'rejected_filters': 0}
+_wire_stats = Counters({'rejected_filters': 0})
 register_health_source('rejected_filters',
                        lambda: _wire_stats['rejected_filters'])
 
@@ -335,7 +336,7 @@ def probe_filter_lenient(filter_bytes, hashes):
         bloom = BloomFilter(bytes(filter_bytes))
         return [bloom.contains_hash(h) for h in hashes]
     except Exception:
-        _wire_stats['rejected_filters'] += 1
+        _wire_stats.inc('rejected_filters')
         return [False] * len(hashes)
 
 

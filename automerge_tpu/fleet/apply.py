@@ -14,6 +14,7 @@ that XLA pipelines across the fleet.
 import jax
 import jax.numpy as jnp
 
+from ..observability.perf import instrument_kernel
 from .tensor_doc import FleetState
 
 
@@ -63,7 +64,8 @@ def _apply_op_batch_impl(state, ops):
     return FleetState(winners, values, counters), stats
 
 
-apply_op_batch = jax.jit(_apply_op_batch_impl)
+apply_op_batch = instrument_kernel(
+    'apply_op_batch', jax.jit(_apply_op_batch_impl))
 
 
 def _apply_op_batch_noinc_impl(state, ops):
@@ -98,8 +100,9 @@ def _apply_op_batch_noinc_impl(state, ops):
     return FleetState(winners, values, state.counters), stats
 
 
-apply_op_batch_noinc_donated = jax.jit(_apply_op_batch_noinc_impl,
-                                       donate_argnums=(0,))
+apply_op_batch_noinc_donated = instrument_kernel(
+    'apply_op_batch_noinc_donated',
+    jax.jit(_apply_op_batch_noinc_impl, donate_argnums=(0,)))
 
 
 def _apply_op_batch_noinc_fresh_impl(ops, n_docs, n_keys):
@@ -107,8 +110,9 @@ def _apply_op_batch_noinc_fresh_impl(ops, n_docs, n_keys):
         FleetState.empty(n_docs, n_keys, xp=jnp), ops)
 
 
-apply_op_batch_noinc_fresh = jax.jit(_apply_op_batch_noinc_fresh_impl,
-                                     static_argnums=(1, 2))
+apply_op_batch_noinc_fresh = instrument_kernel(
+    'apply_op_batch_noinc_fresh',
+    jax.jit(_apply_op_batch_noinc_fresh_impl, static_argnums=(1, 2)))
 
 
 def _apply_op_batch_kills_impl(state, ops, kill_key, kill_packed):
@@ -164,9 +168,11 @@ def _apply_op_batch_kills_impl(state, ops, kill_key, kill_packed):
     return _apply_op_batch_impl(cleared, masked)
 
 
-apply_op_batch_kills = jax.jit(_apply_op_batch_kills_impl)
-apply_op_batch_kills_donated = jax.jit(_apply_op_batch_kills_impl,
-                                       donate_argnums=(0,))
+apply_op_batch_kills = instrument_kernel(
+    'apply_op_batch_kills', jax.jit(_apply_op_batch_kills_impl))
+apply_op_batch_kills_donated = instrument_kernel(
+    'apply_op_batch_kills_donated',
+    jax.jit(_apply_op_batch_kills_impl, donate_argnums=(0,)))
 
 # The fleet's own dispatch paths donate the input state: the scatters then
 # update the [docs, keys] grids in place instead of rewriting ~all of HBM
@@ -181,7 +187,9 @@ apply_op_batch_kills_donated = jax.jit(_apply_op_batch_kills_impl,
 # change logs remain the source of truth, so documents rebuild into a
 # fresh fleet (or promote to the host engine) from their logs; device
 # state is always a derived cache.
-apply_op_batch_donated = jax.jit(_apply_op_batch_impl, donate_argnums=(0,))
+apply_op_batch_donated = instrument_kernel(
+    'apply_op_batch_donated',
+    jax.jit(_apply_op_batch_impl, donate_argnums=(0,)))
 
 
 def _apply_op_batch_fresh_impl(ops, n_docs, n_keys):
@@ -195,8 +203,9 @@ def _apply_op_batch_fresh_impl(ops, n_docs, n_keys):
                                 ops)
 
 
-apply_op_batch_fresh = jax.jit(_apply_op_batch_fresh_impl,
-                               static_argnums=(1, 2))
+apply_op_batch_fresh = instrument_kernel(
+    'apply_op_batch_fresh',
+    jax.jit(_apply_op_batch_fresh_impl, static_argnums=(1, 2)))
 
 
 def _apply_op_batch_kills_fresh_impl(ops, kill_key, kill_packed, n_docs,
@@ -209,8 +218,9 @@ def _apply_op_batch_kills_fresh_impl(ops, kill_key, kill_packed, n_docs,
         kill_packed)
 
 
-apply_op_batch_kills_fresh = jax.jit(_apply_op_batch_kills_fresh_impl,
-                                     static_argnums=(3, 4))
+apply_op_batch_kills_fresh = instrument_kernel(
+    'apply_op_batch_kills_fresh',
+    jax.jit(_apply_op_batch_kills_fresh_impl, static_argnums=(3, 4)))
 
 
 def _zero_doc_rows_impl(state, idx):
@@ -223,7 +233,9 @@ def _zero_doc_rows_impl(state, idx):
                       state.counters.at[idx].set(0))
 
 
-zero_doc_rows_donated = jax.jit(_zero_doc_rows_impl, donate_argnums=(0,))
+zero_doc_rows_donated = instrument_kernel(
+    'zero_doc_rows_donated',
+    jax.jit(_zero_doc_rows_impl, donate_argnums=(0,)))
 
 
 def fleet_merge(state, op_batches):

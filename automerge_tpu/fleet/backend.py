@@ -40,6 +40,7 @@ import hashlib
 import queue
 import threading
 import time
+import weakref
 
 import numpy as np
 
@@ -47,11 +48,17 @@ from .. import native
 from ..backend.hash_graph import HashGraph, decode_change_buffers
 from ..errors import (AutomergeError, DanglingPred, DocError, DuplicateOpId,
                       InvalidChange, MalformedChange, as_wire_error)
-from ..observability import Metrics, register_health_source
+from ..observability import (Counters, Metrics, register_health_source,
+                             register_mem_source)
 from ..observability import hist as _hist
 from ..observability import recorder as _flight
 from ..observability.spans import (span as _span, span_seq as _span_seq,
                                    spanned as _spanned)
+
+# live fleets for the memory-watermark tier (see _fleet_bytes below,
+# which must stay below this line since DocFleet.__init__ registers
+# here); a WeakSet so an abandoned fleet leaves the gauge with the fleet
+_live_fleets = weakref.WeakSet()
 from ..backend.op_set import OpSet
 from ..columnar import decode_change, OBJECT_TYPE
 from .tensor_doc import (ACTOR_BITS, CTR_LIMIT, FleetState, MAX_ACTORS,
@@ -345,6 +352,7 @@ class DocFleet:
         # merge kernel (apply.py) that skips the counter grid passes
         self._counters_touched = False
         self.metrics = Metrics()  # per-dispatch counters (observability.py)
+        _live_fleets.add(self)    # memory-watermark tier (perf.py)
         # Sequence-object fleet: one device row per (doc slot, objectId).
         # Text/list CRDT state lives in pow2 size-class pools of SeqStates
         # (fleet/sequence.py SeqPools) so memory follows each document's
@@ -3330,7 +3338,39 @@ def rebuild_docs(handles, fleet=None, mirror=False):
 # rejected by quarantining batch calls, and how many change buffers went
 # down with them. Module-level because quarantine also runs over host
 # backends with no fleet in sight (the sync driver's receive path).
-quarantine_stats = {'quarantined_docs': 0, 'rejected_changes': 0}
+quarantine_stats = Counters({'quarantined_docs': 0,
+                             'rejected_changes': 0})
+
+# ---- memory-watermark tier: fleet-resident state ---------------------------
+#
+# Every live DocFleet's device grids + register/sequence pools + host
+# mirror, summed on demand for the perf observatory's watermark sampler
+# (perf.sample_watermarks). The WeakSet itself lives just under the
+# import block (a fleet is constructed during module init, before this
+# block runs).
+def _fleet_bytes(fleet):
+    import jax
+    total = 0
+    for state in (fleet.state, fleet.reg_state):
+        if state is not None:
+            total += sum(getattr(leaf, 'nbytes', 0)
+                         for leaf in jax.tree_util.tree_leaves(state))
+    if fleet.host_winners is not None:
+        total += fleet.host_winners.nbytes
+    pools = getattr(fleet, 'seq_pools', None)
+    if pools is not None:
+        for state in list(pools.pools.values()):
+            total += sum(getattr(leaf, 'nbytes', 0)
+                         for leaf in jax.tree_util.tree_leaves(state))
+    return total
+
+
+def fleets_resident_bytes():
+    """Resident bytes across every live fleet's device/mirror state."""
+    return sum(_fleet_bytes(fleet) for fleet in list(_live_fleets))
+
+
+register_mem_source('fleet_resident_bytes', fleets_resident_bytes)
 register_health_source('quarantined_docs',
                        lambda: quarantine_stats['quarantined_docs'])
 register_health_source('rejected_changes',
@@ -3640,8 +3680,8 @@ def _apply_changes_docs_quarantine(handles, per_doc_changes, mirror):
 
     def reject(d, exc, stage):
         errors[d] = DocError(d, stage, exc)
-        quarantine_stats['quarantined_docs'] += 1
-        quarantine_stats['rejected_changes'] += len(work[d])
+        quarantine_stats.inc('quarantined_docs')
+        quarantine_stats.inc('rejected_changes', len(work[d]))
         # flight-recorder event: WHICH doc (slot + durable id), WHAT
         # phase, WHAT typed error, plus a digest of the refused bytes so
         # the forensic dump can be matched to a captured wire corpus

@@ -20,8 +20,6 @@ weak #2). Filters cross the host<->device link already in the wire format's
 little-bit-order byte packing (8x less transfer than unpacked bools).
 """
 
-import functools
-
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -42,6 +40,7 @@ def dispatch_count():
 
 
 from ..observability import register_dispatch_source  # noqa: E402
+from ..observability.perf import instrument_kernel  # noqa: E402
 from ..observability.spans import spanned as _spanned  # noqa: E402
 register_dispatch_source('bloom', dispatch_count)
 
@@ -159,7 +158,6 @@ def bloom_filter_bytes(bits_row, num_entries):
 # concatenates every filter's exact byte span instead, so ONE dispatch
 # covers arbitrarily skewed fleets without padding-driven memory blowup.
 
-@jax.jit
 def _build_varsize(words, valid, row_bits, bits_init):
     n_rows, n_bits_max = bits_init.shape
     probes = _probe_indexes(words, row_bits[:, None])
@@ -169,7 +167,6 @@ def _build_varsize(words, valid, row_bits, bits_init):
     return bits_init.at[row_idx, probes].set(True, mode='drop')
 
 
-@jax.jit
 def _probe_varsize(bits, row_bits, words, valid):
     n_rows, _ = bits.shape
     probes = _probe_indexes(words, row_bits[:, None])
@@ -186,7 +183,6 @@ def _probe_varsize(bits, row_bits, words, valid):
 # packed bytes through the same offsets. Row axes and the flat length are
 # pow2-padded by the callers so JIT recompiles stay O(log fleet size).
 
-@functools.partial(jax.jit, static_argnums=(4,))
 def _build_flat_packed(words, valid, row_bits, bit_off, total_bits):
     # total_bits is static and byte-aligned; padded/invalid lanes scatter
     # out of range and drop
@@ -201,12 +197,24 @@ def _build_flat_packed(words, valid, row_bits, bit_off, total_bits):
                    * weights, axis=-1, dtype=jnp.uint8)
 
 
-@jax.jit
 def _probe_flat_packed(flat, row_bits, byte_off, words, valid):
     probes = _probe_indexes(words, row_bits[:, None])
     byte = flat[byte_off[:, None, None] + (probes >> 3)].astype(jnp.int32)
     hit = ((byte >> (probes & 7)) & 1) == 1
     return jnp.all(hit, axis=-1) & valid
+
+
+# jit + ledger wrap at definition (plain calls instead of decorators so
+# the cost-ledger wrapper composes with static_argnums cleanly):
+_build_varsize = instrument_kernel(
+    'bloom_build_varsize', jax.jit(_build_varsize))
+_probe_varsize = instrument_kernel(
+    'bloom_probe_varsize', jax.jit(_probe_varsize))
+_build_flat_packed = instrument_kernel(
+    'bloom_build_flat_packed',
+    jax.jit(_build_flat_packed, static_argnums=(4,)))
+_probe_flat_packed = instrument_kernel(
+    'bloom_probe_flat_packed', jax.jit(_probe_flat_packed))
 
 
 def _pow2(n, floor=1):
@@ -343,7 +351,7 @@ def probe_bloom_filters_batch_begin(filter_bytes, hash_lists):
             # probes — same containment rule as the host path's
             # probe_filter_lenient; the shared counter records it
             from ..backend.sync import _wire_stats
-            _wire_stats['rejected_filters'] += 1
+            _wire_stats.inc('rejected_filters')
             continue
         rows.append((i, np.frombuffer(raw, dtype=np.uint8), 8 * len(raw)))
     if not rows:

@@ -64,6 +64,8 @@ import numpy as np
 from ..errors import (AutomergeError, DocError, MalformedJournal,
                       MalformedSnapshot, TornTail, as_wire_error)
 from ..observability import register_health_source
+from ..observability.metrics import Counters
+from ..observability.perf import register_mem_source
 from ..observability import hist as _hist
 from ..observability import recorder as _flight
 from ..observability.spans import (span as _span, span_seq as _span_seq,
@@ -464,7 +466,7 @@ def parse_manifest_bytes(data):
 # health counters (observability roll-up; monotonic, module-level)
 # ---------------------------------------------------------------------------
 
-_stats = {
+_stats = Counters({
     'checkpoints': 0,            # snapshots written (incl. compactions)
     'compactions': 0,            # cost-triggered checkpoints
     'journal_commits': 0,        # group commits
@@ -479,7 +481,7 @@ _stats = {
     'segment_docs': 0,           # doc frames written by incremental
     #                              compaction — the O(churn) signal: after
     #                              touching K of N docs this grows by K
-}
+})
 for _key in _stats:
     register_health_source(_key, lambda k=_key: _stats[k])
 
@@ -517,6 +519,10 @@ def pending_fsync_bytes_total():
 
 
 register_health_source('pending_fsync_bytes', pending_fsync_bytes_total)
+# ...and the same number as a memory-watermark tier: the loss window is
+# ALSO resident bytes (buffered records waiting on the fsync cadence)
+register_mem_source('journal_pending_fsync_bytes',
+                    pending_fsync_bytes_total)
 
 
 def durability_stats():
@@ -623,7 +629,7 @@ class ChangeJournal:
         self._pending += encode_frame(kind, doc_id, bytes(payload))
         self.records += 1
         self.dirty.add(doc_id)
-        _stats['journal_records'] += 1
+        _stats.inc('journal_records')
 
     def record_changes(self, state, buffers, commit=True):
         """Journal a batch of accepted change buffers for one document
@@ -684,7 +690,7 @@ class ChangeJournal:
             self._pending += _encode_batch(dids, bufs)
         self.records += n_rec
         self.dirty.update(dids)
-        _stats['journal_records'] += n_rec
+        _stats.inc('journal_records', n_rec)
         self.commit()
 
     def record_free(self, state, commit=True):
@@ -728,7 +734,7 @@ class ChangeJournal:
                 self._f.flush()
                 self.written_bytes += len(self._pending)
                 self._pending = bytearray()
-            _stats['journal_commits'] += 1
+            _stats.inc('journal_commits')
             if self.fsync_bytes <= 0 or \
                     self.pending_fsync_bytes >= self.fsync_bytes:
                 self._fsync()
@@ -754,7 +760,7 @@ class ChangeJournal:
         _hist.record_value('fsync_s', time.perf_counter() - start,
                            scale=1e9, unit='s')
         self.durable_bytes = self.written_bytes
-        _stats['journal_fsyncs'] += 1
+        _stats.inc('journal_fsyncs')
         self._window_alerted = False    # window closed; re-arm the alert
 
     def _check_loss_window(self):
@@ -767,7 +773,7 @@ class ChangeJournal:
         pending = self.pending_fsync_bytes
         if pending >= _fsync_alert_bytes:
             self._window_alerted = True
-            _stats['fsync_window_alerts'] += 1
+            _stats.inc('fsync_window_alerts')
             _flight.record_event('fsync_window_alert', path=self.path,
                                  pending_bytes=pending,
                                  threshold=_fsync_alert_bytes,
@@ -1099,7 +1105,7 @@ class DurableFleet:
                     self.journal._pending += _encode_batch(pend_d, pend_b)
                 self.journal.records += len(pend_b)
                 self.journal.dirty.update(pend_d)
-                _stats['journal_records'] += len(pend_b)
+                _stats.inc('journal_records', len(pend_b))
                 pend_d.clear()
                 pend_b.clear()
 
@@ -1219,7 +1225,7 @@ class DurableFleet:
                    debt_records=debt['records']):
             did_work = self.compact()
         if did_work:
-            _stats['compactions'] += 1
+            _stats.inc('compactions')
         return did_work
 
     # -- checkpointing --------------------------------------------------
@@ -1338,7 +1344,7 @@ class DurableFleet:
                                             (), base=True)
         self.chain = [snap_name]
         self._rotate_and_flip(new_seq, live, next_doc_id)
-        _stats['checkpoints'] += 1
+        _stats.inc('checkpoints')
 
     @_spanned('compact_segment')
     def compact(self):
@@ -1379,8 +1385,8 @@ class DurableFleet:
                                                 tombstones, base=False)
         self.chain = self.chain + [snap_name]
         self._rotate_and_flip(new_seq, live, next_doc_id)
-        _stats['segments'] += 1
-        _stats['segment_docs'] += n_docs
+        _stats.inc('segments')
+        _stats.inc('segment_docs', n_docs)
         return True
 
     def _fault(self, point):
@@ -1445,11 +1451,11 @@ class DurableFleet:
         report.torn_tail_bytes = info['torn_tail_bytes']
         report.rotted_records = len(info['rotted'])
         if report.torn_tail_bytes:
-            _stats['journal_truncations'] += 1
+            _stats.inc('journal_truncations')
             _flight.record_event('recovery_truncation',
                                  bytes=report.torn_tail_bytes,
                                  path=str(path))
-        _stats['rotted_records'] += report.rotted_records
+        _stats.inc('rotted_records', report.rotted_records)
         for _did, _at, _rec in info['rotted']:
             _flight.record_event('journal_rot', durable_id=_did,
                                  at_byte=_at, record=_rec)
@@ -1598,8 +1604,8 @@ class DurableFleet:
                 handle = fleet_backend.init(fleet)
                 handles[did] = handle
                 states[did] = handle['state']
-        _stats['replayed_records'] += report.replayed_records
-        _stats['recovered_docs'] += len(handles)
+        _stats.inc('replayed_records', report.replayed_records)
+        _stats.inc('recovered_docs', len(handles))
 
         # quarantined docs stay registered (their handle holds the last
         # good prefix); rebuild the registry for the fresh journal.

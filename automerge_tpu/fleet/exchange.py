@@ -26,13 +26,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..errors import SyncOverflow
 from ..observability import register_health_source
+from ..observability.metrics import Counters
 from ..observability import hist as _hist
 from ..observability import recorder as _flight
 from ..observability.spans import span as _span
 
 # Fault-containment roll-up: extra sub-rounds paid to move over-limit sync
 # payloads through the fixed-width wire (sync_round_multihost chunking).
-_sync_stats = {'sync_retries': 0}
+_sync_stats = Counters({'sync_retries': 0})
 register_health_source('sync_retries', lambda: _sync_stats['sync_retries'])
 
 
@@ -275,7 +276,7 @@ def _sync_round_multihost(mesh, axis, generate, receive, max_msg,
         return 0
     n_sub = -(-global_max // max_msg) if global_max else 1
     if n_sub > 1:
-        _sync_stats['sync_retries'] += n_sub - 1
+        _sync_stats.inc('sync_retries', n_sub - 1)
     sh_data = NamedSharding(mesh, P(axis, None, None))
     sh_lens = NamedSharding(mesh, P(axis, None))
     inbox_acc = {}        # (dst, src) -> bytearray of reassembled fragments

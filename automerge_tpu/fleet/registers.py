@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..observability.perf import instrument_kernel
 from .tensor_doc import MAX_ACTORS, register_pytrees
 
 ACTOR_MASK = MAX_ACTORS - 1
@@ -218,12 +219,14 @@ def _apply_register_batch_impl(state, ops):
     return RegisterState(*carry), jnp.sum(applied)
 
 
-apply_register_batch = jax.jit(_apply_register_batch_impl)
+apply_register_batch = instrument_kernel(
+    'apply_register_batch', jax.jit(_apply_register_batch_impl))
 # In-place variant for the fleet's own dispatch paths (see
 # apply.apply_op_batch_donated): the register tensors update without a
 # full-state rewrite; callers must replace their state reference.
-apply_register_batch_donated = jax.jit(_apply_register_batch_impl,
-                                       donate_argnums=(0,))
+apply_register_batch_donated = instrument_kernel(
+    'apply_register_batch_donated',
+    jax.jit(_apply_register_batch_impl, donate_argnums=(0,)))
 
 
 def _zero_register_rows_impl(state, idx):
@@ -236,8 +239,9 @@ def _zero_register_rows_impl(state, idx):
                          state.inexact.at[idx].set(False))
 
 
-zero_register_rows_donated = jax.jit(_zero_register_rows_impl,
-                                     donate_argnums=(0,))
+zero_register_rows_donated = instrument_kernel(
+    'zero_register_rows_donated',
+    jax.jit(_zero_register_rows_impl, donate_argnums=(0,)))
 
 
 @jax.jit

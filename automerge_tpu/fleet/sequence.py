@@ -46,6 +46,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..observability.perf import instrument_kernel
 from .tensor_doc import ACTOR_BITS, MAX_ACTORS, pack_op_id, register_pytrees
 
 # Op kinds in a SeqOpBatch
@@ -452,11 +453,13 @@ def _apply_seq_batch_impl(state, ops):
     return SeqState(*carry), jnp.sum(applied)
 
 
-apply_seq_batch = jax.jit(_apply_seq_batch_impl)
+apply_seq_batch = instrument_kernel(
+    'apply_seq_batch', jax.jit(_apply_seq_batch_impl))
 # In-place variant for the fleet's own dispatch paths (see
 # apply.apply_op_batch_donated)
-apply_seq_batch_donated = jax.jit(_apply_seq_batch_impl,
-                                  donate_argnums=(0,))
+apply_seq_batch_donated = instrument_kernel(
+    'apply_seq_batch_donated',
+    jax.jit(_apply_seq_batch_impl, donate_argnums=(0,)))
 
 
 def _visible_impl(state):
@@ -473,7 +476,8 @@ def _visible_impl(state):
     return vis, winner, value, cnt
 
 
-element_visibility = jax.jit(_visible_impl)
+element_visibility = instrument_kernel(
+    'element_visibility', jax.jit(_visible_impl))
 
 
 def _linearize_impl(state):
@@ -505,7 +509,7 @@ def _linearize_impl(state):
     return pos, state.n
 
 
-linearize = jax.jit(_linearize_impl)
+linearize = instrument_kernel('linearize', jax.jit(_linearize_impl))
 
 
 def _materialize_impl(state):
@@ -542,7 +546,7 @@ def _materialize_impl(state):
     return vals, cnts, vis, state.n
 
 
-materialize = jax.jit(_materialize_impl)
+materialize = instrument_kernel('materialize', jax.jit(_materialize_impl))
 
 
 def visible_text(state):

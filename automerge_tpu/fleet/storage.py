@@ -35,21 +35,31 @@ compaction that keeps checkpoint cost proportional to churn lives in
 fleet/durability.py; this module is the RAM-resident tier.
 """
 
+import weakref
+
 import numpy as np
 
 from ..columnar import DocChunkView
 from ..errors import MalformedDocument
-from ..observability.metrics import register_health_source
+from ..observability.metrics import Counters, register_health_source
+from ..observability.perf import register_mem_source
 from ..observability.spans import span as _span
 
 __all__ = ['MainStore', 'StorageEngine']
 
-_stats = {
+_stats = Counters({
     'storage_auto_vacuums': 0,   # dead_fraction-policy vacuums triggered
     'storage_parked_syncs_skipped': 0,   # sync rounds served parked
-}
+})
 for _key in _stats:
     register_health_source(_key, lambda k=_key: _stats[k])
+
+# memory-watermark tier: every live MainStore's chunk arena + causal
+# lanes, the signal the cost-based-tiering ROADMAP item consumes
+_live_stores = weakref.WeakSet()
+register_mem_source(
+    'mainstore_bytes',
+    lambda: sum(s.resident_bytes() for s in list(_live_stores)))
 
 
 class _I64:
@@ -111,9 +121,21 @@ class MainStore:
         self._live = 0
         self._dead_head_bytes = 0
         self._dead_clock_rows = 0
+        _live_stores.add(self)          # memory-watermark tier (perf.py)
 
     def __len__(self):
         return self._live
+
+    def resident_bytes(self):
+        """Resident bytes of this store: the compressed chunk arena plus
+        the columnar causal lanes (heads arena + index arrays) — the
+        number the cost-based-tiering ROADMAP item budgets against."""
+        total = self._chunk_bytes + len(self._heads_arena)
+        for col in (self._heads_off, self._heads_n, self._clock_actor,
+                    self._clock_seq, self._clock_off, self._clock_n,
+                    self._max_op, self._n_changes):
+            total += col.nbytes
+        return total
 
     def _intern_actor(self, hexa):
         idx = self._actor_index.get(hexa)
@@ -333,7 +355,7 @@ class StorageEngine:
         self._row_of = {doc_id: remap[row]
                         for doc_id, row in self._row_of.items()}
         self.vacuums += 1
-        _stats['storage_auto_vacuums'] += 1
+        _stats.inc('storage_auto_vacuums')
         return True
 
     # -- demotion -------------------------------------------------------

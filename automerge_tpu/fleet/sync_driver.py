@@ -270,7 +270,7 @@ def _receive_inner(backends, sync_states, binary_messages, mirror,
                 if not quarantine:
                     raise err
                 errors[i] = DocError(i, 'decode', err)
-                quarantine_stats['quarantined_docs'] += 1
+                quarantine_stats.inc('quarantined_docs')
                 state = backends[i].get('state') \
                     if isinstance(backends[i], dict) else None
                 _flight.record_event(
@@ -405,7 +405,7 @@ def generate_sync_messages_mixed(storage, docs, sync_states,
                 sorted(last_sent) == storage.heads(doc) and \
                 not state['theirNeed'] and last_sync_known
             if quiet:
-                _parked_stats()['storage_parked_syncs_skipped'] += 1
+                _parked_stats().inc('storage_parked_syncs_skipped')
             else:
                 revive.append(i)
     if revive:
@@ -538,7 +538,7 @@ def receive_sync_messages_mixed(storage, docs, sync_states,
     for i, decoded in fast.items():
         if isinstance(decoded, Exception):
             errors[i] = DocError(i, 'decode', decoded)
-            quarantine_stats['quarantined_docs'] += 1
+            quarantine_stats.inc('quarantined_docs')
             # same forensic trail as the live decode path: this fault
             # class must not go invisible just because the doc is parked
             _flight.record_event(
@@ -569,7 +569,7 @@ def receive_sync_messages_mixed(storage, docs, sync_states,
             'theirNeed': decoded['need'],
             'sentHashes': sent_hashes,
         }
-        _parked_stats()['storage_parked_syncs_skipped'] += 1
+        _parked_stats().inc('storage_parked_syncs_skipped')
     if fast_errors:
         _flight.dump_flight_record('quarantine', detail={
             'errors': [e.describe() for e in fast_errors]})

@@ -59,10 +59,15 @@ from .export import (MetricsExporter, maybe_start_exporter,
                      render_prometheus)
 from .hist import (Histogram, histogram, histogram_delta,
                    histogram_snapshot, record_value)
-from .metrics import (Metrics, counts_delta, dispatch_counts,
+from .metrics import (Counters, Metrics, counts_delta, dispatch_counts,
                       dispatch_delta, health_counts, health_delta,
                       register_dispatch_source, register_health_source,
                       timed, trace)
+from .perf import (PerfBaselines, baselines, disable_observatory,
+                   dump_ledger, enable_observatory, instrument_kernel,
+                   kernel_report, kernel_snapshot, perf_stats,
+                   register_mem_source, sample_watermarks,
+                   watermark_snapshot)
 from .recorder import (configure as configure_flight_recorder, clear_events,
                        dump_flight_record, flight_stats, last_flight_record,
                        recent_events, record_event)
@@ -85,7 +90,11 @@ __all__ = [
     'last_flight_record', 'flight_stats', 'configure_flight_recorder',
     'SloPolicy', 'SloRegistry', 'outcome_class', 'slo_stats',
     'MetricsExporter', 'maybe_start_exporter', 'render_prometheus',
-    'TraceContext',
+    'TraceContext', 'Counters',
+    'PerfBaselines', 'baselines', 'enable_observatory',
+    'disable_observatory', 'instrument_kernel', 'kernel_snapshot',
+    'kernel_report', 'dump_ledger', 'register_mem_source',
+    'sample_watermarks', 'watermark_snapshot', 'perf_stats',
     'enable', 'disable', 'enabled',
 ]
 

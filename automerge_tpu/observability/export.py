@@ -72,10 +72,23 @@ def _hist_snapshot(h):
     """Torn-read-proof plain snapshot of one histogram: the bucket
     vector is copied ONCE and count derived from that same copy, so
     the rendered cumulative buckets always sum to the rendered count
-    even while another thread is recording."""
-    counts = list(h.counts)
+    even while another thread is recording. The SUM is derived the same
+    way — from the per-bucket sums vector copied inside a counts-stable
+    bracket (copy counts, copy sums, re-copy counts; retry on movement),
+    and Histogram.record updates sums BEFORE counts, so every record
+    the page counts has its value in the page's sum (the page may
+    additionally carry the value of a record still in flight — sum is
+    an upper bound by at most the in-flight writers' values, never an
+    undercount of what _count claims). That is what makes
+    rate(..._sum)/rate(..._count) PromQL honest under concurrent
+    recording."""
+    for _ in range(8):
+        counts = list(h.counts)
+        sums = list(h.sums)
+        if list(h.counts) == counts:
+            break
     return {'counts': counts, 'count': sum(counts),
-            'sum': float(h.total), 'scale': h.scale}
+            'sum': float(sum(sums)), 'scale': h.scale}
 
 
 def snapshot_all(slo=None, fleets=(), router=None):
@@ -101,6 +114,12 @@ def snapshot_all(slo=None, fleets=(), router=None):
         snap['slo_lag'] = slo.lag_gauges()
         snap['slo_hists'] = {key: _hist_snapshot(h)
                              for key, h in slo.histograms().items()}
+    # the perf observatory's three legs (perf.py), each empty until its
+    # switch is on — no series churn for processes that never enable it
+    from . import perf as _perf
+    snap['perf_seams'] = _perf.baseline_gauges()
+    snap['kernels'] = _perf.kernel_snapshot()
+    snap['mem'] = _perf.watermark_snapshot() if _perf._mem_last else None
     return snap
 
 
@@ -170,6 +189,47 @@ def render_prometheus(slo=None, fleets=(), shard=None, router=None):
         for sid, v in sorted(snap['shard_pump_s'].items()):
             ls = _labelset(psl, f'shard="{_label(sid)}"')
             lines.append(f'{_PREFIX}_shard_pump_seconds{ls} {_fmt(v)}')
+
+    if snap.get('perf_seams'):
+        # seam perf baselines (perf.py): trailing baseline vs newest
+        # window, the drift ratio the alert machinery judges, and the
+        # alert state — one series set per seam that closed a window
+        lines.append(f'# TYPE {_PREFIX}_perf_baseline_seconds gauge')
+        lines.append(f'# TYPE {_PREFIX}_perf_window_seconds gauge')
+        lines.append(f'# TYPE {_PREFIX}_perf_drift_ratio gauge')
+        lines.append(f'# TYPE {_PREFIX}_perf_alert_active gauge')
+        rows = {'perf_baseline_seconds': 'baseline_s',
+                'perf_window_seconds': 'window_s',
+                'perf_drift_ratio': 'drift',
+                'perf_alert_active': 'alert'}
+        for seam, gauge in sorted(snap['perf_seams'].items()):
+            ls = _labelset(sl, f'seam="{_label(seam)}"')
+            for metric, key in rows.items():
+                lines.append(f'{_PREFIX}_{metric}{ls} '
+                             f'{_fmt(gauge[key])}')
+    if snap.get('kernels'):
+        # device-kernel cost ledger: dispatches + blocking wall seconds
+        # per kernel kind (flops/bytes live in obs_report --floor — the
+        # AOT cost analysis has no place on a scrape hot path)
+        lines.append(f'# TYPE {_PREFIX}_kernel_dispatches_total counter')
+        lines.append(f'# TYPE {_PREFIX}_kernel_seconds_total counter')
+        for kind, row in sorted(snap['kernels'].items()):
+            ls = _labelset(sl, f'kernel="{_label(kind)}"')
+            lines.append(f'{_PREFIX}_kernel_dispatches_total{ls} '
+                         f'{row["dispatches"]}')
+            lines.append(f'{_PREFIX}_kernel_seconds_total{ls} '
+                         f'{_fmt(row["seconds"])}')
+    if snap.get('mem'):
+        # memory watermarks: current resident bytes + process-lifetime
+        # high per tier (rss rides as its own tier)
+        lines.append(f'# TYPE {_PREFIX}_mem_bytes gauge')
+        lines.append(f'# TYPE {_PREFIX}_mem_high_bytes gauge')
+        for tier, value in sorted(snap['mem']['current'].items()):
+            ls = _labelset(sl, f'tier="{_label(tier)}"')
+            lines.append(f'{_PREFIX}_mem_bytes{ls} {value}')
+        for tier, value in sorted(snap['mem']['high'].items()):
+            ls = _labelset(sl, f'tier="{_label(tier)}"')
+            lines.append(f'{_PREFIX}_mem_high_bytes{ls} {value}')
 
     for name, hsnap in sorted(snap['histograms'].items()):
         metric = f'{_PREFIX}_{_sanitize(name)}'

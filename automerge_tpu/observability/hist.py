@@ -76,14 +76,20 @@ def _summarize(counts, count, total, scale):
 class Histogram:
     """Fixed log2-bucket histogram of non-negative values."""
 
-    __slots__ = ('name', 'scale', 'unit', 'counts', 'count', 'total',
-                 'vmin', 'vmax')
+    __slots__ = ('name', 'scale', 'unit', 'counts', 'sums', 'count',
+                 'total', 'vmin', 'vmax')
 
     def __init__(self, name, scale=1, unit=''):
         self.name = name
         self.scale = scale
         self.unit = unit
         self.counts = [0] * NBUCKETS
+        # per-bucket value sums, updated BEFORE the bucket count: the
+        # exposition derives its `_sum` from a copy of this vector taken
+        # inside a counts-stable bracket (export._hist_snapshot), so a
+        # record counted on the page always has its value in the page's
+        # sum — the `_sum` twin of the round-14 torn-read contract
+        self.sums = [0.0] * NBUCKETS
         self.count = 0
         self.total = 0.0
         self.vmin = None
@@ -109,6 +115,7 @@ class Histogram:
         that also classifies by bucket (the SLO latency SLI) pays
         bucket_of once."""
         b = self.bucket_of(value)
+        self.sums[b] += value
         self.counts[b] += 1
         self.count += 1
         self.total += value
@@ -131,7 +138,9 @@ class Histogram:
         b = np.where(s > 0, exp, 0)
         np.clip(b, 0, NBUCKETS - 1, out=b)
         binned = np.bincount(b, minlength=NBUCKETS)
+        summed = np.bincount(b, weights=v, minlength=NBUCKETS)
         for i in np.flatnonzero(binned):
+            self.sums[int(i)] += float(summed[i])
             self.counts[int(i)] += int(binned[i])
         self.count += int(v.size)
         self.total += float(v.sum())

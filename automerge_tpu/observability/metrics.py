@@ -35,11 +35,46 @@ synthetic key). Both register functions reject them with ValueError.
 
 import contextlib
 import re
+import threading
 import time
 
-__all__ = ['Metrics', 'timed', 'trace', 'register_dispatch_source',
-           'dispatch_counts', 'register_health_source', 'health_counts',
+__all__ = ['Counters', 'Metrics', 'timed', 'trace',
+           'register_dispatch_source', 'dispatch_counts',
+           'register_health_source', 'health_counts',
            'counts_delta', 'health_delta', 'dispatch_delta']
+
+
+# One process-global lock for every Counters family: stat increments are
+# rare events (health counters, not per-op work), so contention on a
+# shared lock is cheaper than a lock object per module — and a single
+# lock means two families incremented from one code path can never
+# deadlock against each other.
+_COUNTERS_LOCK = threading.Lock()
+
+
+class Counters(dict):
+    """A module-stats dict whose increments are ATOMIC under threads.
+
+    ``d[key] += n`` on a plain dict is a read-modify-write that the GIL
+    can split between threads — which is exactly how the round-15
+    thread-per-shard pump pool undercounted health counters (two pumps
+    read the same value, both wrote value+1). Every module `_stats`
+    family is now one of these, and every increment goes through
+    ``inc``, which holds the shared lock across the whole
+    read-add-write. Plain reads and whole-value assignments
+    (``d[key] = 0`` resets, gauge sets) stay ordinary dict operations —
+    each is a single GIL-atomic bytecode effect.
+    """
+
+    __slots__ = ()
+
+    def inc(self, key, n=1):
+        """Atomically add ``n`` (may be negative) to ``key`` (missing
+        keys start at 0). Returns the new value."""
+        with _COUNTERS_LOCK:
+            value = self.get(key, 0) + n
+            self[key] = value
+        return value
 
 
 class Metrics:

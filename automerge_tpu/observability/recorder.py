@@ -29,7 +29,7 @@ import os
 import time
 
 from . import spans as _spans
-from .metrics import health_counts, register_health_source
+from .metrics import Counters, health_counts, register_health_source
 
 __all__ = ['configure', 'record_event', 'recent_events', 'clear_events',
            'dump_flight_record', 'last_flight_record', 'flight_stats']
@@ -47,7 +47,8 @@ _dump_window_s = float(os.environ.get('AUTOMERGE_TPU_FLIGHT_DUMP_WINDOW',
                                       60.0))
 _dump_times = collections.deque()
 _last = None
-_stats = {'flight_events': 0, 'flight_dumps': 0, 'dumps_suppressed': 0}
+_stats = Counters({'flight_events': 0, 'flight_dumps': 0,
+                   'dumps_suppressed': 0})
 register_health_source('flight_events', lambda: _stats['flight_events'])
 register_health_source('flight_dumps', lambda: _stats['flight_dumps'])
 register_health_source('dumps_suppressed',
@@ -93,7 +94,7 @@ def _dump_write_allowed(now):
 def record_event(kind, **fields):
     """Append a structured event to the ring. Values should already be
     JSON-friendly (strings/numbers); anything else is repr'd at dump."""
-    _stats['flight_events'] += 1
+    _stats.inc('flight_events')
     ev = {'kind': kind, 'ts_ns': time.time_ns()}
     ev.update(fields)
     _events.append(ev)
@@ -122,7 +123,7 @@ def dump_flight_record(trigger, detail=None, path=None):
     counter."""
     global _last
     from . import hist
-    _stats['flight_dumps'] += 1
+    _stats.inc('flight_dumps')
     now = time.time()
     report = {
         'trigger': trigger,
@@ -143,7 +144,7 @@ def dump_flight_record(trigger, detail=None, path=None):
             out_path = os.path.join(
                 _dump_dir, f'flight-{trigger}-{report["seq"]}.json')
         else:
-            _stats['dumps_suppressed'] += 1
+            _stats.inc('dumps_suppressed')
             report['suppressed'] = True
     if out_path is not None:
         with open(out_path, 'w') as f:

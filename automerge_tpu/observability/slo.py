@@ -62,7 +62,7 @@ import collections
 
 from . import hist as _hist
 from . import recorder as _flight
-from .metrics import register_health_source
+from .metrics import Counters, register_health_source
 
 __all__ = ['SloPolicy', 'SloRegistry', 'outcome_class', 'slo_stats',
            'DEFAULT_POLICIES', 'AVAILABILITY_CLASSES']
@@ -72,12 +72,12 @@ __all__ = ['SloPolicy', 'SloRegistry', 'outcome_class', 'slo_stats',
 # default — they are the CLIENT's bytes or a typed retry exhaustion)
 AVAILABILITY_CLASSES = ('throttled', 'overloaded', 'deadline')
 
-_stats = {
+_stats = Counters({
     'slo_alerts_fired': 0,       # alert activations (monotonic)
     'slo_alerts_cleared': 0,     # alert deactivations (monotonic)
     'slo_alerts_active': 0,      # currently-firing alerts (gauge)
     'slo_ticks': 0,              # registry evaluation ticks (monotonic)
-}
+})
 for _key in _stats:
     register_health_source(_key, lambda k=_key: _stats[k])
 
@@ -564,8 +564,8 @@ class SloRegistry:
         for key in [k for k in pair.alerts if k[0] not in live]:
             alert = pair.alerts.pop(key)
             if alert.active:
-                _stats['slo_alerts_cleared'] += 1
-                _stats['slo_alerts_active'] -= 1
+                _stats.inc('slo_alerts_cleared')
+                _stats.inc('slo_alerts_active', -1)
                 self.alert_log.append((self.ticks, tenant, kind, key[0],
                                        key[1], 'clear', 0.0))
         if not any(a.active for a in pair.alerts.values()):
@@ -647,7 +647,7 @@ class SloRegistry:
         (see _Window.push) — so the steady-state tick is O(talkers),
         independent of the tenant universe and of request volume."""
         self.ticks += 1
-        _stats['slo_ticks'] += 1
+        _stats.inc('slo_ticks')
         if not self._tick_windows:
             self._dirty.clear()
             return
@@ -785,14 +785,14 @@ class SloRegistry:
     def _transition(self, tenant, kind, sli, window, edge, burn):
         pair = self._pairs[(tenant, kind)]
         if edge == 'fire':
-            _stats['slo_alerts_fired'] += 1
-            _stats['slo_alerts_active'] += 1
+            _stats.inc('slo_alerts_fired')
+            _stats.inc('slo_alerts_active')
             # a firing pair joins the per-tick evaluation set: its clear
             # hysteresis must decay even if the tenant goes silent
             self._alerting.add((tenant, kind))
         else:
-            _stats['slo_alerts_cleared'] += 1
-            _stats['slo_alerts_active'] -= 1
+            _stats.inc('slo_alerts_cleared')
+            _stats.inc('slo_alerts_active', -1)
             if not any(a.active for a in pair.alerts.values()):
                 self._alerting.discard((tenant, kind))
         self.alert_log.append((self.ticks, tenant, kind, sli, window,

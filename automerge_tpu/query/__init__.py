@@ -32,16 +32,16 @@ and forensic flight-recorder dumps on invalid cursors / unknown heads.
 BASELINE.md "Query contract" states the full semantics.
 """
 
-from ..observability.metrics import register_health_source
+from ..observability.metrics import Counters, register_health_source
 
-_stats = {
+_stats = Counters({
     'timetravel_reads': 0,         # materialized historical reads
     'subscription_pushes': 0,      # patch/resync events pushed
     'subscription_resyncs': 0,     # invalid-cursor full resyncs
     'subscription_diff_reuse': 0,  # diffs served from an equivalence class
     'unknown_heads': 0,            # typed UnknownHeads rejections
     'invalid_cursors': 0,          # typed InvalidCursor rejections
-}
+})
 for _key in _stats:
     register_health_source(_key, lambda k=_key: _stats[k])
 

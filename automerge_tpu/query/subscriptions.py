@@ -253,7 +253,7 @@ class SubscriptionHub:
                     event = memo[ckey]
                     if event is not None:
                         self.stats['diffs_reused'] += 1
-                        _stats['subscription_diff_reuse'] += 1
+                        _stats.inc('subscription_diff_reuse')
                 else:
                     event = self._class_diff(source, sub, invalid)
                     memo[ckey] = event
@@ -267,7 +267,7 @@ class SubscriptionHub:
                 events[sub.id] = event
                 sub.cursor = list(event['heads'])
                 self.stats['pushes'] += 1
-                _stats['subscription_pushes'] += 1
+                _stats.inc('subscription_pushes')
                 # freshness: this push catches the cursor up — its lag
                 # is the ticks since the subscriber was last at-frontier
                 lag = 0 if sub.fresh_tick is None \
@@ -293,8 +293,8 @@ class SubscriptionHub:
             # bogus/stale cursor: typed, resync from scratch — never a
             # wrong patch
             self.stats['resyncs'] += 1
-            _stats['subscription_resyncs'] += 1
-            _stats['unknown_heads'] += 1
+            _stats.inc('subscription_resyncs')
+            _stats.inc('unknown_heads')
             invalid.append({'subscriber': sub.id, 'key': repr(sub.key),
                             'error': type(exc).__name__,
                             'message': str(exc)[:200]})

@@ -93,7 +93,7 @@ def materialize_at_docs(sources, heads_list, fleet=None, deadline=None,
                 if getattr(exc, 'doc_index', None) is None:
                     exc.doc_index = i
                 if isinstance(exc, UnknownHeads):
-                    _stats['unknown_heads'] += 1
+                    _stats.inc('unknown_heads')
                 if not quarantine:
                     raise
                 errors[i] = DocError(i, 'select', exc)
@@ -145,7 +145,7 @@ def materialize_at_docs(sources, heads_list, fleet=None, deadline=None,
         if to_free:
             fleet_backend.free_docs(to_free)
     elapsed = time.perf_counter() - start
-    _stats['timetravel_reads'] += n
+    _stats.inc('timetravel_reads', n)
     _hist.record_value('materialize_at_s', elapsed, scale=1e9, unit='s')
     if quarantine:
         return handles, errors

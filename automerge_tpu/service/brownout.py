@@ -29,16 +29,16 @@ history is in the forensic dump.
 """
 
 from ..observability import recorder as _flight
-from ..observability.metrics import register_health_source
+from ..observability.metrics import Counters, register_health_source
 
 __all__ = ['BrownoutController', 'brownout_stats']
 
-_stats = {
+_stats = Counters({
     'brownout_escalations': 0,     # stage climbs (monotonic)
     'brownout_deescalations': 0,   # stage descents (monotonic)
     'brownout_stage': 0,           # current stage across controllers (gauge)
     'shed_sync_rounds': 0,         # stage-3 typed sheds (monotonic)
-}
+})
 for _key in _stats:
     register_health_source(_key, lambda k=_key: _stats[k])
 
@@ -111,9 +111,9 @@ class BrownoutController:
         old = self.stage
         self.stage = new_stage
         if new_stage > old:
-            _stats['brownout_escalations'] += 1
+            _stats.inc('brownout_escalations')
         else:
-            _stats['brownout_deescalations'] += 1
+            _stats.inc('brownout_deescalations')
         _stats['brownout_stage'] = new_stage
         self.transitions.append((old, new_stage, pressure))
         self._apply_stage(old)
@@ -147,4 +147,4 @@ class BrownoutController:
         return self.shed_priority if self.stage >= 3 else None
 
     def count_shed(self, n=1):
-        _stats['shed_sync_rounds'] += n
+        _stats.inc('shed_sync_rounds', n)

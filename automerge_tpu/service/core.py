@@ -58,9 +58,10 @@ from ..fleet import backend as fleet_backend
 from ..fleet.sync_driver import (generate_sync_messages_docs,
                                  receive_sync_messages_docs)
 from ..observability import hist as _hist
+from ..observability import perf as _perf
 from ..observability import recorder as _flight
 from ..observability import tracecontext as _trace
-from ..observability.metrics import register_health_source
+from ..observability.metrics import Counters, register_health_source
 from ..observability.slo import SloRegistry
 from ..observability.spans import on as _spans_on, span as _span
 from .admission import AdmissionController
@@ -71,7 +72,7 @@ from .deadline import Deadline
 __all__ = ['DocService', 'AsyncDocService', 'Session', 'Ticket',
            'service_stats']
 
-_stats = {
+_stats = Counters({
     'service_requests': 0,         # submitted (admitted) requests
     'service_completed': 0,        # tickets resolved ok
     'service_failed': 0,           # tickets resolved with a typed error
@@ -79,7 +80,7 @@ _stats = {
     'service_retries': 0,          # transient-fault retries scheduled
     'retry_budget_exhausted': 0,   # typed RetriesExhausted resolutions
     'sync_reconnects': 0,          # stalled sessions reset with backoff
-}
+})
 for _key in _stats:
     register_health_source(_key, lambda k=_key: _stats[k])
 
@@ -132,11 +133,11 @@ class Ticket:
         if error is not None:
             self.status = 'error'
             self.error = error
-            _stats['service_failed'] += 1
+            _stats.inc('service_failed')
         else:
             self.status = 'ok'
             self.result = result
-            _stats['service_completed'] += 1
+            _stats.inc('service_completed')
         latency = self.finished_at - self.submitted_at
         _hist.record_value('service_request_s', latency, scale=1e9,
                            unit='s')
@@ -385,7 +386,7 @@ class DocService:
             # tenant's availability budget all the same — account them
             # before the typed raise leaves the building
             raise self._slo_reject(session.tenant, kind, exc)
-        _stats['service_requests'] += 1
+        _stats.inc('service_requests')
         return ticket
 
     def _slo_reject(self, tenant, kind, exc):
@@ -413,6 +414,9 @@ class DocService:
             # one evaluation round per service tick: the SLO windows are
             # tick-denominated, like the brownout ladder's hysteresis
             self.slo.tick(now)
+        # the seam-perf observatory rides the same cadence: a no-op flag
+        # check unless perf.enable_baselines()/enable_observatory() ran
+        _perf.maybe_tick()
         return stats
 
     def _pump_inner(self, now):
@@ -456,7 +460,7 @@ class DocService:
                     f'{request.kind}: deadline exceeded by '
                     f'{late * 1e3:.2f}ms before dispatch',
                     deadline=request.deadline.at, late_by=late))
-                _stats['deadline_exceeded'] += 1
+                _stats.inc('deadline_exceeded')
                 stats['deadline_dropped'] += 1
                 continue
             if request.kind in ('sync', 'subscribe') and \
@@ -524,7 +528,7 @@ class DocService:
                     f'{request.kind}: deadline exceeded by '
                     f'{late * 1e3:.2f}ms before dispatch',
                     deadline=request.deadline.at, late_by=late))
-                _stats['deadline_exceeded'] += 1
+                _stats.inc('deadline_exceeded')
                 stats['deadline_dropped'] += 1
             else:
                 requeue.setdefault(request.session.tenant, []).append(
@@ -545,11 +549,11 @@ class DocService:
             request.attempts += 1
             request.not_before = now + delay
             self._delayed.append(request)
-            _stats['service_retries'] += 1
+            _stats.inc('service_retries')
             stats['retried'] += 1
             return
         if transient:
-            _stats['retry_budget_exhausted'] += 1
+            _stats.inc('retry_budget_exhausted')
             _flight.record_event('retry_exhausted',
                                  tenant=request.session.tenant,
                                  request_kind=request.kind,
@@ -676,7 +680,7 @@ class DocService:
             try:
                 return decode_cursor(payload)
             except InvalidCursor as exc:
-                _query_stats['invalid_cursors'] += 1
+                _query_stats.inc('invalid_cursors')
                 _flight.record_event('invalid_cursor',
                                      tenant=request.session.tenant,
                                      session=request.session.id,
@@ -752,8 +756,8 @@ class DocService:
                         event = {'kind': 'patch', 'changes': changes,
                                  'heads': heads}
                     except UnknownHeads as exc:
-                        _query_stats['subscription_resyncs'] += 1
-                        _query_stats['unknown_heads'] += 1
+                        _query_stats.inc('subscription_resyncs')
+                        _query_stats.inc('unknown_heads')
                         _flight.record_event(
                             'invalid_cursor', tenant=session.tenant,
                             session=session.id,
@@ -766,8 +770,8 @@ class DocService:
                                  'error': type(exc).__name__}
                     memo[ckey] = event
                 else:
-                    _query_stats['subscription_diff_reuse'] += 1
-                _query_stats['subscription_pushes'] += 1
+                    _query_stats.inc('subscription_diff_reuse')
+                _query_stats.inc('subscription_pushes')
                 session.sub_cursor = list(event['heads'])
                 if self.slo is not None:
                     # cursor lag in service ticks: how long this pull's
@@ -955,7 +959,7 @@ class DocService:
             session.sync_state = _init_sync_state()
             session._stall_rounds = 0
             session._reconnect_attempts += 1
-            _stats['sync_reconnects'] += 1
+            _stats.inc('sync_reconnects')
             _flight.record_event('sync_reconnect', session=session.id,
                                  tenant=session.tenant,
                                  attempt=session._reconnect_attempts)

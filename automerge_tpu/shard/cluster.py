@@ -57,6 +57,7 @@ acknowledged-write loss across kills, and post-quiet byte-identical
 convergence between every tenant's home and replica docs.
 """
 
+import threading
 import time
 
 from ..backend import get_change_by_hash, get_heads
@@ -69,8 +70,9 @@ from ..fleet.backend import DocFleet
 from ..fleet.storage import StorageEngine
 from ..fleet.sync_driver import (generate_sync_messages_docs,
                                  receive_sync_messages_docs)
+from ..observability import hist as _hist
 from ..observability import recorder as _flight
-from ..observability.metrics import register_health_source
+from ..observability.metrics import Counters, register_health_source
 from ..observability.spans import span as _span
 from ..service import DocService
 from ..service.backoff import Backoff, RetryBudgetPool
@@ -78,7 +80,11 @@ from .ring import HashRing
 
 __all__ = ['Shard', 'ShardRouter', 'RouterTicket', 'shard_stats']
 
-_stats = {
+# serializes shard_pump_s histogram records across pool pumps (see
+# Shard.pump); Counters have their own lock, Histogram.record does not
+_pump_hist_lock = threading.Lock()
+
+_stats = Counters({
     'shard_kills': 0,              # Shard.kill() crashes injected
     'shard_revives': 0,            # Shard.revive() restarts
     'shard_failovers': 0,          # lease expiries acted on
@@ -93,7 +99,7 @@ _stats = {
     'shard_degraded_acks': 0,      # applies acked with no replica copy
     'shard_ticks_slipped': 0,      # shard pumps that overran tick_budget_s
     'shard_scrub_mismatches': 0,   # anti-entropy frontier divergences found
-}
+})
 for _key in _stats:
     register_health_source(_key, lambda k=_key: _stats[k])
 
@@ -147,9 +153,17 @@ class Shard:
         with _span('shard_tick', shard=self.id):
             stats = self.service.pump(now=now)
         self.last_pump_s = time.perf_counter() - start
+        # the perf observatory's shard seam: pump seconds as a log2
+        # histogram, the signal PerfBaselines('shard_pump') judges.
+        # Recorded under a lock: pumps run CONCURRENTLY on the pool,
+        # and Histogram.record is a read-modify-write (one acquire per
+        # pump TICK, not per request — nothing the 2% budget sees)
+        with _pump_hist_lock:
+            _hist.record_value('shard_pump_s', self.last_pump_s,
+                               scale=1e9, unit='s')
         if budget_s is not None and self.last_pump_s > budget_s:
             self.ticks_slipped += 1
-            _stats['shard_ticks_slipped'] += 1
+            _stats.inc('shard_ticks_slipped')
         self.last_beat = tick
         return stats
 
@@ -159,7 +173,7 @@ class Shard:
         if not self.alive:
             return
         self.alive = False
-        _stats['shard_kills'] += 1
+        _stats.inc('shard_kills')
         _flight.record_event('shard_kill', shard=self.id)
 
     def revive(self):
@@ -170,7 +184,7 @@ class Shard:
             return
         self._build()
         self.alive = True
-        _stats['shard_revives'] += 1
+        _stats.inc('shard_revives')
         _flight.record_event('shard_revive', shard=self.id)
 
 
@@ -340,11 +354,16 @@ class ShardRouter:
         # leases, replication, migration, settlement — runs serially
         # after the barrier), so pumping them concurrently changes no
         # DOC/TICKET state outcome, only wall time. None/1 = serial.
-        # Caveat: module-global telemetry counters are unsynchronized
-        # dict increments, so concurrent pumps can undercount them —
-        # best-effort health numbers only; nothing the ack contract or
-        # the chaos audits read rides them (shard services run with
-        # slo=False, and the audits check hashes/bytes, not counters).
+        # Module-global telemetry COUNTERS are EXACT under the pool:
+        # every `_stats` family is an observability.Counters whose
+        # increments hold a shared lock across the read-add-write (the
+        # round-15 undercount caveat, retired — pinned by the
+        # pump_threads>1 hammer in tests/test_perf_obs.py). The
+        # shard_pump_s histogram takes a lock at its record site;
+        # other histograms recorded from inside concurrent pumps
+        # (service_tick_s, apply_batch_s — off unless observability is
+        # enabled) remain best-effort per-sample, which the perf
+        # baselines' window means tolerate.
         self._pool = None
         if pump_threads is not None and int(pump_threads) > 1:
             from concurrent.futures import ThreadPoolExecutor
@@ -494,7 +513,7 @@ class ShardRouter:
         """Mint a typed ``ShardUnavailable`` and count it — EVERY mint
         site goes through here so ``shard_unavailable`` matches the
         tickets that actually saw the error."""
-        _stats['shard_unavailable'] += 1
+        _stats.inc('shard_unavailable')
         return ShardUnavailable(message, shard=shard, tenant=tenant,
                                 retry_after=None)
 
@@ -509,7 +528,7 @@ class ShardRouter:
             req.not_before = now + delay
             req.state = 'parked'
             req.sub = None
-            _stats['shard_retries'] += 1
+            _stats.inc('shard_retries')
             return
         req.ticket._finish(self.ticks, error=error,
                            shard=self._tenants[req.tenant].home)
@@ -618,7 +637,7 @@ class ShardRouter:
         their replicas, re-place replicas that lived there, cancel
         migrations touching it."""
         self.alive.discard(dead)
-        _stats['shard_failovers'] += 1
+        _stats.inc('shard_failovers')
         _flight.record_event('shard_failover', shard=dead,
                              tick=self.ticks)
         moved = []
@@ -650,7 +669,7 @@ class ShardRouter:
                 rec.replica_on = None
                 rec.replica_handle = None
                 rec.needs_reset = True
-                _stats['shard_rehomed_sessions'] += 1
+                _stats.inc('shard_rehomed_sessions')
                 self._ensure_replica(rec)
                 moved.append(rec.name)
             elif rec.replica_on == dead:
@@ -700,7 +719,7 @@ class ShardRouter:
             active.append(rec)
         if not active:
             return
-        _stats['shard_repl_rounds'] += 1
+        _stats.inc('shard_repl_rounds')
         sent = {}
         with _span('shard_replication', pairs=len(active)):
             # generate, home side, grouped per home shard
@@ -766,7 +785,7 @@ class ShardRouter:
                             # corrupt wire bytes: contained to this doc,
                             # equivalent to a drop — the handshake
                             # re-sends through its own machinery
-                            _stats['shard_repl_quarantined'] += 1
+                            _stats.inc('shard_repl_quarantined')
                         sent[id(r)] = True
         # stall detection: TRAFFIC without head movement is the
         # loss-poisoned handshake (split heads = poisoned sentHashes;
@@ -790,7 +809,7 @@ class ShardRouter:
             rec.last_pair_heads = pair
             if rec.stall >= self.repl_stall_rounds:
                 rec._reset_pair()
-                _stats['shard_repl_resets'] += 1
+                _stats.inc('shard_repl_resets')
 
     def scrub_frontiers(self):
         """Anti-entropy head-frontier scrub (ROADMAP shard leftover):
@@ -822,7 +841,7 @@ class ShardRouter:
                 # every write into a false divergence event
                 continue
             found += 1
-            _stats['shard_scrub_mismatches'] += 1
+            _stats.inc('shard_scrub_mismatches')
             record = {'tick': self.ticks, 'tenant': rec.name,
                       'home': rec.home, 'replica': rec.replica_on,
                       'home_heads': len(home), 'replica_heads': len(rep)}
@@ -852,7 +871,7 @@ class ShardRouter:
             want = self.ring.primary(rec.name, alive=self.alive)
             if want is not None and want != rec.home:
                 rec.migrating = {'phase': 'readonly', 'to': want}
-                _stats['shard_rebalances'] += 1
+                _stats.inc('shard_rebalances')
                 started += 1
         return started
 
@@ -901,7 +920,7 @@ class ShardRouter:
             rec.migrating = None
             rec._reset_pair()
             self._ensure_replica(rec)
-            _stats['shard_migrations'] += 1
+            _stats.inc('shard_migrations')
             _flight.record_event('shard_migration', tenant=rec.name,
                                  dst=rec.home, tick=self.ticks)
 
@@ -950,7 +969,7 @@ class ShardRouter:
                 self._settle_replica_wait(req, rec, now)
                 return
             if req.kind == 'apply':
-                _stats['shard_degraded_acks'] += 1
+                _stats.inc('shard_degraded_acks')
             self._resolve_ok(req, rec)
             return
         err = sub.error
@@ -997,7 +1016,7 @@ class ShardRouter:
                 shard=req.home_at_submit, tenant=req.tenant), now)
             return
         if rec.replica_handle is None:
-            _stats['shard_degraded_acks'] += 1
+            _stats.inc('shard_degraded_acks')
             self._resolve_ok(req, rec)
             return
         if self.shards[rec.replica_on].alive and \

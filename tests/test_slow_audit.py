@@ -57,6 +57,21 @@ GRANDFATHER_BUDGETS = {
     'tests/test_service_chaos.py::test_service_chaos_smoke': 10.0,
     'tests/test_durability.py::test_crashtest_smoke': 10.0,
     'tests/test_fuzz_wire.py::test_fuzz_wire_smoke': 10.0,
+    # ISSUE-13 perf-observatory family: the atomic-counter hammer (6
+    # threads x 10k locked incs, measured ~2s isolated) and the torn-
+    # read `_sum` exposition hammer (writer thread + 50 scrapes,
+    # measured ~2.2s) — budgeted at ~4x for full-suite contention on
+    # this 2-core box
+    'tests/test_perf_obs.py::TestAtomicCounters::'
+    'test_inc_exact_under_hammer': 10.0,
+    'tests/test_export.py::test_sum_consistent_under_concurrent_'
+    'recording': 10.0,
+    # measured 0.35s isolated (0.22s at the prior tree — the family's
+    # cost is unchanged) but observed at 10.3s under full-suite
+    # contention on this box (round-17 run) — the same contention
+    # class as test_chaos_checkpoint_crash_recover above; budgeted off
+    # the contended worst case
+    'tests/test_service.py::test_brownout_widen_fsync_and_restore': 15.0,
 }
 
 

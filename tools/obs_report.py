@@ -9,6 +9,17 @@ Usage:
                                [-o stitched_trace.json]
     python tools/obs_report.py --stitch shard0=a.json shard1=b.json
     python tools/obs_report.py --metrics metrics_snapshot.prom
+    python tools/obs_report.py --floor kernel_ledger.json [trace.json]
+    python tools/obs_report.py --trajectory [BENCH_LEDGER.jsonl]
+
+Floor mode renders the RESIDUAL-FLOOR table the ROADMAP used to carry
+as a hand-measured note: per device-kernel-kind dispatch counts,
+blocking wall time, and XLA cost_analysis (flops / bytes accessed /
+achieved GB/s) from a ``perf.dump_ledger`` JSON, beside the host
+phases of an optional span trace — so "native parse vs scatter
+dispatch vs host phases" reads from live data
+(``observability.perf.instrument_kernel`` wraps every jitted entry
+point; the bench ``perf`` section writes the ledger dump).
 
 Metrics mode reads a Prometheus exposition page (a MetricsExporter
 ``write_snapshot`` file or a curl'd /metrics body) and surfaces the
@@ -136,7 +147,7 @@ def attribution(events):
     return rows, wall
 
 
-def render_trace(path, out=sys.stdout):
+def render_trace(path, out=None):
     events = load_events(path)
     rows, wall = attribution(events)
     print(f'# {path}: {len(events)} spans, wall {wall / 1000.0:.2f} ms',
@@ -251,7 +262,7 @@ def stitch(paths, out_path=None):
     return events, shared, truncated
 
 
-def render_stitch(paths, out_path, out=sys.stdout):
+def render_stitch(paths, out_path, out=None):
     events, shared, truncated = stitch(paths, out_path)
     spans = [e for e in events if e.get('ph') == 'X']
     print(f'# stitched {len(paths)} peers: {len(spans)} spans'
@@ -276,7 +287,7 @@ def render_stitch(paths, out_path, out=sys.stdout):
     return shared
 
 
-def render_flight(path, baseline=None, out=sys.stdout):
+def render_flight(path, baseline=None, out=None):
     with open(path) as f:
         report = json.load(f)
     print(f'# flight record: trigger={report.get("trigger")!r} '
@@ -320,7 +331,7 @@ def render_flight(path, baseline=None, out=sys.stdout):
     return report
 
 
-def render_metrics(path, out=sys.stdout):
+def render_metrics(path, out=None):
     """Pretty-print a Prometheus exposition page (a MetricsExporter
     ``write_snapshot`` file, or anything curl'd from /metrics): the
     shard-labeled operational counters first — per-shard slipped ticks
@@ -359,10 +370,78 @@ def render_metrics(path, out=sys.stdout):
     return 0
 
 
+def render_floor(ledger_path, trace_path=None, out=None):
+    """The residual-floor table: device kernels (cost ledger) and,
+    when a trace is given, the host phases they compete with."""
+    out = out if out is not None else sys.stdout
+    with open(ledger_path) as f:
+        dump = json.load(f)
+    kernels = dump.get('kernels', {})
+    print(f'# device-kernel cost ledger ({ledger_path}):', file=out)
+    if not kernels:
+        print('  (no dispatches recorded — was the ledger enabled? '
+              'perf.enable_ledger() / enable_observatory())', file=out)
+    else:
+        # "host ms" = host-blocking wall (execution on the sync CPU
+        # backend; enqueue time on async devices — perf.py caveat)
+        print(f'  {"kernel":<30}{"disp":>6}{"host ms":>10}'
+              f'{"ms/disp":>9}{"MFLOP":>9}{"MB acc":>9}{"GB/s":>7}',
+              file=out)
+        rows = sorted(kernels.items(),
+                      key=lambda kv: -kv[1].get('seconds', 0.0))
+        for kind, row in rows:
+            disp = row.get('dispatches', 0)
+            wall = row.get('seconds', 0.0) * 1000.0
+            flops = row.get('flops_total')
+            acc = row.get('bytes_accessed_total')
+            gbs = row.get('gbytes_per_s')
+            print(f'  {kind:<30}{disp:>6}{wall:>10.2f}'
+                  f'{wall / max(disp, 1):>9.3f}'
+                  f'{(flops or 0) / 1e6:>9.2f}'
+                  f'{(acc or 0) / 1e6:>9.2f}'
+                  f'{gbs if gbs is not None else 0:>7.2f}', file=out)
+        errors = [(kind, sig['cost']['error'])
+                  for kind, row in kernels.items()
+                  for sig in row.get('signatures', ())
+                  if 'error' in (sig.get('cost') or {})]
+        for kind, err in errors:
+            print(f'  # {kind}: cost_analysis unavailable ({err})',
+                  file=out)
+    if trace_path:
+        print(f'# host phases beside them ({trace_path}):', file=out)
+        events = load_events(trace_path)
+        rows, wall = attribution(events)
+        for name, n, tot, wall_n, mean, mx, pct in rows[:12]:
+            print(f'  {name:<30}{n:>6}{tot / 1000.0:>10.2f} ms cpu '
+                  f'({pct:>5.1f}% of wall)', file=out)
+    mem = dump.get('watermarks')
+    if mem:
+        print('# memory watermarks (bytes, current / high):', file=out)
+        for tier in sorted(mem.get('current', {})):
+            cur = mem['current'][tier]
+            high = mem.get('high', {}).get(tier, cur)
+            print(f'  {tier:<30}{cur:>14,} / {high:,}', file=out)
+    return 0
+
+
 def main(argv):
     if not argv or argv[0] in ('-h', '--help'):
         print(__doc__.strip())
         return 2
+    if argv[0] == '--floor':
+        if len(argv) < 2:
+            print('--floor needs a kernel-ledger JSON path '
+                  '(perf.dump_ledger / bench perf section)',
+                  file=sys.stderr)
+            return 2
+        return render_floor(argv[1], argv[2] if len(argv) > 2 else None)
+    if argv[0] == '--trajectory':
+        # the bench-ledger trajectory, from the observability front door
+        # (implementation lives in tools/bench_ledger.py)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import bench_ledger
+        return bench_ledger.render_trajectory(
+            argv[1] if len(argv) > 1 else None)
     if argv[0] == '--metrics':
         if len(argv) < 2:
             print('--metrics needs an exposition-file path',
