@@ -201,6 +201,15 @@ class HashGraph:
         re-decoding every buffer per round (the reference's own TODO at
         sync.js:378) dominated fleet-scale sync profiles. get_changes is
         a buffer lookup over this (single copy of the traversal)."""
+        if have_deps and sorted(have_deps) == sorted(self.heads):
+            # have_deps IS the current frontier: every change is an
+            # ancestor of it, so the delta is empty BY DEFINITION — a
+            # heads compare, no graph walk, and crucially no _ensure_graph
+            # (a freshly loaded doc answering a converged handshake would
+            # otherwise build its whole O(history) dict set to learn
+            # "nothing since lastSync"). The quiet steady state of every
+            # sync/replication round lands here.
+            return []
         self._ensure_graph()
 
         def ordered_hashes():

@@ -261,6 +261,25 @@ def _cached_meta(change):
     return meta
 
 
+def known_hash_flags(backend, hashes):
+    """Membership of `hashes` in the backend's APPLIED history — the one
+    helper behind theirHave lastSync reconciliation and received-heads
+    lookup. A fleet document whose frontier index is warm
+    (fleet/hashindex.py — registered by a batched sync round) answers
+    from the index without ever touching the hash-graph dicts; every
+    other backend takes the classic get_change_by_hash path. Both
+    answers are exact and identical (the equivalence tests pin it)."""
+    if not hashes:
+        return []
+    state = backend.get('state') if isinstance(backend, dict) else None
+    probe = getattr(state, 'probe_hashes', None)
+    if probe is not None:
+        flags = probe(hashes)
+        if flags is not None:
+            return [bool(f) for f in flags]
+    return [get_change_by_hash(backend, h) is not None for h in hashes]
+
+
 def make_bloom_filter(backend, last_sync):
     """Bloom filter over changes applied since `last_sync` (ref sync.js:234-238)."""
     from . import get_change_hashes
@@ -393,7 +412,7 @@ def generate_sync_message(backend, sync_state):
     # (e.g. peer crashed without persisting; ref sync.js:352-362)
     if their_have:
         last_sync = their_have[0]['lastSync']
-        if not all(get_change_by_hash(backend, h) is not None for h in last_sync):
+        if not all(known_hash_flags(backend, last_sync)):
             reset = {'heads': our_heads, 'need': [],
                      'have': [{'lastSync': [], 'bloom': b''}], 'changes': []}
             return [sync_state, encode_sync_message(reset)]
@@ -453,8 +472,10 @@ def receive_sync_message(backend, old_sync_state, binary_message):
     if not message['changes'] and message['heads'] == before_heads:
         last_sent_heads = message['heads']
 
-    known_heads = [h for h in message['heads']
-                   if get_change_by_hash(backend, h) is not None]
+    known_heads = [h for h, known in
+                   zip(message['heads'],
+                       known_hash_flags(backend, message['heads']))
+                   if known]
     if len(known_heads) == len(message['heads']):
         shared_heads = message['heads']
         # Remote peer lost all its data: reset for a full resync

@@ -21,9 +21,12 @@ from .sequence import (SeqState, SeqOpBatch, SeqEncoder, apply_seq_batch,
 from .sync_driver import (generate_sync_messages_docs,
                           receive_sync_messages_docs)
 from .loader import load_docs
+from .hashindex import (HashIndex, FleetFrontierIndex, frontier_compare,
+                        hashes_to_rows)
 
 __all__ = [
     'load_docs',
+    'HashIndex', 'FleetFrontierIndex', 'frontier_compare', 'hashes_to_rows',
     'FleetState', 'OpBatch', 'TOMBSTONE', 'pack_op_id', 'unpack_op_id',
     'apply_op_batch', 'fleet_merge',
     'build_bloom_filters', 'probe_bloom_filters', 'bloom_filter_bytes',

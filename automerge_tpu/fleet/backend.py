@@ -328,6 +328,10 @@ class DocFleet:
         self.key_cap = key_capacity
         self.n_slots = 0
         self.free_slots = []
+        # bumped by every free_slots_batch: slot-indexed caches outside
+        # the fleet (the subscription hub's frontier-scan plan) key on it
+        # so a freed/recycled slot can never serve a stale row
+        self.free_epoch = 0
         self.pending = []         # (slot, [change buffers])
         self.pending_actors = set()
         # Struct-of-arrays doc state (heads/clock/max_op/stale/...): the
@@ -371,6 +375,21 @@ class DocFleet:
         # through it, so sync rounds and batched applies are crash-durable
         # without callers doing anything per call.
         self.journal = None
+        # Device-resident frontier index (fleet/hashindex.py): exact
+        # (slot, change-hash) membership for the sync plane. Created
+        # lazily by the first batched sync round (frontier_index());
+        # while None the commit seams pay a single attribute check.
+        self._hash_index = None
+
+    def frontier_index(self, create=True, **kwargs):
+        """The fleet's FleetFrontierIndex (fleet/hashindex.py), created
+        on first use. The commit seams stage every accepted change hash
+        into it host-side once it exists; sync rounds flush + probe in
+        one dispatch each."""
+        if self._hash_index is None and create:
+            from .hashindex import FleetFrontierIndex
+            self._hash_index = FleetFrontierIndex(self, **kwargs)
+        return self._hash_index
 
     def _cap_docs(self, n_docs):
         """Doc-capacity sizing shared by the grid and register allocators:
@@ -505,6 +524,11 @@ class DocFleet:
                     seg.rowmap.pop(slot, None)
             self._pend_seams = [s for s in self._pend_seams if s.rowmap]
         self._index_consolidate()
+        if self._hash_index is not None:
+            # release the slots' membership spaces (and purge staged
+            # rows) so a recycled slot never inherits its previous
+            # tenant's change hashes
+            self._hash_index.drop_slots(slots)
         seq_zero = []
         for slot in slots:
             eng = self._engines.pop(slot, None)
@@ -534,6 +558,7 @@ class DocFleet:
         if seq_zero:
             self._zero_seq_rows(seq_zero)
         self.free_slots.extend(slots)
+        self.free_epoch += 1
 
     def _fold_all_pending(self):
         """Fold every doc's pending turbo-commit segments into the real
@@ -2309,6 +2334,12 @@ class _FlatEngine(HashGraph):
         decoded history dropped. Causal state (heads/clock/max_op/
         actor_ids) is NOT touched; callers own it."""
         from .loader import _DocDeferredBatch
+        ix = self.fleet._hash_index
+        if ix is not None:
+            # the slot's history representation is being replaced
+            # wholesale; drop its membership space (a later sync round
+            # re-registers and backfills from the chunk's hash lanes)
+            ix.drop_slots([self.slot])
         self._changes = []
         self._doc_pending = chunk
         self._doc_decoded = None
@@ -2400,6 +2431,38 @@ class _FlatEngine(HashGraph):
         if self._deferred:
             self.fleet.metrics.graph_builds += 1
         super()._ensure_graph()
+
+    # Frontier-index maintenance (fleet/hashindex.py): every path that
+    # lands an APPLIED change on this engine stages its hash — the
+    # general/exact paths per change here, the turbo fast path as one
+    # vectorized batch in the commit. One attribute check when no index
+    # exists.
+
+    def _record_applied(self, change):
+        super()._record_applied(change)
+        ix = self.fleet._hash_index
+        if ix is not None:
+            ix.stage_one(self.slot, change['hash'])
+
+    def _defer_record(self, change):
+        super()._defer_record(change)
+        ix = self.fleet._hash_index
+        if ix is not None:
+            ix.stage_one(self.slot, change['hash'])
+
+    def probe_hashes(self, hashes):
+        """Exact membership flags for `hashes` from the fleet's frontier
+        index, or None when this doc has no WARM index space (the
+        single-doc protocol path must not pay a surprise history
+        backfill — the batched driver registers; until then the caller's
+        dict path serves) or routing is disabled
+        (AUTOMERGE_TPU_FRONTIER_INDEX=0 must pin the classic path on
+        EVERY consumer, not just the batched driver)."""
+        from .hashindex import frontier_enabled
+        ix = self.fleet._hash_index
+        if ix is None or not ix.registered(self) or not frontier_enabled():
+            return None
+        return ix.probe_pairs([self] * len(hashes), list(hashes))
 
     def apply_changes(self, change_buffers, is_local=False):
         self.fleet.metrics.exact_calls += 1
@@ -2947,6 +3010,12 @@ class FleetDoc:
 
     def get_change_by_hash(self, hash):
         return self._impl.get_change_by_hash(hash)
+
+    def probe_hashes(self, hashes):
+        """Frontier-index membership flags (see _FlatEngine.probe_hashes);
+        None after promotion or while the index is cold."""
+        probe = getattr(self._impl, 'probe_hashes', None)
+        return probe(hashes) if probe is not None else None
 
     def get_missing_deps(self, heads=()):
         return self._impl.get_missing_deps(heads)
@@ -3787,6 +3856,27 @@ def _dump_quarantine_record(handles, errors):
     _flight.dump_flight_record('quarantine', detail)
 
 
+class _LazyHandle(dict):
+    """A backend handle whose 'heads' hexes LAZILY from the head32 row
+    captured at commit time (dict ``__missing__``): the turbo fast path
+    stopped materializing hex head strings per doc (the residual-floor
+    fix), so a handle nobody asks for heads never pays the decode. The
+    row is captured by VALUE at commit, so a stale handle still answers
+    with its own generation's frontier exactly like the eager dict did.
+    Every dict operation real callers use (['state'], ['heads'],
+    .get('frozen'), item assignment, isinstance(..., dict)) behaves
+    identically."""
+
+    __slots__ = ('_head32',)
+
+    def __missing__(self, key):
+        if key == 'heads':
+            value = [self._head32.tobytes().hex()]
+            self['heads'] = value
+            return value
+        raise KeyError(key)
+
+
 class _TurboMetaBatch:
     """Raw per-change metadata from the native parser, with lazy hex/dict
     materialization: the fast path touches only numpy arrays; full dicts are
@@ -4155,10 +4245,14 @@ def _apply_changes_turbo_inner(handles, per_doc_changes, ps, parsed=None):
     if len(kept_packed_nat):
         kept_doc = change_doc[kept_change]
         pairs = kept_doc * (1 << 32) + kept_packed_nat
-        uniq_pairs, pair_counts = np.unique(pairs, return_counts=True)
-        if len(uniq_pairs) != len(pairs):
+        # run-boundary dup check (the trick staging uses): one sort and
+        # an adjacent-equality scan — np.unique(return_counts=True) paid
+        # for the unique array and a reduceat nobody read
+        pairs_sorted = np.sort(pairs)
+        dup = pairs_sorted[1:] == pairs_sorted[:-1]
+        if dup.any():
             restore_all()
-            bad_doc = int(uniq_pairs[pair_counts > 1][0] >> 32)
+            bad_doc = int(pairs_sorted[1:][dup][0] >> 32)
             raise DuplicateOpId('duplicate operation ID in turbo batch',
                                 doc_index=bad_doc)
 
@@ -4208,20 +4302,18 @@ def _apply_changes_turbo_inner(handles, per_doc_changes, ps, parsed=None):
     fast_ne = np.flatnonzero(fast_mask & nonempty)
     # ---- Columnar commit: the whole fast-doc batch lands as vectorized
     # scatters into the _DocCols struct-of-arrays — no per-doc Python.
-    # Head frontier: binary rows straight from the parser's hash lanes,
-    # hex strings decoded in ONE numpy pass (S64 -> U64 view of the
-    # single .hex() string), per-doc lists built by one comprehension.
+    # Head frontier: binary rows straight from the parser's hash lanes;
+    # hex strings are NOT materialized here (the residual-floor fix) —
+    # the heads property's per-row memo hexes on first genuine access,
+    # and the returned handles capture their head32 row for the same
+    # lazy treatment (_LazyHandle).
     frows = erows[fast_ne]
     last_idx = (starts_all + doc_counts - 1)[fast_ne]
-    head_hex_all = hash32[last_idx].tobytes().hex()
-    cols.head32[frows] = hash32[last_idx]
+    head_rows = hash32[last_idx]
+    cols.head32[frows] = head_rows
     cols.head_n[frows] = 1
-    hex_strs = np.frombuffer(head_hex_all.encode('ascii'),
-                             dtype='S64').astype('U64').tolist()
-    cols.head_hex[frows] = hex_strs
-    head_lists = np.empty(len(fast_ne), dtype=object)
-    head_lists[:] = [[s] for s in hex_strs]
-    cols.head_obj[frows] = head_lists
+    cols.head_hex[frows] = None
+    cols.head_obj[frows] = None
     cols.maxop[frows] = np.maximum(cols.maxop[frows], doc_max[fast_ne])
     cols.stale[frows] = True
     cols.bindoc[frows] = None
@@ -4249,6 +4341,13 @@ def _apply_changes_turbo_inner(handles, per_doc_changes, ps, parsed=None):
     fleet._pend_seams.append(seg)
     if len(fleet._pend_seams) > _SEAM_FOLD_LIMIT:
         fleet._fold_all_pending()
+    if fleet._hash_index is not None and len(fast_ne):
+        # frontier-index staging for the whole fast batch: a host-side
+        # numpy append of the parser's hash lanes (no dispatch here —
+        # the next sync probe flushes). Staged/slow docs stage per
+        # change via the _defer_record override below.
+        fsel = fast_mask[doc_of]
+        fleet._hash_index.stage_rows(erows[doc_of[fsel]], hash32[fsel])
     # Clock advance: the gate kernel's per-(doc, actor) groups scatter
     # their final seqs into the clock lanes. Rows already in dict mode,
     # or overflowing the lane width this batch, take the counted
@@ -4333,15 +4432,22 @@ def _apply_changes_turbo_inner(handles, per_doc_changes, ps, parsed=None):
 
     for handle in handles:
         handle['frozen'] = True
-    # Fast docs' head lists come straight from the commit scatter (the
-    # same list objects the columns memoize) — no per-doc property-chain
-    # reads; only slow/empty docs consult their engines.
-    heads_out = np.empty(len(handles), dtype=object)
-    heads_out[fast_ne] = head_lists
-    for d in np.flatnonzero(~(fast_mask & nonempty)).tolist():
-        heads_out[d] = engines[d].heads
-    out_handles = [{'state': handle['state'], 'heads': h}
-                   for handle, h in zip(handles, heads_out.tolist())]
+    # Fast docs' handles capture their post-commit head32 ROW and hex it
+    # only when someone reads 'heads' (_LazyHandle.__missing__) — the
+    # commit fast path serves the handle contract with zero hex
+    # materializations; slow/empty docs consult their engines eagerly
+    # (few, and their memos are already warm).
+    fast_pos = {int(d): k for k, d in enumerate(fast_ne.tolist())}
+    out_handles = []
+    for d, handle in enumerate(handles):
+        k = fast_pos.get(d)
+        if k is None:
+            out_handles.append({'state': handle['state'],
+                                'heads': engines[d].heads})
+        else:
+            lazy = _LazyHandle(state=handle['state'])
+            lazy._head32 = head_rows[k]
+            out_handles.append(lazy)
     result = out_handles, [None] * len(handles)
     if not keep.any():
         return result            # everything queued: no device work

@@ -108,12 +108,17 @@ def sync_round_sharded(mesh, axis, backends, sync_states, generate, receive):
     payload matrix rides ONE all_to_all, and `receive(dst, src, payload)`
     applies what arrived. Returns the number of non-empty payloads moved."""
     n = mesh.shape[axis]
+    row_fn = getattr(generate, 'row', None)
     rows, row_lens = [], []
     for src in range(n):
-        payloads = []
-        for dst in range(n):
-            msg = generate(src, dst) if dst != src else None
-            payloads.append(msg or b'')
+        if row_fn is not None:
+            # one batched generate per shard (single Bloom build +
+            # frontier-index membership dispatch) instead of one per
+            # ordered pair — byte-identical messages either way
+            payloads = [m or b'' for m in row_fn(src, range(n))]
+        else:
+            payloads = [(generate(src, dst) or b'') if dst != src
+                        else b'' for dst in range(n)]
         data, lens = pack_outboxes(payloads)
         rows.append(data)
         row_lens.append(lens)
@@ -142,13 +147,47 @@ def _pairwise_callbacks(docs, sync_states, backend_module):
     """(generate, receive) closures over a docs container (list indexed by
     shard, or dict keyed by global shard id) and per-ordered-pair sync
     states — THE sync-state handshake, shared by the single-controller
-    and multi-controller drivers so it cannot drift between them."""
+    and multi-controller drivers so it cannot drift between them.
+
+    ``generate.row(src, dsts)`` produces ALL of src's outgoing messages
+    for one round through the batched fleet driver when the backend
+    module is the fleet (ONE Bloom build + ONE frontier-index membership
+    dispatch per shard instead of one of each per ordered pair — the
+    per-peer scan the round used to pay); byte-identical to the per-pair
+    calls (the driver's differential tests pin it), and host backend
+    modules simply take the per-pair path."""
 
     def generate(src, dst):
         state, msg = backend_module.generate_sync_message(
             docs[src], sync_states[(src, dst)])
         sync_states[(src, dst)] = state
         return msg
+
+    # batch through the fleet driver ONLY when the module's generate IS
+    # the canonical protocol (host Backend and fleet.backend both
+    # re-export it; a third-party backend module keeps per-pair calls)
+    from ..backend.sync import generate_sync_message as _canonical
+    if getattr(backend_module, 'generate_sync_message', None) \
+            is _canonical:
+        from .sync_driver import generate_sync_messages_docs as \
+            batched_gen
+    else:
+        batched_gen = None
+
+    def generate_row(src, dsts):
+        if batched_gen is None:
+            return [generate(src, dst) if dst != src else None
+                    for dst in dsts]
+        peers = [dst for dst in dsts if dst != src]
+        new_states, msgs = batched_gen(
+            [docs[src]] * len(peers),
+            [sync_states[(src, dst)] for dst in peers])
+        for dst, state in zip(peers, new_states):
+            sync_states[(src, dst)] = state
+        by_dst = dict(zip(peers, msgs))
+        return [by_dst.get(dst) for dst in dsts]
+
+    generate.row = generate_row
 
     def receive(dst, src, payload):
         doc, state, _patch = backend_module.receive_sync_message(
@@ -236,11 +275,15 @@ def _sync_round_multihost(mesh, axis, generate, receive, max_msg,
                           max_chunks):
     n = mesh.shape[axis]
     mine = local_shard_ids(mesh, axis)
+    row_fn = getattr(generate, 'row', None)
     per_src = []
     biggest = sent = 0
     for src in mine:
-        payloads = [generate(src, dst) or b'' if dst != src else b''
-                    for dst in range(n)]
+        if row_fn is not None:
+            payloads = [m or b'' for m in row_fn(src, range(n))]
+        else:
+            payloads = [generate(src, dst) or b'' if dst != src else b''
+                        for dst in range(n)]
         biggest = max(biggest, max(map(len, payloads)))
         sent += sum(1 for p in payloads if p)
         per_src.append(payloads)

@@ -28,9 +28,12 @@ Wire format, resets, and the dependents-closure repair of Bloom false
 positives are unchanged — graph traversal stays host-side (SURVEY.md §2.11).
 """
 
+import hashlib
+
 from ..backend import (
     get_heads, get_missing_deps, get_change_by_hash, get_change_hashes,
 )
+from ..columnar import CHUNK_TYPE_CHANGE, MAGIC_BYTES as _MAGIC
 from ..backend.sync import (
     _cached_meta, advance_heads, changes_to_send_finish,
     changes_to_send_prescan, decode_sync_message, encode_sync_message,
@@ -39,7 +42,7 @@ from ..errors import DocError, MalformedSyncMessage, as_wire_error
 from ..observability import recorder as _flight
 from ..observability import tracecontext as _trace
 from ..observability.spans import span as _span
-from .backend import apply_changes_docs, quarantine_stats
+from .backend import FleetDoc, apply_changes_docs, quarantine_stats
 from .bloom import (
     build_bloom_filters_batch_begin, build_bloom_filters_batch_finish,
     dispatch_count, probe_bloom_filters_batch_begin,
@@ -49,6 +52,92 @@ from .bloom import (
 __all__ = ['generate_sync_messages_docs', 'receive_sync_messages_docs',
            'generate_sync_messages_mixed', 'receive_sync_messages_mixed',
            'dispatch_count']
+
+
+# the enable flag lives in hashindex so the single-doc protocol path
+# (backend/sync.py -> _FlatEngine.probe_hashes) honors the same toggle
+from .hashindex import frontier_enabled, set_frontier_enabled  # noqa: E402,F401
+
+
+def _frontier_of(backends):
+    """(FleetFrontierIndex, [engine]) when every backend is a live fleet
+    document on ONE fleet — the condition under which the round's
+    membership probes (theirHave lastSync reconciliation, received-heads
+    lookup, incoming-change dedup) ride the device-resident frontier
+    index as batched dispatches instead of per-doc host-dict probes
+    (fleet/hashindex.py). None for host backends / mixed fleets: those
+    keep the classic dict path."""
+    if not frontier_enabled():
+        return None
+    engines = []
+    fleet = None
+    for backend in backends:
+        state = backend.get('state') if isinstance(backend, dict) else None
+        if not isinstance(state, FleetDoc) or not state.is_fleet:
+            return None
+        engine = state._impl
+        if fleet is None:
+            fleet = engine.fleet
+        elif engine.fleet is not fleet:
+            return None
+        engines.append(engine)
+    if fleet is None:
+        return None
+    return fleet.frontier_index(), engines
+
+
+def _probe_pairs_grouped(frontier, engines, per_doc_hashes):
+    """Batch N docs' membership questions into ONE index probe:
+    per_doc_hashes[i] is a (possibly empty) list of hex hashes for
+    engines[i]. Returns {i: [bool, ...]} aligned with each doc's list
+    (docs with no hashes are omitted)."""
+    flat_e, flat_h, owners = [], [], []
+    for i, hashes in enumerate(per_doc_hashes):
+        for h in hashes:
+            flat_e.append(engines[i])
+            flat_h.append(h)
+            owners.append(i)
+    if not flat_h:
+        return {}
+    hits = frontier.probe_pairs(flat_e, flat_h)
+    out = {}
+    for i, hit in zip(owners, hits):
+        out.setdefault(i, []).append(bool(hit))
+    return out
+
+
+def _batched_generate_probes(frontier, sync_states):
+    """The generate round's TWO membership questions — get_missing_deps
+    candidates (the peer's advertised heads plus deps of causally-queued
+    changes) and the theirHave lastSync reconciliation — merged into ONE
+    index dispatch. Returns (our_need, reset_known): our_need[i] exactly
+    matches backend.get_missing_deps (the equivalence tests pin it);
+    reset_known[i] is all-lastSync-hashes-known, defaulting True for
+    docs with nothing to check."""
+    fidx, engines = frontier
+    cands, queued, last_syncs = [], [], []
+    for engine, state in zip(engines, sync_states):
+        all_deps = set(state['theirHeads'] or [])
+        in_queue = set()
+        for change in engine.queue:
+            in_queue.add(change['hash'])
+            all_deps.update(change['deps'])
+        cands.append(sorted(all_deps))
+        queued.append(in_queue)
+        their_have = state['theirHave']
+        last_syncs.append(their_have[0]['lastSync'] if their_have else [])
+    hits = _probe_pairs_grouped(
+        fidx, engines,
+        [cand + ls for cand, ls in zip(cands, last_syncs)])
+    our_need, reset_known = [], {}
+    for i, cand in enumerate(cands):
+        flags = hits.get(i, [])
+        need_flags = flags[:len(cand)]
+        our_need.append([h for h, known in zip(cand, need_flags)
+                         if not known and h not in queued[i]])
+        if last_syncs[i]:
+            reset_known[i] = all(flags[len(cand):])
+    return our_need, reset_known
 
 
 def generate_sync_messages_docs(backends, sync_states, deadline=None,
@@ -83,8 +172,20 @@ def generate_sync_messages_docs(backends, sync_states, deadline=None,
 
 def _generate_inner(backends, sync_states, n):
     our_heads = [get_heads(b) for b in backends]
-    our_need = [get_missing_deps(b, s['theirHeads'] or [])
-                for b, s in zip(backends, sync_states)]
+    frontier = _frontier_of(backends)
+    # With a frontier index (all-fleet batch), the round's membership
+    # questions — get_missing_deps candidates AND every doc's theirHave
+    # lastSync reconciliation — merge into ONE batched dispatch here,
+    # replacing per-doc get_change_by_hash dict probes: O(1) dispatches
+    # regardless of peer count or history depth, and no hash-graph dict
+    # build for docs that are otherwise quiet.
+    if frontier is not None:
+        our_need, reset_known = _batched_generate_probes(frontier,
+                                                         sync_states)
+    else:
+        reset_known = None
+        our_need = [get_missing_deps(b, s['theirHeads'] or [])
+                    for b, s in zip(backends, sync_states)]
 
     # Phase 1 — which docs attach a filter, and over which hashes. The
     # build dispatch is issued here but not materialized until after the
@@ -100,6 +201,8 @@ def _generate_inner(backends, sync_states, n):
         [row if row is not None else [] for row in bloom_hash_lists])
 
     # Phase 2 — full-resync resets, and the changes-to-send pre-scan
+    # (the lastSync reconciliation answers come from the merged phase-1
+    # probe when the frontier index is on)
     results = [None] * n          # i -> (new_state, message or None)
     probe_rows = []               # flattened (doc, filter) probe requests
     probe_meta = []               # i -> ('probe', changes, first_row, n_filters)
@@ -107,8 +210,10 @@ def _generate_inner(backends, sync_states, n):
         their_have, their_need = state['theirHave'], state['theirNeed']
         if their_have:
             last_sync = their_have[0]['lastSync']
-            if not all(get_change_by_hash(backend, h) is not None
-                       for h in last_sync):
+            known = reset_known.get(i, True) if reset_known is not None \
+                else all(get_change_by_hash(backend, h) is not None
+                         for h in last_sync)
+            if not known:
                 reset = {'heads': our_heads[i], 'need': [],
                          'have': [{'lastSync': [], 'bloom': b''}],
                          'changes': []}
@@ -244,6 +349,51 @@ def _strip_trace_envelopes(binary_messages):
     return wire_ctx, (binary_messages if stripped is None else stripped)
 
 
+def _quick_change_hash(buf):
+    """Hex hash of a SINGLE well-formed change chunk without any header
+    decode: the change hash is SHA-256 over the chunk from the type byte
+    on, and the wire checksum is its first four bytes — so one hashlib
+    pass whose digest matches the stored checksum proves both that the
+    buffer is exactly one chunk (no trailing bytes shifted the span) and
+    that the digest IS the change's hash. Anything else (deflated,
+    multi-chunk, corrupt) returns None: the caller must keep the buffer
+    for the apply path, which types those cases properly."""
+    b = bytes(buf)
+    if len(b) > 9 and b[:4] == _MAGIC and b[8] == CHUNK_TYPE_CHANGE:
+        digest = hashlib.sha256(b[8:]).digest()
+        if digest[:4] == b[4:8]:
+            return digest.hex()
+    return None
+
+
+def _dedup_known_changes(frontier, per_doc_changes):
+    """Drop incoming changes already in their doc's applied history —
+    ONE batched frontier-index probe for the round. The causal gate
+    would skip them anyway, but at general-gate prices: a resent known
+    change (Bloom false negative, replayed wire) breaks the turbo chain
+    shape and demotes the whole doc to the per-change path. Buffers
+    whose hash has no cheap provable lane are kept (never wrong)."""
+    fidx, engines = frontier
+    flat_e, flat_h, where = [], [], []
+    for i, changes in enumerate(per_doc_changes):
+        for j, buf in enumerate(changes):
+            h = _quick_change_hash(buf)
+            if h is not None:
+                flat_e.append(engines[i])
+                flat_h.append(h)
+                where.append((i, j))
+    if not flat_h:
+        return
+    hits = fidx.probe_pairs(flat_e, flat_h)
+    drop = {}
+    for (i, j), hit in zip(where, hits):
+        if hit:
+            drop.setdefault(i, set()).add(j)
+    for i, gone in drop.items():
+        per_doc_changes[i] = [c for j, c in enumerate(per_doc_changes[i])
+                              if j not in gone]
+
+
 def _receive_inner(backends, sync_states, binary_messages, mirror,
                    on_error, deadline, _decoded, n):
     quarantine = on_error == 'quarantine'
@@ -288,7 +438,10 @@ def _receive_inner(backends, sync_states, binary_messages, mirror,
             for i, e in enumerate(errors) if e is not None]})
     before_heads = [get_heads(b) for b in backends]
 
+    frontier = _frontier_of(backends)
     per_doc_changes = [list(d['changes']) if d else [] for d in decoded]
+    if frontier is not None and any(per_doc_changes):
+        _dedup_known_changes(frontier, per_doc_changes)
     if any(per_doc_changes):
         # the decode above was pure host-side reading; this is the last
         # point before the fused dispatch mutates anything (apply checks
@@ -307,6 +460,22 @@ def _receive_inner(backends, sync_states, binary_messages, mirror,
     else:
         new_backends, patches = list(backends), [None] * n
 
+    # Received-heads membership for every doc in ONE index dispatch
+    # (post-apply: the commit staged this round's hashes, the probe's
+    # flush lands them first). Quarantined docs probe nothing. Derived
+    # from the POST-apply backends, not the pre-apply engine list: an
+    # apply can PROMOTE a doc to the host engine (unsupported ops),
+    # freeing its slot — a stale engine reference would crash the probe
+    # mid-round; after a promotion the whole round takes the dict path.
+    heads_known = None
+    post_frontier = _frontier_of(new_backends)
+    if post_frontier is not None:
+        heads_known = _probe_pairs_grouped(
+            post_frontier[0], post_frontier[1],
+            [decoded[i]['heads']
+             if decoded[i] is not None and errors[i] is None else []
+             for i in range(n)])
+
     new_states = []
     for i, (backend, state) in enumerate(zip(new_backends, sync_states)):
         message = decoded[i]
@@ -323,8 +492,13 @@ def _receive_inner(backends, sync_states, binary_messages, mirror,
                                          shared_heads)
         if not message['changes'] and message['heads'] == before_heads[i]:
             last_sent_heads = message['heads']
-        known_heads = [h for h in message['heads']
-                       if get_change_by_hash(backend, h) is not None]
+        if heads_known is not None:
+            flags = heads_known.get(i, [])
+            known_heads = [h for h, known in zip(message['heads'], flags)
+                           if known]
+        else:
+            known_heads = [h for h in message['heads']
+                           if get_change_by_hash(backend, h) is not None]
         if len(known_heads) == len(message['heads']):
             shared_heads = message['heads']
             if len(message['heads']) == 0:

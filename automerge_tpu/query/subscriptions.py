@@ -34,10 +34,13 @@ the decode boundary to the zero-untyped-escapes contract.
 
 import time
 
+import numpy as np
+
 from ..encoding import Decoder, Encoder
 from ..errors import InvalidCursor, UnknownHeads, as_wire_error
 from ..observability import hist as _hist
 from ..observability import recorder as _flight
+from ..observability.metrics import Counters
 from ..observability.spans import span as _span
 from .history import history_of, select_descendants
 
@@ -133,15 +136,19 @@ class Subscription:
     the ticks elapsed since then)."""
 
     __slots__ = ('id', 'key', 'cursor', 'priority', 'closed',
-                 'fresh_tick')
+                 'fresh_tick', 'born_tick')
 
-    def __init__(self, sid, key, cursor, priority):
+    def __init__(self, sid, key, cursor, priority, born_tick=0):
         self.id = sid
         self.key = key
         self.cursor = list(cursor)
         self.priority = priority
         self.closed = False
         self.fresh_tick = None
+        # hub tick count at subscribe time: an all-quiet fast tick's
+        # hub-wide freshness floor applies to this subscriber only for
+        # ticks it actually existed in (floor > born_tick)
+        self.born_tick = born_tick
 
     def __repr__(self):
         return (f'Subscription({self.id}, key={self.key!r}, '
@@ -152,15 +159,33 @@ class SubscriptionHub:
     """See the module docstring. Single-threaded by contract, like the
     service core it plugs into."""
 
-    def __init__(self):
+    def __init__(self, batch_quiet=True):
         self._sources = {}           # key -> query source
         self._subs = {}              # sub id -> Subscription
         self._next_sid = 0
         self._slo = None             # (SloRegistry, tenant_of) when bound
-        self.stats = {
+        # stats ride the atomic Counters family like every other module
+        # stat: the threaded shard pump can tick hubs concurrently with
+        # readers, and a bare-dict `+=` is a splittable read-modify-write
+        # (the round-15 undercount bug class)
+        self.stats = Counters({
             'ticks': 0, 'pushes': 0, 'resyncs': 0, 'quiet': 0,
             'diffs_computed': 0, 'diffs_reused': 0, 'lag_max': 0,
-        }
+        })
+        # (key, cursor tuple) -> member count, maintained incrementally
+        # at every cursor-mutation point so the tick can enumerate
+        # equivalence CLASSES (k of them) without walking subscribers
+        # (10k of them) — the all-quiet fast path's input
+        self._classes = {}
+        self._cursor_rows = {}       # ckey -> (head32 row | None, n)
+        self._class_epoch = 0        # bumped when the class SET changes
+        self._source_epoch = 0       # bumped when a source (re)binds
+        self._scan_cache = None      # assembled compare arrays (by epoch)
+        self.batch_quiet = batch_quiet
+        # the hub-wide freshness floor: the latest tick every subscriber
+        # was proven at-frontier by the batched compare (per-sub
+        # fresh_tick updates are exactly what the fast path skips)
+        self._quiet_floor = None
 
     def bind_slo(self, registry, tenant_of=str):
         """Feed the freshness SLI: every served push reports its cursor
@@ -177,12 +202,14 @@ class SubscriptionHub:
         """Bind `key` to a query source (live handle, parked (store, id)
         pair, or raw chunk bytes). Re-registering rebinds."""
         self._sources[key] = source
+        self._source_epoch += 1
 
     update_source = register
 
     def unregister(self, key):
         """Drop the doc; its subscribers resolve closed on next tick."""
         self._sources.pop(key, None)
+        self._source_epoch += 1
 
     def keys(self):
         return list(self._sources)
@@ -196,21 +223,77 @@ class SubscriptionHub:
             raise KeyError(f'no document registered under {key!r}')
         sid = self._next_sid
         self._next_sid += 1
-        sub = Subscription(sid, key, cursor or [], priority)
+        sub = Subscription(sid, key, cursor or [], priority,
+                           born_tick=self.stats['ticks'])
         self._subs[sid] = sub
+        self._class_add(sub)
         return sub
 
     def resubscribe(self, sub, cursor):
         """Reset a subscriber's cursor (the client-driven recovery path:
         present the frontier of the state you actually hold)."""
-        sub.cursor = list(cursor)
+        if self._subs.get(sub.id) is sub:
+            self._class_move(sub, list(cursor))
+        else:
+            # detached subscriber: its classes were already released —
+            # touch only the cursor, never the live class map
+            sub.cursor = list(cursor)
 
     def unsubscribe(self, sub):
         sub.closed = True
-        self._subs.pop(sub.id, None)
+        if self._subs.pop(sub.id, None) is not None:
+            self._class_drop(sub)
 
     def __len__(self):
         return len(self._subs)
+
+    # -- cursor equivalence classes ------------------------------------
+
+    @staticmethod
+    def _ckey(sub):
+        return (sub.key, tuple(sorted(sub.cursor)))
+
+    def _class_add(self, sub):
+        ckey = self._ckey(sub)
+        count = self._classes.get(ckey, 0)
+        self._classes[ckey] = count + 1
+        if count == 0:
+            self._class_epoch += 1
+
+    def _class_drop(self, sub):
+        ckey = self._ckey(sub)
+        n = self._classes.get(ckey, 0) - 1
+        if n > 0:
+            self._classes[ckey] = n
+        else:
+            self._classes.pop(ckey, None)
+            self._cursor_rows.pop(ckey, None)
+            self._class_epoch += 1
+
+    def _class_move(self, sub, new_cursor):
+        self._class_drop(sub)
+        sub.cursor = new_cursor
+        self._class_add(sub)
+
+    def _cursor_row(self, ckey):
+        """(head32 row | None, head count) for a class cursor; row None
+        marks a host-residue cursor (multi-head, or not a hex hash)."""
+        ent = self._cursor_rows.get(ckey)
+        if ent is None:
+            heads = ckey[1]
+            if len(heads) == 0:
+                ent = (np.zeros(32, dtype=np.uint8), 0)
+            elif len(heads) == 1 and len(heads[0]) == 64:
+                try:
+                    row = np.frombuffer(bytes.fromhex(heads[0]),
+                                        dtype=np.uint8)
+                except ValueError:
+                    row = None
+                ent = (row, 1)
+            else:
+                ent = (None, len(heads))
+            self._cursor_rows[ckey] = ent
+        return ent
 
     # -- the tick ------------------------------------------------------
 
@@ -230,19 +313,44 @@ class SubscriptionHub:
 
         One diff per (doc, cursor-frontier) equivalence class; class
         members past the first are served from the memo (the
-        ``diffs_reused`` counter / reuse ratio in bench)."""
+        ``diffs_reused`` counter / reuse ratio in bench). An ALL-QUIET
+        tick (every class cursor at its doc's frontier) is proven by ONE
+        batched frontier-compare dispatch over the classes — cursor
+        head32 rows against the fleet's columnar ``_DocCols`` heads —
+        and returns without walking subscribers at all; any non-quiet
+        residue falls back to this per-class diff path byte-identically
+        (proven-quiet classes just pre-seed the memo)."""
         from . import _stats
 
-        self.stats['ticks'] += 1
-        events = {}
-        memo = {}                  # (key, cursor tuple) -> event | None
-        invalid = []
+        tick_no = self.stats.inc('ticks')
+        quiet_classes = None
         with _span('subscription_tick', subscribers=len(self._subs)):
+            if self.batch_quiet and self._subs:
+                quiet_classes, all_quiet = self._try_batch_quiet()
+                if all_quiet:
+                    # every subscriber is at its frontier: one counter
+                    # bump and a hub-wide freshness floor instead of 10k
+                    # attribute writes (push-time lag accounting folds
+                    # the floor back in)
+                    self.stats.inc('quiet', len(self._subs))
+                    self._quiet_floor = tick_no
+                    return {}
+            events = {}
+            memo = {}              # (key, cursor tuple) -> event | None
+            if quiet_classes:
+                # classes the batched compare already proved quiet: the
+                # diff path would return None for them by definition
+                # (cursor == heads), so seed the memo and skip the
+                # recompute — the residue keeps the existing path
+                for ckey in quiet_classes:
+                    memo[ckey] = None
+            invalid = []
             for sub in list(self._subs.values()):
                 source = self._sources.get(sub.key)
                 if source is None:
                     events[sub.id] = {'kind': 'closed'}
-                    self._subs.pop(sub.id, None)
+                    if self._subs.pop(sub.id, None) is not None:
+                        self._class_drop(sub)
                     continue
                 ckey = (sub.key, tuple(sorted(sub.cursor)))
                 if ckey in memo:
@@ -252,26 +360,31 @@ class SubscriptionHub:
                     # class, even at 10k at-frontier subscribers)
                     event = memo[ckey]
                     if event is not None:
-                        self.stats['diffs_reused'] += 1
+                        self.stats.inc('diffs_reused')
                         _stats.inc('subscription_diff_reuse')
                 else:
                     event = self._class_diff(source, sub, invalid)
                     memo[ckey] = event
                     if event is not None:
-                        self.stats['diffs_computed'] += 1
-                tick_no = self.stats['ticks']
+                        self.stats.inc('diffs_computed')
                 if event is None:
-                    self.stats['quiet'] += 1
+                    self.stats.inc('quiet')
                     sub.fresh_tick = tick_no   # at the heads right now
                     continue
                 events[sub.id] = event
-                sub.cursor = list(event['heads'])
-                self.stats['pushes'] += 1
+                self._class_move(sub, list(event['heads']))
+                self.stats.inc('pushes')
                 _stats.inc('subscription_pushes')
                 # freshness: this push catches the cursor up — its lag
                 # is the ticks since the subscriber was last at-frontier
-                lag = 0 if sub.fresh_tick is None \
-                    else tick_no - sub.fresh_tick
+                # (per-sub fresh_tick, or the hub-wide all-quiet floor
+                # for ticks the subscriber existed in)
+                base = sub.fresh_tick
+                floor = self._quiet_floor
+                if floor is not None and floor > sub.born_tick and \
+                        (base is None or floor > base):
+                    base = floor
+                lag = 0 if base is None else tick_no - base
                 sub.fresh_tick = tick_no
                 if lag > self.stats['lag_max']:
                     self.stats['lag_max'] = lag
@@ -282,6 +395,186 @@ class SubscriptionHub:
             _flight.dump_flight_record('query', detail={
                 'invalid_cursors': invalid})
         return events
+
+    # -- the batched quiet proof ---------------------------------------
+
+    @staticmethod
+    def _doc_frontier(source):
+        """The doc's frontier in its cheapest form: ('cols', doc_cols,
+        slot, head_n) for a single-or-empty-head fleet doc (compares on
+        device), ('host', sorted hex list) when a host compare is
+        cheap, None when there is no cheap frontier (raw chunk bytes,
+        freed engines) — the tick then takes the slow path."""
+        if isinstance(source, tuple):
+            return ('host', sorted(source[0].heads(source[1])))
+        if isinstance(source, (bytes, bytearray)):
+            return None
+        state = source.get('state') if isinstance(source, dict) else source
+        impl = getattr(state, '_impl', state)
+        slot = getattr(impl, 'slot', None)
+        fleet = getattr(impl, 'fleet', None)
+        if fleet is not None and isinstance(slot, int):
+            cols = fleet.doc_cols
+            n = int(cols.head_n[slot])
+            if n >= 0:
+                return ('cols', cols, slot, n, fleet)
+            return ('host', sorted(impl.heads))   # multi-head: rare
+        heads = getattr(state, 'heads', None)
+        if heads is None:
+            return None
+        return ('host', sorted(heads))
+
+    def _scan_plan(self):
+        """The compare plan for the CURRENT class set, cached until the
+        set changes (cursor moves / churn bump ``_class_epoch``; the
+        all-quiet steady state never rebuilds): the device-comparable
+        classes' cursor rows as assembled arrays, their keys deduplicated
+        with a class->key index vector, and the host-residue classes
+        (multi-head / non-hex cursors) listed separately."""
+        epochs = (self._class_epoch, self._source_epoch)
+        cache = self._scan_cache
+        if cache is not None and cache['epochs'] == epochs:
+            return cache
+        dev_ckeys, dev_rows, dev_n, key_idx = [], [], [], []
+        host_ckeys = []
+        keys, key_of = [], {}
+        for ckey in self._classes:
+            k = key_of.get(ckey[0])
+            if k is None:
+                k = key_of[ckey[0]] = len(keys)
+                keys.append(ckey[0])
+            cur_row, cur_n = self._cursor_row(ckey)
+            if cur_row is None:
+                host_ckeys.append((ckey, k))
+            else:
+                dev_ckeys.append(ckey)
+                dev_rows.append(cur_row)
+                dev_n.append(cur_n)
+                key_idx.append(k)
+        # resolve every key's SOURCE once per (class, source) epoch pair:
+        # fleet docs collapse to (shared _DocCols, slot) for one gather
+        # per tick; anything else stays 'dynamic' (re-resolved per tick);
+        # a missing source or one with no cheap frontier disables the
+        # whole scan (closed events / the slow path are owed)
+        n_keys = len(keys)
+        col_slots = np.full(n_keys, -1, dtype=np.int64)
+        dynamic = []                 # key indexes resolved per tick
+        shared_cols = None
+        shared_fleet = None
+        usable = True
+        for k, key in enumerate(keys):
+            source = self._sources.get(key)
+            if source is None:
+                usable = False
+                break
+            frontier = self._doc_frontier(source)
+            if frontier is None:
+                usable = False
+                break
+            if frontier[0] == 'cols' and \
+                    (shared_cols is None or shared_cols is frontier[1]):
+                shared_cols = frontier[1]
+                shared_fleet = frontier[4]
+                col_slots[k] = frontier[2]
+            else:
+                dynamic.append(k)
+        cache = {
+            'epochs': epochs,
+            'keys': keys,
+            'dev_ckeys': dev_ckeys,
+            'cur32': np.stack(dev_rows) if dev_rows else
+                np.zeros((0, 32), dtype=np.uint8),
+            'cur_n': np.asarray(dev_n, dtype=np.int32),
+            'key_idx': np.asarray(key_idx, dtype=np.int64),
+            'host_ckeys': host_ckeys,
+            'usable': usable,
+            'shared_cols': shared_cols,
+            'shared_fleet': shared_fleet,
+            'free_epoch': shared_fleet.free_epoch
+                if shared_fleet is not None else 0,
+            'col_slots': col_slots,
+            'dynamic': dynamic,
+        }
+        self._scan_cache = cache
+        return cache
+
+    def _try_batch_quiet(self):
+        """Prove per-class quietness in ONE frontier-compare dispatch:
+        per-KEY doc frontiers gathered from the ``_DocCols`` columns,
+        fanned out to classes through the cached plan's index vector.
+        Returns (proven_quiet_ckeys, all_quiet); (None, False) when the
+        scan cannot run — a class's doc is unregistered (closed events
+        are owed) or has no cheap frontier."""
+        from ..fleet.hashindex import frontier_compare
+
+        if not self._classes:
+            # belt-and-braces: an empty class map with live subscribers
+            # would otherwise prove a vacuous all-quiet
+            return None, False
+        plan = self._scan_plan()
+        if plan['shared_fleet'] is not None and \
+                plan['shared_fleet'].free_epoch != plan['free_epoch']:
+            # slots were freed since the plan was built: a recycled slot
+            # must never serve a stale frontier row — re-resolve
+            self._scan_cache = None
+            plan = self._scan_plan()
+        if not plan['usable']:
+            return None, False
+        keys = plan['keys']
+        n_keys = len(keys)
+        key_rows = np.zeros((n_keys, 32), dtype=np.uint8)
+        key_n = np.zeros(n_keys, dtype=np.int32)
+        key_lists = [None] * n_keys    # hex lists, for host compares
+        shared_cols = plan['shared_cols']
+        col_slots = plan['col_slots']
+        gather = col_slots >= 0
+        if gather.any():
+            # the steady-state path: every fleet doc's frontier in two
+            # vectorized gathers off the shared _DocCols columns
+            slots = col_slots[gather]
+            key_rows[gather] = shared_cols.head32[slots]
+            key_n[gather] = shared_cols.head_n[slots]
+        for k in plan['dynamic']:
+            source = self._sources.get(keys[k])
+            if source is None:
+                return None, False
+            frontier = self._doc_frontier(source)
+            if frontier is None:
+                return None, False
+            if frontier[0] == 'cols':
+                cols, slot, doc_n = frontier[1], frontier[2], frontier[3]
+                key_rows[k] = cols.head32[slot]
+                key_n[k] = doc_n
+            else:
+                heads = frontier[1]
+                key_lists[k] = heads
+                key_n[k] = len(heads)
+                if len(heads) == 1 and len(heads[0]) == 64:
+                    try:
+                        key_rows[k] = np.frombuffer(
+                            bytes.fromhex(heads[0]), dtype=np.uint8)
+                    except ValueError:
+                        key_n[k] = -9      # non-hex head: never quiet
+        quiet = set()
+        if len(plan['dev_ckeys']):
+            idx = plan['key_idx']
+            flags = frontier_compare(plan['cur32'], plan['cur_n'],
+                                     key_rows[idx], key_n[idx])
+            for ckey, flag in zip(plan['dev_ckeys'], flags):
+                if flag:
+                    quiet.add(ckey)
+        for ckey, k in plan['host_ckeys']:
+            # residue cursors (multi-head / non-hex): exact list compare
+            # against the doc frontier; columnar docs hold 0/1 heads so
+            # only a 'host'-form doc can ever match them
+            heads = key_lists[k]
+            if heads is None:
+                doc_n = int(key_n[k])
+                heads = [] if doc_n == 0 else \
+                    [key_rows[k].tobytes().hex()] if doc_n == 1 else None
+            if heads is not None and list(ckey[1]) == heads:
+                quiet.add(ckey)
+        return quiet, len(quiet) == len(self._classes)
 
     def _class_diff(self, source, sub, invalid):
         """The diff event for one (doc, cursor) class; None = quiet."""

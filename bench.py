@@ -882,7 +882,11 @@ SANITY_KEYS = {'seam': 'seam_rate', 'registers': 'reg_rate',
                'perf': 'perf_off_rate',
                # the gate's deterministic synthetic self-test: 1 in any
                # healthy tree, full-run and standalone alike
-               'regress': 'regress_check_ok'}
+               'regress': 'regress_check_ok',
+               # the depth-flatness RATIO (two p50s from one process):
+               # ~1.0 in a healthy tree and self-normalizing against box
+               # load, unlike the raw millisecond legs
+               'frontier': 'frontier_depth_ratio'}
 
 
 def section(name):
@@ -2264,6 +2268,184 @@ def _sec_query():
           f'{reuse_ratio:.3f}', file=sys.stderr)
 
 
+@section('frontier')
+def _sec_frontier():
+    # Device-resident frontier index (ISSUE-14): (a) sync-round
+    # membership cost vs HISTORY DEPTH at fixed batch — warm rounds ride
+    # one batched index dispatch, so the sweep must be FLAT (<=1.2x from
+    # 1k to 100k, the acceptance pin), while the fresh-doc contrast leg
+    # shows what the index removes: the O(history) hash-graph dict build
+    # a converged handshake used to force on a freshly loaded doc;
+    # (b) the 10k-subscriber ALL-QUIET tick collapsed to exactly one
+    # frontier-compare dispatch, p50 vs the per-class host scan.
+    from automerge_tpu.backend import init_sync_state
+    from automerge_tpu.columnar import decode_change_meta, encode_change
+    from automerge_tpu.fleet import backend as fleet_backend
+    from automerge_tpu.fleet import hashindex, sync_driver
+    from automerge_tpu.fleet.backend import DocFleet, init_docs
+    from automerge_tpu.fleet.loader import load_docs
+    from automerge_tpu.query import SubscriptionHub
+
+    depths = [int(x) for x in os.environ.get(
+        'BENCH_FRONTIER_DEPTHS', '1000,100000').split(',')]
+    behind = _env('BENCH_FRONTIER_BEHIND', 64)
+    k_docs = _env('BENCH_FRONTIER_DOCS', 4)
+
+    def chain(n):
+        bufs, hashes, deps = [], [], []
+        for i in range(n):
+            buf = encode_change({
+                'actor': 'f1' * 16, 'seq': i + 1, 'startOp': i + 1,
+                'time': 0, 'message': '', 'deps': deps,
+                'ops': [{'action': 'set', 'obj': '_root',
+                         'key': f'k{i % 7}', 'value': i,
+                         'datatype': 'int', 'pred': []}]})
+            deps = [decode_change_meta(buf, True)['hash']]
+            bufs.append(buf)
+            hashes.append(deps[0])
+        return bufs, hashes
+
+    depth_p50 = {}
+    fresh_ms = {}
+    # one table GEOMETRY for the whole sweep (provisioned for the
+    # deepest leg): the sweep pins cost vs HISTORY DEPTH, and a tiny
+    # table's cache-resident probes would otherwise flatter the shallow
+    # leg by ~0.3ms of pure L2-vs-RAM gather difference
+    table_cap = 2 * k_docs * max(depths)
+    for H in depths:
+        bufs, hashes = chain(H)
+        fleet = DocFleet()
+        handles = init_docs(k_docs, fleet)
+        step = 20000
+        for lo in range(0, H, step):
+            handles, _ = fleet_backend.apply_changes_docs(
+                handles, [bufs[lo:lo + step]] * k_docs, mirror=False)
+        doc_chunk = bytes(handles[0]['state'].save())
+        anchor = hashes[H - behind - 1]
+
+        def mk_states(heads):
+            out = []
+            for _ in range(k_docs):
+                s = init_sync_state()
+                s['sharedHeads'] = list(heads)
+                s['theirHeads'] = list(heads)
+                s['theirHave'] = [{'lastSync': list(heads), 'bloom': b''}]
+                s['theirNeed'] = []
+                out.append(s)
+            return out
+
+        # warm: index registration backfill + graph walk caches, then
+        # measure steady-state rounds with a peer `behind` changes back.
+        # device_min=1 pins the DEVICE table at every depth — the sweep
+        # compares depth, not host-vs-device storage modes
+        fleet.frontier_index(device_min=1, capacity=table_cap)
+        sync_driver.generate_sync_messages_docs(handles,
+                                                mk_states([anchor]))
+        times = []
+        for _ in range(max(REPS, 5)):
+            states = mk_states([anchor])
+            start = time.perf_counter()
+            _s, msgs = sync_driver.generate_sync_messages_docs(handles,
+                                                               states)
+            times.append(time.perf_counter() - start)
+            assert all(m is not None for m in msgs)
+        depth_p50[H] = float(np.median(times)) * 1e3
+        del handles, fleet, bufs
+        _fence()
+
+        # fresh-doc converged round, index on vs off: the one-time cost
+        # a revive pays to answer a quiet handshake (extractor hash-lane
+        # backfill vs the full Python hash-graph dict build)
+        row = {}
+        for label, enabled in (('new', True), ('old', False)):
+            prev = sync_driver.set_frontier_enabled(enabled)
+            try:
+                fleet2 = DocFleet()
+                if enabled:
+                    fleet2.frontier_index(device_min=1,
+                                          capacity=table_cap)
+                loaded = load_docs([doc_chunk] * k_docs, fleet2)
+                heads = list(loaded[0]['heads'])
+                start = time.perf_counter()
+                sync_driver.generate_sync_messages_docs(
+                    loaded, mk_states(heads))
+                row[label] = (time.perf_counter() - start) * 1e3
+            finally:
+                sync_driver.set_frontier_enabled(prev)
+            del fleet2, loaded
+            _fence()
+        fresh_ms[H] = row
+
+    lo_h, hi_h = depths[0], depths[-1]
+    depth_ratio = depth_p50[hi_h] / depth_p50[lo_h]
+
+    # ---- (b) the all-quiet tick at fan-out scale ----
+    n_docs = _env('BENCH_FRONTIER_TICK_DOCS', 1000)
+    n_subs = _env('BENCH_FRONTIER_TICK_SUBS', 10000)
+    fleet = DocFleet()
+    handles = init_docs(n_docs, fleet)
+    per_doc, frontiers = [], []
+    for d in range(n_docs):
+        buf = encode_change({
+            'actor': f'{d % 128:04x}' * 4, 'seq': 1, 'startOp': 1,
+            'time': 0, 'message': '', 'deps': [],
+            'ops': [{'action': 'set', 'obj': '_root', 'key': 'k',
+                     'value': d, 'datatype': 'int', 'pred': []}]})
+        frontiers.append([decode_change_meta(buf, True)['hash']])
+        per_doc.append([buf])
+    handles, _ = fleet_backend.apply_changes_docs(handles, per_doc,
+                                                  mirror=False)
+    hub = SubscriptionHub()
+    for d in range(n_docs):
+        hub.register(d, handles[d])
+    for s in range(n_subs):
+        hub.subscribe(s % n_docs, cursor=frontiers[s % n_docs])
+    hub.tick()                      # warm (plan build, jit)
+    tick_p50 = {}
+    tick_dispatches = None
+    for label, batch in (('batched', True), ('scan', False)):
+        hub.batch_quiet = batch
+        times = []
+        for _ in range(max(REPS, 7)):
+            n0 = hashindex.dispatch_count()
+            d0 = fleet.metrics.dispatches
+            start = time.perf_counter()
+            events = hub.tick()
+            times.append(time.perf_counter() - start)
+            assert events == {}
+            if batch:
+                tick_dispatches = (hashindex.dispatch_count() - n0,
+                                   fleet.metrics.dispatches - d0)
+                assert tick_dispatches == (1, 0), tick_dispatches
+        tick_p50[label] = float(np.median(times)) * 1e3
+    del hub, handles, fleet
+    _fence()
+
+    quiet_speedup = tick_p50['scan'] / tick_p50['batched']
+    # flat scalar keys (the standalone JSON line and the bench ledger
+    # both drop nested values)
+    for h in depths:
+        R[f'frontier_round_p50_ms_{h}'] = depth_p50[h]
+        R[f'frontier_fresh_new_ms_{h}'] = fresh_ms[h]['new']
+        R[f'frontier_fresh_old_ms_{h}'] = fresh_ms[h]['old']
+    R.update(
+        frontier_depth_ratio=depth_ratio,
+        frontier_fresh_speedup=fresh_ms[hi_h]['old'] /
+            max(fresh_ms[hi_h]['new'], 1e-9),
+        frontier_quiet_tick_p50_ms=tick_p50['batched'],
+        frontier_quiet_scan_p50_ms=tick_p50['scan'],
+        frontier_quiet_speedup=quiet_speedup,
+        frontier_quiet_tick_dispatches=1)
+    print(f'# frontier: sync-round p50 '
+          + ' / '.join(f'{h}ch {depth_p50[h]:.2f}ms' for h in depths)
+          + f' (ratio {depth_ratio:.2f}x, budget <=1.2x); fresh-doc '
+          f'converged round at {hi_h}ch: index {fresh_ms[hi_h]["new"]:.0f}ms '
+          f'vs dicts {fresh_ms[hi_h]["old"]:.0f}ms; {n_subs}-sub all-quiet '
+          f'tick p50 {tick_p50["batched"]:.2f}ms (1 dispatch) vs scan '
+          f'{tick_p50["scan"]:.2f}ms = {quiet_speedup:.1f}x',
+          file=sys.stderr)
+
+
 @section('zipf')
 def _sec_zipf():
     # Config 5 (stretch): Zipf-skewed change rates over a large fleet
@@ -2486,6 +2668,12 @@ def _run_sanity():
              'BENCH_SLO_SERIES_TENANTS': '60',
              'BENCH_QUERY_DOCS': '200',
              'BENCH_QUERY_SUBS': '1000',
+             # sanity cares about the RATIO's full-vs-standalone
+             # agreement, not the absolute depth; 8k keeps the fixture
+             # build off the critical path
+             'BENCH_FRONTIER_DEPTHS': '1000,8000',
+             'BENCH_FRONTIER_TICK_DOCS': '200',
+             'BENCH_FRONTIER_TICK_SUBS': '2000',
              # tenants stay at the default: the paced sweep needs the
              # closed-loop writer pool to SATURATE per-shard capacity
              # (tenants >> shards x batch x ack-latency) or the legs go

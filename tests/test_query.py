@@ -414,8 +414,15 @@ class TestDiffSince:
             subs_mod, 'diff_since',
             lambda *a, **k: (calls.append(1), orig(*a, **k))[1])
         assert hub.tick() == {}               # all quiet now
-        assert len(calls) == 1, f'{len(calls)} diffs for one quiet class'
+        # the batched frontier compare proves the tick quiet with ZERO
+        # diff_since calls (round 18); the memoized slow path must still
+        # cost exactly one per class — both pinned
+        assert len(calls) == 0, f'{len(calls)} diffs for a batched tick'
         assert hub.stats['quiet'] >= 5
+        hub.batch_quiet = False
+        calls.clear()
+        assert hub.tick() == {}
+        assert len(calls) == 1, f'{len(calls)} diffs for one quiet class'
 
     def test_non_canonical_count_rejected(self):
         # non-minimal LEB128 count (80 00 = padded zero): decodes to []

@@ -145,6 +145,13 @@ def build_corpus():
                encode_cursor(host.get_heads(backend) +
                              ['ab' * 32, 'cd' * 32])]
 
+    # frontier-index trace programs: opaque byte blobs the hashindex
+    # differential target interprets as (op, space, key) triples — every
+    # mutant is a valid program, so mutation explores the trace space
+    import hashlib as _hashlib
+    traces = [_hashlib.sha256(f'hashindex-trace-{i}'.encode()).digest() * 6
+              for i in range(3)]
+
     corpus = {
         'change': changes,
         'document': [saved, saved2],
@@ -156,6 +163,7 @@ def build_corpus():
         'snapshot': [snapshot],
         'manifest': [manifest],
         'cursor': cursors,
+        'hashindex_trace': traces,
     }
     _corpus_size[0] = sum(len(v) for v in corpus.values())
     return corpus
@@ -268,6 +276,41 @@ def _cursor_target(mutant):
         raise RuntimeError('decode_cursor accepted a non-canonical frame')
 
 
+def _hashindex_target(mutant):
+    """Differential fuzz of the frontier index (fleet/hashindex.py): the
+    mutant bytes read as a trace program — (op, space, key) byte triples
+    — run against BOTH the open-addressing table (tiny capacity, low
+    device threshold, so host->device promotion, collision chains, and
+    grow-by-migration all fire constantly) and a dict-of-sets oracle.
+    Any membership disagreement is raised untyped so the fuzz net flags
+    it; a healthy index never raises on ANY byte sequence."""
+    import hashlib as _hashlib
+    from automerge_tpu.fleet.hashindex import HashIndex
+    ix = HashIndex(capacity=8, device_min=24, load_max=0.7)
+    oracle, live = {}, []
+    data = bytes(mutant)[:180]
+    for k in range(0, len(data) - 2, 3):
+        op, s, kid = data[k], data[k + 1], data[k + 2]
+        if not live or (op % 13 == 0 and len(live) < 6):
+            sid = ix.new_space()
+            oracle[sid] = set()
+            live.append(sid)
+        sid = live[s % len(live)]
+        key = _hashlib.sha256(bytes([kid])).hexdigest()
+        if op % 13 == 1 and len(live) > 1:
+            live.remove(sid)
+            ix.release_space(sid)
+            oracle[sid] = set()
+        elif op % 2:
+            ix.insert(sid, [key])
+            oracle[sid].add(key)
+        else:
+            got = bool(ix.probe(sid, [key])[0])
+            if got != (key in oracle[sid]):
+                raise RuntimeError(
+                    'hashindex membership diverged from the set oracle')
+
+
 def _probe_bloom_target(mutant):
     """Corrupt filter bytes must probe lenient (all-False), never raise."""
     from automerge_tpu.fleet.bloom import probe_bloom_filters_batch
@@ -324,6 +367,7 @@ def run_fuzz(n_seeds=None, n_cases=None, verbose=False):
                    for item in items]
     targets = _targets()
     targets.append(('bloom_probe', _probe_bloom_target))
+    targets.append(('hashindex_trace', _hashindex_target))
     targets.append(('loader_batch', _loader_target(corpus)))
     targets.append(('apply_quarantine', _quarantine_target(corpus)))
 
@@ -334,7 +378,7 @@ def run_fuzz(n_seeds=None, n_cases=None, verbose=False):
         signal.signal(signal.SIGALRM, _alarm)
 
     stats = {'cases': 0, 'rejected': 0, 'accepted': 0, 'escaped': []}
-    heavy = {'loader_batch', 'apply_quarantine'}
+    heavy = {'loader_batch', 'apply_quarantine', 'hashindex_trace'}
     for seed in range(n_seeds):
         rng = random.Random(seed)
         for case in range(n_cases):
