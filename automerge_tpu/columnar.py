@@ -1127,7 +1127,14 @@ class DocChunkView:
 
     def __init__(self, chunk, check=True):
         try:
-            self._parse(bytes(chunk), check)
+            # memoryview chunks (the storage engine's mmap'd segment
+            # arena) parse ZERO-COPY: the Decoder slices the view in
+            # place, the op columns are never touched, and the few
+            # header columns this view keeps are copied out below —
+            # building a DocChunkView never materializes the chunk
+            if not isinstance(chunk, (bytes, memoryview)):
+                chunk = bytes(chunk)
+            self._parse(chunk, check)
         except Exception as exc:
             raise as_wire_error(exc, MalformedDocument, 'DocChunkView')
         self._n_changes = None

@@ -157,9 +157,17 @@ class Decoder:
     """Cursor over a byte buffer with LEB128 reads (ref encoding.js:293-534)."""
 
     def __init__(self, buffer):
-        if not isinstance(buffer, (bytes, bytearray, memoryview)):
+        if isinstance(buffer, memoryview):
+            # ZERO-COPY: a memoryview (e.g. into an mmap'd storage
+            # segment) is consumed in place — raw-byte reads return
+            # sub-views into the source buffer, so decoding a parked
+            # chunk's header costs page-cache touches, not an arena copy
+            self.buf = buffer if buffer.ndim == 1 and \
+                buffer.format == 'B' else buffer.cast('B')
+        elif not isinstance(buffer, (bytes, bytearray)):
             raise TypeError(f'Not a byte array: {buffer!r}')
-        self.buf = bytes(buffer)
+        else:
+            self.buf = bytes(buffer)
         self.offset = 0
 
     @property
@@ -257,13 +265,15 @@ class Decoder:
         return self.buf[start:self.offset]
 
     def read_raw_string(self, length):
-        return self.read_raw_bytes(length).decode('utf-8')
+        # bytes() is a no-op copy for bytes inputs; required for the
+        # memoryview (zero-copy) path, which has no .decode
+        return bytes(self.read_raw_bytes(length)).decode('utf-8')
 
     def read_prefixed_bytes(self):
         return self.read_raw_bytes(self.read_uint53())
 
     def read_prefixed_string(self):
-        return self.read_prefixed_bytes().decode('utf-8')
+        return bytes(self.read_prefixed_bytes()).decode('utf-8')
 
     def read_hex_string(self):
         return bytes_to_hex_string(self.read_prefixed_bytes())

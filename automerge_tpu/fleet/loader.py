@@ -86,7 +86,11 @@ def load_docs(buffers, fleet=None):
     chunks = [None] * n_in
     if native.available():
         for i, buf in enumerate(buffers):
-            buf = bytes(buf)
+            # keep memoryviews (mmap'd parked chunks on the revive
+            # path) unowned: the probe below and the native parse both
+            # read through the buffer protocol without materializing
+            if not isinstance(buf, (bytes, memoryview)):
+                buf = bytes(buf)
             # fast single-container probe: magic + document type byte —
             # the native parser re-verifies framing, checksum, and
             # trailing bytes, so a false positive only round-trips

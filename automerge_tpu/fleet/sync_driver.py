@@ -41,6 +41,7 @@ from ..backend.sync import (
 from ..errors import DocError, MalformedSyncMessage, as_wire_error
 from ..observability import recorder as _flight
 from ..observability import tracecontext as _trace
+from ..observability.metrics import Counters, register_health_source
 from ..observability.spans import span as _span
 from .backend import FleetDoc, apply_changes_docs, quarantine_stats
 from .bloom import (
@@ -58,48 +59,58 @@ __all__ = ['generate_sync_messages_docs', 'receive_sync_messages_docs',
 # (backend/sync.py -> _FlatEngine.probe_hashes) honors the same toggle
 from .hashindex import frontier_enabled, set_frontier_enabled  # noqa: E402,F401
 
+_stats = Counters({
+    'sync_frontier_member_docs': 0,     # docs probed via the hashindex
+    'sync_frontier_straggler_docs': 0,  # docs routed classic in a
+})                                      # frontier-served round
+for _key in _stats:
+    register_health_source(_key, lambda k=_key: _stats[k])
+
 
 def _frontier_of(backends):
-    """(FleetFrontierIndex, [engine]) when every backend is a live fleet
-    document on ONE fleet — the condition under which the round's
-    membership probes (theirHave lastSync reconciliation, received-heads
-    lookup, incoming-change dedup) ride the device-resident frontier
-    index as batched dispatches instead of per-doc host-dict probes
-    (fleet/hashindex.py). None for host backends / mixed fleets: those
-    keep the classic dict path."""
+    """(FleetFrontierIndex, {i: engine}) over the FLEET SUBSET of a
+    batch — the docs whose membership probes (theirHave lastSync
+    reconciliation, received-heads lookup, incoming-change dedup) ride
+    the device-resident frontier index as batched dispatches instead of
+    per-doc host-dict probes (fleet/hashindex.py). Host backends,
+    promoted docs, and docs of a second fleet are STRAGGLERS: absent
+    from the map, they keep the classic dict path — one promoted doc no
+    longer reverts the whole round (the mixed-batch routing ROADMAP
+    follow-up). None when the index is disabled or no doc qualifies."""
     if not frontier_enabled():
         return None
-    engines = []
+    members = {}
     fleet = None
-    for backend in backends:
+    for i, backend in enumerate(backends):
         state = backend.get('state') if isinstance(backend, dict) else None
         if not isinstance(state, FleetDoc) or not state.is_fleet:
-            return None
+            continue
         engine = state._impl
         if fleet is None:
             fleet = engine.fleet
         elif engine.fleet is not fleet:
-            return None
-        engines.append(engine)
-    if fleet is None:
+            continue        # a second fleet's docs route classic
+        members[i] = engine
+    if not members:
         return None
-    return fleet.frontier_index(), engines
+    return fleet.frontier_index(), members
 
 
-def _probe_pairs_grouped(frontier, engines, per_doc_hashes):
-    """Batch N docs' membership questions into ONE index probe:
-    per_doc_hashes[i] is a (possibly empty) list of hex hashes for
-    engines[i]. Returns {i: [bool, ...]} aligned with each doc's list
-    (docs with no hashes are omitted)."""
+def _probe_pairs_grouped(fidx, members, hashes_by_doc):
+    """Batch the member docs' membership questions into ONE index probe:
+    hashes_by_doc[i] is a (possibly empty) list of hex hashes for member
+    doc i. Returns {i: [bool, ...]} aligned with each doc's list (docs
+    with no hashes are omitted)."""
     flat_e, flat_h, owners = [], [], []
-    for i, hashes in enumerate(per_doc_hashes):
+    for i, hashes in hashes_by_doc.items():
+        engine = members[i]
         for h in hashes:
-            flat_e.append(engines[i])
+            flat_e.append(engine)
             flat_h.append(h)
             owners.append(i)
     if not flat_h:
         return {}
-    hits = frontier.probe_pairs(flat_e, flat_h)
+    hits = fidx.probe_pairs(flat_e, flat_h)
     out = {}
     for i, hit in zip(owners, hits):
         out.setdefault(i, []).append(bool(hit))
@@ -110,33 +121,35 @@ def _batched_generate_probes(frontier, sync_states):
     """The generate round's TWO membership questions — get_missing_deps
     candidates (the peer's advertised heads plus deps of causally-queued
     changes) and the theirHave lastSync reconciliation — merged into ONE
-    index dispatch. Returns (our_need, reset_known): our_need[i] exactly
-    matches backend.get_missing_deps (the equivalence tests pin it);
+    index dispatch for the member docs. Returns (our_need, reset_known),
+    both keyed by doc index: our_need[i] exactly matches
+    backend.get_missing_deps (the equivalence tests pin it);
     reset_known[i] is all-lastSync-hashes-known, defaulting True for
-    docs with nothing to check."""
-    fidx, engines = frontier
-    cands, queued, last_syncs = [], [], []
-    for engine, state in zip(engines, sync_states):
+    docs with nothing to check. Straggler docs appear in neither."""
+    fidx, members = frontier
+    cands, queued, last_syncs = {}, {}, {}
+    for i, engine in members.items():
+        state = sync_states[i]
         all_deps = set(state['theirHeads'] or [])
         in_queue = set()
         for change in engine.queue:
             in_queue.add(change['hash'])
             all_deps.update(change['deps'])
-        cands.append(sorted(all_deps))
-        queued.append(in_queue)
+        cands[i] = sorted(all_deps)
+        queued[i] = in_queue
         their_have = state['theirHave']
-        last_syncs.append(their_have[0]['lastSync'] if their_have else [])
+        last_syncs[i] = their_have[0]['lastSync'] if their_have else []
     hits = _probe_pairs_grouped(
-        fidx, engines,
-        [cand + ls for cand, ls in zip(cands, last_syncs)])
-    our_need, reset_known = [], {}
-    for i, cand in enumerate(cands):
+        fidx, members,
+        {i: cands[i] + last_syncs[i] for i in members})
+    our_need, reset_known = {}, {}
+    for i in members:
         flags = hits.get(i, [])
-        need_flags = flags[:len(cand)]
-        our_need.append([h for h, known in zip(cand, need_flags)
-                         if not known and h not in queued[i]])
+        need_flags = flags[:len(cands[i])]
+        our_need[i] = [h for h, known in zip(cands[i], need_flags)
+                       if not known and h not in queued[i]]
         if last_syncs[i]:
-            reset_known[i] = all(flags[len(cand):])
+            reset_known[i] = all(flags[len(cands[i]):])
     return our_need, reset_known
 
 
@@ -173,19 +186,24 @@ def generate_sync_messages_docs(backends, sync_states, deadline=None,
 def _generate_inner(backends, sync_states, n):
     our_heads = [get_heads(b) for b in backends]
     frontier = _frontier_of(backends)
-    # With a frontier index (all-fleet batch), the round's membership
-    # questions — get_missing_deps candidates AND every doc's theirHave
-    # lastSync reconciliation — merge into ONE batched dispatch here,
-    # replacing per-doc get_change_by_hash dict probes: O(1) dispatches
-    # regardless of peer count or history depth, and no hash-graph dict
-    # build for docs that are otherwise quiet.
+    # With a frontier index, the member docs' membership questions —
+    # get_missing_deps candidates AND each doc's theirHave lastSync
+    # reconciliation — merge into ONE batched dispatch here, replacing
+    # per-doc get_change_by_hash dict probes: O(1) dispatches regardless
+    # of peer count or history depth, and no hash-graph dict build for
+    # docs that are otherwise quiet. Stragglers (host backends, promoted
+    # docs, a second fleet) take the classic path WITHOUT demoting the
+    # member subset.
     if frontier is not None:
-        our_need, reset_known = _batched_generate_probes(frontier,
-                                                         sync_states)
+        member_need, reset_known = _batched_generate_probes(frontier,
+                                                            sync_states)
+        _stats.inc('sync_frontier_member_docs', len(frontier[1]))
+        _stats.inc('sync_frontier_straggler_docs', n - len(frontier[1]))
     else:
-        reset_known = None
-        our_need = [get_missing_deps(b, s['theirHeads'] or [])
-                    for b, s in zip(backends, sync_states)]
+        member_need, reset_known = {}, None
+    our_need = [member_need[i] if i in member_need
+                else get_missing_deps(b, s['theirHeads'] or [])
+                for i, (b, s) in enumerate(zip(backends, sync_states))]
 
     # Phase 1 — which docs attach a filter, and over which hashes. The
     # build dispatch is issued here but not materialized until after the
@@ -210,7 +228,7 @@ def _generate_inner(backends, sync_states, n):
         their_have, their_need = state['theirHave'], state['theirNeed']
         if their_have:
             last_sync = their_have[0]['lastSync']
-            known = reset_known.get(i, True) if reset_known is not None \
+            known = reset_known.get(i, True) if i in member_need \
                 else all(get_change_by_hash(backend, h) is not None
                          for h in last_sync)
             if not known:
@@ -368,18 +386,21 @@ def _quick_change_hash(buf):
 
 def _dedup_known_changes(frontier, per_doc_changes):
     """Drop incoming changes already in their doc's applied history —
-    ONE batched frontier-index probe for the round. The causal gate
-    would skip them anyway, but at general-gate prices: a resent known
-    change (Bloom false negative, replayed wire) breaks the turbo chain
-    shape and demotes the whole doc to the per-change path. Buffers
-    whose hash has no cheap provable lane are kept (never wrong)."""
-    fidx, engines = frontier
+    ONE batched frontier-index probe for the round's MEMBER docs
+    (stragglers keep their changes: the causal gate dedups them at
+    general-gate prices). A resent known change (Bloom false negative,
+    replayed wire) breaks the turbo chain shape and demotes its doc to
+    the per-change path. Buffers whose hash has no cheap provable lane
+    are kept (never wrong)."""
+    fidx, members = frontier
     flat_e, flat_h, where = [], [], []
     for i, changes in enumerate(per_doc_changes):
+        if i not in members:
+            continue
         for j, buf in enumerate(changes):
             h = _quick_change_hash(buf)
             if h is not None:
-                flat_e.append(engines[i])
+                flat_e.append(members[i])
                 flat_h.append(h)
                 where.append((i, j))
     if not flat_h:
@@ -460,21 +481,23 @@ def _receive_inner(backends, sync_states, binary_messages, mirror,
     else:
         new_backends, patches = list(backends), [None] * n
 
-    # Received-heads membership for every doc in ONE index dispatch
-    # (post-apply: the commit staged this round's hashes, the probe's
-    # flush lands them first). Quarantined docs probe nothing. Derived
-    # from the POST-apply backends, not the pre-apply engine list: an
-    # apply can PROMOTE a doc to the host engine (unsupported ops),
-    # freeing its slot — a stale engine reference would crash the probe
-    # mid-round; after a promotion the whole round takes the dict path.
+    # Received-heads membership for the member docs in ONE index
+    # dispatch (post-apply: the commit staged this round's hashes, the
+    # probe's flush lands them first). Quarantined docs probe nothing.
+    # Derived from the POST-apply backends, not the pre-apply engine
+    # list: an apply can PROMOTE a doc to the host engine (unsupported
+    # ops), freeing its slot — a stale engine reference would crash the
+    # probe mid-round; a freshly promoted doc simply drops out of the
+    # member map and answers via the classic dict probe below.
     heads_known = None
+    post_members = {}
     post_frontier = _frontier_of(new_backends)
     if post_frontier is not None:
+        post_members = post_frontier[1]
         heads_known = _probe_pairs_grouped(
-            post_frontier[0], post_frontier[1],
-            [decoded[i]['heads']
-             if decoded[i] is not None and errors[i] is None else []
-             for i in range(n)])
+            post_frontier[0], post_members,
+            {i: decoded[i]['heads'] for i in post_members
+             if decoded[i] is not None and errors[i] is None})
 
     new_states = []
     for i, (backend, state) in enumerate(zip(new_backends, sync_states)):
@@ -492,7 +515,7 @@ def _receive_inner(backends, sync_states, binary_messages, mirror,
                                          shared_heads)
         if not message['changes'] and message['heads'] == before_heads[i]:
             last_sent_heads = message['heads']
-        if heads_known is not None:
+        if heads_known is not None and i in post_members:
             flags = heads_known.get(i, [])
             known_heads = [h for h, known in zip(message['heads'], flags)
                            if known]

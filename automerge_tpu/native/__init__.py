@@ -279,7 +279,13 @@ def available():
 
 
 def _u8(buf):
-    arr = np.frombuffer(bytes(buf), dtype=np.uint8)
+    """Byte buffer -> (uint8 array, pointer) WITHOUT an owned-bytes
+    copy: bytes, bytearray, and memoryview (incl. views into mmap'd
+    storage segments) go straight through the buffer protocol, so the
+    native codec reads compressed chunks off the page cache in place."""
+    if not isinstance(buf, (bytes, bytearray, memoryview)):
+        buf = bytes(buf)
+    arr = np.frombuffer(buf, dtype=np.uint8)
     if arr.size == 0:
         arr = np.zeros(1, dtype=np.uint8)
     return arr, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
@@ -293,7 +299,7 @@ def sha256(data):
         return hashlib.sha256(bytes(data)).digest()
     arr, ptr = _u8(data)
     out = np.zeros(32, dtype=np.uint8)
-    lib.am_sha256(ptr, len(bytes(data)),
+    lib.am_sha256(ptr, arr.size if len(data) else 0,
                   out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
     return out.tobytes()
 
@@ -782,10 +788,14 @@ def _parse_documents(buffers):
     lib = _load()
     if lib is None:
         return None
+    # same unowned-buffer discipline as _extract_changes: memoryviews
+    # (mmap'd parked chunks on the revive path) join without a
+    # per-buffer copy, and a single doc parses fully in place
     bufs = buffers if all(type(b) is bytes for b in buffers) else \
-        [bytes(b) for b in buffers]
+        [b if type(b) is bytes or isinstance(b, memoryview) else bytes(b)
+         for b in buffers]
     n_docs = len(bufs)
-    blob = b''.join(bufs)
+    blob = bufs[0] if n_docs == 1 else b''.join(bufs)
     lens = np.fromiter(map(len, bufs), dtype=np.uint64, count=n_docs)
     offsets = np.zeros(max(n_docs, 1), dtype=np.uint64)
     if n_docs > 1:
@@ -961,11 +971,15 @@ def _extract_changes(buffers):
     lib = _load()
     if lib is None:
         return None
-    bufs = [b if type(b) is bytes else bytes(b) for b in buffers]
+    # buffer-protocol inputs pass through unowned (memoryviews into the
+    # storage engine's mmap'd segments included): a single doc reads in
+    # place with ZERO copies; a multi-doc batch pays exactly one join
+    bufs = [b if type(b) is bytes or isinstance(b, memoryview)
+            else bytes(b) for b in buffers]
     n_docs = len(bufs)
     if n_docs == 0:
         return []
-    blob = b''.join(bufs)
+    blob = bufs[0] if n_docs == 1 else b''.join(bufs)
     lens = np.fromiter(map(len, bufs), dtype=np.uint64, count=n_docs)
     offsets = np.zeros(n_docs, dtype=np.uint64)
     if n_docs > 1:

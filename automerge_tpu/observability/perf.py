@@ -44,10 +44,15 @@ trusts:
 - **Memory watermarks** (``sample_watermarks``): process RSS (VmRSS,
   with the kernel's own VmHWM high watermark) plus per-tier byte
   gauges from registered sources — fleet-resident device/mirror state
-  (fleet/backend.py), the ``MainStore`` chunk arena (fleet/storage.py),
-  the journal's ``pending_fsync_bytes`` loss window, and the span /
+  (fleet/backend.py), the ``MainStore`` causal lanes (RESIDENT) and its
+  mmap'd segment arena (``mainstore_disk_bytes`` — MAPPED, page-cache-
+  served, deliberately outside the RSS budget; fleet/storage.py), the
+  journal's ``pending_fsync_bytes`` loss window, and the span /
   flight-recorder rings — each with a process-lifetime high watermark.
-  This is the signal the ROADMAP's cost-based tiering item consumes.
+  ``page_fault_counts()`` rides the same sampler: minor/major fault
+  counters splitting "served from page cache" from "went to disk" for
+  the storage tier's cold reads. This is the signal the cost-based
+  tiering plane (fleet/tiering.py) consumes.
 
 Everything is off by default. ``enable_observatory()`` /
 ``disable_observatory()`` flip all three legs together (the switch the
@@ -75,6 +80,7 @@ __all__ = ['PerfBaselines', 'SeamSpec', 'DEFAULT_SEAMS', 'baselines',
            'reset_ledger', 'dump_ledger',
            'register_mem_source', 'sample_watermarks',
            'watermark_snapshot', 'reset_watermarks', 'rss_bytes',
+           'page_fault_counts',
            'enable_observatory', 'disable_observatory', 'perf_stats']
 
 _stats = Counters({
@@ -618,13 +624,42 @@ def rss_bytes():
     return peak, peak
 
 
+def page_fault_counts():
+    """(minor, major) page faults for this process since start. Major
+    faults are the storage tier's cold-read signal: an mmap'd parked
+    chunk served off the page cache costs zero; one read from disk
+    costs a major fault. Linux: /proc/self/stat fields 10/12;
+    elsewhere: getrusage ru_minflt/ru_majflt."""
+    try:
+        with open('/proc/self/stat') as f:
+            # field 2 (comm) may contain spaces — split after the
+            # closing paren
+            rest = f.read().rsplit(')', 1)[1].split()
+        # rest[0] is field 3 (state); minflt/majflt are fields 10/12
+        return int(rest[7]), int(rest[9])
+    except (OSError, IndexError, ValueError):
+        pass
+    import resource
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    return int(ru.ru_minflt), int(ru.ru_majflt)
+
+
 def sample_watermarks():
     """Read every tier source + RSS, fold the process-lifetime highs,
     return current values. Cost: one /proc read + one call per source —
-    a per-tick sampler, not a per-request one."""
+    a per-tick sampler, not a per-request one. Page-fault counters ride
+    along under 'pagefaults_minor'/'pagefaults_major' (monotonic
+    counters, not byte gauges — the storage tier's cold-read split)."""
     rss, hwm = rss_bytes()
     current = {'rss': rss}
     _mem_high['rss'] = max(_mem_high.get('rss', 0), hwm, rss)
+    minor, major = page_fault_counts()
+    current['pagefaults_minor'] = minor
+    current['pagefaults_major'] = major
+    _mem_high['pagefaults_minor'] = max(
+        _mem_high.get('pagefaults_minor', 0), minor)
+    _mem_high['pagefaults_major'] = max(
+        _mem_high.get('pagefaults_major', 0), major)
     for name, fn in list(_mem_sources.items()):
         try:
             value = int(fn())

@@ -46,7 +46,11 @@ class ChunkHistory:
                  'heads')
 
     def __init__(self, chunk, heads=None):
-        chunk = bytes(chunk)
+        # memoryview chunks (parked docs in the mmap'd segment arena)
+        # extract in place — the time-travel read path never copies the
+        # compressed bytes off the page cache
+        if not isinstance(chunk, (bytes, memoryview)):
+            chunk = bytes(chunk)
         extracted = native.extract_changes([chunk]) \
             if native.available() else None
         if extracted is not None and extracted[0] is not None:

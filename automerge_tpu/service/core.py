@@ -222,9 +222,17 @@ class DocService:
                  default_timeout=None,
                  backoff=None, retry_rate=20.0, retry_burst=40.0,
                  stall_rounds=8,
-                 brownout=None, slo=None, clock=time.monotonic):
+                 brownout=None, slo=None, tiering=None,
+                 clock=time.monotonic):
         from ..fleet.backend import DocFleet
         self.durable = durable
+        # `tiering`: a fleet/tiering.py TieringController. When attached,
+        # the pump's background-maintenance step runs THROUGH its cost
+        # model — auto-demote under watermark pressure, cost-based
+        # vacuum/compaction — and brownout stage 2 becomes a pressure
+        # INPUT to that model (write-cost multiplier) instead of the
+        # legacy hard defer-compaction override.
+        self.tiering = tiering
         if durable is not None:
             fleet = durable.fleet
         self.fleet = fleet if fleet is not None else DocFleet()
@@ -487,9 +495,18 @@ class DocService:
         if syncs:
             self._run_syncs(syncs, now, stats)
 
-        # background durability work: compaction runs cost-based unless
-        # the ladder deferred it; journal rotation re-attaches
-        if self.durable is not None:
+        # background maintenance: with a tiering controller attached the
+        # cost model owns every decision (demote, vacuum, journal
+        # compaction) with the brownout stage as its pressure input —
+        # stage 2 defers by raising the write-cost bar, and still fires
+        # when replay debt overwhelms it (flight-recorded either way).
+        # Without one, the legacy threshold + hard stage-2 defer apply.
+        if self.tiering is not None:
+            self.tiering.tick(stage=self.brownout.stage,
+                              durable=self.durable)
+            if self.durable is not None:
+                self._attach_brownout_journal()
+        elif self.durable is not None:
             if not self.brownout.defer_compaction:
                 self.durable.maybe_compact()
             self._attach_brownout_journal()

@@ -868,6 +868,9 @@ SANITY_KEYS = {'seam': 'seam_rate', 'registers': 'reg_rate',
                # hosts without the native codec, which the sanity ratio
                # would turn into an unconditional FAIL
                'storage': 'storage_recovery_docs_per_s',
+               # park throughput over the mmap arena: a pure host+disk
+               # rate, stable across run order
+               'storage_tier': 'tier_park_docs_per_s',
                'query': 'query_materialize_docs_per_s',
                # render throughput, not the overhead percentage: the
                # paired delta is a noise-sensitive difference that can
@@ -1636,6 +1639,158 @@ def _sec_storage():
           f'({rec_rate:.0f} docs/s); main-store residency '
           f'{overhead_per_doc:.0f} B/doc overhead + '
           f'{chunk_per_doc:.0f} B/doc chunk', file=sys.stderr)
+
+
+@section('storage_tier')
+def _sec_storage_tier():
+    # Mmap-backed MainStore + cost-based tiering (ISSUE-15): the chunk
+    # arena on disk under the RAM-resident causal index. Measures
+    # (a) park (bulk ingest) throughput at BENCH_TIER_DOCS (default 1M;
+    # raise to 10M for the full residency headline), with RSS growth and
+    # resident-per-doc against the acceptance ceiling; (b) revive and
+    # materialize_at throughput off the mapped arena, WARM page cache,
+    # against a RAM-resident-arena baseline at the same batch scale
+    # (acceptance: >= 0.8x); (c) the COLD leg — posix_fadvise DONTNEED
+    # drops the arena's pages, major-fault delta recorded, revive
+    # re-measured from actual disk.
+    import shutil
+    import tempfile
+    from automerge_tpu.columnar import DocChunkView, decode_change_meta, \
+        encode_change
+    from automerge_tpu.fleet import backend as fleet_backend
+    from automerge_tpu.fleet.backend import DocFleet, init_docs
+    from automerge_tpu.fleet.storage import StorageEngine
+    from automerge_tpu.observability.perf import page_fault_counts, \
+        rss_bytes
+    from automerge_tpu.query import materialize_at_docs
+
+    n_docs = _env('BENCH_TIER_DOCS', 1_000_000)
+    distinct = min(_env('BENCH_TIER_DISTINCT', 2048), n_docs)
+    ram_n = min(n_docs, _env('BENCH_TIER_RAM_DOCS', 100_000))
+    revive_batch = min(_env('BENCH_TIER_REVIVE', 1024), distinct)
+    mat_batch = min(_env('BENCH_TIER_MAT', 256), distinct)
+
+    # corpus: `distinct` two-change linear docs, causal rows precomputed
+    # once (the arena append + lane install per doc stay honest; only
+    # the header decode is memoized across the repeats)
+    fleet = DocFleet()
+    handles = init_docs(distinct, fleet)
+    frontier = [[] for _ in range(distinct)]
+    for c in range(2):
+        per_doc = []
+        for d in range(distinct):
+            buf = encode_change({
+                'actor': f'{d % 128:04x}' * 4, 'seq': c + 1,
+                'startOp': c + 1, 'time': 0, 'message': '',
+                'deps': frontier[d],
+                'ops': [{'action': 'set', 'obj': '_root', 'key': f'k{c}',
+                         'value': d * 1000 + c, 'datatype': 'int',
+                         'pred': []}]})
+            frontier[d] = [decode_change_meta(buf, True)['hash']]
+            per_doc.append([buf])
+        handles, _ = fleet_backend.apply_changes_docs(handles, per_doc,
+                                                      mirror=False)
+    chunks = [bytes(h['state'].save()) for h in handles]
+    rows = [(v.heads, v.clock, v.max_op, v.n_changes)
+            for v in (DocChunkView(c) for c in chunks)]
+    fleet_backend.free_docs(handles)
+    del handles
+    _fence()
+
+    def ingest_all(eng, n):
+        start = time.perf_counter()
+        i = 0
+        while i < n:
+            k = min(distinct, n - i)
+            eng.ingest_chunks(chunks[:k], rows=rows[:k])
+            i += k
+        return n / (time.perf_counter() - start)
+
+    def revive_rate(eng, windows, n):
+        # clamp every window into the parked id range: a mid-range
+        # BENCH_TIER_DOCS must shift the legs, not KeyError the section
+        max_w = max(n // revive_batch - 1, 0)
+        rates = []
+        for w in windows:
+            w = min(w, max_w)
+            ids = list(range(w * revive_batch,
+                             min((w + 1) * revive_batch, n)))
+            start = time.perf_counter()
+            got = eng.revive(ids)
+            rate = len(ids) / (time.perf_counter() - start)
+            eng.repark(got, ids)       # restore the store for the next leg
+            rates.append(rate)
+        return float(np.median(rates))
+
+    def mat_rate(eng, eng_fleet, base, n):
+        base = max(0, min(base, n - mat_batch))
+        sources = [(eng, base + i) for i in range(mat_batch)]
+        heads_list = [eng.heads(base + i) for i in range(mat_batch)]
+        rates = []
+        for _ in range(3):
+            start = time.perf_counter()
+            outs = materialize_at_docs(sources, heads_list, fleet=eng_fleet)
+            rates.append(mat_batch / (time.perf_counter() - start))
+            fleet_backend.free_docs(outs)
+        return float(np.median(rates))
+
+    # ---- RAM-resident baseline at the sub-scale ----
+    ram_fleet = DocFleet()
+    ram = StorageEngine(ram_fleet)
+    ram_park = ingest_all(ram, ram_n)
+    ram_revive = revive_rate(ram, [1, 3, 5], ram_n)
+    ram_mat = mat_rate(ram, ram_fleet, 7 * revive_batch, ram_n)
+    del ram, ram_fleet
+    _fence()
+
+    # ---- disk-backed engine at full scale ----
+    root = tempfile.mkdtemp(prefix='bench-tier-')
+    try:
+        disk_fleet = DocFleet()
+        eng = StorageEngine(disk_fleet, path=os.path.join(root, 'arena'))
+        eng.main.reserve(n_docs)
+        rss0 = rss_bytes()[0]
+        tier_park = ingest_all(eng, n_docs)
+        rss1 = rss_bytes()[0]
+        stats = eng.memory_stats()
+        tier_revive = revive_rate(eng, [1, 3, 5], n_docs)
+        tier_mat = mat_rate(eng, disk_fleet, 7 * revive_batch, n_docs)
+        # cold leg: drop the arena's clean pages, read from actual disk
+        mn0, mj0 = page_fault_counts()
+        eng.main._arena.advise_cold()
+        tier_revive_cold = revive_rate(eng, [9, 11, 13], n_docs)
+        _mn1, mj1 = page_fault_counts()
+        eng.close()
+        del eng, disk_fleet
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    _fence()
+
+    R.update(tier_docs=n_docs,
+             tier_park_docs_per_s=tier_park,
+             tier_revive_docs_per_s=tier_revive,
+             tier_revive_cold_docs_per_s=tier_revive_cold,
+             tier_materialize_docs_per_s=tier_mat,
+             tier_ram_park_docs_per_s=ram_park,
+             tier_ram_revive_docs_per_s=ram_revive,
+             tier_ram_materialize_docs_per_s=ram_mat,
+             tier_park_ratio=tier_park / ram_park,
+             tier_revive_ratio=tier_revive / ram_revive,
+             tier_materialize_ratio=tier_mat / ram_mat,
+             tier_resident_bytes_per_doc=stats['resident_per_doc'],
+             tier_rss_grow_bytes=max(0, rss1 - rss0),
+             tier_disk_bytes=stats['disk_bytes'],
+             tier_cold_major_faults=mj1 - mj0)
+    print(f'# storage_tier: {n_docs} docs on disk — park {tier_park:.0f} '
+          f'docs/s ({R["tier_park_ratio"]:.2f}x ram), revive warm '
+          f'{tier_revive:.0f} docs/s ({R["tier_revive_ratio"]:.2f}x ram) '
+          f'/ cold {tier_revive_cold:.0f} docs/s '
+          f'({mj1 - mj0} major faults), materialize '
+          f'{tier_mat:.0f} docs/s ({R["tier_materialize_ratio"]:.2f}x '
+          f'ram); resident {stats["resident_per_doc"]:.0f} B/doc, RSS '
+          f'+{(rss1 - rss0) / (1 << 20):.0f} MiB, arena '
+          f'{stats["disk_bytes"] / (1 << 20):.0f} MiB on disk',
+          file=sys.stderr)
 
 
 @section('observability')
@@ -2561,6 +2716,8 @@ def _sec_regress():
         for key in ('seam_rate', 'seam_commit_rate', 'host_rate',
                     'service_clean_rps', 'slo_render_series_per_s',
                     'storage_recovery_docs_per_s',
+                    'tier_park_docs_per_s', 'tier_revive_docs_per_s',
+                    'tier_materialize_docs_per_s',
                     'query_materialize_docs_per_s', 'shards_rps_4',
                     'obs_overhead_pct', 'perf_overhead_pct'):
             if isinstance(R.get(key), (int, float)):
@@ -2668,6 +2825,11 @@ def _run_sanity():
              'BENCH_SLO_SERIES_TENANTS': '60',
              'BENCH_QUERY_DOCS': '200',
              'BENCH_QUERY_SUBS': '1000',
+             'BENCH_TIER_DOCS': '20000',
+             'BENCH_TIER_RAM_DOCS': '20000',
+             'BENCH_TIER_DISTINCT': '512',
+             'BENCH_TIER_REVIVE': '256',
+             'BENCH_TIER_MAT': '128',
              # sanity cares about the RATIO's full-vs-standalone
              # agreement, not the absolute depth; 8k keeps the fixture
              # build off the critical path

@@ -50,12 +50,31 @@ GRANDFATHER_BUDGETS = {
     'tests/test_chaos.py::test_chaos_checkpoint_crash_recover': 30.0,
     'tests/test_multihost.py::'
     'test_two_process_pairwise_sync_converges': 12.0,
+    # TestBenchLedger: 0.2-0.35s isolated, observed 7.9-13.7s under
+    # full-suite contention on this 9p box (round 19 — file-I/O latency
+    # spikes after the Mosaic-AOT burn; family cost UNCHANGED in
+    # isolation, so contention budgets like the round-14 precedent)
+    'tests/test_perf_obs.py::TestBenchLedger::'
+    'test_append_read_roundtrip': 20.0,
+    'tests/test_perf_obs.py::TestBenchLedger::'
+    'test_backfill_idempotent_and_covers_every_artifact': 25.0,
+    'tests/test_perf_obs.py::TestBenchLedger::'
+    'test_trajectory_renders': 30.0,
+    # spawns a python child (jax import) that dies inside the vacuum's
+    # manifest swap; 1.8s isolated, budgeted for suite contention
+    'tests/test_storage_tier.py::TestDiskArena::'
+    'test_kill_mid_vacuum_recovers': 12.0,
     'tests/test_fleet_backend.py::TestSequenceSeam::'
     'test_randomized_sequence_counter_differential': 10.0,
     'tests/test_service_chaos.py::'
     'test_service_overload_brownout_smoke': 10.0,
     'tests/test_service_chaos.py::test_service_chaos_smoke': 10.0,
-    'tests/test_durability.py::test_crashtest_smoke': 10.0,
+    # 4.3s isolated; observed 25.8s under full-suite I/O contention on
+    # this 9p box (round 19 — the suite's file-heavy families draw a
+    # latency lottery; family cost unchanged in isolation)
+    'tests/test_durability.py::test_crashtest_smoke': 40.0,
+    'tests/test_durability.py::'
+    'test_recovery_rejournals_instead_of_resnapshotting': 25.0,
     'tests/test_fuzz_wire.py::test_fuzz_wire_smoke': 10.0,
     # ISSUE-13 perf-observatory family: the atomic-counter hammer (6
     # threads x 10k locked incs, measured ~2s isolated) and the torn-

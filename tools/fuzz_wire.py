@@ -145,12 +145,15 @@ def build_corpus():
                encode_cursor(host.get_heads(backend) +
                              ['ab' * 32, 'cd' * 32])]
 
-    # frontier-index trace programs: opaque byte blobs the hashindex
-    # differential target interprets as (op, space, key) triples — every
-    # mutant is a valid program, so mutation explores the trace space
+    # frontier-index / storage-engine trace programs: opaque byte blobs
+    # the differential targets interpret as op streams — every mutant is
+    # a valid program, so mutation explores the trace space
     import hashlib as _hashlib
     traces = [_hashlib.sha256(f'hashindex-trace-{i}'.encode()).digest() * 6
               for i in range(3)]
+    storage_traces = [
+        _hashlib.sha256(f'storage-trace-{i}'.encode()).digest() * 4
+        for i in range(3)]
 
     corpus = {
         'change': changes,
@@ -164,6 +167,7 @@ def build_corpus():
         'manifest': [manifest],
         'cursor': cursors,
         'hashindex_trace': traces,
+        'storage_trace': storage_traces,
     }
     _corpus_size[0] = sum(len(v) for v in corpus.values())
     return corpus
@@ -311,6 +315,75 @@ def _hashindex_target(mutant):
                     'hashindex membership diverged from the set oracle')
 
 
+_storage_corpus = []
+
+
+def _storage_trace_target(mutant):
+    """Differential fuzz of the mmap-backed storage engine (fleet/
+    storage.py + fleet/segment.py): the mutant bytes read as a trace
+    program — (op, arg) byte pairs driving ingest / discard / read /
+    vacuum / crash-reopen against a DISK-backed StorageEngine, checked
+    at every step against a plain {id: (bytes, heads)} oracle. The
+    reopen step exercises the manifest + CRC frame recovery path mid-
+    trace. Any divergence (wrong bytes, wrong heads, id resurrection)
+    raises untyped so the fuzz net flags it; a healthy engine never
+    raises on ANY byte sequence."""
+    import tempfile
+    from automerge_tpu.columnar import DocChunkView
+    from automerge_tpu.fleet.storage import StorageEngine
+    if not _storage_corpus:
+        from automerge_tpu.fleet.backend import DocFleet
+        chunks = []
+        d = A.init('ee' * 16)
+        for k in range(4):
+            d = A.change(d, {'time': 0}, lambda r: r.update({'k': k}))
+            chunks.append(bytes(A.save(d)))
+        _storage_corpus.append((chunks, DocFleet()))  # fleet never revives
+    chunks, fleet = _storage_corpus[0]
+    with tempfile.TemporaryDirectory(prefix='fuzz-arena-') as root:
+        path = root + '/store'
+        eng = StorageEngine(fleet=fleet, path=path, segment_bytes=1 << 12,
+                            vacuum_dead_fraction=0.5)
+        oracle = {}
+        data = bytes(mutant)[:60]
+        for k in range(0, len(data) - 1, 2):
+            op, arg = data[k] % 6, data[k + 1]
+            live = sorted(oracle)
+            if op == 0 or not live:                      # ingest
+                chunk = chunks[arg % len(chunks)]
+                did = eng.ingest_chunks([chunk])[0]
+                if did in oracle:
+                    raise RuntimeError('storage id reused while live')
+                oracle[did] = (chunk, sorted(DocChunkView(chunk).heads))
+            elif op == 1:                                # discard
+                did = live[arg % len(live)]
+                eng.discard([did])
+                del oracle[did]
+            elif op in (2, 3):                           # read compare
+                did = live[arg % len(live)]
+                chunk, heads = oracle[did]
+                if bytes(eng.chunk(did)) != chunk:
+                    raise RuntimeError('chunk bytes diverged from oracle')
+                if eng.heads(did) != heads:
+                    raise RuntimeError('heads diverged from oracle')
+            elif op == 4:                                # vacuum
+                eng.vacuum_now()
+            else:                                        # crash + reopen
+                eng.main.sync()
+                eng.main.close()
+                eng = StorageEngine.open(path, fleet=fleet,
+                                         segment_bytes=1 << 12)
+                if sorted(eng._row_of) != live:
+                    raise RuntimeError(
+                        f'recovery id set diverged: {sorted(eng._row_of)}'
+                        f' != {live}')
+                for did, (chunk, heads) in oracle.items():
+                    if bytes(eng.chunk(did)) != chunk or \
+                            eng.heads(did) != heads:
+                        raise RuntimeError('recovery diverged from oracle')
+        eng.main.close()
+
+
 def _probe_bloom_target(mutant):
     """Corrupt filter bytes must probe lenient (all-False), never raise."""
     from automerge_tpu.fleet.bloom import probe_bloom_filters_batch
@@ -368,6 +441,7 @@ def run_fuzz(n_seeds=None, n_cases=None, verbose=False):
     targets = _targets()
     targets.append(('bloom_probe', _probe_bloom_target))
     targets.append(('hashindex_trace', _hashindex_target))
+    targets.append(('storage_trace', _storage_trace_target))
     targets.append(('loader_batch', _loader_target(corpus)))
     targets.append(('apply_quarantine', _quarantine_target(corpus)))
 
@@ -378,7 +452,8 @@ def run_fuzz(n_seeds=None, n_cases=None, verbose=False):
         signal.signal(signal.SIGALRM, _alarm)
 
     stats = {'cases': 0, 'rejected': 0, 'accepted': 0, 'escaped': []}
-    heavy = {'loader_batch', 'apply_quarantine', 'hashindex_trace'}
+    heavy = {'loader_batch', 'apply_quarantine', 'hashindex_trace',
+             'storage_trace'}
     for seed in range(n_seeds):
         rng = random.Random(seed)
         for case in range(n_cases):

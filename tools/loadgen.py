@@ -929,12 +929,270 @@ def run_standard_legs(sessions=1000, tenants=64, requests=10_000,
     return legs
 
 
+def run_tier_leg(name='tier_hybrid', *, docs=512, hot=48, rounds=30,
+                 writes_per_round=24, seed=0, budget_docs=None,
+                 stage_schedule=None, path=None):
+    """Hybrid live/parked storage-tier leg (ISSUE-15 acceptance): a
+    hot-skewed write stream over a doc population living under a
+    RESIDENT-BYTES ceiling, with the cost-based tiering plane doing ALL
+    demotion — zero manual ``park`` calls — fed by the round-17 memory
+    watermarks (``fleet_resident_bytes``). Parked docs that take writes
+    revive through the engine (live/parked churn -> arena garbage ->
+    cost-model vacuums), and a brownout stage schedule (stage 2 mid-leg
+    by default) runs the model's defer/fire ledger, flight-recorded.
+
+    Final CONVERGENCE AUDIT: every doc — live or parked — must be
+    byte-identical to a control fleet fed exactly the committed
+    changes (parked docs compare their canonical chunk bytes; no
+    revive). Returns the leg report dict; ``ok`` summarizes."""
+    import shutil
+    import tempfile
+    from automerge_tpu.fleet.backend import init_docs
+    from automerge_tpu.fleet.storage import StorageEngine
+    from automerge_tpu.fleet.tiering import (ClockDemote, CostModel,
+                                             TieringController,
+                                             tiering_stats)
+    from automerge_tpu.observability.perf import sample_watermarks
+
+    rng = random.Random(seed)
+    root = path or tempfile.mkdtemp(prefix='loadgen-tier-')
+    own_root = path is None
+    fleet = DocFleet()
+    eng = StorageEngine(fleet, path=os.path.join(root, 'arena'))
+
+    # the demote signal: LIVE (unfrozen) docs. The fleet's device grids
+    # are capacity-sized (fleet_resident_bytes cannot fall when a doc
+    # parks — only a capacity shrink moves it), so the leg budgets the
+    # per-doc HOST cost directly: live-doc count against a doc budget,
+    # with the byte watermarks sampled into the report for the record.
+    def resident():
+        return sum(1 for h in by_doc.values()
+                   if h is not None and not h.get('frozen'))
+
+    handles = init_docs(docs, fleet)
+    ledger = [[] for _ in range(docs)]       # committed changes per doc
+    seqs = [0] * docs
+    by_doc = {d: handles[d] for d in range(docs)}   # live handle or None
+    parked_id = [None] * docs
+
+    def write_round(targets):
+        per_handle, hs = [], []
+        for d in targets:
+            seqs[d] += 1
+            heads = fleet_backend.get_heads(by_doc[d])
+            buf = encode_change({
+                'actor': f'{d:04x}' * 4, 'seq': seqs[d],
+                'startOp': seqs[d], 'time': 0, 'message': '',
+                'deps': heads,
+                'ops': [{'action': 'set', 'obj': '_root',
+                         'key': f'k{seqs[d] % 4}', 'value': d * 100 + seqs[d],
+                         'datatype': 'int', 'pred': []}]})
+            ledger[d].append(buf)
+            per_handle.append([buf])
+            hs.append(by_doc[d])
+        out, _ = fleet_backend.apply_changes_docs(hs, per_handle,
+                                                  mirror=False)
+        for d, h in zip(targets, out):
+            by_doc[d] = h
+        return out
+
+    # seed every doc with one change so parked chunks are non-trivial
+    write_round(list(range(docs)))
+    if budget_docs is None:
+        budget_docs = max(hot * 2, docs // 4)
+    budget = budget_docs
+    policy = ClockDemote(eng, budget_bytes=budget,
+                         source=resident, batch=64)
+    # the seam returns FRESH handle dicts each apply (the old ones
+    # freeze): register the post-write handles, and re-register after
+    # every round below — stale ring entries prune themselves
+    policy.register(list(by_doc.values()))
+    # an eager model at leg scale: revive-discard garbage pays for a
+    # rewrite quickly at stage 0, while the stage-2 write penalty defers
+    # it — both verdicts land in the flight record over one leg
+    ctrl = TieringController(engine=eng, demote=policy,
+                             model=CostModel(min_garbage_bytes=1024,
+                                             garbage_byte_cost=8.0))
+    t0 = dict(tiering_stats())
+    if stage_schedule is None:
+        stage_schedule = [0] * (rounds // 3) + [2] * (rounds // 3) + \
+            [0] * (rounds - 2 * (rounds // 3))
+
+    pressures = []
+    revived = 0
+    for r in range(rounds):
+        # hot-skewed target draw: 80% hot set, 20% tail
+        targets = sorted({
+            rng.randrange(hot) if rng.random() < 0.8
+            else rng.randrange(docs) for _ in range(writes_per_round)})
+        # revive any parked targets through the engine (hybrid churn)
+        need = [d for d in targets if by_doc[d] is None]
+        if need:
+            got = eng.revive([parked_id[d] for d in need])
+            revived += len(need)
+            for d, h in zip(need, got):
+                by_doc[d] = h
+                parked_id[d] = None
+            policy.register(got)
+        out = write_round(targets)
+        policy.register(out)
+        policy.touch(out)
+        stage = stage_schedule[min(r, len(stage_schedule) - 1)]
+        ctrl.tick(stage=stage)
+        # fold the tick's parks back into the doc map (handle -> id
+        # pairs from the clock, so a later write can revive by id)
+        if policy.last_parked:
+            doc_of = {id(h): d for d, h in by_doc.items()
+                      if h is not None}
+            for h, i in policy.last_parked:
+                d = doc_of.get(id(h))
+                if d is not None:
+                    by_doc[d] = None
+                    parked_id[d] = i
+        pressures.append(policy.pressure())
+
+    # ---- convergence audit: control fleet fed exactly the ledger ----
+    control_fleet = DocFleet()
+    control = init_docs(docs, control_fleet)
+    control, _ = fleet_backend.apply_changes_docs(
+        control, [list(l) for l in ledger], mirror=False)
+    mismatches = 0
+    for d in range(docs):
+        want = bytes(control[d]['state'].save())
+        if by_doc[d] is not None:
+            got = bytes(by_doc[d]['state'].save())
+        elif parked_id[d] is not None:
+            got = bytes(eng.chunk(parked_id[d]))
+        else:
+            mismatches += 1
+            continue
+        if got != want:
+            mismatches += 1
+    t1 = dict(tiering_stats())
+    final_pressure = policy.pressure()
+    marks = sample_watermarks()
+    report = {
+        'leg': name, 'docs': docs, 'rounds': rounds,
+        'watermarks': {k: marks.get(k, 0) for k in
+                       ('rss', 'mainstore_bytes', 'mainstore_disk_bytes')},
+        'demoted': t1['tiering_demoted_docs'] - t0['tiering_demoted_docs'],
+        'model_vacuums': t1['tiering_vacuums'] - t0['tiering_vacuums'],
+        'engine_vacuums': eng.vacuums,
+        'deferred': t1['tiering_deferred'] - t0['tiering_deferred'],
+        'revived': revived,
+        'manual_parks': 0,
+        'budget_bytes': budget,
+        'final_pressure': round(final_pressure, 3),
+        'max_late_pressure': round(max(pressures[rounds // 2:]), 3),
+        'audit_mismatches': mismatches,
+        'parked_final': len(eng.main),
+    }
+    report['ok'] = mismatches == 0 and report['demoted'] > 0 and \
+        final_pressure <= 1.05
+    eng.close()
+    if own_root:
+        shutil.rmtree(root, ignore_errors=True)
+    return report
+
+
+def run_tier_kill_leg(name='tier_kill', *, docs=32, seed=0, path=None):
+    """Kill-driven vacuum leg: a CHILD process parks a doc population
+    on the mmap arena, discards a slice, and hard-dies (os._exit)
+    INSIDE the vacuum's manifest swap; the parent recovers the arena
+    via StorageEngine.open and audits every surviving doc byte-for-byte
+    against the child's pre-kill expectations."""
+    import shutil
+    import subprocess
+    import tempfile
+    root = path or tempfile.mkdtemp(prefix='loadgen-tierkill-')
+    own_root = path is None
+    arena = os.path.join(root, 'arena')
+    expect_path = os.path.join(root, 'expect.bin')
+    script = f'''
+import os, sys
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+from automerge_tpu.columnar import encode_change
+from automerge_tpu.fleet import backend as fb
+from automerge_tpu.fleet.backend import DocFleet, init_docs
+from automerge_tpu.fleet.storage import StorageEngine
+fleet = DocFleet()
+eng = StorageEngine(fleet, path={arena!r}, vacuum_dead_fraction=None)
+handles = init_docs({docs}, fleet)
+per = [[encode_change({{'actor': f'{{d:04x}}' * 4, 'seq': 1, 'startOp': 1,
+        'time': 0, 'message': '', 'deps': [],
+        'ops': [{{'action': 'set', 'obj': '_root', 'key': 'k',
+                 'value': d, 'datatype': 'int', 'pred': []}}]}})]
+       for d in range({docs})]
+handles, _ = fb.apply_changes_docs(handles, per, mirror=False)
+saves = [bytes(h['state'].save()) for h in handles]
+ids = eng.park(handles)
+keep = ids[{docs} // 3:]
+import struct
+with open({expect_path!r}, 'wb') as f:
+    for i in keep:
+        f.write(struct.pack('<qI', i, len(saves[i])) + saves[i])
+eng.discard(ids[:{docs} // 3])
+eng.main.sync()
+eng.main._arena.fault_point = 'exit:post_manifest'
+eng.vacuum_now()       # never returns
+'''
+    proc = subprocess.run([sys.executable, '-c', script],
+                          capture_output=True, timeout=600)
+    report = {'leg': name, 'docs': docs,
+              'child_exit': proc.returncode}
+    if proc.returncode != 71:
+        report['ok'] = False
+        report['stderr'] = proc.stderr.decode()[-1000:]
+        return report
+    import struct
+    from automerge_tpu.fleet.storage import StorageEngine
+    expect = {}
+    with open(expect_path, 'rb') as f:
+        while True:
+            head = f.read(12)
+            if len(head) < 12:
+                break
+            i, ln = struct.unpack('<qI', head)
+            expect[i] = f.read(ln)
+    eng = StorageEngine.open(arena)
+    mismatches = sum(
+        1 for i, want in expect.items()
+        if i not in eng._row_of or bytes(eng.chunk(i)) != want)
+    missing = sorted(set(eng._row_of) - set(expect))
+    report.update(recovered=len(eng._row_of),
+                  expected=len(expect),
+                  audit_mismatches=mismatches,
+                  resurrected=len(missing),
+                  ok=mismatches == 0 and not missing and
+                  len(eng._row_of) == len(expect))
+    eng.close()
+    if own_root:
+        shutil.rmtree(root, ignore_errors=True)
+    return report
+
+
 def main():
     sessions = int(os.environ.get('LOADGEN_SESSIONS', 1000))
     tenants = int(os.environ.get('LOADGEN_TENANTS', 64))
     requests = int(os.environ.get('LOADGEN_REQUESTS', 10_000))
     seed = int(os.environ.get('LOADGEN_SEED', 0))
     n_shards = int(os.environ.get('LOADGEN_SHARDS', 0))
+    if os.environ.get('LOADGEN_TIER'):
+        # storage-tier mode: the hybrid auto-demote leg + the
+        # kill-mid-vacuum recovery leg (ISSUE-15 acceptance)
+        legs = [
+            run_tier_leg(docs=int(os.environ.get('LOADGEN_TIER_DOCS',
+                                                 512)), seed=seed),
+            run_tier_kill_leg(seed=seed + 1),
+        ]
+        for leg in legs:
+            print(json.dumps(leg))
+            print(f"# {leg['leg']}: {'OK' if leg['ok'] else 'FAIL'} "
+                  f"{leg}", file=sys.stderr)
+            if not leg['ok']:
+                sys.exit(1)
+        return
     if n_shards:
         # multi-shard mode: a clean leg plus a kill-one-shard chaos leg
         # (kill at 1/3 of the arrival window, revive at 2/3)
