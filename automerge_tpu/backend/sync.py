@@ -426,13 +426,26 @@ def generate_sync_message(backend, sync_state):
     if heads_unchanged and heads_equal and not changes_to_send:
         return [sync_state, None]
 
-    changes_to_send = [c for c in changes_to_send
-                       if _cached_meta(c)['hash'] not in sent_hashes]
+    # A state promoted by the fleet driver carries its sentHashes as a
+    # peer-space of the device table (fleet/hashindex.py PeerSentSet):
+    # answer the whole filter in ONE batched probe, and stage new sends
+    # in place — the copy-on-write below only ever shielded old state
+    # dicts, which the peer-space path shares by identity instead.
+    contains_many = getattr(sent_hashes, 'contains_many', None)
+    if contains_many is not None and changes_to_send:
+        already = contains_many([_cached_meta(c)['hash']
+                                 for c in changes_to_send])
+        changes_to_send = [c for c, hit in zip(changes_to_send, already)
+                           if not hit]
+    else:
+        changes_to_send = [c for c in changes_to_send
+                           if _cached_meta(c)['hash'] not in sent_hashes]
 
     message = {'heads': our_heads, 'have': our_have, 'need': our_need,
                'changes': changes_to_send}
     if changes_to_send:
-        sent_hashes = set(sent_hashes)
+        if contains_many is None:
+            sent_hashes = set(sent_hashes)
         for change in changes_to_send:
             sent_hashes.add(_cached_meta(change)['hash'])
 
@@ -478,9 +491,15 @@ def receive_sync_message(backend, old_sync_state, binary_message):
                    if known]
     if len(known_heads) == len(message['heads']):
         shared_heads = message['heads']
-        # Remote peer lost all its data: reset for a full resync
+        # Remote peer lost all its data: reset for a full resync (a
+        # peer-space sent set hands its table space back, see
+        # fleet/hashindex.py — duck-typed so this module stays
+        # fleet-agnostic)
         if len(message['heads']) == 0:
             last_sent_heads = []
+            release = getattr(sent_hashes, 'release', None)
+            if release is not None:
+                release()
             sent_hashes = set()
     else:
         shared_heads = sorted(set(known_heads) | set(shared_heads))

@@ -39,6 +39,7 @@ def dispatch_count():
     return _dispatches
 
 
+from ..observability import hist as _hist  # noqa: E402
 from ..observability import register_dispatch_source  # noqa: E402
 from ..observability.perf import instrument_kernel  # noqa: E402
 from ..observability.spans import spanned as _spanned  # noqa: E402
@@ -268,6 +269,11 @@ def build_bloom_filters_batch_begin(hash_lists):
     global _dispatches
     entry_counts = [len(row) for row in hash_lists]
     live = [i for i, n in enumerate(entry_counts) if n > 0]
+    # fabric fan-in visibility: how many peer links each fused build
+    # actually carried (the sync_fabric bench and obs_report read the
+    # histogram to confirm rounds stay fused as the link count grows)
+    if _hist.on():
+        _hist.record_value('bloom_fused_links', len(live), unit='links')
     if not live:
         return len(hash_lists), entry_counts, live, None, None
     words, valid = hashes_to_words([hash_lists[i] for i in live])
@@ -354,6 +360,8 @@ def probe_bloom_filters_batch_begin(filter_bytes, hash_lists):
             _wire_stats.inc('rejected_filters')
             continue
         rows.append((i, np.frombuffer(raw, dtype=np.uint8), 8 * len(raw)))
+    if _hist.on():
+        _hist.record_value('bloom_fused_probe_links', len(rows), unit='links')
     if not rows:
         return out, hash_lists, None, None
     words, valid = hashes_to_words([hash_lists[i] for i, _, _ in rows])

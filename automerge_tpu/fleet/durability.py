@@ -1210,6 +1210,30 @@ class DurableFleet:
         return {'bytes': j.written_bytes + j.buffered_bytes,
                 'records': j.records}
 
+    def chain_debt(self):
+        """Stitch debt of the incremental chain: the segments past the
+        base snapshot and their on-disk bytes — what recovery must open
+        and scan ON TOP of the base, and what the retention sweep must
+        keep protected. Feeds CostModel.chain_escalate_due."""
+        tail = self.chain[1:]
+        total = 0
+        for name in tail:
+            try:
+                total += os.path.getsize(os.path.join(self.path, name))
+            except OSError:
+                pass
+        return {'segments': len(tail), 'bytes': total}
+
+    def base_bytes(self):
+        """On-disk size of the chain's base snapshot (0 when none) —
+        the dominant term of a full checkpoint's rewrite cost."""
+        if not self.chain:
+            return 0
+        try:
+            return os.path.getsize(os.path.join(self.path, self.chain[0]))
+        except OSError:
+            return 0
+
     def maybe_compact(self, force=False):
         """Compact once replay debt crosses the byte/record threshold
         (the LSM-style cost trigger). Compaction is INCREMENTAL: only
@@ -1357,7 +1381,16 @@ class DurableFleet:
         anything was persisted (incl. the escalated full checkpoint),
         False when zero churn made it a no-op. Recovery stitches the
         chain; byte-identical to a full-checkpoint recovery."""
-        if not self.chain or len(self.chain) >= self.max_chain:
+        escalate = not self.chain or len(self.chain) >= self.max_chain
+        model = getattr(self, 'cost_model', None)
+        if not escalate and model is not None:
+            # the attached cost model (TieringController wires it) may
+            # escalate EARLIER than the fixed ceiling when the chain's
+            # stitch debt already outweighs the full rewrite; max_chain
+            # stays the hard backstop bounding stitch work absolutely
+            escalate = model.chain_escalate_due(
+                self, stage=getattr(self, 'pressure_stage', 0))
+        if escalate:
             # no base yet (a fleet that never checkpointed): segments
             # without a base are invisible to the manifest-rot fallback
             # scan, and retention would eventually delete the journals

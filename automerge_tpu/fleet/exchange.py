@@ -133,14 +133,22 @@ def sync_round_sharded(mesh, axis, backends, sync_states, generate, receive):
     inboxes = np.asarray(jax.device_get(inboxes))
     in_lens = np.asarray(jax.device_get(in_lens))
 
-    moved = 0
+    items = []
     for dst in range(n):
         for src in range(n):
             length = int(in_lens[dst, src])
             if length:
-                receive(dst, src, inboxes[dst, src, :length].tobytes())
-                moved += 1
-    return moved
+                items.append((dst, src,
+                              inboxes[dst, src, :length].tobytes()))
+    all_fn = getattr(receive, 'all', None)
+    if all_fn is not None:
+        # fused receive waves (see _pairwise_callbacks.receive_all):
+        # O(max inbox depth) driver calls per round instead of O(pairs)
+        all_fn(items)
+    else:
+        for dst, src, payload in items:
+            receive(dst, src, payload)
+    return len(items)
 
 
 def _pairwise_callbacks(docs, sync_states, backend_module):
@@ -194,6 +202,43 @@ def _pairwise_callbacks(docs, sync_states, backend_module):
             docs[dst], sync_states[(dst, src)], payload)
         docs[dst] = doc
         sync_states[(dst, src)] = state
+
+    from ..backend.sync import receive_sync_message as _canonical_recv
+    if getattr(backend_module, 'receive_sync_message', None) \
+            is _canonical_recv:
+        from .sync_driver import receive_sync_messages_docs as \
+            batched_recv
+    else:
+        batched_recv = None
+
+    def receive_all(items):
+        """Apply a whole round's inbound (dst, src, payload) triples in
+        fused WAVES: wave k carries each destination's k-th message, so
+        every wave is one batched receive over DISTINCT dst docs — the
+        per-(dst, src) stream order the sharedHeads algebra depends on
+        is preserved, wire behavior byte-identical to the per-pair
+        loop, and a round costs O(max inbox depth) fused driver calls
+        instead of O(pairs)."""
+        if batched_recv is None:
+            for dst, src, payload in items:
+                receive(dst, src, payload)
+            return
+        queues = {}
+        for dst, src, payload in items:
+            queues.setdefault(dst, []).append((src, payload))
+        while queues:
+            wave = [(dst, q.pop(0)) for dst, q in queues.items()]
+            new_docs, new_states, _patches = batched_recv(
+                [docs[dst] for dst, _ in wave],
+                [sync_states[(dst, src)] for dst, (src, _p) in wave],
+                [payload for _dst, (_src, payload) in wave])
+            for (dst, (src, _p)), doc, state in zip(wave, new_docs,
+                                                    new_states):
+                docs[dst] = doc
+                sync_states[(dst, src)] = state
+            queues = {d: q for d, q in queues.items() if q}
+
+    receive.all = receive_all
 
     return generate, receive
 
@@ -347,8 +392,14 @@ def _sync_round_multihost(mesh, axis, generate, receive, max_msg,
                 if fragment:
                     inbox_acc.setdefault((dst, src),
                                          bytearray()).extend(fragment)
-    for (dst, src), payload in inbox_acc.items():
-        receive(dst, src, bytes(payload))
+    items = [(dst, src, bytes(payload))
+             for (dst, src), payload in inbox_acc.items()]
+    all_fn = getattr(receive, 'all', None)
+    if all_fn is not None:
+        all_fn(items)
+    else:
+        for dst, src, payload in items:
+            receive(dst, src, payload)
     # the GLOBAL count, identical on every controller: callers may branch
     # on it (the driver's lock-step break) — a process-local count here
     # would desync the round loops and deadlock the next collective

@@ -55,8 +55,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ['HashIndex', 'FleetFrontierIndex', 'frontier_compare',
-           'hashes_to_rows', 'engine_hash_population', 'dispatch_count']
+__all__ = ['HashIndex', 'FleetFrontierIndex', 'PeerSentSet',
+           'flush_peer_sets', 'probe_peer_sets', 'release_sent_hashes',
+           'release_sync_state', 'frontier_compare', 'hashes_to_rows',
+           'engine_hash_population', 'dispatch_count', 'probe_window',
+           'set_probe_window']
 
 _GOLD = np.uint32(0x9E3779B9)     # Fibonacci-hash mix for the space id
 
@@ -97,6 +100,44 @@ def set_frontier_enabled(on):
     return prev
 
 
+def _env_int(name, default, lo, hi):
+    try:
+        val = int(_os.environ.get(name, '') or default)
+    except ValueError:
+        val = default
+    return max(lo, min(hi, val))
+
+
+# The windowed-probe width and the host/device crossover were both tuned
+# against XLA-CPU dispatch overhead (a while_loop iteration costs
+# ~0.1 ms there). On-chip both tradeoffs move, so they are env-tunable —
+# no code change to re-tune the fabric — and bench.py sweeps the window.
+_DEF_PROBE_WINDOW = 16
+_DEF_DEVICE_MIN = 4096
+_probe_window = _env_int('AUTOMERGE_TPU_PROBE_WINDOW',
+                         _DEF_PROBE_WINDOW, 1, 1024)
+_default_device_min = _env_int('AUTOMERGE_TPU_DEVICE_MIN',
+                               _DEF_DEVICE_MIN, 0, 1 << 30)
+
+
+def probe_window():
+    """Current windowed-probe width (slots gathered per probe before the
+    serial tail walk). Set via AUTOMERGE_TPU_PROBE_WINDOW or
+    ``set_probe_window``."""
+    return _probe_window
+
+
+def set_probe_window(width):
+    """Set the probe window width (bench sweep / on-chip retune);
+    returns the previous width. The probe kernel specializes per width
+    (static jit arg), so each distinct width compiles once per batch
+    shape and is cached thereafter."""
+    global _probe_window
+    prev = _probe_window
+    _probe_window = max(1, min(1024, int(width)))
+    return prev
+
+
 from ..observability import register_dispatch_source  # noqa: E402
 from ..observability.metrics import Counters  # noqa: E402
 from ..observability.perf import instrument_kernel, register_mem_source  # noqa: E402
@@ -109,18 +150,23 @@ _stats = Counters({
     'hashindex_migrations': 0,    # grow-by-migration passes
     'hashindex_promotions': 0,    # host-mode tables promoted to device
     'hashindex_backfills': 0,     # doc registrations (history backfills)
+    'hashindex_peer_spaces': 0,   # peer sentHashes spaces minted
+    'hashindex_peer_releases': 0,  # peer spaces handed back
 })
 from ..observability import register_health_source  # noqa: E402
 for _key in _stats:
     register_health_source(_key, lambda k=_key: _stats[k])
 
 _live_indexes = weakref.WeakSet()
+_live_peer_sets = weakref.WeakSet()
 
 
 def _index_bytes():
     total = 0
     for ix in list(_live_indexes):
         total += ix.resident_bytes()
+    for ps in list(_live_peer_sets):
+        total += ps.staged_bytes()
     return total
 
 
@@ -215,29 +261,27 @@ def _insert_kernel(tkey, tspace, keys, spaces, valid):
     return tkey, tspace, n_new
 
 
-_PROBE_WINDOW = 16
-
-
-def _probe_kernel(tkey, tspace, keys, spaces, valid):
+def _probe_kernel(tkey, tspace, keys, spaces, valid, window):
     """Batched exact-membership probe; [N] bool (True = present). The
-    first _PROBE_WINDOW slots of every row's chain are gathered and
+    first `window` slots of every row's chain are gathered and
     compared in ONE vectorized pass (XLA-CPU while_loop iterations cost
     ~0.1ms each in dispatch overhead, so the common short-chain case
     must not loop); only rows still undecided after the window — all
     occupied, no match, possible at high load — take the serial tail
-    walk. Sound because slots are never emptied in place (dead spaces
+    walk. `window` is a static jit arg (see ``set_probe_window``).
+    Sound because slots are never emptied in place (dead spaces
     stay occupied until migration), so a chain scan ending at an empty
     slot is always conclusive."""
     cap = tkey.shape[0]
     wrap = jnp.int32(cap - 1)
     pos0 = _start_pos(keys, spaces, cap)
-    w = jnp.arange(_PROBE_WINDOW, dtype=jnp.int32)
+    w = jnp.arange(window, dtype=jnp.int32)
     win = (pos0[:, None] + w[None, :]) & wrap            # [N, W]
     slot_space = tspace[win]                             # [N, W]
     occ = slot_space >= 0
     match = occ & (slot_space == spaces[:, None]) & \
         jnp.all(tkey[win] == keys[:, None, :], axis=-1)  # [N, W]
-    big = jnp.int32(_PROBE_WINDOW + 1)
+    big = jnp.int32(window + 1)
     first_match = jnp.min(jnp.where(match, w[None, :], big), axis=1)
     first_empty = jnp.min(jnp.where(~occ, w[None, :], big), axis=1)
     found = valid & (first_match < first_empty)
@@ -258,7 +302,7 @@ def _probe_kernel(tkey, tspace, keys, spaces, valid):
         pos = jnp.where(active, (pos + 1) & wrap, pos)
         return pos, active, found
 
-    tail_pos = (pos0 + jnp.int32(_PROBE_WINDOW)) & wrap
+    tail_pos = (pos0 + jnp.int32(window)) & wrap
     _pos, _active, found = jax.lax.while_loop(
         cond, body, (tail_pos, undecided, found))
     return found
@@ -278,8 +322,8 @@ def _compare_kernel(cur32, cur_n, doc32, doc_n):
 # old table is dead the moment the wrapper reassigns self._tkey)
 _insert_kernel = instrument_kernel(
     'hashindex_insert', jax.jit(_insert_kernel, donate_argnums=(0, 1)))
-_probe_kernel = instrument_kernel('hashindex_probe',
-                                  jax.jit(_probe_kernel))
+_probe_kernel = instrument_kernel(
+    'hashindex_probe', jax.jit(_probe_kernel, static_argnums=(5,)))
 _compare_kernel = instrument_kernel('frontier_compare',
                                     jax.jit(_compare_kernel))
 
@@ -331,10 +375,13 @@ class HashIndex:
     sets) below ``device_min`` total keys; device mode past it; both
     modes answer identically (the adversarial suite pins it)."""
 
-    def __init__(self, capacity=1024, device_min=4096, load_max=0.6):
+    def __init__(self, capacity=1024, device_min=None, load_max=0.6):
         if load_max <= 0 or load_max >= 1:
             raise ValueError('load_max must be in (0, 1)')
-        self.device_min = int(device_min)
+        # None -> AUTOMERGE_TPU_DEVICE_MIN (default 4096) so the
+        # host/device crossover is re-tunable on-chip without code
+        self.device_min = _default_device_min if device_min is None \
+            else int(device_min)
         self.load_max = float(load_max)
         self.cap = _pow2(capacity, floor=8)
         self._tkey = None          # [cap, 8] uint32 (device)
@@ -459,7 +506,7 @@ class HashIndex:
             _rows_to_words(rows), spaces, valid)
         hit = _probe_kernel(self._tkey, self._tspace,
                             jnp.asarray(words), jnp.asarray(spaces_p),
-                            jnp.asarray(valid_p))
+                            jnp.asarray(valid_p), _probe_window)
         _dispatches += 1
         return np.asarray(hit)[:n]
 
@@ -529,6 +576,148 @@ class HashIndex:
         _stats.inc('hashindex_migrations')
 
 
+# ---- peer sent-spaces ------------------------------------------------
+
+def _release_peer_space(table, sid):
+    table.release_space(sid)
+    _stats.inc('hashindex_peer_releases')
+
+
+class PeerSentSet:
+    """One peer link's ``sentHashes`` as a *peer-space* of a shared
+    ``HashIndex``: a set-like duck type (``in`` / ``add``) whose adds
+    STAGE host-side (hex strings, bounded by sent volume) until
+    ``flush_peer_sets`` lands every link's backlog in ONE batched
+    insert per shard round. Space ids are minted monotonically and
+    never reused, so a reconnecting peer can never inherit a
+    predecessor's sent set; ``release()`` — and GC, via the finalizer,
+    for states dropped without ceremony — hands the space back for the
+    next grow-by-migration to reclaim.
+
+    Unlike the plain-set path, the object is shared BY IDENTITY across
+    sync-state generations: the classic ``set(sent_hashes)``
+    copy-on-write only shielded the OLD state dict, which no caller
+    ever re-generates from, and the promotion itself snapshots the old
+    plain set — so membership answers are unchanged."""
+
+    __slots__ = ('table', 'sid', '_staged', '_finalizer', '__weakref__')
+
+    def __init__(self, table, seed=()):
+        self.table = table
+        self.sid = table.new_space()
+        self._staged = set(seed)
+        self._finalizer = weakref.finalize(
+            self, _release_peer_space, table, self.sid)
+        _stats.inc('hashindex_peer_spaces')
+        _live_peer_sets.add(self)
+
+    @property
+    def alive(self):
+        return self._finalizer.alive
+
+    def __contains__(self, hash_hex):
+        if hash_hex in self._staged:
+            return True
+        return bool(self.table.probe(self.sid, [hash_hex])[0])
+
+    def add(self, hash_hex):
+        self._staged.add(hash_hex)
+
+    def stage_many(self, hashes):
+        self._staged.update(hashes)
+
+    def contains_many(self, hashes):
+        """[N] bool membership without flushing: staged hashes answer
+        host-side, the remainder in one probe."""
+        out = np.zeros(len(hashes), dtype=bool)
+        rest = []
+        for i, h in enumerate(hashes):
+            if h in self._staged:
+                out[i] = True
+            else:
+                rest.append(i)
+        if rest:
+            out[rest] = self.table.probe(
+                self.sid, [hashes[i] for i in rest])
+        return out
+
+    def flush(self):
+        """Land this one link's staged rows (prefer the module-level
+        ``flush_peer_sets`` — it batches N links into one insert)."""
+        flush_peer_sets([self])
+
+    def release(self):
+        """Disconnect / reset: hand the space back (idempotent)."""
+        if self._finalizer.alive:
+            self._staged.clear()
+            self._finalizer()
+
+    def staged_bytes(self):
+        # staged hex strings: ~112 B apiece (64-char str + set slot)
+        return len(self._staged) * 112
+
+
+def flush_peer_sets(peer_sets):
+    """Land every staged (peer-space, hash) row across N links in ONE
+    batched insert per underlying table — THE per-shard-round insert of
+    the sync fabric. Returns the number of new keys landed."""
+    by_table = {}
+    for ps in peer_sets:
+        if isinstance(ps, PeerSentSet) and ps._staged and ps.alive:
+            by_table.setdefault(id(ps.table), (ps.table, []))[1].append(ps)
+    landed = 0
+    for table, group in by_table.values():
+        spaces, hex_list = [], []
+        for ps in group:
+            staged = sorted(ps._staged)
+            ps._staged.clear()
+            spaces.extend([ps.sid] * len(staged))
+            hex_list.extend(staged)
+        landed += table.insert(np.asarray(spaces, dtype=np.int32),
+                               hex_list)
+    return landed
+
+
+def release_sent_hashes(obj):
+    """Hand back the peer-space behind a ``sentHashes`` value (no-op for
+    plain sets). Call wherever a link's sync state is discarded —
+    disconnect, ``reset=True``, stall reset — the GC finalizer would get
+    there eventually; deterministic release gets there now."""
+    if isinstance(obj, PeerSentSet):
+        obj.release()
+
+
+def release_sync_state(state):
+    """``release_sent_hashes`` over a whole sync-state dict."""
+    if isinstance(state, dict):
+        release_sent_hashes(state.get('sentHashes'))
+
+
+def probe_peer_sets(peer_sets, hash_lists):
+    """Fused sentHashes filter: ``out[i][j]`` is True iff
+    ``hash_lists[i][j]`` was already sent on link ``peer_sets[i]``.
+    Every link's staged backlog flushes first (at most one insert per
+    table), then ALL links' questions ride one probe dispatch per
+    table. Released links answer all-False (their space is dead)."""
+    flush_peer_sets(peer_sets)
+    out = [np.zeros(len(hs), dtype=bool) for hs in hash_lists]
+    by_table = {}
+    for i, (ps, hs) in enumerate(zip(peer_sets, hash_lists)):
+        if hs and isinstance(ps, PeerSentSet):
+            by_table.setdefault(id(ps.table), (ps.table, []))[1].append(i)
+    for table, idxs in by_table.values():
+        spaces, hex_list, owner = [], [], []
+        for i in idxs:
+            hs = list(hash_lists[i])
+            spaces.extend([peer_sets[i].sid] * len(hs))
+            hex_list.extend(hs)
+            owner.extend([(i, j) for j in range(len(hs))])
+        hit = table.probe(np.asarray(spaces, dtype=np.int32), hex_list)
+        for (i, j), h in zip(owner, hit):
+            out[i][j] = bool(h)
+    return out
+
+
 # ---- fleet wiring ----------------------------------------------------
 
 def engine_hash_population(engine):
@@ -580,7 +769,7 @@ class FleetFrontierIndex:
     ``engine_hash_population``); slot frees release the space
     (reclaimed at the next migration — tombstone-free)."""
 
-    def __init__(self, fleet, device_min=4096, capacity=1024):
+    def __init__(self, fleet, device_min=None, capacity=1024):
         self._fleet_ref = weakref.ref(fleet)
         self.table = HashIndex(capacity=capacity, device_min=device_min)
         self._spaces = {}          # slot -> space id

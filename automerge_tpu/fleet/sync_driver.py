@@ -57,12 +57,17 @@ __all__ = ['generate_sync_messages_docs', 'receive_sync_messages_docs',
 
 # the enable flag lives in hashindex so the single-doc protocol path
 # (backend/sync.py -> _FlatEngine.probe_hashes) honors the same toggle
-from .hashindex import frontier_enabled, set_frontier_enabled  # noqa: E402,F401
+from .hashindex import (  # noqa: E402,F401
+    PeerSentSet, frontier_enabled, probe_peer_sets, release_sync_state,
+    set_frontier_enabled,
+)
 
 _stats = Counters({
     'sync_frontier_member_docs': 0,     # docs probed via the hashindex
     'sync_frontier_straggler_docs': 0,  # docs routed classic in a
-})                                      # frontier-served round
+                                        # frontier-served round
+    'sync_peer_space_links': 0,         # links whose sentHashes rode a
+})                                      # peer-space this round
 for _key in _stats:
     register_health_source(_key, lambda k=_key: _stats[k])
 
@@ -151,6 +156,26 @@ def _batched_generate_probes(frontier, sync_states):
         if last_syncs[i]:
             reset_known[i] = all(flags[len(cands[i]):])
     return our_need, reset_known
+
+
+def _fused_sent_filter(sync_states, changes_to_send_by_doc):
+    """{i: [bool]} "already sent on this link?" flags for every doc
+    whose sentHashes rides a peer-space (``PeerSentSet``): ALL such
+    links' questions fuse into at most one staged-flush insert plus one
+    probe dispatch for the round (hashindex.probe_peer_sets). Plain-set
+    links are absent — their check is a host set hit, and a member link
+    only promotes to a peer-space the first time it actually sends."""
+    idxs = [i for i, ch in changes_to_send_by_doc.items()
+            if ch and isinstance(sync_states[i]['sentHashes'],
+                                 PeerSentSet)]
+    if not idxs:
+        return {}
+    flags = probe_peer_sets(
+        [sync_states[i]['sentHashes'] for i in idxs],
+        [[_cached_meta(c)['hash'] for c in changes_to_send_by_doc[i]]
+         for i in idxs])
+    _stats.inc('sync_peer_space_links', len(idxs))
+    return dict(zip(idxs, flags))
 
 
 def generate_sync_messages_docs(backends, sync_states, deadline=None,
@@ -277,6 +302,12 @@ def _generate_inner(backends, sync_states, n):
                 backends[i], changes, bloom_hits,
                 sync_states[i]['theirNeed'])
 
+    # Fused sentHashes filter: every peer-space link's already-sent?
+    # questions ride one flush insert + one probe dispatch for the whole
+    # round, regardless of link count (tentpole of the sync fabric)
+    sent_flags = _fused_sent_filter(sync_states, changes_to_send_by_doc)
+    member_docs = frontier[1] if frontier is not None else {}
+
     new_states, messages = [], []
     with _span('sync_encode', docs=n):
         for i, (backend, state) in enumerate(zip(backends, sync_states)):
@@ -294,14 +325,35 @@ def _generate_inner(backends, sync_states, n):
                 messages.append(None)
                 continue
             sent_hashes = state['sentHashes']
-            changes_to_send = [c for c in changes_to_send
-                               if _cached_meta(c)['hash'] not in sent_hashes]
+            if i in sent_flags:
+                changes_to_send = [c for c, hit in zip(changes_to_send,
+                                                       sent_flags[i])
+                                   if not hit]
+            else:
+                changes_to_send = [
+                    c for c in changes_to_send
+                    if _cached_meta(c)['hash'] not in sent_hashes]
             message = {'heads': our_heads[i], 'have': our_have[i],
                        'need': our_need[i], 'changes': changes_to_send}
             if changes_to_send:
-                sent_hashes = set(sent_hashes)
-                for change in changes_to_send:
-                    sent_hashes.add(_cached_meta(change)['hash'])
+                new_hashes = [_cached_meta(c)['hash']
+                              for c in changes_to_send]
+                if isinstance(sent_hashes, PeerSentSet):
+                    # staged host-side; next round's fused filter (or
+                    # flush_peer_sets) lands the whole shard's backlog
+                    # in ONE insert
+                    sent_hashes.stage_many(new_hashes)
+                elif i in member_docs:
+                    # first send on a member link: promote the plain set
+                    # to a peer-space of the fleet's table — the
+                    # promotion snapshot IS the copy-on-write the
+                    # classic path performed
+                    sent_hashes = PeerSentSet(frontier[0].table,
+                                              seed=sent_hashes)
+                    sent_hashes.stage_many(new_hashes)
+                else:
+                    sent_hashes = set(sent_hashes)
+                    sent_hashes.update(new_hashes)
             new_states.append(dict(state, lastSentHeads=our_heads[i],
                                    sentHashes=sent_hashes))
             messages.append(encode_sync_message(message))
@@ -526,6 +578,9 @@ def _receive_inner(backends, sync_states, binary_messages, mirror,
             shared_heads = message['heads']
             if len(message['heads']) == 0:
                 last_sent_heads = []
+                # peer lost all data: its sent set must not survive —
+                # hand a peer-space back deterministically
+                release_sync_state(state)
                 sent_hashes = set()
         else:
             shared_heads = sorted(set(known_heads) | set(shared_heads))
@@ -757,6 +812,7 @@ def receive_sync_messages_mixed(storage, docs, sync_states,
         shared_heads = decoded['heads']
         if len(decoded['heads']) == 0:
             last_sent = []
+            release_sync_state(state)
             sent_hashes = set()
         new_states[i] = {
             'sharedHeads': shared_heads,

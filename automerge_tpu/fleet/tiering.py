@@ -68,7 +68,7 @@ class CostModel:
     def __init__(self, write_byte_cost=1.0, garbage_byte_cost=2.0,
                  replay_byte_cost=3.0, replay_record_cost=256.0,
                  stage_write_penalty=7.0, min_garbage_bytes=256 << 10,
-                 min_replay_bytes=64 << 10):
+                 min_replay_bytes=64 << 10, segment_stitch_cost=64 << 10):
         self.write_byte_cost = float(write_byte_cost)
         self.garbage_byte_cost = float(garbage_byte_cost)
         self.replay_byte_cost = float(replay_byte_cost)
@@ -76,6 +76,10 @@ class CostModel:
         self.stage_write_penalty = float(stage_write_penalty)
         self.min_garbage_bytes = int(min_garbage_bytes)
         self.min_replay_bytes = int(min_replay_bytes)
+        # per-segment recovery overhead (file open + frame validation)
+        # in byte-units: many tiny segments can justify an escalation
+        # even when their summed bytes look cheap
+        self.segment_stitch_cost = float(segment_stitch_cost)
         self._verdicts = {}          # (kind, target id) -> last verdict
 
     def _pressure_mult(self, stage):
@@ -137,6 +141,31 @@ class CostModel:
         fire = benefit > base_cost * self._pressure_mult(stage)
         deferred = (not fire) and benefit > base_cost
         self._note('compact', durable, fire, deferred, stage)
+        return fire
+
+    def chain_escalate_due(self, durable, stage=0):
+        """Should the next incremental compaction escalate to a FULL
+        checkpoint? Benefit: retiring the chain's stitch debt — the
+        tail segment bytes recovery re-reads on top of the base (mostly
+        superseded doc copies, i.e. disk amplification) plus a
+        per-segment open/validate overhead. Cost: rewriting every live
+        doc (~base + tail bytes), scaled by pressure. This replaces the
+        bare ``len(chain) >= max_chain`` count as the DECIDING rule —
+        ``max_chain`` survives in DurableFleet.compact as the hard
+        ceiling bounding stitch work absolutely; the ledger only moves
+        the escalation EARLIER when the debt pays for it. Verdict flips
+        are flight-recorded like vacuum/compact."""
+        debt = durable.chain_debt()
+        if debt['segments'] == 0:
+            self._note('chain', durable, False, False, stage)
+            return False
+        benefit = debt['bytes'] * self.garbage_byte_cost + \
+            debt['segments'] * self.segment_stitch_cost
+        base_cost = (durable.base_bytes() + debt['bytes']) * \
+            self.write_byte_cost
+        fire = benefit > base_cost * self._pressure_mult(stage)
+        deferred = (not fire) and benefit > base_cost
+        self._note('chain', durable, fire, deferred, stage)
         return fire
 
 
@@ -274,6 +303,9 @@ class TieringController:
         if engine is not None:
             engine.cost_model = self.model
             engine.vacuum_dead_fraction = None
+        if durable is not None:
+            # chain-escalation verdicts route through the same ledger
+            durable.cost_model = self.model
 
     def tick(self, stage=0, durable=None):
         """Returns {'demoted': n, 'vacuumed': bool, 'compacted': bool}."""
@@ -290,8 +322,13 @@ class TieringController:
             _stats.inc('tiering_vacuums')
             out['vacuumed'] = True
         dur = durable if durable is not None else self.durable
-        if dur is not None and self.model.compact_due(dur, stage=stage):
-            if dur.maybe_compact(force=True):
+        if dur is not None:
+            # compact() consults the model for chain escalation and the
+            # stage for its pressure multiplier
+            dur.cost_model = self.model
+            dur.pressure_stage = stage
+            if self.model.compact_due(dur, stage=stage) and \
+                    dur.maybe_compact(force=True):
                 _stats.inc('tiering_compactions')
                 out['compacted'] = True
         return out

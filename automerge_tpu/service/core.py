@@ -55,6 +55,7 @@ import time
 from ..errors import (AutomergeError, DeadlineExceeded, Overloaded,
                       RetriesExhausted, SessionClosed, WireCorruption)
 from ..fleet import backend as fleet_backend
+from ..fleet.hashindex import release_sync_state
 from ..fleet.sync_driver import (generate_sync_messages_docs,
                                  receive_sync_messages_docs)
 from ..observability import hist as _hist
@@ -302,6 +303,7 @@ class DocService:
             return
         session.closed = True
         fleet_backend.free_docs([session.handle])
+        release_sync_state(session.sync_state)
         self.sessions.pop(session.id, None)
 
     def adopt_session(self, tenant, handle):
@@ -331,6 +333,7 @@ class DocService:
         if session.closed:
             return
         session.closed = True
+        release_sync_state(session.sync_state)
         self.sessions.pop(session.id, None)
 
     # -- submission ------------------------------------------------------
@@ -855,7 +858,10 @@ class DocService:
                     request.ticket.trace = ctx
             if request.reset:
                 # client reconnect: both ends handshake fresh (delivery
-                # is idempotent; only optimization state is discarded)
+                # is idempotent; only optimization state is discarded —
+                # including the old link's peer-space, handed back here
+                # so the fresh state can never inherit the sent set)
+                release_sync_state(request.session.sync_state)
                 request.session.sync_state = _init_sync_state()
                 request.session._stall_rounds = 0
             seen.add(request.session.id)
@@ -899,6 +905,7 @@ class DocService:
                 return
         ok_sessions = []
         ok_requests = []
+        served_handles = []
         for session, handle, state, err, request, message in zip(
                 sessions, handles, states, errors, live, incoming):
             session.handle = handle     # valid for rejected slots too
@@ -908,6 +915,7 @@ class DocService:
                 self._fail_or_retry(request, err.error, now, stats)
                 continue
             session.sync_state = state
+            served_handles.append(handle)
             if message is not None and session._heads_moved_tick is None:
                 # a received sync message may have applied changes: start
                 # the freshness clock (conservative — a quiet handshake
@@ -921,6 +929,9 @@ class DocService:
                 continue
             ok_sessions.append(session)
             ok_requests.append(request)
+        # recency feedback from the SYNC path, not just writes: a doc
+        # that answers handshakes every tick must not be auto-demoted
+        self._touch_tiering(served_handles)
         if not ok_sessions:
             return
         self._detect_stalls(ok_sessions, now)
@@ -943,6 +954,18 @@ class DocService:
                 request.ticket.trace is None:
             return reply
         return _trace.wrap(reply, request.ticket.trace.child())
+
+    def _touch_tiering(self, handles):
+        """Stamp served docs on the tiering demote ring (register plus
+        the second-chance bit). The clock otherwise only hears about
+        writes, so a read-mostly doc serving sync handshakes every tick
+        would look cold and get parked mid-conversation."""
+        demote = getattr(self.tiering, 'demote', None) \
+            if self.tiering is not None else None
+        if demote is None or not handles:
+            return
+        demote.register(handles)
+        demote.touch(handles)
 
     def _detect_stalls(self, sessions, now):
         """Reconnect-on-stall with jittered backoff + the tenant retry
@@ -973,6 +996,7 @@ class DocService:
                 continue
             if not self._retry_budget(session.tenant).spend(now):
                 continue
+            release_sync_state(session.sync_state)
             session.sync_state = _init_sync_state()
             session._stall_rounds = 0
             session._reconnect_attempts += 1

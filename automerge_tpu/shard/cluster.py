@@ -68,6 +68,7 @@ from ..errors import (AutomergeError, Overloaded, SessionClosed,
 from ..fleet import backend as fleet_backend
 from ..fleet.backend import DocFleet
 from ..fleet.storage import StorageEngine
+from ..fleet.hashindex import release_sync_state
 from ..fleet.sync_driver import (generate_sync_messages_docs,
                                  receive_sync_messages_docs)
 from ..observability import hist as _hist
@@ -287,6 +288,11 @@ class _Tenant:
                                     # a double-failure one cannot)
 
     def _reset_pair(self):
+        # the old handshake's sentHashes may ride fleet peer-spaces:
+        # hand them back now, not at GC (space ids are never reused, so
+        # the fresh pair cannot inherit the stale sent set either way)
+        release_sync_state(self.state_home)
+        release_sync_state(self.state_rep)
         self.state_home = init_sync_state()
         self.state_rep = init_sync_state()
         self.inbox_home = []

@@ -889,7 +889,10 @@ SANITY_KEYS = {'seam': 'seam_rate', 'registers': 'reg_rate',
                # the depth-flatness RATIO (two p50s from one process):
                # ~1.0 in a healthy tree and self-normalizing against box
                # load, unlike the raw millisecond legs
-               'frontier': 'frontier_depth_ratio'}
+               'frontier': 'frontier_depth_ratio',
+               # links served per second at the top leg: a throughput
+               # rate, stable across run order like the other rates
+               'sync_fabric': 'fabric_links_per_s'}
 
 
 def section(name):
@@ -2601,6 +2604,191 @@ def _sec_frontier():
           file=sys.stderr)
 
 
+@section('sync_fabric')
+def _sec_sync_fabric():
+    # Fleet-scale sync fabric (ISSUE-16): a shard serving N peer links
+    # out of its doc set, every link's sentHashes a peer-space in the
+    # shared frontier table. (a) steady-state round p50 across a link
+    # sweep with per-round hashindex + Bloom dispatch counts (the O(1)
+    # pin: counts must not move with N); (b) fused round vs the classic
+    # per-peer generate loop the fabric replaced (subsampled and
+    # extrapolated; acceptance >=3x at the 10k leg); (c) the probe-
+    # window sweep behind AUTOMERGE_TPU_PROBE_WINDOW.
+    from automerge_tpu.backend import init_sync_state
+    from automerge_tpu.backend.sync import generate_sync_message
+    from automerge_tpu.columnar import decode_change_meta, encode_change
+    from automerge_tpu.fleet import backend as fleet_backend
+    from automerge_tpu.fleet import bloom as fleet_bloom
+    from automerge_tpu.fleet import hashindex, sync_driver
+    from automerge_tpu.fleet.backend import DocFleet, init_docs
+    from automerge_tpu.fleet.hashindex import PeerSentSet, set_probe_window
+
+    link_sweep = [int(x) for x in os.environ.get(
+        'BENCH_FABRIC_LINKS', '1000,10000,100000').split(',')]
+    n_docs = _env('BENCH_FABRIC_DOCS', 4)
+    depth = _env('BENCH_FABRIC_DEPTH', 8)
+    loop_sample = _env('BENCH_FABRIC_LOOP_SAMPLE', 512)
+    windows = [int(x) for x in os.environ.get(
+        'BENCH_FABRIC_WINDOWS', '8,16,32').split(',')]
+
+    def chain(actor, n):
+        bufs, hashes, deps = [], [], []
+        for i in range(n):
+            buf = encode_change({
+                'actor': actor, 'seq': i + 1, 'startOp': i + 1,
+                'time': 0, 'message': '', 'deps': deps,
+                'ops': [{'action': 'set', 'obj': '_root',
+                         'key': f'k{i % 5}', 'value': i,
+                         'datatype': 'int', 'pred': []}]})
+            deps = [decode_change_meta(buf, True)['hash']]
+            bufs.append(buf)
+            hashes.append(deps[0])
+        return bufs, hashes
+
+    def solicit(states):
+        # every peer asks for a full resend (empty bloom): the round
+        # must answer membership for every candidate on every link —
+        # the fabric's worst-case steady state
+        for s in states:
+            s['theirHeads'] = []
+            s['theirHave'] = [{'lastSync': [], 'bloom': b''}]
+            s['theirNeed'] = []
+
+    round_p50, loop_ms, host_loop_ms, disp = {}, {}, {}, {}
+    for n_links in link_sweep:
+        fleet = DocFleet()
+        handles = init_docs(n_docs, fleet)
+        doc_rows = [chain(f'{0xe0 + d:02x}' * 16, depth)
+                    for d in range(n_docs)]
+        handles, _ = fleet_backend.apply_changes_docs(
+            handles, [bufs for bufs, _ in doc_rows], mirror=False)
+        fleet.frontier_index(device_min=1,
+                             capacity=2 * n_links * depth)
+        flat_docs = [handles[i % n_docs] for i in range(n_links)]
+        states = [init_sync_state() for _ in range(n_links)]
+        solicit(states)
+        # cold round: every link sends its doc's changes, staging and
+        # promoting its sentHashes into a peer-space
+        states, msgs = sync_driver.generate_sync_messages_docs(
+            flat_docs, states)
+        assert all(isinstance(s['sentHashes'], PeerSentSet)
+                   for s in states)
+        solicit(states)
+        # warm round: flushes the staged spaces + compiles steady shapes
+        states, _msgs = sync_driver.generate_sync_messages_docs(
+            flat_docs, states)
+        times = []
+        for _ in range(max(REPS, 5)):
+            solicit(states)
+            h0 = hashindex.dispatch_count()
+            b0 = fleet_bloom.dispatch_count()
+            start = time.perf_counter()
+            states, msgs = sync_driver.generate_sync_messages_docs(
+                flat_docs, states)
+            times.append(time.perf_counter() - start)
+            disp[n_links] = (hashindex.dispatch_count() - h0,
+                             fleet_bloom.dispatch_count() - b0)
+        assert all(m is not None for m in msgs)
+        round_p50[n_links] = float(np.median(times)) * 1e3
+
+        # the per-peer loop this PR replaced (exchange.py/cluster.py
+        # before the fabric): one driver call PER PEER PAIR, so every
+        # link pays its own Bloom-build + membership-probe dispatches.
+        # Subsampled and extrapolated to the full link set (strictly
+        # per-link work, so the extrapolation is linear by construction)
+        m_links = min(n_links, loop_sample)
+
+        def run_loop():
+            sub = states[:m_links]
+            solicit(sub)
+            start = time.perf_counter()
+            for i in range(m_links):
+                new, _m = sync_driver.generate_sync_messages_docs(
+                    [flat_docs[i]], [states[i]])
+                states[i] = new[0]
+            return time.perf_counter() - start
+
+        run_loop()                                   # warm n=1 shapes
+        loop_reps = [run_loop() for _ in range(max(REPS, 3))]
+        loop_ms[n_links] = float(np.median(loop_reps)) * 1e3 \
+            * (n_links / m_links)
+
+        # secondary reference: the single-doc HOST protocol with plain-
+        # set sentHashes (no device work at all) — the floor the shared
+        # per-link host assembly cost imposes on both paths
+        host_states = []
+        for i in range(m_links):
+            s = init_sync_state()
+            s['sentHashes'] = set(doc_rows[i % n_docs][1])
+            host_states.append(s)
+
+        def run_host_loop():
+            solicit(host_states)
+            start = time.perf_counter()
+            for i in range(m_links):
+                host_states[i], _m = generate_sync_message(
+                    handles[i % n_docs], host_states[i])
+            return time.perf_counter() - start
+
+        run_host_loop()                              # warm
+        host_reps = [run_host_loop() for _ in range(max(REPS, 3))]
+        host_loop_ms[n_links] = float(np.median(host_reps)) * 1e3 \
+            * (n_links / m_links)
+
+        if n_links == link_sweep[len(link_sweep) // 2]:
+            # probe-window sweep at the middle leg: the 16-slot default
+            # vs narrower/wider windows (static jit arg -> each width
+            # compiles once, then steady rounds)
+            for width in windows:
+                prev = set_probe_window(width)
+                try:
+                    solicit(states)
+                    states, _msgs = sync_driver.\
+                        generate_sync_messages_docs(flat_docs, states)
+                    wtimes = []
+                    for _ in range(max(REPS, 3)):
+                        solicit(states)
+                        start = time.perf_counter()
+                        states, _msgs = sync_driver.\
+                            generate_sync_messages_docs(flat_docs, states)
+                        wtimes.append(time.perf_counter() - start)
+                    R[f'fabric_window_p50_ms_{width}'] = \
+                        float(np.median(wtimes)) * 1e3
+                finally:
+                    set_probe_window(prev)
+        del fleet, handles, flat_docs, states, host_states, msgs
+        _fence()
+
+    mid = min(link_sweep, key=lambda n: abs(n - 10_000))
+    top = link_sweep[-1]
+    flat = len({d for d in disp.values()}) == 1
+    for n_links in link_sweep:
+        R[f'fabric_round_p50_ms_{n_links}'] = round_p50[n_links]
+        R[f'fabric_loop_round_ms_{n_links}'] = loop_ms[n_links]
+        R[f'fabric_host_loop_round_ms_{n_links}'] = host_loop_ms[n_links]
+        R[f'fabric_fused_vs_loop_{n_links}'] = \
+            loop_ms[n_links] / round_p50[n_links]
+        R[f'fabric_round_hashindex_dispatches_{n_links}'] = \
+            disp[n_links][0]
+        R[f'fabric_round_bloom_dispatches_{n_links}'] = disp[n_links][1]
+    R.update(
+        fabric_links_per_s=top / round_p50[top] * 1e3,
+        fabric_fused_vs_loop_ratio=loop_ms[mid] / round_p50[mid],
+        fabric_dispatches_flat=int(flat))
+    print(f'# sync fabric: round p50 '
+          + ' / '.join(f'{n}lk {round_p50[n]:.1f}ms' for n in link_sweep)
+          + f'; dispatches/round {disp[top]} '
+          f'({"FLAT" if flat else "SCALING"} across the sweep); fused vs '
+          f'per-peer loop at {mid} links: {loop_ms[mid]:.0f}ms -> '
+          f'{round_p50[mid]:.1f}ms = '
+          f'{loop_ms[mid] / round_p50[mid]:.1f}x (budget >=3x; host-'
+          f'protocol floor {host_loop_ms[mid]:.0f}ms); '
+          f'window sweep '
+          + ' / '.join(f'w{w} {R.get(f"fabric_window_p50_ms_{w}", 0):.1f}ms'
+                       for w in windows),
+          file=sys.stderr)
+
+
 @section('zipf')
 def _sec_zipf():
     # Config 5 (stretch): Zipf-skewed change rates over a large fleet
@@ -2719,6 +2907,7 @@ def _sec_regress():
                     'tier_park_docs_per_s', 'tier_revive_docs_per_s',
                     'tier_materialize_docs_per_s',
                     'query_materialize_docs_per_s', 'shards_rps_4',
+                    'fabric_links_per_s', 'fabric_fused_vs_loop_ratio',
                     'obs_overhead_pct', 'perf_overhead_pct'):
             if isinstance(R.get(key), (int, float)):
                 head_metrics[key] = float(R[key])
@@ -2844,6 +3033,8 @@ def _run_sanity():
              'BENCH_SHARD_KILL_REQUESTS': '240',
              'BENCH_PERF_DOCS': '1000',
              'BENCH_REGRESS_DOCS': '500',
+             'BENCH_FABRIC_LINKS': '256,1024',
+             'BENCH_FABRIC_LOOP_SAMPLE': '64',
              # scaled-down sanity rows must not pollute the trajectory
              'BENCH_LEDGER': '0',
              'BENCH_REPS': '3'}

@@ -31,6 +31,10 @@ Targets:
 - query.decode_cursor — the subscription-cursor decode boundary: a
   hostile cursor fails typed InvalidCursor, and one that DECODES must
   round-trip (re-encode to the same bytes: canonical-form discipline)
+- fleet.hashindex peer sent-spaces — mutant bytes decode to connect/
+  send/probe/disconnect/reset programs over PeerSentSet vs a
+  dict-of-sets oracle (differential; reconnects must never inherit a
+  predecessor's sent set)
 
 Dose scales like tests/test_chaos.py: FUZZ_SEEDS x FUZZ_CASES mutants per
 target (env-overridable); tests/test_fuzz_wire.py runs a small smoke dose
@@ -154,6 +158,9 @@ def build_corpus():
     storage_traces = [
         _hashlib.sha256(f'storage-trace-{i}'.encode()).digest() * 4
         for i in range(3)]
+    peer_traces = [
+        _hashlib.sha256(f'peer-space-trace-{i}'.encode()).digest() * 5
+        for i in range(3)]
 
     corpus = {
         'change': changes,
@@ -168,6 +175,7 @@ def build_corpus():
         'cursor': cursors,
         'hashindex_trace': traces,
         'storage_trace': storage_traces,
+        'peer_space_trace': peer_traces,
     }
     _corpus_size[0] = sum(len(v) for v in corpus.values())
     return corpus
@@ -315,6 +323,79 @@ def _hashindex_target(mutant):
                     'hashindex membership diverged from the set oracle')
 
 
+def _peer_space_target(mutant):
+    """Differential fuzz of the peer sent-spaces (fleet/hashindex.py
+    PeerSentSet): the mutant bytes read as a trace program — (op, peer,
+    key) byte triples decoding to connect / send / probe / disconnect /
+    reset(=True) / flush — run against BOTH the shared open-addressing
+    table (tiny capacity + low device threshold, so host->device
+    promotion, collision chains, and grow-by-migration fire constantly)
+    and a dict-of-sets oracle. Checks the fabric's reconnect contract
+    too: space ids are never reused, so a peer reconnecting after
+    disconnect/reset can never inherit its predecessor's sent set. Any
+    divergence raises untyped so the fuzz net flags it; a healthy table
+    never raises on ANY byte sequence."""
+    import hashlib as _hashlib
+    from automerge_tpu.fleet.hashindex import (HashIndex, PeerSentSet,
+                                               flush_peer_sets)
+    table = HashIndex(capacity=8, device_min=24, load_max=0.7)
+    peers, oracle, seen_sids = [], {}, set()
+
+    def connect():
+        ps = PeerSentSet(table)
+        if ps.sid in seen_sids:
+            raise RuntimeError('peer space id reused')
+        seen_sids.add(ps.sid)
+        peers.append(ps)
+        oracle[id(ps)] = set()
+        return ps
+
+    connect()
+    data = bytes(mutant)[:150]
+    for k in range(0, len(data) - 2, 3):
+        op, p, kid = data[k] % 16, data[k + 1], data[k + 2]
+        ps = peers[p % len(peers)]
+        key = _hashlib.sha256(bytes([kid % 24])).hexdigest()
+        if op == 0 and len(peers) < 6:                       # connect
+            connect()
+        elif op == 1 and len(peers) > 1:                     # disconnect
+            peers.remove(ps)
+            ps.release()
+            del oracle[id(ps)]
+            if ps.alive or any(ps.contains_many([key])):
+                raise RuntimeError('released peer space still answers')
+        elif op == 2:                                        # reset=True
+            old = ps
+            peers.remove(old)
+            old.release()
+            old_sent = oracle.pop(id(old))
+            ps = connect()
+            if ps.sid <= old.sid:
+                raise RuntimeError('reset reused or rewound a space id')
+            hits = ps.contains_many(sorted(old_sent) or [key])
+            if any(hits):
+                raise RuntimeError(
+                    'reconnected peer inherited predecessor sent set')
+        elif op == 3:                                        # flush all
+            flush_peer_sets(peers)
+        elif op % 2:                                         # send
+            ps.add(key)
+            oracle[id(ps)].add(key)
+        else:                                                # probe
+            want = key in oracle[id(ps)]
+            if (key in ps) != want or \
+                    bool(ps.contains_many([key])[0]) != want:
+                raise RuntimeError(
+                    'peer space membership diverged from the set oracle')
+    flush_peer_sets(peers)
+    for ps in peers:                                         # final audit
+        members = sorted(oracle[id(ps)])
+        if members:
+            got = ps.contains_many(members)
+            if not all(got):
+                raise RuntimeError('post-flush membership lost a sent hash')
+
+
 _storage_corpus = []
 
 
@@ -441,6 +522,7 @@ def run_fuzz(n_seeds=None, n_cases=None, verbose=False):
     targets = _targets()
     targets.append(('bloom_probe', _probe_bloom_target))
     targets.append(('hashindex_trace', _hashindex_target))
+    targets.append(('peer_space_trace', _peer_space_target))
     targets.append(('storage_trace', _storage_trace_target))
     targets.append(('loader_batch', _loader_target(corpus)))
     targets.append(('apply_quarantine', _quarantine_target(corpus)))
@@ -453,7 +535,7 @@ def run_fuzz(n_seeds=None, n_cases=None, verbose=False):
 
     stats = {'cases': 0, 'rejected': 0, 'accepted': 0, 'escaped': []}
     heavy = {'loader_batch', 'apply_quarantine', 'hashindex_trace',
-             'storage_trace'}
+             'peer_space_trace', 'storage_trace'}
     for seed in range(n_seeds):
         rng = random.Random(seed)
         for case in range(n_cases):
