@@ -1,0 +1,34 @@
+"""Static contract linter: the invariants past PRs learned at runtime,
+enforced at parse time.
+
+Five rounds of chaos/fuzz work kept rediscovering the same contract
+classes the hard way — untyped escapes out of decoders (fuzz rounds 2/3),
+an error-message string match where a typed class belonged (round 11),
+torn raw-dict counters across 15 files (round 13), un-instrumented jit
+entry points, wall-clock reads on deterministic paths. The contracts are
+written down in BASELINE.md; this package *checks* them: an AST rule
+framework (`core`), the per-surface scope tables (`scopes`), and one
+module per rule under `rules/`. `tools/archlint.py` is the CLI;
+tests/test_archlint.py pins every rule with positive/negative fixtures
+and runs the linter over the real tree as a tier-1 gate.
+
+Suppression contract: a violation may be silenced ONLY by an inline
+justification comment (`# archlint: ok[rule-id] why this is safe`) whose
+fingerprint is recorded in the checked-in baseline
+(tools/archlint_baseline.json). `--check` fails on any NEW violation,
+any suppression missing from the baseline (so suppressions always show
+up in review), and any stale baseline entry (so the baseline can only
+shrink silently, never grow).
+"""
+
+from .core import (
+    Finding, Module, Rule, BaselineError, check_findings, lint_paths,
+    lint_source, load_baseline, write_baseline, iter_py_files,
+)
+from .rules import ALL_RULES, get_rules
+
+__all__ = [
+    'Finding', 'Module', 'Rule', 'BaselineError', 'ALL_RULES',
+    'get_rules', 'check_findings', 'lint_paths', 'lint_source',
+    'load_baseline', 'write_baseline', 'iter_py_files',
+]

@@ -1,0 +1,102 @@
+"""kernel-ledger: every jit entry point is costed; no per-doc dispatch.
+
+Round 17's cost ledger only works if every jitted kernel passes through
+`instrument_kernel` — an unwrapped `jax.jit` is a kernel the floor
+table cannot see. Two checks:
+
+1. jit coverage: a `jax.jit(...)` call must be the direct argument of
+   `instrument_kernel(kind, jax.jit(...))`; decorator forms (`@jax.jit`,
+   `@functools.partial(jax.jit, ...)`) are always violations because a
+   decorator cannot be wrapped (rebind the impl instead — the idiom
+   everywhere else in fleet/).
+2. per-doc dispatch (rounds 6/16's O(1)-dispatch contract): a `jnp.`
+   use inside a `for` loop whose iterable is doc-shaped (docs, handles,
+   peers, subscribers, n_docs, ...) in a host-path module dispatches
+   one kernel per document. Per-class pool loops and fixed array-tuple
+   grows don't match the iterable pattern and stay legal.
+"""
+
+import ast
+
+from .. import scopes
+from ..astutil import dotted
+from ..core import Rule
+
+JIT_NAMES = frozenset({'jax.jit', 'jit'})
+WRAPPER_NAMES = frozenset({'instrument_kernel'})
+
+
+def _is_jit(node):
+    return isinstance(node, ast.Call) and dotted(node.func) in JIT_NAMES
+
+
+def _is_partial_of_jit(node):
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted(node.func)
+    if name not in ('functools.partial', 'partial'):
+        return False
+    return any(dotted(a) in JIT_NAMES for a in node.args)
+
+
+class KernelLedgerRule(Rule):
+    rule_id = 'kernel-ledger'
+    doc = ('jax.jit entry points must be instrument_kernel-wrapped; no '
+           'jnp dispatch inside per-doc loops in host-path modules')
+
+    def check(self, module):
+        if scopes.kernel_scope(module.path):
+            yield from self._jit_coverage(module)
+        if scopes.host_loop_scope(module.path):
+            yield from self._per_doc_dispatch(module)
+
+    def _jit_coverage(self, module):
+        decorators = set()
+        for fn in module.nodes:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in fn.decorator_list:
+                    decorators.add(id(dec))
+                    if dotted(dec) in JIT_NAMES:
+                        yield module.finding(
+                            self.rule_id, dec,
+                            f'@jax.jit on {fn.name}() bypasses the cost '
+                            f'ledger — rebind as name = instrument_'
+                            f'kernel(kind, jax.jit(_impl))')
+                    elif _is_partial_of_jit(dec):
+                        yield module.finding(
+                            self.rule_id, dec,
+                            f'@functools.partial(jax.jit, ...) on '
+                            f'{fn.name}() bypasses the cost ledger — '
+                            f'rebind as name = instrument_kernel(kind, '
+                            f'jax.jit(_impl, ...))')
+        for node in module.nodes:
+            if not _is_jit(node) or id(node) in decorators:
+                continue
+            parent = module.parent_of(node)
+            if isinstance(parent, ast.Call) and \
+                    (dotted(parent.func) or '').split('.')[-1] in \
+                    WRAPPER_NAMES:
+                continue
+            yield module.finding(
+                self.rule_id, node,
+                'jax.jit(...) result is not instrument_kernel-wrapped '
+                '— the kernel is invisible to the cost ledger')
+
+    def _per_doc_dispatch(self, module):
+        for loop in module.nodes:
+            if not isinstance(loop, ast.For):
+                continue
+            iter_text = module.text(loop.iter)
+            if not scopes.PER_DOC_ITER_RE.search(iter_text):
+                continue
+            for node in ast.walk(loop):
+                if isinstance(node, ast.Attribute) and \
+                        (dotted(node) or '').startswith(('jnp.',
+                                                         'jax.numpy.')):
+                    yield module.finding(
+                        self.rule_id, node,
+                        f'jnp dispatch inside a per-doc loop (iterating '
+                        f'{iter_text.strip()[:60]!r}) — batch it into '
+                        f'one fused dispatch (the O(1)-dispatch '
+                        f'contract)')
+                    break  # one finding per loop is enough

@@ -51,7 +51,7 @@ def read_filter_header(decoder):
     bits_per_entry = decoder.read_uint32()
     num_probes = decoder.read_uint32()
     if num_entries and (bits_per_entry == 0 or num_probes == 0):
-        raise ValueError('bloom filter with zero-width probes')
+        raise MalformedSyncMessage('bloom filter with zero-width probes')
     return (num_entries, bits_per_entry, num_probes,
             (num_entries * bits_per_entry + 7) // 8)
 

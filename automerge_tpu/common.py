@@ -7,6 +7,7 @@ def parse_op_id(op_id):
     """Parse 'counter@actorId' into (counter, actor_id) (ref src/common.js:32-38)."""
     counter, sep, actor_id = op_id.partition('@')
     if not sep or not counter.isdigit():
+        # archlint: ok[typed-errors] internal funnel helper like columnar/encoding: every wire path reaching it sits under a converting as_wire_error boundary (fuzz-enforced by tools/fuzz_wire.py)
         raise ValueError(f'Not a valid opId: {op_id}')
     return int(counter), actor_id
 

@@ -29,6 +29,7 @@ from ..observability import register_health_source
 from ..observability.metrics import Counters
 from ..observability import hist as _hist
 from ..observability import recorder as _flight
+from ..observability.perf import instrument_kernel
 from ..observability.spans import span as _span
 
 # Fault-containment roll-up: extra sub-rounds paid to move over-limit sync
@@ -79,8 +80,7 @@ def exchange_changes(mesh, axis, all_outboxes, all_lens):
     spec_data = P(axis, None, None)
     spec_lens = P(axis, None)
 
-    @jax.jit
-    def run(data, lens):
+    def _run(data, lens):
         def body(data, lens):
             # shard view: [1, n, L]; exchange rows over the peer axis so
             # each shard ends with [from_peer, L] — one tiled all_to_all
@@ -93,6 +93,8 @@ def exchange_changes(mesh, axis, all_outboxes, all_lens):
         return shard_map(body, mesh=mesh,
                          in_specs=(spec_data, spec_lens),
                          out_specs=(spec_data, spec_lens))(data, lens)
+
+    run = instrument_kernel('exchange_all_to_all', jax.jit(_run))
 
     data = jax.device_put(jnp.asarray(all_outboxes),
                           NamedSharding(mesh, spec_data))

@@ -39,6 +39,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .tensor_doc import FleetState
+from ..observability.perf import instrument_kernel
 
 # Tile sizes are env-tunable (PALLAS_DOC_TILE / PALLAS_KEY_TILE /
 # PALLAS_OP_CHUNK) so on-chip VMEM pressure can be dialed without code
@@ -194,8 +195,8 @@ def _pad_to(x, axis, multiple):
     return jnp.pad(x, pad)
 
 
-@functools.partial(jax.jit, static_argnames=('interpret', 'variant'))
-def pallas_apply_op_batch(state, ops, interpret=False, variant='dense'):
+def _pallas_apply_op_batch_impl(state, ops, interpret=False,
+                                variant='dense'):
     """Drop-in fused-kernel equivalent of fleet.apply.apply_op_batch.
 
     variant='dense' materializes the 3D one-hot (best VPU shape, highest
@@ -246,3 +247,9 @@ def pallas_apply_op_batch(state, ops, interpret=False, variant='dense'):
                            out_c[:n_docs, :n_slots])
     stats = jnp.sum(ops.valid, dtype=jnp.int32)
     return new_state, stats
+
+
+pallas_apply_op_batch = instrument_kernel(
+    'pallas_apply_op_batch',
+    jax.jit(_pallas_apply_op_batch_impl,
+            static_argnames=('interpret', 'variant')))

@@ -244,8 +244,7 @@ zero_register_rows_donated = instrument_kernel(
     jax.jit(_zero_register_rows_impl, donate_argnums=(0,)))
 
 
-@jax.jit
-def visible_registers(state):
+def _visible_registers_impl(state):
     """(visible [N, K+1, A] bool, winner_slot [N, K+1] int32,
     winner_packed [N, K+1] int32): the multi-value register contents and the
     Lamport winner per key (packed ids order like lamportCompare because
@@ -255,6 +254,10 @@ def visible_registers(state):
     winner_slot = jnp.argmax(masked, axis=-1).astype(jnp.int32)
     winner_packed = jnp.max(jnp.where(visible, state.reg, 0), axis=-1)
     return visible, winner_slot, winner_packed
+
+
+visible_registers = instrument_kernel(
+    'visible_registers', jax.jit(_visible_registers_impl))
 
 
 def rows_to_register_batch(doc_ids, flags, key_ids, packed, values,

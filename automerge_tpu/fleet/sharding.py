@@ -18,6 +18,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .tensor_doc import FleetState
 from .apply import apply_op_batch
+from ..observability.perf import instrument_kernel
 
 
 def fleet_mesh(devices=None, keys_axis=1):
@@ -91,11 +92,10 @@ def sharded_seq_apply(mesh):
     from .sequence import _apply_seq_batch_impl
     by_ndim = seq_sharding(mesh)
 
-    @jax.jit
-    def step(state, ops):
+    def _step(state, ops):
         new_state, stats = _apply_seq_batch_impl(state, ops)
         return _constrain_by_ndim(new_state, by_ndim), stats
-    return step
+    return instrument_kernel('sharded_seq_apply', jax.jit(_step))
 
 
 def long_seq_sharding(mesh):
@@ -145,11 +145,10 @@ def sharded_long_seq_apply(mesh):
     from .sequence import _apply_seq_batch_impl
     by_ndim = long_seq_sharding(mesh)
 
-    @jax.jit
-    def step(state, ops):
+    def _step(state, ops):
         new_state, stats = _apply_seq_batch_impl(state, ops)
         return _constrain_by_ndim(new_state, by_ndim), stats
-    return step
+    return instrument_kernel('sharded_long_seq_apply', jax.jit(_step))
 
 
 def sharded_long_seq_materialize(mesh):
@@ -163,13 +162,12 @@ def sharded_long_seq_materialize(mesh):
     from .sequence import _materialize_impl
     slots = long_seq_sharding(mesh)[2]
 
-    @jax.jit
-    def run(state):
+    def _run(state):
         vals, cnts, vis, n = _materialize_impl(state)
         return (jax.lax.with_sharding_constraint(vals, slots),
                 jax.lax.with_sharding_constraint(cnts, slots),
                 jax.lax.with_sharding_constraint(vis, slots), n)
-    return run
+    return instrument_kernel('sharded_long_seq_materialize', jax.jit(_run))
 
 
 def sharded_apply(mesh):
@@ -179,11 +177,10 @@ def sharded_apply(mesh):
     global psum over the mesh."""
     state_spec, _ = fleet_sharding(mesh)
 
-    @jax.jit
-    def step(state, ops):
+    def _step(state, ops):
         new_state, stats = apply_op_batch(state, ops)
         new_state = FleetState(
             *(jax.lax.with_sharding_constraint(x, state_spec)
               for x in (new_state.winners, new_state.values, new_state.counters)))
         return new_state, stats
-    return step
+    return instrument_kernel('sharded_apply', jax.jit(_step))

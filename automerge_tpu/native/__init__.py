@@ -48,8 +48,16 @@ class NativeAbiMismatch(RuntimeError):
     """A compiled codec binary is stale and could not be rebuilt."""
 
 _SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'codec.cpp')
-_LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         f'_codec_{sys.implementation.cache_tag}.so')
+# AUTOMERGE_TPU_NATIVE_SO points the wrapper at an alternate prebuilt
+# binary — the sanitizer plane loads the ASan/UBSan build this way
+# (tools/build_native.sh --sanitize). The override is loaded VERBATIM:
+# never rebuilt, and any failure (missing file, ABI skew) is loud —
+# silently falling back to the normal .so would make a sanitizer replay
+# quietly test the wrong library.
+_SO_OVERRIDE = os.environ.get('AUTOMERGE_TPU_NATIVE_SO') or None
+_LIB_PATH = _SO_OVERRIDE or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    f'_codec_{sys.implementation.cache_tag}.so')
 
 _lib = None
 _load_error = None
@@ -91,8 +99,8 @@ def _build():
         inc = sysconfig.get_paths().get('include')
         if inc and os.path.exists(os.path.join(inc, 'Python.h')):
             cmd.insert(1, f'-I{inc}')
-    except Exception:
-        pass
+    except (ImportError, KeyError, OSError):
+        pass    # no headers: build without the zero-copy list entry
     subprocess.run(cmd, check=True, capture_output=True)
 
 
@@ -112,6 +120,22 @@ def _load():
     if _lib is not None or _load_error is not None:
         return _lib
     try:
+        if _SO_OVERRIDE:
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+            except OSError as exc:
+                raise NativeAbiMismatch(
+                    f'AUTOMERGE_TPU_NATIVE_SO={_LIB_PATH} could not be '
+                    f'loaded ({exc}) — the override is never rebuilt or '
+                    f'fallen back from; fix the path or unset it'
+                ) from exc
+            if _abi_of(lib) != _ABI_VERSION:
+                raise NativeAbiMismatch(
+                    f'AUTOMERGE_TPU_NATIVE_SO={_LIB_PATH} reports ABI '
+                    f'{_abi_of(lib)}, wrapper expects {_ABI_VERSION} — '
+                    f'rebuild it (tools/build_native.sh --sanitize=... '
+                    f'for sanitized binaries)')
+            return _finish_load(lib)
         if not os.path.exists(_LIB_PATH) or \
                 os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC):
             _build()
@@ -140,43 +164,49 @@ def _load():
                     f'native codec binary {_LIB_PATH} still reports ABI '
                     f'{_abi_of(lib)} after a rebuild (wrapper expects '
                     f'{_ABI_VERSION}) — source/wrapper version skew')
-        u8p = ctypes.POINTER(ctypes.c_uint8)
-        u64p = ctypes.POINTER(ctypes.c_uint64)
-        i64p = ctypes.POINTER(ctypes.c_int64)
-        lib.am_sha256.argtypes = [u8p, ctypes.c_uint64, u8p]
-        lib.am_sha256_batch.argtypes = [u8p, u64p, u64p, ctypes.c_uint64, u8p]
-        lib.am_deflate_raw.argtypes = [u8p, ctypes.c_uint64, u8p, ctypes.c_uint64]
-        lib.am_deflate_raw.restype = ctypes.c_int64
-        lib.am_inflate_raw.argtypes = [u8p, ctypes.c_uint64, u8p, ctypes.c_uint64]
-        lib.am_inflate_raw.restype = ctypes.c_int64
-        lib.am_decode_rle.argtypes = [u8p, ctypes.c_uint64, ctypes.c_int,
-                                      i64p, u8p, ctypes.c_int64]
-        lib.am_decode_rle.restype = ctypes.c_int64
-        lib.am_decode_delta.argtypes = [u8p, ctypes.c_uint64, i64p, u8p,
-                                        ctypes.c_int64]
-        lib.am_decode_delta.restype = ctypes.c_int64
-        lib.am_decode_boolean.argtypes = [u8p, ctypes.c_uint64, i64p, u8p,
-                                          ctypes.c_int64]
-        lib.am_decode_boolean.restype = ctypes.c_int64
-        lib.am_count_rle.argtypes = [u8p, ctypes.c_uint64, ctypes.c_int]
-        lib.am_count_rle.restype = ctypes.c_int64
-        lib.am_pool_configure.argtypes = [ctypes.c_int]
-        lib.am_pool_configure.restype = ctypes.c_int64
-        lib.am_pool_threads.argtypes = []
-        lib.am_pool_threads.restype = ctypes.c_int64
-        lib.am_pool_stats.argtypes = [i64p, i64p, i64p]
-        lib.am_pool_stats.restype = ctypes.c_int64
-        lib.am_ingest_parse_stats.argtypes = [i64p, i64p, i64p, i64p,
-                                              ctypes.c_int64]
-        lib.am_ingest_parse_stats.restype = ctypes.c_int64
-        global _threads
-        _threads = int(lib.am_pool_configure(_default_threads()))
-        _lib = lib
+        return _finish_load(lib)
     except NativeAbiMismatch:
         raise                     # stale binaries fail loudly, not silently
     except Exception as exc:  # toolchain missing or compile failure
         _load_error = exc
         _lib = None
+    return _lib
+
+
+def _finish_load(lib):
+    """Declare the C surface and adopt `lib` as THE loaded codec."""
+    global _lib, _threads
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.am_sha256.argtypes = [u8p, ctypes.c_uint64, u8p]
+    lib.am_sha256_batch.argtypes = [u8p, u64p, u64p, ctypes.c_uint64, u8p]
+    lib.am_deflate_raw.argtypes = [u8p, ctypes.c_uint64, u8p, ctypes.c_uint64]
+    lib.am_deflate_raw.restype = ctypes.c_int64
+    lib.am_inflate_raw.argtypes = [u8p, ctypes.c_uint64, u8p, ctypes.c_uint64]
+    lib.am_inflate_raw.restype = ctypes.c_int64
+    lib.am_decode_rle.argtypes = [u8p, ctypes.c_uint64, ctypes.c_int,
+                                  i64p, u8p, ctypes.c_int64]
+    lib.am_decode_rle.restype = ctypes.c_int64
+    lib.am_decode_delta.argtypes = [u8p, ctypes.c_uint64, i64p, u8p,
+                                    ctypes.c_int64]
+    lib.am_decode_delta.restype = ctypes.c_int64
+    lib.am_decode_boolean.argtypes = [u8p, ctypes.c_uint64, i64p, u8p,
+                                      ctypes.c_int64]
+    lib.am_decode_boolean.restype = ctypes.c_int64
+    lib.am_count_rle.argtypes = [u8p, ctypes.c_uint64, ctypes.c_int]
+    lib.am_count_rle.restype = ctypes.c_int64
+    lib.am_pool_configure.argtypes = [ctypes.c_int]
+    lib.am_pool_configure.restype = ctypes.c_int64
+    lib.am_pool_threads.argtypes = []
+    lib.am_pool_threads.restype = ctypes.c_int64
+    lib.am_pool_stats.argtypes = [i64p, i64p, i64p]
+    lib.am_pool_stats.restype = ctypes.c_int64
+    lib.am_ingest_parse_stats.argtypes = [i64p, i64p, i64p, i64p,
+                                          ctypes.c_int64]
+    lib.am_ingest_parse_stats.restype = ctypes.c_int64
+    _threads = int(lib.am_pool_configure(_default_threads()))
+    _lib = lib
     return _lib
 
 
@@ -363,7 +393,10 @@ def inflate_raw(data, max_size=1 << 28):
         if size >= 0:
             return out[:size].tobytes()
         if cap >= max_size:
-            raise ValueError('inflate failed')
+            # hostile wire bytes reach this decoder (deflated columns in
+            # change/document chunks), so the failure is typed
+            raise MalformedChange('inflate failed: corrupt or oversized '
+                                  'deflate stream')
         cap = min(cap * 4, max_size)
 
 

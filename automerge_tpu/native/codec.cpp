@@ -30,6 +30,15 @@
 #define AM_HAVE_X86 1
 #endif
 
+// memcpy with a null pointer is UB even when n == 0 (glibc declares both
+// arguments nonnull, and UBSan's nonnull check fires), and an empty
+// std::vector's data() is exactly such a null — which every *_fetch
+// entry hits when a hostile batch parses to zero rows. All bulk copies
+// funnel through this guard.
+static inline void copy_bytes(void *dst, const void *src, size_t n) {
+  if (n && dst && src) memcpy(dst, src, n);
+}
+
 extern "C" {
 
 // ---------------------------------------------------------------------------
@@ -222,7 +231,7 @@ static void sha256_stream_init(Sha256Stream &s) {
   static const uint32_t init[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
                                    0xa54ff53a, 0x510e527f, 0x9b05688c,
                                    0x1f83d9ab, 0x5be0cd19};
-  memcpy(s.st, init, sizeof(init));
+  copy_bytes(s.st, init, sizeof(init));
   s.total = 0;
   s.buffered = 0;
 }
@@ -232,7 +241,7 @@ static void sha256_stream_update(Sha256Stream &s, const uint8_t *p,
   s.total += n;
   if (s.buffered) {
     uint64_t take = 64 - s.buffered < n ? 64 - s.buffered : n;
-    memcpy(s.buf + s.buffered, p, take);
+    copy_bytes(s.buf + s.buffered, p, take);
     s.buffered += uint32_t(take);
     p += take;
     n -= take;
@@ -248,7 +257,7 @@ static void sha256_stream_update(Sha256Stream &s, const uint8_t *p,
     n -= 64 * full;
   }
   if (n) {
-    memcpy(s.buf, p, n);
+    copy_bytes(s.buf, p, n);
     s.buffered = uint32_t(n);
   }
 }
@@ -256,7 +265,7 @@ static void sha256_stream_update(Sha256Stream &s, const uint8_t *p,
 static void sha256_stream_final(Sha256Stream &s, uint8_t *out) {
   uint8_t tail[128];
   uint32_t rem = s.buffered;
-  memcpy(tail, s.buf, rem);
+  copy_bytes(tail, s.buf, rem);
   tail[rem] = 0x80;
   uint64_t tail_len = (rem + 9 <= 64) ? 64 : 128;
   memset(tail + rem + 1, 0, tail_len - rem - 9);
@@ -358,16 +367,19 @@ static inline uint64_t read_uleb(const uint8_t *buf, uint64_t len,
 
 static inline int64_t read_sleb(const uint8_t *buf, uint64_t len,
                                 uint64_t *pos, int *err) {
-  int64_t result = 0;
+  // assembled unsigned: a signed left shift that reaches bit 63 is UB
+  // (a 10-byte hostile varint put `42 << 63` here under UBSan), while
+  // unsigned shifts just discard the overflow like the JS reference
+  uint64_t result = 0;
   int shift = 0;
   while (*pos < len) {
     uint8_t byte = buf[(*pos)++];
     if (shift >= 64) { *err = 1; return 0; }
-    result |= int64_t(byte & 0x7f) << shift;
+    result |= uint64_t(byte & 0x7f) << shift;
     shift += 7;
     if ((byte & 0x80) == 0) {
-      if ((byte & 0x40) && shift < 64) result |= -(int64_t(1) << shift);
-      return result;
+      if ((byte & 0x40) && shift < 64) result |= ~uint64_t(0) << shift;
+      return int64_t(result);
     }
   }
   *err = 1;
@@ -1780,11 +1792,11 @@ int64_t am_ingest_fetch(int32_t *doc, int32_t *key, int32_t *packed,
   if (!g_ingest) return -1;
   IngestCtx &ctx = *g_ingest;
   size_t n = ctx.out_doc.size();
-  memcpy(doc, ctx.out_doc.data(), n * 4);
-  memcpy(key, ctx.out_key.data(), n * 4);
-  memcpy(packed, ctx.out_packed.data(), n * 4);
-  memcpy(val, ctx.out_val.data(), n * 4);
-  memcpy(flags, ctx.out_flags.data(), n);
+  copy_bytes(doc, ctx.out_doc.data(), n * 4);
+  copy_bytes(key, ctx.out_key.data(), n * 4);
+  copy_bytes(packed, ctx.out_packed.data(), n * 4);
+  copy_bytes(val, ctx.out_val.data(), n * 4);
+  copy_bytes(flags, ctx.out_flags.data(), n);
 
   auto write_blob = [](const std::vector<std::string> &items, uint8_t *out,
                        uint64_t cap) -> int64_t {
@@ -1800,7 +1812,7 @@ int64_t am_ingest_fetch(int32_t *doc, int32_t *key, int32_t *packed,
         out[pos++] = byte | (v ? 0x80 : 0);
       } while (v);
       if (pos + len > cap) return -1;
-      memcpy(out + pos, s.data(), len);
+      copy_bytes(out + pos, s.data(), len);
       pos += len;
     }
     return int64_t(pos);
@@ -1862,19 +1874,19 @@ int64_t am_ingest_meta_fetch(int32_t *actor, int64_t *seq, int64_t *start_op,
       ctx.m_hash.size() != 32 * n || ctx.m_buf_len.size() != n)
     return -1;
   if (ctx.m_deps.size() > deps_cap || ctx.m_msg.size() > msg_cap) return -1;
-  memcpy(actor, ctx.m_actor.data(), n * 4);
-  memcpy(seq, ctx.m_seq.data(), n * 8);
-  memcpy(start_op, ctx.m_start_op.data(), n * 8);
-  memcpy(time, ctx.m_time.data(), n * 8);
-  memcpy(nops, ctx.m_nops.data(), n * 8);
-  memcpy(hash32, ctx.m_hash.data(), 32 * n);
-  memcpy(deps_off, ctx.m_deps_off.data(), n * 8);
+  copy_bytes(actor, ctx.m_actor.data(), n * 4);
+  copy_bytes(seq, ctx.m_seq.data(), n * 8);
+  copy_bytes(start_op, ctx.m_start_op.data(), n * 8);
+  copy_bytes(time, ctx.m_time.data(), n * 8);
+  copy_bytes(nops, ctx.m_nops.data(), n * 8);
+  copy_bytes(hash32, ctx.m_hash.data(), 32 * n);
+  copy_bytes(deps_off, ctx.m_deps_off.data(), n * 8);
   deps_off[n] = int64_t(ctx.m_deps.size() / 32);
-  memcpy(deps_blob, ctx.m_deps.data(), ctx.m_deps.size());
-  memcpy(msg_off, ctx.m_msg_off.data(), n * 8);
+  copy_bytes(deps_blob, ctx.m_deps.data(), ctx.m_deps.size());
+  copy_bytes(msg_off, ctx.m_msg_off.data(), n * 8);
   msg_off[n] = int64_t(ctx.m_msg.size());
-  memcpy(msg_blob, ctx.m_msg.data(), ctx.m_msg.size());
-  memcpy(buf_len, ctx.m_buf_len.data(), n * 8);
+  copy_bytes(msg_blob, ctx.m_msg.data(), ctx.m_msg.size());
+  copy_bytes(buf_len, ctx.m_buf_len.data(), n * 8);
   return int64_t(n);
 }
 
@@ -1977,9 +1989,9 @@ int64_t am_ingest_seq_fetch(int32_t *obj, int32_t *ref, uint8_t *vtype) {
   if (n != ctx.out_doc.size() || ctx.out_ref.size() != n ||
       ctx.out_vtype.size() != n)
     return -1;
-  memcpy(obj, ctx.out_obj.data(), n * 4);
-  memcpy(ref, ctx.out_ref.data(), n * 4);
-  memcpy(vtype, ctx.out_vtype.data(), n);
+  copy_bytes(obj, ctx.out_obj.data(), n * 4);
+  copy_bytes(ref, ctx.out_ref.data(), n * 4);
+  copy_bytes(vtype, ctx.out_vtype.data(), n);
   return int64_t(n);
 }
 
@@ -1998,9 +2010,9 @@ int64_t am_ingest_val_fetch(int32_t *vlen, uint8_t *arena, uint64_t cap) {
   IngestCtx &ctx = *g_ingest;
   if (ctx.out_vlen.size() != ctx.out_doc.size()) return -1;
   if (ctx.val_arena.size() > cap) return -1;
-  memcpy(vlen, ctx.out_vlen.data(), ctx.out_vlen.size() * 4);
+  copy_bytes(vlen, ctx.out_vlen.data(), ctx.out_vlen.size() * 4);
   if (!ctx.val_arena.empty())
-    memcpy(arena, ctx.val_arena.data(), ctx.val_arena.size());
+    copy_bytes(arena, ctx.val_arena.data(), ctx.val_arena.size());
   return int64_t(ctx.val_arena.size());
 }
 
@@ -2019,9 +2031,9 @@ int64_t am_ingest_pred_fetch(int64_t *pred_off, int32_t *pred_blob,
   size_t n = ctx.out_pred_off.size();
   if (n != ctx.out_doc.size()) return -1;
   if (ctx.out_pred.size() > pred_cap) return -1;
-  memcpy(pred_off, ctx.out_pred_off.data(), n * 8);
+  copy_bytes(pred_off, ctx.out_pred_off.data(), n * 8);
   pred_off[n] = int64_t(ctx.out_pred.size());
-  memcpy(pred_blob, ctx.out_pred.data(), ctx.out_pred.size() * 4);
+  copy_bytes(pred_blob, ctx.out_pred.data(), ctx.out_pred.size() * 4);
   return int64_t(ctx.out_pred.size());
 }
 
@@ -2529,39 +2541,39 @@ int64_t am_docparse_fetch(
   if (!g_docparse) return -1;
   DocParseCtx &ctx = *g_docparse;
   size_t nd = ctx.d_ok.size(), nc = ctx.c_doc.size(), no = ctx.o_doc.size();
-  memcpy(d_ok, ctx.d_ok.data(), nd);
-  memcpy(d_n_changes, ctx.d_n_changes.data(), nd * 8);
-  memcpy(d_n_ops, ctx.d_n_ops.data(), nd * 8);
-  memcpy(d_max_op, ctx.d_max_op.data(), nd * 8);
-  memcpy(d_heads_off, ctx.d_heads_off.data(), nd * 8);
+  copy_bytes(d_ok, ctx.d_ok.data(), nd);
+  copy_bytes(d_n_changes, ctx.d_n_changes.data(), nd * 8);
+  copy_bytes(d_n_ops, ctx.d_n_ops.data(), nd * 8);
+  copy_bytes(d_max_op, ctx.d_max_op.data(), nd * 8);
+  copy_bytes(d_heads_off, ctx.d_heads_off.data(), nd * 8);
   d_heads_off[nd] = int64_t(ctx.heads.size() / 32);
-  memcpy(d_actor_off, ctx.d_actor_off.data(), nd * 8);
+  copy_bytes(d_actor_off, ctx.d_actor_off.data(), nd * 8);
   d_actor_off[nd] = int64_t(ctx.d_actor_ids.size());
-  memcpy(d_actor_ids, ctx.d_actor_ids.data(), ctx.d_actor_ids.size() * 4);
-  memcpy(heads, ctx.heads.data(), ctx.heads.size());
-  memcpy(c_doc, ctx.c_doc.data(), nc * 4);
-  memcpy(c_actor, ctx.c_actor.data(), nc * 4);
-  memcpy(c_seq, ctx.c_seq.data(), nc * 8);
-  memcpy(c_max_op, ctx.c_max_op.data(), nc * 8);
-  memcpy(o_doc, ctx.o_doc.data(), no * 4);
-  memcpy(o_obj_ctr, ctx.o_obj_ctr.data(), no * 8);
-  memcpy(o_obj_actor, ctx.o_obj_actor.data(), no * 4);
-  memcpy(o_key_ctr, ctx.o_key_ctr.data(), no * 8);
-  memcpy(o_key_actor, ctx.o_key_actor.data(), no * 4);
-  memcpy(o_key_str, ctx.o_key_str.data(), no * 4);
-  memcpy(o_insert, ctx.o_insert.data(), no);
-  memcpy(o_action, ctx.o_action.data(), no);
-  memcpy(o_vtype, ctx.o_vtype.data(), no);
-  memcpy(o_id_ctr, ctx.o_id_ctr.data(), no * 8);
-  memcpy(o_id_actor, ctx.o_id_actor.data(), no * 4);
-  memcpy(o_val_int, ctx.o_val_int.data(), no * 8);
-  memcpy(o_val_off, ctx.o_val_off.data(), no * 8);
-  memcpy(o_val_len, ctx.o_val_len.data(), no * 4);
-  memcpy(val_blob, ctx.val_blob.data(), ctx.val_blob.size());
-  memcpy(o_succ_off, ctx.o_succ_off.data(), no * 8);
+  copy_bytes(d_actor_ids, ctx.d_actor_ids.data(), ctx.d_actor_ids.size() * 4);
+  copy_bytes(heads, ctx.heads.data(), ctx.heads.size());
+  copy_bytes(c_doc, ctx.c_doc.data(), nc * 4);
+  copy_bytes(c_actor, ctx.c_actor.data(), nc * 4);
+  copy_bytes(c_seq, ctx.c_seq.data(), nc * 8);
+  copy_bytes(c_max_op, ctx.c_max_op.data(), nc * 8);
+  copy_bytes(o_doc, ctx.o_doc.data(), no * 4);
+  copy_bytes(o_obj_ctr, ctx.o_obj_ctr.data(), no * 8);
+  copy_bytes(o_obj_actor, ctx.o_obj_actor.data(), no * 4);
+  copy_bytes(o_key_ctr, ctx.o_key_ctr.data(), no * 8);
+  copy_bytes(o_key_actor, ctx.o_key_actor.data(), no * 4);
+  copy_bytes(o_key_str, ctx.o_key_str.data(), no * 4);
+  copy_bytes(o_insert, ctx.o_insert.data(), no);
+  copy_bytes(o_action, ctx.o_action.data(), no);
+  copy_bytes(o_vtype, ctx.o_vtype.data(), no);
+  copy_bytes(o_id_ctr, ctx.o_id_ctr.data(), no * 8);
+  copy_bytes(o_id_actor, ctx.o_id_actor.data(), no * 4);
+  copy_bytes(o_val_int, ctx.o_val_int.data(), no * 8);
+  copy_bytes(o_val_off, ctx.o_val_off.data(), no * 8);
+  copy_bytes(o_val_len, ctx.o_val_len.data(), no * 4);
+  copy_bytes(val_blob, ctx.val_blob.data(), ctx.val_blob.size());
+  copy_bytes(o_succ_off, ctx.o_succ_off.data(), no * 8);
   o_succ_off[no] = int64_t(ctx.s_ctr.size());
-  memcpy(s_ctr, ctx.s_ctr.data(), ctx.s_ctr.size() * 8);
-  memcpy(s_actor, ctx.s_actor.data(), ctx.s_actor.size() * 4);
+  copy_bytes(s_ctr, ctx.s_ctr.data(), ctx.s_ctr.size() * 8);
+  copy_bytes(s_actor, ctx.s_actor.data(), ctx.s_actor.size() * 4);
 
   auto write_blob = [](const std::vector<std::string> &items, uint8_t *out,
                        uint64_t cap) -> int64_t {
@@ -2576,7 +2588,7 @@ int64_t am_docparse_fetch(
         out[pos++] = byte | (v ? 0x80 : 0);
       } while (v);
       if (pos + len > cap) return -1;
-      memcpy(out + pos, s.data(), len);
+      copy_bytes(out + pos, s.data(), len);
       pos += len;
     }
     return int64_t(pos);
@@ -3602,7 +3614,7 @@ int64_t am_build_document(const uint8_t *blob, const uint64_t *offsets,
 int64_t am_build_fetch(uint8_t *out, uint64_t cap) {
   if (!g_build) return -1;
   if (g_build->result.size() > cap) return -1;
-  memcpy(out, g_build->result.data(), g_build->result.size());
+  copy_bytes(out, g_build->result.data(), g_build->result.size());
   int64_t n = int64_t(g_build->result.size());
   delete g_build;
   g_build = nullptr;
@@ -3969,7 +3981,7 @@ static bool encode_extracted_change(
   doc.lens.push_back(int64_t(doc.blob.size() - chunk_start));
   doc.hashes.insert(doc.hashes.end(), digest, digest + 32);
   doc.max_ops.push_back(ch.max_op);
-  memcpy(ch.hash, digest, 32);
+  copy_bytes(ch.hash, digest, 32);
   return true;
 }
 
@@ -4540,9 +4552,9 @@ int64_t am_extract_fetch(uint8_t *ok, int64_t *d_off, int64_t *c_off,
       bpos += docs[d].lens[k];
       ci++;
     }
-    memcpy(blob + (bpos - int64_t(docs[d].blob.size())),
+    copy_bytes(blob + (bpos - int64_t(docs[d].blob.size())),
            docs[d].blob.data(), docs[d].blob.size());
-    memcpy(hashes + 32 * (ci - int64_t(docs[d].lens.size())),
+    copy_bytes(hashes + 32 * (ci - int64_t(docs[d].lens.size())),
            docs[d].hashes.data(), docs[d].hashes.size());
   }
   d_off[docs.size()] = ci;

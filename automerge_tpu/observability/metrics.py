@@ -161,7 +161,8 @@ def register_dispatch_source(name, fn):
     Raises ValueError for the reserved roll-up keys ('total',
     'fleet<N>')."""
     _check_source_name(name)
-    _dispatch_sources[name] = fn
+    with _COUNTERS_LOCK:
+        _dispatch_sources[name] = fn
 
 
 def dispatch_counts(fleets=()):
@@ -188,7 +189,8 @@ def register_health_source(name, fn):
     the source — same contract (and same reserved-name rejection) as
     register_dispatch_source."""
     _check_source_name(name)
-    _health_sources[name] = fn
+    with _COUNTERS_LOCK:
+        _health_sources[name] = fn
 
 
 def health_counts():

@@ -553,10 +553,12 @@ def kernel_report(include_costs=True):
             row = {'dispatches': sig['count'],
                    'seconds': round(sig['seconds'], 6)}
             if include_costs:
-                cost = _cost_cache.get((kind, key))
+                with _ledger_lock:
+                    cost = _cost_cache.get((kind, key))
                 if cost is None:
-                    cost = _cost_cache[(kind, key)] = \
-                        _cost_analysis_for(entry, sig['spec'])
+                    cost = _cost_analysis_for(entry, sig['spec'])
+                    with _ledger_lock:
+                        _cost_cache[(kind, key)] = cost
                 row['cost'] = {k: v for k, v in cost.items()
                                if k in _COST_KEYS or k == 'error'}
                 if 'flops' in cost:
@@ -594,13 +596,15 @@ def dump_ledger(path, include_costs=True, extra=None):
 _mem_sources = {}
 _mem_high = {}
 _mem_last = {}
+_mem_lock = threading.Lock()
 
 
 def register_mem_source(name, fn):
     """Register a zero-arg callable returning a tier's CURRENT resident
     bytes (same registry discipline as register_dispatch_source; unlike
     the counter roll-ups these are gauges, so re-reads may go down)."""
-    _mem_sources[name] = fn
+    with _mem_lock:
+        _mem_sources[name] = fn
 
 
 def rss_bytes():
@@ -652,23 +656,25 @@ def sample_watermarks():
     counters, not byte gauges — the storage tier's cold-read split)."""
     rss, hwm = rss_bytes()
     current = {'rss': rss}
-    _mem_high['rss'] = max(_mem_high.get('rss', 0), hwm, rss)
+    highs = {'rss': max(hwm, rss)}
     minor, major = page_fault_counts()
-    current['pagefaults_minor'] = minor
-    current['pagefaults_major'] = major
-    _mem_high['pagefaults_minor'] = max(
-        _mem_high.get('pagefaults_minor', 0), minor)
-    _mem_high['pagefaults_major'] = max(
-        _mem_high.get('pagefaults_major', 0), major)
+    current['pagefaults_minor'] = highs['pagefaults_minor'] = minor
+    current['pagefaults_major'] = highs['pagefaults_major'] = major
     for name, fn in list(_mem_sources.items()):
         try:
             value = int(fn())
+        # archlint: ok[typed-errors] containment: a dying mem source must not take the sampler down; the source is skipped, not trusted
         except Exception:                         # noqa: BLE001
-            continue      # a dying source must not take sampling down
+            continue
         current[name] = value
-        _mem_high[name] = max(_mem_high.get(name, 0), value)
-    _mem_last.clear()
-    _mem_last.update(current)
+        highs[name] = value
+    # sources were read unlocked (they may call back into modules that
+    # take their own locks); only the shared fold holds _mem_lock
+    with _mem_lock:
+        for name, value in highs.items():
+            _mem_high[name] = max(_mem_high.get(name, 0), value)
+        _mem_last.clear()
+        _mem_last.update(current)
     return current
 
 
@@ -681,8 +687,9 @@ def watermark_snapshot(sample=True):
 
 
 def reset_watermarks():
-    _mem_high.clear()
-    _mem_last.clear()
+    with _mem_lock:
+        _mem_high.clear()
+        _mem_last.clear()
 
 
 # the observatory's own rings are tiers too (bounded by design, but the

@@ -26,6 +26,7 @@ SyncOverflow (fleet/exchange.py).
 import collections
 import json
 import os
+import threading
 import time
 
 from . import spans as _spans
@@ -46,6 +47,7 @@ _dump_limit = int(os.environ.get('AUTOMERGE_TPU_FLIGHT_DUMP_LIMIT', 16))
 _dump_window_s = float(os.environ.get('AUTOMERGE_TPU_FLIGHT_DUMP_WINDOW',
                                       60.0))
 _dump_times = collections.deque()
+_dump_lock = threading.Lock()   # the window check is check-then-append
 _last = None
 _stats = Counters({'flight_events': 0, 'flight_dumps': 0,
                    'dumps_suppressed': 0})
@@ -83,12 +85,13 @@ def _dump_write_allowed(now):
     always assembles). True = write, with the slot recorded."""
     if _dump_limit <= 0:
         return True
-    while _dump_times and now - _dump_times[0] > _dump_window_s:
-        _dump_times.popleft()
-    if len(_dump_times) >= _dump_limit:
-        return False
-    _dump_times.append(now)
-    return True
+    with _dump_lock:
+        while _dump_times and now - _dump_times[0] > _dump_window_s:
+            _dump_times.popleft()
+        if len(_dump_times) >= _dump_limit:
+            return False
+        _dump_times.append(now)
+        return True
 
 
 def record_event(kind, **fields):
@@ -97,6 +100,7 @@ def record_event(kind, **fields):
     _stats.inc('flight_events')
     ev = {'kind': kind, 'ts_ns': time.time_ns()}
     ev.update(fields)
+    # archlint: ok[lock-discipline] lock-free ring by design: deque.append is one atomic op under the GIL and the ring is bounded by maxlen
     _events.append(ev)
     return ev
 
@@ -108,6 +112,7 @@ def recent_events(n=None):
 
 
 def clear_events():
+    # archlint: ok[lock-discipline] lock-free ring by design: deque.clear is one atomic op under the GIL (test-scoped reset, not a hot path)
     _events.clear()
 
 

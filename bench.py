@@ -2942,6 +2942,34 @@ def _sec_regress():
           file=sys.stderr)
 
 
+@section('archlint')
+def _sec_archlint():
+    # the static-contract gate rides the bench: a perf number appended
+    # to the ledger is only trajectory-comparable when the kernel-ledger
+    # / counter / determinism contracts held while it was measured. The
+    # analysis package is stdlib-only, so this costs ~1s of AST time.
+    import time as _time
+    from automerge_tpu import analysis
+    root = os.path.dirname(os.path.abspath(__file__))
+    t0 = _time.perf_counter()
+    findings, files, errors = analysis.lint_paths(
+        ['automerge_tpu', 'tools', 'bench.py'], analysis.get_rules(),
+        root=root)
+    baseline = analysis.load_baseline(
+        os.path.join(root, 'tools', 'archlint_baseline.json'))
+    checked = analysis.check_findings(findings, baseline)
+    R['archlint_violations'] = (
+        len(checked['violations']) + len(checked['unlisted']) +
+        len(checked['stale']) + len(errors))
+    R['archlint_suppressed'] = len(checked['suppressed'])
+    R['archlint_files'] = len(files)
+    R['archlint_s'] = round(_time.perf_counter() - t0, 3)
+    print(f'# archlint: {len(files)} files, '
+          f'{R["archlint_violations"]} violations, '
+          f'{R["archlint_suppressed"]} suppressed '
+          f'({R["archlint_s"]}s)', file=sys.stderr)
+
+
 @section('trace')
 def _sec_trace():
     trace_dir = capture_trace(_env('BENCH_DOCS', 10000),
@@ -2966,6 +2994,7 @@ def _final_json():
         'seam_dispatches_per_round': R.get('seam_dispatches_per_round'),
         'init_dispatches': R.get('seam_init_dispatches'),
         'sync_dispatches_per_round': R.get('syncdrv_dispatches_per_round'),
+        'archlint_violations': R.get('archlint_violations'),
         'health': health_counts(),
     }
     if BENCH_PLATFORM is not None:
@@ -3085,11 +3114,20 @@ def _run_sanity():
         if ratio > 2.0:
             failures.append(f'{name}.{key}: full {full_val:.0f} vs '
                             f'standalone {alone:.0f} = {ratio:.2f}x > 2x')
+    # not a rate ratio: the static-contract gate must read exactly zero
+    # (BENCH_SANITY is the harness CI leans on, so a contract violation
+    # fails it even when every throughput ratio agrees)
+    av = R.get('archlint_violations')
+    if av != 0:
+        failures.append(f'archlint_violations={av!r} (want 0)')
+    print(f'# sanity archlint.archlint_violations: {av!r} '
+          f'{"OK" if av == 0 else "FAIL"}', file=sys.stderr)
     if failures:
         print(json.dumps({'sanity': 'FAIL', 'failures': failures}))
         sys.exit(1)
     print(json.dumps({'sanity': 'OK',
-                      'sections_checked': list(SANITY_KEYS)}))
+                      'sections_checked': list(SANITY_KEYS) +
+                      ['archlint']}))
 
 
 def main():

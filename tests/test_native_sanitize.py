@@ -1,0 +1,140 @@
+"""Sanitizer-plane tests (ISSUE-19 satellite): the fuzz corpus replayed
+against the ASan/UBSan build of the native codec.
+
+Two doses:
+
+- the `slow`-marked test compiles the sanitized .so from scratch
+  (~100s of g++ alone) and replays a real dose — the standalone
+  enforcement run, same tier as `python tools/fuzz_wire.py`;
+- the tier-1 smoke replays a tiny dose ONLY when a sanitized .so is
+  already cached (built earlier by the slow test or by hand) and the
+  toolchain ships the sanitizer runtimes — otherwise it skips cleanly.
+  Tier-1 must never pay the compile.
+
+Also pins the AUTOMERGE_TPU_NATIVE_SO loader override the replay child
+rides on: the override loads exactly the named artifact and fails LOUDLY
+(NativeAbiMismatch) on a missing file — never a silent fallback rebuild,
+which would quietly replay against the unsanitized codec.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPLAY = os.path.join(REPO, 'tools', 'native_sanitize_replay.py')
+
+sys.path.insert(0, REPO)
+
+from automerge_tpu import native  # noqa: E402
+from tools import native_sanitize_replay as replay  # noqa: E402
+
+
+def _skip_unless_replayable(require_cached_so):
+    if not native.available():
+        pytest.skip('native toolchain unavailable')
+    if replay.sanitizer_preload() is None:
+        pytest.skip('toolchain has no libasan/libubsan runtime')
+    if require_cached_so and not os.path.exists(replay.default_san_so()):
+        pytest.skip('no cached sanitized codec (the slow test or '
+                    'tools/build_native.sh --sanitize builds it)')
+
+
+def _run_replay(seeds, cases):
+    proc = subprocess.run(
+        [sys.executable, REPLAY, '--seeds', str(seeds),
+         '--cases', str(cases)],
+        cwd=REPO, capture_output=True, text=True, timeout=1200)
+    assert proc.returncode == 0, \
+        f'rc={proc.returncode}\n{proc.stdout}\n{proc.stderr}'
+    assert 'sanitize replay clean' in proc.stdout
+
+
+def test_sanitize_smoke_replay_under_cached_so():
+    """Tier-1 dose: pristine corpus + one seed of mutants against an
+    ALREADY-BUILT sanitized codec. Skips (never compiles) otherwise."""
+    _skip_unless_replayable(require_cached_so=True)
+    _run_replay(seeds=1, cases=8)
+
+
+@pytest.mark.slow
+def test_sanitize_full_build_and_replay():
+    """Standalone dose: compile the sanitized .so from source, then
+    replay the full default corpus dose under it."""
+    _skip_unless_replayable(require_cached_so=False)
+    build = subprocess.run(
+        ['sh', os.path.join(REPO, 'tools', 'build_native.sh'),
+         '--sanitize=address,undefined'],
+        cwd=REPO, capture_output=True, text=True, timeout=600)
+    assert build.returncode == 0, build.stdout + build.stderr
+    assert os.path.exists(replay.default_san_so())
+    _run_replay(seeds=int(os.environ.get('FUZZ_SEEDS', '5')),
+                cases=int(os.environ.get('FUZZ_CASES', '40')))
+
+
+def test_overlong_sleb_varints_reject_typed():
+    """Pin for the read_sleb UB fix the sanitizer replay caught: a
+    10-byte SLEB whose last payload byte lands at shift 63 (`42 << 63`
+    was UB when read_sleb assembled into a signed int64). The column
+    decoders must reject all three handcrafted varints typed — and,
+    under the sanitized build (the smoke test above), without UBSan
+    tripping, since these payloads are pinned into the replay corpus."""
+    if not native.available():
+        pytest.skip('native toolchain unavailable')
+    from automerge_tpu.errors import AutomergeError
+    for name, payload in replay.HANDCRAFTED:
+        for fn in (native.decode_rle_column, native.decode_delta_column,
+                   lambda b: native.decode_rle_column(b, signed=True)):
+            try:
+                fn(payload)
+            except AutomergeError:
+                pass
+
+
+def test_so_override_refuses_missing_file(tmp_path):
+    """AUTOMERGE_TPU_NATIVE_SO names a file that is not there: the
+    loader must raise NativeAbiMismatch in that process, not fall back
+    to rebuilding the default codec (a silent fallback would replay the
+    fuzz corpus against the WRONG .so and report it sanitized)."""
+    if not native.available():
+        pytest.skip('native toolchain unavailable')
+    missing = str(tmp_path / 'nope.so')
+    code = ('from automerge_tpu import native\n'
+            'from automerge_tpu.native import NativeAbiMismatch\n'
+            'try:\n'
+            '    native._load()\n'
+            'except NativeAbiMismatch as exc:\n'
+            "    assert 'nope.so' in str(exc), exc\n"
+            "    print('LOUD')\n"
+            'else:\n'
+            "    raise SystemExit('override silently ignored')\n")
+    env = dict(os.environ, AUTOMERGE_TPU_NATIVE_SO=missing)
+    proc = subprocess.run([sys.executable, '-c', code], env=env,
+                          cwd=REPO, capture_output=True, text=True,
+                          timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert 'LOUD' in proc.stdout
+
+
+def test_so_override_loads_the_named_artifact():
+    """The override path loads the exact named .so (here: the normal
+    cached build, addressed explicitly) and passes the ABI check."""
+    if not native.available():
+        pytest.skip('native toolchain unavailable')
+    tag = sys.implementation.cache_tag
+    so = os.path.join(REPO, 'automerge_tpu', 'native', f'_codec_{tag}.so')
+    if not os.path.exists(so):
+        pytest.skip('no cached normal codec to address explicitly')
+    code = ('from automerge_tpu import native\n'
+            'assert native.available()\n'
+            'assert native._LIB_PATH == %r, native._LIB_PATH\n'
+            "assert native.sha256(b'x').hex().startswith('2d71')\n"
+            "print('OVERRIDE-OK')\n" % so)
+    env = dict(os.environ, AUTOMERGE_TPU_NATIVE_SO=so)
+    proc = subprocess.run([sys.executable, '-c', code], env=env,
+                          cwd=REPO, capture_output=True, text=True,
+                          timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert 'OVERRIDE-OK' in proc.stdout

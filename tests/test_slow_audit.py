@@ -21,14 +21,22 @@ import os
 
 import conftest
 
-DEFAULT_BUDGET_S = 5.0
+# 5.0s is calibrated on the >=2-core reference box. A 1-core box
+# (the round-21 driver) at least doubles every family's wall time —
+# XLA compiles lose their thread pool and the 3-4s families straddle
+# the default on scheduling noise alone (observed: 3.2s -> 5.4s run to
+# run) — so the default scales up there. Grandfather budgets already
+# carry contended-worst-case headroom and stay fixed.
+DEFAULT_BUDGET_S = 5.0 if (os.cpu_count() or 2) >= 2 else 12.0
 
 # family (tests/<file>.py::<function>) -> tier-1 budget in seconds,
 # ~2.5x the family's measured cost on the reference box (2026-08-03
 # full-run --durations sweep) so box noise passes but a doubled matrix
-# fails. The Mosaic AOT family's cost is a ~435s SETUP burned by this
-# image's pre-existing environment failure (the compile retries until
-# its own timeout) — budgeted as-is, flagged for any further growth.
+# fails. The Mosaic AOT family's SETUP used to burn ~435s on this
+# image's pre-existing environment failure; since round 21 a 120s
+# deadline-bounded topology probe caps that burn (the family skips on
+# broken-libtpu boxes). The 600s budget is the real compile's cost on
+# a working-toolchain box, where the probe passes in seconds.
 GRANDFATHER_BUDGETS = {
     'tests/test_pallas.py::TestMosaicAOT::test_mosaic_compiles_variant':
         600.0,
@@ -76,6 +84,22 @@ GRANDFATHER_BUDGETS = {
     'tests/test_durability.py::'
     'test_recovery_rejournals_instead_of_resnapshotting': 25.0,
     'tests/test_fuzz_wire.py::test_fuzz_wire_smoke': 10.0,
+    # measured 4.2-5.3s across two full runs on the 1-core round-21 box
+    # (straddling the 5.0s default by box noise alone; family cost
+    # unchanged in isolation) — budgeted off the contended worst case
+    'tests/test_hashindex.py::TestHashIndexCore::'
+    'test_host_and_device_modes_answer_identically': 12.0,
+    # ISSUE-19 sanitizer smoke: the replay parent subprocess imports the
+    # full stack (jax) to build the fuzz corpus before the jax-free
+    # child replays it under the cached ASan .so — 5.0s isolated,
+    # budgeted for suite contention like the other child-spawners
+    'tests/test_native_sanitize.py::'
+    'test_sanitize_smoke_replay_under_cached_so': 20.0,
+    # ISSUE-19 tier-1 contract gate: one archlint subprocess over the
+    # real tree (stdlib-only AST pass, ~1.1s isolated; subprocess
+    # startup draws the same contention lottery as the others)
+    'tests/test_archlint.py::'
+    'test_real_tree_is_clean_under_checked_in_baseline': 12.0,
     # ISSUE-13 perf-observatory family: the atomic-counter hammer (6
     # threads x 10k locked incs, measured ~2s isolated) and the torn-
     # read `_sum` exposition hammer (writer thread + 50 scrapes,

@@ -12,24 +12,60 @@
 #   -I<python> optional: CPython headers enable the zero-copy list entry
 #              (am_ingest_changes_list); the codec builds without them
 #
+# Sanitizer plane:
+#   --sanitize=address,undefined   builds a SEPARATE artifact
+#       _codec_<cache_tag>_san.<sanitizers>.so at -O1 -g with the given
+#       -fsanitize= list. The normal .so is untouched; point the loader
+#       at the sanitized build explicitly with
+#       AUTOMERGE_TPU_NATIVE_SO=<path> (plus LD_PRELOAD of libasan when
+#       ASan is in the list — the host python is not ASan-linked).
+#       tools/native_sanitize_replay.py replays the fuzz corpus under it.
+#
 # The binary carries an ABI stamp (am_abi_version, checked against
 # native.__init__._ABI_VERSION at import): a stale .so fails LOUDLY
 # instead of silently running an old single-threaded codec. After editing
-# codec.cpp's C surface, bump BOTH stamps.
+# codec.cpp's C surface, bump BOTH stamps. The sanitized build compiles
+# from the same source, so it carries the same stamp — the loader's ABI
+# check applies to it unchanged.
 set -eu
 
 here="$(cd "$(dirname "$0")/.." && pwd)"
 src="$here/automerge_tpu/native/codec.cpp"
 python_bin="${PYTHON:-python3}"
 
+sanitize=""
+for arg in "$@"; do
+    case "$arg" in
+        --sanitize=*) sanitize="${arg#--sanitize=}" ;;
+        --sanitize) sanitize="address,undefined" ;;
+        *) echo "usage: $0 [--sanitize[=address,undefined]]" >&2; exit 2 ;;
+    esac
+done
+
 cache_tag="$("$python_bin" -c 'import sys; print(sys.implementation.cache_tag)')"
-out="$here/automerge_tpu/native/_codec_${cache_tag}.so"
 
 inc="$("$python_bin" -c 'import sysconfig; print(sysconfig.get_paths().get("include") or "")')"
 inc_flag=""
 if [ -n "$inc" ] && [ -e "$inc/Python.h" ]; then
     inc_flag="-I$inc"
 fi
+
+if [ -n "$sanitize" ]; then
+    # separate artifact name so the sanitized build can never shadow the
+    # fast .so the on-demand loader picks up
+    suffix="$(printf '%s' "$sanitize" | tr ',' '-')"
+    out="$here/automerge_tpu/native/_codec_${cache_tag}_san.${suffix}.so"
+    rm -f "$out"   # glibc dlopen dedups by inode: never rebuild in place
+    # shellcheck disable=SC2086
+    g++ -O1 -g -fno-omit-frame-pointer "-fsanitize=$sanitize" \
+        -shared -fPIC -std=c++17 -pthread $inc_flag "$src" -lz -o "$out"
+    echo "built sanitized codec: $out"
+    echo "replay the fuzz corpus under it with:"
+    echo "  $python_bin $here/tools/native_sanitize_replay.py --so $out"
+    exit 0
+fi
+
+out="$here/automerge_tpu/native/_codec_${cache_tag}.so"
 
 # shellcheck disable=SC2086  # inc_flag is intentionally word-split
 g++ -O3 -shared -fPIC -std=c++17 -pthread $inc_flag "$src" -lz -o "$out"

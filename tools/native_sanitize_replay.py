@@ -1,0 +1,219 @@
+"""Replay the fuzz corpus against the SANITIZED native codec.
+
+The normal fuzz net (tools/fuzz_wire.py) enforces the typed-error
+envelope against the -O3 codec; a heap overread that happens to land in
+mapped memory sails right through it. This tool is the memory-safety
+half of that contract: build hostile mutants from the same corpus and
+feed them to every native entry point with the codec compiled at
+`-fsanitize=address,undefined` (tools/build_native.sh --sanitize). Any
+out-of-bounds access, use-after-free, or UB aborts the child process —
+the parent turns that into a nonzero exit.
+
+Split into two processes because the sanitized .so and the fuzz corpus
+have incompatible needs:
+
+- the PARENT builds the corpus via tools/fuzz_wire.py, which imports the
+  full stack (jax included) — loading an ASan-instrumented .so into that
+  process would need ASan to interpose malloc before jax/XLA start
+  allocating, and the host python is not ASan-linked;
+- the CHILD (`--child`) imports ONLY `automerge_tpu.native` (jax-free,
+  ~0.1s) with `AUTOMERGE_TPU_NATIVE_SO` pointing at the sanitized build
+  and `LD_PRELOAD` carrying libasan/libubsan, so the sanitizer runtime
+  is in place before the codec loads.
+
+The child catches Python-level exceptions (typed rejections are the
+EXPECTED outcome for mutants; the envelope itself is fuzz_wire's job at
+the normal build) — only a sanitizer abort, a crash, or a corpus
+shortfall fails the replay.
+
+Usage:
+  tools/build_native.sh --sanitize=address,undefined
+  python tools/native_sanitize_replay.py [--seeds N] [--cases N] [--so PATH]
+"""
+
+import argparse
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SAN_SUFFIX = 'address-undefined'
+
+
+def default_san_so():
+    tag = sys.implementation.cache_tag
+    return os.path.join(REPO, 'automerge_tpu', 'native',
+                        f'_codec_{tag}_san.{SAN_SUFFIX}.so')
+
+
+def sanitizer_preload():
+    """The libasan/libubsan runtime paths for LD_PRELOAD, or None when
+    the toolchain does not ship them (then there is nothing to replay
+    under and callers should skip, not fail)."""
+    libs = []
+    for name in ('libasan.so', 'libubsan.so'):
+        try:
+            out = subprocess.run(['gcc', f'-print-file-name={name}'],
+                                 capture_output=True, text=True, timeout=30)
+        except (OSError, subprocess.SubprocessError):
+            return None
+        path = out.stdout.strip()
+        # gcc echoes the bare name back when it has no such library
+        if not path or path == name or not os.path.exists(path):
+            return None
+        libs.append(os.path.realpath(path))
+    return ':'.join(libs)
+
+
+# regression pins: payloads that once tripped the sanitizer stay in the
+# replay forever, whatever the seeded mutator happens to generate
+HANDCRAFTED = [
+    # 10-byte SLEB whose final byte lands at shift 63: `42 << 63` was UB
+    # in read_sleb when it assembled into a signed int64 (UBSan, found
+    # by this replay; codec.cpp now assembles unsigned)
+    ('handcrafted:sleb-shift63', bytes([0xaa] * 9 + [0x2a])),
+    # INT64_MIN as a literal-run count (-count negation guard)
+    ('handcrafted:sleb-int64min', bytes([0x80] * 9 + [0x01])),
+    # ULEB longer than 64 bits (shift >= 64 error path)
+    ('handcrafted:uleb-overlong', bytes([0xff] * 10 + [0x01])),
+]
+
+
+def build_cases(n_seeds, n_cases):
+    """(name, payload) replay cases: every pristine corpus artifact plus
+    seeded mutants — the pristine items drive the success paths (RLE
+    runs, deflated columns, multi-change docs) under the sanitizer, the
+    mutants drive the bounds checks."""
+    import random
+
+    from tools import fuzz_wire  # heavy import (full stack), parent-only
+
+    corpus = fuzz_wire.build_corpus()
+    flat = [(kind, item) for kind, items in corpus.items()
+            for item in items]
+    cases = list(HANDCRAFTED)
+    cases += [(f'corpus:{kind}', bytes(item)) for kind, item in flat]
+    for seed in range(n_seeds):
+        rng = random.Random(seed)
+        for case in range(n_cases):
+            kind, base = flat[rng.randrange(len(flat))]
+            cases.append((f'mutant:{kind}:{seed}:{case}',
+                          fuzz_wire.mutate(rng, base)))
+    return cases
+
+
+def child_main(cases_path):
+    """Runs inside the sanitized environment. Keep this jax-free."""
+    from automerge_tpu import native
+
+    so = os.environ.get('AUTOMERGE_TPU_NATIVE_SO')
+    if not so:
+        print('child: AUTOMERGE_TPU_NATIVE_SO is not set', file=sys.stderr)
+        return 2
+    if not native.available():
+        print(f'child: sanitized codec failed to load: {so}',
+              file=sys.stderr)
+        return 2
+
+    with open(cases_path, 'rb') as fh:
+        cases = pickle.load(fh)
+
+    # every native entry point that eats untrusted bytes; max_size on
+    # inflate is capped so a mutant length header cannot OOM the replay
+    targets = [
+        ('sha256', native.sha256),
+        ('sha256_batch', lambda m: native.sha256_batch([m, m])),
+        ('deflate', native.deflate_raw),
+        ('inflate', lambda m: native.inflate_raw(m, max_size=1 << 20)),
+        ('rle', native.decode_rle_column),
+        ('rle_signed', lambda m: native.decode_rle_column(m, signed=True)),
+        ('delta', native.decode_delta_column),
+        ('boolean', native.decode_boolean_column),
+        ('ingest', lambda m: native.ingest_changes(
+            [m], None, with_meta=True, with_seq=True)),
+        ('parse_documents', lambda m: native.parse_documents([m])),
+        ('extract_changes', lambda m: native.extract_changes([m])),
+        ('build_document', lambda m: native.build_document([m], [])),
+    ]
+
+    ran = 0
+    outcomes = {}
+    for _name, payload in cases:
+        for tname, fn in targets:
+            try:
+                fn(payload)
+                verdict = 'ok'
+            except Exception as exc:  # noqa: BLE001 — envelope is fuzz_wire's job
+                verdict = type(exc).__name__
+            key = (tname, verdict)
+            outcomes[key] = outcomes.get(key, 0) + 1
+            ran += 1
+
+    for (tname, verdict), count in sorted(outcomes.items()):
+        print(f'child: {tname:16s} {verdict:24s} x{count}')
+    print(f'child: replayed {ran} (case, target) pairs over '
+          f'{len(cases)} payloads, sanitizer quiet')
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--so', default=None,
+                    help='sanitized .so (default: the build_native.sh '
+                         '--sanitize artifact for this interpreter)')
+    ap.add_argument('--seeds', type=int,
+                    default=int(os.environ.get('FUZZ_SEEDS', '5')))
+    ap.add_argument('--cases', type=int,
+                    default=int(os.environ.get('FUZZ_CASES', '40')))
+    ap.add_argument('--child', metavar='CASES_PKL', default=None,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.child:
+        return child_main(args.child)
+
+    so = os.path.abspath(args.so or default_san_so())
+    if not os.path.exists(so):
+        print(f'sanitized codec not built: {so}\n'
+              f'build it with: tools/build_native.sh '
+              f'--sanitize=address,undefined', file=sys.stderr)
+        return 2
+    preload = sanitizer_preload()
+    if preload is None:
+        print('toolchain has no libasan/libubsan runtime; nothing to '
+              'replay under', file=sys.stderr)
+        return 2
+
+    cases = build_cases(args.seeds, args.cases)
+    env = dict(os.environ)
+    env['AUTOMERGE_TPU_NATIVE_SO'] = so
+    env['LD_PRELOAD'] = preload
+    # the replay python is not ASan-linked, so interceptors see "leaks"
+    # from the interpreter itself; halt_on_error stays on for real bugs
+    env['ASAN_OPTIONS'] = 'detect_leaks=0:abort_on_error=1'
+    env['UBSAN_OPTIONS'] = 'halt_on_error=1:print_stacktrace=1'
+
+    with tempfile.TemporaryDirectory(prefix='am_san_replay_') as tmp:
+        cases_path = os.path.join(tmp, 'cases.pkl')
+        with open(cases_path, 'wb') as fh:
+            pickle.dump(cases, fh)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             '--child', cases_path],
+            env=env, cwd=REPO, timeout=1800)
+    if proc.returncode != 0:
+        print(f'SANITIZER REPLAY FAILED (child rc={proc.returncode}): '
+              f'{len(cases)} payloads against {so}', file=sys.stderr)
+        return 1
+    print(f'sanitize replay clean: {len(cases)} payloads '
+          f'({args.seeds} seeds x {args.cases} cases + corpus) '
+          f'against {os.path.basename(so)}')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

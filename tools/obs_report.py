@@ -9,6 +9,8 @@ Usage:
                                [-o stitched_trace.json]
     python tools/obs_report.py --stitch shard0=a.json shard1=b.json
     python tools/obs_report.py --metrics metrics_snapshot.prom
+    python tools/archlint.py --check --json - | \\
+                               python tools/obs_report.py --archlint -
     python tools/obs_report.py --floor kernel_ledger.json [trace.json]
     python tools/obs_report.py --trajectory [BENCH_LEDGER.jsonl]
 
@@ -370,6 +372,54 @@ def render_metrics(path, out=None):
     return 0
 
 
+def render_archlint(path, out=None):
+    """Pretty-print an ``archlint --json`` payload (file or ``-`` for
+    stdin): the per-rule violation/suppression roll-up, every violation
+    with its file:line, and the justified suppressions — the static-
+    contract counterpart of the runtime health-counter report."""
+    if path == '-':
+        data = json.load(sys.stdin)
+    else:
+        with open(path) as f:
+            data = json.load(f)
+    if data.get('version') != 1:
+        print(f'unsupported archlint payload version '
+              f'{data.get("version")!r}', file=sys.stderr)
+        return 2
+    per_rule = {}
+    for f in data.get('findings', []):
+        bucket = 'suppressed' if f.get('suppressed') else 'violations'
+        per_rule.setdefault(f['rule'], {'violations': 0,
+                                        'suppressed': 0})[bucket] += 1
+    print(f'# archlint over {data.get("files")} files: '
+          f'{data.get("violations")} violations, '
+          f'{data.get("suppressed")} suppressed '
+          f'({data.get("unlisted")} unlisted, '
+          f'{len(data.get("stale", []))} stale baseline entries)',
+          file=out)
+    for rule in data.get('rules', []):
+        rid = rule['id']
+        counts = per_rule.get(rid, {'violations': 0, 'suppressed': 0})
+        print(f'  {rid:20s} {counts["violations"]:3d} violations  '
+              f'{counts["suppressed"]:3d} suppressed', file=out)
+    for f in data.get('findings', []):
+        if not f.get('suppressed'):
+            print(f'  VIOLATION {f["path"]}:{f["line"]}: [{f["rule"]}] '
+                  f'{f["message"]}', file=out)
+    for f in data.get('findings', []):
+        if f.get('suppressed'):
+            print(f'  suppressed {f["path"]}:{f["line"]} [{f["rule"]}]: '
+                  f'{f.get("justification")}', file=out)
+    for e in data.get('stale', []):
+        print(f'  STALE baseline entry {e.get("fingerprint")} '
+              f'[{e.get("rule")}] {e.get("path")}', file=out)
+    for e in data.get('errors', []):
+        print(f'  UNPARSEABLE {e.get("path")}: {e.get("message")}',
+              file=out)
+    return 0 if not (data.get('violations') or data.get('unlisted') or
+                     data.get('stale') or data.get('errors')) else 1
+
+
 def render_floor(ledger_path, trace_path=None, out=None):
     """The residual-floor table: device kernels (cost ledger) and,
     when a trace is given, the host phases they compete with."""
@@ -442,6 +492,12 @@ def main(argv):
         import bench_ledger
         return bench_ledger.render_trajectory(
             argv[1] if len(argv) > 1 else None)
+    if argv[0] == '--archlint':
+        if len(argv) < 2:
+            print('--archlint needs an `archlint --json` payload path '
+                  '(or - for stdin)', file=sys.stderr)
+            return 2
+        return render_archlint(argv[1])
     if argv[0] == '--metrics':
         if len(argv) < 2:
             print('--metrics needs an exposition-file path',
