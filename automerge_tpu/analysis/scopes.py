@@ -119,6 +119,12 @@ THREADED_MODULES = frozenset({
     'automerge_tpu/observability/perf.py',
     'automerge_tpu/service/core.py',
     'automerge_tpu/fleet/exchange.py',
+    # the control plane: its gauges are read by the exporter's scrape
+    # thread while the pump thread commits decisions (the controller
+    # lock brackets both sides; module stats are Counters)
+    'automerge_tpu/control/signals.py',
+    'automerge_tpu/control/policies.py',
+    'automerge_tpu/control/controller.py',
 })
 
 

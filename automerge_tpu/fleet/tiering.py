@@ -178,11 +178,18 @@ class ClockDemote:
     the resident-bytes ``source`` reads above ``budget_bytes`` — the
     watermark feed (observability/perf.py ``sample_watermarks`` tiers,
     or process RSS by default). Docs the engine refuses to park (queued
-    changes, frozen) stay in the ring for the next pass."""
+    changes, frozen) stay in the ring for the next pass.
+
+    Two control-plane levers (control/): ``pin``/``unpin`` exempt
+    specific handles from demotion (an SLO-freshness-lagging tenant's
+    docs stay resident however cold they look), and ``pressure_factor``
+    scales the effective budget (<1.0 demotes the unpinned population
+    harder — the memory the pins hold has to come from somewhere)."""
 
     def __init__(self, engine, budget_bytes, source=None, batch=128):
         self.engine = engine
         self.budget_bytes = int(budget_bytes)
+        self.pressure_factor = 1.0
         if source is None:
             from ..observability.perf import rss_bytes
             source = lambda: rss_bytes()[0]      # noqa: E731
@@ -191,6 +198,7 @@ class ClockDemote:
         self._ring = []              # [handle, ref_bit]
         self._by_handle = {}         # id(handle) -> ring index
         self._hand = 0
+        self._pinned = {}            # id(handle) -> handle (strong ref)
         self.last_parked = []        # (handle, doc_id) pairs, last tick
 
     def __len__(self):
@@ -209,10 +217,26 @@ class ClockDemote:
             if idx is not None:
                 self._ring[idx][1] = True
 
+    def pin(self, handles):
+        """Exempt these handles from demotion (idempotent). The pin
+        holds a strong ref so a pinned doc's handle id cannot be
+        recycled out from under the exemption; stale (frozen/parked)
+        pins drop at the next prune."""
+        for handle in handles:
+            self._pinned[id(handle)] = handle
+
+    def unpin(self, handles):
+        for handle in handles:
+            self._pinned.pop(id(handle), None)
+
+    def pinned_count(self):
+        return len(self._pinned)
+
     def pressure(self):
-        if self.budget_bytes <= 0:
+        budget = self.budget_bytes * self.pressure_factor
+        if budget <= 0:
             return 0.0
-        return self.source() / self.budget_bytes
+        return self.source() / budget
 
     def _prune(self):
         """Drop parked/frozen/dead entries, reindex, and KEEP the hand
@@ -232,6 +256,15 @@ class ClockDemote:
         self._ring = fresh
         self._by_handle = {id(h): i for i, (h, _r) in enumerate(fresh)}
         self._hand = new_hand % len(fresh) if fresh else 0
+        if self._pinned:
+            # pins on handles the seam has since frozen (each apply
+            # freezes the old handle dict) are stale: drop them so the
+            # pin set stays bounded by the live pinned population
+            self._pinned = {
+                hid: h for hid, h in self._pinned.items()
+                if not h.get('frozen') and
+                isinstance(h.get('state'), FleetDoc) and
+                h.get('state').is_fleet}
 
     def _sweep(self, budget):
         """Advance the hand up to `budget` steps collecting at most
@@ -246,7 +279,8 @@ class ClockDemote:
             steps += 1
             if entry[1]:
                 entry[1] = False
-            elif not entry[0].get('frozen'):
+            elif not entry[0].get('frozen') and \
+                    id(entry[0]) not in self._pinned:
                 out.append(entry[0])
         return out, steps
 

@@ -91,11 +91,14 @@ def _hist_snapshot(h):
             'sum': float(sum(sums)), 'scale': h.scale}
 
 
-def snapshot_all(slo=None, fleets=(), router=None):
+def snapshot_all(slo=None, fleets=(), router=None, control=None):
     """Every exposed value as plain data — the atomic snapshot both the
     text renderer and the snapshot-file mode serialize from. ``router``
     (an in-process ShardRouter) adds per-shard tick-overrun telemetry:
-    each shard's slipped-tick counter and last pump seconds."""
+    each shard's slipped-tick counter and last pump seconds.
+    ``control`` (a control/ Controller) adds the controller's own
+    gauges — its ``gauges()`` copies under the controller lock, the
+    same torn-read-proof contract the SLO reads carry."""
     snap = {
         'health': health_counts(),
         'dispatch': dispatch_counts(fleets),
@@ -108,6 +111,8 @@ def snapshot_all(slo=None, fleets=(), router=None):
                                for sid, s in router.shards.items()}
         snap['shard_pump_s'] = {sid: s.last_pump_s
                                 for sid, s in router.shards.items()}
+    if control is not None:
+        snap['control'] = control.gauges()
     if slo is not None:
         snap['slo_tallies'] = slo.tallies()
         snap['slo_gauges'] = slo.gauges()
@@ -148,7 +153,8 @@ def _render_hist_lines(lines, metric, snap, labels=''):
                  if labels else f'{metric}_count {snap["count"]}')
 
 
-def render_prometheus(slo=None, fleets=(), shard=None, router=None):
+def render_prometheus(slo=None, fleets=(), shard=None, router=None,
+                      control=None):
     """The full text-format 0.0.4 exposition page (one trailing
     newline), rendered from ``snapshot_all``. ``shard`` stamps a
     ``shard="<id>"`` label on EVERY sample line — the process-level
@@ -156,7 +162,8 @@ def render_prometheus(slo=None, fleets=(), shard=None, router=None):
     shard process; the in-process ``ShardRouter`` testbed renders one
     page per shard the same way), so per-shard dashboards and the
     failover runbooks can select a single failure domain."""
-    snap = snapshot_all(slo=slo, fleets=fleets, router=router)
+    snap = snapshot_all(slo=slo, fleets=fleets, router=router,
+                        control=control)
     sl = f'shard="{_label(shard)}"' if shard is not None else ''
     lines = []
 
@@ -189,6 +196,47 @@ def render_prometheus(slo=None, fleets=(), shard=None, router=None):
         for sid, v in sorted(snap['shard_pump_s'].items()):
             ls = _labelset(psl, f'shard="{_label(sid)}"')
             lines.append(f'{_PREFIX}_shard_pump_seconds{ls} {_fmt(v)}')
+
+    if snap.get('control'):
+        # the control plane's own reasoning as series (control/): how
+        # often each (policy, action) decided — split by mode, so a
+        # shadow deployment graphs would-have-acted next to an active
+        # one — plus direction reversals (the anti-oscillation number),
+        # currently-active policy state, and decision latency. The
+        # process `shard=` identity composes alongside like every other
+        # domain label.
+        ctl = snap['control']
+        lines.append(f'# TYPE {_PREFIX}_control_decisions_total counter')
+        for (policy, action, mode), n in sorted(
+                ctl['decisions'].items()):
+            ls = _labelset(sl, (f'policy="{_label(policy)}",'
+                                f'action="{_label(action)}",'
+                                f'mode="{_label(mode)}"'))
+            lines.append(f'{_PREFIX}_control_decisions_total{ls} {n}')
+        lines.append(f'# TYPE {_PREFIX}_control_reversals_total counter')
+        for policy, n in sorted(ctl['reversals'].items()):
+            ls = _labelset(sl, f'policy="{_label(policy)}"')
+            lines.append(f'{_PREFIX}_control_reversals_total{ls} {n}')
+        lines.append(f'# TYPE {_PREFIX}_control_policy_active gauge')
+        for (policy, target), value in sorted(ctl['active'].items()):
+            ls = _labelset(sl, (f'policy="{_label(policy)}",'
+                                f'target="{_label(target)}"'))
+            lines.append(f'{_PREFIX}_control_policy_active{ls} '
+                         f'{_fmt(value)}')
+        lines.append(f'# TYPE {_PREFIX}_control_windows_total counter')
+        lines.append(f'{_PREFIX}_control_windows_total{_labelset(sl)} '
+                     f'{ctl["windows"]}')
+        lines.append(f'# TYPE {_PREFIX}_control_last_decision_tick '
+                     f'gauge')
+        lines.append(f'{_PREFIX}_control_last_decision_tick'
+                     f'{_labelset(sl)} '
+                     f'{ctl["last_decision_tick"] or 0}')
+        lines.append(f'# TYPE {_PREFIX}_control_decide_seconds gauge')
+        for which, key in (('last', 'decide_s_last'),
+                           ('max', 'decide_s_max')):
+            ls = _labelset(sl, f'window="{which}"')
+            lines.append(f'{_PREFIX}_control_decide_seconds{ls} '
+                         f'{_fmt(ctl[key])}')
 
     if snap.get('perf_seams'):
         # seam perf baselines (perf.py): trailing baseline vs newest
@@ -289,7 +337,8 @@ class MetricsExporter:
     snapshot-file writer only."""
 
     def __init__(self, port=0, host='127.0.0.1', slo=None, fleets=(),
-                 snapshot_path=None, shard=None, router=None):
+                 snapshot_path=None, shard=None, router=None,
+                 control=None):
         self._port_arg = port
         self.host = host
         self.slo = slo
@@ -297,13 +346,15 @@ class MetricsExporter:
         self.snapshot_path = snapshot_path
         self.shard = shard
         self.router = router
+        self.control = control
         self.port = None
         self._server = None
         self._thread = None
 
     def render(self):
         return render_prometheus(slo=self.slo, fleets=self.fleets,
-                                 shard=self.shard, router=self.router)
+                                 shard=self.shard, router=self.router,
+                                 control=self.control)
 
     # -- HTTP mode ------------------------------------------------------
 
@@ -378,7 +429,8 @@ class MetricsExporter:
         return path
 
 
-def maybe_start_exporter(slo=None, fleets=(), shard=None, router=None):
+def maybe_start_exporter(slo=None, fleets=(), shard=None, router=None,
+                         control=None):
     """The env-driven entry point: ``AUTOMERGE_TPU_METRICS_PORT`` set
     starts (and returns) a serving ``MetricsExporter`` on that port
     (0 = ephemeral); ``AUTOMERGE_TPU_METRICS_SNAPSHOT`` set (with no
@@ -394,10 +446,11 @@ def maybe_start_exporter(slo=None, fleets=(), shard=None, router=None):
     if port is not None and port != '':
         exporter = MetricsExporter(port=int(port), slo=slo, fleets=fleets,
                                    snapshot_path=snapshot or None,
-                                   shard=shard, router=router)
+                                   shard=shard, router=router,
+                                   control=control)
         return exporter.start()
     if snapshot:
         return MetricsExporter(port=None, slo=slo, fleets=fleets,
                                snapshot_path=snapshot, shard=shard,
-                               router=router)
+                               router=router, control=control)
     return None

@@ -129,6 +129,20 @@ class AdmissionController:
         self.queued += 1
         self.stats['admitted'] += 1
 
+    def set_tenant_rate(self, name, rate=None, burst=None):
+        """Retarget one tenant's token-bucket refill ``rate`` (and/or
+        ``burst``) in place — the control plane's actuator. The bucket
+        object survives, so tokens already accrued are kept (clamped to
+        the new burst) and the next ``_refill`` accrues at the new rate
+        mid-flight. Returns the bucket."""
+        bucket = self.tenant(name).bucket
+        if rate is not None:
+            bucket.rate = float(rate)
+        if burst is not None:
+            bucket.burst = float(burst)
+            bucket.tokens = min(bucket.tokens, bucket.burst)
+        return bucket
+
     def requeue_front(self, tenant, requests):
         """Push unserved requests back at the FRONT of their tenant's
         queue (a batch aborted before its dispatch — deadline raced, the

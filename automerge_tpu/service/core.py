@@ -223,7 +223,7 @@ class DocService:
                  default_timeout=None,
                  backoff=None, retry_rate=20.0, retry_burst=40.0,
                  stall_rounds=8,
-                 brownout=None, slo=None, tiering=None,
+                 brownout=None, slo=None, tiering=None, control=None,
                  clock=time.monotonic):
         from ..fleet.backend import DocFleet
         self.durable = durable
@@ -234,6 +234,14 @@ class DocService:
         # INPUT to that model (write-cost multiplier) instead of the
         # legacy hard defer-compaction override.
         self.tiering = tiering
+        # `control`: a control/ Controller. When attached, the pump
+        # ticks it after the observability hooks — the feedback loop
+        # (admission-rate adaptation, freshness pins) rides the same
+        # cadence as the signals it consumes. The controller binds
+        # itself to this service here.
+        self.control = control
+        if control is not None:
+            control.attach(service=self)
         if durable is not None:
             fleet = durable.fleet
         self.fleet = fleet if fleet is not None else DocFleet()
@@ -428,6 +436,10 @@ class DocService:
         # the seam-perf observatory rides the same cadence: a no-op flag
         # check unless perf.enable_baselines()/enable_observatory() ran
         _perf.maybe_tick()
+        # the control plane ticks LAST: its decision windows read the
+        # SLO/perf state the hooks above just rolled
+        if self.control is not None:
+            self.control.tick(now)
         return stats
 
     def _pump_inner(self, now):

@@ -314,7 +314,7 @@ class ShardRouter:
                  retry_rate=50.0, retry_burst=100.0,
                  repl_stall_rounds=8, service_kwargs=None,
                  pump_threads=None, repl_every=1, tick_budget_s=None,
-                 scrub_every=25):
+                 scrub_every=25, control=None):
         if shard_ids is None:
             shard_ids = [f'shard{i}' for i in range(n_shards or 1)]
         self.clock = clock if clock is not None else time.monotonic
@@ -376,6 +376,13 @@ class ShardRouter:
             self._pool = ThreadPoolExecutor(
                 max_workers=int(pump_threads),
                 thread_name_prefix='shard-pump')
+        # `control`: a control/ Controller ticked once per cluster pump
+        # (after harvest, when the tick's placement/pending state is
+        # settled). Its shard-balance policy drives rehome_tenant —
+        # the same migration machinery rebalance() uses.
+        self.control = control
+        if control is not None:
+            control.attach(router=self)
 
     # -- wiring ---------------------------------------------------------
 
@@ -635,6 +642,8 @@ class ShardRouter:
                 self.scrub_frontiers()
             self._advance_migrations()
             self._harvest(now)
+            if self.control is not None:
+                self.control.tick(now)
 
     # -- failover -------------------------------------------------------
 
@@ -880,6 +889,24 @@ class ShardRouter:
                 _stats.inc('shard_rebalances')
                 started += 1
         return started
+
+    def rehome_tenant(self, name, dst):
+        """Start migrating ONE tenant to an explicit destination shard —
+        the control plane's targeted actuator (hot-shard relief, ring
+        healing), riding the exact migration machinery ``rebalance``
+        uses (read-only window -> chunk transfer -> cutover across the
+        next pumps). Returns True when a migration started; False when
+        the move is impossible right now (unknown tenant, already
+        migrating, unplaced, dead or identical destination)."""
+        rec = self._tenants.get(name)
+        if rec is None or rec.migrating is not None or rec.home is None:
+            return False
+        if dst == rec.home or dst not in self.alive or \
+                dst not in self.shards:
+            return False
+        rec.migrating = {'phase': 'readonly', 'to': dst}
+        _stats.inc('shard_rebalances')
+        return True
 
     def migrating(self):
         return [rec.name for rec in self._tenants.values()

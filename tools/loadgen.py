@@ -56,6 +56,7 @@ from automerge_tpu.errors import AutomergeError                # noqa: E402
 from automerge_tpu.fleet import backend as fleet_backend      # noqa: E402
 from automerge_tpu.fleet.backend import DocFleet              # noqa: E402
 from automerge_tpu.fleet.faults import LossyLink              # noqa: E402
+from automerge_tpu.control import Controller                  # noqa: E402
 from automerge_tpu.observability.slo import outcome_class     # noqa: E402
 from automerge_tpu.service import Backoff, DocService         # noqa: E402
 from automerge_tpu.shard import ShardRouter, shard_stats      # noqa: E402
@@ -655,7 +656,8 @@ def run_shard_leg(name, *, n_shards=4, tenants=16, requests=800,
                   audit_rounds=True, exact_device=False,
                   link_budget=48, max_ticks=60_000, mttr_bound=None,
                   service_kwargs=None, pump_threads=None, repl_every=1,
-                  pace=False):
+                  pace=False, control=None, control_window=5,
+                  settle_bound=None):
     """The kill-and-recover chaos leg for the shard cluster (ISSUE-11).
 
     Drives an open-loop workload (applies + subscription pulls + sync
@@ -679,10 +681,33 @@ def run_shard_leg(name, *, n_shards=4, tenants=16, requests=800,
     Plus the standing properties: zero untyped escapes (every failed
     ticket carries an AutomergeError), and failover MTTR — ticks from
     each kill to the first acked request served by a re-homed tenant's
-    replica — reported per kill (``mttr_bound`` asserts a ceiling)."""
+    replica — reported per kill (``mttr_bound`` asserts a ceiling).
+
+    ``control='active'|'shadow'`` rides a ``control.Controller`` on the
+    router's pump (the self-driving leg, ISSUE-20): under ACTIVE
+    control the leg's hardcoded ``rebalance_after_revive`` call is
+    disabled — post-revive placement healing is exactly the control
+    plane's heal lane, and this leg is where it earns that job. The
+    leg's ``ok`` then also requires <= 2 direction reversals per policy
+    (the anti-oscillation bound), a decision-free CONVERGENCE HOLD (10
+    quiet decision windows pumped after the drain — an oscillating
+    controller keeps hunting and fails it), and, with ``settle_bound``,
+    that the last decision lands within that many ticks of the last
+    revive. Both audits (zero acked-write loss, byte-identical
+    convergence) run unchanged: a controller that converges by losing
+    writes fails the same assert the chaos schedule does."""
     rng = random.Random(seed)
     clk = [0.0]
     link_seed = [seed * 7919 + 13]
+    if control is not None and control not in ('active', 'shadow'):
+        raise ValueError(f"control must be None, 'active' or 'shadow', "
+                         f'got {control!r}')
+    ctrl = Controller(mode=control, window=control_window) \
+        if control is not None else None
+    if control == 'active':
+        # the heal lane owns post-revive placement now; the hardcoded
+        # rebalance would fight it (and mask it)
+        rebalance_after_revive = False
 
     def link_factory(src, dst):
         if not chaos:
@@ -701,6 +726,7 @@ def run_shard_leg(name, *, n_shards=4, tenants=16, requests=800,
         # ticks are attributed PER SHARD (Shard.ticks_slipped -> the
         # labeled Prometheus counter), not just counted in this loop
         tick_budget_s=tick_dt if pace else None,
+        control=ctrl,
         backoff=Backoff(base=tick_dt, factor=1.5, cap=tick_dt * 16,
                         retries=16, jitter=0.5, seed=seed + 3))
     shard_ids = router.ring.shard_ids()
@@ -718,6 +744,7 @@ def run_shard_leg(name, *, n_shards=4, tenants=16, requests=800,
     mttrs = []                  # one record per kill
     kill_list = sorted(kills)
     revive_pending = []         # (revive_tick, shard_id)
+    last_revive_tick = None
     base_health = shard_stats()
 
     def pump():
@@ -829,6 +856,7 @@ def run_shard_leg(name, *, n_shards=4, tenants=16, requests=800,
             if router.ticks >= rtick:
                 revive_pending.remove((rtick, sid))
                 router.revive_shard(sid)
+                last_revive_tick = router.ticks
                 if rebalance_after_revive:
                     router.rebalance()
                 if audit_rounds:
@@ -858,6 +886,16 @@ def run_shard_leg(name, *, n_shards=4, tenants=16, requests=800,
         pump()
         harvest()
     drained = drain_quiet(budget=2400)
+    fixed_point = None
+    if ctrl is not None:
+        # the convergence hold: pump 10 quiet decision windows with no
+        # arrivals — a converged controller makes ZERO further
+        # decisions (an oscillating one keeps hunting and fails here)
+        before = len(ctrl.decision_log())
+        for _ in range(10 * control_window):
+            pump()
+        harvest()
+        fixed_point = len(ctrl.decision_log()) == before
     elapsed = time.perf_counter() - start   # serving window: audits are
     final = audit('final')                  # verification, not serving
 
@@ -905,6 +943,39 @@ def run_shard_leg(name, *, n_shards=4, tenants=16, requests=800,
         ok = ok and all(m['mttr_ticks'] is not None and
                         m['mttr_ticks'] <= mttr_bound
                         for m in mttrs if m['tenants'])
+    if ctrl is not None:
+        gauges = ctrl.gauges()
+        per_policy = {}
+        for (policy, _action, _mode), n in gauges['decisions'].items():
+            per_policy[policy] = per_policy.get(policy, 0) + n
+        last_tick = gauges['last_decision_tick']
+        settle = None
+        if last_revive_tick is not None and last_tick is not None and \
+                last_tick > last_revive_tick:
+            settle = last_tick - last_revive_tick
+        report['control'] = {
+            'mode': control,
+            'window': control_window,
+            'windows': gauges['windows'],
+            'decisions': per_policy,
+            'actuations': sum(
+                n for (_p, _a, mode), n in gauges['decisions'].items()
+                if mode == 'active'),
+            'reversals': gauges['reversals'],
+            'last_decision_tick': last_tick,
+            'last_revive_tick': last_revive_tick,
+            'settle_ticks': settle,
+            'fixed_point': fixed_point,
+            'decide_s_max': gauges['decide_s_max'],
+            'ledger_entries': len(ctrl.decision_log()),
+        }
+        # the anti-oscillation bound: a policy flip-flopping on one
+        # target more than twice in an episode is hunting, not
+        # converging — and the post-drain hold must be decision-free
+        ok = ok and all(n <= 2 for n in gauges['reversals'].values())
+        ok = ok and fixed_point
+        if settle_bound is not None and last_revive_tick is not None:
+            ok = ok and (settle is None or settle <= settle_bound)
     report['ok'] = ok
     router.close()
     return report
@@ -1195,7 +1266,10 @@ def main():
         return
     if n_shards:
         # multi-shard mode: a clean leg plus a kill-one-shard chaos leg
-        # (kill at 1/3 of the arrival window, revive at 2/3)
+        # (kill at 1/3 of the arrival window, revive at 2/3).
+        # LOADGEN_CONTROL=active|shadow adds the self-driving leg: the
+        # same kill schedule with a control.Controller on the pump and
+        # the hardcoded post-revive rebalance handed to its heal lane.
         arrivals = 8
         window = max(1, requests // arrivals)
         legs = [
@@ -1206,12 +1280,23 @@ def main():
                           chaos=True, seed=seed + 1,
                           kills=((window // 3, 0, 2 * window // 3),)),
         ]
+        control_mode = os.environ.get('LOADGEN_CONTROL')
+        if control_mode:
+            legs.append(run_shard_leg(
+                'shard_control', n_shards=n_shards, tenants=tenants,
+                requests=requests, chaos=True, seed=seed + 2,
+                kills=((window // 3, 0, 2 * window // 3),),
+                control=control_mode, settle_bound=400))
         for leg in legs:
             print(json.dumps(leg))
+            ctl = leg.get('control')
+            ctl_s = (f", control {ctl['decisions']} decisions "
+                     f"{ctl['reversals']} reversals "
+                     f"settle {ctl['settle_ticks']} ticks") if ctl else ''
             print(f"# {leg['leg']}: {leg['completed_ok']}/"
                   f"{leg['submitted']} ok, {leg['failovers']} failovers, "
                   f"mttr {leg['mttr_ticks']} ticks, audit "
-                  f"{leg['final_audit']}, "
+                  f"{leg['final_audit']}{ctl_s}, "
                   f"{'OK' if leg['ok'] else 'FAIL'}", file=sys.stderr)
             if not leg['ok']:
                 sys.exit(1)

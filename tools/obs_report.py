@@ -13,6 +13,8 @@ Usage:
                                python tools/obs_report.py --archlint -
     python tools/obs_report.py --floor kernel_ledger.json [trace.json]
     python tools/obs_report.py --trajectory [BENCH_LEDGER.jsonl]
+    python tools/obs_report.py --control control_ledger.json [--json]
+    python tools/obs_report.py --control flight-quarantine-1.json
 
 Floor mode renders the RESIDUAL-FLOOR table the ROADMAP used to carry
 as a hand-measured note: per device-kernel-kind dispatch counts,
@@ -46,6 +48,15 @@ With a second (baseline) dump, the health counters print as the DELTA
 between the two dumps — the counter twin of the histogram delta, so two
 forensic snapshots bracket an incident the way two bench snapshots
 bracket a workload.
+
+Control mode renders the control plane's why-did-it-act timeline from
+a ``Controller.dump_decisions`` ledger or a flight dump's
+control_decision events: per decision the tick, policy/action/target,
+direction, applied/shadow/refused flag, the input signal snapshot that
+justified it, and the trace ids of affected in-flight requests —
+reversals flagged inline. ``--json`` keeps stdout a single
+machine-readable JSON object (the ``--archlint -`` pipe discipline),
+and ``-`` reads either payload shape from stdin.
 
 Stitch mode merges span exports from MULTIPLE peers — Chrome traces
 (export_chrome_trace) or flight dumps (their ``recent_spans``) — into
@@ -420,6 +431,129 @@ def render_archlint(path, out=None):
                      data.get('stale') or data.get('errors')) else 1
 
 
+def _control_payload(path):
+    """Load a ``--control`` input: a ``Controller.dump_decisions``
+    ledger, a flight-recorder dump (its control_decision events), or
+    ``-`` for either on stdin. Returns (decisions, gauges, mode)."""
+    if path == '-':
+        data = json.load(sys.stdin)
+    else:
+        with open(path) as f:
+            data = json.load(f)
+    if data.get('kind') == 'control_ledger':
+        return (data.get('decisions', []), data.get('gauges', {}),
+                data.get('mode'))
+    if 'events' in data:         # a dump_flight_record forensic report
+        decisions = [e for e in data['events']
+                     if e.get('kind') == 'control_decision']
+        return decisions, {}, None
+    raise ValueError(
+        f'{path}: neither a control ledger (kind=control_ledger) nor '
+        f'a flight dump (events=[...])')
+
+
+def render_control(path, json_out=False, out=None):
+    """The why-did-it-act timeline: every control-plane decision with
+    the signal snapshot that justified it and the trace ids of the
+    in-flight requests it touched. With ``json_out`` the report is a
+    single machine-readable JSON object on stdout (the ``--archlint
+    --json -`` pipe discipline: nothing else lands on stdout)."""
+    try:
+        decisions, gauges, mode = _control_payload(path)
+    except (ValueError, KeyError) as exc:
+        print(f'unsupported --control payload: {exc}', file=sys.stderr)
+        return 2
+    per_policy = {}
+    reversals = {}
+    applied = shadow = 0
+    for d in decisions:
+        key = (d.get('policy', '?'), d.get('action', '?'))
+        per_policy[key] = per_policy.get(key, 0) + 1
+        if d.get('reversal'):
+            pol = d.get('policy', '?')
+            reversals[pol] = reversals.get(pol, 0) + 1
+        if d.get('mode') == 'shadow':
+            shadow += 1
+        elif d.get('applied'):
+            applied += 1
+    if json_out:
+        report = {'kind': 'control_report', 'mode': mode,
+                  'decisions': len(decisions), 'applied': applied,
+                  'shadow': shadow,
+                  'per_policy': {f'{p}/{a}': n
+                                 for (p, a), n in sorted(per_policy.items())},
+                  'reversals': reversals, 'gauges': gauges,
+                  'timeline': decisions}
+        json.dump(report, sys.stdout, indent=1, default=repr)
+        sys.stdout.write('\n')
+        return 0 if decisions or gauges else 1
+    out = out if out is not None else sys.stdout
+    mode_s = f' mode={mode}' if mode else ''
+    print(f'# control plane: {len(decisions)} decisions'
+          f' ({applied} applied, {shadow} shadow,'
+          f' {sum(reversals.values())} reversals){mode_s}', file=out)
+    for (pol, act), n in sorted(per_policy.items()):
+        rev = reversals.get(pol, 0)
+        print(f'  {pol:<16} {act:<16} {n:3d} decisions'
+              + (f'  {rev} reversals' if rev else ''), file=out)
+    if gauges:
+        print(f'# windows={gauges.get("windows")} '
+              f'ticks={gauges.get("ticks")} '
+              f'last_decision_tick={gauges.get("last_decision_tick")} '
+              f'decide_s_last={gauges.get("decide_s_last", 0):.6f} '
+              f'decide_s_max={gauges.get("decide_s_max", 0):.6f}',
+              file=out)
+        active = gauges.get('active') or {}
+        for key, value in sorted(active.items(), key=repr):
+            print(f'  active {key}: {value}', file=out)
+    if decisions:
+        print('# timeline (oldest first):', file=out)
+    for d in decisions:
+        flags = []
+        if d.get('mode') == 'shadow':
+            flags.append('SHADOW')
+        elif d.get('applied'):
+            flags.append('applied')
+        else:
+            flags.append('REFUSED')
+        if d.get('reversal'):
+            flags.append('REVERSAL')
+        head = (f'  tick {d.get("tick", "?"):>6} '
+                f'{d.get("policy", "?")}/{d.get("action", "?")} '
+                f'{d.get("target", "")} '
+                f'dir={d.get("direction", "")} [{" ".join(flags)}]')
+        print(head, file=out)
+        if d.get('detail'):
+            print(f'    why: {d["detail"]}', file=out)
+        sig = d.get('signals') or {}
+        adm = sig.get('admission') or {}
+        bits = []
+        if adm:
+            bits.append(f'reject_frac={adm.get("reject_frac", 0):.3f} '
+                        f'queue={adm.get("queue_pressure", 0):.3f}')
+        ten = sig.get('tenant') or {}
+        if ten:
+            bits.append(f'tenant admitted_d={ten.get("admitted_d")} '
+                        f'throttled_d={ten.get("throttled_d")} '
+                        f'rate={ten.get("rate")}')
+        wm = (sig.get('watermark') or {}).get('pressure')
+        if wm is not None:
+            bits.append(f'watermark={wm:.3f}')
+        if 'pump_mean_s' in sig:
+            bits.append(f'pump_mean_s={sig["pump_mean_s"]:.6f} '
+                        f'misplaced={len(sig.get("misplaced", ()))}')
+        if bits:
+            print(f'    signals: {"; ".join(bits)}', file=out)
+        traces = d.get('traces') or []
+        if traces:
+            print(f'    traces: {", ".join(str(t) for t in traces)}',
+                  file=out)
+    if not decisions:
+        print('# no control decisions in the window '
+              '(a quiet controller is a converged controller)', file=out)
+    return 0
+
+
 def render_floor(ledger_path, trace_path=None, out=None):
     """The residual-floor table: device kernels (cost ledger) and,
     when a trace is given, the host phases they compete with."""
@@ -498,6 +632,15 @@ def main(argv):
                   '(or - for stdin)', file=sys.stderr)
             return 2
         return render_archlint(argv[1])
+    if argv[0] == '--control':
+        rest = [a for a in argv[1:] if a != '--json']
+        json_out = '--json' in argv[1:]
+        if not rest:
+            print('--control needs a control-ledger JSON '
+                  '(Controller.dump_decisions), a flight dump, '
+                  'or - for stdin', file=sys.stderr)
+            return 2
+        return render_control(rest[0], json_out=json_out)
     if argv[0] == '--metrics':
         if len(argv) < 2:
             print('--metrics needs an exposition-file path',
